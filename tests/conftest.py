@@ -1,0 +1,31 @@
+"""Test harness configuration.
+
+JAX runs on a virtual 8-device CPU mesh so all sharding/collective paths are
+exercised without TPU hardware (the analog of the reference faking its world
+with envtest + httptest + gomonkey, SURVEY.md §4). Must run before any jax
+import, hence the env mutation at module import time.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import pytest  # noqa: E402
+
+from tpu_composer.runtime.store import Store  # noqa: E402
+
+
+@pytest.fixture()
+def store(tmp_path):
+    """Fresh in-memory store (no persistence)."""
+    return Store()
+
+
+@pytest.fixture()
+def persistent_store(tmp_path):
+    return Store(persist_dir=str(tmp_path / "state"))

@@ -1,0 +1,134 @@
+"""Schema validation + serde round-trips for the API types.
+
+Mirrors the kubebuilder validation markers asserted by the reference CRDs
+(api/v1alpha1/composabilityrequest_types.go:40-53).
+"""
+
+import pytest
+
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    ComposableResourceSpec,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+    OtherSpec,
+    default_scheme,
+)
+from tpu_composer.api.types import ValidationError
+
+
+def make_request(**overrides) -> ComposabilityRequest:
+    details = dict(type="tpu", model="tpu-v4", size=4)
+    details.update(overrides)
+    return ComposabilityRequest(
+        metadata=ObjectMeta(name="req-1"),
+        spec=ComposabilityRequestSpec(resource=ResourceDetails(**details)),
+    )
+
+
+class TestValidation:
+    def test_valid_request_passes(self):
+        make_request().validate()
+
+    def test_bad_type_rejected(self):
+        with pytest.raises(ValidationError):
+            make_request(type="fpga").validate()
+
+    def test_empty_model_rejected(self):
+        with pytest.raises(ValidationError):
+            make_request(model="").validate()
+
+    def test_negative_size_rejected(self):
+        with pytest.raises(ValidationError):
+            make_request(size=-1).validate()
+
+    def test_bad_policy_rejected(self):
+        with pytest.raises(ValidationError):
+            make_request(allocation_policy="anywhere").validate()
+
+    def test_negative_other_spec_rejected(self):
+        with pytest.raises(ValidationError):
+            make_request(other_spec=OtherSpec(milli_cpu=-5)).validate()
+
+    def test_resource_requires_target_node(self):
+        res = ComposableResource(
+            metadata=ObjectMeta(name="r"),
+            spec=ComposableResourceSpec(type="tpu", model="tpu-v4", target_node=""),
+        )
+        with pytest.raises(ValidationError):
+            res.validate()
+
+    def test_gpu_compat_type_accepted(self):
+        # BASELINE.json config[0]: gpu requests must still work.
+        make_request(type="gpu", model="A100 40G", size=1).validate()
+
+
+class TestSerde:
+    def test_request_roundtrip(self):
+        req = make_request(topology="2x2", allocation_policy="topology")
+        req.metadata.labels["a"] = "b"
+        req.metadata.finalizers.append("tpu.composer.dev/finalizer")
+        req.status.state = "Running"
+        req.status.scalar_resource = req.spec.resource
+        d = req.to_dict()
+        back = ComposabilityRequest.from_dict(d)
+        assert back.to_dict() == d
+        assert back.spec.resource.topology == "2x2"
+        assert back.status.scalar_resource.model == "tpu-v4"
+
+    def test_resource_roundtrip(self):
+        res = ComposableResource(
+            metadata=ObjectMeta(name="tpu-abc"),
+            spec=ComposableResourceSpec(
+                type="tpu", model="tpu-v4", target_node="worker-0",
+                chip_count=4, slice_name="req-1-slice", worker_id=2, topology="2x2x4",
+            ),
+        )
+        res.status.device_ids = ["chip-0", "chip-1"]
+        d = res.to_dict()
+        back = ComposableResource.from_dict(d)
+        assert back.to_dict() == d
+        assert back.spec.worker_id == 2
+
+    def test_scheme_decode_by_kind(self):
+        s = default_scheme()
+        req = make_request()
+        obj = s.decode(req.to_dict())
+        assert isinstance(obj, ComposabilityRequest)
+        assert set(s.kinds()) == {"ComposabilityRequest", "ComposableResource", "Node"}
+
+    def test_deepcopy_isolation(self):
+        req = make_request()
+        cp = req.deepcopy()
+        cp.spec.resource.size = 99
+        cp.metadata.labels["x"] = "y"
+        assert req.spec.resource.size == 4
+        assert "x" not in req.metadata.labels
+
+
+class TestMetaHelpers:
+    def test_finalizer_add_remove(self):
+        req = make_request()
+        assert req.add_finalizer("f1")
+        assert not req.add_finalizer("f1")
+        assert req.has_finalizer("f1")
+        assert req.remove_finalizer("f1")
+        assert not req.remove_finalizer("f1")
+
+    def test_owner_references(self):
+        req = make_request()
+        req.metadata.uid = "uid-1"
+        child = ComposableResource(metadata=ObjectMeta(name="c"))
+        child.set_owner(req)
+        assert child.owned_by(req)
+        child.set_owner(req)  # idempotent
+        assert len(child.metadata.owner_references) == 1
+
+    def test_node_roundtrip(self):
+        n = Node(metadata=ObjectMeta(name="worker-0"))
+        n.status.tpu_slots = 4
+        back = Node.from_dict(n.to_dict())
+        assert back.status.tpu_slots == 4

@@ -1,0 +1,234 @@
+"""Work queue, controller loop, manager, leader election, metrics."""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_composer.api import ComposabilityRequest, ComposabilityRequestSpec, ObjectMeta, ResourceDetails
+from tpu_composer.runtime.controller import Controller, Result
+from tpu_composer.runtime.leader import LeaderElector
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.metrics import Registry
+from tpu_composer.runtime.queue import RateLimitingQueue
+from tpu_composer.runtime.store import Store, WatchEvent
+
+
+def req(name="req-1"):
+    return ComposabilityRequest(
+        metadata=ObjectMeta(name=name),
+        spec=ComposabilityRequestSpec(resource=ResourceDetails(type="tpu", model="tpu-v4", size=1)),
+    )
+
+
+class TestQueue:
+    def test_dedup_while_queued(self):
+        q = RateLimitingQueue()
+        q.add("a")
+        q.add("a")
+        assert q.get(timeout=0.1) == "a"
+        assert q.get(timeout=0.05) is None
+
+    def test_readd_while_processing_requeues_on_done(self):
+        q = RateLimitingQueue()
+        q.add("a")
+        key = q.get(timeout=0.1)
+        q.add("a")  # in-flight → dirty
+        assert q.get(timeout=0.05) is None  # not yet requeued
+        q.done(key)
+        assert q.get(timeout=0.1) == "a"
+
+    def test_add_after_delays(self):
+        q = RateLimitingQueue()
+        t0 = time.monotonic()
+        q.add_after("a", 0.15)
+        assert q.get(timeout=0.05) is None
+        assert q.get(timeout=1.0) == "a"
+        assert time.monotonic() - t0 >= 0.15
+
+    def test_rate_limited_backoff_grows_and_forget_resets(self):
+        q = RateLimitingQueue(base_delay=0.05, max_delay=1.0)
+        q.add_rate_limited("a")
+        assert q.retries("a") == 1
+        q.add_rate_limited("a")
+        assert q.retries("a") == 2
+        q.forget("a")
+        assert q.retries("a") == 0
+
+    def test_shutdown_unblocks(self):
+        q = RateLimitingQueue()
+        out = []
+
+        def getter():
+            out.append(q.get())
+
+        t = threading.Thread(target=getter)
+        t.start()
+        time.sleep(0.05)
+        q.shutdown()
+        t.join(timeout=1)
+        assert out == [None]
+
+
+class CountingController(Controller):
+    primary_kind = "ComposabilityRequest"
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.seen = []
+        self.reconciled = threading.Event()
+
+    def reconcile(self, name):
+        self.seen.append(name)
+        self.reconciled.set()
+        return Result()
+
+
+class TestControllerLoop:
+    def test_events_drive_reconcile(self, store):
+        c = CountingController(store)
+        c.start()
+        try:
+            store.create(req())
+            assert c.reconciled.wait(2)
+            assert "req-1" in c.seen
+        finally:
+            c.stop()
+
+    def test_initial_wave_covers_existing_objects(self, store):
+        store.create(req("pre-existing"))
+        c = CountingController(store)
+        c.start()
+        try:
+            assert c.reconciled.wait(2)
+            assert "pre-existing" in c.seen
+        finally:
+            c.stop()
+
+    def test_secondary_watch_with_mapper_and_predicate(self, store):
+        class MappedController(CountingController):
+            primary_kind = ""  # only the secondary watch below
+
+        c = MappedController(store)
+        c.watch(
+            "ComposabilityRequest",
+            mapper=lambda ev: [f"mapped-{ev.obj.metadata.name}"],
+            predicate=lambda ev: ev.obj.metadata.name != "skip",
+        )
+        c.start()
+        try:
+            store.create(req("skip"))
+            store.create(req("take"))
+            assert c.reconciled.wait(2)
+            time.sleep(0.1)
+            assert c.seen == ["mapped-take"]
+        finally:
+            c.stop()
+
+    def test_error_retries_with_backoff(self, store):
+        class FlakyController(Controller):
+            primary_kind = "ComposabilityRequest"
+
+            def __init__(self, store):
+                super().__init__(store)
+                self.calls = 0
+                self.succeeded = threading.Event()
+
+            def reconcile(self, name):
+                self.calls += 1
+                if self.calls < 3:
+                    raise RuntimeError("boom")
+                self.succeeded.set()
+                return Result()
+
+        c = FlakyController(store)
+        c.start()
+        try:
+            store.create(req())
+            assert c.succeeded.wait(5)
+            assert c.calls == 3
+        finally:
+            c.stop()
+
+    def test_requeue_after_causes_second_reconcile(self, store):
+        class RequeueOnce(Controller):
+            primary_kind = "ComposabilityRequest"
+
+            def __init__(self, store):
+                super().__init__(store)
+                self.calls = 0
+                self.twice = threading.Event()
+
+            def reconcile(self, name):
+                self.calls += 1
+                if self.calls >= 2:
+                    self.twice.set()
+                    return Result()
+                return Result(requeue_after=0.05)
+
+        c = RequeueOnce(store)
+        c.start()
+        try:
+            store.create(req())
+            assert c.twice.wait(2)
+        finally:
+            c.stop()
+
+
+class TestManager:
+    def test_health_endpoints_and_metrics(self, store):
+        m = Manager(store=store, health_addr="127.0.0.1:0")
+        c = CountingController(store)
+        m.add_controller(c)
+        m.start()
+        try:
+            port = m.health_port
+            body = urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz").read()
+            assert body == b"ok"
+            ready = urllib.request.urlopen(f"http://127.0.0.1:{port}/readyz")
+            assert ready.status == 200
+            metrics = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics").read().decode()
+            assert "tpuc_attach_to_ready_seconds" in metrics
+        finally:
+            m.stop()
+
+    def test_runnable_receives_stop_event(self, store):
+        stopped = threading.Event()
+
+        def runnable(stop_event):
+            stop_event.wait(5)
+            stopped.set()
+
+        m = Manager(store=store)
+        m.add_runnable(runnable)
+        m.start()
+        m.stop()
+        assert stopped.wait(1)
+
+
+class TestLeaderElection:
+    def test_second_elector_blocks_until_release(self, tmp_path):
+        path = str(tmp_path / "leader.lock")
+        a, b = LeaderElector(path), LeaderElector(path)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        a.release()
+        assert b.try_acquire()
+        b.release()
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram_exposition(self):
+        r = Registry()
+        r.counter("c_total", "help").inc(controller="x")
+        r.gauge("g", "help").set(3.5, node="n0")
+        h = r.histogram("h_seconds", "help", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v, op="attach")
+        text = r.expose_text()
+        assert 'c_total{controller="x"} 1.0' in text
+        assert 'g{node="n0"} 3.5' in text
+        assert 'h_seconds_bucket{op="attach",le="+Inf"} 3' in text
+        assert h.count(op="attach") == 3
+        assert h.percentile(0.5, op="attach") == 0.5

@@ -1,0 +1,235 @@
+"""Store semantics: CRUD, optimistic concurrency, status subresource,
+finalizer-gated deletion, watches, label selection, persistence/resume.
+
+These are the API-server behaviors the reference operator assumes of
+Kubernetes (SURVEY.md §4's envtest layer); everything downstream builds on
+them, so they are pinned exhaustively here.
+"""
+
+import pytest
+
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    ComposableResourceSpec,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.runtime.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Store,
+)
+
+
+def req(name="req-1", size=4) -> ComposabilityRequest:
+    return ComposabilityRequest(
+        metadata=ObjectMeta(name=name),
+        spec=ComposabilityRequestSpec(
+            resource=ResourceDetails(type="tpu", model="tpu-v4", size=size)
+        ),
+    )
+
+
+def res(name="tpu-1", node="worker-0") -> ComposableResource:
+    return ComposableResource(
+        metadata=ObjectMeta(name=name),
+        spec=ComposableResourceSpec(type="tpu", model="tpu-v4", target_node=node),
+    )
+
+
+class TestCrud:
+    def test_create_assigns_system_fields(self, store):
+        created = store.create(req())
+        assert created.metadata.uid
+        assert created.metadata.resource_version > 0
+        assert created.metadata.generation == 1
+        assert created.metadata.creation_timestamp
+
+    def test_create_duplicate_rejected(self, store):
+        store.create(req())
+        with pytest.raises(AlreadyExistsError):
+            store.create(req())
+
+    def test_get_missing_raises(self, store):
+        with pytest.raises(NotFoundError):
+            store.get(ComposabilityRequest, "nope")
+        assert store.try_get(ComposabilityRequest, "nope") is None
+
+    def test_get_returns_isolated_copy(self, store):
+        store.create(req())
+        a = store.get(ComposabilityRequest, "req-1")
+        a.spec.resource.size = 123
+        b = store.get(ComposabilityRequest, "req-1")
+        assert b.spec.resource.size == 4
+
+    def test_list_by_label(self, store):
+        r1, r2 = res("a"), res("b")
+        r1.metadata.labels["app.kubernetes.io/managed-by"] = "req-1"
+        r2.metadata.labels["app.kubernetes.io/managed-by"] = "req-2"
+        store.create(r1)
+        store.create(r2)
+        got = store.list(
+            ComposableResource, label_selector={"app.kubernetes.io/managed-by": "req-1"}
+        )
+        assert [o.metadata.name for o in got] == ["a"]
+
+    def test_update_bumps_generation_only_on_spec_change(self, store):
+        store.create(req())
+        obj = store.get(ComposabilityRequest, "req-1")
+        obj.metadata.labels["x"] = "y"
+        obj = store.update(obj)
+        assert obj.metadata.generation == 1  # metadata-only change
+        obj.spec.resource.size = 8
+        obj = store.update(obj)
+        assert obj.metadata.generation == 2
+
+    def test_conflict_on_stale_resource_version(self, store):
+        store.create(req())
+        a = store.get(ComposabilityRequest, "req-1")
+        b = store.get(ComposabilityRequest, "req-1")
+        a.spec.resource.size = 8
+        store.update(a)
+        b.spec.resource.size = 16
+        with pytest.raises(ConflictError):
+            store.update(b)
+
+
+class TestStatusSubresource:
+    def test_update_ignores_status(self, store):
+        store.create(req())
+        obj = store.get(ComposabilityRequest, "req-1")
+        obj.status.state = "Running"
+        store.update(obj)  # status change must NOT persist through update()
+        assert store.get(ComposabilityRequest, "req-1").status.state == ""
+
+    def test_update_status_ignores_spec(self, store):
+        store.create(req())
+        obj = store.get(ComposabilityRequest, "req-1")
+        obj.status.state = "NodeAllocating"
+        obj.spec.resource.size = 99
+        store.update_status(obj)
+        back = store.get(ComposabilityRequest, "req-1")
+        assert back.status.state == "NodeAllocating"
+        assert back.spec.resource.size == 4
+
+    def test_update_status_conflict(self, store):
+        store.create(req())
+        a = store.get(ComposabilityRequest, "req-1")
+        store.update_status(a)
+        with pytest.raises(ConflictError):
+            store.update_status(a)
+
+
+class TestFinalizerDeletion:
+    def test_delete_without_finalizers_purges(self, store):
+        store.create(req())
+        store.delete(ComposabilityRequest, "req-1")
+        assert store.try_get(ComposabilityRequest, "req-1") is None
+
+    def test_delete_with_finalizer_marks_terminating(self, store):
+        obj = req()
+        obj.add_finalizer("tpu.composer.dev/finalizer")
+        store.create(obj)
+        store.delete(ComposabilityRequest, "req-1")
+        got = store.get(ComposabilityRequest, "req-1")
+        assert got.being_deleted
+        # second delete is a no-op, not an error
+        store.delete(ComposabilityRequest, "req-1")
+
+    def test_removing_last_finalizer_purges(self, store):
+        obj = req()
+        obj.add_finalizer("f")
+        store.create(obj)
+        store.delete(ComposabilityRequest, "req-1")
+        got = store.get(ComposabilityRequest, "req-1")
+        got.remove_finalizer("f")
+        store.update(got)
+        assert store.try_get(ComposabilityRequest, "req-1") is None
+
+
+class TestWatch:
+    def test_watch_sees_lifecycle(self, store):
+        q = store.watch("ComposabilityRequest")
+        store.create(req())
+        obj = store.get(ComposabilityRequest, "req-1")
+        obj.spec.resource.size = 8
+        store.update(obj)
+        store.delete(ComposabilityRequest, "req-1")
+        events = [q.get(timeout=1) for _ in range(3)]
+        assert [e.type for e in events] == [ADDED, MODIFIED, DELETED]
+
+    def test_watch_filters_kind(self, store):
+        q = store.watch("ComposableResource")
+        store.create(req())
+        store.create(res())
+        ev = q.get(timeout=1)
+        assert ev.obj.KIND == "ComposableResource"
+        assert q.empty()
+
+    def test_status_update_emits_modified(self, store):
+        store.create(req())
+        q = store.watch("ComposabilityRequest")
+        obj = store.get(ComposabilityRequest, "req-1")
+        obj.status.state = "Running"
+        store.update_status(obj)
+        assert q.get(timeout=1).type == MODIFIED
+
+
+class TestAdmission:
+    def test_admission_can_reject(self, store):
+        def deny(op, new, old):
+            if op == "CREATE" and new.spec.resource.size > 8:
+                raise ValueError("too big")
+
+        store.register_admission("ComposabilityRequest", deny)
+        store.create(req(size=8))
+        with pytest.raises(ValueError):
+            store.create(req(name="big", size=16))
+
+    def test_admission_can_mutate(self, store):
+        def default_model(op, new, old):
+            if not new.spec.resource.model:
+                new.spec.resource.model = "tpu-v4"
+
+        store.register_admission("*", default_model)
+        r = req()
+        r.spec.resource.model = ""
+        created = store.create(r)
+        assert created.spec.resource.model == "tpu-v4"
+
+
+class TestPersistence:
+    def test_restart_resumes_state(self, tmp_path):
+        """CRD-as-checkpoint (SURVEY.md §5): restart resumes mid-state-machine."""
+        state = str(tmp_path / "state")
+        s1 = Store(persist_dir=state)
+        obj = req()
+        obj.add_finalizer("f")
+        s1.create(obj)
+        got = s1.get(ComposabilityRequest, "req-1")
+        got.status.state = "NodeAllocating"
+        s1.update_status(got)
+        rv = s1.get(ComposabilityRequest, "req-1").metadata.resource_version
+
+        s2 = Store(persist_dir=state)
+        back = s2.get(ComposabilityRequest, "req-1")
+        assert back.status.state == "NodeAllocating"
+        assert back.metadata.resource_version == rv
+        assert back.has_finalizer("f")
+        # resourceVersion counter resumes past the old max
+        s2.create(req(name="req-2"))
+        assert s2.get(ComposabilityRequest, "req-2").metadata.resource_version > rv
+
+    def test_purge_removes_file(self, tmp_path):
+        state = str(tmp_path / "state")
+        s1 = Store(persist_dir=state)
+        s1.create(req())
+        s1.delete(ComposabilityRequest, "req-1")
+        s2 = Store(persist_dir=state)
+        assert s2.try_get(ComposabilityRequest, "req-1") is None

@@ -1,0 +1,35 @@
+"""tpu-composer: a TPU-native composable-resource framework.
+
+A from-scratch rebuild of the capabilities of CoHDI/composable-resource-operator
+(reference: /root/reference, a Go/kubebuilder K8s operator that hot-attaches
+fabric-composable NVIDIA GPUs to cluster nodes) with TPUs as the first-class
+device type:
+
+- ``ComposabilityRequest{deviceType: tpu, count: N}`` drives a pluggable
+  fabric/pool provider to reserve chips and program the ICI mesh into a valid
+  slice topology (reference analog: internal/cdi/* fabric clients).
+- Per chip-group ``ComposableResource`` objects run the attach/online/detach
+  lifecycle (reference analog: internal/controller/composableresource_controller.go).
+- A node agent generates CDI specs exposing ``/dev/accel*`` + libtpu mounts and
+  verifies chip visibility/load (reference analog: internal/utils/gpus.go, which
+  shells nvidia-smi via pod-exec).
+- Admission webhooks validate requests and inject ``TPU_WORKER_ID`` /
+  ``TPU_WORKER_HOSTNAMES`` coordinates (reference analog:
+  internal/webhook/v1alpha1, validation only).
+- A JAX workload layer (``tpu_composer.workload``, ``tpu_composer.parallel``,
+  ``tpu_composer.models``) consumes the injected coordinates and runs sharded
+  compute (collectives, ring attention, train steps) on the composed slice —
+  the piece the reference, which never touches model execution, lacks.
+
+The control plane is an in-process, watchable, persistent object store with
+controller-runtime-style reconcilers (``tpu_composer.runtime``); it can stand
+alone (tests, benches, single-box deployments) and mirrors the Kubernetes
+semantics the reference relies on (optimistic concurrency, status subresource,
+finalizers, watches).
+"""
+
+__version__ = "0.1.0"
+
+GROUP = "cro.tpu.composer.dev"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"

@@ -1,0 +1,44 @@
+"""API object model: typed objects, metadata, scheme registry, validation.
+
+Reference analog: /root/reference/api/v1alpha1 (CRD Go structs + generated
+deepcopy + scheme registration). Here the types are plain dataclasses with
+dict/JSON serde, a kind registry, and field validators mirroring the
+kubebuilder validation markers.
+"""
+
+from tpu_composer.api.meta import ObjectMeta, OwnerReference, now_iso
+from tpu_composer.api.scheme import Scheme, default_scheme
+from tpu_composer.api.types import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposabilityRequestStatus,
+    ComposableResource,
+    ComposableResourceSpec,
+    ComposableResourceStatus,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ResourceDetails,
+    ResourceStatus,
+    OtherSpec,
+)
+
+__all__ = [
+    "ObjectMeta",
+    "OwnerReference",
+    "now_iso",
+    "Scheme",
+    "default_scheme",
+    "ComposabilityRequest",
+    "ComposabilityRequestSpec",
+    "ComposabilityRequestStatus",
+    "ComposableResource",
+    "ComposableResourceSpec",
+    "ComposableResourceStatus",
+    "Node",
+    "NodeSpec",
+    "NodeStatus",
+    "ResourceDetails",
+    "ResourceStatus",
+    "OtherSpec",
+]

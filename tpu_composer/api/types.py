@@ -1,0 +1,517 @@
+"""CRD-equivalent typed objects.
+
+Reference analog:
+- ComposabilityRequest: /root/reference/api/v1alpha1/composabilityrequest_types.go:36-106
+- ComposableResource:   /root/reference/api/v1alpha1/composableresource_types.go:27-56
+
+TPU-first deltas from the reference's data model:
+- ``type: tpu`` is first-class; ``size`` means chip count and must solve to a
+  valid ICI slice topology (see tpu_composer.topology.slices), not N
+  independent devices.
+- A ComposableResource represents one *chip group on one host* (a slice
+  member), carrying ``chip_count``, ``slice_name``, ``worker_id`` and
+  ``topology`` — because TPU slices are allocated as connected topologies
+  (SURVEY.md §5 "slice topology" note), unlike the reference's strictly
+  per-device children.
+- Status carries ``device_ids`` (list of chip UUIDs) instead of the single
+  ``device_id`` string at composableresource_types.go:40.
+- The request status gains a ``slice`` block (topology + worker hostnames) that
+  the mutating webhook uses to inject ``TPU_*`` coordinates consistently with
+  the final allocation (SURVEY.md §7 hard-part #4).
+
+State strings deliberately match the reference's controller literals
+(composableresource_controller.go:107-127, composabilityrequest_controller.go:108-142)
+so operational knowledge transfers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tpu_composer.api.meta import ApiObject, ObjectMeta
+
+# --- state machines (string literals, as the reference's controllers use) ---
+
+# ComposabilityRequest states — composabilityrequest_controller.go:108-142
+REQUEST_STATE_EMPTY = ""
+REQUEST_STATE_NODE_ALLOCATING = "NodeAllocating"
+REQUEST_STATE_UPDATING = "Updating"
+REQUEST_STATE_RUNNING = "Running"
+REQUEST_STATE_CLEANING = "Cleaning"
+REQUEST_STATE_DELETING = "Deleting"
+
+# ComposableResource states — composableresource_controller.go:107-127
+RESOURCE_STATE_EMPTY = ""
+RESOURCE_STATE_ATTACHING = "Attaching"
+RESOURCE_STATE_ONLINE = "Online"
+RESOURCE_STATE_DETACHING = "Detaching"
+RESOURCE_STATE_DELETING = "Deleting"
+
+# Device types — reference enum gpu|cxlmemory (composabilityrequest_types.go:41);
+# tpu is our first-class addition.
+DEVICE_TYPES = ("tpu", "gpu", "cxlmemory")
+
+# Allocation policies — reference enum samenode|differentnode
+# (composabilityrequest_types.go:47-49); "topology" is the TPU-native policy:
+# place a connected slice across however many hosts its shape requires.
+ALLOCATION_POLICIES = ("samenode", "differentnode", "topology")
+
+FINALIZER = "tpu.composer.dev/finalizer"  # analog of com.ie.ibm.hpsys/finalizer
+
+# Annotations (reference: cohdi.io/* at composabilityrequest_controller.go:46-47)
+ANNOTATION_LAST_USED_TIME = "tpu.composer.dev/last-used-time"
+ANNOTATION_DELETE_DEVICE = "tpu.composer.dev/delete-device"
+LABEL_MANAGED_BY = "app.kubernetes.io/managed-by"
+LABEL_READY_TO_DETACH = "tpu.composer.dev/ready-to-detach-device-id"
+
+
+class ValidationError(ValueError):
+    """Schema-level rejection, the analog of kubebuilder validation markers."""
+
+
+# --------------------------------------------------------------------------
+# Shared spec fragments
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class OtherSpec:
+    """Extra node capacity the allocator must leave available.
+
+    Reference: NodeSpec at composabilityrequest_types.go:56-64 (milli_cpu,
+    memory, ephemeral_storage, allowed_pod_number) used by
+    CheckNodeCapacitySufficient (utils/nodes.go:78-117).
+    """
+
+    milli_cpu: int = 0
+    memory: int = 0
+    ephemeral_storage: int = 0
+    allowed_pod_number: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "milli_cpu": self.milli_cpu,
+            "memory": self.memory,
+            "ephemeral_storage": self.ephemeral_storage,
+            "allowed_pod_number": self.allowed_pod_number,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "OtherSpec":
+        return cls(
+            milli_cpu=int(d.get("milli_cpu", 0)),
+            memory=int(d.get("memory", 0)),
+            ephemeral_storage=int(d.get("ephemeral_storage", 0)),
+            allowed_pod_number=int(d.get("allowed_pod_number", 0)),
+        )
+
+    def validate(self) -> None:
+        for f in ("milli_cpu", "memory", "ephemeral_storage", "allowed_pod_number"):
+            if getattr(self, f) < 0:
+                raise ValidationError(f"other_spec.{f} must be >= 0")
+
+
+@dataclass
+class ResourceDetails:
+    """What the user asks for — reference ScalarResourceDetails
+    (composabilityrequest_types.go:40-53).
+
+    ``size`` for tpu means chip count; ``topology`` optionally pins an explicit
+    slice shape (e.g. "2x2x1"); otherwise the solver picks one.
+    """
+
+    type: str = "tpu"
+    model: str = ""
+    size: int = 0
+    force_detach: bool = False
+    allocation_policy: str = "samenode"
+    target_node: str = ""
+    topology: str = ""
+    other_spec: Optional[OtherSpec] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "type": self.type,
+            "model": self.model,
+            "size": self.size,
+            "force_detach": self.force_detach,
+            "allocation_policy": self.allocation_policy,
+        }
+        if self.target_node:
+            d["target_node"] = self.target_node
+        if self.topology:
+            d["topology"] = self.topology
+        if self.other_spec is not None:
+            d["other_spec"] = self.other_spec.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ResourceDetails":
+        other = d.get("other_spec")
+        return cls(
+            type=d.get("type", "tpu"),
+            model=d.get("model", ""),
+            size=int(d.get("size", 0)),
+            force_detach=bool(d.get("force_detach", False)),
+            allocation_policy=d.get("allocation_policy", "samenode"),
+            target_node=d.get("target_node", ""),
+            topology=d.get("topology", ""),
+            other_spec=OtherSpec.from_dict(other) if other is not None else None,
+        )
+
+    def validate(self) -> None:
+        if self.type not in DEVICE_TYPES:
+            raise ValidationError(
+                f"resource.type must be one of {DEVICE_TYPES}, got {self.type!r}"
+            )
+        if not self.model:
+            raise ValidationError("resource.model must be non-empty")  # MinLength=1
+        if self.size < 0:
+            raise ValidationError("resource.size must be >= 0")  # Minimum=0
+        if self.allocation_policy not in ALLOCATION_POLICIES:
+            raise ValidationError(
+                f"resource.allocation_policy must be one of {ALLOCATION_POLICIES},"
+                f" got {self.allocation_policy!r}"
+            )
+        if self.other_spec is not None:
+            self.other_spec.validate()
+
+
+# --------------------------------------------------------------------------
+# ComposabilityRequest
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ComposabilityRequestSpec:
+    resource: ResourceDetails = field(default_factory=ResourceDetails)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"resource": self.resource.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ComposabilityRequestSpec":
+        return cls(resource=ResourceDetails.from_dict(d.get("resource", {})))
+
+    def validate(self) -> None:
+        self.resource.validate()
+
+
+@dataclass
+class ResourceStatus:
+    """Per-child summary folded into the request status.
+
+    Reference: ScalarResourceStatus (composabilityrequest_types.go:74-80), plus
+    TPU additions (device_ids list, worker_id).
+    """
+
+    state: str = ""
+    node_name: str = ""
+    device_ids: List[str] = field(default_factory=list)
+    cdi_device_id: str = ""
+    worker_id: int = -1
+    error: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"state": self.state}
+        if self.node_name:
+            d["node_name"] = self.node_name
+        if self.device_ids:
+            d["device_ids"] = list(self.device_ids)
+        if self.cdi_device_id:
+            d["cdi_device_id"] = self.cdi_device_id
+        if self.worker_id >= 0:
+            d["worker_id"] = self.worker_id
+        if self.error:
+            d["error"] = self.error
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ResourceStatus":
+        return cls(
+            state=d.get("state", ""),
+            node_name=d.get("node_name", ""),
+            device_ids=list(d.get("device_ids", [])),
+            cdi_device_id=d.get("cdi_device_id", ""),
+            worker_id=int(d.get("worker_id", -1)),
+            error=d.get("error", ""),
+        )
+
+
+@dataclass
+class SliceStatus:
+    """The composed-slice view used for TPU_* coordinate injection.
+
+    No reference analog — the reference never had to keep admission output
+    consistent with allocation output (SURVEY.md §7 hard-part #4); we record
+    the authoritative coordinates here.
+    """
+
+    name: str = ""
+    topology: str = ""
+    num_hosts: int = 0
+    chips_per_host: int = 0
+    worker_hostnames: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.name:
+            d["name"] = self.name
+        if self.topology:
+            d["topology"] = self.topology
+        if self.num_hosts:
+            d["num_hosts"] = self.num_hosts
+        if self.chips_per_host:
+            d["chips_per_host"] = self.chips_per_host
+        if self.worker_hostnames:
+            d["worker_hostnames"] = list(self.worker_hostnames)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SliceStatus":
+        return cls(
+            name=d.get("name", ""),
+            topology=d.get("topology", ""),
+            num_hosts=int(d.get("num_hosts", 0)),
+            chips_per_host=int(d.get("chips_per_host", 0)),
+            worker_hostnames=list(d.get("worker_hostnames", [])),
+        )
+
+
+@dataclass
+class ComposabilityRequestStatus:
+    state: str = ""
+    error: str = ""
+    resources: Dict[str, ResourceStatus] = field(default_factory=dict)
+    # Spec snapshot for drift detection — reference status.scalarResource
+    # (composabilityrequest_types.go:71, used at composabilityrequest_controller.go:495,:570-579)
+    scalar_resource: Optional[ResourceDetails] = None
+    slice: SliceStatus = field(default_factory=SliceStatus)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"state": self.state}
+        if self.error:
+            d["error"] = self.error
+        if self.resources:
+            d["resources"] = {k: v.to_dict() for k, v in self.resources.items()}
+        if self.scalar_resource is not None:
+            d["scalarResource"] = self.scalar_resource.to_dict()
+        s = self.slice.to_dict()
+        if s:
+            d["slice"] = s
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ComposabilityRequestStatus":
+        sr = d.get("scalarResource")
+        return cls(
+            state=d.get("state", ""),
+            error=d.get("error", ""),
+            resources={
+                k: ResourceStatus.from_dict(v) for k, v in d.get("resources", {}).items()
+            },
+            scalar_resource=ResourceDetails.from_dict(sr) if sr is not None else None,
+            slice=SliceStatus.from_dict(d.get("slice", {})),
+        )
+
+
+class ComposabilityRequest(ApiObject):
+    KIND = "ComposabilityRequest"
+
+    def __init__(
+        self,
+        metadata: Optional[ObjectMeta] = None,
+        spec: Optional[ComposabilityRequestSpec] = None,
+        status: Optional[ComposabilityRequestStatus] = None,
+    ):
+        self.metadata = metadata or ObjectMeta()
+        self.spec = spec or ComposabilityRequestSpec()
+        self.status = status or ComposabilityRequestStatus()
+
+    def validate(self) -> None:
+        self.spec.validate()
+
+
+# --------------------------------------------------------------------------
+# ComposableResource
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ComposableResourceSpec:
+    """One chip-group on one host.
+
+    Reference: ComposableResourceSpec (composableresource_types.go:27-33) plus
+    the TPU slice-membership fields.
+    """
+
+    type: str = "tpu"
+    model: str = ""
+    target_node: str = ""
+    force_detach: bool = False
+    # TPU slice membership (no reference analog; SURVEY.md §7 checklist #1)
+    chip_count: int = 1
+    slice_name: str = ""
+    worker_id: int = 0
+    topology: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "type": self.type,
+            "model": self.model,
+            "target_node": self.target_node,
+            "force_detach": self.force_detach,
+        }
+        if self.type == "tpu":
+            d["chip_count"] = self.chip_count
+            d["slice_name"] = self.slice_name
+            d["worker_id"] = self.worker_id
+            d["topology"] = self.topology
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ComposableResourceSpec":
+        return cls(
+            type=d.get("type", "tpu"),
+            model=d.get("model", ""),
+            target_node=d.get("target_node", ""),
+            force_detach=bool(d.get("force_detach", False)),
+            chip_count=int(d.get("chip_count", 1)),
+            slice_name=d.get("slice_name", ""),
+            worker_id=int(d.get("worker_id", 0)),
+            topology=d.get("topology", ""),
+        )
+
+    def validate(self) -> None:
+        if self.type not in DEVICE_TYPES:
+            raise ValidationError(f"type must be one of {DEVICE_TYPES}")
+        if not self.model:
+            raise ValidationError("model must be non-empty")
+        if not self.target_node:
+            raise ValidationError("target_node must be non-empty")
+        if self.chip_count < 1:
+            raise ValidationError("chip_count must be >= 1")
+
+
+@dataclass
+class ComposableResourceStatus:
+    state: str = ""
+    error: str = ""
+    device_ids: List[str] = field(default_factory=list)
+    cdi_device_id: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"state": self.state}
+        if self.error:
+            d["error"] = self.error
+        if self.device_ids:
+            d["device_ids"] = list(self.device_ids)
+        if self.cdi_device_id:
+            d["cdi_device_id"] = self.cdi_device_id
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ComposableResourceStatus":
+        return cls(
+            state=d.get("state", ""),
+            error=d.get("error", ""),
+            device_ids=list(d.get("device_ids", [])),
+            cdi_device_id=d.get("cdi_device_id", ""),
+        )
+
+
+class ComposableResource(ApiObject):
+    KIND = "ComposableResource"
+
+    def __init__(
+        self,
+        metadata: Optional[ObjectMeta] = None,
+        spec: Optional[ComposableResourceSpec] = None,
+        status: Optional[ComposableResourceStatus] = None,
+    ):
+        self.metadata = metadata or ObjectMeta()
+        self.spec = spec or ComposableResourceSpec()
+        self.status = status or ComposableResourceStatus()
+
+    def validate(self) -> None:
+        self.spec.validate()
+
+
+# --------------------------------------------------------------------------
+# Node — the worker-node view the allocator and node agent operate on.
+# Reference analog: corev1.Node objects listed by utils/nodes.go:119-135 and
+# capacity-checked at nodes.go:78-117. We model only what the controllers use.
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class NodeSpec:
+    # Hostname or address the node agent for this node is reachable at.
+    agent_endpoint: str = ""
+    # Schedulable toggle (reference analog: node cordon).
+    unschedulable: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "agent_endpoint": self.agent_endpoint,
+            "unschedulable": self.unschedulable,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NodeSpec":
+        return cls(
+            agent_endpoint=d.get("agent_endpoint", ""),
+            unschedulable=bool(d.get("unschedulable", False)),
+        )
+
+
+@dataclass
+class NodeStatus:
+    # Allocatable scalar capacity, the fields CheckNodeCapacitySufficient
+    # consults (utils/nodes.go:78-117).
+    milli_cpu: int = 0
+    memory: int = 0
+    ephemeral_storage: int = 0
+    allowed_pod_number: int = 0
+    # Max TPU chips this host can accept over the fabric (PCIe/ICI ports free).
+    tpu_slots: int = 0
+    ready: bool = True
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "milli_cpu": self.milli_cpu,
+            "memory": self.memory,
+            "ephemeral_storage": self.ephemeral_storage,
+            "allowed_pod_number": self.allowed_pod_number,
+            "tpu_slots": self.tpu_slots,
+            "ready": self.ready,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NodeStatus":
+        return cls(
+            milli_cpu=int(d.get("milli_cpu", 0)),
+            memory=int(d.get("memory", 0)),
+            ephemeral_storage=int(d.get("ephemeral_storage", 0)),
+            allowed_pod_number=int(d.get("allowed_pod_number", 0)),
+            tpu_slots=int(d.get("tpu_slots", 0)),
+            ready=bool(d.get("ready", True)),
+        )
+
+
+class Node(ApiObject):
+    KIND = "Node"
+
+    def __init__(
+        self,
+        metadata: Optional[ObjectMeta] = None,
+        spec: Optional[NodeSpec] = None,
+        status: Optional[NodeStatus] = None,
+    ):
+        self.metadata = metadata or ObjectMeta()
+        self.spec = spec or NodeSpec()
+        self.status = status or NodeStatus()
+
+    def validate(self) -> None:
+        pass

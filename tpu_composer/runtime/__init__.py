@@ -1,0 +1,34 @@
+"""Controller runtime: object store, work queues, controllers, manager.
+
+Reference analog: sigs.k8s.io/controller-runtime as consumed by
+/root/reference/cmd/main.go and internal/controller/*. The reference leans on
+the K8s API server + etcd for storage/watches and on controller-runtime for
+queues/reconcile loops; we provide an in-process equivalent with the same
+semantics (optimistic concurrency, status subresource, finalizer-gated
+deletion, watches, rate-limited requeue) so the whole framework runs
+standalone and the tests can drive single reconcile steps exactly like the
+reference's envtest suites do (SURVEY.md §4).
+"""
+
+from tpu_composer.runtime.store import (
+    ConflictError,
+    NotFoundError,
+    AlreadyExistsError,
+    Store,
+    WatchEvent,
+)
+from tpu_composer.runtime.queue import RateLimitingQueue
+from tpu_composer.runtime.controller import Controller, Result
+from tpu_composer.runtime.manager import Manager
+
+__all__ = [
+    "ConflictError",
+    "NotFoundError",
+    "AlreadyExistsError",
+    "Store",
+    "WatchEvent",
+    "RateLimitingQueue",
+    "Controller",
+    "Result",
+    "Manager",
+]

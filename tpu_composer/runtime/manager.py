@@ -1,0 +1,136 @@
+"""Manager: owns the store, controllers, runnables, health + metrics server.
+
+Reference analog: ctrl.NewManager + mgr.Start in cmd/main.go:137-218 —
+controllers are registered, optional leader election gates startup, healthz/
+readyz endpoints back the Deployment probes (config/manager/manager.yaml:73-85),
+and a metrics endpoint serves Prometheus text.
+"""
+
+from __future__ import annotations
+
+import http.server
+import logging
+import threading
+from typing import Callable, List, Optional
+
+from tpu_composer.runtime.controller import Controller
+from tpu_composer.runtime.events import EventRecorder
+from tpu_composer.runtime.leader import LeaderElector
+from tpu_composer.runtime.metrics import global_registry
+from tpu_composer.runtime.store import Store
+
+# A runnable is the analog of manager.Add(RunnableFunc) used by the
+# UpstreamSyncer (upstreamsyncer_controller.go:52-77): start(stop_event).
+Runnable = Callable[[threading.Event], None]
+
+
+class _HealthHandler(http.server.BaseHTTPRequestHandler):
+    manager: "Manager"
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/healthz":
+            self._respond(200, "ok")
+        elif self.path == "/readyz":
+            ready = self.manager.ready()
+            self._respond(200 if ready else 503, "ok" if ready else "not ready")
+        elif self.path == "/metrics":
+            self._respond(200, global_registry.expose_text())
+        else:
+            self._respond(404, "not found")
+
+    def _respond(self, code: int, body: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+class Manager:
+    def __init__(
+        self,
+        store: Optional[Store] = None,
+        leader_elect: bool = False,
+        leader_lock_path: Optional[str] = None,
+        health_addr: Optional[str] = None,  # "host:port" or None to disable
+    ) -> None:
+        self.store = store or Store()
+        self.recorder = EventRecorder()
+        self.log = logging.getLogger("manager")
+        self._controllers: List[Controller] = []
+        self._runnables: List[Runnable] = []
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._started = False
+        self._leader_elect = leader_elect
+        self._elector = LeaderElector(leader_lock_path) if leader_elect else None
+        self._health_addr = health_addr
+        self._health_server: Optional[http.server.ThreadingHTTPServer] = None
+
+    def add_controller(self, controller: Controller) -> None:
+        self._controllers.append(controller)
+
+    def add_runnable(self, runnable: Runnable) -> None:
+        self._runnables.append(runnable)
+
+    def ready(self) -> bool:
+        return self._started
+
+    @property
+    def health_port(self) -> Optional[int]:
+        if self._health_server is None:
+            return None
+        return self._health_server.server_address[1]
+
+    def start(self, workers_per_controller: int = 1) -> None:
+        if self._health_addr is not None:
+            host, _, port = self._health_addr.rpartition(":")
+            handler = type("BoundHealthHandler", (_HealthHandler,), {"manager": self})
+            self._health_server = http.server.ThreadingHTTPServer(
+                (host or "127.0.0.1", int(port)), handler
+            )
+            t = threading.Thread(
+                target=self._health_server.serve_forever, name="health", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+        if self._elector is not None:
+            self.log.info("waiting for leader lock %s", self._elector.lock_path)
+            if not self._elector.acquire(stop_event=self._stop):
+                return
+            self.log.info("became leader")
+
+        for c in self._controllers:
+            c.start(workers=workers_per_controller)
+        for r in self._runnables:
+            t = threading.Thread(target=r, args=(self._stop,), daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._started = True
+
+    def stop(self) -> None:
+        self._stop.set()
+        for c in self._controllers:
+            c.stop()
+        if self._health_server is not None:
+            self._health_server.shutdown()
+            self._health_server.server_close()
+            self._health_server = None
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+        if self._elector is not None:
+            self._elector.release()
+        self._started = False
+
+    def wait(self) -> None:  # pragma: no cover - used by cmd/main
+        try:
+            while not self._stop.wait(1.0):
+                pass
+        except KeyboardInterrupt:
+            self.stop()

@@ -1,0 +1,195 @@
+"""Metrics registry with Prometheus text exposition.
+
+Reference analog: controller-runtime's metrics server (cmd/main.go:109-127 +
+config/prometheus/monitor.yaml). The reference exposes only default
+controller metrics and notably has NO attach-latency instrumentation
+(SURVEY.md §6) — our north-star metric requires one, so a Histogram is
+first-class here and the controllers record ``attach_to_ready_seconds``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = "") -> None:
+        self.name, self.help = name, help_
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Histogram:
+    def __init__(
+        self,
+        name: str,
+        help_: str = "",
+        buckets: Sequence[float] = _DEFAULT_BUCKETS,
+    ) -> None:
+        self.name, self.help = name, help_
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        self._counts: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
+        self._sums: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        # Bounded raw-sample retention for exact percentiles (bench use);
+        # bucket counts + sums alone serve /metrics exposition.
+        self._samples: Dict[Tuple[Tuple[str, str], ...], "collections.deque[float]"] = {}
+        self._max_samples = 10000
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._samples.setdefault(
+                key, collections.deque(maxlen=self._max_samples)
+            ).append(value)
+
+    def count(self, **labels: str) -> int:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return sum(self._counts.get(key, []))
+
+    def percentile(self, q: float, **labels: str) -> Optional[float]:
+        """Exact percentile from retained samples (bench convenience)."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            samples = sorted(self._samples.get(key, []))
+        if not samples:
+            return None
+        idx = min(len(samples) - 1, max(0, int(round(q * (len(samples) - 1)))))
+        return samples[idx]
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key, counts in sorted(self._counts.items()):
+                cum = 0
+                for i, b in enumerate(self.buckets):
+                    cum += counts[i]
+                    lab = key + (("le", repr(b)),)
+                    out.append(f"{self.name}_bucket{_fmt_labels(lab)} {cum}")
+                cum += counts[-1]
+                lab = key + (("le", "+Inf"),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lab)} {cum}")
+                out.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums.get(key, 0.0)}")
+                out.append(f"{self.name}_count{_fmt_labels(key)} {cum}")
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Counter(name, help_)
+                self._metrics[name] = m
+            assert isinstance(m, Counter) and not isinstance(m, Gauge)
+            return m
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Gauge(name, help_)
+                self._metrics[name] = m
+            assert isinstance(m, Gauge)
+            return m
+
+    def histogram(
+        self, name: str, help_: str = "", buckets: Sequence[float] = _DEFAULT_BUCKETS
+    ) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, buckets)
+                self._metrics[name] = m
+            assert isinstance(m, Histogram)
+            return m
+
+    def expose_text(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.expose())  # type: ignore[attr-defined]
+        return "\n".join(lines) + "\n"
+
+
+#: Process-global registry (controllers import this), like controller-runtime's
+#: metrics.Registry singleton.
+global_registry = Registry()
+
+#: The instrumentation the reference lacks (BASELINE.md north star).
+attach_to_ready_seconds = global_registry.histogram(
+    "tpuc_attach_to_ready_seconds",
+    "Latency from ComposabilityRequest creation to Running state",
+)
+reconcile_total = global_registry.counter(
+    "tpuc_reconcile_total", "Reconcile invocations by controller and outcome"
+)
+fabric_requests_total = global_registry.counter(
+    "tpuc_fabric_requests_total", "Fabric provider calls by op and outcome"
+)
+composed_chips = global_registry.gauge(
+    "tpuc_composed_chips", "Currently attached chips by node"
+)
+
+
+def timed() -> float:
+    return time.monotonic()

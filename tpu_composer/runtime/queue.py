@@ -1,0 +1,131 @@
+"""Rate-limited, deduplicating work queue.
+
+Reference analog: k8s.io/client-go/util/workqueue as used implicitly by every
+controller-runtime reconciler in /root/reference/internal/controller. Contract:
+
+- ``add(key)`` enqueues; a key already queued or being processed is not
+  double-queued (dedup) but a key re-added while in-flight is re-queued when
+  ``done`` is called (the "dirty" set);
+- ``add_after(key, delay)`` schedules a delayed requeue (the reference's
+  ``RequeueAfter: 30s`` results);
+- ``add_rate_limited(key)`` applies per-key exponential backoff (failures);
+- ``forget(key)`` resets the backoff (successful reconcile).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, Hashable, List, Optional, Set, Tuple
+
+
+class RateLimitingQueue:
+    def __init__(
+        self,
+        base_delay: float = 0.005,
+        max_delay: float = 16.0,
+    ) -> None:
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        self._cond = threading.Condition()
+        self._queue: List[Hashable] = []
+        self._queued: Set[Hashable] = set()
+        self._processing: Set[Hashable] = set()
+        self._dirty: Set[Hashable] = set()
+        self._failures: Dict[Hashable, int] = {}
+        # min-heap of (ready_time, seq, key)
+        self._delayed: List[Tuple[float, int, Hashable]] = []
+        self._seq = 0
+        self._shutdown = False
+
+    # ------------------------------------------------------------------
+    def add(self, key: Hashable) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            if key in self._processing:
+                self._dirty.add(key)
+                return
+            if key not in self._queued:
+                self._queued.add(key)
+                self._queue.append(key)
+                self._cond.notify()
+
+    def add_after(self, key: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(key)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, key))
+            self._cond.notify()
+
+    def add_rate_limited(self, key: Hashable) -> None:
+        with self._cond:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+        self.add_after(key, min(self._base_delay * (2 ** n), self._max_delay))
+
+    def forget(self, key: Hashable) -> None:
+        with self._cond:
+            self._failures.pop(key, None)
+
+    def retries(self, key: Hashable) -> int:
+        with self._cond:
+            return self._failures.get(key, 0)
+
+    # ------------------------------------------------------------------
+    def _promote_ready(self, now: float) -> None:
+        # caller holds the lock
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, key = heapq.heappop(self._delayed)
+            if key in self._processing:
+                self._dirty.add(key)
+            elif key not in self._queued:
+                self._queued.add(key)
+                self._queue.append(key)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Hashable]:
+        """Block until a key is ready (or timeout/shutdown → None)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                self._promote_ready(now)
+                if self._queue:
+                    key = self._queue.pop(0)
+                    self._queued.discard(key)
+                    self._processing.add(key)
+                    return key
+                if self._shutdown:
+                    return None
+                waits = []
+                if self._delayed:
+                    waits.append(self._delayed[0][0] - now)
+                if deadline is not None:
+                    if deadline <= now:
+                        return None
+                    waits.append(deadline - now)
+                self._cond.wait(timeout=min(waits) if waits else None)
+
+    def done(self, key: Hashable) -> None:
+        with self._cond:
+            self._processing.discard(key)
+            if key in self._dirty:
+                self._dirty.discard(key)
+                if key not in self._queued:
+                    self._queued.add(key)
+                    self._queue.append(key)
+                    self._cond.notify()
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)

@@ -1,0 +1,335 @@
+"""In-process, watchable, persistent object store.
+
+Plays the role the K8s API server + etcd play for the reference operator:
+
+- optimistic concurrency via ``metadata.resource_version`` (update conflicts
+  surface as ConflictError, the analog of a 409 that controller-runtime
+  requeues on);
+- a status subresource: ``update_status`` persists only ``status`` (the
+  reference CRDs declare ``+kubebuilder:subresource:status``,
+  composabilityrequest_types.go:82-84);
+- finalizer-gated deletion: ``delete`` sets ``deletionTimestamp`` while
+  finalizers remain, and the object is purged when the last finalizer is
+  removed — exactly the lifecycle the reference's handleDeletingState relies
+  on (composableresource_controller.go:418-434);
+- label-selector listing (the reference lists children by
+  ``app.kubernetes.io/managed-by``, composabilityrequest_controller.go:222-235);
+- watches with ADDED/MODIFIED/DELETED events feeding controller work queues
+  (analog of controller-runtime's source.Kind watches, cmd/main.go:167-194);
+- optional file persistence, one JSON doc per object, making the object store
+  itself the checkpoint/resume mechanism (SURVEY.md §5 "the CRDs *are* the
+  checkpoint").
+
+Objects handed out and accepted are deep-copied at the boundary, so callers
+can mutate freely — same contract as client-go's cache + typed client.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Tuple, Type, TypeVar
+
+from tpu_composer.api.meta import ApiObject, new_uid, now_iso
+from tpu_composer.api.scheme import Scheme, default_scheme
+
+T = TypeVar("T", bound=ApiObject)
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFoundError(StoreError):
+    pass
+
+
+class AlreadyExistsError(StoreError):
+    pass
+
+
+class ConflictError(StoreError):
+    """resourceVersion mismatch — caller must re-get and retry."""
+
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    obj: ApiObject
+
+
+# An admission hook runs inside create/update with (op, new_obj, old_obj) and
+# may mutate new_obj or raise to reject. op ∈ {"CREATE", "UPDATE", "DELETE"}.
+# Reference analog: the validating webhook registered at cmd/main.go:196-201.
+AdmissionHook = Callable[[str, ApiObject, Optional[ApiObject]], None]
+
+
+class Store:
+    def __init__(
+        self,
+        scheme: Optional[Scheme] = None,
+        persist_dir: Optional[str] = None,
+    ) -> None:
+        self._scheme = scheme or default_scheme()
+        self._lock = threading.RLock()
+        # (kind, name) -> object. All objects are cluster-scoped, like the
+        # reference's CRDs (+kubebuilder:resource:scope=Cluster).
+        self._objects: Dict[Tuple[str, str], ApiObject] = {}
+        self._watchers: List[Tuple[Optional[str], "queue.Queue[WatchEvent]"]] = []
+        self._admission: List[Tuple[str, AdmissionHook]] = []  # (kind or "*", hook)
+        self._rv_counter = 0
+        self._persist_dir = persist_dir
+        if persist_dir:
+            self._load(persist_dir)
+
+    @property
+    def scheme(self) -> Scheme:
+        return self._scheme
+
+    # ------------------------------------------------------------------
+    # persistence (checkpoint/resume)
+    # ------------------------------------------------------------------
+    def _obj_path(self, kind: str, name: str) -> str:
+        assert self._persist_dir
+        return os.path.join(self._persist_dir, kind, f"{name}.json")
+
+    def _persist(self, obj: ApiObject) -> None:
+        if not self._persist_dir:
+            return
+        path = self._obj_path(obj.KIND, obj.metadata.name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj.to_dict(), f, sort_keys=True)
+        os.replace(tmp, path)
+
+    def _unpersist(self, kind: str, name: str) -> None:
+        if not self._persist_dir:
+            return
+        try:
+            os.remove(self._obj_path(kind, name))
+        except FileNotFoundError:
+            pass
+
+    def _load(self, persist_dir: str) -> None:
+        if not os.path.isdir(persist_dir):
+            return
+        max_rv = 0
+        for kind in os.listdir(persist_dir):
+            kdir = os.path.join(persist_dir, kind)
+            if not os.path.isdir(kdir):
+                continue
+            for fn in os.listdir(kdir):
+                if not fn.endswith(".json"):
+                    continue
+                with open(os.path.join(kdir, fn)) as f:
+                    obj = self._scheme.decode(json.load(f))
+                self._objects[(obj.KIND, obj.metadata.name)] = obj
+                max_rv = max(max_rv, obj.metadata.resource_version)
+        self._rv_counter = max_rv
+
+    # ------------------------------------------------------------------
+    # admission + watch registration
+    # ------------------------------------------------------------------
+    def register_admission(self, kind: str, hook: AdmissionHook) -> None:
+        """kind="*" applies to every kind."""
+        with self._lock:
+            self._admission.append((kind, hook))
+
+    def watch(self, kind: Optional[str] = None) -> "queue.Queue[WatchEvent]":
+        """Subscribe to events; kind=None receives everything.
+
+        Returns an unbounded queue the caller drains. Existing objects are NOT
+        replayed — controllers do their own initial list (same as
+        controller-runtime's cache sync + initial reconcile wave, which our
+        Controller base performs on start).
+        """
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        with self._lock:
+            self._watchers.append((kind, q))
+        return q
+
+    def stop_watch(self, q: "queue.Queue[WatchEvent]") -> None:
+        with self._lock:
+            self._watchers = [(k, w) for (k, w) in self._watchers if w is not q]
+
+    def _notify(self, event_type: str, obj: ApiObject) -> None:
+        snap = obj.deepcopy()
+        for kind, q in self._watchers:
+            if kind is None or kind == obj.KIND:
+                q.put(WatchEvent(event_type, snap))
+
+    def _run_admission(self, op: str, new: ApiObject, old: Optional[ApiObject]) -> None:
+        for kind, hook in list(self._admission):
+            if kind == "*" or kind == new.KIND:
+                hook(op, new, old)
+
+    # ------------------------------------------------------------------
+    # CRUD
+    # ------------------------------------------------------------------
+    def _next_rv(self) -> int:
+        self._rv_counter += 1
+        return self._rv_counter
+
+    def create(self, obj: T) -> T:
+        obj = obj.deepcopy()
+        if not obj.metadata.name:
+            raise StoreError("metadata.name is required")
+        with self._lock:
+            key = (obj.KIND, obj.metadata.name)
+            if key in self._objects:
+                raise AlreadyExistsError(f"{obj.KIND}/{obj.metadata.name} already exists")
+            # Admission (mutating) runs before schema validation, matching the
+            # K8s admission chain the reference's webhook participates in.
+            self._run_admission("CREATE", obj, None)
+            if hasattr(obj, "validate"):
+                obj.validate()
+            obj.metadata.uid = obj.metadata.uid or new_uid()
+            obj.metadata.resource_version = self._next_rv()
+            obj.metadata.generation = 1
+            obj.metadata.creation_timestamp = obj.metadata.creation_timestamp or now_iso()
+            obj.metadata.deletion_timestamp = None
+            self._objects[key] = obj
+            self._persist(obj)
+            self._notify(ADDED, obj)
+            return obj.deepcopy()
+
+    def get(self, cls: Type[T], name: str) -> T:
+        with self._lock:
+            try:
+                obj = self._objects[(cls.KIND, name)]
+            except KeyError:
+                raise NotFoundError(f"{cls.KIND}/{name} not found") from None
+            return obj.deepcopy()  # type: ignore[return-value]
+
+    def try_get(self, cls: Type[T], name: str) -> Optional[T]:
+        try:
+            return self.get(cls, name)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        cls: Type[T],
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[T]:
+        with self._lock:
+            out: List[T] = []
+            for (kind, _), obj in sorted(self._objects.items()):
+                if kind != cls.KIND:
+                    continue
+                if label_selector and any(
+                    obj.metadata.labels.get(k) != v for k, v in label_selector.items()
+                ):
+                    continue
+                out.append(obj.deepcopy())  # type: ignore[arg-type]
+            return out
+
+    def _check_conflict(self, stored: ApiObject, incoming: ApiObject) -> None:
+        if incoming.metadata.resource_version != stored.metadata.resource_version:
+            raise ConflictError(
+                f"{incoming.KIND}/{incoming.metadata.name}: resourceVersion"
+                f" {incoming.metadata.resource_version} != {stored.metadata.resource_version}"
+            )
+
+    def update(self, obj: T) -> T:
+        """Update spec + metadata; status is preserved from the stored copy.
+
+        If the object is terminating and this update removes the last
+        finalizer, the object is purged (DELETED event) — K8s semantics.
+        """
+        obj = obj.deepcopy()
+        with self._lock:
+            key = (obj.KIND, obj.metadata.name)
+            stored = self._objects.get(key)
+            if stored is None:
+                raise NotFoundError(f"{obj.KIND}/{obj.metadata.name} not found")
+            self._check_conflict(stored, obj)
+            self._run_admission("UPDATE", obj, stored.deepcopy())
+            if hasattr(obj, "validate"):
+                obj.validate()
+
+            spec_changed = stored.spec.to_dict() != obj.spec.to_dict()  # type: ignore[attr-defined]
+            obj.status = copy.deepcopy(stored.status)  # type: ignore[attr-defined]
+            # Immutable/system-owned fields
+            obj.metadata.uid = stored.metadata.uid
+            obj.metadata.creation_timestamp = stored.metadata.creation_timestamp
+            obj.metadata.deletion_timestamp = stored.metadata.deletion_timestamp
+            obj.metadata.generation = stored.metadata.generation + (1 if spec_changed else 0)
+            obj.metadata.resource_version = self._next_rv()
+
+            if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+                del self._objects[key]
+                self._unpersist(obj.KIND, obj.metadata.name)
+                self._notify(DELETED, obj)
+                return obj.deepcopy()
+
+            self._objects[key] = obj
+            self._persist(obj)
+            self._notify(MODIFIED, obj)
+            return obj.deepcopy()
+
+    def update_status(self, obj: T) -> T:
+        """Persist only ``status`` (status subresource semantics)."""
+        obj = obj.deepcopy()
+        with self._lock:
+            key = (obj.KIND, obj.metadata.name)
+            stored = self._objects.get(key)
+            if stored is None:
+                raise NotFoundError(f"{obj.KIND}/{obj.metadata.name} not found")
+            self._check_conflict(stored, obj)
+            updated = stored.deepcopy()
+            updated.status = obj.status  # type: ignore[attr-defined]
+            updated.metadata.resource_version = self._next_rv()
+            self._objects[key] = updated
+            self._persist(updated)
+            self._notify(MODIFIED, updated)
+            return updated.deepcopy()  # type: ignore[return-value]
+
+    def delete(self, cls: Type[T], name: str) -> None:
+        """Finalizer-aware delete.
+
+        With finalizers present: marks deletionTimestamp and emits MODIFIED so
+        controllers run their teardown states (the reference's Cleaning /
+        Detaching paths). Without: purges immediately.
+        """
+        with self._lock:
+            key = (cls.KIND, name)
+            stored = self._objects.get(key)
+            if stored is None:
+                raise NotFoundError(f"{cls.KIND}/{name} not found")
+            # Hooks get copies: a mutating hook must not corrupt canonical
+            # state outside the rv/persist/notify path.
+            self._run_admission("DELETE", stored.deepcopy(), stored.deepcopy())
+            if stored.metadata.finalizers:
+                if stored.metadata.deletion_timestamp is None:
+                    updated = stored.deepcopy()
+                    updated.metadata.deletion_timestamp = now_iso()
+                    updated.metadata.resource_version = self._next_rv()
+                    self._objects[key] = updated
+                    self._persist(updated)
+                    self._notify(MODIFIED, updated)
+                return
+            del self._objects[key]
+            self._unpersist(cls.KIND, name)
+            self._notify(DELETED, stored)
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def keys(self) -> Iterable[Tuple[str, str]]:
+        with self._lock:
+            return list(self._objects.keys())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objects)
