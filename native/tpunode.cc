@@ -1,0 +1,116 @@
+// tpunode — native node-agent core for tpu-composer.
+//
+// The reference operator's node-side device work is shell-outs via pod-exec
+// (nvidia-smi, modprobe, /sys writes — internal/utils/gpus.go). Our node
+// agent instead links this small C++ library for the hot, syscall-heavy
+// paths that run on every reconcile poll:
+//   - accel device enumeration (/dev/accel*),
+//   - open-fd holder scanning across /proc (the drain guard; the reference
+//     greps `ls -l /proc/*/fd` output via exec, gpus.go:416-439),
+//   - sysfs reads for PCI/driver state.
+// Exposed with a plain C ABI consumed through ctypes
+// (tpu_composer/agent/native.py); a pure-Python fallback mirrors the
+// semantics when the library is not built.
+//
+// Build: make -C native   (produces native/build/libtpunode.so)
+
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <string>
+#include <sys/types.h>
+#include <unistd.h>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+bool starts_with(const char* s, const char* prefix) {
+  return std::strncmp(s, prefix, std::strlen(prefix)) == 0;
+}
+
+bool all_digits(const char* s) {
+  if (!*s) return false;
+  for (; *s; ++s)
+    if (*s < '0' || *s > '9') return false;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* tpun_version() { return "tpunode 0.1.0"; }
+
+// Enumerate accel device nodes under dev_dir. Writes newline-separated
+// absolute paths into buf (NUL-terminated); returns the number of devices
+// found, or -1 if the buffer is too small, or 0 when dev_dir is absent.
+int tpun_enum_accel(const char* dev_dir, char* buf, int buflen) {
+  DIR* d = opendir(dev_dir);
+  if (!d) {
+    if (buflen > 0) buf[0] = '\0';
+    return 0;
+  }
+  std::vector<std::string> found;
+  struct dirent* e;
+  while ((e = readdir(d)) != nullptr) {
+    if (starts_with(e->d_name, "accel"))
+      found.push_back(std::string(dev_dir) + "/" + e->d_name);
+  }
+  closedir(d);
+  std::sort(found.begin(), found.end());
+  std::string joined;
+  for (const auto& p : found) {
+    if (!joined.empty()) joined += '\n';
+    joined += p;
+  }
+  if ((int)joined.size() + 1 > buflen) return -1;
+  std::memcpy(buf, joined.c_str(), joined.size() + 1);
+  return (int)found.size();
+}
+
+// Scan proc_dir for processes with an open fd resolving to dev_path.
+// Fills up to max_pids entries; returns the holder count (which may exceed
+// max_pids), or -1 on error.
+int tpun_fd_holders(const char* dev_path, const char* proc_dir, int* pids,
+                    int max_pids) {
+  DIR* proc = opendir(proc_dir);
+  if (!proc) return -1;
+  int count = 0;
+  struct dirent* pe;
+  char fd_dir[512], link_path[768], target[768];
+  while ((pe = readdir(proc)) != nullptr) {
+    if (!all_digits(pe->d_name)) continue;
+    std::snprintf(fd_dir, sizeof fd_dir, "%s/%s/fd", proc_dir, pe->d_name);
+    DIR* fds = opendir(fd_dir);
+    if (!fds) continue;  // permission or exited — same as the Python fallback
+    struct dirent* fe;
+    while ((fe = readdir(fds)) != nullptr) {
+      if (fe->d_name[0] == '.') continue;
+      std::snprintf(link_path, sizeof link_path, "%s/%s", fd_dir, fe->d_name);
+      ssize_t n = readlink(link_path, target, sizeof target - 1);
+      if (n <= 0) continue;
+      target[n] = '\0';
+      if (std::strcmp(target, dev_path) == 0) {
+        if (count < max_pids) pids[count] = std::atoi(pe->d_name);
+        ++count;
+        break;  // one hit per process is enough
+      }
+    }
+    closedir(fds);
+  }
+  closedir(proc);
+  return count;
+}
+
+// Read a small sysfs/procfs file into buf; returns bytes read or -1.
+int tpun_read_file(const char* path, char* buf, int buflen) {
+  FILE* f = std::fopen(path, "r");
+  if (!f) return -1;
+  size_t n = std::fread(buf, 1, (size_t)(buflen > 0 ? buflen - 1 : 0), f);
+  std::fclose(f);
+  buf[n] = '\0';
+  return (int)n;
+}
+
+}  // extern "C"

@@ -1,0 +1,157 @@
+"""Node agent: CDI spec generation, LocalNodeAgent against a fake /dev and
+/proc tree, native-library parity with the Python fallback."""
+
+import json
+import os
+
+import pytest
+
+from tpu_composer.agent.cdi import (
+    CdiSpec,
+    generate_cdi_spec,
+    list_cdi_specs,
+    remove_cdi_spec,
+    write_cdi_spec,
+)
+from tpu_composer.agent.native import native_lib
+from tpu_composer.agent.nodeagent import (
+    AgentError,
+    DeviceBusyError,
+    DriverType,
+    LocalNodeAgent,
+)
+
+
+class TestCdiSpec:
+    def test_generate_accel_nodes_and_env(self):
+        spec = generate_cdi_spec(
+            "req1-slice", 2, [0, 1, 2, 3], env={"TPU_WORKER_ID": "2"}
+        )
+        assert spec.name == "req1-slice-worker2"
+        assert spec.qualified_name == "tpu.composer.dev/tpu=req1-slice-worker2"
+        d = spec.to_dict()
+        edits = d["devices"][0]["containerEdits"]
+        assert [n["path"] for n in edits["deviceNodes"]] == [
+            "/dev/accel0", "/dev/accel1", "/dev/accel2", "/dev/accel3",
+        ]
+        assert edits["env"] == ["TPU_WORKER_ID=2"]
+        assert edits["mounts"][0]["containerPath"] == "/lib/libtpu.so"
+        assert d["cdiVersion"] == "0.6.0"
+
+    def test_vfio_mode(self):
+        spec = generate_cdi_spec("s", 0, [0, 1], use_vfio=True)
+        assert spec.device_nodes == ["/dev/vfio/vfio", "/dev/vfio/0", "/dev/vfio/1"]
+
+    def test_write_list_remove_roundtrip(self, tmp_path):
+        cdi = str(tmp_path / "cdi")
+        spec = generate_cdi_spec("s1", 0, [0])
+        path = write_cdi_spec(cdi, spec)
+        assert json.load(open(path))["kind"] == "tpu.composer.dev/tpu"
+        assert list_cdi_specs(cdi) == ["s1-worker0"]
+        assert remove_cdi_spec(cdi, "s1-worker0")
+        assert list_cdi_specs(cdi) == []
+        assert not remove_cdi_spec(cdi, "s1-worker0")
+
+
+@pytest.fixture()
+def fake_host(tmp_path):
+    """A fake host root: /dev with accel nodes, /proc with one process
+    holding accel0 open."""
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(4):
+        (dev / f"accel{i}").write_text("")
+    proc = tmp_path / "proc"
+    fd_dir = proc / "1234" / "fd"
+    fd_dir.mkdir(parents=True)
+    os.symlink(str(dev / "accel0"), str(fd_dir / "7"))
+    (proc / "not-a-pid").mkdir()
+    lib = tmp_path / "libtpu.so"
+    lib.write_text("")
+    return tmp_path, str(dev), str(proc), str(lib)
+
+
+def make_agent(fake_host, with_lib=True):
+    root, dev, proc, lib = fake_host
+    return LocalNodeAgent(
+        dev_dir=dev,
+        proc_dir=proc,
+        cdi_dir=str(root / "cdi"),
+        libtpu_paths=[lib] if with_lib else [str(root / "missing.so")],
+        state_dir=str(root / "state"),
+    )
+
+
+class TestLocalNodeAgent:
+    def test_ensure_driver_found(self, fake_host):
+        assert make_agent(fake_host).ensure_driver("n0") == DriverType.HOST
+
+    def test_ensure_driver_missing_raises(self, fake_host):
+        with pytest.raises(AgentError):
+            make_agent(fake_host, with_lib=False).ensure_driver("n0")
+
+    def test_check_visible_counts_accel_nodes(self, fake_host):
+        agent = make_agent(fake_host)
+        assert agent.check_visible("n0", ["a", "b", "c", "d"])
+        assert not agent.check_visible("n0", ["a"] * 5)
+
+    def test_check_no_loads_detects_open_fd(self, fake_host):
+        agent = make_agent(fake_host)
+        assert not agent.check_no_loads("n0", ["chip-0"])
+
+    def test_drain_blocks_on_busy_then_force(self, fake_host):
+        agent = make_agent(fake_host)
+        with pytest.raises(DeviceBusyError) as ei:
+            agent.drain("n0", ["chip-0"])
+        assert "1234" in str(ei.value)
+        agent.drain("n0", ["chip-0"], force=True)  # force path proceeds
+
+    def test_drain_clean_when_no_holders(self, fake_host):
+        root, dev, proc, lib = fake_host
+        os.remove(os.path.join(proc, "1234", "fd", "7"))
+        make_agent(fake_host).drain("n0", ["chip-0"])
+
+    def test_refresh_and_taints(self, fake_host):
+        root, *_ = fake_host
+        agent = make_agent(fake_host)
+        spec = generate_cdi_spec("s1", 0, [0, 1])
+        agent.refresh_device_stack("n0", spec=spec)
+        assert list_cdi_specs(agent.cdi_dir) == ["s1-worker0"]
+        agent.refresh_device_stack("n0", remove_name="s1-worker0")
+        assert list_cdi_specs(agent.cdi_dir) == []
+        agent.create_device_taint("n0", ["chip-a", "chip-b"], "detaching")
+        assert agent.has_device_taint("n0", "chip-a")
+        agent.delete_device_taint("n0", ["chip-a", "chip-b"])
+        assert not agent.has_device_taint("n0", "chip-a")
+
+
+class TestNativeParity:
+    """The C++ lib and the Python fallback must agree (the lib is an
+    optimization, not a behavior change)."""
+
+    def test_native_enum_matches_python(self, fake_host):
+        lib = native_lib()
+        if lib is None:
+            pytest.skip("native lib not built")
+        root, dev, proc, _ = fake_host
+        agent_native = make_agent(fake_host)
+        agent_py = make_agent(fake_host)
+        agent_py._native = None
+        assert agent_native._accel_nodes() == agent_py._accel_nodes()
+
+    def test_native_fd_holders_matches_python(self, fake_host):
+        lib = native_lib()
+        if lib is None:
+            pytest.skip("native lib not built")
+        root, dev, proc, _ = fake_host
+        target = os.path.join(dev, "accel0")
+        assert lib.fd_holders(target, proc) == [1234]
+        agent_py = make_agent(fake_host)
+        agent_py._native = None
+        assert agent_py._holders(target) == [1234]
+
+    def test_native_enum_missing_dir(self):
+        lib = native_lib()
+        if lib is None:
+            pytest.skip("native lib not built")
+        assert lib.enum_accel("/definitely/not/a/dir") == []

@@ -1,0 +1,138 @@
+"""End-to-end: the full operator running live (manager + threaded controllers
++ syncer) against the mock fabric — the 'minimum end-to-end slice' of
+SURVEY.md §7 and BASELINE.json configs [0]-[3], driven through the public API
+the way a user would."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.types import REQUEST_STATE_RUNNING
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.controllers import (
+    ComposabilityRequestReconciler,
+    ComposableResourceReconciler,
+    RequestTiming,
+    ResourceTiming,
+    UpstreamSyncer,
+)
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.store import Store
+
+
+@pytest.fixture()
+def operator():
+    store = Store()
+    for i in range(8):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 4
+        store.create(n)
+    pool = InMemoryPool()
+    agent = FakeNodeAgent(pool=pool)
+    mgr = Manager(store=store)
+    mgr.add_controller(ComposabilityRequestReconciler(
+        store, pool, timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.05)))
+    mgr.add_controller(ComposableResourceReconciler(
+        store, pool, agent,
+        timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
+                              detach_poll=0.05, detach_fast=0.05, busy_poll=0.05)))
+    syncer = UpstreamSyncer(store, pool, period=0.05, grace=0.2)
+    mgr.add_runnable(syncer)
+    mgr.start(workers_per_controller=2)
+    yield store, pool, agent, mgr
+    mgr.stop()
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def submit(store, name, size, type_="tpu", model="tpu-v4"):
+    store.create(ComposabilityRequest(
+        metadata=ObjectMeta(name=name),
+        spec=ComposabilityRequestSpec(
+            resource=ResourceDetails(type=type_, model=model, size=size)),
+    ))
+
+
+class TestEndToEnd:
+    def test_tpu8_request_reaches_running_and_cleans_up(self, operator):
+        store, pool, agent, mgr = operator
+        submit(store, "job", 8)
+        assert wait_for(
+            lambda: store.get(ComposabilityRequest, "job").status.state
+            == REQUEST_STATE_RUNNING
+        ), store.get(ComposabilityRequest, "job").status.to_dict()
+        req = store.get(ComposabilityRequest, "job")
+        assert req.status.slice.topology == "2x2x2"
+        assert len(req.status.resources) == 2
+        assert all(len(r.device_ids) == 4 for r in req.status.resources.values())
+        # CDI specs live on both workers
+        hosts = req.status.slice.worker_hostnames
+        assert all(agent.published(h) for h in hosts)
+
+        store.delete(ComposabilityRequest, "job")
+        assert wait_for(lambda: store.try_get(ComposabilityRequest, "job") is None)
+        assert wait_for(lambda: not store.list(ComposableResource))
+        assert wait_for(lambda: pool.free_chips("tpu-v4") == 64)
+
+    def test_concurrent_requests_share_the_pool(self, operator):
+        store, pool, agent, mgr = operator
+        for i in range(3):
+            submit(store, f"job-{i}", 4)
+        ok = wait_for(
+            lambda: all(
+                store.get(ComposabilityRequest, f"job-{i}").status.state
+                == REQUEST_STATE_RUNNING
+                for i in range(3)
+            )
+        )
+        assert ok, [store.get(ComposabilityRequest, f"job-{i}").status.to_dict() for i in range(3)]
+        assert pool.free_chips("tpu-v4") == 64 - 12
+        used_nodes = {
+            rs.node_name
+            for i in range(3)
+            for rs in store.get(ComposabilityRequest, f"job-{i}").status.resources.values()
+        }
+        assert len(used_nodes) == 3  # one 4-chip slice fills a 4-slot host
+
+    def test_syncer_reclaims_leak_while_operator_runs(self, operator):
+        store, pool, agent, mgr = operator
+        before = pool.free_chips("tpu-v4")
+        pool.leak_attachment("worker-5", "tpu-v4")
+        assert wait_for(lambda: pool.free_chips("tpu-v4") == before, timeout=15)
+        assert wait_for(lambda: not store.list(ComposableResource))
+
+    def test_live_resize_grows_slice(self, operator):
+        store, pool, agent, mgr = operator
+        submit(store, "job", 4)
+        assert wait_for(
+            lambda: store.get(ComposabilityRequest, "job").status.state
+            == REQUEST_STATE_RUNNING
+        )
+        req = store.get(ComposabilityRequest, "job")
+        req.spec.resource.size = 16
+        store.update(req)
+        assert wait_for(
+            lambda: (
+                store.get(ComposabilityRequest, "job").status.state == REQUEST_STATE_RUNNING
+                and store.get(ComposabilityRequest, "job").status.slice.num_hosts == 4
+            ),
+            timeout=15,
+        ), store.get(ComposabilityRequest, "job").status.to_dict()
+        assert pool.free_chips("tpu-v4") == 64 - 16

@@ -1,0 +1,209 @@
+"""In-memory pool: slice transactions, async attach sentinels, idempotency,
+fault injection, drift leaks — the mock-fabric contract every controller
+test builds on (reference analog: the httptest fake, SURVEY.md §4)."""
+
+import pytest
+
+from tpu_composer.api import ComposableResource, ComposableResourceSpec, ObjectMeta
+from tpu_composer.fabric import (
+    DeviceHealth,
+    FabricError,
+    InMemoryPool,
+    WaitingDeviceAttaching,
+    WaitingDeviceDetaching,
+    new_fabric_provider,
+)
+from tpu_composer.fabric.adapter import AdapterError, reset_shared_mock
+
+
+def tpu_res(name="r0", node="worker-0", slice_name="s1", worker_id=0, chips=4):
+    return ComposableResource(
+        metadata=ObjectMeta(name=name),
+        spec=ComposableResourceSpec(
+            type="tpu", model="tpu-v4", target_node=node,
+            chip_count=chips, slice_name=slice_name, worker_id=worker_id,
+            topology="2x2x2",
+        ),
+    )
+
+
+def gpu_res(name="g0", node="worker-0"):
+    return ComposableResource(
+        metadata=ObjectMeta(name=name),
+        spec=ComposableResourceSpec(type="gpu", model="gpu-a100", target_node=node),
+    )
+
+
+class TestSliceTransactions:
+    def test_reserve_then_attach_members(self):
+        pool = InMemoryPool()
+        pool.reserve_slice("s1", "tpu-v4", "2x2x2", ["worker-0", "worker-1"])
+        assert pool.free_chips("tpu-v4") == 64 - 8
+        r0 = pool.add_resource(tpu_res("r0", "worker-0", worker_id=0))
+        r1 = pool.add_resource(tpu_res("r1", "worker-1", worker_id=1))
+        assert len(r0.device_ids) == 4 and len(r1.device_ids) == 4
+        assert not set(r0.device_ids) & set(r1.device_ids)
+        assert "slice=s1" in r0.cdi_device_id and "worker=0" in r0.cdi_device_id
+
+    def test_reserve_is_all_or_nothing(self):
+        pool = InMemoryPool(chips={"tpu-v4": 7})
+        with pytest.raises(FabricError):
+            pool.reserve_slice("s1", "tpu-v4", "2x2x2", ["w0", "w1"])
+        assert pool.free_chips("tpu-v4") == 7  # nothing carved
+
+    def test_reserve_host_count_mismatch(self):
+        pool = InMemoryPool()
+        with pytest.raises(FabricError):
+            pool.reserve_slice("s1", "tpu-v4", "2x2x2", ["w0"])  # needs 2 hosts
+
+    def test_release_returns_chips(self):
+        pool = InMemoryPool()
+        pool.reserve_slice("s1", "tpu-v4", "2x2x2", ["w0", "w1"])
+        pool.release_slice("s1")
+        assert pool.free_chips("tpu-v4") == 64
+
+    def test_release_after_detach_no_double_free(self):
+        pool = InMemoryPool()
+        pool.reserve_slice("s1", "tpu-v4", "2x2x2", ["w0", "w1"])
+        res = tpu_res("r0", "w0", worker_id=0)
+        res.status.device_ids = pool.add_resource(res).device_ids
+        pool.remove_resource(res)
+        pool.release_slice("s1")
+        assert pool.free_chips("tpu-v4") == 64
+
+    def test_attach_without_reservation_fails(self):
+        pool = InMemoryPool()
+        with pytest.raises(FabricError):
+            pool.add_resource(tpu_res("r0", slice_name="ghost"))
+
+
+class TestAsyncAttach:
+    def test_async_steps_raise_wait_sentinels_then_complete(self):
+        pool = InMemoryPool(async_steps=2)
+        pool.reserve_slice("s1", "tpu-v4", "2x2x1", ["w0"])
+        res = tpu_res("r0", "w0", chips=4)
+        res.spec.topology = "2x2x1"
+        with pytest.raises(WaitingDeviceAttaching):
+            pool.add_resource(res)  # accepted
+        with pytest.raises(WaitingDeviceAttaching):
+            pool.add_resource(res)  # still in progress
+        out = pool.add_resource(res)  # complete
+        assert len(out.device_ids) == 4
+
+    def test_attach_idempotent_after_complete(self):
+        pool = InMemoryPool()
+        pool.reserve_slice("s1", "tpu-v4", "2x2x1", ["w0"])
+        res = tpu_res("r0", "w0")
+        a = pool.add_resource(res)
+        b = pool.add_resource(res)
+        assert a.device_ids == b.device_ids
+
+    def test_async_detach(self):
+        pool = InMemoryPool(async_steps=1)
+        res = gpu_res()
+        with pytest.raises(WaitingDeviceAttaching):
+            pool.add_resource(res)
+        pool.add_resource(res)
+        with pytest.raises(WaitingDeviceDetaching):
+            pool.remove_resource(res)
+        pool.remove_resource(res)
+        assert pool.free_chips("gpu-a100") == 8
+
+    def test_remove_unknown_is_noop(self):
+        pool = InMemoryPool()
+        pool.remove_resource(gpu_res("never-attached"))
+
+
+class TestGpuCompat:
+    def test_loose_attach_detach(self):
+        pool = InMemoryPool()
+        out = pool.add_resource(gpu_res())
+        assert len(out.device_ids) == 1
+        assert pool.free_chips("gpu-a100") == 7
+        res = gpu_res()
+        pool.remove_resource(res)
+        assert pool.free_chips("gpu-a100") == 8
+
+    def test_pool_exhaustion(self):
+        pool = InMemoryPool(chips={"gpu-a100": 0})
+        with pytest.raises(FabricError):
+            pool.add_resource(gpu_res())
+
+    def test_unknown_model(self):
+        pool = InMemoryPool()
+        r = gpu_res()
+        r.spec.model = "gpu-h999"
+        with pytest.raises(FabricError):
+            pool.add_resource(r)
+
+
+class TestHealthAndDrift:
+    def test_check_resource_reports_worst_health(self):
+        pool = InMemoryPool()
+        pool.reserve_slice("s1", "tpu-v4", "2x2x1", ["w0"])
+        res = tpu_res("r0", "w0")
+        out = pool.add_resource(res)
+        assert pool.check_resource(res).healthy
+        pool.set_health(out.device_ids[2], DeviceHealth("Critical", "ICI link down"))
+        h = pool.check_resource(res)
+        assert h.state == "Critical" and "ICI" in h.detail
+
+    def test_check_unattached_is_critical(self):
+        pool = InMemoryPool()
+        assert pool.check_resource(gpu_res()).state == "Critical"
+
+    def test_get_resources_lists_attachments_and_leaks(self):
+        pool = InMemoryPool()
+        pool.add_resource(gpu_res())
+        leaked = pool.leak_attachment("worker-3", "tpu-v4")
+        devs = pool.get_resources()
+        assert len(devs) == 2
+        by_id = {d.device_id: d for d in devs}
+        assert by_id[leaked].node == "worker-3"
+
+    def test_detach_cr_reclaims_leak(self):
+        pool = InMemoryPool()
+        leaked = pool.leak_attachment("worker-3", "tpu-v4")
+        before = pool.free_chips("tpu-v4")
+        detach_cr = tpu_res("detach-cr", "worker-3", slice_name="")
+        detach_cr.status.device_ids = [leaked]
+        pool.remove_resource(detach_cr)
+        assert pool.free_chips("tpu-v4") == before + 1
+        assert not any(d.device_id == leaked for d in pool.get_resources())
+
+
+class TestFaultInjection:
+    def test_injected_add_failure_then_success(self):
+        pool = InMemoryPool()
+        pool.inject_add_failure("g0", times=1)
+        with pytest.raises(FabricError):
+            pool.add_resource(gpu_res())
+        out = pool.add_resource(gpu_res())
+        assert out.device_ids
+
+    def test_injected_remove_failure(self):
+        pool = InMemoryPool()
+        pool.add_resource(gpu_res())
+        pool.inject_remove_failure("g0", times=1)
+        with pytest.raises(FabricError):
+            pool.remove_resource(gpu_res())
+        pool.remove_resource(gpu_res())
+
+
+class TestAdapter:
+    def test_default_is_shared_mock(self, monkeypatch):
+        reset_shared_mock()
+        monkeypatch.delenv("CDI_PROVIDER_TYPE", raising=False)
+        a = new_fabric_provider()
+        b = new_fabric_provider()
+        assert a is b and isinstance(a, InMemoryPool)
+        reset_shared_mock()
+
+    def test_rest_requires_endpoint(self, monkeypatch):
+        monkeypatch.delenv("FABRIC_ENDPOINT", raising=False)
+        with pytest.raises(AdapterError):
+            new_fabric_provider("REST_CM")
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(AdapterError):
+            new_fabric_provider("NVSWITCH")

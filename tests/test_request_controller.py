@@ -1,0 +1,345 @@
+"""ComposabilityRequest state machine + allocator, stepped one reconcile at a
+time (reference pattern: composabilityrequest_controller_test.go table-driven
+entries, SURVEY.md §4)."""
+
+import pytest
+
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    OtherSpec,
+    ResourceDetails,
+)
+from tpu_composer.api.types import (
+    ANNOTATION_DELETE_DEVICE,
+    ANNOTATION_LAST_USED_TIME,
+    LABEL_MANAGED_BY,
+    REQUEST_STATE_CLEANING,
+    REQUEST_STATE_NODE_ALLOCATING,
+    REQUEST_STATE_RUNNING,
+    REQUEST_STATE_UPDATING,
+    RESOURCE_STATE_ONLINE,
+)
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.controllers.request_controller import (
+    AllocationError,
+    ComposabilityRequestReconciler,
+)
+from tpu_composer.controllers.resource_controller import ComposableResourceReconciler
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.store import Store
+
+
+@pytest.fixture()
+def world():
+    store = Store()
+    for i in range(8):  # mirrors the reference suite's worker-0..7 fixture
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 4
+        n.status.milli_cpu = 8000
+        n.status.memory = 64 << 30
+        n.status.allowed_pod_number = 100
+        store.create(n)
+    pool = InMemoryPool()
+    agent = FakeNodeAgent(pool=pool)
+    req_rec = ComposabilityRequestReconciler(store, pool)
+    res_rec = ComposableResourceReconciler(store, pool, agent)
+    return store, pool, agent, req_rec, res_rec
+
+
+def make_request(store, name="req-1", type_="tpu", model="tpu-v4", size=4, **kw):
+    req = ComposabilityRequest(
+        metadata=ObjectMeta(name=name),
+        spec=ComposabilityRequestSpec(
+            resource=ResourceDetails(type=type_, model=model, size=size, **kw)
+        ),
+    )
+    return store.create(req)
+
+
+def get_req(store, name="req-1"):
+    return store.get(ComposabilityRequest, name)
+
+
+def children_of(store, name="req-1"):
+    return store.list(ComposableResource, label_selector={LABEL_MANAGED_BY: name})
+
+
+def run_to_ready(store, req_rec, res_rec, name="req-1", max_steps=60):
+    """Pump both reconcilers until the request is Running (or give up)."""
+    for _ in range(max_steps):
+        req_rec.reconcile(name)
+        for c in store.list(ComposableResource):
+            res_rec.reconcile(c.metadata.name)
+        if get_req(store, name).status.state == REQUEST_STATE_RUNNING:
+            return
+    raise AssertionError(
+        f"request never reached Running: {get_req(store, name).status.to_dict()}"
+    )
+
+
+class TestTpuAllocation:
+    def test_single_host_slice_to_running(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=4)
+        req_rec.reconcile("req-1")  # "" -> NodeAllocating
+        req_rec.reconcile("req-1")  # allocate -> Updating
+        req = get_req(store)
+        assert req.status.state == REQUEST_STATE_UPDATING
+        assert req.status.slice.topology == "1x2x2"
+        assert req.status.slice.num_hosts == 1
+        assert len(req.status.resources) == 1
+        run_to_ready(store, req_rec, res_rec)
+        req = get_req(store)
+        assert req.status.state == REQUEST_STATE_RUNNING
+        (rs,) = req.status.resources.values()
+        assert rs.state == RESOURCE_STATE_ONLINE
+        assert len(rs.device_ids) == 4
+
+    def test_multi_host_pod_slice(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=32)
+        run_to_ready(store, req_rec, res_rec)
+        req = get_req(store)
+        assert req.status.slice.num_hosts == 8
+        assert len(req.status.slice.worker_hostnames) == 8
+        assert len(set(req.status.slice.worker_hostnames)) == 8
+        kids = children_of(store)
+        assert len(kids) == 8
+        assert sorted(c.spec.worker_id for c in kids) == list(range(8))
+        assert all(c.spec.chip_count == 4 for c in kids)
+        # 32 chips carved from the pool
+        assert pool.free_chips("tpu-v4") == 64 - 32
+
+    def test_all_or_nothing_when_pool_too_small(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        small = InMemoryPool(chips={"tpu-v4": 6})
+        req_rec = ComposabilityRequestReconciler(store, small)
+        make_request(store, size=8)
+        req_rec.reconcile("req-1")
+        with pytest.raises(Exception):
+            req_rec.reconcile("req-1")
+        req = get_req(store)
+        assert req.status.state == REQUEST_STATE_NODE_ALLOCATING
+        assert "free" in req.status.error
+        assert small.free_chips("tpu-v4") == 6  # nothing leaked
+        assert children_of(store) == []
+
+    def test_not_enough_hosts_is_allocation_error(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=64)  # needs 16 hosts, we have 8
+        req_rec.reconcile("req-1")
+        with pytest.raises(AllocationError):
+            req_rec.reconcile("req-1")
+        assert "hosts" in get_req(store).status.error
+
+    def test_invalid_chip_count_surfaces_topology_error(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=6)
+        req_rec.reconcile("req-1")
+        with pytest.raises(Exception):
+            req_rec.reconcile("req-1")
+        assert "cannot form a slice" in get_req(store).status.error
+
+    def test_target_node_single_host(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=4, target_node="worker-3")
+        run_to_ready(store, req_rec, res_rec)
+        (child,) = children_of(store)
+        assert child.spec.target_node == "worker-3"
+
+    def test_target_node_rejects_multi_host_topology(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=8, target_node="worker-0")
+        req_rec.reconcile("req-1")
+        with pytest.raises(AllocationError):
+            req_rec.reconcile("req-1")
+
+    def test_default_policy_places_multi_host_slice(self, world):
+        # For tpu the topology dictates host count; the default (samenode)
+        # policy must not block a multi-host slice.
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=8, allocation_policy="samenode")
+        run_to_ready(store, req_rec, res_rec)
+        kids = children_of(store)
+        assert len({c.spec.target_node for c in kids}) == 2
+
+    def test_topology_policy_spreads_multi_host(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=8, allocation_policy="topology")
+        run_to_ready(store, req_rec, res_rec)
+        kids = children_of(store)
+        assert len({c.spec.target_node for c in kids}) == 2
+
+    def test_capacity_filter_respects_other_spec(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        # Demand more CPU than any node offers.
+        make_request(store, size=4, other_spec=OtherSpec(milli_cpu=99999))
+        req_rec.reconcile("req-1")
+        with pytest.raises(AllocationError):
+            req_rec.reconcile("req-1")
+
+    def test_occupancy_excludes_full_nodes(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        # Fill every node but worker-7 with a 4-chip slice each.
+        for i in range(7):
+            make_request(store, name=f"filler-{i}", size=4, target_node=f"worker-{i}")
+            run_to_ready(store, req_rec, res_rec, name=f"filler-{i}")
+        make_request(store, size=4)
+        run_to_ready(store, req_rec, res_rec)
+        (child,) = children_of(store)
+        assert child.spec.target_node == "worker-7"
+
+
+class TestScalarCompat:
+    def test_gpu_request_to_running(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, type_="gpu", model="gpu-a100", size=2,
+                     allocation_policy="differentnode")
+        run_to_ready(store, req_rec, res_rec)
+        kids = children_of(store)
+        assert len(kids) == 2
+        assert len({c.spec.target_node for c in kids}) == 2
+        assert pool.free_chips("gpu-a100") == 6
+
+    def test_samenode_packs_one_node(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, type_="gpu", model="gpu-a100", size=2)
+        run_to_ready(store, req_rec, res_rec)
+        kids = children_of(store)
+        assert len({c.spec.target_node for c in kids}) == 1
+
+    def test_shrink_uses_deletion_priorities(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, type_="gpu", model="gpu-a100", size=3,
+                     allocation_policy="differentnode")
+        run_to_ready(store, req_rec, res_rec)
+        kids = children_of(store)
+        # Mark one child explicitly deletable and one as recently used.
+        marked = kids[0]
+        marked.metadata.annotations[ANNOTATION_DELETE_DEVICE] = "true"
+        store.update(marked)
+        used = kids[1]
+        used.metadata.annotations[ANNOTATION_LAST_USED_TIME] = "2026-07-29T00:00:00Z"
+        store.update(used)
+
+        req = get_req(store)
+        req.spec.resource.size = 2
+        store.update(req)
+        # Running sees drift -> NodeAllocating -> deletes the marked child.
+        req_rec.reconcile("req-1")
+        req_rec.reconcile("req-1")
+        doomed = store.try_get(ComposableResource, marked.metadata.name)
+        assert doomed is None or doomed.being_deleted
+        survivor = store.get(ComposableResource, used.metadata.name)
+        assert not survivor.being_deleted
+
+    def test_grow_keeps_existing_children(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, type_="gpu", model="gpu-a100", size=1)
+        run_to_ready(store, req_rec, res_rec)
+        (orig,) = children_of(store)
+        req = get_req(store)
+        req.spec.resource.size = 2
+        store.update(req)
+        run_to_ready(store, req_rec, res_rec)
+        kids = children_of(store)
+        assert len(kids) == 2
+        assert orig.metadata.name in {c.metadata.name for c in kids}
+
+
+class TestLifecycle:
+    def test_delete_cleans_children_and_releases_chips(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=8)
+        run_to_ready(store, req_rec, res_rec)
+        assert pool.free_chips("tpu-v4") == 56
+        store.delete(ComposabilityRequest, "req-1")
+        for _ in range(30):
+            if store.try_get(ComposabilityRequest, "req-1") is None:
+                break
+            req_rec.reconcile("req-1")
+            for c in store.list(ComposableResource):
+                res_rec.reconcile(c.metadata.name)
+        assert store.try_get(ComposabilityRequest, "req-1") is None
+        assert store.list(ComposableResource) == []
+        assert pool.free_chips("tpu-v4") == 64  # slice fully released
+
+    def test_spec_drift_in_running_reallocates(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=4)
+        run_to_ready(store, req_rec, res_rec)
+        req = get_req(store)
+        req.spec.resource.size = 8
+        store.update(req)
+        req_rec.reconcile("req-1")
+        assert get_req(store).status.state == REQUEST_STATE_NODE_ALLOCATING
+        run_to_ready(store, req_rec, res_rec)
+        req = get_req(store)
+        assert req.status.slice.num_hosts == 2
+        assert sum(len(r.device_ids) for r in req.status.resources.values()) == 8
+
+    def test_member_loss_triggers_reallocation(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=8)
+        run_to_ready(store, req_rec, res_rec)
+        victim = children_of(store)[0]
+        store.delete(ComposableResource, victim.metadata.name)
+        # let the victim's detach run to purge
+        for _ in range(10):
+            if store.try_get(ComposableResource, victim.metadata.name) is None:
+                break
+            res_rec.reconcile(victim.metadata.name)
+        req_rec.reconcile("req-1")
+        assert get_req(store).status.state == REQUEST_STATE_NODE_ALLOCATING
+        run_to_ready(store, req_rec, res_rec)
+        assert get_req(store).status.state == REQUEST_STATE_RUNNING
+
+    def test_request_gc_when_target_node_deleted(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=4, target_node="worker-2")
+        run_to_ready(store, req_rec, res_rec)
+        store.delete(Node, "worker-2")
+        req_rec.reconcile("req-1")
+        req = get_req(store)
+        assert req.being_deleted
+        assert req.status.state == REQUEST_STATE_CLEANING
+
+    def test_size_zero_runs_with_no_children(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=0)
+        run_to_ready(store, req_rec, res_rec)
+        assert children_of(store) == []
+
+
+class TestScalarRecovery:
+    def test_lost_scalar_child_is_replaced(self, world):
+        """A gpu request that loses a child must re-allocate it, not flap
+        Running<->Updating at reduced size."""
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, type_="gpu", model="gpu-a100", size=2,
+                     allocation_policy="differentnode")
+        run_to_ready(store, req_rec, res_rec)
+        victim = children_of(store)[0]
+        store.delete(ComposableResource, victim.metadata.name)
+        for _ in range(10):
+            if store.try_get(ComposableResource, victim.metadata.name) is None:
+                break
+            res_rec.reconcile(victim.metadata.name)
+        run_to_ready(store, req_rec, res_rec)
+        kids = children_of(store)
+        assert len(kids) == 2
+        assert all(c.status.state == RESOURCE_STATE_ONLINE for c in kids)
+
+    def test_scalar_target_node_overcommit_rejected(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        # worker-0 has 4 slots; ask for 5 devices pinned there.
+        make_request(store, type_="gpu", model="gpu-a100", size=5,
+                     target_node="worker-0")
+        req_rec.reconcile("req-1")
+        with pytest.raises(AllocationError):
+            req_rec.reconcile("req-1")
+        assert "free device ports" in get_req(store).status.error

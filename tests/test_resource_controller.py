@@ -1,0 +1,320 @@
+"""ComposableResource state machine, stepped one reconcile at a time —
+the reference's test pattern (triggerComposableResourceReconcile,
+composableresource_controller_test.go:90-102): drive Reconcile directly, then
+assert the full status after each transition."""
+
+import pytest
+
+from tpu_composer.api import (
+    ComposableResource,
+    ComposableResourceSpec,
+    Node,
+    ObjectMeta,
+)
+from tpu_composer.api.types import (
+    FINALIZER,
+    LABEL_READY_TO_DETACH,
+    RESOURCE_STATE_ATTACHING,
+    RESOURCE_STATE_DELETING,
+    RESOURCE_STATE_DETACHING,
+    RESOURCE_STATE_ONLINE,
+)
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.controllers.resource_controller import (
+    ComposableResourceReconciler,
+    ResourceTiming,
+)
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.fabric.provider import DeviceHealth, FabricError
+from tpu_composer.runtime.store import Store
+
+
+@pytest.fixture()
+def world():
+    """Store with nodes + mock fabric + fake agent + reconciler (not started:
+    tests step reconcile() directly)."""
+    store = Store()
+    for i in range(4):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 8
+        store.create(n)
+    pool = InMemoryPool()
+    agent = FakeNodeAgent(pool=pool)
+    rec = ComposableResourceReconciler(store, pool, agent, timing=ResourceTiming())
+    return store, pool, agent, rec
+
+
+def make_tpu_cr(store, pool, name="r0", node="worker-0", slice_name="s1",
+                worker_id=0, topology="2x2x1", reserve=True, nodes=None):
+    if reserve:
+        pool.reserve_slice(slice_name, "tpu-v4", topology, nodes or [node])
+    cr = ComposableResource(
+        metadata=ObjectMeta(name=name),
+        spec=ComposableResourceSpec(
+            type="tpu", model="tpu-v4", target_node=node, chip_count=4,
+            slice_name=slice_name, worker_id=worker_id, topology=topology,
+        ),
+    )
+    return store.create(cr)
+
+
+def make_gpu_cr(store, name="g0", node="worker-0"):
+    return store.create(ComposableResource(
+        metadata=ObjectMeta(name=name),
+        spec=ComposableResourceSpec(type="gpu", model="gpu-a100", target_node=node),
+    ))
+
+
+def step(rec, name):
+    return rec.reconcile(name)
+
+
+def get(store, name):
+    return store.get(ComposableResource, name)
+
+
+class TestAttachPath:
+    def test_none_state_adds_finalizer_and_moves_to_attaching(self, world):
+        store, pool, agent, rec = world
+        make_tpu_cr(store, pool)
+        step(rec, "r0")
+        cr = get(store, "r0")
+        assert cr.has_finalizer(FINALIZER)
+        assert cr.status.state == RESOURCE_STATE_ATTACHING
+
+    def test_attaching_reaches_online_with_cdi_published(self, world):
+        store, pool, agent, rec = world
+        make_tpu_cr(store, pool)
+        step(rec, "r0")  # "" -> Attaching
+        step(rec, "r0")  # Attaching -> Online
+        cr = get(store, "r0")
+        assert cr.status.state == RESOURCE_STATE_ONLINE
+        assert len(cr.status.device_ids) == 4
+        assert "slice=s1" in cr.status.cdi_device_id
+        assert agent.published("worker-0") == ["s1-worker0"]
+        spec = agent.published_spec("worker-0", "s1-worker0")
+        assert spec.env["TPU_WORKER_ID"] == "0"
+        assert spec.env["TPU_TOPOLOGY"] == "2x2x1"
+        assert spec.device_nodes == ["/dev/accel0", "/dev/accel1", "/dev/accel2", "/dev/accel3"]
+
+    def test_async_fabric_requeues_without_error(self, world):
+        store, _, agent, _ = world
+        pool = InMemoryPool(async_steps=2)
+        rec = ComposableResourceReconciler(store, pool, FakeNodeAgent(pool=pool))
+        make_tpu_cr(store, pool)
+        step(rec, "r0")  # -> Attaching
+        r = step(rec, "r0")  # fabric: accepted, waiting
+        assert r.requeue_after == rec.timing.attach_poll
+        cr = get(store, "r0")
+        assert cr.status.state == RESOURCE_STATE_ATTACHING
+        assert cr.status.error == ""  # wait sentinel is not an error
+        step(rec, "r0")  # still waiting
+        step(rec, "r0")  # completes
+        assert get(store, "r0").status.state == RESOURCE_STATE_ONLINE
+
+    def test_visibility_delay_polls_then_online(self, world):
+        store, pool, agent, rec = world
+        make_tpu_cr(store, pool)
+        agent.set_visibility_delay("worker-0", 2)
+        step(rec, "r0")
+        r = step(rec, "r0")
+        assert r.requeue_after == rec.timing.visibility_poll
+        assert get(store, "r0").status.state == RESOURCE_STATE_ATTACHING
+        step(rec, "r0")
+        step(rec, "r0")
+        assert get(store, "r0").status.state == RESOURCE_STATE_ONLINE
+
+    def test_missing_driver_surfaces_error_and_raises(self, world):
+        store, pool, agent, rec = world
+        make_tpu_cr(store, pool)
+        agent.set_no_driver("worker-0")
+        step(rec, "r0")
+        with pytest.raises(Exception):
+            step(rec, "r0")
+        assert "no libtpu" in get(store, "r0").status.error
+
+    def test_fabric_failure_surfaces_error(self, world):
+        store, pool, agent, rec = world
+        make_tpu_cr(store, pool)
+        pool.inject_add_failure("r0")
+        step(rec, "r0")
+        with pytest.raises(FabricError):
+            step(rec, "r0")
+        assert "injected attach failure" in get(store, "r0").status.error
+        # retry succeeds and clears the error
+        step(rec, "r0")
+        cr = get(store, "r0")
+        assert cr.status.state == RESOURCE_STATE_ONLINE and cr.status.error == ""
+
+    def test_gpu_compat_attach(self, world):
+        store, pool, agent, rec = world
+        make_gpu_cr(store)
+        step(rec, "g0")
+        step(rec, "g0")
+        cr = get(store, "g0")
+        assert cr.status.state == RESOURCE_STATE_ONLINE
+        assert len(cr.status.device_ids) == 1
+        assert agent.published("worker-0") == []  # no CDI for gpu compat
+
+
+class TestOnlineState:
+    def _online(self, world, name="r0"):
+        store, pool, agent, rec = world
+        make_tpu_cr(store, pool, name=name)
+        step(rec, name)
+        step(rec, name)
+        assert get(store, name).status.state == RESOURCE_STATE_ONLINE
+        return store, pool, agent, rec
+
+    def test_healthy_poll_keeps_online(self, world):
+        store, pool, agent, rec = self._online(world)
+        r = step(rec, "r0")
+        assert r.requeue_after == rec.timing.health_poll
+        assert get(store, "r0").status.error == ""
+
+    def test_unhealthy_fabric_surfaces_error_but_stays_online(self, world):
+        store, pool, agent, rec = self._online(world)
+        chip = get(store, "r0").status.device_ids[0]
+        pool.set_health(chip, DeviceHealth("Critical", "ICI link down"))
+        step(rec, "r0")
+        cr = get(store, "r0")
+        assert cr.status.state == RESOURCE_STATE_ONLINE
+        assert "Critical" in cr.status.error
+        pool.set_health(chip, DeviceHealth())
+        step(rec, "r0")
+        assert get(store, "r0").status.error == ""
+
+    def test_delete_moves_to_detaching(self, world):
+        store, pool, agent, rec = self._online(world)
+        store.delete(ComposableResource, "r0")
+        step(rec, "r0")
+        assert get(store, "r0").status.state == RESOURCE_STATE_DETACHING
+
+
+class TestDetachPath:
+    def _deleting_online(self, world):
+        store, pool, agent, rec = world
+        make_tpu_cr(store, pool)
+        step(rec, "r0")
+        step(rec, "r0")
+        store.delete(ComposableResource, "r0")
+        step(rec, "r0")  # Online -> Detaching
+        return store, pool, agent, rec
+
+    def test_full_detach_releases_and_purges(self, world):
+        store, pool, agent, rec = self._deleting_online(world)
+        step(rec, "r0")  # Detaching: drain+fabric remove+cleanup -> Deleting
+        cr = get(store, "r0")
+        assert cr.status.state == RESOURCE_STATE_DELETING
+        assert cr.status.device_ids == []
+        assert agent.published("worker-0") == []  # CDI retracted
+        assert agent.taints() == {}  # quarantine lifted
+        step(rec, "r0")  # Deleting -> finalizer removed -> purged
+        assert store.try_get(ComposableResource, "r0") is None
+        pool.release_slice("s1")
+        assert pool.free_chips("tpu-v4") == 64
+
+    def test_busy_device_blocks_detach_until_idle(self, world):
+        store, pool, agent, rec = self._deleting_online(world)
+        chip = pool.attached_to("worker-0")[0]
+        agent.add_load("worker-0", chip)
+        r = step(rec, "r0")
+        assert r.requeue_after == rec.timing.busy_poll
+        cr = get(store, "r0")
+        assert cr.status.state == RESOURCE_STATE_DETACHING
+        assert "in use" in cr.status.error
+        agent.clear_loads("worker-0")
+        step(rec, "r0")
+        assert get(store, "r0").status.state == RESOURCE_STATE_DELETING
+
+    def test_force_detach_ignores_loads(self, world):
+        store, pool, agent, rec = world
+        pool.reserve_slice("s1", "tpu-v4", "2x2x1", ["worker-0"])
+        cr = ComposableResource(
+            metadata=ObjectMeta(name="r0"),
+            spec=ComposableResourceSpec(
+                type="tpu", model="tpu-v4", target_node="worker-0", chip_count=4,
+                slice_name="s1", worker_id=0, topology="2x2x1", force_detach=True,
+            ),
+        )
+        store.create(cr)
+        step(rec, "r0")
+        step(rec, "r0")
+        chip = pool.attached_to("worker-0")[0]
+        agent.add_load("worker-0", chip)
+        store.delete(ComposableResource, "r0")
+        step(rec, "r0")
+        step(rec, "r0")
+        assert get(store, "r0").status.state == RESOURCE_STATE_DELETING
+
+    def test_detach_during_attaching_without_devices_goes_straight_to_deleting(self, world):
+        store, pool, agent, rec = world
+        make_tpu_cr(store, pool)
+        step(rec, "r0")  # -> Attaching
+        store.delete(ComposableResource, "r0")
+        step(rec, "r0")
+        assert get(store, "r0").status.state == RESOURCE_STATE_DELETING
+
+    def test_taint_created_while_draining_busy(self, world):
+        """Quarantine must be in place even while waiting on the fabric."""
+        store, _, agent, _ = world
+        pool = InMemoryPool(async_steps=2)
+        agent = FakeNodeAgent(pool=pool)
+        rec = ComposableResourceReconciler(store, pool, agent)
+        make_tpu_cr(store, pool)
+        step(rec, "r0")
+        step(rec, "r0")  # wait
+        step(rec, "r0")  # wait
+        step(rec, "r0")  # online
+        assert get(store, "r0").status.state == RESOURCE_STATE_ONLINE
+        store.delete(ComposableResource, "r0")
+        step(rec, "r0")  # -> Detaching
+        r = step(rec, "r0")  # fabric detach accepted, waiting
+        assert r.requeue_after == rec.timing.detach_poll
+        assert len(agent.taints()) == 4  # chips quarantined during the wait
+        step(rec, "r0")  # still waiting
+        step(rec, "r0")  # completes
+        assert get(store, "r0").status.state == RESOURCE_STATE_DELETING
+        assert agent.taints() == {}
+
+
+class TestGcAndAdoption:
+    def test_node_gone_forces_teardown(self, world):
+        store, pool, agent, rec = world
+        make_tpu_cr(store, pool)
+        step(rec, "r0")
+        step(rec, "r0")
+        store.delete(Node, "worker-0")
+        step(rec, "r0")  # GC kicks in
+        cr = get(store, "r0")
+        assert cr.status.state == RESOURCE_STATE_DELETING
+        assert cr.being_deleted
+        step(rec, "r0")
+        assert store.try_get(ComposableResource, "r0") is None
+
+    def test_ready_to_detach_label_adopted_and_detached(self, world):
+        store, pool, agent, rec = world
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        cr = ComposableResource(
+            metadata=ObjectMeta(
+                name="detach-cr",
+                labels={LABEL_READY_TO_DETACH: leaked},
+            ),
+            spec=ComposableResourceSpec(type="tpu", model="tpu-v4", target_node="worker-1"),
+        )
+        store.create(cr)
+        step(rec, "detach-cr")  # adopt: device id from label, state=Online
+        got = get(store, "detach-cr")
+        assert got.status.device_ids == [leaked]
+        assert got.status.state == RESOURCE_STATE_ONLINE
+        step(rec, "detach-cr")  # Online sees label -> self-delete -> Detaching
+        assert get(store, "detach-cr").status.state == RESOURCE_STATE_DETACHING
+        before = pool.free_chips("tpu-v4")
+        step(rec, "detach-cr")  # detach reclaims the leak
+        step(rec, "detach-cr")
+        assert store.try_get(ComposableResource, "detach-cr") is None
+        assert pool.free_chips("tpu-v4") == before + 1
+
+    def test_reconcile_of_absent_object_is_noop(self, world):
+        _, _, _, rec = world
+        assert rec.reconcile("ghost").requeue_after == 0

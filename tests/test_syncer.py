@@ -1,0 +1,147 @@
+"""UpstreamSyncer drift repair: grace tracking, detach-CR creation, and the
+full leak-reclaim loop through the resource controller (reference:
+upstreamsyncer_controller_test.go's 16 entries, SURVEY.md §3.5)."""
+
+import pytest
+
+from tpu_composer.api import ComposableResource, Node, ObjectMeta
+from tpu_composer.api.types import LABEL_READY_TO_DETACH
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.controllers.resource_controller import ComposableResourceReconciler
+from tpu_composer.controllers.syncer import UpstreamSyncer
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.store import Store
+
+
+@pytest.fixture()
+def world():
+    store = Store()
+    for i in range(2):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 8
+        store.create(n)
+    pool = InMemoryPool()
+    syncer = UpstreamSyncer(store, pool, period=0.01, grace=100.0)
+    return store, pool, syncer
+
+
+class TestDriftTracking:
+    def test_leak_tracked_but_not_acted_on_before_grace(self, world):
+        store, pool, syncer = world
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        created = syncer.sync_once(now=0.0)
+        assert created == 0
+        assert leaked in syncer.tracked_missing
+        assert store.list(ComposableResource) == []
+
+    def test_detach_cr_created_after_grace(self, world):
+        store, pool, syncer = world
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        syncer.sync_once(now=0.0)
+        created = syncer.sync_once(now=101.0)
+        assert created == 1
+        (cr,) = store.list(ComposableResource)
+        assert cr.metadata.labels[LABEL_READY_TO_DETACH] == leaked
+        assert cr.spec.force_detach
+        assert cr.spec.target_node == "worker-1"
+        # no duplicate on the next pass
+        assert syncer.sync_once(now=102.0) == 0
+
+    def test_locally_owned_devices_not_flagged(self, world):
+        store, pool, syncer = world
+        pool.reserve_slice("s1", "tpu-v4", "2x2x1", ["worker-0"])
+        res = ComposableResource(metadata=ObjectMeta(name="r0"))
+        res.spec.type, res.spec.model, res.spec.target_node = "tpu", "tpu-v4", "worker-0"
+        res.spec.chip_count, res.spec.slice_name, res.spec.topology = 4, "s1", "2x2x1"
+        out = pool.add_resource(res)
+        res.status.device_ids = out.device_ids
+        store.create(res)
+        created_obj = store.get(ComposableResource, "r0")
+        created_obj.status.device_ids = out.device_ids
+        store.update_status(created_obj)
+        syncer.sync_once(now=0.0)
+        assert syncer.tracked_missing == {}
+        assert syncer.sync_once(now=1000.0) == 0
+
+    def test_vanished_leak_stops_tracking(self, world):
+        store, pool, syncer = world
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        syncer.sync_once(now=0.0)
+        # Reclaim behind the syncer's back.
+        cr = ComposableResource(metadata=ObjectMeta(name="manual"))
+        cr.spec.type, cr.spec.model, cr.spec.target_node = "tpu", "tpu-v4", "worker-1"
+        cr.status.device_ids = [leaked]
+        pool.remove_resource(cr)
+        syncer.sync_once(now=50.0)
+        assert syncer.tracked_missing == {}
+
+    def test_reappeared_local_owner_clears_tracking(self, world):
+        store, pool, syncer = world
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        syncer.sync_once(now=0.0)
+        cr = ComposableResource(metadata=ObjectMeta(name="late-owner"))
+        cr.spec.type, cr.spec.model, cr.spec.target_node = "tpu", "tpu-v4", "worker-1"
+        store.create(cr)
+        got = store.get(ComposableResource, "late-owner")
+        got.status.device_ids = [leaked]
+        store.update_status(got)
+        syncer.sync_once(now=50.0)
+        assert syncer.tracked_missing == {}
+
+
+class TestEndToEndReclaim:
+    def test_leak_reclaimed_through_detach_path(self, world):
+        store, pool, syncer = world
+        agent = FakeNodeAgent(pool=pool)
+        rec = ComposableResourceReconciler(store, pool, agent)
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        free_before = pool.free_chips("tpu-v4")
+        syncer.sync_once(now=0.0)
+        syncer.sync_once(now=200.0)  # creates detach-CR
+        (cr,) = store.list(ComposableResource)
+        for _ in range(8):
+            if store.try_get(ComposableResource, cr.metadata.name) is None:
+                break
+            rec.reconcile(cr.metadata.name)
+        assert store.try_get(ComposableResource, cr.metadata.name) is None
+        assert pool.free_chips("tpu-v4") == free_before + 1
+        assert syncer.sync_once(now=300.0) == 0  # world converged
+
+
+class TestOrphanOnDeadNode:
+    def test_node_gone_orphan_fully_reclaimed(self, world):
+        """Node-gone GC purges the CR but leaves the fabric attachment; the
+        syncer's detach-CR (targeting the dead node) must still run the
+        fabric detach and return the chips to the pool."""
+        store, pool, syncer = world
+        agent = FakeNodeAgent(pool=pool)
+        rec = ComposableResourceReconciler(store, pool, agent)
+        pool.reserve_slice("s1", "tpu-v4", "2x2x1", ["worker-1"])
+        res = ComposableResource(metadata=ObjectMeta(name="r0"))
+        res.spec.type, res.spec.model, res.spec.target_node = "tpu", "tpu-v4", "worker-1"
+        res.spec.chip_count, res.spec.slice_name, res.spec.topology = 4, "s1", "2x2x1"
+        store.create(res)
+        rec.reconcile("r0")
+        rec.reconcile("r0")
+        assert store.get(ComposableResource, "r0").status.state == "Online"
+
+        store.delete(Node, "worker-1")
+        for _ in range(5):
+            if store.try_get(ComposableResource, "r0") is None:
+                break
+            rec.reconcile("r0")
+        assert store.try_get(ComposableResource, "r0") is None
+        # fabric still holds the chips -> syncer repairs
+        assert len(pool.get_resources()) == 4
+        syncer.sync_once(now=0.0)
+        assert syncer.sync_once(now=200.0) == 4  # one detach-CR per chip
+        for cr in store.list(ComposableResource):
+            for _ in range(6):
+                if store.try_get(ComposableResource, cr.metadata.name) is None:
+                    break
+                rec.reconcile(cr.metadata.name)
+        pool.release_slice("s1")
+        assert pool.free_chips("tpu-v4") == 64
+        assert pool.get_resources() == []
+        # converged: no more detach-CRs get created
+        assert syncer.sync_once(now=400.0) == 0

@@ -1,0 +1,85 @@
+"""Slice shape solver: valid shapes, host math, rejection of bad counts."""
+
+import pytest
+
+from tpu_composer.topology import SliceShape, TopologyError, solve_slice, is_tpu_model
+
+
+class TestSolve:
+    def test_single_chip(self):
+        s = solve_slice("tpu-v4", 1)
+        assert s.num_chips == 1 and s.num_hosts == 1 and s.chips_per_host == 1
+
+    def test_two_chips_standalone(self):
+        s = solve_slice("tpu-v4", 2)
+        assert s.num_hosts == 1
+
+    def test_single_host_v4_8(self):
+        # BASELINE config[2]: count=4 → single-host 2x2 slice
+        s = solve_slice("tpu-v4", 4)
+        assert sorted(s.dims) == [1, 2, 2]
+        assert s.num_hosts == 1 and s.chips_per_host == 4
+
+    def test_two_host_slice(self):
+        s = solve_slice("tpu-v4", 8)
+        assert s.num_hosts == 2
+        assert sorted(s.dims) == [2, 2, 2]
+
+    def test_pod_slice_32(self):
+        # BASELINE config[3]: multi-host pod slice
+        s = solve_slice("tpu-v4", 32)
+        assert s.num_hosts == 8
+        prod = 1
+        for d in s.dims:
+            prod *= d
+        assert prod == 32
+        # compactness: prefers 2x4x4 over 2x2x8
+        assert sorted(s.dims) == [2, 4, 4]
+
+    def test_explicit_topology_pinned(self):
+        s = solve_slice("tpu-v4", 16, topology="2x2x4")
+        assert s.dims == (2, 2, 4)
+        assert s.num_hosts == 4
+
+    def test_explicit_topology_wrong_count_rejected(self):
+        with pytest.raises(TopologyError):
+            solve_slice("tpu-v4", 8, topology="2x2x4")
+
+    def test_invalid_topology_shape_rejected(self):
+        # 1x1x16 is not a valid torus for 16 chips (dims must be >=2)
+        with pytest.raises(TopologyError):
+            solve_slice("tpu-v4", 16, topology="1x1x16")
+
+    def test_non_tileable_count_rejected_with_suggestions(self):
+        with pytest.raises(TopologyError) as ei:
+            solve_slice("tpu-v4", 6)
+        assert "nearby valid counts" in str(ei.value)
+
+    def test_v5e_is_2d(self):
+        s = solve_slice("tpu-v5e", 16)
+        assert len(s.dims) == 2
+        assert s.num_hosts == 2 and s.chips_per_host == 8
+
+    def test_v5e_standalone_4(self):
+        s = solve_slice("tpu-v5e", 4)
+        assert s.num_hosts == 1
+
+    def test_unknown_model(self):
+        with pytest.raises(TopologyError):
+            solve_slice("tpu-v99", 4)
+
+    def test_over_max_rejected(self):
+        with pytest.raises(TopologyError):
+            solve_slice("tpu-v5e", 512)
+
+    def test_worker_chip_indices(self):
+        s = solve_slice("tpu-v4", 8)
+        assert s.worker_chip_indices(0) == [0, 1, 2, 3]
+        assert s.worker_chip_indices(1) == [4, 5, 6, 7]
+
+    def test_is_tpu_model(self):
+        assert is_tpu_model("tpu-v4")
+        assert not is_tpu_model("gpu-a100")
+
+    def test_topology_string(self):
+        assert solve_slice("tpu-v4", 16, topology="2x2x4").topology == "2x2x4"

@@ -1,0 +1,35 @@
+"""Node/device plumbing: the TPU analog of internal/utils (gpus.go, nodes.go).
+
+The reference actuates node device stacks by pod-exec'ing nvidia-smi /
+modprobe / sysfs writes into privileged pods (gpus.go:1040-1067). The TPU
+equivalent is a **node agent**: it owns ``/dev/accel*`` and ``/dev/vfio/*``
+visibility, generates CDI (Container Device Interface) specs with libtpu
+mounts, scans ``/proc`` for open device fds before drain, and quarantines
+devices during detach.
+
+Three implementations share the NodeAgent interface (the injectable seam the
+reference lacked — it monkey-patched SPDY executors in tests, SURVEY.md §4
+takeaway):
+- LocalNodeAgent: real host operations (TPU VM), with a C++ fast path
+  (native/tpunode.cc via ctypes) and a pure-Python fallback;
+- FakeNodeAgent: in-memory world for tests/benches.
+"""
+
+from tpu_composer.agent.nodeagent import (
+    AgentError,
+    DeviceBusyError,
+    DriverType,
+    NodeAgent,
+)
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.agent.cdi import CdiSpec, generate_cdi_spec
+
+__all__ = [
+    "AgentError",
+    "DeviceBusyError",
+    "DriverType",
+    "NodeAgent",
+    "FakeNodeAgent",
+    "CdiSpec",
+    "generate_cdi_spec",
+]

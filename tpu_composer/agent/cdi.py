@@ -1,0 +1,121 @@
+"""CDI (Container Device Interface) spec generation for TPU chip groups.
+
+Replaces the reference's NVIDIA device-stack refresh path: where the reference
+restarts nvidia-device-plugin daemonsets or pokes DRA kubelet plugins so
+containers see `/dev/nvidiaX` (composableresource_controller.go:252-286), a
+composed TPU chip group is published to container runtimes as a CDI spec —
+one JSON document per chip group exposing:
+
+- the accel device nodes (``/dev/accel<N>``) or vfio nodes for the chips,
+- the libtpu mount (``libtpu.so`` is how JAX/XLA drive the chip),
+- the ``TPU_*`` coordinate env so a JAX process sees a native slice
+  (BASELINE.json north star: "no GPU driver in the loop").
+
+Spec layout follows the CDI 0.6 schema (cdi.k8s.io), so real container
+runtimes (containerd/CRI-O with CDI enabled) can consume it unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+CDI_VERSION = "0.6.0"
+CDI_VENDOR = "tpu.composer.dev"
+CDI_CLASS = "tpu"
+DEFAULT_CDI_DIR = "/var/run/cdi"
+DEFAULT_LIBTPU_PATH = "/lib/libtpu.so"
+
+
+@dataclass
+class CdiSpec:
+    """One chip-group's CDI document."""
+
+    name: str  # device name within the vendor/class, e.g. "slice-req1-worker0"
+    device_nodes: List[str]
+    env: Dict[str, str] = field(default_factory=dict)
+    libtpu_host_path: str = DEFAULT_LIBTPU_PATH
+
+    @property
+    def qualified_name(self) -> str:
+        return f"{CDI_VENDOR}/{CDI_CLASS}={self.name}"
+
+    def to_dict(self) -> Dict:
+        return {
+            "cdiVersion": CDI_VERSION,
+            "kind": f"{CDI_VENDOR}/{CDI_CLASS}",
+            "devices": [
+                {
+                    "name": self.name,
+                    "containerEdits": {
+                        "deviceNodes": [{"path": p} for p in self.device_nodes],
+                        "mounts": [
+                            {
+                                "hostPath": self.libtpu_host_path,
+                                "containerPath": DEFAULT_LIBTPU_PATH,
+                                "options": ["ro", "nosuid", "nodev", "bind"],
+                            }
+                        ],
+                        "env": [f"{k}={v}" for k, v in sorted(self.env.items())],
+                    },
+                }
+            ],
+        }
+
+
+def generate_cdi_spec(
+    slice_name: str,
+    worker_id: int,
+    chip_indices: List[int],
+    env: Optional[Dict[str, str]] = None,
+    use_vfio: bool = False,
+) -> CdiSpec:
+    """Build the spec for one worker's chip group.
+
+    chip_indices are host-local accel indices (0..chips_per_host-1); with
+    ``use_vfio`` the chips are exposed through vfio device nodes instead
+    (IOMMU passthrough hosts).
+    """
+    if use_vfio:
+        nodes = ["/dev/vfio/vfio"] + [f"/dev/vfio/{i}" for i in chip_indices]
+    else:
+        nodes = [f"/dev/accel{i}" for i in chip_indices]
+    name = f"{slice_name}-worker{worker_id}" if slice_name else f"chips-{'-'.join(map(str, chip_indices))}"
+    return CdiSpec(name=name, device_nodes=nodes, env=dict(env or {}))
+
+
+def spec_path(cdi_dir: str, spec: CdiSpec) -> str:
+    return os.path.join(cdi_dir, f"{CDI_VENDOR}-{CDI_CLASS}-{spec.name}.json")
+
+
+def write_cdi_spec(cdi_dir: str, spec: CdiSpec) -> str:
+    """Atomically write the spec document; returns its path."""
+    os.makedirs(cdi_dir, exist_ok=True)
+    path = spec_path(cdi_dir, spec)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(spec.to_dict(), f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def remove_cdi_spec(cdi_dir: str, name: str) -> bool:
+    path = os.path.join(cdi_dir, f"{CDI_VENDOR}-{CDI_CLASS}-{name}.json")
+    try:
+        os.remove(path)
+        return True
+    except FileNotFoundError:
+        return False
+
+
+def list_cdi_specs(cdi_dir: str) -> List[str]:
+    if not os.path.isdir(cdi_dir):
+        return []
+    prefix = f"{CDI_VENDOR}-{CDI_CLASS}-"
+    return sorted(
+        fn[len(prefix):-len(".json")]
+        for fn in os.listdir(cdi_dir)
+        if fn.startswith(prefix) and fn.endswith(".json")
+    )

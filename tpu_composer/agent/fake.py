@@ -1,0 +1,139 @@
+"""FakeNodeAgent — the injectable node world for tests and benches.
+
+Replaces the reference's gomonkey SPDY-executor interception
+(composableresource_controller_test.go:2702-2713) with a real implementation
+of the NodeAgent interface. Optionally wired to an InMemoryPool so chip
+visibility follows fabric attachment the way real hosts behave (a chip
+enumerates as /dev/accelN only after the fabric programs the link), plus
+explicit knobs for every failure mode the reference's canned-output tests
+cover: missing driver, delayed visibility, stuck loads, taint state.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from tpu_composer.agent.cdi import CdiSpec
+from tpu_composer.agent.nodeagent import (
+    AgentError,
+    DeviceBusyError,
+    DriverType,
+    NodeAgent,
+)
+
+
+class FakeNodeAgent(NodeAgent):
+    def __init__(self, pool=None) -> None:
+        self._pool = pool  # InMemoryPool or None
+        self._lock = threading.RLock()
+        self._drivers: Dict[str, str] = {}  # node -> DriverType (default HOST)
+        self._no_driver: Set[str] = set()
+        self._visible: Dict[str, Set[str]] = {}  # node -> device ids (pool-less mode)
+        self._visibility_delay: Dict[str, int] = {}  # node -> polls until visible
+        self._loads: Dict[str, Set[str]] = {}  # node -> busy device ids
+        self._taints: Dict[str, str] = {}  # device id -> reason
+        self._published: Dict[str, Dict[str, CdiSpec]] = {}  # node -> name -> spec
+        self.drain_calls: List[tuple] = []
+
+    # ------------------------------------------------------------------
+    # NodeAgent interface
+    # ------------------------------------------------------------------
+    def ensure_driver(self, node: str) -> str:
+        with self._lock:
+            if node in self._no_driver:
+                raise AgentError(f"no libtpu on {node}")
+            return self._drivers.get(node, DriverType.HOST)
+
+    def check_visible(self, node: str, device_ids: List[str]) -> bool:
+        with self._lock:
+            delay = self._visibility_delay.get(node, 0)
+            if delay > 0:
+                self._visibility_delay[node] = delay - 1
+                return False
+            if self._pool is not None:
+                attached = set(self._pool.attached_to(node))
+            else:
+                attached = self._visible.get(node, set())
+            return bool(device_ids) and set(device_ids) <= attached
+
+    def check_no_loads(self, node: str, device_ids: List[str]) -> bool:
+        with self._lock:
+            busy = self._loads.get(node, set())
+            return not (busy & set(device_ids))
+
+    def drain(self, node: str, device_ids: List[str], force: bool = False) -> None:
+        with self._lock:
+            self.drain_calls.append((node, tuple(device_ids), force))
+            busy = self._loads.get(node, set()) & set(device_ids)
+            if busy and not force:
+                raise DeviceBusyError(f"{node}: open handles on {sorted(busy)}")
+            if force:
+                self._loads.get(node, set()).difference_update(device_ids)
+            self._visible.get(node, set()).difference_update(device_ids)
+
+    def refresh_device_stack(self, node, spec: Optional[CdiSpec] = None, remove_name: str = ""):
+        with self._lock:
+            pubs = self._published.setdefault(node, {})
+            if spec is not None:
+                pubs[spec.name] = spec
+            if remove_name:
+                pubs.pop(remove_name, None)
+
+    def create_device_taint(self, node, device_ids, reason):
+        with self._lock:
+            for d in device_ids:
+                self._taints[d] = reason
+
+    def delete_device_taint(self, node, device_ids):
+        with self._lock:
+            for d in device_ids:
+                self._taints.pop(d, None)
+
+    def has_device_taint(self, node, device_id) -> bool:
+        with self._lock:
+            return device_id in self._taints
+
+    # ------------------------------------------------------------------
+    # test knobs
+    # ------------------------------------------------------------------
+    def set_no_driver(self, node: str, missing: bool = True) -> None:
+        with self._lock:
+            if missing:
+                self._no_driver.add(node)
+            else:
+                self._no_driver.discard(node)
+
+    def set_driver_type(self, node: str, driver: str) -> None:
+        with self._lock:
+            self._drivers[node] = driver
+
+    def set_visible(self, node: str, device_ids: List[str]) -> None:
+        """Pool-less mode: mark chips as enumerating on the host."""
+        with self._lock:
+            self._visible.setdefault(node, set()).update(device_ids)
+
+    def set_visibility_delay(self, node: str, polls: int) -> None:
+        """Chip shows up only after N visibility checks (slow PCIe rescan)."""
+        with self._lock:
+            self._visibility_delay[node] = polls
+
+    def add_load(self, node: str, device_id: str) -> None:
+        with self._lock:
+            self._loads.setdefault(node, set()).add(device_id)
+
+    def clear_loads(self, node: str) -> None:
+        with self._lock:
+            self._loads.pop(node, None)
+
+    def published(self, node: str) -> List[str]:
+        with self._lock:
+            return sorted(self._published.get(node, {}))
+
+    def published_spec(self, node: str, name: str) -> Optional[CdiSpec]:
+        with self._lock:
+            return self._published.get(node, {}).get(name)
+
+    def taints(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._taints)

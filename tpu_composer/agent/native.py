@@ -1,0 +1,84 @@
+"""ctypes binding to the native node-agent core (native/tpunode.cc).
+
+Loads ``libtpunode.so`` from (in order) $TPUNODE_LIB, the repo's
+``native/build`` directory, or the system loader. Returns None when absent so
+callers fall back to the pure-Python implementations with identical
+semantics — the library is an optimization for the syscall-heavy polling
+paths (full /proc fd sweeps each drain check), not a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import List, Optional
+
+_lock = threading.Lock()
+_loaded = False
+_lib: Optional["_NativeLib"] = None
+
+
+class _NativeLib:
+    def __init__(self, cdll: ctypes.CDLL) -> None:
+        self._c = cdll
+        self._c.tpun_version.restype = ctypes.c_char_p
+        self._c.tpun_enum_accel.restype = ctypes.c_int
+        self._c.tpun_enum_accel.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        self._c.tpun_fd_holders.restype = ctypes.c_int
+        self._c.tpun_fd_holders.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ]
+        self._c.tpun_read_file.restype = ctypes.c_int
+        self._c.tpun_read_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+
+    def version(self) -> str:
+        return self._c.tpun_version().decode()
+
+    def enum_accel(self, dev_dir: str) -> List[str]:
+        buf = ctypes.create_string_buffer(64 * 1024)
+        n = self._c.tpun_enum_accel(dev_dir.encode(), buf, len(buf))
+        if n <= 0:
+            return []
+        return buf.value.decode().split("\n")
+
+    def fd_holders(self, dev_path: str, proc_dir: str) -> List[int]:
+        arr = (ctypes.c_int * 1024)()
+        n = self._c.tpun_fd_holders(dev_path.encode(), proc_dir.encode(), arr, 1024)
+        if n <= 0:
+            return []
+        return list(arr[: min(n, 1024)])
+
+    def read_file(self, path: str) -> Optional[str]:
+        buf = ctypes.create_string_buffer(64 * 1024)
+        n = self._c.tpun_read_file(path.encode(), buf, len(buf))
+        if n < 0:
+            return None
+        return buf.value.decode(errors="replace")
+
+
+def _candidate_paths() -> List[str]:
+    paths = []
+    env = os.environ.get("TPUNODE_LIB")
+    if env:
+        paths.append(env)
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    paths.append(os.path.join(here, "native", "build", "libtpunode.so"))
+    paths.append("libtpunode.so")
+    return paths
+
+
+def native_lib() -> Optional[_NativeLib]:
+    """Load (once) and return the native library, or None."""
+    global _loaded, _lib
+    with _lock:
+        if _loaded:
+            return _lib
+        _loaded = True
+        for path in _candidate_paths():
+            try:
+                _lib = _NativeLib(ctypes.CDLL(path))
+                return _lib
+            except OSError:
+                continue
+        return None

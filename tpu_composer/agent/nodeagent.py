@@ -1,0 +1,217 @@
+"""NodeAgent interface + LocalNodeAgent (real-host implementation).
+
+The per-operation mapping from the reference's GPU plumbing (internal/utils):
+
+| reference (gpus.go)                         | TPU node agent                       |
+|---------------------------------------------|--------------------------------------|
+| EnsureGPUDriverExists (:86, modinfo/chroot) | ensure_driver: libtpu present?       |
+| CheckGPUVisible (:207, nvidia-smi/RS scan)  | check_visible: accel nodes enumerate |
+| CheckNoGPULoads (:241, query-compute-apps)  | check_no_loads: /proc open-fd scan   |
+| DrainGPU (:352, persistence off→fd check→   | drain: taint → fd check → unbind     |
+|   rm node→nvidia-smi drain/sysfs remove)    |   accel node → verify gone           |
+| CreateDeviceTaint/Delete/Has (:894-977)     | taint/untaint/has_taint              |
+| RestartDaemonset / TerminateKubeletPlugin   | refresh_device_stack: (re)write CDI  |
+|   (nodes.go:35, gpus.go:1127)               |   specs + signal runtime            |
+
+The reference reaches nodes via SPDY pod-exec into privileged pods
+(gpus.go:1040-1067); our LocalNodeAgent runs *on* the node (deployed as the
+node-agent daemonset) and the controller talks to it through this interface —
+in-process for single-box runs, RPC in a cluster. The interface is the
+dependency-injection seam the tests use (SURVEY.md §4 takeaway: prefer DI
+over gomonkey).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from tpu_composer.agent import cdi as cdimod
+from tpu_composer.agent.native import native_lib
+
+
+class AgentError(Exception):
+    pass
+
+
+class DeviceBusyError(AgentError):
+    """A process still holds the device open — drain must not proceed
+    (the reference's open-fd guard, gpus.go:416-439)."""
+
+
+class DriverType:
+    NONE = "none"
+    HOST = "host"  # libtpu on the host image
+    CONTAINER = "container"  # libtpu supplied by a driver container
+
+
+class NodeAgent:
+    """All methods take the node name; implementations may ignore it (a local
+    agent serves exactly one node) or route RPC (a cluster agent client)."""
+
+    def ensure_driver(self, node: str) -> str:
+        """Verify the TPU runtime stack exists; returns a DriverType.
+        Raises AgentError when no usable driver is found
+        (EnsureGPUDriverExists, gpus.go:86-95)."""
+        raise NotImplementedError
+
+    def check_visible(self, node: str, device_ids: List[str]) -> bool:
+        """All chips of the group enumerate on the host
+        (CheckGPUVisible, gpus.go:207-239)."""
+        raise NotImplementedError
+
+    def check_no_loads(self, node: str, device_ids: List[str]) -> bool:
+        """No process holds the chips open
+        (CheckNoGPULoads, gpus.go:241-350)."""
+        raise NotImplementedError
+
+    def drain(self, node: str, device_ids: List[str], force: bool = False) -> None:
+        """Quiesce and remove the chips from the host device stack. Raises
+        DeviceBusyError if loads remain and not force
+        (DrainGPU, gpus.go:352-865)."""
+        raise NotImplementedError
+
+    def refresh_device_stack(
+        self,
+        node: str,
+        spec: Optional[cdimod.CdiSpec] = None,
+        remove_name: str = "",
+    ) -> None:
+        """Publish (or retract) the chip group to container workloads — CDI
+        spec write/remove (replaces daemonset restarts,
+        composableresource_controller.go:252-286)."""
+        raise NotImplementedError
+
+    # -- scheduling quarantine (DeviceTaintRule analog, gpus.go:894-977) ---
+    def create_device_taint(self, node: str, device_ids: List[str], reason: str) -> None:
+        raise NotImplementedError
+
+    def delete_device_taint(self, node: str, device_ids: List[str]) -> None:
+        raise NotImplementedError
+
+    def has_device_taint(self, node: str, device_id: str) -> bool:
+        raise NotImplementedError
+
+
+class LocalNodeAgent(NodeAgent):
+    """Operates on the local host's real device stack.
+
+    Uses the native library (native/tpunode.cc) for device enumeration and
+    /proc fd scanning when built, with pure-Python fallbacks. Paths are
+    parameterized for tests and non-standard images.
+    """
+
+    def __init__(
+        self,
+        dev_dir: str = "/dev",
+        proc_dir: str = "/proc",
+        cdi_dir: str = cdimod.DEFAULT_CDI_DIR,
+        libtpu_paths: Optional[List[str]] = None,
+        state_dir: str = "/var/run/tpu-composer",
+    ) -> None:
+        self.dev_dir = dev_dir
+        self.proc_dir = proc_dir
+        self.cdi_dir = cdi_dir
+        self.libtpu_paths = libtpu_paths or [
+            "/lib/libtpu.so",
+            "/usr/lib/libtpu.so",
+            "/usr/local/lib/libtpu.so",
+            "/home/kubernetes/bin/libtpu.so",
+        ]
+        self.state_dir = state_dir
+        self._native = native_lib()
+
+    # ------------------------------------------------------------------
+    def ensure_driver(self, node: str) -> str:
+        for p in self.libtpu_paths:
+            if os.path.exists(p):
+                return DriverType.HOST
+        # A driver container mounts libtpu under /run (the analog of the
+        # reference's containerized driver root /run/nvidia/driver, gpus.go:47)
+        if os.path.exists("/run/libtpu/libtpu.so"):
+            return DriverType.CONTAINER
+        raise AgentError(f"no libtpu found on {node}; looked in {self.libtpu_paths}")
+
+    def _accel_nodes(self) -> List[str]:
+        if self._native is not None:
+            return self._native.enum_accel(self.dev_dir)
+        try:
+            return sorted(
+                os.path.join(self.dev_dir, fn)
+                for fn in os.listdir(self.dev_dir)
+                if fn.startswith("accel")
+            )
+        except FileNotFoundError:
+            return []
+
+    def check_visible(self, node: str, device_ids: List[str]) -> bool:
+        return len(self._accel_nodes()) >= len(device_ids)
+
+    def _holders(self, dev_path: str) -> List[int]:
+        if self._native is not None:
+            return self._native.fd_holders(dev_path, self.proc_dir)
+        pids: List[int] = []
+        try:
+            entries = os.listdir(self.proc_dir)
+        except FileNotFoundError:
+            return pids
+        for entry in entries:
+            if not entry.isdigit():
+                continue
+            fd_dir = os.path.join(self.proc_dir, entry, "fd")
+            try:
+                for fd in os.listdir(fd_dir):
+                    try:
+                        if os.readlink(os.path.join(fd_dir, fd)) == dev_path:
+                            pids.append(int(entry))
+                            break
+                    except OSError:
+                        continue
+            except OSError:
+                continue
+        return pids
+
+    def check_no_loads(self, node: str, device_ids: List[str]) -> bool:
+        for path in self._accel_nodes()[: len(device_ids) or None]:
+            if self._holders(path):
+                return False
+        return True
+
+    def drain(self, node: str, device_ids: List[str], force: bool = False) -> None:
+        nodes = self._accel_nodes()
+        if not force:
+            busy = {p: self._holders(p) for p in nodes}
+            busy = {p: h for p, h in busy.items() if h}
+            if busy:
+                raise DeviceBusyError(f"open fds on {sorted(busy)}: {busy}")
+        # On a real fabric the unbind happens through the fabric manager; the
+        # host-side publication retraction is targeted per group via
+        # refresh_device_stack(remove_name=...) — drain must NOT touch CDI
+        # specs, or it would destroy co-located groups' publications.
+
+    def refresh_device_stack(self, node, spec=None, remove_name=""):
+        if spec is not None:
+            cdimod.write_cdi_spec(self.cdi_dir, spec)
+        if remove_name:
+            cdimod.remove_cdi_spec(self.cdi_dir, remove_name)
+
+    # -- taints are marker files under state_dir ------------------------
+    def _taint_path(self, device_id: str) -> str:
+        safe = device_id.replace("/", "_")
+        return os.path.join(self.state_dir, "taints", safe)
+
+    def create_device_taint(self, node, device_ids, reason):
+        os.makedirs(os.path.join(self.state_dir, "taints"), exist_ok=True)
+        for d in device_ids:
+            with open(self._taint_path(d), "w") as f:
+                f.write(reason)
+
+    def delete_device_taint(self, node, device_ids):
+        for d in device_ids:
+            try:
+                os.remove(self._taint_path(d))
+            except FileNotFoundError:
+                pass
+
+    def has_device_taint(self, node, device_id) -> bool:
+        return os.path.exists(self._taint_path(device_id))

@@ -288,11 +288,16 @@ class ComposabilityRequestStatus:
     # (composabilityrequest_types.go:71, used at composabilityrequest_controller.go:495,:570-579)
     scalar_resource: Optional[ResourceDetails] = None
     slice: SliceStatus = field(default_factory=SliceStatus)
+    # Set once on the first transition to Running; guards the attach-to-ready
+    # histogram against re-observation on recovery transitions.
+    first_ready_time: str = ""
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"state": self.state}
         if self.error:
             d["error"] = self.error
+        if self.first_ready_time:
+            d["first_ready_time"] = self.first_ready_time
         if self.resources:
             d["resources"] = {k: v.to_dict() for k, v in self.resources.items()}
         if self.scalar_resource is not None:
@@ -313,6 +318,7 @@ class ComposabilityRequestStatus:
             },
             scalar_resource=ResourceDetails.from_dict(sr) if sr is not None else None,
             slice=SliceStatus.from_dict(d.get("slice", {})),
+            first_ready_time=d.get("first_ready_time", ""),
         )
 
 

@@ -1,0 +1,24 @@
+"""Orchestration controllers.
+
+Reference analog: internal/controller — ComposabilityRequest reconciler
+(request → slice → per-host children), ComposableResource reconciler
+(per chip-group lifecycle), UpstreamSyncer (fabric↔local anti-drift).
+"""
+
+from tpu_composer.controllers.resource_controller import (
+    ComposableResourceReconciler,
+    ResourceTiming,
+)
+from tpu_composer.controllers.request_controller import (
+    ComposabilityRequestReconciler,
+    RequestTiming,
+)
+from tpu_composer.controllers.syncer import UpstreamSyncer
+
+__all__ = [
+    "ComposableResourceReconciler",
+    "ResourceTiming",
+    "ComposabilityRequestReconciler",
+    "RequestTiming",
+    "UpstreamSyncer",
+]

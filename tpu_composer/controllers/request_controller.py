@@ -1,0 +1,655 @@
+"""ComposabilityRequest reconciler — request-level state machine + allocator.
+
+Reference analog: internal/controller/composabilityrequest_controller.go
+(6-state machine at :108-142). State strings preserved:
+
+  ""             -> finalizer; NodeAllocating                  (:197-211)
+  NodeAllocating -> keep/discard children, deletion priorities,
+                    node selection, placeholders; Updating     (:213-485)
+  Updating       -> create/delete children; all Online->Running(:487-560)
+  Running        -> spec-drift + child-health watch            (:562-586)
+  Cleaning       -> delete children until none; Deleting       (:588-612)
+  Deleting       -> remove finalizer                           (:614-625)
+
+TPU-first deltas (SURVEY.md §5/§7):
+- ``type: tpu`` requests are solved into a *connected slice shape*
+  (topology.solve_slice) and placed all-or-nothing: one ComposableResource
+  per host carrying (slice_name, worker_id, chip_count, topology), with the
+  fabric reservation made atomically up front (reserve_slice) and rolled back
+  on allocation failure — the reference's one-device-at-a-time fan-out
+  (:361-467) cannot express this and deadlocks a slice at 31/32 chips
+  (SURVEY.md §7 hard-part #1);
+- losing a slice member (node death) invalidates the ICI topology, so Running
+  re-enters NodeAllocating for a full re-solve instead of patching one child;
+- the authoritative TPU_* coordinates (worker hostnames, topology) land in
+  status.slice for the admission webhook to inject consistently;
+- attach-to-Ready latency is observed into the histogram the reference never
+  had (BASELINE.md).
+
+gpu/cxlmemory requests keep the reference's independent-device semantics
+(BASELINE.json config[0] compatibility).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from tpu_composer.api.meta import now_iso, parse_iso
+from tpu_composer.api.types import (
+    ANNOTATION_DELETE_DEVICE,
+    ANNOTATION_LAST_USED_TIME,
+    ComposabilityRequest,
+    ComposableResource,
+    ComposableResourceSpec,
+    FINALIZER,
+    LABEL_MANAGED_BY,
+    Node,
+    REQUEST_STATE_CLEANING,
+    REQUEST_STATE_DELETING,
+    REQUEST_STATE_EMPTY,
+    REQUEST_STATE_NODE_ALLOCATING,
+    REQUEST_STATE_RUNNING,
+    REQUEST_STATE_UPDATING,
+    RESOURCE_STATE_ONLINE,
+    ResourceStatus,
+    SliceStatus,
+)
+from tpu_composer.fabric.provider import FabricError, FabricProvider
+from tpu_composer.runtime.controller import Controller, Result
+from tpu_composer.runtime.events import WARNING, EventRecorder
+from tpu_composer.runtime.metrics import attach_to_ready_seconds, reconcile_total
+from tpu_composer.runtime.store import Store, WatchEvent
+from tpu_composer.topology.slices import SliceShape, TopologyError, is_tpu_model, solve_slice
+
+
+@dataclass
+class RequestTiming:
+    updating_poll: float = 0.5  # children-not-ready re-check (30s, :558)
+    running_poll: float = 30.0  # drift/health re-check (30s, :585)
+    cleaning_poll: float = 0.3  # children-still-terminating re-check (30s, :611)
+
+
+class AllocationError(FabricError):
+    """No valid placement exists right now — surfaced in status.error."""
+
+
+def generate_resource_name(device_type: str) -> str:
+    """`<type>-<uuid>` (stringutils.go:26-33)."""
+    return f"{device_type}-{uuid.uuid4()}"
+
+
+class ComposabilityRequestReconciler(Controller):
+    primary_kind = "ComposabilityRequest"
+    quiet_exceptions = (FabricError, TopologyError)
+
+    def __init__(
+        self,
+        store: Store,
+        fabric: FabricProvider,
+        timing: Optional[RequestTiming] = None,
+        recorder: Optional[EventRecorder] = None,
+    ) -> None:
+        super().__init__(store)
+        self.fabric = fabric
+        self.timing = timing or RequestTiming()
+        self.recorder = recorder or EventRecorder()
+        # Placement decisions must be serialized: two concurrent allocations
+        # would otherwise both pick the same least-loaded node before either
+        # writes its placeholders (the reference gets this implicitly from
+        # controller-runtime's default MaxConcurrentReconciles=1).
+        self._alloc_lock = threading.Lock()
+        # Child status changes fold into the request (reference Watches with a
+        # status-change predicate, :658-678 + :169-195).
+        self.watch("ComposableResource", mapper=self._map_child_event)
+        # Target-node deletion GCs the request (:147-167).
+        self.watch("Node", mapper=self._map_node_event)
+
+    def _map_child_event(self, ev: WatchEvent) -> List[str]:
+        owner = ev.obj.metadata.labels.get(LABEL_MANAGED_BY, "")
+        return [owner] if owner else []
+
+    def _map_node_event(self, ev: WatchEvent) -> List[str]:
+        if ev.type != "DELETED":
+            return []
+        node = ev.obj.metadata.name
+        out = []
+        for req in self.store.list(ComposabilityRequest):
+            if req.spec.resource.target_node == node or any(
+                rs.node_name == node for rs in req.status.resources.values()
+            ):
+                out.append(req.metadata.name)
+        return out
+
+    # ------------------------------------------------------------------
+    def reconcile(self, name: str) -> Result:
+        req = self.store.try_get(ComposabilityRequest, name)
+        if req is None:
+            return Result()
+        try:
+            result = self._reconcile_inner(req)
+            reconcile_total.inc(controller="request", outcome="ok")
+            return result
+        except (FabricError, TopologyError) as e:
+            reconcile_total.inc(controller="request", outcome="error")
+            self._set_error(name, str(e))
+            raise
+
+    def _reconcile_inner(self, req: ComposabilityRequest) -> Result:
+        self._fold_child_statuses(req)
+
+        # GC: explicit target node deleted -> the request is unsatisfiable as
+        # written; tear it down (:147-167).
+        if (
+            req.spec.resource.target_node
+            and not req.being_deleted
+            and req.status.state in (REQUEST_STATE_UPDATING, REQUEST_STATE_RUNNING)
+            and self.store.try_get(Node, req.spec.resource.target_node) is None
+        ):
+            self.recorder.event(req, WARNING, "TargetNodeGone",
+                                f"target node {req.spec.resource.target_node} deleted")
+            self.store.delete(ComposabilityRequest, req.name)
+            req = self.store.get(ComposabilityRequest, req.name)
+
+        if req.being_deleted and req.status.state not in (
+            REQUEST_STATE_CLEANING, REQUEST_STATE_DELETING,
+        ):
+            req.status.state = REQUEST_STATE_CLEANING
+            self.store.update_status(req)
+            return Result(requeue_after=self.timing.cleaning_poll)
+
+        state = req.status.state
+        if state == REQUEST_STATE_EMPTY:
+            return self._handle_none(req)
+        if state == REQUEST_STATE_NODE_ALLOCATING:
+            return self._handle_node_allocating(req)
+        if state == REQUEST_STATE_UPDATING:
+            return self._handle_updating(req)
+        if state == REQUEST_STATE_RUNNING:
+            return self._handle_running(req)
+        if state == REQUEST_STATE_CLEANING:
+            return self._handle_cleaning(req)
+        if state == REQUEST_STATE_DELETING:
+            return self._handle_deleting(req)
+        self.log.warning("%s: unknown state %r", req.name, state)
+        return Result()
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def _children(self, req: ComposabilityRequest) -> List[ComposableResource]:
+        return self.store.list(
+            ComposableResource, label_selector={LABEL_MANAGED_BY: req.name}
+        )
+
+    def _fold_child_statuses(self, req: ComposabilityRequest) -> None:
+        """Copy child state/devices into status.resources (:169-195)."""
+        children = {c.name: c for c in self._children(req)}
+        changed = False
+        for name, child in children.items():
+            rs = req.status.resources.get(name)
+            new = ResourceStatus(
+                state=child.status.state,
+                node_name=child.spec.target_node,
+                device_ids=list(child.status.device_ids),
+                cdi_device_id=child.status.cdi_device_id,
+                worker_id=child.spec.worker_id if child.spec.type == "tpu" else -1,
+                error=child.status.error,
+            )
+            if rs is None or rs.to_dict() != new.to_dict():
+                req.status.resources[name] = new
+                changed = True
+        for name in list(req.status.resources):
+            if name not in children and req.status.state not in (
+                REQUEST_STATE_NODE_ALLOCATING, REQUEST_STATE_EMPTY,
+            ):
+                # placeholder rows (no child yet) are legitimate only before
+                # Updating creates them; otherwise the child is gone.
+                if req.status.resources[name].state != "":
+                    del req.status.resources[name]
+                    changed = True
+        if changed:
+            self.store.update_status(req)
+            req.metadata.resource_version = self.store.get(
+                ComposabilityRequest, req.name
+            ).metadata.resource_version
+
+    def _slice_name(self, req: ComposabilityRequest) -> str:
+        return f"{req.name}-slice"
+
+    def _set_error(self, name: str, msg: str) -> None:
+        req = self.store.try_get(ComposabilityRequest, name)
+        if req is None or req.status.error == msg:
+            return
+        req.status.error = msg
+        try:
+            self.store.update_status(req)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # states
+    # ------------------------------------------------------------------
+    def _handle_none(self, req: ComposabilityRequest) -> Result:
+        if req.add_finalizer(FINALIZER):
+            req = self.store.update(req)
+        req.status.state = REQUEST_STATE_NODE_ALLOCATING
+        req.status.error = ""
+        self.store.update_status(req)
+        return Result(requeue_after=0.0)
+
+    def _handle_node_allocating(self, req: ComposabilityRequest) -> Result:
+        with self._alloc_lock:
+            # Re-read inside the lock so this decision sees every placeholder
+            # written by allocations that just finished.
+            req = self.store.get(ComposabilityRequest, req.name)
+            res = req.spec.resource
+            children = self._children(req)
+
+            if res.type == "tpu":
+                return self._allocate_tpu(req, children)
+            return self._allocate_scalar(req, children)
+
+    # -- TPU slice allocation ------------------------------------------
+    def _allocate_tpu(self, req: ComposabilityRequest, children) -> Result:
+        res = req.spec.resource
+        if res.size == 0:
+            return self._shrink_to_zero(req, children)
+        shape = solve_slice(res.model, res.size, res.topology)
+        slice_name = self._slice_name(req)
+
+        # Children that don't fit the solved shape must go first — a slice is
+        # valid only as a whole (keep/discard analog of :254-305, but
+        # all-or-nothing).
+        matching = [
+            c for c in children
+            if not c.being_deleted
+            and c.spec.model == res.model
+            and c.spec.slice_name == slice_name
+            and c.spec.topology == shape.topology
+            and c.spec.chip_count == shape.chips_per_host
+            and c.spec.force_detach == res.force_detach
+            and self.store.try_get(Node, c.spec.target_node) is not None
+        ]
+        stale = [c for c in children if c not in matching]
+        if stale:
+            self._delete_children(req, stale)
+            return Result(requeue_after=self.timing.cleaning_poll)
+
+        if len(matching) == shape.num_hosts:
+            nodes = [c.spec.target_node for c in sorted(matching, key=lambda c: c.spec.worker_id)]
+        else:
+            if matching:
+                # Partial group from a previous shape — dissolve before
+                # re-reserving (atomicity over reuse).
+                self._delete_children(req, matching)
+                return Result(requeue_after=self.timing.cleaning_poll)
+            self.fabric.release_slice(slice_name)
+            nodes = self._pick_nodes(req, shape)
+            try:
+                self.fabric.reserve_slice(slice_name, res.model, shape.topology, nodes)
+            except FabricError:
+                raise
+        # Placeholders + authoritative coordinates (:471-484, plus slice
+        # block for webhook injection).
+        req.status.resources = {
+            c.name: req.status.resources.get(c.name, ResourceStatus(node_name=c.spec.target_node))
+            for c in matching
+        }
+        if not matching:
+            for w, node in enumerate(nodes):
+                placeholder = generate_resource_name(res.type)
+                req.status.resources[placeholder] = ResourceStatus(
+                    node_name=node, worker_id=w
+                )
+        req.status.slice = SliceStatus(
+            name=slice_name,
+            topology=shape.topology,
+            num_hosts=shape.num_hosts,
+            chips_per_host=shape.chips_per_host,
+            worker_hostnames=list(nodes),
+        )
+        req.status.scalar_resource = res
+        req.status.state = REQUEST_STATE_UPDATING
+        req.status.error = ""
+        self.store.update_status(req)
+        return Result(requeue_after=0.0)
+
+    def _pick_nodes(self, req: ComposabilityRequest, shape: SliceShape) -> List[str]:
+        """Choose shape.num_hosts nodes with free TPU ports + capacity.
+
+        Policies (:361-467 analog): explicit target_node (single-host only),
+        samenode (single-host auto-pick), differentnode/topology (spread).
+        """
+        res = req.spec.resource
+        if res.target_node:
+            if shape.num_hosts > 1:
+                raise AllocationError(
+                    f"topology {shape.topology} spans {shape.num_hosts} hosts;"
+                    " target_node only supports single-host slices"
+                )
+            node = self.store.try_get(Node, res.target_node)
+            if node is None:
+                raise AllocationError(f"target node {res.target_node} does not exist")
+            if not self._node_fits(req, node, shape.chips_per_host):
+                raise AllocationError(
+                    f"target node {res.target_node} lacks capacity for"
+                    f" {shape.chips_per_host} chips"
+                )
+            return [res.target_node]
+
+        # For tpu, allocation_policy does not constrain host count — the
+        # topology dictates it (a 2x2x2 slice needs exactly 2 hosts). The
+        # policy is honored as a placement preference: samenode/topology pack
+        # least-loaded-first; differentnode is identical for slices since
+        # workers always land on distinct hosts.
+        candidates = [
+            n for n in self.store.list(Node)
+            if n.status.ready and not n.spec.unschedulable
+            and self._node_fits(req, n, shape.chips_per_host)
+        ]
+        if len(candidates) < shape.num_hosts:
+            raise AllocationError(
+                f"need {shape.num_hosts} hosts with {shape.chips_per_host} free"
+                f" TPU ports, only {len(candidates)} available"
+            )
+        # Least-loaded first so slices pack breadth-first across the fabric.
+        candidates.sort(key=lambda n: (self._used_slots(n.name, req.name), n.name))
+        return [n.metadata.name for n in candidates[: shape.num_hosts]]
+
+    def _used_slots(self, node_name: str, exclude_request: str = "") -> int:
+        """Chips already claimed on a node: instantiated children PLUS other
+        requests' placeholder rows whose child doesn't exist yet — without the
+        placeholder term, concurrent allocations all pick the same
+        least-loaded node before any child materializes (the occupancy check
+        vs other requests, composabilityrequest_controller.go:386-443)."""
+        existing = {
+            c.name: c
+            for c in self.store.list(ComposableResource)
+        }
+        total = sum(
+            c.spec.chip_count if c.spec.type == "tpu" else 1
+            for c in existing.values()
+            if c.spec.target_node == node_name
+            and not c.being_deleted
+            and c.metadata.labels.get(LABEL_MANAGED_BY) != exclude_request
+        )
+        for other in self.store.list(ComposabilityRequest):
+            if other.name == exclude_request or other.being_deleted:
+                continue
+            per_member = (
+                other.status.slice.chips_per_host
+                if other.spec.resource.type == "tpu" and other.status.slice.chips_per_host
+                else 1
+            )
+            for name, rs in other.status.resources.items():
+                if name not in existing and rs.node_name == node_name:
+                    total += per_member
+        return total
+
+    def _node_fits(self, req: ComposabilityRequest, node: Node, chips: int) -> bool:
+        if node.status.tpu_slots - self._used_slots(node.metadata.name, req.name) < chips:
+            return False
+        other = req.spec.resource.other_spec
+        if other is not None:
+            # CheckNodeCapacitySufficient analog (utils/nodes.go:78-117).
+            if (
+                node.status.milli_cpu < other.milli_cpu
+                or node.status.memory < other.memory
+                or node.status.ephemeral_storage < other.ephemeral_storage
+                or node.status.allowed_pod_number < other.allowed_pod_number
+            ):
+                return False
+        return True
+
+    # -- scalar (gpu/cxlmemory) allocation ------------------------------
+    def _allocate_scalar(self, req: ComposabilityRequest, children) -> Result:
+        res = req.spec.resource
+        keep: List[ComposableResource] = []
+        discard: List[ComposableResource] = []
+        for c in children:
+            if (
+                not c.being_deleted
+                and c.spec.model == res.model
+                and c.spec.force_detach == res.force_detach
+                and (not res.target_node or c.spec.target_node == res.target_node)
+                and self.store.try_get(Node, c.spec.target_node) is not None
+            ):
+                keep.append(c)
+            else:
+                discard.append(c)
+
+        if len(keep) > res.size:
+            excess = self._deletion_order(keep)[: len(keep) - res.size]
+            discard.extend(excess)
+            keep = [c for c in keep if c not in excess]
+        if discard:
+            self._delete_children(req, discard)
+            return Result(requeue_after=self.timing.cleaning_poll)
+
+        # Node placement for missing devices (:361-467).
+        assignments = [c.spec.target_node for c in keep]
+        missing = res.size - len(keep)
+        if missing > 0:
+            assignments.extend(self._pick_scalar_nodes(req, missing, assignments))
+
+        req.status.resources = {
+            c.name: req.status.resources.get(c.name, ResourceStatus(node_name=c.spec.target_node))
+            for c in keep
+        }
+        for node in assignments[len(keep):]:
+            req.status.resources[generate_resource_name(res.type)] = ResourceStatus(node_name=node)
+        req.status.scalar_resource = res
+        req.status.slice = SliceStatus()
+        req.status.state = REQUEST_STATE_UPDATING
+        req.status.error = ""
+        self.store.update_status(req)
+        return Result(requeue_after=0.0)
+
+    def _pick_scalar_nodes(self, req, count: int, existing: List[str]) -> List[str]:
+        res = req.spec.resource
+        if res.target_node:
+            node = self.store.try_get(Node, res.target_node)
+            if node is None:
+                raise AllocationError(f"target node {res.target_node} does not exist")
+            # Capacity must cover everything this request puts there.
+            already = sum(1 for e in existing if e == res.target_node)
+            if not self._node_fits(req, node, already + count):
+                raise AllocationError(
+                    f"target node {res.target_node} lacks {already + count} free device ports"
+                )
+            return [res.target_node] * count
+        nodes = [
+            n for n in self.store.list(Node)
+            if n.status.ready and not n.spec.unschedulable and self._node_fits(req, n, 1)
+        ]
+        if not nodes:
+            raise AllocationError("no schedulable node with free device ports")
+        if res.allocation_policy == "samenode":
+            if existing:
+                anchor_name = existing[0]
+            else:
+                anchor_name = min(
+                    nodes, key=lambda n: (self._used_slots(n.name, req.name), n.name)
+                ).metadata.name
+            anchor = self.store.try_get(Node, anchor_name)
+            already = sum(1 for e in existing if e == anchor_name)
+            if anchor is None or not self._node_fits(req, anchor, already + count):
+                raise AllocationError(
+                    f"samenode anchor {anchor_name} lacks {already + count} free device ports"
+                )
+            return [anchor_name] * count
+        # differentnode: spread over distinct nodes not already used (:444-467)
+        used = set(existing)
+        fresh = [n.metadata.name for n in nodes if n.metadata.name not in used]
+        if len(fresh) < count:
+            raise AllocationError(
+                f"differentnode policy needs {count} unused nodes, found {len(fresh)}"
+            )
+        fresh.sort(key=lambda nm: (self._used_slots(nm, req.name), nm))
+        return fresh[:count]
+
+    def _deletion_order(self, children: List[ComposableResource]) -> List[ComposableResource]:
+        """5-bucket deletion priority, oldest-used first within a bucket
+        (:307-359, buckets :329-339, last-used annotation :320-327)."""
+
+        def bucket(c: ComposableResource) -> int:
+            if c.metadata.annotations.get(ANNOTATION_DELETE_DEVICE) == "true":
+                return 0  # explicitly marked for deletion
+            if c.status.error:
+                return 1  # failed
+            if c.status.state != RESOURCE_STATE_ONLINE:
+                return 2  # not yet online — cheapest to cancel
+            if ANNOTATION_LAST_USED_TIME not in c.metadata.annotations:
+                return 3  # online, never used
+            return 4  # online, used — last resort, oldest first
+
+        def last_used(c: ComposableResource) -> float:
+            ts = c.metadata.annotations.get(ANNOTATION_LAST_USED_TIME, "")
+            try:
+                return parse_iso(ts).timestamp()
+            except ValueError:
+                return 0.0
+
+        return sorted(children, key=lambda c: (bucket(c), last_used(c), c.name))
+
+    def _delete_children(self, req, children) -> None:
+        for c in children:
+            try:
+                self.store.delete(ComposableResource, c.name)
+            except Exception:
+                pass
+
+    # -- Updating / Running / Cleaning / Deleting ----------------------
+    def _handle_updating(self, req: ComposabilityRequest) -> Result:
+        res = req.spec.resource
+        # Spec drifted since allocation -> re-solve (:495-499).
+        if req.status.scalar_resource is None or (
+            req.status.scalar_resource.to_dict() != res.to_dict()
+        ):
+            req.status.state = REQUEST_STATE_NODE_ALLOCATING
+            self.store.update_status(req)
+            return Result(requeue_after=0.0)
+
+        children = {c.name: c for c in self._children(req)}
+        # Delete children that lost their placeholder row (:509-521).
+        redundant = [c for name, c in children.items() if name not in req.status.resources]
+        if redundant:
+            self._delete_children(req, redundant)
+            return Result(requeue_after=self.timing.cleaning_poll)
+        # Create missing children (:523-542).
+        created = False
+        for name, rs in req.status.resources.items():
+            if name in children:
+                continue
+            child = ComposableResource()
+            child.metadata.name = name
+            child.metadata.labels[LABEL_MANAGED_BY] = req.name
+            child.spec = ComposableResourceSpec(
+                type=res.type,
+                model=res.model,
+                target_node=rs.node_name,
+                force_detach=res.force_detach,
+            )
+            if res.type == "tpu":
+                child.spec.chip_count = req.status.slice.chips_per_host
+                child.spec.slice_name = req.status.slice.name
+                child.spec.worker_id = rs.worker_id if rs.worker_id >= 0 else 0
+                child.spec.topology = req.status.slice.topology
+            child.set_owner(req)
+            self.store.create(child)
+            created = True
+        if created:
+            return Result(requeue_after=self.timing.updating_poll)
+
+        # All children Online -> Running (:544-559).
+        if children and all(
+            c.status.state == RESOURCE_STATE_ONLINE for c in children.values()
+        ):
+            first_ready = not req.status.first_ready_time
+            req.status.state = REQUEST_STATE_RUNNING
+            req.status.error = ""
+            if first_ready:
+                req.status.first_ready_time = now_iso()
+            self.store.update_status(req)
+            if first_ready and req.metadata.creation_timestamp:
+                try:
+                    dt = (
+                        parse_iso(req.status.first_ready_time)
+                        - parse_iso(req.metadata.creation_timestamp)
+                    ).total_seconds()
+                    attach_to_ready_seconds.observe(dt, type=res.type)
+                except ValueError:
+                    pass
+            self.recorder.event(req, "Normal", "Ready",
+                                f"{res.size} x {res.model} composed")
+            return Result()
+        if not children and res.size == 0:
+            req.status.state = REQUEST_STATE_RUNNING
+            self.store.update_status(req)
+            return Result()
+        return Result(requeue_after=self.timing.updating_poll)
+
+    def _handle_running(self, req: ComposabilityRequest) -> Result:
+        res = req.spec.resource
+        # Spec drift -> full re-allocation (:562-586). For TPU this is the
+        # resize path: NodeAllocating re-solves the shape, dissolving or
+        # extending the slice.
+        if req.status.scalar_resource is None or (
+            req.status.scalar_resource.to_dict() != res.to_dict()
+        ):
+            req.status.state = REQUEST_STATE_NODE_ALLOCATING
+            self.store.update_status(req)
+            return Result(requeue_after=0.0)
+        children = self._children(req)
+        live = [c for c in children if not c.being_deleted]
+        # Authoritative member count — NOT len(status.resources), which the
+        # fold step already shrank when a child vanished.
+        expected = (
+            req.status.slice.num_hosts if res.type == "tpu" and res.size > 0 else res.size
+        )
+        if len(live) < expected or any(
+            c.status.state != RESOURCE_STATE_ONLINE for c in live
+        ):
+            # Lost or degraded member -> full re-solve. (Scalar requests must
+            # also go through NodeAllocating, not Updating: the fold step
+            # already dropped the lost child's status row, so Updating would
+            # find nothing to create and flap Running<->Updating forever.)
+            self.recorder.event(req, WARNING, "Degraded",
+                                f"{len(live)}/{expected} members online")
+            req.status.state = REQUEST_STATE_NODE_ALLOCATING
+            self.store.update_status(req)
+            return Result(requeue_after=0.0)
+        return Result(requeue_after=self.timing.running_poll)
+
+    def _shrink_to_zero(self, req: ComposabilityRequest, children) -> Result:
+        if children:
+            self._delete_children(req, children)
+            return Result(requeue_after=self.timing.cleaning_poll)
+        self.fabric.release_slice(self._slice_name(req))
+        req.status.resources = {}
+        req.status.slice = SliceStatus()
+        req.status.scalar_resource = req.spec.resource
+        req.status.state = REQUEST_STATE_UPDATING
+        self.store.update_status(req)
+        return Result(requeue_after=0.0)
+
+    def _handle_cleaning(self, req: ComposabilityRequest) -> Result:
+        children = self._children(req)
+        if children:
+            self._delete_children(req, children)
+            return Result(requeue_after=self.timing.cleaning_poll)
+        self.fabric.release_slice(self._slice_name(req))
+        req.status.state = REQUEST_STATE_DELETING
+        self.store.update_status(req)
+        return Result(requeue_after=0.0)
+
+    def _handle_deleting(self, req: ComposabilityRequest) -> Result:
+        if not req.being_deleted:
+            self.store.delete(ComposabilityRequest, req.name)
+            req = self.store.get(ComposabilityRequest, req.name)
+        if req.remove_finalizer(FINALIZER):
+            self.store.update(req)
+        return Result()

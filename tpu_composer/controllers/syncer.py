@@ -1,0 +1,119 @@
+"""UpstreamSyncer — fabric↔cluster anti-drift repair loop.
+
+Reference analog: internal/controller/upstreamsyncer_controller.go — a
+manager runnable (not a reconciler) ticking every 60s (:52-77):
+fabric.GetResources() is diffed against local ComposableResources; a fabric
+attachment with no local owner is tracked, and if still unclaimed after a
+grace period (10 min, :38) a synthetic detach-CR is created, labeled with the
+leaked device id (:140-165) — its reconciler adopts the id and runs the
+normal detach path, returning the chip to the pool.
+
+Ours keeps the design but with configurable cadence/grace (the bench runs
+sub-second) and structured events.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from tpu_composer.api.meta import ObjectMeta
+from tpu_composer.api.types import (
+    ComposableResource,
+    ComposableResourceSpec,
+    LABEL_READY_TO_DETACH,
+    Node,
+)
+from tpu_composer.fabric.provider import FabricError, FabricProvider
+from tpu_composer.runtime.events import WARNING, EventRecorder
+from tpu_composer.runtime.store import AlreadyExistsError, Store
+
+import logging
+
+
+class UpstreamSyncer:
+    def __init__(
+        self,
+        store: Store,
+        fabric: FabricProvider,
+        period: float = 60.0,  # :61
+        grace: float = 600.0,  # :38 (10 min)
+        recorder: Optional[EventRecorder] = None,
+    ) -> None:
+        self.store = store
+        self.fabric = fabric
+        self.period = period
+        self.grace = grace
+        self.recorder = recorder or EventRecorder()
+        self.log = logging.getLogger("UpstreamSyncer")
+        # device_id -> first-seen-missing monotonic time (:38, :107-123)
+        self._missing: Dict[str, float] = {}
+
+    # The Manager runnable entry point (mgr.Add(RunnableFunc) analog).
+    def __call__(self, stop_event: threading.Event) -> None:
+        while not stop_event.wait(self.period):
+            try:
+                self.sync_once()
+            except FabricError as e:
+                self.log.warning("sync failed: %s", e)
+
+    def sync_once(self, now: Optional[float] = None) -> int:
+        """One diff pass; returns the number of detach-CRs created."""
+        now = time.monotonic() if now is None else now
+        upstream = self.fabric.get_resources()
+
+        local_ids = {
+            d
+            for r in self.store.list(ComposableResource)
+            for d in r.status.device_ids
+        }
+        upstream_ids = set()
+        created = 0
+
+        for dev in upstream:
+            upstream_ids.add(dev.device_id)
+            if dev.device_id in local_ids:
+                self._missing.pop(dev.device_id, None)  # reappeared (:99-105)
+                continue
+            first = self._missing.setdefault(dev.device_id, now)
+            if now - first < self.grace:
+                continue
+            if self._create_detach_cr(dev):
+                created += 1
+            self._missing.pop(dev.device_id, None)
+
+        # Vanished upstream -> stop tracking (:130-135).
+        for dev_id in list(self._missing):
+            if dev_id not in upstream_ids:
+                del self._missing[dev_id]
+        return created
+
+    def _create_detach_cr(self, dev) -> bool:
+        name = f"detach-{dev.device_id}".lower().replace("/", "-")
+        cr = ComposableResource(
+            metadata=ObjectMeta(
+                name=name,
+                labels={LABEL_READY_TO_DETACH: dev.device_id},
+            ),
+            spec=ComposableResourceSpec(
+                type="tpu" if dev.model.startswith("tpu") else "gpu",
+                model=dev.model,
+                target_node=dev.node or "unknown",
+                force_detach=True,
+            ),
+        )
+        try:
+            self.store.create(cr)
+        except AlreadyExistsError:
+            return False
+        self.recorder.event(
+            cr, WARNING, "OrphanedDevice",
+            f"fabric reports {dev.device_id} on {dev.node} with no local owner;"
+            " created detach resource",
+        )
+        return True
+
+    @property
+    def tracked_missing(self) -> Dict[str, float]:
+        return dict(self._missing)

@@ -1,0 +1,31 @@
+"""Fabric/pool provider abstraction.
+
+Reference analog: internal/cdi — the ``CdiProvider`` interface
+(internal/cdi/client.go:34-39) with its wait sentinels (client.go:41-44) and
+four HTTPS backends. Ours reserves TPU chips from a disaggregated pool and
+programs ICI links into slice topologies instead of attaching PCIe GPUs.
+"""
+
+from tpu_composer.fabric.provider import (
+    AttachResult,
+    DeviceHealth,
+    FabricDevice,
+    FabricError,
+    FabricProvider,
+    WaitingDeviceAttaching,
+    WaitingDeviceDetaching,
+)
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.fabric.adapter import new_fabric_provider
+
+__all__ = [
+    "AttachResult",
+    "DeviceHealth",
+    "FabricDevice",
+    "FabricError",
+    "FabricProvider",
+    "WaitingDeviceAttaching",
+    "WaitingDeviceDetaching",
+    "InMemoryPool",
+    "new_fabric_provider",
+]

@@ -1,0 +1,75 @@
+"""Env-driven fabric provider factory.
+
+Reference analog: NewComposableResourceAdapter
+(internal/controller/composableresource_adapter.go:40-76) — selects among
+SUNFISH | NEC | FTI_CDI (CM/FM) via CDI_PROVIDER_TYPE / FTI_CDI_API_TYPE env
+vars. Same pattern, TPU backends:
+
+    CDI_PROVIDER_TYPE = MOCK        -> InMemoryPool (default)
+                        REST_CM     -> async REST pool client (CM-style)
+                        REST_FM     -> sync REST pool client (FM-style)
+                        LAYOUT      -> layout-apply pool client (NEC-style)
+                        REDFISH     -> redfish-style client (Sunfish-style)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.fabric.provider import FabricProvider
+
+_shared_mock: Optional[InMemoryPool] = None
+
+
+class AdapterError(ValueError):
+    pass
+
+
+def new_fabric_provider(provider_type: Optional[str] = None) -> FabricProvider:
+    """Build the provider named by `provider_type` or $CDI_PROVIDER_TYPE.
+
+    The MOCK pool is process-shared: every controller must see the same
+    inventory, the way all reference controllers share one fabric
+    (composableresource_adapter.go is instantiated per reconcile but the
+    fabric state lives server-side).
+    """
+    kind = (provider_type or os.environ.get("CDI_PROVIDER_TYPE", "MOCK")).upper()
+    if kind == "MOCK":
+        global _shared_mock
+        if _shared_mock is None:
+            _shared_mock = InMemoryPool(
+                async_steps=int(os.environ.get("MOCK_FABRIC_ASYNC_STEPS", "0"))
+            )
+        return _shared_mock
+    if kind in ("REST_CM", "REST_FM", "LAYOUT", "REDFISH"):
+        endpoint = os.environ.get("FABRIC_ENDPOINT", "")
+        if not endpoint:
+            raise AdapterError(f"{kind} requires FABRIC_ENDPOINT")
+        try:
+            if kind in ("REST_CM", "REST_FM"):
+                from tpu_composer.fabric.rest import RestPoolClient
+
+                return RestPoolClient(
+                    endpoint=endpoint,
+                    tenant_id=os.environ.get("FABRIC_TENANT_ID", ""),
+                    cluster_id=os.environ.get("FABRIC_CLUSTER_ID", ""),
+                    synchronous=(kind == "REST_FM"),
+                )
+            if kind == "LAYOUT":
+                from tpu_composer.fabric.layout import LayoutApplyClient
+
+                return LayoutApplyClient(endpoint=endpoint)
+            from tpu_composer.fabric.redfish import RedfishClient
+
+            return RedfishClient(endpoint=endpoint)
+        except ModuleNotFoundError as e:
+            raise AdapterError(f"{kind} backend not available: {e}") from e
+    raise AdapterError(f"unknown CDI_PROVIDER_TYPE {kind!r}")
+
+
+def reset_shared_mock() -> None:
+    """Test hook: drop the shared mock pool."""
+    global _shared_mock
+    _shared_mock = None

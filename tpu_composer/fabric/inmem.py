@@ -1,0 +1,340 @@
+"""In-memory TPU pool manager — the mock fabric backend.
+
+Dual role, mirroring how the reference treats its fake fabric:
+- the default provider for standalone/bench runs (BASELINE.json config[0]
+  "mock fabric backend, CPU-only");
+- the fault-injection surface for tests, replacing the reference's
+  ~50-URL-path httptest persona server
+  (composableresource_controller_test.go:737-998) with explicit injection
+  methods.
+
+Models a disaggregated chip pool: free chips per TPU model, per-host
+attachment ports (Node.status.tpu_slots is enforced by the allocator; the
+pool enforces its own chip inventory), slice reservations that carve
+ICI-adjacent chip groups atomically, and optionally *asynchronous* attach —
+``async_steps > 0`` makes add_resource raise WaitingDeviceAttaching for the
+first N polls, emulating the reference's CM resize flow
+(fti/cm/client.go:140-186: POST resize then ErrWaitingDeviceAttaching until a
+later pass finds ADD_COMPLETE); ``async_steps == 0`` emulates the synchronous
+FM flow (fti/fm/client.go:100-214).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tpu_composer.api.types import ComposableResource
+from tpu_composer.fabric.provider import (
+    AttachResult,
+    DeviceHealth,
+    FabricDevice,
+    FabricError,
+    FabricProvider,
+    HEALTH_OK,
+    WaitingDeviceAttaching,
+    WaitingDeviceDetaching,
+)
+from tpu_composer.topology.slices import is_tpu_model, solve_slice
+
+
+@dataclass
+class _Attachment:
+    resource_name: str
+    node: str
+    model: str
+    device_ids: List[str]
+    cdi_device_id: str
+    slice_name: str = ""
+
+
+@dataclass
+class _SliceReservation:
+    model: str
+    topology: str
+    nodes: List[str]
+    # worker_id -> chip ids reserved for that host
+    groups: Dict[int, List[str]] = field(default_factory=dict)
+
+
+class InMemoryPool(FabricProvider):
+    def __init__(
+        self,
+        chips: Optional[Dict[str, int]] = None,
+        async_steps: int = 0,
+    ) -> None:
+        # Default inventory: enough v4 chips for a 32-chip pod slice plus
+        # some loose gpu-compat devices.
+        self._chips = dict(chips or {"tpu-v4": 64, "tpu-v5e": 32, "gpu-a100": 8})
+        self._async_steps = async_steps
+        self._lock = threading.RLock()
+        self._free: Dict[str, List[str]] = {
+            model: [f"{model}-chip-{i:04d}" for i in range(n)]
+            for model, n in self._chips.items()
+        }
+        self._attachments: Dict[str, _Attachment] = {}  # resource_name -> attachment
+        self._slices: Dict[str, _SliceReservation] = {}
+        self._pending_attach: Dict[str, int] = {}  # resource_name -> polls remaining
+        self._pending_detach: Dict[str, int] = {}
+        self._health: Dict[str, DeviceHealth] = {}  # device_id -> health override
+        self._add_failures: Dict[str, int] = {}  # resource_name -> remaining failures
+        self._remove_failures: Dict[str, int] = {}
+        self._leaked: List[FabricDevice] = []
+
+    # ------------------------------------------------------------------
+    # slice transactions
+    # ------------------------------------------------------------------
+    def reserve_slice(self, slice_name: str, model: str, topology: str, nodes: List[str]) -> None:
+        with self._lock:
+            if slice_name in self._slices:
+                return  # idempotent
+            shape = solve_slice(model, _chips_in(topology), topology)
+            if len(nodes) != shape.num_hosts:
+                raise FabricError(
+                    f"slice {slice_name}: topology {topology} needs {shape.num_hosts}"
+                    f" hosts, got {len(nodes)}"
+                )
+            free = self._free.get(model, [])
+            if len(free) < shape.num_chips:
+                raise FabricError(
+                    f"slice {slice_name}: pool has {len(free)} free {model} chips,"
+                    f" need {shape.num_chips}"
+                )
+            # Carve ICI-adjacent chips: the pool hands out a contiguous run,
+            # split into per-host groups in worker order.
+            taken = [free.pop(0) for _ in range(shape.num_chips)]
+            groups = {
+                w: taken[w * shape.chips_per_host : (w + 1) * shape.chips_per_host]
+                for w in range(shape.num_hosts)
+            }
+            self._slices[slice_name] = _SliceReservation(
+                model=model, topology=topology, nodes=list(nodes), groups=groups
+            )
+
+    def release_slice(self, slice_name: str) -> None:
+        with self._lock:
+            resv = self._slices.pop(slice_name, None)
+            if resv is None:
+                return
+            attached_ids = {
+                d for a in self._attachments.values() if a.slice_name == slice_name
+                for d in a.device_ids
+            }
+            for chips in resv.groups.values():
+                for c in chips:
+                    if c not in attached_ids:
+                        self._free[resv.model].append(c)
+
+    # ------------------------------------------------------------------
+    # provider interface
+    # ------------------------------------------------------------------
+    def add_resource(self, resource: ComposableResource) -> AttachResult:
+        name = resource.metadata.name
+        spec = resource.spec
+        with self._lock:
+            existing = self._attachments.get(name)
+            if existing is not None:
+                # Idempotent completion re-read (CM ADD_COMPLETE re-scan).
+                return AttachResult(list(existing.device_ids), existing.cdi_device_id)
+
+            if self._add_failures.get(name, 0) > 0:
+                self._add_failures[name] -= 1
+                raise FabricError(f"injected attach failure for {name}")
+
+            pending = self._pending_attach.get(name)
+            if pending is None and self._async_steps > 0:
+                self._pending_attach[name] = self._async_steps
+                raise WaitingDeviceAttaching(f"{name}: attach accepted, in progress")
+            if pending is not None and pending > 0:
+                self._pending_attach[name] = pending - 1
+                if self._pending_attach[name] > 0:
+                    raise WaitingDeviceAttaching(f"{name}: attach in progress")
+
+            if spec.type == "tpu" and spec.slice_name:
+                att = self._attach_slice_member(resource)
+            else:
+                att = self._attach_loose(resource)
+            self._attachments[name] = att
+            self._pending_attach.pop(name, None)
+            return AttachResult(list(att.device_ids), att.cdi_device_id)
+
+    def _attach_slice_member(self, resource: ComposableResource) -> _Attachment:
+        spec = resource.spec
+        resv = self._slices.get(spec.slice_name)
+        if resv is None:
+            raise FabricError(
+                f"{resource.metadata.name}: slice {spec.slice_name} not reserved"
+            )
+        chips = resv.groups.get(spec.worker_id)
+        if chips is None:
+            raise FabricError(
+                f"{resource.metadata.name}: slice {spec.slice_name} has no worker"
+                f" {spec.worker_id}"
+            )
+        if len(chips) != spec.chip_count:
+            raise FabricError(
+                f"{resource.metadata.name}: reservation has {len(chips)} chips,"
+                f" spec wants {spec.chip_count}"
+            )
+        return _Attachment(
+            resource_name=resource.metadata.name,
+            node=spec.target_node,
+            model=spec.model,
+            device_ids=list(chips),
+            cdi_device_id=f"tpu.composer.dev/slice={spec.slice_name}/worker={spec.worker_id}",
+            slice_name=spec.slice_name,
+        )
+
+    def _attach_loose(self, resource: ComposableResource) -> _Attachment:
+        """gpu/cxlmemory compat path, and single-chip tpu without a slice."""
+        spec = resource.spec
+        free = self._free.get(spec.model)
+        if free is None:
+            raise FabricError(f"unknown device model {spec.model!r}")
+        count = spec.chip_count if spec.type == "tpu" else 1
+        if len(free) < count:
+            raise FabricError(
+                f"pool exhausted for {spec.model}: need {count}, free {len(free)}"
+            )
+        chips = [free.pop(0) for _ in range(count)]
+        return _Attachment(
+            resource_name=resource.metadata.name,
+            node=spec.target_node,
+            model=spec.model,
+            device_ids=chips,
+            cdi_device_id=f"tpu.composer.dev/device={chips[0]}",
+        )
+
+    def remove_resource(self, resource: ComposableResource) -> None:
+        name = resource.metadata.name
+        with self._lock:
+            if self._remove_failures.get(name, 0) > 0:
+                self._remove_failures[name] -= 1
+                raise FabricError(f"injected detach failure for {name}")
+            att = self._attachments.get(name)
+            if att is None:
+                self._drop_leaked(resource)
+                return  # idempotent
+            pending = self._pending_detach.get(name)
+            if pending is None and self._async_steps > 0:
+                self._pending_detach[name] = self._async_steps
+                raise WaitingDeviceDetaching(f"{name}: detach accepted, in progress")
+            if pending is not None and pending > 0:
+                self._pending_detach[name] = pending - 1
+                if self._pending_detach[name] > 0:
+                    raise WaitingDeviceDetaching(f"{name}: detach in progress")
+            del self._attachments[name]
+            self._pending_detach.pop(name, None)
+            if att.slice_name and att.slice_name in self._slices:
+                # Chips return to the reservation (released with the slice).
+                pass
+            else:
+                self._free.setdefault(att.model, []).extend(att.device_ids)
+            for d in att.device_ids:
+                self._health.pop(d, None)
+
+    def _drop_leaked(self, resource: ComposableResource) -> None:
+        """A detach-CR created by the syncer targets an orphaned attachment by
+        device id (the ready-to-detach flow, upstreamsyncer_controller.go:140-165).
+        Orphans come in two forms: test-injected leaks (_leaked) and real
+        attachments whose owning CR was purged (e.g. node-gone GC) — both must
+        release by device id, since the detach-CR's name never matches the
+        original attachment key."""
+        ids = set(resource.status.device_ids)
+        if not ids:
+            return
+        kept = []
+        for dev in self._leaked:
+            if dev.device_id in ids:
+                self._free.setdefault(dev.model, []).append(dev.device_id)
+            else:
+                kept.append(dev)
+        self._leaked = kept
+        for name, att in list(self._attachments.items()):
+            hit = ids & set(att.device_ids)
+            if not hit:
+                continue
+            att.device_ids = [d for d in att.device_ids if d not in hit]
+            if not (att.slice_name and att.slice_name in self._slices):
+                # (chips of a still-reserved slice return via release_slice)
+                self._free.setdefault(att.model, []).extend(sorted(hit))
+            for d in hit:
+                self._health.pop(d, None)
+            if not att.device_ids:
+                del self._attachments[name]
+
+    def check_resource(self, resource: ComposableResource) -> DeviceHealth:
+        with self._lock:
+            att = self._attachments.get(resource.metadata.name)
+            if att is None:
+                return DeviceHealth("Critical", "not attached")
+            worst = DeviceHealth(HEALTH_OK)
+            rank = {"OK": 0, "Warning": 1, "Critical": 2}
+            for d in att.device_ids:
+                h = self._health.get(d)
+                if h is not None and rank[h.state] > rank[worst.state]:
+                    worst = h
+            return worst
+
+    def get_resources(self) -> List[FabricDevice]:
+        with self._lock:
+            out = [
+                FabricDevice(
+                    device_id=d,
+                    node=a.node,
+                    model=a.model,
+                    slice_name=a.slice_name,
+                    health=self._health.get(d, DeviceHealth()),
+                )
+                for a in self._attachments.values()
+                for d in a.device_ids
+            ]
+            out.extend(FabricDevice(
+                device_id=l.device_id, node=l.node, model=l.model,
+                slice_name=l.slice_name, health=l.health,
+            ) for l in self._leaked)
+            return out
+
+    # ------------------------------------------------------------------
+    # test/bench instrumentation (replaces URL-persona fault injection)
+    # ------------------------------------------------------------------
+    def inject_add_failure(self, resource_name: str, times: int = 1) -> None:
+        with self._lock:
+            self._add_failures[resource_name] = times
+
+    def inject_remove_failure(self, resource_name: str, times: int = 1) -> None:
+        with self._lock:
+            self._remove_failures[resource_name] = times
+
+    def set_health(self, device_id: str, health: DeviceHealth) -> None:
+        with self._lock:
+            self._health[device_id] = health
+
+    def leak_attachment(self, node: str, model: str) -> str:
+        """Create a fabric-side attachment with no local CR (drift source)."""
+        with self._lock:
+            free = self._free[model]
+            if not free:
+                raise FabricError(f"no free {model} chips to leak")
+            dev = free.pop(0)
+            self._leaked.append(FabricDevice(device_id=dev, node=node, model=model))
+            return dev
+
+    def free_chips(self, model: str) -> int:
+        with self._lock:
+            return len(self._free.get(model, []))
+
+    def attached_to(self, node: str) -> List[str]:
+        with self._lock:
+            return sorted(
+                d for a in self._attachments.values() if a.node == node
+                for d in a.device_ids
+            )
+
+
+def _chips_in(topology: str) -> int:
+    n = 1
+    for p in topology.lower().split("x"):
+        n *= int(p)
+    return n

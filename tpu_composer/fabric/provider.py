@@ -1,0 +1,118 @@
+"""FabricProvider interface — the seam between controllers and pool managers.
+
+Reference analog: CdiProvider (internal/cdi/client.go:34-39):
+
+    AddResource / RemoveResource / CheckResource / GetResources
+
+with sentinel errors ErrWaitingDeviceAttaching / ErrWaitingDeviceDetaching
+(client.go:41-44) meaning "operation in progress — requeue and call again".
+The same contract is kept because it is what lets the per-resource state
+machine treat slow fabric operations as level-triggered polling
+(composableresource_controller.go:209-300).
+
+TPU-first deltas:
+- ``add_resource`` operates on a *chip group* (ComposableResource.spec
+  carries chip_count/slice_name/worker_id/topology) and must program the ICI
+  links joining the group to its slice, not just attach one device;
+- ``reserve_slice``/``release_slice`` bracket multi-host groups so providers
+  can allocate connected chips atomically with rollback (SURVEY.md §7
+  hard-part #1 — the reference has no transaction concept);
+- health is structured (DeviceHealth) instead of the reference's
+  res_op_status digit convention (fti/cm/client.go:293-309).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_composer.api.types import ComposableResource
+
+
+class FabricError(Exception):
+    """Terminal fabric failure — surfaces into status.error."""
+
+
+class WaitingDeviceAttaching(FabricError):
+    """Attach accepted but still in progress; requeue (client.go:41-42)."""
+
+
+class WaitingDeviceDetaching(FabricError):
+    """Detach accepted but still in progress; requeue (client.go:43-44)."""
+
+
+# Health states — replaces the reference's res_op_status first-digit scheme
+# (0/1/2 = OK/Warning/Critical, fti/cm/client.go:293-309).
+HEALTH_OK = "OK"
+HEALTH_WARNING = "Warning"
+HEALTH_CRITICAL = "Critical"
+
+
+@dataclass
+class DeviceHealth:
+    state: str = HEALTH_OK
+    detail: str = ""
+
+    @property
+    def healthy(self) -> bool:
+        return self.state == HEALTH_OK
+
+
+@dataclass
+class AttachResult:
+    """Outcome of a completed attach."""
+
+    device_ids: List[str]  # chip UUIDs, slice-local worker order
+    cdi_device_id: str  # CDI composite device name for the group
+
+
+@dataclass
+class FabricDevice:
+    """One fabric-side attachment record, as reported by get_resources.
+
+    Reference analog: the per-machine device lists walked by the
+    UpstreamSyncer (upstreamsyncer_controller.go:79-138).
+    """
+
+    device_id: str
+    node: str
+    model: str
+    slice_name: str = ""
+    health: DeviceHealth = field(default_factory=DeviceHealth)
+
+
+class FabricProvider(abc.ABC):
+    """All methods are thread-safe; controllers call them from worker threads."""
+
+    @abc.abstractmethod
+    def add_resource(self, resource: ComposableResource) -> AttachResult:
+        """Attach the chip group to resource.spec.target_node.
+
+        Raises WaitingDeviceAttaching while in progress; idempotent — calling
+        again after completion returns the same AttachResult (the reference's
+        ADD_COMPLETE re-scan, fti/cm/client.go:445-472).
+        """
+
+    @abc.abstractmethod
+    def remove_resource(self, resource: ComposableResource) -> None:
+        """Detach the chip group. Raises WaitingDeviceDetaching while in
+        progress; removing an unknown group is a no-op (idempotent)."""
+
+    @abc.abstractmethod
+    def check_resource(self, resource: ComposableResource) -> DeviceHealth:
+        """Fabric-side health of an attached group (Online-state polling,
+        composableresource_controller.go:317-330)."""
+
+    @abc.abstractmethod
+    def get_resources(self) -> List[FabricDevice]:
+        """Every attachment the fabric currently knows about (drives the
+        anti-drift syncer, upstreamsyncer_controller.go:85-97)."""
+
+    # -- slice transactions (TPU addition; default no-ops for gpu compat) --
+    def reserve_slice(self, slice_name: str, model: str, topology: str, nodes: List[str]) -> None:
+        """Atomically reserve ICI-adjacent chips for a whole slice across
+        `nodes`. Raises FabricError (nothing reserved) on failure."""
+
+    def release_slice(self, slice_name: str) -> None:
+        """Tear down a slice reservation and any remaining attachments."""

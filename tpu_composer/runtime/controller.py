@@ -45,6 +45,11 @@ class Controller:
     #: KIND string of the primary watched type; subclasses set this.
     primary_kind: str = ""
 
+    #: Exception types that are expected operational outcomes (already
+    #: surfaced in status.error by the reconciler) — retried with backoff but
+    #: logged without a traceback.
+    quiet_exceptions: tuple = ()
+
     def __init__(self, store: Store, name: Optional[str] = None) -> None:
         self.store = store
         self.name = name or type(self).__name__
@@ -140,8 +145,11 @@ class Controller:
                 # Stale read — immediate retry with fresh state (controller-
                 # runtime requeues conflicts without logging an error).
                 self.queue.add_rate_limited(key)
-            except Exception:
-                self.log.exception("reconcile %s failed", key)
+            except Exception as e:
+                if isinstance(e, self.quiet_exceptions):
+                    self.log.warning("reconcile %s: %s", key, e)
+                else:
+                    self.log.exception("reconcile %s failed", key)
                 self.queue.add_rate_limited(key)
             else:
                 self.queue.forget(key)

@@ -1,0 +1,25 @@
+"""Slice topology solving — the TPU-native allocation core.
+
+The reference allocates N *independent* devices one at a time
+(composabilityrequest_controller.go:361-467). TPU chips are only useful as a
+*connected* ICI topology, so ``size`` must solve to a valid slice shape placed
+all-or-nothing across hosts (SURVEY.md §5 "slice topology", §7 hard-part #1).
+"""
+
+from tpu_composer.topology.slices import (
+    SliceShape,
+    TopologyError,
+    TpuModel,
+    TPU_MODELS,
+    is_tpu_model,
+    solve_slice,
+)
+
+__all__ = [
+    "SliceShape",
+    "TopologyError",
+    "TpuModel",
+    "TPU_MODELS",
+    "is_tpu_model",
+    "solve_slice",
+]

@@ -1,0 +1,188 @@
+"""Chip-count → ICI slice-shape solver.
+
+Models the generation-specific constraints that make a TPU slice valid:
+
+- each TPU generation has an ICI dimensionality (3D torus for v4/v5p, 2D for
+  v5e/v6e) and a fixed chips-per-host;
+- a multi-host slice's chip count must tile whole hosts, and every torus
+  dimension must be a power of two (wrap-around links come in powers of two on
+  the optical switch fabric);
+- sub-host counts (1 chip, or 2 on 3D generations) are "standalone" shapes
+  with no torus requirement.
+
+The solver prefers the most compact (closest-to-cube) shape because compact
+tori minimize the worst-case hop count and maximize bisection bandwidth —
+which is what the allreduce north-star metric in BASELINE.md rewards.
+
+Reference contrast: the reference has no analog — its node allocator
+(composabilityrequest_controller.go:361-467) treats devices as independent
+scalars. This module is the "single largest semantic change" SURVEY.md §5
+calls out.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class TopologyError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class TpuModel:
+    """Per-generation fabric constraints."""
+
+    name: str
+    ici_dims: int  # 3 = 3D torus (v4/v5p), 2 = 2D (v5e/v6e)
+    chips_per_host: int
+    max_chips: int
+    # Chip counts allowed below one full host (no torus formed).
+    standalone_counts: Tuple[int, ...]
+    # How one host's chips are arranged on the ICI mesh (the single-full-host
+    # slice shape, e.g. v4's 2x2x1 tray).
+    host_dims: Tuple[int, ...] = ()
+
+
+TPU_MODELS: Dict[str, TpuModel] = {
+    m.name: m
+    for m in (
+        TpuModel("tpu-v4", ici_dims=3, chips_per_host=4, max_chips=4096,
+                 standalone_counts=(1, 2), host_dims=(2, 2, 1)),
+        TpuModel("tpu-v5p", ici_dims=3, chips_per_host=4, max_chips=8960,
+                 standalone_counts=(1, 2), host_dims=(2, 2, 1)),
+        TpuModel("tpu-v5e", ici_dims=2, chips_per_host=8, max_chips=256,
+                 standalone_counts=(1, 2, 4), host_dims=(2, 4)),
+        TpuModel("tpu-v6e", ici_dims=2, chips_per_host=8, max_chips=256,
+                 standalone_counts=(1, 2, 4), host_dims=(2, 4)),
+    )
+}
+
+
+def is_tpu_model(model: str) -> bool:
+    return model in TPU_MODELS
+
+
+@dataclass(frozen=True)
+class SliceShape:
+    model: str
+    dims: Tuple[int, ...]  # e.g. (2, 2, 4)
+    num_chips: int
+    num_hosts: int
+    chips_per_host: int
+
+    @property
+    def topology(self) -> str:
+        return "x".join(str(d) for d in self.dims)
+
+    def worker_chip_indices(self, worker_id: int) -> List[int]:
+        """Chip indices (slice-local) owned by one host/worker."""
+        start = worker_id * self.chips_per_host
+        return list(range(start, min(start + self.chips_per_host, self.num_chips)))
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def _parse_dims(topology: str) -> Tuple[int, ...]:
+    try:
+        dims = tuple(int(p) for p in topology.lower().split("x"))
+    except ValueError:
+        raise TopologyError(f"unparseable topology {topology!r}") from None
+    if not dims or any(d < 1 for d in dims):
+        raise TopologyError(f"invalid topology {topology!r}")
+    return dims
+
+
+def _candidate_shapes(model: TpuModel, count: int) -> List[Tuple[int, ...]]:
+    """All valid dim-tuples (sorted ascending) for `count` chips."""
+    if count in model.standalone_counts:
+        # Standalone sub-host shape: a simple line, no torus constraint.
+        return [(count,) if model.ici_dims == 2 else (1, 1, count)]
+    if count % model.chips_per_host != 0:
+        return []
+    if count == model.chips_per_host:
+        # One full host: the slice shape IS the host tray shape.
+        return [tuple(sorted(model.host_dims))]
+    out = []
+    if model.ici_dims == 3:
+        for x in _pow2_divisors(count):
+            for y in _pow2_divisors(count // x):
+                z = count // (x * y)
+                if x <= y <= z and _is_pow2(z) and x >= 2:
+                    out.append((x, y, z))
+    else:
+        for x in _pow2_divisors(count):
+            y = count // x
+            if x <= y and _is_pow2(y) and x >= 2:
+                out.append((x, y))
+    return out
+
+
+def _pow2_divisors(n: int) -> List[int]:
+    return [d for d in (2 ** i for i in range(n.bit_length())) if n % d == 0]
+
+
+def _compactness(dims: Tuple[int, ...]) -> float:
+    # Lower is better: max/min aspect ratio; ties broken by perimeter.
+    return max(dims) / min(dims) + 1e-3 * sum(dims)
+
+
+def solve_slice(model_name: str, count: int, topology: str = "") -> SliceShape:
+    """Solve `count` chips of `model_name` into a valid slice shape.
+
+    An explicit ``topology`` (e.g. "2x2x4") pins the shape after validation;
+    otherwise the most compact valid shape is chosen.
+    """
+    model = TPU_MODELS.get(model_name)
+    if model is None:
+        raise TopologyError(
+            f"unknown TPU model {model_name!r}; known: {sorted(TPU_MODELS)}"
+        )
+    if count < 1:
+        raise TopologyError("chip count must be >= 1")
+    if count > model.max_chips:
+        raise TopologyError(
+            f"{model_name} supports at most {model.max_chips} chips, requested {count}"
+        )
+
+    candidates = _candidate_shapes(model, count)
+    if not candidates:
+        valid = sorted(
+            set(model.standalone_counts)
+            | {c for c in range(model.chips_per_host, min(count * 2, model.max_chips) + 1, model.chips_per_host)
+               if _candidate_shapes(model, c)}
+        )
+        raise TopologyError(
+            f"{count} chips of {model_name} cannot form a slice;"
+            f" nearby valid counts: {valid[:12]}"
+        )
+
+    if topology:
+        dims = _parse_dims(topology)
+        want = 1
+        for d in dims:
+            want *= d
+        if want != count:
+            raise TopologyError(
+                f"topology {topology!r} has {want} chips but size is {count}"
+            )
+        if tuple(sorted(dims)) not in {tuple(sorted(c)) for c in candidates}:
+            raise TopologyError(
+                f"topology {topology!r} is not a valid {model_name} slice shape;"
+                f" valid: {['x'.join(map(str, c)) for c in candidates]}"
+            )
+    else:
+        dims = min(candidates, key=_compactness)
+
+    num_hosts = max(1, count // model.chips_per_host)
+    return SliceShape(
+        model=model_name,
+        dims=tuple(dims),
+        num_chips=count,
+        num_hosts=num_hosts,
+        chips_per_host=min(count, model.chips_per_host),
+    )
