@@ -1,0 +1,162 @@
+"""Benchmark: ComposabilityRequest attach-to-Ready p50 through the live
+operator stack, plus slice qualification on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": "attach_to_ready_p50", "value": <ms>, "unit": "ms",
+   "vs_baseline": <x faster than the reference>, "extra": {...}}
+
+Baseline: the reference operator's attach path is quantized by fixed 30 s
+reconcile requeues (composableresource_controller.go:236,298; BASELINE.md
+"attach-to-Ready p50 ... roughly 30-90 s plus fabric latency"). We take the
+single most favorable quantum — 30 s — as the reference p50; vs_baseline is
+baseline_ms / our_p50_ms. The fabric itself is mocked identically for both
+sides of the comparison (the reference's latency floor comes from its control
+loop, not the fabric).
+
+The `extra` block carries the TPU-side qualification numbers (allreduce busbw
+over the device mesh — 0.0 on a single chip, where no ICI exists — and the
+flagship model's train-step throughput on the real accelerator).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+REFERENCE_P50_MS = 30_000.0  # one reference requeue quantum (BASELINE.md)
+
+
+def bench_attach_to_ready(cycles: int = 40, size: int = 8):
+    """Full request lifecycle through the live threaded operator."""
+    from tpu_composer.api import (
+        ComposabilityRequest,
+        ComposabilityRequestSpec,
+        ComposableResource,
+        Node,
+        ObjectMeta,
+        ResourceDetails,
+    )
+    from tpu_composer.agent.fake import FakeNodeAgent
+    from tpu_composer.controllers import (
+        ComposabilityRequestReconciler,
+        ComposableResourceReconciler,
+        RequestTiming,
+        ResourceTiming,
+    )
+    from tpu_composer.fabric.inmem import InMemoryPool
+    from tpu_composer.runtime.manager import Manager
+    from tpu_composer.runtime.store import Store
+
+    store = Store()
+    for i in range(8):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 4
+        store.create(n)
+    pool = InMemoryPool()
+    agent = FakeNodeAgent(pool=pool)
+    mgr = Manager(store=store)
+    mgr.add_controller(ComposabilityRequestReconciler(
+        store, pool, timing=RequestTiming(updating_poll=0.01, cleaning_poll=0.01)))
+    mgr.add_controller(ComposableResourceReconciler(
+        store, pool, agent,
+        timing=ResourceTiming(attach_poll=0.01, visibility_poll=0.01,
+                              detach_poll=0.01, detach_fast=0.01, busy_poll=0.01)))
+    mgr.start(workers_per_controller=2)
+
+    latencies_ms = []
+    try:
+        for i in range(cycles):
+            name = f"bench-{i}"
+            t0 = time.perf_counter()
+            store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name=name),
+                spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                    type="tpu", model="tpu-v4", size=size)),
+            ))
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if store.get(ComposabilityRequest, name).status.state == "Running":
+                    break
+                time.sleep(0.001)
+            else:
+                raise RuntimeError(f"{name} never reached Running")
+            latencies_ms.append((time.perf_counter() - t0) * 1e3)
+
+            store.delete(ComposabilityRequest, name)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if store.try_get(ComposabilityRequest, name) is None:
+                    break
+                time.sleep(0.001)
+    finally:
+        mgr.stop()
+
+    latencies_ms.sort()
+    return {
+        "p50": statistics.median(latencies_ms),
+        "p90": latencies_ms[int(0.9 * (len(latencies_ms) - 1))],
+        "max": latencies_ms[-1],
+        "cycles": len(latencies_ms),
+    }
+
+
+_ACCEL_PROBE = """
+import json, sys
+import jax
+from tpu_composer.workload.acceptance import qualify_slice
+results = qualify_slice(batch=4, seq=512, allreduce_mb=16.0, steps=5)
+results["backend"] = jax.default_backend()
+print("ACCEL_RESULT " + json.dumps(results))
+"""
+
+
+def bench_accelerator(timeout_s: float = 420.0):
+    """Slice qualification on the local accelerator, run in a subprocess with
+    a hard timeout — a hung device tunnel must not sink the headline metric."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) + os.pathsep + env.get(
+        "PYTHONPATH", ""
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _ACCEL_PROBE],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"accelerator probe timed out after {timeout_s:.0f}s"}
+    for line in proc.stdout.splitlines():
+        if line.startswith("ACCEL_RESULT "):
+            return json.loads(line[len("ACCEL_RESULT "):])
+    tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+    return {"error": f"accelerator probe failed (rc={proc.returncode}): {' | '.join(tail)}"}
+
+
+def main():
+    attach = bench_attach_to_ready()
+    accel = bench_accelerator()
+    out = {
+        "metric": "attach_to_ready_p50",
+        "value": round(attach["p50"], 3),
+        "unit": "ms",
+        "vs_baseline": round(REFERENCE_P50_MS / attach["p50"], 1),
+        "extra": {
+            "attach_p90_ms": round(attach["p90"], 3),
+            "attach_max_ms": round(attach["max"], 3),
+            "cycles": attach["cycles"],
+            "baseline_p50_ms": REFERENCE_P50_MS,
+            "accelerator": {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in accel.items()
+            },
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,13 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# The image's sitecustomize imports jax at interpreter start (registering the
+# real-TPU backend), so the env var alone is read too late — force the
+# platform through the live config as well, before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 from tpu_composer.runtime.store import Store  # noqa: E402

@@ -155,3 +155,46 @@ class TestNativeParity:
         if lib is None:
             pytest.skip("native lib not built")
         assert lib.enum_accel("/definitely/not/a/dir") == []
+
+
+class TestGroupClaims:
+    """Co-located chip groups must not satisfy each other's visibility/load
+    checks (count-based checks livelock detach when two groups share a host)."""
+
+    def test_visibility_is_per_group(self, fake_host):
+        root, dev, proc, lib = fake_host
+        agent = make_agent(fake_host)
+        # Group A claims accel0-1, group B claims accel2-3 (via CDI publish).
+        specA = generate_cdi_spec("sA", 0, [0, 1])
+        specB = generate_cdi_spec("sB", 0, [2, 3])
+        agent.refresh_device_stack("n0", spec=specA)
+        agent.refresh_device_stack("n0", spec=specB)
+        assert agent.check_visible("n0", ["a1", "a2"], group="sA-worker0")
+        assert agent.check_visible("n0", ["b1", "b2"], group="sB-worker0")
+        # A's accel nodes vanish (fabric detached) -> A invisible, B still up.
+        os.remove(os.path.join(dev, "accel0"))
+        os.remove(os.path.join(dev, "accel1"))
+        assert not agent.check_visible("n0", ["a1", "a2"], group="sA-worker0")
+        assert agent.check_visible("n0", ["b1", "b2"], group="sB-worker0")
+
+    def test_post_retract_visibility_excludes_other_groups_nodes(self, fake_host):
+        root, dev, proc, lib = fake_host
+        agent = make_agent(fake_host)
+        agent.refresh_device_stack("n0", spec=generate_cdi_spec("sB", 0, [2, 3]))
+        # A already retracted (no claim); its 2 chips are gone from /dev:
+        os.remove(os.path.join(dev, "accel0"))
+        os.remove(os.path.join(dev, "accel1"))
+        # B's two remaining nodes must NOT make A look visible.
+        assert not agent.check_visible("n0", ["a1", "a2"], group="sA-worker0")
+
+    def test_load_check_scoped_to_own_claim(self, fake_host):
+        root, dev, proc, lib = fake_host
+        agent = make_agent(fake_host)
+        # the fake /proc holds accel0 open (fixture); claim it for group A
+        agent.refresh_device_stack("n0", spec=generate_cdi_spec("sA", 0, [0, 1]))
+        agent.refresh_device_stack("n0", spec=generate_cdi_spec("sB", 0, [2, 3]))
+        assert not agent.check_no_loads("n0", ["a1", "a2"], group="sA-worker0")
+        assert agent.check_no_loads("n0", ["b1", "b2"], group="sB-worker0")
+        with pytest.raises(DeviceBusyError):
+            agent.drain("n0", ["a1", "a2"], group="sA-worker0")
+        agent.drain("n0", ["b1", "b2"], group="sB-worker0")  # B drains fine

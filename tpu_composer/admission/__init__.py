@@ -1,0 +1,15 @@
+"""Admission: validating rules + TPU coordinate injection.
+
+Reference analog: internal/webhook/v1alpha1 (validating-only webhook on
+ComposabilityRequest create/update, composabilityrequest_webhook.go:36-49).
+Ours adds what SURVEY.md §7 (M3) calls for and the reference lacks: a
+*mutating* side that injects ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` /
+topology env so JAX workloads see a native slice, sourced from the
+authoritative ``status.slice`` the allocator wrote (hard-part #4: admission
+output must match allocation output).
+"""
+
+from tpu_composer.admission.validating import register_validating_webhooks
+from tpu_composer.admission.coordinates import slice_env, inject_pod_env
+
+__all__ = ["register_validating_webhooks", "slice_env", "inject_pod_env"]

@@ -1,0 +1,108 @@
+"""TPU coordinate injection — the mutating-admission side.
+
+The reference has no analog: it never provisions distributed-runtime
+coordinates (SURVEY.md §5 "distributed communication backend": injection of
+TPU_WORKER_ID / TPU_WORKER_HOSTNAMES via a mutating webhook is listed as the
+TPU addition). The coordinate contract is what ``jax.distributed`` +
+libtpu read on a multi-host slice:
+
+  TPU_WORKER_ID             this host's index in the slice (worker order)
+  TPU_WORKER_HOSTNAMES      comma-separated host list in worker order
+  TPU_CHIPS_PER_HOST_BOUNDS per-dimension chip grid on one host, "x,y,z"
+                            (libtpu parses bounds, not a count — e.g. v4's
+                            tray is "2,2,1")
+  TPU_HOST_BOUNDS           per-dimension host grid of the slice, "x,y,z"
+  TPU_TOPOLOGY              slice shape, e.g. "2x2x4"
+  TPU_SLICE_NAME            stable slice identity
+  TPU_ACCELERATOR_MODEL     generation (tpu-v4, ...)
+
+``slice_env`` derives all of it from ``ComposabilityRequest.status.slice`` —
+the allocator's authoritative record — so injected coordinates can never
+drift from the real allocation even across re-allocations (SURVEY.md §7
+hard-part #4). ``inject_pod_env`` applies them to a K8s Pod manifest dict for
+the real-cluster mutating webhook deployment.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from tpu_composer.api.types import SliceStatus
+from tpu_composer.topology.slices import TPU_MODELS
+
+#: Pods opt in by carrying this label with the request name as value.
+LABEL_INJECT = "tpu.composer.dev/composability-request"
+#: Pod label naming which worker of the slice this pod is.
+LABEL_WORKER_ID = "tpu.composer.dev/worker-id"
+
+
+def _bounds(slice_status: SliceStatus, model: str):
+    """(chip-grid-per-host, host-grid) as 'x,y,z' strings.
+
+    host bounds = slice dims / host tray dims, elementwise; when the model is
+    unknown or the slice is sub-host, fall back to a linear layout.
+    """
+    try:
+        dims = [int(p) for p in slice_status.topology.lower().split("x") if p]
+    except ValueError:
+        dims = []
+    m = TPU_MODELS.get(model)
+
+    def linear():
+        chip = [max(1, slice_status.chips_per_host), 1, 1]
+        host = [max(1, slice_status.num_hosts), 1, 1]
+        return ",".join(map(str, chip)), ",".join(map(str, host))
+
+    if (
+        m is None
+        or not dims
+        or len(dims) != len(m.host_dims)
+        or slice_status.chips_per_host < m.chips_per_host
+    ):
+        return linear()
+    # Orient the host tray onto the slice dims: pair sorted tray factors with
+    # sorted dims (solver dims are canonicalized ascending; a user-pinned
+    # permutation still divides or we fall back to linear bounds).
+    order = sorted(range(len(dims)), key=lambda i: dims[i])
+    tray_sorted = sorted(m.host_dims)
+    chip = [1] * len(dims)
+    host = [1] * len(dims)
+    for idx, t in zip(order, tray_sorted):
+        d = dims[idx]
+        if d % t != 0:
+            return linear()
+        chip[idx] = t
+        host[idx] = d // t
+    return ",".join(map(str, chip)), ",".join(map(str, host))
+
+
+def slice_env(slice_status: SliceStatus, worker_id: int, model: str = "") -> Dict[str, str]:
+    chip_bounds, host_bounds = _bounds(slice_status, model)
+    env = {
+        "TPU_WORKER_ID": str(worker_id),
+        "TPU_WORKER_HOSTNAMES": ",".join(slice_status.worker_hostnames),
+        "TPU_CHIPS_PER_HOST_BOUNDS": chip_bounds,
+        "TPU_HOST_BOUNDS": host_bounds,
+        "TPU_TOPOLOGY": slice_status.topology,
+        "TPU_SLICE_NAME": slice_status.name,
+    }
+    if model:
+        env["TPU_ACCELERATOR_MODEL"] = model
+    return env
+
+
+def inject_pod_env(pod: Dict, slice_status: SliceStatus, worker_id: int, model: str = "") -> Dict:
+    """Mutate a Pod manifest (dict form): append TPU_* env to every container
+    and pin the pod to its worker's host via nodeSelector. Returns the pod."""
+    env = slice_env(slice_status, worker_id, model)
+    spec = pod.setdefault("spec", {})
+    for container in spec.setdefault("containers", []):
+        existing = {e.get("name") for e in container.setdefault("env", [])}
+        for k, v in sorted(env.items()):
+            if k not in existing:
+                container["env"].append({"name": k, "value": v})
+    if 0 <= worker_id < len(slice_status.worker_hostnames):
+        spec.setdefault("nodeSelector", {})[
+            "kubernetes.io/hostname"
+        ] = slice_status.worker_hostnames[worker_id]
+    return pod

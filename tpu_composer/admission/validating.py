@@ -1,0 +1,74 @@
+"""Validating admission for ComposabilityRequest.
+
+Rules mirror composabilityrequest_webhook.go:91-128:
+1. ``target_node`` cannot be combined with ``allocation_policy:
+   differentnode`` (:91-93);
+2. two ``differentnode`` requests for the same (type, model) conflict — they
+   would race for the same spread (:97-107);
+3. two ``samenode`` requests for the same (type, model) pinned (explicitly or
+   by allocation) to the same node conflict (:108-128).
+
+Runs in-process through the store's admission chain; the same callable backs
+the HTTP webhook endpoint when deployed against a real K8s API server.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpu_composer.api.types import ComposabilityRequest, ValidationError
+from tpu_composer.runtime.store import Store
+
+
+class AdmissionDenied(ValidationError):
+    pass
+
+
+def _effective_target(req: ComposabilityRequest) -> str:
+    """Explicit target_node, else the node the allocator already chose
+    (the webhook's status fallback, :113-122)."""
+    if req.spec.resource.target_node:
+        return req.spec.resource.target_node
+    for rs in req.status.resources.values():
+        if rs.node_name:
+            return rs.node_name
+    return ""
+
+
+def validate_request(store: Store, req: ComposabilityRequest) -> None:
+    res = req.spec.resource
+
+    if res.allocation_policy == "differentnode" and res.target_node:
+        raise AdmissionDenied(
+            "target_node cannot be specified when allocation_policy is 'differentnode'"
+        )
+
+    for other in store.list(ComposabilityRequest):
+        if other.name == req.name:
+            continue
+        o = other.spec.resource
+        if o.type != res.type or o.model != res.model:
+            continue
+        if res.allocation_policy == "differentnode":
+            if o.allocation_policy == "differentnode":
+                raise AdmissionDenied(
+                    f"composabilityRequest {other.name} with type {res.type} and"
+                    f" model {res.model} already exists"
+                )
+        elif res.allocation_policy == "samenode":
+            if _effective_target(other) == res.target_node and res.target_node:
+                raise AdmissionDenied(
+                    f"composabilityRequest {other.name} with type {res.type} and"
+                    f" model {res.model} already targets {res.target_node}"
+                )
+
+
+def register_validating_webhooks(store: Store) -> None:
+    """Hook the rules into create/update, like SetupWebhookWithManager
+    (cmd/main.go:196-201)."""
+
+    def hook(op: str, new, old) -> None:
+        if op in ("CREATE", "UPDATE"):
+            validate_request(store, new)
+
+    store.register_admission("ComposabilityRequest", hook)

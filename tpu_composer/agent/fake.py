@@ -45,7 +45,7 @@ class FakeNodeAgent(NodeAgent):
                 raise AgentError(f"no libtpu on {node}")
             return self._drivers.get(node, DriverType.HOST)
 
-    def check_visible(self, node: str, device_ids: List[str]) -> bool:
+    def check_visible(self, node: str, device_ids: List[str], group: str = "") -> bool:
         with self._lock:
             delay = self._visibility_delay.get(node, 0)
             if delay > 0:
@@ -57,12 +57,12 @@ class FakeNodeAgent(NodeAgent):
                 attached = self._visible.get(node, set())
             return bool(device_ids) and set(device_ids) <= attached
 
-    def check_no_loads(self, node: str, device_ids: List[str]) -> bool:
+    def check_no_loads(self, node: str, device_ids: List[str], group: str = "") -> bool:
         with self._lock:
             busy = self._loads.get(node, set())
             return not (busy & set(device_ids))
 
-    def drain(self, node: str, device_ids: List[str], force: bool = False) -> None:
+    def drain(self, node: str, device_ids: List[str], force: bool = False, group: str = "") -> None:
         with self._lock:
             self.drain_calls.append((node, tuple(device_ids), force))
             busy = self._loads.get(node, set()) & set(device_ids)

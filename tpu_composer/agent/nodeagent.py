@@ -23,6 +23,7 @@ over gomonkey).
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Dict, List, Optional
 
@@ -55,17 +56,20 @@ class NodeAgent:
         (EnsureGPUDriverExists, gpus.go:86-95)."""
         raise NotImplementedError
 
-    def check_visible(self, node: str, device_ids: List[str]) -> bool:
+    def check_visible(self, node: str, device_ids: List[str], group: str = "") -> bool:
         """All chips of the group enumerate on the host
-        (CheckGPUVisible, gpus.go:207-239)."""
+        (CheckGPUVisible, gpus.go:207-239). ``group`` is the CDI publication
+        name, letting implementations distinguish this group's device nodes
+        from co-located groups'."""
         raise NotImplementedError
 
-    def check_no_loads(self, node: str, device_ids: List[str]) -> bool:
+    def check_no_loads(self, node: str, device_ids: List[str], group: str = "") -> bool:
         """No process holds the chips open
         (CheckNoGPULoads, gpus.go:241-350)."""
         raise NotImplementedError
 
-    def drain(self, node: str, device_ids: List[str], force: bool = False) -> None:
+    def drain(self, node: str, device_ids: List[str], force: bool = False,
+              group: str = "") -> None:
         """Quiesce and remove the chips from the host device stack. Raises
         DeviceBusyError if loads remain and not force
         (DrainGPU, gpus.go:352-865)."""
@@ -144,8 +148,63 @@ class LocalNodeAgent(NodeAgent):
         except FileNotFoundError:
             return []
 
-    def check_visible(self, node: str, device_ids: List[str]) -> bool:
-        return len(self._accel_nodes()) >= len(device_ids)
+    # -- device-node claims: which accel paths belong to which group -------
+    # Recorded at CDI publish time so visibility/load checks are per-group
+    # rather than count-based (co-located groups must not satisfy each
+    # other's checks).
+    def _claims_dir(self) -> str:
+        return os.path.join(self.state_dir, "claims")
+
+    def _claim_path(self, group: str) -> str:
+        return os.path.join(self._claims_dir(), group.replace("/", "_") + ".json")
+
+    def _record_claim(self, group: str, device_nodes: List[str]) -> None:
+        # CDI specs carry container-visible paths (/dev/accelN); rebase onto
+        # this agent's dev_dir so checks work under a relocated host root
+        # (tests, chrooted agents). Non-accel nodes (vfio control nodes) are
+        # not per-group and are skipped.
+        paths = [
+            os.path.join(self.dev_dir, os.path.basename(p))
+            for p in device_nodes
+            if os.path.basename(p).startswith("accel")
+        ]
+        os.makedirs(self._claims_dir(), exist_ok=True)
+        with open(self._claim_path(group), "w") as f:
+            json.dump(sorted(paths), f)
+
+    def _drop_claim(self, group: str) -> None:
+        try:
+            os.remove(self._claim_path(group))
+        except FileNotFoundError:
+            pass
+
+    def _claims(self) -> Dict[str, List[str]]:
+        out: Dict[str, List[str]] = {}
+        try:
+            entries = os.listdir(self._claims_dir())
+        except FileNotFoundError:
+            return out
+        for fn in entries:
+            if fn.endswith(".json"):
+                with open(os.path.join(self._claims_dir(), fn)) as f:
+                    out[fn[:-5]] = json.load(f)
+        return out
+
+    def _group_paths(self, group: str, count: int) -> List[str]:
+        """The accel paths to inspect for a group: its claimed nodes when the
+        claim exists, else the host's accel nodes NOT claimed by others."""
+        claims = self._claims()
+        key = group.replace("/", "_") if group else ""
+        if key and key in claims:
+            return claims[key]
+        others = {p for g, paths in claims.items() if g != key for p in paths}
+        return [p for p in self._accel_nodes() if p not in others][: count or None]
+
+    def check_visible(self, node: str, device_ids: List[str], group: str = "") -> bool:
+        paths = self._group_paths(group, len(device_ids))
+        existing = set(self._accel_nodes())
+        present = [p for p in paths if p in existing]
+        return len(present) >= len(device_ids) and bool(device_ids)
 
     def _holders(self, dev_path: str) -> List[int]:
         if self._native is not None:
@@ -171,16 +230,17 @@ class LocalNodeAgent(NodeAgent):
                 continue
         return pids
 
-    def check_no_loads(self, node: str, device_ids: List[str]) -> bool:
-        for path in self._accel_nodes()[: len(device_ids) or None]:
+    def check_no_loads(self, node: str, device_ids: List[str], group: str = "") -> bool:
+        for path in self._group_paths(group, len(device_ids)):
             if self._holders(path):
                 return False
         return True
 
-    def drain(self, node: str, device_ids: List[str], force: bool = False) -> None:
-        nodes = self._accel_nodes()
+    def drain(self, node: str, device_ids: List[str], force: bool = False,
+              group: str = "") -> None:
+        paths = self._group_paths(group, len(device_ids))
         if not force:
-            busy = {p: self._holders(p) for p in nodes}
+            busy = {p: self._holders(p) for p in paths}
             busy = {p: h for p, h in busy.items() if h}
             if busy:
                 raise DeviceBusyError(f"open fds on {sorted(busy)}: {busy}")
@@ -192,8 +252,10 @@ class LocalNodeAgent(NodeAgent):
     def refresh_device_stack(self, node, spec=None, remove_name=""):
         if spec is not None:
             cdimod.write_cdi_spec(self.cdi_dir, spec)
+            self._record_claim(spec.name, spec.device_nodes)
         if remove_name:
             cdimod.remove_cdi_spec(self.cdi_dir, remove_name)
+            self._drop_claim(remove_name)
 
     # -- taints are marker files under state_dir ------------------------
     def _taint_path(self, device_id: str) -> str:

@@ -333,7 +333,7 @@ class ComposabilityRequestReconciler(Controller):
             node = self.store.try_get(Node, res.target_node)
             if node is None:
                 raise AllocationError(f"target node {res.target_node} does not exist")
-            if not self._node_fits(req, node, shape.chips_per_host):
+            if not self._node_fits(req, node, shape.chips_per_host, self._used_slots_map(req.name)):
                 raise AllocationError(
                     f"target node {res.target_node} lacks capacity for"
                     f" {shape.chips_per_host} chips"
@@ -345,10 +345,11 @@ class ComposabilityRequestReconciler(Controller):
         # policy is honored as a placement preference: samenode/topology pack
         # least-loaded-first; differentnode is identical for slices since
         # workers always land on distinct hosts.
+        used = self._used_slots_map(req.name)
         candidates = [
             n for n in self.store.list(Node)
             if n.status.ready and not n.spec.unschedulable
-            and self._node_fits(req, n, shape.chips_per_host)
+            and self._node_fits(req, n, shape.chips_per_host, used)
         ]
         if len(candidates) < shape.num_hosts:
             raise AllocationError(
@@ -356,26 +357,27 @@ class ComposabilityRequestReconciler(Controller):
                 f" TPU ports, only {len(candidates)} available"
             )
         # Least-loaded first so slices pack breadth-first across the fabric.
-        candidates.sort(key=lambda n: (self._used_slots(n.name, req.name), n.name))
+        candidates.sort(key=lambda n: (used.get(n.name, 0), n.name))
         return [n.metadata.name for n in candidates[: shape.num_hosts]]
 
-    def _used_slots(self, node_name: str, exclude_request: str = "") -> int:
-        """Chips already claimed on a node: instantiated children PLUS other
-        requests' placeholder rows whose child doesn't exist yet — without the
-        placeholder term, concurrent allocations all pick the same
-        least-loaded node before any child materializes (the occupancy check
-        vs other requests, composabilityrequest_controller.go:386-443)."""
-        existing = {
-            c.name: c
-            for c in self.store.list(ComposableResource)
-        }
-        total = sum(
-            c.spec.chip_count if c.spec.type == "tpu" else 1
-            for c in existing.values()
-            if c.spec.target_node == node_name
-            and not c.being_deleted
-            and c.metadata.labels.get(LABEL_MANAGED_BY) != exclude_request
-        )
+    def _used_slots_map(self, exclude_request: str = "") -> Dict[str, int]:
+        """node -> chips already claimed there: instantiated children PLUS
+        other requests' placeholder rows whose child doesn't exist yet —
+        without the placeholder term, concurrent allocations all pick the
+        same least-loaded node before any child materializes (the occupancy
+        check vs other requests, composabilityrequest_controller.go:386-443).
+        Built in one pass over the store; allocation holds _alloc_lock, so
+        per-candidate rescans would serialize the whole fleet behind O(N*R)
+        work."""
+        used: Dict[str, int] = {}
+        existing = {c.name: c for c in self.store.list(ComposableResource)}
+        for c in existing.values():
+            if (
+                not c.being_deleted
+                and c.metadata.labels.get(LABEL_MANAGED_BY) != exclude_request
+            ):
+                n = c.spec.chip_count if c.spec.type == "tpu" else 1
+                used[c.spec.target_node] = used.get(c.spec.target_node, 0) + n
         for other in self.store.list(ComposabilityRequest):
             if other.name == exclude_request or other.being_deleted:
                 continue
@@ -385,12 +387,15 @@ class ComposabilityRequestReconciler(Controller):
                 else 1
             )
             for name, rs in other.status.resources.items():
-                if name not in existing and rs.node_name == node_name:
-                    total += per_member
-        return total
+                if name not in existing and rs.node_name:
+                    used[rs.node_name] = used.get(rs.node_name, 0) + per_member
+        return used
 
-    def _node_fits(self, req: ComposabilityRequest, node: Node, chips: int) -> bool:
-        if node.status.tpu_slots - self._used_slots(node.metadata.name, req.name) < chips:
+    def _node_fits(
+        self, req: ComposabilityRequest, node: Node, chips: int,
+        used: Dict[str, int],
+    ) -> bool:
+        if node.status.tpu_slots - used.get(node.metadata.name, 0) < chips:
             return False
         other = req.spec.resource.other_spec
         if other is not None:
@@ -450,20 +455,21 @@ class ComposabilityRequestReconciler(Controller):
 
     def _pick_scalar_nodes(self, req, count: int, existing: List[str]) -> List[str]:
         res = req.spec.resource
+        used = self._used_slots_map(req.name)
         if res.target_node:
             node = self.store.try_get(Node, res.target_node)
             if node is None:
                 raise AllocationError(f"target node {res.target_node} does not exist")
             # Capacity must cover everything this request puts there.
             already = sum(1 for e in existing if e == res.target_node)
-            if not self._node_fits(req, node, already + count):
+            if not self._node_fits(req, node, already + count, used):
                 raise AllocationError(
                     f"target node {res.target_node} lacks {already + count} free device ports"
                 )
             return [res.target_node] * count
         nodes = [
             n for n in self.store.list(Node)
-            if n.status.ready and not n.spec.unschedulable and self._node_fits(req, n, 1)
+            if n.status.ready and not n.spec.unschedulable and self._node_fits(req, n, 1, used)
         ]
         if not nodes:
             raise AllocationError("no schedulable node with free device ports")
@@ -472,23 +478,23 @@ class ComposabilityRequestReconciler(Controller):
                 anchor_name = existing[0]
             else:
                 anchor_name = min(
-                    nodes, key=lambda n: (self._used_slots(n.name, req.name), n.name)
+                    nodes, key=lambda n: (used.get(n.name, 0), n.name)
                 ).metadata.name
             anchor = self.store.try_get(Node, anchor_name)
             already = sum(1 for e in existing if e == anchor_name)
-            if anchor is None or not self._node_fits(req, anchor, already + count):
+            if anchor is None or not self._node_fits(req, anchor, already + count, used):
                 raise AllocationError(
                     f"samenode anchor {anchor_name} lacks {already + count} free device ports"
                 )
             return [anchor_name] * count
         # differentnode: spread over distinct nodes not already used (:444-467)
-        used = set(existing)
-        fresh = [n.metadata.name for n in nodes if n.metadata.name not in used]
+        taken = set(existing)
+        fresh = [n.metadata.name for n in nodes if n.metadata.name not in taken]
         if len(fresh) < count:
             raise AllocationError(
                 f"differentnode policy needs {count} unused nodes, found {len(fresh)}"
             )
-        fresh.sort(key=lambda nm: (self._used_slots(nm, req.name), nm))
+        fresh.sort(key=lambda nm: (used.get(nm, 0), nm))
         return fresh[:count]
 
     def _deletion_order(self, children: List[ComposableResource]) -> List[ComposableResource]:

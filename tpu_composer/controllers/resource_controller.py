@@ -200,17 +200,13 @@ class ComposableResourceReconciler(Controller):
                 slice_name=res.spec.slice_name or res.name,
                 worker_id=res.spec.worker_id,
                 chip_indices=list(range(len(attach.device_ids))),
-                env={
-                    "TPU_WORKER_ID": str(res.spec.worker_id),
-                    "TPU_SLICE_NAME": res.spec.slice_name or res.name,
-                    "TPU_TOPOLOGY": res.spec.topology,
-                    "TPU_CHIPS_PER_HOST_BOUNDS": str(res.spec.chip_count),
-                    "TPU_ACCELERATOR_MODEL": res.spec.model,
-                },
+                env=self._coordinate_env(res),
             )
             self.agent.refresh_device_stack(res.spec.target_node, spec=spec)
 
-        if not self.agent.check_visible(res.spec.target_node, res.status.device_ids):
+        if not self.agent.check_visible(
+            res.spec.target_node, res.status.device_ids, group=self._cdi_name(res)
+        ):
             return Result(requeue_after=self.timing.visibility_poll)
 
         res.status.state = RESOURCE_STATE_ONLINE
@@ -222,6 +218,35 @@ class ComposableResourceReconciler(Controller):
         self.recorder.event(res, "Normal", "Attached",
                             f"{len(res.status.device_ids)} chip(s) online on {res.spec.target_node}")
         return Result()
+
+    def _cdi_name(self, res: ComposableResource) -> str:
+        """The CDI publication name for a tpu group ('' for gpu compat) —
+        the 'group' identity the node agent keys its claims on."""
+        if not is_tpu_model(res.spec.model):
+            return ""
+        return f"{res.spec.slice_name or res.name}-worker{res.spec.worker_id}"
+
+    def _coordinate_env(self, res: ComposableResource):
+        """TPU_* env for this worker's CDI spec, sourced from the owning
+        request's authoritative status.slice when it exists (coordinate
+        consistency, SURVEY.md §7 hard-part #4); standalone CRs fall back to
+        their own spec fields."""
+        from tpu_composer.admission.coordinates import slice_env
+        from tpu_composer.api.types import ComposabilityRequest, LABEL_MANAGED_BY, SliceStatus
+
+        owner = res.metadata.labels.get(LABEL_MANAGED_BY, "")
+        if owner:
+            req = self.store.try_get(ComposabilityRequest, owner)
+            if req is not None and req.status.slice.name:
+                return slice_env(req.status.slice, res.spec.worker_id, res.spec.model)
+        standalone = SliceStatus(
+            name=res.spec.slice_name or res.name,
+            topology=res.spec.topology,
+            num_hosts=1,
+            chips_per_host=res.spec.chip_count,
+            worker_hostnames=[res.spec.target_node],
+        )
+        return slice_env(standalone, res.spec.worker_id, res.spec.model)
 
     def fabric_attached(self, node: str):
         try:
@@ -256,7 +281,7 @@ class ComposableResourceReconciler(Controller):
         node_exists = self.store.try_get(Node, node) is not None
         # 1. Load check unless force (:340-353).
         if not res.spec.force_detach and node_exists:
-            if not self.agent.check_no_loads(node, res.status.device_ids):
+            if not self.agent.check_no_loads(node, res.status.device_ids, group=self._cdi_name(res)):
                 msg = f"chips in use on {node}; waiting for workloads to finish"
                 if res.status.error != msg:
                     res.status.error = msg
@@ -270,7 +295,8 @@ class ComposableResourceReconciler(Controller):
 
             # 3. Drain the host device stack (:365-379).
             try:
-                self.agent.drain(node, res.status.device_ids, force=res.spec.force_detach)
+                self.agent.drain(node, res.status.device_ids,
+                                 force=res.spec.force_detach, group=self._cdi_name(res))
             except DeviceBusyError:
                 return Result(requeue_after=self.timing.busy_poll)
 
@@ -287,15 +313,14 @@ class ComposableResourceReconciler(Controller):
             # slice_name-or-resource-name + worker id, matching what
             # _handle_attaching published.
             if is_tpu_model(res.spec.model):
-                self.agent.refresh_device_stack(
-                    node,
-                    remove_name=f"{res.spec.slice_name or res.name}-worker{res.spec.worker_id}",
-                )
+                self.agent.refresh_device_stack(node, remove_name=self._cdi_name(res))
 
             # 6. Chips must stop enumerating before we declare success
             # (:393-401, 3s fast requeue in the reference; ours is
             # timing.detach_fast).
-            if res.status.device_ids and self.agent.check_visible(node, res.status.device_ids):
+            if res.status.device_ids and self.agent.check_visible(
+                node, res.status.device_ids, group=self._cdi_name(res)
+            ):
                 return Result(requeue_after=self.timing.detach_fast)
 
             # 7. Cleanup (:404-415).

@@ -334,7 +334,9 @@ class InMemoryPool(FabricProvider):
 
 
 def _chips_in(topology: str) -> int:
+    from tpu_composer.topology.slices import _parse_dims
+
     n = 1
-    for p in topology.lower().split("x"):
-        n *= int(p)
+    for d in _parse_dims(topology):  # raises TopologyError on malformed input
+        n *= d
     return n

@@ -1,0 +1,12 @@
+"""Model zoo: the flagship decoder-only transformer used as the
+slice-acceptance workload and benchmark subject."""
+
+from tpu_composer.models.transformer import (
+    ModelConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_specs,
+)
+
+__all__ = ["ModelConfig", "forward", "init_params", "loss_fn", "param_specs"]

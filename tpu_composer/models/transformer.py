@@ -1,0 +1,166 @@
+"""Flagship model: a decoder-only transformer LM in pure JAX pytrees.
+
+Built TPU-first:
+- bfloat16 activations/weights with fp32 softmax/normalizer math (MXU wants
+  bf16 inputs, fp32 accumulation);
+- RMSNorm + rotary position embeddings + SwiGLU MLP (standard modern LM
+  block) — all fusible elementwise chains XLA folds into the matmuls;
+- head and ffn dimensions are the tensor-parallel shard axes; param_specs()
+  publishes the PartitionSpec pytree so the train step can lay params out
+  over a ('dp','sp','tp') mesh and let GSPMD insert the collectives;
+- attention impl is pluggable: reference einsum, Pallas flash kernel, or
+  ring attention for sequence parallelism (the long-context path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpu_composer.ops.attention import flash_attention, mha_reference
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 1408  # ~2.75x, SwiGLU-style
+    max_seq: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "reference"  # reference | flash | ring (via attn_fn)
+    rope_theta: float = 10000.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(config: ModelConfig, key) -> Dict:
+    """Pytree: {embed, layers: [{ln1, wqkv, wo, ln2, w_gate, w_up, w_down}], ln_f}."""
+    c = config
+    k_embed, k_layers = jax.random.split(key)
+    init = jax.nn.initializers.normal(stddev=0.02)
+
+    def dense(k, shape):
+        return init(k, shape, jnp.float32).astype(c.dtype)
+
+    layers = []
+    for lk in jax.random.split(k_layers, c.n_layers):
+        k1, k2, k3, k4, k5 = jax.random.split(lk, 5)
+        layers.append({
+            "ln1": jnp.ones((c.d_model,), jnp.float32),
+            "wqkv": dense(k1, (c.d_model, 3, c.n_heads, c.head_dim)),
+            "wo": dense(k2, (c.n_heads, c.head_dim, c.d_model)),
+            "ln2": jnp.ones((c.d_model,), jnp.float32),
+            "w_gate": dense(k3, (c.d_model, c.d_ff)),
+            "w_up": dense(k4, (c.d_model, c.d_ff)),
+            "w_down": dense(k5, (c.d_ff, c.d_model)),
+        })
+    return {
+        "embed": dense(k_embed, (c.vocab_size, c.d_model)),
+        "layers": layers,
+        "ln_f": jnp.ones((c.d_model,), jnp.float32),
+    }
+
+
+def param_specs(config: ModelConfig) -> Dict:
+    """PartitionSpec pytree matching init_params — 'tp' shards heads/ffn,
+    'dp'/'sp' never touch params (they shard batch/sequence)."""
+    layer = {
+        "ln1": P(),
+        "wqkv": P(None, None, "tp", None),
+        "wo": P("tp", None, None),
+        "ln2": P(),
+        "w_gate": P(None, "tp"),
+        "w_up": P(None, "tp"),
+        "w_down": P("tp", None),
+    }
+    return {
+        "embed": P("tp", None),
+        "layers": [dict(layer) for _ in range(config.n_layers)],
+        "ln_f": P(),
+    }
+
+
+def _rmsnorm(x, gamma, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale * gamma).astype(x.dtype)
+
+
+def _rope(x, positions, theta: float):
+    """Rotary embedding. x: (B, S, H, D), positions: (B, S)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, half)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+AttnFn = Callable[..., jax.Array]  # (q, k, v, causal=...) -> out
+
+
+def _select_attn(config: ModelConfig, attn_fn: Optional[AttnFn]) -> AttnFn:
+    if attn_fn is not None:
+        return attn_fn
+    if config.attn_impl == "flash":
+        return flash_attention
+    return mha_reference
+
+
+def forward(
+    params: Dict,
+    tokens: jax.Array,  # (B, S) int32
+    config: ModelConfig,
+    attn_fn: Optional[AttnFn] = None,
+) -> jax.Array:
+    """Returns logits (B, S, vocab). attn_fn overrides the attention impl
+    (the train step passes a shard_map-wrapped ring_attention for sp)."""
+    c = config
+    attn = _select_attn(c, attn_fn)
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+    x = jnp.take(params["embed"], tokens, axis=0)  # (B, S, D)
+    for layer in params["layers"]:
+        h = _rmsnorm(x, layer["ln1"])
+        qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"])
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+        o = attn(q, k, v, causal=True)
+        x = x + jnp.einsum("bshk,hkd->bsd", o.astype(c.dtype), layer["wo"])
+
+        h = _rmsnorm(x, layer["ln2"])
+        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]).astype(jnp.float32))
+        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"]).astype(jnp.float32)
+        x = x + jnp.einsum("bsf,fd->bsd", (gate * up).astype(c.dtype), layer["w_down"])
+
+    x = _rmsnorm(x, params["ln_f"])
+    # Tied output head (embed^T), fp32 logits for a stable softmax.
+    return jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
+
+
+def loss_fn(
+    params: Dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    attn_fn: Optional[AttnFn] = None,
+) -> jax.Array:
+    """Next-token cross-entropy (mean over B*(S-1) positions)."""
+    logits = forward(params, tokens, config, attn_fn)[:, :-1]
+    targets = tokens[:, 1:]
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

@@ -1,0 +1,11 @@
+"""Compute ops: attention (reference + Pallas flash kernel).
+
+Hot ops for the slice-acceptance workload and the flagship model. The Pallas
+kernel targets the TPU memory hierarchy (HBM→VMEM streaming, MXU matmuls,
+online softmax in fp32 scratch); on CPU it runs in interpreter mode so the
+whole stack is testable on the 8-device virtual mesh.
+"""
+
+from tpu_composer.ops.attention import flash_attention, mha_reference
+
+__all__ = ["flash_attention", "mha_reference"]

@@ -1,0 +1,128 @@
+"""Multi-head attention: reference einsum implementation + Pallas flash
+kernel.
+
+The flash kernel follows the online-softmax (FlashAttention) recurrence:
+stream K/V blocks through VMEM, keep the running row-max ``m``, normalizer
+``l`` and fp32 accumulator in registers/VMEM, and never materialize the
+(Sq, Sk) score matrix in HBM. Matmuls hit the MXU with
+``preferred_element_type=float32``; block shapes default to the 128-lane
+tile the MXU wants (pallas_guide.md "Tiling Constraints").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU backend module exists even on CPU builds of current JAX
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def mha_reference(q, k, v, causal: bool = False):
+    """Plain attention. Shapes: (B, S, H, D) -> (B, S, H, D)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        scores = jnp.where(qi >= ki, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+    return out
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sk: int):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    scale = 1.0 / (d ** 0.5)
+    q = q_ref[0].astype(jnp.float32) * scale  # (bq, D)
+
+    nk = sk // block_k
+    # Causal: K blocks entirely above the diagonal are fully masked — skip
+    # them instead of paying two MXU matmuls for -inf scores. The last block
+    # that can contain an unmasked entry for this q block is
+    # ceil(((qi+1) * block_q) / block_k).
+    if causal:
+        nk = jnp.minimum(nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            q_pos = qi * block_q + rows
+            k_pos = j * block_k + cols
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """FlashAttention via Pallas. Shapes: (B, S, H, D) -> (B, S, H, D).
+
+    ``interpret`` defaults to True off-TPU so the kernel is testable on the
+    CPU mesh; on TPU it compiles to a Mosaic kernel.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
+
+    # Collapse (B, H) into one grid axis; move seq next to head_dim.
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    grid = (b * h, sq // block_q)
+    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal, sk=sk)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

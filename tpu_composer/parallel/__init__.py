@@ -1,0 +1,34 @@
+"""Distributed compute: meshes, collectives, ring attention, sharded training.
+
+This is the layer the reference does not have (SURVEY.md §2: "no DP/TP/PP/SP/
+EP/CP, ring attention, ... or collective-communication backend of any kind")
+but a TPU composability framework must ship: the operator composes an ICI
+slice; this package is what runs on it. Design follows the JAX SPMD recipe:
+pick a Mesh, annotate shardings, let XLA insert collectives over ICI;
+shard_map + ppermute for the explicitly-scheduled ring paths.
+"""
+
+from tpu_composer.parallel.mesh import make_mesh, solve_mesh_axes
+from tpu_composer.parallel.collectives import (
+    all_gather,
+    all_reduce,
+    allreduce_bandwidth_gbps,
+    reduce_scatter,
+    ring_shift,
+)
+from tpu_composer.parallel.ring_attention import ring_attention
+from tpu_composer.parallel.train import TrainConfig, make_train_state, make_train_step
+
+__all__ = [
+    "make_mesh",
+    "solve_mesh_axes",
+    "all_gather",
+    "all_reduce",
+    "allreduce_bandwidth_gbps",
+    "reduce_scatter",
+    "ring_shift",
+    "ring_attention",
+    "TrainConfig",
+    "make_train_state",
+    "make_train_step",
+]

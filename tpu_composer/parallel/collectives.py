@@ -1,0 +1,90 @@
+"""Collective primitives + the ICI allreduce bandwidth probe.
+
+Thin wrappers over XLA collectives (psum/all_gather/psum_scatter/ppermute)
+for use inside ``shard_map`` — the composed slice's data plane. The
+``allreduce_bandwidth_gbps`` probe is the second half of the north-star
+metric ("JAX allreduce GB/s on composed slice", BASELINE.md): it is how a
+freshly composed slice is qualified before being handed to users.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+
+def all_reduce(x, axis: str):
+    return jax.lax.psum(x, axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = True):
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_dimension: int = 0):
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension, tiled=True)
+
+
+def ring_shift(x, axis: str, shift: int = 1):
+    """Rotate shards around the `axis` ring (ppermute), the building block of
+    ring attention and ring collectives."""
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def allreduce_bandwidth_gbps(
+    mesh: Optional[Mesh] = None,
+    size_mb: float = 64.0,
+    iters: int = 10,
+    dtype=jnp.bfloat16,
+) -> float:
+    """Measure allreduce algorithmic bandwidth over the mesh's device set.
+
+    Algorithmic bandwidth for a ring allreduce of S bytes over n devices is
+    2*(n-1)/n * S per device; we report GB/s of that busbw convention so
+    numbers are comparable with NCCL-style reports.
+    """
+    if mesh is None:
+        from tpu_composer.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"x": len(jax.devices())})
+    axis_names = mesh.axis_names
+    n = int(np.prod(mesh.devices.shape))
+    if n < 2:
+        # Single chip: no ICI to exercise; report 0 rather than a fiction.
+        return 0.0
+
+    # NCCL busbw convention: every rank contributes its OWN buffer of S
+    # bytes; allreduce returns the elementwise sum to all ranks. Model that
+    # as a (n, E) global sharded on dim 0, one row per device.
+    per_dev = int(size_mb * 1e6 / jnp.dtype(dtype).itemsize)
+    per_dev -= per_dev % 128  # lane-aligned
+    x = jnp.ones((n, per_dev), dtype=dtype)
+    x = jax.device_put(x, NamedSharding(mesh, P(axis_names, None)))
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=P(axis_names, None), out_specs=P(axis_names, None),
+    )
+    def allreduce(lx):  # lx: (1, per_dev) local buffer
+        return jax.lax.psum(lx, axis_names)
+
+    fn = jax.jit(allreduce)
+    fn(x).block_until_ready()  # compile + warm up
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+
+    buf_bytes = per_dev * jnp.dtype(dtype).itemsize
+    busbw = 2 * (n - 1) / n * buf_bytes / dt
+    return busbw / 1e9

@@ -1,0 +1,70 @@
+"""Device mesh construction for composed slices."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def solve_mesh_axes(
+    n_devices: int,
+    dp: int = 0,
+    sp: int = 0,
+    tp: int = 0,
+) -> Dict[str, int]:
+    """Factor `n_devices` into (dp, sp, tp) axis sizes.
+
+    Fixed (nonzero) degrees are honored; free axes absorb the remainder with
+    preference order tp ≤ 8 (keep tensor-parallel groups inside one ICI
+    neighborhood), then sp, then dp takes what's left. Raises if the fixed
+    degrees don't divide the device count.
+    """
+    remaining = n_devices
+    for name, v in (("dp", dp), ("sp", sp), ("tp", tp)):
+        if v:
+            if remaining % v != 0:
+                raise ValueError(
+                    f"{name}={v} does not divide remaining device count {remaining}"
+                )
+            remaining //= v
+    if tp == 0:
+        tp = 1
+        for cand in (8, 4, 2):
+            if remaining % cand == 0:
+                tp = cand
+                break
+        remaining //= tp
+    if sp == 0:
+        sp = 2 if remaining % 2 == 0 and remaining >= 2 else 1
+        remaining //= sp
+    if dp == 0:
+        dp = remaining
+        remaining = 1
+    if dp * sp * tp != n_devices:
+        raise ValueError(
+            f"dp*sp*tp = {dp}*{sp}*{tp} != device count {n_devices}"
+        )
+    return {"dp": dp, "sp": sp, "tp": tp}
+
+
+def make_mesh(
+    axis_sizes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a named Mesh over `devices` (default: all local devices).
+
+    axis order is the dict order; default axes solve (dp, sp, tp) for the
+    device count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axis_sizes is None:
+        axis_sizes = solve_mesh_axes(len(devices))
+    shape = tuple(axis_sizes.values())
+    total = int(np.prod(shape))
+    if total != len(devices):
+        raise ValueError(f"mesh shape {shape} needs {total} devices, have {len(devices)}")
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_sizes.keys()))

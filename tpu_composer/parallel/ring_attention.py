@@ -1,0 +1,82 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+Long-context path: the sequence axis is sharded over a mesh axis; each device
+holds a Q/K/V shard and K/V chunks rotate around the ring via ``ppermute``
+while the online-softmax state (running max, normalizer, accumulator)
+accumulates locally. After ``n`` steps every Q shard has attended to the full
+sequence while only ever holding 1/n of K/V — memory per device is O(S/n) and
+the ring traffic overlaps with compute on real ICI (XLA schedules the
+ppermute DMA alongside the matmuls).
+
+Use inside shard_map with the sequence axis sharded, e.g.:
+
+    shard_map(
+        functools.partial(ring_attention, axis_name="sp", causal=True),
+        mesh=mesh,
+        in_specs=(P(None, "sp", None, None),) * 3,
+        out_specs=P(None, "sp", None, None),
+    )(q, k, v)
+
+No reference analog (SURVEY.md §5: long-context parallelism is absent there);
+this is first-class here per the build spec.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Blockwise ring attention. Local shapes: (B, S_local, H, D).
+
+    The global sequence is the concatenation of shards in ring order
+    (axis index 0..n-1). Causal masking uses global positions.
+    """
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+
+    qf = q.astype(jnp.float32) * scale
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(s, carry):
+        k_cur, v_cur, m, l, acc = carry
+        # After s shifts we hold the chunk originally on device (my_idx - s).
+        src = (my_idx - s) % n
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = my_idx * s_local + jax.lax.broadcasted_iota(
+                jnp.int32, (s_local, s_local), 0
+            )
+            k_pos = src * s_local + jax.lax.broadcasted_iota(
+                jnp.int32, (s_local, s_local), 1
+            )
+            scores = jnp.where(q_pos >= k_pos, scores, -1e30)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * alpha.transpose(0, 2, 1, 3) + pv
+        # Rotate K/V to the next device; the final rotation restores the
+        # original placement (and XLA overlaps it with the next step's math).
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m_new, l_new, acc_new
+
+    m0 = jnp.full((b, h, s_local, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    acc0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    _, _, m, l, acc = jax.lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)

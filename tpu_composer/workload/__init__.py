@@ -1,0 +1,13 @@
+"""Workload-side integration: coordinate consumption + slice acceptance.
+
+The closing of the loop: the operator composes a slice and injects TPU_*
+coordinates (admission.coordinates); this package is what a JAX workload
+calls to consume them — bootstrap jax.distributed from the injected env,
+build the mesh, and qualify the slice (allreduce bandwidth + a real sharded
+train step) before the job trusts it.
+"""
+
+from tpu_composer.workload.coords import SliceCoords, bootstrap_distributed
+from tpu_composer.workload.acceptance import qualify_slice
+
+__all__ = ["SliceCoords", "bootstrap_distributed", "qualify_slice"]

@@ -1,0 +1,80 @@
+"""Slice qualification — prove a freshly composed slice actually works.
+
+The reference's notion of device health is `nvidia-smi` answering and the
+fabric reporting OK (composableresource_controller.go:317-330). For a TPU
+slice that is not enough: the ICI mesh must move bytes and the MXU must hit
+rate. ``qualify_slice`` runs the two north-star probes (BASELINE.md):
+
+1. allreduce busbw over the mesh (ICI health + topology sanity);
+2. a real sharded train step of the flagship model (MXU + memory system +
+   collective overlap), returning step time and achieved TFLOP/s.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from tpu_composer.models.transformer import ModelConfig
+from tpu_composer.parallel.collectives import allreduce_bandwidth_gbps
+from tpu_composer.parallel.mesh import make_mesh, solve_mesh_axes
+from tpu_composer.parallel.train import TrainConfig, make_train_state, make_train_step
+
+
+def _model_flops_per_token(c: ModelConfig) -> float:
+    """~6 * params matmul FLOPs per token for fwd+bwd (standard estimate)."""
+    per_layer = (
+        3 * c.d_model * c.n_heads * c.head_dim  # qkv
+        + c.n_heads * c.head_dim * c.d_model  # out proj
+        + 3 * c.d_model * c.d_ff  # swiglu
+    )
+    params = c.n_layers * per_layer + c.vocab_size * c.d_model
+    return 6.0 * params
+
+
+def qualify_slice(
+    mesh: Optional[Mesh] = None,
+    batch: int = 8,
+    seq: int = 512,
+    model_config: Optional[ModelConfig] = None,
+    allreduce_mb: float = 64.0,
+    steps: int = 5,
+) -> Dict[str, float]:
+    if mesh is None:
+        mesh = make_mesh(solve_mesh_axes(len(jax.devices())))
+    mc = model_config or ModelConfig(
+        vocab_size=8192, d_model=512, n_layers=4, n_heads=8, d_ff=1408, max_seq=seq
+    )
+    tc = TrainConfig(model=mc)
+
+    results: Dict[str, float] = {
+        "n_devices": float(int(np.prod(mesh.devices.shape))),
+        "allreduce_gbps": allreduce_bandwidth_gbps(mesh, size_mb=allreduce_mb),
+    }
+
+    state = make_train_state(tc, jax.random.key(0), mesh)
+    step_fn, batch_sharding = make_train_step(tc, mesh)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (batch, seq), 0, mc.vocab_size),
+        batch_sharding,
+    )
+
+    state, metrics = step_fn(state, tokens)  # compile + first step
+    jax.block_until_ready(metrics)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, tokens)
+    jax.block_until_ready(metrics)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_step = batch * seq
+    results["train_step_ms"] = dt * 1e3
+    results["train_loss"] = float(metrics["loss"])
+    results["tokens_per_s"] = tokens_per_step / dt
+    results["tflops"] = _model_flops_per_token(mc) * tokens_per_step / dt / 1e12
+    return results

@@ -1,0 +1,95 @@
+"""Consume the injected TPU_* coordinates.
+
+The contract is exactly what admission.coordinates.slice_env writes (and the
+CDI specs carry): a workload process on a composed slice reads its identity
+from env, initializes jax.distributed for multi-host, and gets a mesh over
+the slice's devices. The reference never had this layer — its workloads were
+opaque pods; ours closes the loop to JAX.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+log = logging.getLogger("workload.coords")
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass(frozen=True)
+class SliceCoords:
+    worker_id: int
+    worker_hostnames: List[str]
+    chips_per_host: int
+    topology: str
+    slice_name: str
+    model: str = ""
+
+    @property
+    def num_workers(self) -> int:
+        return max(1, len(self.worker_hostnames))
+
+    @property
+    def num_chips(self) -> int:
+        return self.chips_per_host * self.num_workers
+
+    @property
+    def coordinator_address(self) -> str:
+        host = self.worker_hostnames[0] if self.worker_hostnames else "localhost"
+        return f"{host}:{DEFAULT_COORDINATOR_PORT}"
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "SliceCoords":
+        e = os.environ if env is None else env
+        hostnames = [h for h in e.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+        # TPU_CHIPS_PER_HOST_BOUNDS is a per-dimension grid ("2,2,1", the
+        # libtpu convention); the chip count is its product.
+        bounds = e.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
+        chips = 0
+        if bounds:
+            chips = 1
+            for p in bounds.split(","):
+                chips *= int(p or 1)
+        return cls(
+            worker_id=int(e.get("TPU_WORKER_ID", "0")),
+            worker_hostnames=hostnames,
+            chips_per_host=chips,
+            topology=e.get("TPU_TOPOLOGY", ""),
+            slice_name=e.get("TPU_SLICE_NAME", ""),
+            model=e.get("TPU_ACCELERATOR_MODEL", ""),
+        )
+
+
+def bootstrap_distributed(
+    coords: Optional[SliceCoords] = None,
+    env: Optional[Dict[str, str]] = None,
+) -> SliceCoords:
+    """Initialize jax.distributed from injected coordinates (multi-host
+    slices only; single-host is a no-op). Idempotent. Returns the coords.
+
+    Worker 0's host is the coordinator — the same convention libtpu's
+    megascale setup uses, so the injected hostname list is sufficient.
+    """
+    coords = coords or SliceCoords.from_env(env)
+    if coords.num_workers <= 1:
+        return coords
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coords.coordinator_address,
+            num_processes=coords.num_workers,
+            process_id=coords.worker_id,
+        )
+        log.info(
+            "jax.distributed up: worker %d/%d via %s",
+            coords.worker_id, coords.num_workers, coords.coordinator_address,
+        )
+    except RuntimeError as e:
+        # Already initialized (restart inside the same process) is fine.
+        if "already" not in str(e).lower():
+            raise
+    return coords
