@@ -187,6 +187,26 @@ class TestGroupClaims:
         # B's two remaining nodes must NOT make A look visible.
         assert not agent.check_visible("n0", ["a1", "a2"], group="sA-worker0")
 
+    def test_vfio_spec_claims_track_vfio_group_nodes(self, fake_host):
+        """A vfio-exposed group (IOMMU passthrough host) must record its
+        numbered /dev/vfio/N nodes as the claim — an accel-only filter
+        records an empty claim and visibility never succeeds."""
+        root, dev, proc, lib = fake_host
+        vfio = os.path.join(dev, "vfio")
+        os.makedirs(vfio)
+        for n in ("vfio", "0", "1"):
+            with open(os.path.join(vfio, n), "w"):
+                pass
+        agent = make_agent(fake_host)
+        spec = generate_cdi_spec("sV", 0, [0, 1], use_vfio=True)
+        agent.refresh_device_stack("n0", spec=spec)
+        assert agent.check_visible("n0", ["v1", "v2"], group="sV-worker0")
+        os.remove(os.path.join(vfio, "0"))
+        assert not agent.check_visible("n0", ["v1", "v2"], group="sV-worker0")
+        # The shared control node is never claimed per-group.
+        claims = agent._claims()
+        assert os.path.join(vfio, "vfio") not in claims["sV-worker0"]
+
     def test_load_check_scoped_to_own_claim(self, fake_host):
         root, dev, proc, lib = fake_host
         agent = make_agent(fake_host)

@@ -1,0 +1,378 @@
+"""Remote fabric backends (rest / layout / redfish) against the fake
+pool-manager server — the analog of the reference's {CM,FM} x {state} x
+{happy, failure} client matrix (composableresource_controller_test.go)."""
+
+import pytest
+
+from tests.fake_fabric import FakeFabricServer
+from tpu_composer.api.types import (
+    ComposableResource,
+    ComposableResourceSpec,
+    ComposableResourceStatus,
+    ObjectMeta,
+)
+from tpu_composer.fabric.adapter import AdapterError, new_fabric_provider
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.fabric.layout import LayoutApplyClient
+from tpu_composer.fabric.provider import (
+    FabricError,
+    WaitingDeviceAttaching,
+    WaitingDeviceDetaching,
+)
+from tpu_composer.fabric.redfish import RedfishClient
+from tpu_composer.fabric.rest import RestPoolClient
+from tpu_composer.fabric.token import TokenCache
+
+
+def make_resource(name="res-0", node="worker-0", model="tpu-v4", count=1,
+                  slice_name="", worker_id=0, device_ids=None):
+    return ComposableResource(
+        metadata=ObjectMeta(name=name),
+        spec=ComposableResourceSpec(
+            type="tpu", model=model, target_node=node, chip_count=count,
+            slice_name=slice_name, worker_id=worker_id,
+        ),
+        status=ComposableResourceStatus(device_ids=device_ids or []),
+    )
+
+
+@pytest.fixture()
+def server():
+    s = FakeFabricServer()
+    yield s
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# RestPoolClient
+# ---------------------------------------------------------------------------
+
+class TestRestClient:
+    def test_attach_detach_roundtrip(self, server):
+        client = RestPoolClient(server.url, token_cache=None)
+        res = make_resource()
+        result = client.add_resource(res)
+        assert len(result.device_ids) == 1
+        assert server.pool.attached_to("worker-0") == result.device_ids
+        # Idempotent re-add returns the same attachment.
+        again = client.add_resource(res)
+        assert again.device_ids == result.device_ids
+        res.status.device_ids = result.device_ids
+        client.remove_resource(res)
+        assert server.pool.attached_to("worker-0") == []
+        client.remove_resource(res)  # idempotent no-op
+
+    def test_async_attach_raises_wait_sentinel(self):
+        server = FakeFabricServer(pool=InMemoryPool(async_steps=2))
+        try:
+            client = RestPoolClient(server.url, token_cache=None)
+            res = make_resource()
+            with pytest.raises(WaitingDeviceAttaching):
+                client.add_resource(res)
+            with pytest.raises(WaitingDeviceAttaching):
+                client.add_resource(res)
+            result = client.add_resource(res)  # third poll completes
+            assert result.device_ids
+        finally:
+            server.close()
+
+    def test_synchronous_mode_completes_inline(self):
+        """FM-style: ?wait=true drives the pool's async steps server-side."""
+        server = FakeFabricServer(pool=InMemoryPool(async_steps=3))
+        try:
+            client = RestPoolClient(server.url, synchronous=True, token_cache=None)
+            result = client.add_resource(make_resource())
+            assert result.device_ids  # no sentinel surfaced
+        finally:
+            server.close()
+
+    def test_pool_exhausted_is_terminal_error(self, server):
+        client = RestPoolClient(server.url, token_cache=None)
+        with pytest.raises(FabricError) as ei:
+            client.add_resource(make_resource(model="tpu-v5e", count=64))
+        assert not isinstance(ei.value, WaitingDeviceAttaching)
+
+    def test_health_and_get_resources(self, server):
+        client = RestPoolClient(server.url, token_cache=None)
+        res = make_resource()
+        result = client.add_resource(res)
+        assert client.check_resource(res).healthy
+        from tpu_composer.fabric.provider import DeviceHealth
+        server.pool.set_health(result.device_ids[0], DeviceHealth("Critical", "ECC"))
+        health = client.check_resource(res)
+        assert health.state == "Critical" and health.detail == "ECC"
+        devices = client.get_resources()
+        assert [d.device_id for d in devices] == result.device_ids
+        assert devices[0].node == "worker-0"
+        # Unknown attachment reads as Critical/not attached.
+        assert client.check_resource(make_resource(name="ghost")).state == "Critical"
+
+    def test_slice_reserve_attach_release(self, server):
+        client = RestPoolClient(server.url, token_cache=None)
+        nodes = ["worker-0", "worker-1"]
+        client.reserve_slice("s0", "tpu-v4", "2x2x2", nodes)
+        results = []
+        for w, node in enumerate(nodes):
+            res = make_resource(name=f"s0-w{w}", node=node, count=4,
+                                slice_name="s0", worker_id=w)
+            results.append(client.add_resource(res))
+        ids = {d for r in results for d in r.device_ids}
+        assert len(ids) == 8
+        # Double reserve is idempotent; releasing frees unattached chips.
+        client.reserve_slice("s0", "tpu-v4", "2x2x2", nodes)
+        client.release_slice("s0")
+
+    def test_detach_orphan_by_device_id(self, server):
+        """The syncer's ready-to-detach flow: DELETE names device ids only."""
+        leaked = server.pool.leak_attachment("worker-3", "tpu-v4")
+        client = RestPoolClient(server.url, token_cache=None)
+        free_before = server.pool.free_chips("tpu-v4")
+        client.remove_resource(make_resource(name="detach-cr", device_ids=[leaked]))
+        assert server.pool.free_chips("tpu-v4") == free_before + 1
+
+    def test_api_error_maps_to_fabric_error(self, server):
+        client = RestPoolClient(server.url, token_cache=None)
+        server.fail_next("PUT", "/v1/attachments", 500)
+        with pytest.raises(FabricError):
+            client.add_resource(make_resource())
+
+    def test_tenant_cluster_path_prefix(self, server):
+        client = RestPoolClient(server.url, tenant_id="t0", cluster_id="c0",
+                                token_cache=None)
+        assert client.add_resource(make_resource()).device_ids
+        assert any("/v1/tenants/t0/clusters/c0/" in line
+                   for line in server.request_log)
+
+    def test_bearer_auth_and_401_retry(self):
+        server = FakeFabricServer(require_auth=True)
+        try:
+            cache = TokenCache(server.token_url, "composer", "secret")
+            client = RestPoolClient(server.url, token_cache=cache)
+            client.add_resource(make_resource(name="auth-0"))
+            # Server-side revocation: next call gets 401, client must
+            # invalidate + refetch + retry transparently.
+            server.revoke_tokens()
+            client.add_resource(make_resource(name="auth-1"))
+            assert server.token_requests == 2
+        finally:
+            server.close()
+
+    def test_unauthenticated_rejected(self):
+        server = FakeFabricServer(require_auth=True)
+        try:
+            client = RestPoolClient(server.url, token_cache=None)
+            with pytest.raises(FabricError):
+                client.add_resource(make_resource())
+        finally:
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# LayoutApplyClient
+# ---------------------------------------------------------------------------
+
+def layout_client(server, attempts=6):
+    return LayoutApplyClient(server.url, token_cache=None,
+                             poll_interval=0.01, poll_attempts=attempts)
+
+
+class TestLayoutClient:
+    def test_connect_completes_within_budget(self, server):
+        server.apply_steps = 3
+        client = layout_client(server)
+        result = client.add_resource(make_resource())
+        assert result.device_ids
+        assert server.pool.attached_to("worker-0") == result.device_ids
+        # Idempotent re-add short-circuits on the attachment record.
+        log_len = len(server.request_log)
+        again = client.add_resource(make_resource())
+        assert again.device_ids == result.device_ids
+        assert not any("layout-apply" in line
+                       for line in server.request_log[log_len:])
+
+    def test_poll_budget_exhausted_raises_wait(self, server):
+        server.apply_steps = 10
+        client = layout_client(server, attempts=2)
+        with pytest.raises(WaitingDeviceAttaching):
+            client.add_resource(make_resource())
+
+    def test_busy_fabric_409_raises_wait(self, server):
+        server.apply_steps = 100  # first apply never completes
+        client = layout_client(server, attempts=1)
+        with pytest.raises(WaitingDeviceAttaching):
+            client.add_resource(make_resource(name="a"))
+        with pytest.raises(WaitingDeviceAttaching):  # 409 APPLY_IN_PROGRESS
+            client.add_resource(make_resource(name="b"))
+
+    def test_failed_apply_is_terminal(self, server):
+        client = layout_client(server)
+        with pytest.raises(FabricError) as ei:
+            client.add_resource(make_resource(model="no-such-model"))
+        assert "failed" in str(ei.value)
+        assert not isinstance(ei.value, WaitingDeviceAttaching)
+
+    def test_disconnect(self, server):
+        client = layout_client(server)
+        res = make_resource()
+        result = client.add_resource(res)
+        res.status.device_ids = result.device_ids
+        client.remove_resource(res)
+        assert server.pool.attached_to("worker-0") == []
+        client.remove_resource(make_resource(name="ghost"))  # no-op
+
+    def test_health_passthrough(self, server):
+        client = layout_client(server)
+        res = make_resource()
+        client.add_resource(res)
+        assert client.check_resource(res).healthy
+        assert client.get_resources()[0].node == "worker-0"
+
+
+# ---------------------------------------------------------------------------
+# RedfishClient
+# ---------------------------------------------------------------------------
+
+class TestRedfishClient:
+    def test_compose_decompose(self, server):
+        client = RedfishClient(server.url, token_cache=None)
+        res = make_resource(count=2)
+        result = client.add_resource(res)
+        assert len(result.device_ids) == 2
+        # Idempotent re-add reads the existing block from the system.
+        assert client.add_resource(res).device_ids == result.device_ids
+        assert client.check_resource(res).healthy
+        devices = client.get_resources()
+        assert {d.device_id for d in devices} == set(result.device_ids)
+        res.status.device_ids = result.device_ids
+        client.remove_resource(res)
+        assert client.get_resources() == []
+        assert client.check_resource(res).state == "Critical"
+
+    def test_health_aggregation(self, server):
+        client = RedfishClient(server.url, token_cache=None)
+        res = make_resource(count=2)
+        result = client.add_resource(res)
+        from tpu_composer.fabric.provider import DeviceHealth
+        server.pool.set_health(result.device_ids[1], DeviceHealth("Warning", "thermal"))
+        assert client.check_resource(res).state == "Warning"
+
+    def test_exhaustion_is_terminal(self, server):
+        client = RedfishClient(server.url, token_cache=None)
+        with pytest.raises(FabricError):
+            client.add_resource(make_resource(model="gpu-a100", count=99))
+
+    def test_resource_zone_reserve_release(self, server):
+        client = RedfishClient(server.url, token_cache=None)
+        client.reserve_slice("z0", "tpu-v4", "1x2x2", ["worker-0"])
+        res = make_resource(name="z0-w0", count=4, slice_name="z0", worker_id=0)
+        assert len(client.add_resource(res).device_ids) == 4
+        client.release_slice("z0")
+
+
+# ---------------------------------------------------------------------------
+# Adapter factory wiring (env -> backend)
+# ---------------------------------------------------------------------------
+
+class TestAdapterFactory:
+    def test_rest_backends(self, server, monkeypatch):
+        monkeypatch.setenv("FABRIC_ENDPOINT", server.url)
+        monkeypatch.delenv("FABRIC_AUTH_URL", raising=False)
+        cm = new_fabric_provider("REST_CM")
+        assert isinstance(cm, RestPoolClient) and not cm.synchronous
+        fm = new_fabric_provider("REST_FM")
+        assert isinstance(fm, RestPoolClient) and fm.synchronous
+        assert isinstance(new_fabric_provider("LAYOUT"), LayoutApplyClient)
+        assert isinstance(new_fabric_provider("REDFISH"), RedfishClient)
+        # And they actually work end-to-end through the factory.
+        assert cm.add_resource(make_resource(name="factory-0")).device_ids
+
+    def test_missing_endpoint_rejected(self, monkeypatch):
+        monkeypatch.delenv("FABRIC_ENDPOINT", raising=False)
+        with pytest.raises(AdapterError):
+            new_fabric_provider("REST_CM")
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: full operator over the wire
+# ---------------------------------------------------------------------------
+
+class TestOperatorOverRest:
+    """The whole control plane (request + resource controllers + syncer)
+    driving the fabric through HTTP — the closest analog to the reference's
+    envtest + httptest integration suites, with a real wire in the loop."""
+
+    def test_request_lifecycle_over_http(self):
+        import time
+
+        from tpu_composer.api import (
+            ComposabilityRequest,
+            ComposabilityRequestSpec,
+            Node,
+            ObjectMeta,
+            ResourceDetails,
+        )
+        from tpu_composer.api.types import REQUEST_STATE_RUNNING
+        from tpu_composer.agent.fake import FakeNodeAgent
+        from tpu_composer.controllers import (
+            ComposabilityRequestReconciler,
+            ComposableResourceReconciler,
+            RequestTiming,
+            ResourceTiming,
+            UpstreamSyncer,
+        )
+        from tpu_composer.runtime.manager import Manager
+        from tpu_composer.runtime.store import Store
+
+        server = FakeFabricServer(require_auth=True)
+        try:
+            cache = TokenCache(server.token_url, "composer", "secret")
+            client = RestPoolClient(server.url, token_cache=cache)
+            store = Store()
+            for i in range(4):
+                n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+                n.status.tpu_slots = 4
+                store.create(n)
+            agent = FakeNodeAgent(pool=server.pool)
+            mgr = Manager(store=store)
+            mgr.add_controller(ComposabilityRequestReconciler(
+                store, client,
+                timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.05)))
+            mgr.add_controller(ComposableResourceReconciler(
+                store, client, agent,
+                timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
+                                      detach_poll=0.05, detach_fast=0.05,
+                                      busy_poll=0.05)))
+            mgr.add_runnable(UpstreamSyncer(store, client, period=0.1, grace=0.5))
+            mgr.start(workers_per_controller=2)
+
+            req = ComposabilityRequest(
+                metadata=ObjectMeta(name="req-http"),
+                spec=ComposabilityRequestSpec(
+                    resource=ResourceDetails(type="tpu", model="tpu-v4", size=4)),
+            )
+            store.create(req)
+
+            def wait_for(pred, timeout=20.0):
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    if pred():
+                        return True
+                    time.sleep(0.02)
+                return False
+
+            assert wait_for(
+                lambda: store.get(ComposabilityRequest, "req-http").status.state
+                == REQUEST_STATE_RUNNING
+            ), store.get(ComposabilityRequest, "req-http").status.to_dict()
+            live = store.get(ComposabilityRequest, "req-http")
+            ids = [d for r in live.status.resources.values() for d in r.device_ids]
+            assert len(ids) == 4
+            assert server.pool.free_chips("tpu-v4") == 60
+
+            store.delete(ComposabilityRequest, "req-http")
+            assert wait_for(
+                lambda: store.try_get(ComposabilityRequest, "req-http") is None)
+            assert wait_for(lambda: server.pool.free_chips("tpu-v4") == 64)
+            mgr.stop()
+        finally:
+            server.close()

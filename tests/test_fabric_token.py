@@ -1,0 +1,122 @@
+"""OAuth2 token cache tests (reference analog: the fake Keycloak endpoint +
+token personas, composableresource_controller_test.go:739-790)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tests.fake_fabric import FakeFabricServer, _make_jwt
+from tpu_composer.fabric.token import (
+    AuthError,
+    EXPIRY_LEEWAY_S,
+    TokenCache,
+    decode_jwt_expiry,
+)
+
+
+@pytest.fixture()
+def server():
+    s = FakeFabricServer(require_auth=True)
+    yield s
+    s.close()
+
+
+def test_decode_jwt_expiry_roundtrip():
+    tok = _make_jwt(120)
+    exp = decode_jwt_expiry(tok)
+    assert exp is not None
+    assert abs(exp - (time.time() + 120)) < 5
+
+
+def test_decode_jwt_expiry_garbage():
+    assert decode_jwt_expiry("not-a-jwt") is None
+    assert decode_jwt_expiry("a.b.c") is None
+    assert decode_jwt_expiry("") is None
+
+
+def test_fetch_and_cache(server):
+    cache = TokenCache(server.token_url, "composer", "secret")
+    t1 = cache.get()
+    t2 = cache.get()
+    assert t1 == t2
+    assert server.token_requests == 1  # second get served from cache
+
+
+def test_refresh_inside_leeway(server):
+    # Issue tokens that are already within the renewal leeway: every get()
+    # must refresh (expiry - leeway is in the past).
+    server.token_ttl = EXPIRY_LEEWAY_S / 2
+    cache = TokenCache(server.token_url, "composer", "secret")
+    cache.get()
+    cache.get()
+    assert server.token_requests == 2
+
+
+def test_bad_credentials(server):
+    cache = TokenCache(server.token_url, "composer", "wrong")
+    with pytest.raises(AuthError):
+        cache.get()
+
+
+def test_invalidate_forces_refetch(server):
+    cache = TokenCache(server.token_url, "composer", "secret")
+    cache.get()
+    cache.invalidate()
+    cache.get()
+    assert server.token_requests == 2
+
+
+def test_concurrent_gets_single_fetch(server):
+    """Double-checked locking: N threads racing a cold cache fetch once."""
+    cache = TokenCache(server.token_url, "composer", "secret")
+    barrier = threading.Barrier(8)
+    tokens = []
+
+    def worker():
+        barrier.wait()
+        tokens.append(cache.get())
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(tokens)) == 1
+    assert server.token_requests == 1
+
+
+def test_blip_tolerance_serves_valid_token(server):
+    """A failing refresh keeps serving a token that is still valid."""
+    server.token_ttl = EXPIRY_LEEWAY_S + 2  # valid, but inside leeway soon
+    cache = TokenCache(server.token_url, "composer", "secret")
+    tok = cache.get()
+    server.password = "rotated"  # auth service now rejects us
+    time.sleep(0.01)
+    # Inside leeway -> refresh attempt fails -> old (still unexpired) token.
+    assert cache.get() == tok
+
+
+def test_from_env_credentials_file(tmp_path, server, monkeypatch):
+    creds = tmp_path / "credentials.json"
+    creds.write_text(json.dumps({"username": "composer", "password": "secret"}))
+    monkeypatch.setenv("FABRIC_AUTH_URL", server.token_url)
+    monkeypatch.setenv("FABRIC_CREDENTIALS_FILE", str(creds))
+    cache = TokenCache.from_env()
+    assert cache is not None
+    assert cache.get()
+
+
+def test_from_env_absent(monkeypatch):
+    monkeypatch.delenv("FABRIC_AUTH_URL", raising=False)
+    assert TokenCache.from_env() is None
+
+
+def test_from_env_url_without_credentials(monkeypatch):
+    monkeypatch.setenv("FABRIC_AUTH_URL", "http://example.invalid/token")
+    monkeypatch.delenv("FABRIC_USERNAME", raising=False)
+    monkeypatch.delenv("FABRIC_PASSWORD", raising=False)
+    monkeypatch.delenv("FABRIC_CREDENTIALS_FILE", raising=False)
+    with pytest.raises(AuthError):
+        TokenCache.from_env()

@@ -97,6 +97,36 @@ class TestAttachPath:
         assert spec.env["TPU_TOPOLOGY"] == "2x2x1"
         assert spec.device_nodes == ["/dev/accel0", "/dev/accel1", "/dev/accel2", "/dev/accel3"]
 
+    def test_colocated_groups_get_disjoint_chip_indices(self, world):
+        """Two chip groups on ONE host must publish disjoint /dev/accel sets —
+        otherwise both containers are handed the same physical chips and each
+        group's open fds deadlock the other's drain."""
+        store, pool, agent, rec = world
+        make_tpu_cr(store, pool, name="a", slice_name="sa", node="worker-0")
+        make_tpu_cr(store, pool, name="b", slice_name="sb", node="worker-0")
+        for name in ("a", "b"):
+            step(rec, name)  # "" -> Attaching
+            step(rec, name)  # Attaching -> Online
+        spec_a = agent.published_spec("worker-0", "sa-worker0")
+        spec_b = agent.published_spec("worker-0", "sb-worker0")
+        assert spec_a.device_nodes == ["/dev/accel0", "/dev/accel1",
+                                       "/dev/accel2", "/dev/accel3"]
+        assert spec_b.device_nodes == ["/dev/accel4", "/dev/accel5",
+                                       "/dev/accel6", "/dev/accel7"]
+        assert not set(spec_a.device_nodes) & set(spec_b.device_nodes)
+        # Persisted for restart stability.
+        assert get(store, "a").status.chip_indices == [0, 1, 2, 3]
+        assert get(store, "b").status.chip_indices == [4, 5, 6, 7]
+        # Releasing group a frees its indices for the next group.
+        store.delete(ComposableResource, "a")
+        step(rec, "a")  # Online -> Detaching
+        step(rec, "a")  # Detaching -> Deleting
+        step(rec, "a")  # purge
+        make_tpu_cr(store, pool, name="c", slice_name="sc", node="worker-0")
+        step(rec, "c")
+        step(rec, "c")
+        assert get(store, "c").status.chip_indices == [0, 1, 2, 3]
+
     def test_async_fabric_requeues_without_error(self, world):
         store, _, agent, _ = world
         pool = InMemoryPool(async_steps=2)

@@ -28,7 +28,7 @@ from __future__ import annotations
 from typing import Dict
 
 from tpu_composer.api.types import SliceStatus
-from tpu_composer.topology.slices import TPU_MODELS
+from tpu_composer.topology.slices import TPU_MODELS, TopologyError, _parse_dims
 
 #: Pods opt in by carrying this label with the request name as value.
 LABEL_INJECT = "tpu.composer.dev/composability-request"
@@ -43,8 +43,8 @@ def _bounds(slice_status: SliceStatus, model: str):
     unknown or the slice is sub-host, fall back to a linear layout.
     """
     try:
-        dims = [int(p) for p in slice_status.topology.lower().split("x") if p]
-    except ValueError:
+        dims = list(_parse_dims(slice_status.topology))
+    except TopologyError:
         dims = []
     m = TPU_MODELS.get(model)
 

@@ -159,15 +159,19 @@ class LocalNodeAgent(NodeAgent):
         return os.path.join(self._claims_dir(), group.replace("/", "_") + ".json")
 
     def _record_claim(self, group: str, device_nodes: List[str]) -> None:
-        # CDI specs carry container-visible paths (/dev/accelN); rebase onto
-        # this agent's dev_dir so checks work under a relocated host root
-        # (tests, chrooted agents). Non-accel nodes (vfio control nodes) are
-        # not per-group and are skipped.
-        paths = [
-            os.path.join(self.dev_dir, os.path.basename(p))
-            for p in device_nodes
-            if os.path.basename(p).startswith("accel")
-        ]
+        # CDI specs carry container-visible paths (/dev/accelN or
+        # /dev/vfio/N); rebase onto this agent's dev_dir so checks work under
+        # a relocated host root (tests, chrooted agents). Per-chip nodes are
+        # accelN and numbered vfio group nodes; the shared vfio control node
+        # (/dev/vfio/vfio) is not per-group and is skipped.
+        paths = []
+        for p in device_nodes:
+            base = os.path.basename(p)
+            parent = os.path.basename(os.path.dirname(p))
+            if base.startswith("accel"):
+                paths.append(os.path.join(self.dev_dir, base))
+            elif parent == "vfio" and base != "vfio":
+                paths.append(os.path.join(self.dev_dir, "vfio", base))
         os.makedirs(self._claims_dir(), exist_ok=True)
         with open(self._claim_path(group), "w") as f:
             json.dump(sorted(paths), f)
@@ -201,9 +205,10 @@ class LocalNodeAgent(NodeAgent):
         return [p for p in self._accel_nodes() if p not in others][: count or None]
 
     def check_visible(self, node: str, device_ids: List[str], group: str = "") -> bool:
+        # Claimed paths may be accel or vfio nodes; presence on the host is
+        # what "visible" means either way (CheckGPUVisible, gpus.go:207-239).
         paths = self._group_paths(group, len(device_ids))
-        existing = set(self._accel_nodes())
-        present = [p for p in paths if p in existing]
+        present = [p for p in paths if os.path.exists(p)]
         return len(present) >= len(device_ids) and bool(device_ids)
 
     def _holders(self, dev_path: str) -> List[int]:

@@ -406,6 +406,10 @@ class ComposableResourceStatus:
     error: str = ""
     device_ids: List[str] = field(default_factory=list)
     cdi_device_id: str = ""
+    # Host-local device-node indices (/dev/accel<i>) assigned to this group.
+    # Persisted so co-located groups on one host keep disjoint nodes across
+    # controller restarts (no reference analog — one GPU per CR there).
+    chip_indices: List[int] = field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"state": self.state}
@@ -415,6 +419,8 @@ class ComposableResourceStatus:
             d["device_ids"] = list(self.device_ids)
         if self.cdi_device_id:
             d["cdi_device_id"] = self.cdi_device_id
+        if self.chip_indices:
+            d["chip_indices"] = list(self.chip_indices)
         return d
 
     @classmethod
@@ -424,6 +430,7 @@ class ComposableResourceStatus:
             error=d.get("error", ""),
             device_ids=list(d.get("device_ids", [])),
             cdi_device_id=d.get("cdi_device_id", ""),
+            chip_indices=[int(i) for i in d.get("chip_indices", [])],
         )
 
 

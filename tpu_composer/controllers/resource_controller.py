@@ -23,8 +23,9 @@ TPU-first deltas:
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
-from typing import Optional
+from typing import List, Optional
 
 from tpu_composer.agent.cdi import generate_cdi_spec
 from tpu_composer.agent.nodeagent import AgentError, DeviceBusyError, NodeAgent
@@ -81,6 +82,9 @@ class ComposableResourceReconciler(Controller):
         self.agent = agent
         self.timing = timing or ResourceTiming()
         self.recorder = recorder or EventRecorder()
+        # Serializes host-local chip-index assignment across worker threads
+        # (two groups landing on one node must get disjoint /dev/accel sets).
+        self._index_lock = threading.Lock()
         # Node deletions GC dependent resources (reference watches nodes via
         # the request controller; we react directly, :137-183).
         self.watch("Node", mapper=self._map_node_event)
@@ -196,10 +200,11 @@ class ComposableResourceReconciler(Controller):
         # Publish to workloads: CDI spec with TPU_* coordinates (:252-286's
         # TPU-native replacement).
         if is_tpu_model(res.spec.model):
+            res = self._ensure_chip_indices(res)
             spec = generate_cdi_spec(
                 slice_name=res.spec.slice_name or res.name,
                 worker_id=res.spec.worker_id,
-                chip_indices=list(range(len(attach.device_ids))),
+                chip_indices=list(res.status.chip_indices),
                 env=self._coordinate_env(res),
             )
             self.agent.refresh_device_stack(res.spec.target_node, spec=spec)
@@ -218,6 +223,34 @@ class ComposableResourceReconciler(Controller):
         self.recorder.event(res, "Normal", "Attached",
                             f"{len(res.status.device_ids)} chip(s) online on {res.spec.target_node}")
         return Result()
+
+    def _ensure_chip_indices(self, res: ComposableResource) -> ComposableResource:
+        """Assign host-local /dev/accel indices disjoint from every other
+        group on the same node, and persist them in status.
+
+        Without this, co-located groups would all publish accel0..N-1 and
+        hand containers the same physical chips (and deadlock each other's
+        drain fd-checks). Assignment is serialized in-process — safe because
+        exactly one controller instance is active (leader election)."""
+        need = len(res.status.device_ids)
+        if len(res.status.chip_indices) == need and need > 0:
+            return res
+        with self._index_lock:
+            used = {
+                i
+                for other in self.store.list(ComposableResource)
+                if other.metadata.name != res.metadata.name
+                and other.spec.target_node == res.spec.target_node
+                for i in other.status.chip_indices
+            }
+            indices: List[int] = []
+            candidate = 0
+            while len(indices) < need:
+                if candidate not in used:
+                    indices.append(candidate)
+                candidate += 1
+            res.status.chip_indices = indices
+            return self.store.update_status(res)
 
     def _cdi_name(self, res: ComposableResource) -> str:
         """The CDI publication name for a tpu group ('' for gpu compat) —
@@ -327,6 +360,7 @@ class ComposableResourceReconciler(Controller):
             self.agent.delete_device_taint(node, res.status.device_ids)
         res.status.device_ids = []
         res.status.cdi_device_id = ""
+        res.status.chip_indices = []
         res.status.error = ""
         res.status.state = RESOURCE_STATE_DELETING
         self.store.update_status(res)

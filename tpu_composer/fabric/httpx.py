@@ -1,0 +1,94 @@
+"""Shared JSON-over-HTTP transport for the remote fabric backends.
+
+The reference duplicates an http.Client + bearer-auth + JSON envelope across
+its four fabric clients (fti/cm/client.go:50-93, fti/fm/client.go:47-98,
+nec/client.go:..., sunfish/client.go:...); here it is factored once. Every
+remote provider (rest, layout, redfish) composes this transport.
+
+Semantics:
+- bearer auth from an optional TokenCache; a 401 invalidates the cached
+  token and retries exactly once (the reference refetches on expiry only —
+  retrying on 401 also heals server-side token revocation);
+- responses are parsed as JSON when non-empty; HTTP errors carry the
+  server's ``{"error": ...}`` message when present.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+from tpu_composer.fabric.provider import FabricError
+from tpu_composer.fabric.token import TokenCache
+
+
+class HttpStatusError(FabricError):
+    """Non-2xx response from the fabric endpoint."""
+
+    def __init__(self, code: int, message: str, body: Optional[Dict[str, Any]] = None):
+        super().__init__(message)
+        self.code = code
+        self.body = body or {}
+
+
+class JsonHttpClient:
+    def __init__(
+        self,
+        base_url: str,
+        token_cache: Optional[TokenCache] = None,
+        timeout: float = 60.0,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.token_cache = token_cache
+        self.timeout = timeout
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[int, Dict[str, Any]]:
+        """Returns (status_code, parsed_json_or_{}). Raises HttpStatusError on
+        4xx/5xx (other than the single retried 401) and FabricError on
+        transport failure."""
+        try:
+            return self._do(method, path, body)
+        except HttpStatusError as e:
+            if e.code == 401 and self.token_cache is not None:
+                self.token_cache.invalidate()
+                return self._do(method, path, body)
+            raise
+
+    def _do(
+        self, method: str, path: str, body: Optional[Dict[str, Any]]
+    ) -> Tuple[int, Dict[str, Any]]:
+        url = self.base_url + path
+        headers = {"Accept": "application/json"}
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        if self.token_cache is not None:
+            headers["Authorization"] = f"Bearer {self.token_cache.get()}"
+        req = urllib.request.Request(url, data=data, headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, _parse(resp.read())
+        except urllib.error.HTTPError as e:
+            payload = _parse(e.read())
+            message = payload.get("error") or f"{method} {url}: HTTP {e.code}"
+            raise HttpStatusError(e.code, message, payload) from e
+        except (urllib.error.URLError, OSError) as e:
+            raise FabricError(f"{method} {url}: {e}") from e
+
+
+def _parse(raw: bytes) -> Dict[str, Any]:
+    if not raw:
+        return {}
+    try:
+        parsed = json.loads(raw)
+    except ValueError:
+        return {}
+    return parsed if isinstance(parsed, dict) else {"items": parsed}

@@ -321,6 +321,22 @@ class InMemoryPool(FabricProvider):
             self._leaked.append(FabricDevice(device_id=dev, node=node, model=model))
             return dev
 
+    def attachment_record(self, resource_name: str) -> Optional[Dict[str, object]]:
+        """Public read of one attachment (used by the HTTP fabric fake and
+        any pool-manager frontend serving this pool over the wire)."""
+        with self._lock:
+            att = self._attachments.get(resource_name)
+            if att is None:
+                return None
+            return {
+                "resource": att.resource_name,
+                "node": att.node,
+                "model": att.model,
+                "device_ids": list(att.device_ids),
+                "cdi_device_id": att.cdi_device_id,
+                "slice": att.slice_name,
+            }
+
     def free_chips(self, model: str) -> int:
         with self._lock:
             return len(self._free.get(model, []))

@@ -1,0 +1,176 @@
+"""Redfish-style composition client — Sunfish-flavored pool managers.
+
+Reference analog: internal/cdi/sunfish/client.go, which PATCHes a Redfish
+``ComputerSystem`` with a processor request (client.go:~100) and leaves
+CheckResource/GetResources as no-ops (client.go:140-146). This backend keeps
+the Redfish nouns (Systems collection, resource blocks, Redfish
+``Status.Health`` = OK/Warning/Critical — which maps 1:1 onto our
+DeviceHealth states) but implements the full provider contract, because the
+syncer and Online-state health polling need real answers.
+
+Wire API (Redfish-style):
+    GET    /redfish/v1/Systems                        Members list
+    GET    /redfish/v1/Systems/{node}                 system + accelerators
+    PATCH  /redfish/v1/Systems/{node}                 {"Accelerators": {"Add"|"Remove": ...}}
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tpu_composer.api.types import ComposableResource
+from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient
+from tpu_composer.fabric.provider import (
+    AttachResult,
+    DeviceHealth,
+    FabricDevice,
+    FabricError,
+    FabricProvider,
+    WaitingDeviceAttaching,
+    WaitingDeviceDetaching,
+)
+from tpu_composer.fabric.token import TokenCache
+
+
+class RedfishClient(FabricProvider):
+    def __init__(
+        self,
+        endpoint: str,
+        token_cache: Optional[TokenCache] = None,
+        timeout: float = 60.0,
+    ) -> None:
+        if token_cache is None:
+            token_cache = TokenCache.from_env()
+        self._http = JsonHttpClient(
+            endpoint.rstrip("/") + "/redfish/v1", token_cache=token_cache, timeout=timeout
+        )
+
+    def add_resource(self, resource: ComposableResource) -> AttachResult:
+        name = resource.metadata.name
+        node = resource.spec.target_node
+        existing = self._find_blocks(node, name)
+        if existing:
+            return self._to_result(existing)
+        body = {
+            "Accelerators": {
+                "Add": {
+                    "Resource": name,
+                    "Model": resource.spec.model,
+                    "Count": resource.spec.chip_count,
+                    "Slice": resource.spec.slice_name,
+                    "WorkerId": resource.spec.worker_id,
+                }
+            }
+        }
+        try:
+            status, payload = self._http.request("PATCH", f"/Systems/{node}", body)
+        except HttpStatusError as e:
+            raise FabricError(f"attach {name}: {e}") from e
+        if status == 202:
+            raise WaitingDeviceAttaching(f"{name}: composition task accepted")
+        blocks = payload.get("Accelerators", [])
+        mine = [b for b in blocks if b.get("Resource") == name] or blocks
+        if not mine:
+            raise FabricError(f"attach {name}: system returned no resource blocks")
+        return self._to_result(mine)
+
+    def remove_resource(self, resource: ComposableResource) -> None:
+        name = resource.metadata.name
+        node = resource.spec.target_node
+        body = {
+            "Accelerators": {
+                "Remove": {
+                    "Resource": name,
+                    "DeviceIds": list(resource.status.device_ids),
+                }
+            }
+        }
+        try:
+            status, _ = self._http.request("PATCH", f"/Systems/{node}", body)
+        except HttpStatusError as e:
+            if e.code == 404:
+                return  # system or block gone: idempotent
+            raise FabricError(f"detach {name}: {e}") from e
+        if status == 202:
+            raise WaitingDeviceDetaching(f"{name}: decomposition task accepted")
+
+    def check_resource(self, resource: ComposableResource) -> DeviceHealth:
+        name = resource.metadata.name
+        blocks = self._find_blocks(resource.spec.target_node, name)
+        if not blocks:
+            return DeviceHealth("Critical", "not attached")
+        worst = DeviceHealth("OK")
+        rank = {"OK": 0, "Warning": 1, "Critical": 2}
+        for b in blocks:
+            state = b.get("Status", {}).get("Health", "OK")
+            if rank.get(state, 2) > rank[worst.state]:
+                worst = DeviceHealth(state, b.get("Status", {}).get("Detail", ""))
+        return worst
+
+    def get_resources(self) -> List[FabricDevice]:
+        try:
+            _, payload = self._http.request("GET", "/Systems")
+        except HttpStatusError as e:
+            raise FabricError(f"get_resources: {e}") from e
+        out: List[FabricDevice] = []
+        for member in payload.get("Members", []):
+            node = member.get("Id") or member.get("@odata.id", "").rsplit("/", 1)[-1]
+            if not node:
+                continue
+            for b in self._system_blocks(node):
+                for dev in b.get("DeviceIds", []):
+                    out.append(
+                        FabricDevice(
+                            device_id=dev,
+                            node=node,
+                            model=b.get("Model", ""),
+                            slice_name=b.get("Slice", ""),
+                            health=DeviceHealth(
+                                state=b.get("Status", {}).get("Health", "OK"),
+                                detail=b.get("Status", {}).get("Detail", ""),
+                            ),
+                        )
+                    )
+        return out
+
+    def reserve_slice(
+        self, slice_name: str, model: str, topology: str, nodes: List[str]
+    ) -> None:
+        status, _ = self._http.request(
+            "PUT",
+            f"/CompositionService/ResourceZones/{slice_name}",
+            {"Model": model, "Topology": topology, "Nodes": list(nodes)},
+        )
+        if status not in (200, 201):
+            raise FabricError(f"reserve_slice {slice_name}: HTTP {status}")
+
+    def release_slice(self, slice_name: str) -> None:
+        self._http.request(
+            "DELETE", f"/CompositionService/ResourceZones/{slice_name}"
+        )
+
+    # -- internals ---------------------------------------------------------
+    def _system_blocks(self, node: str) -> List[dict]:
+        try:
+            _, payload = self._http.request("GET", f"/Systems/{node}")
+        except HttpStatusError as e:
+            if e.code == 404:
+                return []
+            raise FabricError(f"get system {node}: {e}") from e
+        return list(payload.get("Accelerators", []))
+
+    def _find_blocks(self, node: str, resource_name: str) -> List[dict]:
+        return [
+            b for b in self._system_blocks(node) if b.get("Resource") == resource_name
+        ]
+
+    @staticmethod
+    def _to_result(blocks: List[dict]) -> AttachResult:
+        ids: List[str] = []
+        cdi = ""
+        for b in blocks:
+            ids.extend(b.get("DeviceIds", []))
+            cdi = cdi or b.get("CDIDeviceId", "")
+        if not ids:
+            raise FabricError("resource block carries no device ids")
+        return AttachResult(device_ids=ids, cdi_device_id=cdi)

@@ -1,0 +1,194 @@
+"""REST TPU pool-manager client — the primary remote fabric backend.
+
+Reference analogs: the FTI Cluster-Manager client (internal/cdi/fti/cm/
+client.go) and Fabric-Manager client (internal/cdi/fti/fm/client.go). Those
+speak a machine-resize API ("this machine now owns N+1 GPUs"); a TPU pool
+manager instead deals in *slices* (atomic ICI-connected reservations) and
+*chip-group attachments*, so the wire API here is designed around those
+nouns rather than translated:
+
+    PUT    /v1/slices/{name}            {model, topology, nodes}   reserve
+    DELETE /v1/slices/{name}                                       release
+    PUT    /v1/attachments/{resource}   {node, model, ...}         attach
+    DELETE /v1/attachments/{resource}   {device_ids: [...]}        detach
+    GET    /v1/attachments/{resource}/health                       health
+    GET    /v1/attachments                                         list all
+
+(with an optional /v1/tenants/{t}/clusters/{c} path prefix mirroring the
+reference's multi-tenant URL layout, cm/client.go:95-97).
+
+The CM/FM split survives as one flag, because it is really one semantic bit:
+- ``synchronous=False`` (CM-style, fti/cm/client.go:140-186): attach/detach
+  return 202 while the fabric works; the client raises the wait sentinels
+  and the controller requeues — completion is observed by a later idempotent
+  re-PUT (the ADD_COMPLETE re-scan, cm/client.go:445-472).
+- ``synchronous=True`` (FM-style, fti/fm/client.go:100-214): the request is
+  sent with ``?wait=true`` asking the server to complete inline, with the
+  reference FM's longer 180s timeout (fm/client.go:47).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from tpu_composer.api.types import ComposableResource
+from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient
+from tpu_composer.fabric.provider import (
+    AttachResult,
+    DeviceHealth,
+    FabricDevice,
+    FabricError,
+    FabricProvider,
+    WaitingDeviceAttaching,
+    WaitingDeviceDetaching,
+)
+from tpu_composer.fabric.token import TokenCache
+
+# Reference HTTP timeouts: CM 60s (cm/client.go:50), FM 180s (fm/client.go:47).
+CM_TIMEOUT_S = 60.0
+FM_TIMEOUT_S = 180.0
+
+
+class RestPoolClient(FabricProvider):
+    def __init__(
+        self,
+        endpoint: str,
+        tenant_id: str = "",
+        cluster_id: str = "",
+        synchronous: bool = False,
+        token_cache: Optional[TokenCache] = None,
+        timeout: Optional[float] = None,
+    ) -> None:
+        if timeout is None:
+            timeout = FM_TIMEOUT_S if synchronous else CM_TIMEOUT_S
+        if token_cache is None:
+            token_cache = TokenCache.from_env()
+        self.synchronous = synchronous
+        prefix = ""
+        if tenant_id and cluster_id:
+            prefix = f"/v1/tenants/{tenant_id}/clusters/{cluster_id}"
+        else:
+            prefix = "/v1"
+        self._http = JsonHttpClient(
+            endpoint.rstrip("/") + prefix, token_cache=token_cache, timeout=timeout
+        )
+
+    # -- slice transactions ------------------------------------------------
+    def reserve_slice(
+        self, slice_name: str, model: str, topology: str, nodes: List[str]
+    ) -> None:
+        status, _ = self._http.request(
+            "PUT",
+            f"/slices/{slice_name}",
+            {"model": model, "topology": topology, "nodes": list(nodes)},
+        )
+        if status not in (200, 201):
+            raise FabricError(f"reserve_slice {slice_name}: HTTP {status}")
+
+    def release_slice(self, slice_name: str) -> None:
+        self._http.request("DELETE", f"/slices/{slice_name}")
+
+    # -- attachment lifecycle ---------------------------------------------
+    def add_resource(self, resource: ComposableResource) -> AttachResult:
+        spec = resource.spec
+        name = resource.metadata.name
+        body: Dict[str, object] = {
+            "type": spec.type,
+            "node": spec.target_node,
+            "model": spec.model,
+            "chip_count": spec.chip_count,
+        }
+        if spec.slice_name:
+            body["slice"] = spec.slice_name
+            body["worker_id"] = spec.worker_id
+            body["topology"] = spec.topology
+        try:
+            status, payload = self._http.request(
+                "PUT", f"/attachments/{name}" + self._wait_qs(), body
+            )
+        except HttpStatusError as e:
+            raise FabricError(f"attach {name}: {e}") from e
+        if status == 202:
+            raise WaitingDeviceAttaching(
+                f"{name}: attach in progress ({payload.get('state', 'attaching')})"
+            )
+        device_ids = list(payload.get("device_ids", []))
+        cdi = payload.get("cdi_device_id", "")
+        if not device_ids:
+            raise FabricError(f"attach {name}: fabric returned no device ids")
+        return AttachResult(device_ids=device_ids, cdi_device_id=cdi)
+
+    def remove_resource(self, resource: ComposableResource) -> None:
+        name = resource.metadata.name
+        # DELETE carries the known device ids so the pool can release an
+        # orphaned attachment recorded under a different resource name (the
+        # syncer's ready-to-detach flow); the reference FM likewise sends a
+        # DELETE body naming the device (fm/client.go:250-311).
+        body = (
+            {"device_ids": list(resource.status.device_ids)}
+            if resource.status.device_ids
+            else None
+        )
+        try:
+            status, payload = self._http.request(
+                "DELETE", f"/attachments/{name}" + self._wait_qs(), body
+            )
+        except HttpStatusError as e:
+            if e.code == 404:
+                return  # unknown attachment: idempotent no-op
+            raise FabricError(f"detach {name}: {e}") from e
+        if status == 202:
+            raise WaitingDeviceDetaching(
+                f"{name}: detach in progress ({payload.get('state', 'detaching')})"
+            )
+
+    def check_resource(self, resource: ComposableResource) -> DeviceHealth:
+        name = resource.metadata.name
+        try:
+            _, payload = self._http.request("GET", f"/attachments/{name}/health")
+        except HttpStatusError as e:
+            if e.code == 404:
+                return DeviceHealth("Critical", "not attached")
+            raise FabricError(f"check {name}: {e}") from e
+        return DeviceHealth(
+            state=payload.get("state", "Critical"), detail=payload.get("detail", "")
+        )
+
+    def get_resources(self) -> List[FabricDevice]:
+        try:
+            _, payload = self._http.request("GET", "/attachments")
+        except HttpStatusError as e:
+            raise FabricError(f"get_resources: {e}") from e
+        out = []
+        for item in payload.get("attachments", []):
+            health = item.get("health", {})
+            out.append(
+                FabricDevice(
+                    device_id=item.get("device_id", ""),
+                    node=item.get("node", ""),
+                    model=item.get("model", ""),
+                    slice_name=item.get("slice", ""),
+                    health=DeviceHealth(
+                        state=health.get("state", "OK"),
+                        detail=health.get("detail", ""),
+                    ),
+                )
+            )
+        return out
+
+    def _wait_qs(self) -> str:
+        return "?wait=true" if self.synchronous else ""
+
+
+def from_env() -> RestPoolClient:
+    """Convenience constructor mirroring the adapter's env contract."""
+    endpoint = os.environ.get("FABRIC_ENDPOINT", "")
+    if not endpoint:
+        raise FabricError("FABRIC_ENDPOINT not set")
+    return RestPoolClient(
+        endpoint=endpoint,
+        tenant_id=os.environ.get("FABRIC_TENANT_ID", ""),
+        cluster_id=os.environ.get("FABRIC_CLUSTER_ID", ""),
+        synchronous=os.environ.get("FABRIC_SYNCHRONOUS", "") == "true",
+    )
