@@ -1,0 +1,20 @@
+# Operator + node-agent image (reference analog: the distroless two-stage
+# Dockerfile). One image serves both roles: the Deployment runs
+# `python -m tpu_composer`, the DaemonSet runs `python -m tpu_composer.agent.serve`.
+FROM python:3.12-slim AS build
+WORKDIR /src
+COPY native/ native/
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && make -C native \
+    && apt-get purge -y g++ make && apt-get autoremove -y \
+    && rm -rf /var/lib/apt/lists/*
+
+FROM python:3.12-slim
+WORKDIR /app
+RUN pip install --no-cache-dir pyyaml
+COPY tpu_composer/ tpu_composer/
+COPY --from=build /src/native/build/libtpunode.so native/build/libtpunode.so
+ENV PYTHONPATH=/app \
+    PYTHONUNBUFFERED=1
+USER 65532:65532
+ENTRYPOINT ["python", "-m", "tpu_composer"]

@@ -1,0 +1,49 @@
+# Build/test/deploy targets (reference analog: the kubebuilder Makefile —
+# manifests/generate/test/docker-build/deploy, Makefile:105-329).
+
+PYTHON ?= python
+IMG ?= tpu-composer:latest
+
+.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean
+
+all: native test
+
+## test: full suite on the virtual 8-device CPU mesh
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+## test-fast: stop at first failure
+test-fast:
+	$(PYTHON) -m pytest tests/ -x -q
+
+## bench: one-line JSON benchmark (attach-to-Ready p50 + slice qualification)
+bench:
+	$(PYTHON) bench.py
+
+## manifests: regenerate CRD YAML from api/types.py (controller-gen analog)
+manifests:
+	$(PYTHON) -m tpu_composer.api.crdgen deploy/crds
+
+## native: build the C++ node-agent library (libtpunode.so)
+native:
+	$(MAKE) -C native
+
+## dryrun: compile-check the single-chip entry + 8-device sharded train step
+dryrun:
+	$(PYTHON) __graft_entry__.py
+
+## run: start the operator locally against the mock fabric
+run:
+	CDI_PROVIDER_TYPE=MOCK $(PYTHON) -m tpu_composer --health-probe-bind-address=:8081
+
+## docker-build: build the operator/agent image
+docker-build:
+	docker build -t $(IMG) .
+
+## lint: syntax check every module
+lint:
+	$(PYTHON) -m compileall -q tpu_composer tests bench.py __graft_entry__.py
+
+clean:
+	rm -rf native/build
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -227,6 +227,10 @@ class _FabricHandler(BaseHTTPRequestHandler):
                     return self._send(409, {"error": str(e)})
                 return self._send(201, {"name": name})
             if method == "DELETE":
+                # Strict server behavior: unknown slice is 404 (clients must
+                # treat release as idempotent on their side).
+                if not pool.has_slice(name):
+                    return self._send(404, {"error": f"no slice {name}"})
                 pool.release_slice(name)
                 return self._send(204)
         if parts == ["attachments"] and method == "GET":
@@ -369,6 +373,8 @@ class _FabricHandler(BaseHTTPRequestHandler):
                     return self._send(409, {"error": str(e)})
                 return self._send(201, {"Id": name})
             if method == "DELETE":
+                if not pool.has_slice(name):
+                    return self._send(404, {"error": f"no zone {name}"})
                 pool.release_slice(name)
                 return self._send(204)
         self._send(404, {"error": f"no redfish route for {method} /{'/'.join(parts)}"})

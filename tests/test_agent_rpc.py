@@ -1,0 +1,137 @@
+"""Node-agent RPC seam: AgentServer (serve.py) + RemoteNodeAgent (remote.py).
+
+The controller↔node transport that replaces the reference's SPDY pod-exec
+(utils/gpus.go:1040-1067): every NodeAgent method round-trips over HTTP with
+faithful error mapping, and the resource controller runs unchanged against
+the remote client."""
+
+import os
+
+import pytest
+
+from tpu_composer.agent.cdi import generate_cdi_spec
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.agent.nodeagent import AgentError, DeviceBusyError, DriverType
+from tpu_composer.agent.remote import RemoteNodeAgent
+from tpu_composer.agent.serve import AgentServer
+from tpu_composer.fabric.inmem import InMemoryPool
+
+
+@pytest.fixture()
+def rpc():
+    """(local fake agent, remote client talking to it over HTTP)."""
+    local = FakeNodeAgent()
+    server = AgentServer(local)
+    server.start()
+    remote = RemoteNodeAgent(lambda node: server.address)
+    yield local, remote
+    server.stop()
+
+
+class TestRoundTrips:
+    def test_ensure_driver(self, rpc):
+        local, remote = rpc
+        assert remote.ensure_driver("n0") == DriverType.HOST
+        local.set_no_driver("n0")
+        with pytest.raises(AgentError):
+            remote.ensure_driver("n0")
+
+    def test_visibility_and_loads(self, rpc):
+        local, remote = rpc
+        local.set_visible("n0", ["c0", "c1"])
+        assert remote.check_visible("n0", ["c0", "c1"])
+        assert not remote.check_visible("n0", ["c0", "ghost"])
+        local.add_load("n0", "c0")
+        assert not remote.check_no_loads("n0", ["c0"])
+        assert remote.check_no_loads("n0", ["c1"])
+
+    def test_drain_busy_maps_to_device_busy_error(self, rpc):
+        local, remote = rpc
+        local.set_visible("n0", ["c0"])
+        local.add_load("n0", "c0")
+        with pytest.raises(DeviceBusyError):
+            remote.drain("n0", ["c0"])
+        remote.drain("n0", ["c0"], force=True)  # force path succeeds
+
+    def test_refresh_device_stack_publishes_spec(self, rpc):
+        local, remote = rpc
+        spec = generate_cdi_spec("s1", 0, [0, 1], env={"TPU_WORKER_ID": "0"})
+        remote.refresh_device_stack("n0", spec=spec)
+        assert local.published("n0") == ["s1-worker0"]
+        got = local.published_spec("n0", "s1-worker0")
+        assert got.device_nodes == spec.device_nodes
+        assert got.env == spec.env
+        remote.refresh_device_stack("n0", remove_name="s1-worker0")
+        assert local.published("n0") == []
+
+    def test_taints(self, rpc):
+        local, remote = rpc
+        remote.create_device_taint("n0", ["c0"], "detaching")
+        assert remote.has_device_taint("n0", "c0")
+        assert not remote.has_device_taint("n0", "c1")
+        remote.delete_device_taint("n0", ["c0"])
+        assert not remote.has_device_taint("n0", "c0")
+
+    def test_unreachable_agent_is_agent_error(self):
+        remote = RemoteNodeAgent(lambda node: "127.0.0.1:9", timeout=0.5)
+        with pytest.raises(AgentError, match="unreachable"):
+            remote.check_visible("n0", ["c0"])
+
+    def test_unresolvable_node_is_agent_error(self):
+        def resolver(node):
+            raise AgentError(f"node {node}: no agent endpoint registered")
+
+        remote = RemoteNodeAgent(resolver)
+        with pytest.raises(AgentError, match="no agent endpoint"):
+            remote.ensure_driver("nowhere")
+
+
+class TestControllerOverRpc:
+    def test_attach_detach_through_remote_agent(self, store):
+        """Resource controller state machine driven end-to-end with BOTH of
+        its seams remote-shaped: mock fabric + HTTP node agent."""
+        from tpu_composer.api import ComposableResource, ComposableResourceSpec, Node, ObjectMeta
+        from tpu_composer.api.types import (
+            RESOURCE_STATE_DELETING,
+            RESOURCE_STATE_ONLINE,
+        )
+        from tpu_composer.controllers.resource_controller import (
+            ComposableResourceReconciler,
+            ResourceTiming,
+        )
+
+        pool = InMemoryPool()
+        local = FakeNodeAgent(pool=pool)
+        server = AgentServer(local)
+        server.start()
+        try:
+            node = Node(metadata=ObjectMeta(name="worker-0"))
+            node.spec.agent_endpoint = server.address
+            node.status.tpu_slots = 8
+            store.create(node)
+            remote = RemoteNodeAgent.from_store(store)
+            rec = ComposableResourceReconciler(store, pool, remote,
+                                               timing=ResourceTiming())
+            pool.reserve_slice("s1", "tpu-v4", "2x2x1", ["worker-0"])
+            store.create(ComposableResource(
+                metadata=ObjectMeta(name="r0"),
+                spec=ComposableResourceSpec(
+                    type="tpu", model="tpu-v4", target_node="worker-0",
+                    chip_count=4, slice_name="s1", worker_id=0, topology="2x2x1",
+                ),
+            ))
+            rec.reconcile("r0")  # "" -> Attaching
+            rec.reconcile("r0")  # Attaching -> Online
+            cr = store.get(ComposableResource, "r0")
+            assert cr.status.state == RESOURCE_STATE_ONLINE
+            assert local.published("worker-0") == ["s1-worker0"]
+
+            store.delete(ComposableResource, "r0")
+            rec.reconcile("r0")  # Online -> Detaching
+            rec.reconcile("r0")  # Detaching -> Deleting (drain over HTTP)
+            cr = store.try_get(ComposableResource, "r0")
+            assert cr is None or cr.status.state == RESOURCE_STATE_DELETING
+            assert pool.attached_to("worker-0") == []
+            assert local.published("worker-0") == []
+        finally:
+            server.stop()

@@ -1,0 +1,170 @@
+"""CLI entry point wiring (reference analog: the main() wiring asserted by
+envtest suites booting a manager) + CRD manifest generation."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+from tpu_composer.api.crdgen import manifests
+from tpu_composer.cmd.main import build_manager, build_parser
+
+
+class TestBuildManager:
+    def test_mock_wiring_reaches_running(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("CDI_PROVIDER_TYPE", "MOCK")
+        monkeypatch.delenv("NODE_AGENT", raising=False)
+        from tpu_composer.fabric.adapter import reset_shared_mock
+
+        reset_shared_mock()
+        args = build_parser().parse_args([
+            "--health-probe-bind-address", "127.0.0.1:0",
+            "--state-dir", str(tmp_path / "state"),
+        ])
+        mgr = build_manager(args)
+        try:
+            from tpu_composer.api import (
+                ComposabilityRequest,
+                ComposabilityRequestSpec,
+                Node,
+                ObjectMeta,
+                ResourceDetails,
+            )
+            from tpu_composer.api.types import REQUEST_STATE_RUNNING
+
+            n = Node(metadata=ObjectMeta(name="worker-0"))
+            n.status.tpu_slots = 4
+            mgr.store.create(n)
+            mgr.start(workers_per_controller=2)
+
+            port = mgr.health_port
+            assert urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz").status == 200
+            assert urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz").status == 200
+
+            mgr.store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name="cli-req"),
+                spec=ComposabilityRequestSpec(
+                    resource=ResourceDetails(type="tpu", model="tpu-v4", size=4)),
+            ))
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if (mgr.store.get(ComposabilityRequest, "cli-req").status.state
+                        == REQUEST_STATE_RUNNING):
+                    break
+                time.sleep(0.05)
+            assert (mgr.store.get(ComposabilityRequest, "cli-req").status.state
+                    == REQUEST_STATE_RUNNING)
+
+            metrics = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics").read().decode()
+            assert "reconcile" in metrics
+        finally:
+            mgr.stop()
+
+    def test_webhooks_enabled_by_default(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("CDI_PROVIDER_TYPE", "MOCK")
+        monkeypatch.delenv("ENABLE_WEBHOOKS", raising=False)
+        from tpu_composer.admission.validating import AdmissionDenied
+        from tpu_composer.api import (
+            ComposabilityRequest,
+            ComposabilityRequestSpec,
+            ObjectMeta,
+            ResourceDetails,
+        )
+        from tpu_composer.fabric.adapter import reset_shared_mock
+
+        reset_shared_mock()
+        args = build_parser().parse_args(["--health-probe-bind-address", ""])
+        mgr = build_manager(args)
+        bad = ComposabilityRequest(
+            metadata=ObjectMeta(name="bad"),
+            spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                type="tpu", model="tpu-v4", size=1,
+                allocation_policy="differentnode", target_node="worker-0")),
+        )
+        with pytest.raises(AdmissionDenied):
+            mgr.store.create(bad)
+
+    def test_remote_agent_requires_endpoints(self, monkeypatch):
+        monkeypatch.setenv("CDI_PROVIDER_TYPE", "MOCK")
+        monkeypatch.setenv("NODE_AGENT", "REMOTE")
+        from tpu_composer.agent.remote import RemoteNodeAgent
+        from tpu_composer.fabric.adapter import reset_shared_mock
+
+        reset_shared_mock()
+        args = build_parser().parse_args(["--health-probe-bind-address", ""])
+        mgr = build_manager(args)
+        # The resource controller got a RemoteNodeAgent wired to the store.
+        agents = [c.agent for c in mgr._controllers if hasattr(c, "agent")]
+        assert any(isinstance(a, RemoteNodeAgent) for a in agents)
+
+
+class TestCliProcess:
+    def test_process_starts_serves_health_and_exits_on_sigterm(self, tmp_path):
+        env = dict(os.environ, CDI_PROVIDER_TYPE="MOCK", PYTHONPATH="/root/repo")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_composer",
+             "--health-probe-bind-address", "127.0.0.1:18347",
+             "--state-dir", str(tmp_path / "state")],
+            env=env, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            deadline = time.monotonic() + 15
+            up = False
+            while time.monotonic() < deadline:
+                try:
+                    up = urllib.request.urlopen(
+                        "http://127.0.0.1:18347/healthz", timeout=1).status == 200
+                    if up:
+                        break
+                except OSError:
+                    time.sleep(0.1)
+            assert up, "healthz never came up"
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=15) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+
+class TestCrdGen:
+    def test_manifests_shape(self):
+        docs = manifests()
+        assert set(docs) == {
+            "tpu.composer.dev_composabilityrequests.yaml",
+            "tpu.composer.dev_composableresources.yaml",
+        }
+        req = docs["tpu.composer.dev_composabilityrequests.yaml"]
+        assert req["spec"]["scope"] == "Cluster"
+        version = req["spec"]["versions"][0]
+        assert version["subresources"] == {"status": {}}
+        schema = version["schema"]["openAPIV3Schema"]
+        resource = schema["properties"]["spec"]["properties"]["resource"]
+        assert resource["required"] == ["type", "model", "size"]
+        assert "tpu" in resource["properties"]["type"]["enum"]
+
+    def test_generated_files_match_types(self, tmp_path):
+        from tpu_composer.api.crdgen import write_manifests
+
+        paths = write_manifests(str(tmp_path))
+        assert len(paths) == 2
+        for p in paths:
+            with open(p) as f:
+                doc = yaml.safe_load(f)
+            assert doc["apiVersion"] == "apiextensions.k8s.io/v1"
+
+    def test_checked_in_manifests_are_current(self):
+        """deploy/crds must match what crdgen produces (drift gate —
+        the `make manifests` discipline)."""
+        for fn, doc in manifests().items():
+            path = os.path.join("/root/repo/deploy/crds", fn)
+            with open(path) as f:
+                on_disk = yaml.safe_load(f)
+            assert on_disk == doc, f"{fn} is stale; run: make manifests"

@@ -122,6 +122,24 @@ class TestRestClient:
         client.reserve_slice("s0", "tpu-v4", "2x2x2", nodes)
         client.release_slice("s0")
 
+    def test_release_unknown_slice_is_idempotent(self, server):
+        """The request controller releases slices unconditionally during
+        cleanup; a strict pool manager answers 404 for an unknown slice and
+        that must read as a no-op, not an error."""
+        RestPoolClient(server.url, token_cache=None).release_slice("never-existed")
+        layout_client(server).release_slice("never-existed")
+        RedfishClient(server.url, token_cache=None).release_slice("never-existed")
+
+    def test_unknown_health_state_ranks_critical(self, server):
+        from tpu_composer.fabric.provider import DeviceHealth
+
+        client = RestPoolClient(server.url, token_cache=None)
+        res = make_resource()
+        result = client.add_resource(res)
+        server.pool.set_health(result.device_ids[0], DeviceHealth("Degraded", "odd"))
+        health = client.check_resource(res)
+        assert not health.healthy  # non-standard state must not read healthy
+
     def test_detach_orphan_by_device_id(self, server):
         """The syncer's ready-to-detach flow: DELETE names device ids only."""
         leaked = server.pool.leak_attachment("worker-3", "tpu-v4")
@@ -247,6 +265,19 @@ class TestRedfishClient:
         client.remove_resource(res)
         assert client.get_resources() == []
         assert client.check_resource(res).state == "Critical"
+
+    def test_colocated_groups_keep_their_own_device_ids(self, server):
+        """Attach of group B on a system already hosting group A must never
+        return A's devices (the unlabeled-blocks aggregation hazard)."""
+        client = RedfishClient(server.url, token_cache=None)
+        res_a = make_resource(name="blk-a", count=2)
+        res_b = make_resource(name="blk-b", count=2)
+        ids_a = set(client.add_resource(res_a).device_ids)
+        ids_b = set(client.add_resource(res_b).device_ids)
+        assert ids_a and ids_b and not (ids_a & ids_b)
+        # Idempotent re-reads stay scoped to the right group too.
+        assert set(client.add_resource(res_a).device_ids) == ids_a
+        assert set(client.add_resource(res_b).device_ids) == ids_b
 
     def test_health_aggregation(self, server):
         client = RedfishClient(server.url, token_cache=None)

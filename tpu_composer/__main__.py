@@ -1,0 +1,3 @@
+from tpu_composer.cmd.main import main
+
+raise SystemExit(main())

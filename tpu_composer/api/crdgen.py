@@ -1,0 +1,247 @@
+"""CRD manifest generation — keeps deploy/crds in sync with api/types.py.
+
+Reference analog: the controller-gen-produced OpenAPI schemas under
+config/crd/bases (generated from kubebuilder markers in
+api/v1alpha1/*_types.go). Our schemas are built programmatically from the
+same constants the Python types validate against, so the YAML can never
+drift from the code: ``python -m tpu_composer.api.crdgen deploy/crds``
+regenerates (the ``make manifests`` analog, Makefile:162).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List
+
+from tpu_composer.api.types import (
+    ALLOCATION_POLICIES,
+    DEVICE_TYPES,
+)
+
+GROUP = "tpu.composer.dev"
+VERSION = "v1alpha1"
+
+
+def _str(desc: str = "", enum: List[str] = None, min_length: int = 0) -> Dict:
+    s: Dict = {"type": "string"}
+    if desc:
+        s["description"] = desc
+    if enum:
+        s["enum"] = list(enum)
+    if min_length:
+        s["minLength"] = min_length
+    return s
+
+
+def _int(desc: str = "", minimum: int = None) -> Dict:
+    s: Dict = {"type": "integer"}
+    if desc:
+        s["description"] = desc
+    if minimum is not None:
+        s["minimum"] = minimum
+    return s
+
+
+def _bool(desc: str = "") -> Dict:
+    s: Dict = {"type": "boolean"}
+    if desc:
+        s["description"] = desc
+    return s
+
+
+def _obj(props: Dict, required: List[str] = None, desc: str = "") -> Dict:
+    s: Dict = {"type": "object", "properties": props}
+    if required:
+        s["required"] = list(required)
+    if desc:
+        s["description"] = desc
+    return s
+
+
+def _array(items: Dict, desc: str = "") -> Dict:
+    s: Dict = {"type": "array", "items": items}
+    if desc:
+        s["description"] = desc
+    return s
+
+
+_OTHER_SPEC = _obj(
+    {
+        "milli_cpu": _int(minimum=0),
+        "memory": _int(minimum=0),
+        "ephemeral_storage": _int(minimum=0),
+        "allowed_pod_number": _int(minimum=0),
+    },
+    desc="Node capacity the allocation must leave available "
+    "(reference: composabilityrequest_types.go:55-64).",
+)
+
+_RESOURCE_DETAILS = _obj(
+    {
+        "type": _str("Device type", enum=list(DEVICE_TYPES)),
+        "model": _str("Device model, e.g. tpu-v4", min_length=1),
+        "size": _int("Chip count; must solve to a valid slice topology", minimum=1),
+        "force_detach": _bool("Skip load checks on detach"),
+        "allocation_policy": _str(enum=list(ALLOCATION_POLICIES)),
+        "target_node": _str("Pin the allocation to one node (samenode only)"),
+        "topology": _str("Explicit slice shape, e.g. 2x2x2 (else solved from size)"),
+        "other_spec": _OTHER_SPEC,
+    },
+    required=["type", "model", "size"],
+)
+
+_RESOURCE_STATUS = _obj(
+    {
+        "state": _str(),
+        "node_name": _str(),
+        "device_ids": _array(_str()),
+        "cdi_device_id": _str(),
+        "worker_id": _int(),
+        "error": _str(),
+    }
+)
+
+_SLICE_STATUS = _obj(
+    {
+        "name": _str(),
+        "topology": _str(),
+        "num_hosts": _int(),
+        "chips_per_host": _int(),
+        "nodes": _array(_str(), "Hosts in worker order"),
+    },
+    desc="Authoritative record of the composed slice; the mutating webhook "
+    "derives TPU_* coordinates from this (admission/coordinates.py).",
+)
+
+COMPOSABILITY_REQUEST_SCHEMA = _obj(
+    {
+        "apiVersion": _str(),
+        "kind": _str(),
+        "metadata": {"type": "object"},
+        "spec": _obj({"resource": _RESOURCE_DETAILS}, required=["resource"]),
+        "status": _obj(
+            {
+                "state": _str(),
+                "error": _str(),
+                "resources": {
+                    "type": "object",
+                    "additionalProperties": _RESOURCE_STATUS,
+                },
+                "slice": _SLICE_STATUS,
+                "scalar_resource": _RESOURCE_DETAILS,
+                "first_ready_time": _str(),
+            }
+        ),
+    }
+)
+
+COMPOSABLE_RESOURCE_SCHEMA = _obj(
+    {
+        "apiVersion": _str(),
+        "kind": _str(),
+        "metadata": {"type": "object"},
+        "spec": _obj(
+            {
+                "type": _str(enum=list(DEVICE_TYPES)),
+                "model": _str(min_length=1),
+                "target_node": _str(min_length=1),
+                "force_detach": _bool(),
+                "chip_count": _int(minimum=1),
+                "slice_name": _str(),
+                "worker_id": _int(minimum=0),
+                "topology": _str(),
+            },
+            required=["type", "model", "target_node"],
+        ),
+        "status": _obj(
+            {
+                "state": _str(),
+                "error": _str(),
+                "device_ids": _array(_str()),
+                "cdi_device_id": _str(),
+                "chip_indices": _array(_int()),
+            }
+        ),
+    }
+)
+
+
+def crd(kind: str, plural: str, singular: str, short: List[str], schema: Dict) -> Dict:
+    """Cluster-scoped CRD with status subresource + printer columns
+    (reference: cluster-scoped markers, composabilityrequest_types.go:82-84)."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "scope": "Cluster",
+            "names": {
+                "kind": kind,
+                "listKind": f"{kind}List",
+                "plural": plural,
+                "singular": singular,
+                "shortNames": short,
+            },
+            "versions": [
+                {
+                    "name": VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {
+                            "name": "State",
+                            "type": "string",
+                            "jsonPath": ".status.state",
+                        },
+                        {
+                            "name": "Age",
+                            "type": "date",
+                            "jsonPath": ".metadata.creationTimestamp",
+                        },
+                    ],
+                    "schema": {"openAPIV3Schema": schema},
+                }
+            ],
+        },
+    }
+
+
+def manifests() -> Dict[str, Dict]:
+    return {
+        f"{GROUP}_composabilityrequests.yaml": crd(
+            "ComposabilityRequest",
+            "composabilityrequests",
+            "composabilityrequest",
+            ["creq"],
+            COMPOSABILITY_REQUEST_SCHEMA,
+        ),
+        f"{GROUP}_composableresources.yaml": crd(
+            "ComposableResource",
+            "composableresources",
+            "composableresource",
+            ["cres"],
+            COMPOSABLE_RESOURCE_SCHEMA,
+        ),
+    }
+
+
+def write_manifests(outdir: str) -> List[str]:
+    import yaml
+
+    os.makedirs(outdir, exist_ok=True)
+    paths = []
+    for fn, doc in manifests().items():
+        path = os.path.join(outdir, fn)
+        with open(path, "w") as f:
+            yaml.safe_dump(doc, f, sort_keys=False)
+        paths.append(path)
+    return paths
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "deploy/crds"
+    for p in write_manifests(out):
+        print(p)

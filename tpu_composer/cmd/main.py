@@ -1,0 +1,182 @@
+"""Operator process entry point.
+
+Reference analog: cmd/main.go — flag parsing (:62-82), logger setup (:84),
+manager construction with metrics/health endpoints and leader election
+(:137-155), controller + webhook wiring (:167-201), healthz/readyz (:205-212),
+and the blocking Start with signal handling (:214-218).
+
+Env contract (reference analog: composableresource_adapter.go:43-70 +
+SURVEY.md §5 "Config / flag system"):
+
+  CDI_PROVIDER_TYPE   MOCK | REST_CM | REST_FM | LAYOUT | REDFISH
+  FABRIC_ENDPOINT     base URL for remote providers
+  FABRIC_TENANT_ID / FABRIC_CLUSTER_ID     multi-tenant path scoping
+  FABRIC_AUTH_URL / FABRIC_USERNAME / FABRIC_PASSWORD /
+  FABRIC_CREDENTIALS_FILE                  OAuth2 password-grant auth
+  NODE_AGENT          FAKE | LOCAL (default FAKE under MOCK, LOCAL otherwise)
+  ENABLE_WEBHOOKS     "false" disables in-process admission (cmd/main.go:196)
+  TPUC_STATE_DIR      object-store persistence directory
+
+Run: ``python -m tpu_composer [flags]`` or ``python -m tpu_composer.cmd.main``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+from typing import List, Optional
+
+from tpu_composer.admission.validating import register_validating_webhooks
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.agent.nodeagent import LocalNodeAgent, NodeAgent
+from tpu_composer.controllers import (
+    ComposabilityRequestReconciler,
+    ComposableResourceReconciler,
+    UpstreamSyncer,
+)
+from tpu_composer.fabric.adapter import new_fabric_provider
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.store import Store
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-composer",
+        description="TPU-native composable-resource operator",
+    )
+    # Reference flags (cmd/main.go:68-81); one HTTP server carries health,
+    # readiness and Prometheus metrics.
+    p.add_argument(
+        "--health-probe-bind-address",
+        default=":8081",
+        help="host:port for /healthz, /readyz and /metrics (empty to disable)",
+    )
+    p.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="enable leader election (file-lock based) before starting controllers",
+    )
+    p.add_argument(
+        "--leader-lock-path",
+        default=None,
+        help="leader lock file (default under TPUC_RUN_DIR)",
+    )
+    p.add_argument(
+        "--state-dir",
+        default=os.environ.get("TPUC_STATE_DIR", ""),
+        help="persist API objects under this directory (empty: in-memory only)",
+    )
+    p.add_argument(
+        "--workers",
+        type=int,
+        default=2,
+        help="reconcile worker threads per controller",
+    )
+    p.add_argument(
+        "--sync-period",
+        type=float,
+        default=60.0,
+        help="upstream fabric anti-drift sync period, seconds (reference: 60)",
+    )
+    p.add_argument(
+        "--sync-grace",
+        type=float,
+        default=600.0,
+        help="grace before orphaned fabric devices are force-detached (reference: 600)",
+    )
+    p.add_argument("--log-level", default="info",
+                   choices=["debug", "info", "warning", "error"])
+    return p
+
+
+def pick_node_agent(store: Optional[Store] = None) -> NodeAgent:
+    kind = os.environ.get("NODE_AGENT", "").upper()
+    if not kind:
+        provider = os.environ.get("CDI_PROVIDER_TYPE", "MOCK").upper()
+        kind = "FAKE" if provider == "MOCK" else "LOCAL"
+    if kind == "LOCAL":
+        return LocalNodeAgent()
+    if kind == "REMOTE":
+        # Cluster mode: route to each node's agent DaemonSet pod via
+        # Node.spec.agent_endpoint (deploy/node-agent.yaml).
+        from tpu_composer.agent.remote import RemoteNodeAgent
+
+        if store is None:
+            raise SystemExit("NODE_AGENT=REMOTE requires the store")
+        return RemoteNodeAgent.from_store(store)
+    if kind == "FAKE":
+        # Wired to the mock pool when that is the provider, so visibility
+        # follows attachment in single-box/bench runs.
+        provider = new_fabric_provider()
+        from tpu_composer.fabric.inmem import InMemoryPool
+
+        pool = provider if isinstance(provider, InMemoryPool) else None
+        return FakeNodeAgent(pool=pool)
+    raise SystemExit(f"unknown NODE_AGENT {kind!r} (want FAKE or LOCAL)")
+
+
+def build_manager(args: argparse.Namespace) -> Manager:
+    store = Store(persist_dir=args.state_dir or None)
+    fabric = new_fabric_provider()
+    agent = pick_node_agent(store)
+
+    addr = args.health_probe_bind_address or None
+    if addr and addr.startswith(":"):
+        addr = "0.0.0.0" + addr
+    mgr = Manager(
+        store=store,
+        leader_elect=args.leader_elect,
+        leader_lock_path=args.leader_lock_path,
+        health_addr=addr,
+    )
+    mgr.add_controller(ComposabilityRequestReconciler(store, fabric,
+                                                      recorder=mgr.recorder))
+    mgr.add_controller(ComposableResourceReconciler(store, fabric, agent,
+                                                    recorder=mgr.recorder))
+    mgr.add_runnable(UpstreamSyncer(store, fabric, period=args.sync_period,
+                                    grace=args.sync_grace,
+                                    recorder=mgr.recorder))
+    if os.environ.get("ENABLE_WEBHOOKS", "").lower() != "false":
+        register_validating_webhooks(store)
+    return mgr
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+        stream=sys.stderr,
+    )
+    log = logging.getLogger("setup")
+
+    mgr = build_manager(args)
+
+    stopping = []
+
+    def handle_signal(signum, frame):
+        if stopping:
+            return
+        stopping.append(signum)
+        log.info("received signal %s, shutting down", signum)
+        mgr.stop()
+
+    signal.signal(signal.SIGTERM, handle_signal)
+    signal.signal(signal.SIGINT, handle_signal)
+
+    log.info(
+        "starting manager (provider=%s, health=%s, leader_elect=%s)",
+        os.environ.get("CDI_PROVIDER_TYPE", "MOCK"),
+        args.health_probe_bind_address or "disabled",
+        args.leader_elect,
+    )
+    mgr.start(workers_per_controller=args.workers)
+    mgr.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

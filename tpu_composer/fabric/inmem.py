@@ -273,7 +273,8 @@ class InMemoryPool(FabricProvider):
             rank = {"OK": 0, "Warning": 1, "Critical": 2}
             for d in att.device_ids:
                 h = self._health.get(d)
-                if h is not None and rank[h.state] > rank[worst.state]:
+                # Unknown states rank as Critical rather than crashing.
+                if h is not None and rank.get(h.state, 2) > rank.get(worst.state, 2):
                     worst = h
             return worst
 
@@ -336,6 +337,10 @@ class InMemoryPool(FabricProvider):
                 "cdi_device_id": att.cdi_device_id,
                 "slice": att.slice_name,
             }
+
+    def has_slice(self, slice_name: str) -> bool:
+        with self._lock:
+            return slice_name in self._slices
 
     def free_chips(self, model: str) -> int:
         with self._lock:

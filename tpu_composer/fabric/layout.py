@@ -30,10 +30,9 @@ from typing import List, Optional
 
 from tpu_composer.api.types import ComposableResource
 from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient
+from tpu_composer.fabric.poolapi import PoolApiMixin
 from tpu_composer.fabric.provider import (
     AttachResult,
-    DeviceHealth,
-    FabricDevice,
     FabricError,
     FabricProvider,
     WaitingDeviceAttaching,
@@ -49,7 +48,7 @@ POLL_ATTEMPTS = 6
 CODE_APPLY_IN_PROGRESS = "APPLY_IN_PROGRESS"
 
 
-class LayoutApplyClient(FabricProvider):
+class LayoutApplyClient(PoolApiMixin, FabricProvider):
     def __init__(
         self,
         endpoint: str,
@@ -105,51 +104,7 @@ class LayoutApplyClient(FabricProvider):
         apply_id = self._submit_apply(body, WaitingDeviceDetaching)
         self._poll_apply(apply_id, name, WaitingDeviceDetaching)
 
-    def check_resource(self, resource: ComposableResource) -> DeviceHealth:
-        name = resource.metadata.name
-        try:
-            _, payload = self._http.request("GET", f"/attachments/{name}/health")
-        except HttpStatusError as e:
-            if e.code == 404:
-                return DeviceHealth("Critical", "not attached")
-            raise FabricError(f"check {name}: {e}") from e
-        return DeviceHealth(
-            state=payload.get("state", "Critical"), detail=payload.get("detail", "")
-        )
-
-    def get_resources(self) -> List[FabricDevice]:
-        try:
-            _, payload = self._http.request("GET", "/attachments")
-        except HttpStatusError as e:
-            raise FabricError(f"get_resources: {e}") from e
-        return [
-            FabricDevice(
-                device_id=item.get("device_id", ""),
-                node=item.get("node", ""),
-                model=item.get("model", ""),
-                slice_name=item.get("slice", ""),
-                health=DeviceHealth(
-                    state=item.get("health", {}).get("state", "OK"),
-                    detail=item.get("health", {}).get("detail", ""),
-                ),
-            )
-            for item in payload.get("attachments", [])
-        ]
-
-    # -- slice transactions (same wire shape as the REST backend) ----------
-    def reserve_slice(
-        self, slice_name: str, model: str, topology: str, nodes: List[str]
-    ) -> None:
-        status, _ = self._http.request(
-            "PUT",
-            f"/slices/{slice_name}",
-            {"model": model, "topology": topology, "nodes": list(nodes)},
-        )
-        if status not in (200, 201):
-            raise FabricError(f"reserve_slice {slice_name}: HTTP {status}")
-
-    def release_slice(self, slice_name: str) -> None:
-        self._http.request("DELETE", f"/slices/{slice_name}")
+    # (slices, health, listing come from PoolApiMixin — same /v1 wire shape)
 
     # -- internals ---------------------------------------------------------
     def _get_attachment(self, name: str) -> Optional[AttachResult]:

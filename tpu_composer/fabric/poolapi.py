@@ -1,0 +1,71 @@
+"""Shared pool-API operations for backends speaking the /v1 wire shape.
+
+rest.py and layout.py differ only in how an attach/detach *mutation* travels
+(direct PUT/DELETE vs layout-apply procedures); slices, health and the
+attachment listing are byte-identical wire calls. They live here once so the
+dialects cannot drift.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tpu_composer.api.types import ComposableResource
+from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient
+from tpu_composer.fabric.provider import DeviceHealth, FabricDevice, FabricError
+
+
+class PoolApiMixin:
+    """Requires ``self._http: JsonHttpClient`` rooted at the /v1 prefix."""
+
+    _http: JsonHttpClient
+
+    def reserve_slice(
+        self, slice_name: str, model: str, topology: str, nodes: List[str]
+    ) -> None:
+        status, _ = self._http.request(
+            "PUT",
+            f"/slices/{slice_name}",
+            {"model": model, "topology": topology, "nodes": list(nodes)},
+        )
+        if status not in (200, 201):
+            raise FabricError(f"reserve_slice {slice_name}: HTTP {status}")
+
+    def release_slice(self, slice_name: str) -> None:
+        try:
+            self._http.request("DELETE", f"/slices/{slice_name}")
+        except HttpStatusError as e:
+            if e.code == 404:
+                return  # unknown slice: idempotent no-op (InMemoryPool parity)
+            raise
+
+    def check_resource(self, resource: ComposableResource) -> DeviceHealth:
+        name = resource.metadata.name
+        try:
+            _, payload = self._http.request("GET", f"/attachments/{name}/health")
+        except HttpStatusError as e:
+            if e.code == 404:
+                return DeviceHealth("Critical", "not attached")
+            raise FabricError(f"check {name}: {e}") from e
+        return DeviceHealth(
+            state=payload.get("state", "Critical"), detail=payload.get("detail", "")
+        )
+
+    def get_resources(self) -> List[FabricDevice]:
+        try:
+            _, payload = self._http.request("GET", "/attachments")
+        except HttpStatusError as e:
+            raise FabricError(f"get_resources: {e}") from e
+        return [
+            FabricDevice(
+                device_id=item.get("device_id", ""),
+                node=item.get("node", ""),
+                model=item.get("model", ""),
+                slice_name=item.get("slice", ""),
+                health=DeviceHealth(
+                    state=item.get("health", {}).get("state", "OK"),
+                    detail=item.get("health", {}).get("detail", ""),
+                ),
+            )
+            for item in payload.get("attachments", [])
+        ]

@@ -68,10 +68,17 @@ class RedfishClient(FabricProvider):
             raise FabricError(f"attach {name}: {e}") from e
         if status == 202:
             raise WaitingDeviceAttaching(f"{name}: composition task accepted")
-        blocks = payload.get("Accelerators", [])
-        mine = [b for b in blocks if b.get("Resource") == name] or blocks
+        # Only blocks labeled with OUR resource name count — aggregating
+        # unlabeled blocks could hand us a co-located group's devices. If the
+        # PATCH response omits labels, re-read the system record.
+        mine = [b for b in payload.get("Accelerators", [])
+                if b.get("Resource") == name]
         if not mine:
-            raise FabricError(f"attach {name}: system returned no resource blocks")
+            mine = self._find_blocks(node, name)
+        if not mine:
+            raise FabricError(
+                f"attach {name}: system reports no resource block for it"
+            )
         return self._to_result(mine)
 
     def remove_resource(self, resource: ComposableResource) -> None:
@@ -103,7 +110,10 @@ class RedfishClient(FabricProvider):
         rank = {"OK": 0, "Warning": 1, "Critical": 2}
         for b in blocks:
             state = b.get("Status", {}).get("Health", "OK")
-            if rank.get(state, 2) > rank[worst.state]:
+            # Unknown Redfish health states rank as Critical (rank.get
+            # default on BOTH sides: a non-standard state must neither crash
+            # nor read as healthy).
+            if rank.get(state, 2) > rank.get(worst.state, 2):
                 worst = DeviceHealth(state, b.get("Status", {}).get("Detail", ""))
         return worst
 
@@ -145,9 +155,14 @@ class RedfishClient(FabricProvider):
             raise FabricError(f"reserve_slice {slice_name}: HTTP {status}")
 
     def release_slice(self, slice_name: str) -> None:
-        self._http.request(
-            "DELETE", f"/CompositionService/ResourceZones/{slice_name}"
-        )
+        try:
+            self._http.request(
+                "DELETE", f"/CompositionService/ResourceZones/{slice_name}"
+            )
+        except HttpStatusError as e:
+            if e.code == 404:
+                return  # unknown zone: idempotent no-op
+            raise
 
     # -- internals ---------------------------------------------------------
     def _system_blocks(self, node: str) -> List[dict]:

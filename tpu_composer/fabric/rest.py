@@ -34,10 +34,9 @@ from typing import Dict, List, Optional
 
 from tpu_composer.api.types import ComposableResource
 from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient
+from tpu_composer.fabric.poolapi import PoolApiMixin
 from tpu_composer.fabric.provider import (
     AttachResult,
-    DeviceHealth,
-    FabricDevice,
     FabricError,
     FabricProvider,
     WaitingDeviceAttaching,
@@ -50,7 +49,7 @@ CM_TIMEOUT_S = 60.0
 FM_TIMEOUT_S = 180.0
 
 
-class RestPoolClient(FabricProvider):
+class RestPoolClient(PoolApiMixin, FabricProvider):
     def __init__(
         self,
         endpoint: str,
@@ -74,20 +73,7 @@ class RestPoolClient(FabricProvider):
             endpoint.rstrip("/") + prefix, token_cache=token_cache, timeout=timeout
         )
 
-    # -- slice transactions ------------------------------------------------
-    def reserve_slice(
-        self, slice_name: str, model: str, topology: str, nodes: List[str]
-    ) -> None:
-        status, _ = self._http.request(
-            "PUT",
-            f"/slices/{slice_name}",
-            {"model": model, "topology": topology, "nodes": list(nodes)},
-        )
-        if status not in (200, 201):
-            raise FabricError(f"reserve_slice {slice_name}: HTTP {status}")
-
-    def release_slice(self, slice_name: str) -> None:
-        self._http.request("DELETE", f"/slices/{slice_name}")
+    # (slices, health, listing come from PoolApiMixin)
 
     # -- attachment lifecycle ---------------------------------------------
     def add_resource(self, resource: ComposableResource) -> AttachResult:
@@ -142,40 +128,6 @@ class RestPoolClient(FabricProvider):
             raise WaitingDeviceDetaching(
                 f"{name}: detach in progress ({payload.get('state', 'detaching')})"
             )
-
-    def check_resource(self, resource: ComposableResource) -> DeviceHealth:
-        name = resource.metadata.name
-        try:
-            _, payload = self._http.request("GET", f"/attachments/{name}/health")
-        except HttpStatusError as e:
-            if e.code == 404:
-                return DeviceHealth("Critical", "not attached")
-            raise FabricError(f"check {name}: {e}") from e
-        return DeviceHealth(
-            state=payload.get("state", "Critical"), detail=payload.get("detail", "")
-        )
-
-    def get_resources(self) -> List[FabricDevice]:
-        try:
-            _, payload = self._http.request("GET", "/attachments")
-        except HttpStatusError as e:
-            raise FabricError(f"get_resources: {e}") from e
-        out = []
-        for item in payload.get("attachments", []):
-            health = item.get("health", {})
-            out.append(
-                FabricDevice(
-                    device_id=item.get("device_id", ""),
-                    node=item.get("node", ""),
-                    model=item.get("model", ""),
-                    slice_name=item.get("slice", ""),
-                    health=DeviceHealth(
-                        state=health.get("state", "OK"),
-                        detail=health.get("detail", ""),
-                    ),
-                )
-            )
-        return out
 
     def _wait_qs(self) -> str:
         return "?wait=true" if self.synchronous else ""

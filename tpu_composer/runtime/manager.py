@@ -58,7 +58,10 @@ class Manager:
         leader_lock_path: Optional[str] = None,
         health_addr: Optional[str] = None,  # "host:port" or None to disable
     ) -> None:
-        self.store = store or Store()
+        # `is not None`, not `or`: an EMPTY store is falsy (Store.__len__),
+        # and silently swapping in a fresh one would orphan the caller's
+        # admission hooks and persistence settings.
+        self.store = store if store is not None else Store()
         self.recorder = EventRecorder()
         self.log = logging.getLogger("manager")
         self._controllers: List[Controller] = []
