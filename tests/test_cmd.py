@@ -108,7 +108,8 @@ class TestBuildManager:
 
 class TestCliProcess:
     def test_process_starts_serves_health_and_exits_on_sigterm(self, tmp_path):
-        env = dict(os.environ, CDI_PROVIDER_TYPE="MOCK", PYTHONPATH="/root/repo")
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, CDI_PROVIDER_TYPE="MOCK", PYTHONPATH=repo_root)
         proc = subprocess.Popen(
             [sys.executable, "-m", "tpu_composer",
              "--health-probe-bind-address", "127.0.0.1:18347",

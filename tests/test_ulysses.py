@@ -1,0 +1,91 @@
+"""Ulysses all-to-all sequence parallelism vs full attention."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from tpu_composer.ops.attention import flash_attention, mha_reference
+from tpu_composer.parallel.ulysses import ulysses_attention
+
+
+def qkv(b=2, s=32, h=8, d=16, key=0):
+    ks = jax.random.split(jax.random.key(key), 3)
+    shape = (b, s, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_reference(causal):
+    q, k, v = qkv()
+    want = mha_reference(q, k, v, causal=causal)
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:4]).reshape(4), ("sp",))
+    spec = P(None, "sp", None, None)
+    got = jax.jit(
+        shard_map(
+            functools.partial(ulysses_attention, axis_name="sp", causal=causal),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_matches_ring_attention():
+    from tpu_composer.parallel.ring_attention import ring_attention
+
+    q, k, v = qkv(key=1)
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:4]).reshape(4), ("sp",))
+    spec = P(None, "sp", None, None)
+
+    def run(fn):
+        return jax.jit(
+            shard_map(
+                functools.partial(fn, axis_name="sp", causal=True),
+                mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+                check_vma=False,
+            )
+        )(q, k, v)
+
+    np.testing.assert_allclose(
+        np.asarray(run(ulysses_attention)),
+        np.asarray(run(ring_attention)),
+        atol=1e-5,
+    )
+
+
+def test_flash_kernel_inside_ulysses():
+    """The Pallas flash kernel is a drop-in local attention for Ulysses."""
+    q, k, v = qkv(s=64, key=2)
+    want = mha_reference(q, k, v, causal=True)
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:2]).reshape(2), ("sp",))
+    spec = P(None, "sp", None, None)
+    got = jax.jit(
+        shard_map(
+            functools.partial(
+                ulysses_attention, axis_name="sp", causal=True,
+                attn_fn=functools.partial(flash_attention, block_q=32, block_k=32),
+            ),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_head_divisibility_error():
+    q, k, v = qkv(h=6)
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:4]).reshape(4), ("sp",))
+    spec = P(None, "sp", None, None)
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_map(
+            functools.partial(ulysses_attention, axis_name="sp"),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+        )(q, k, v)

@@ -34,14 +34,25 @@ class RemoteNodeAgent(NodeAgent):
         self.timeout = timeout
 
     @classmethod
-    def from_store(cls, store, timeout: float = 30.0) -> "RemoteNodeAgent":
+    def from_store(
+        cls,
+        store,
+        timeout: float = 30.0,
+        endpoint_template: str = "",
+    ) -> "RemoteNodeAgent":
+        """Resolve endpoints from ``Node.spec.agent_endpoint``, falling back
+        to ``endpoint_template`` (e.g. ``{node}:9444``, the node-agent
+        DaemonSet's hostPort) for nodes that never registered one —
+        NODE_AGENT_ENDPOINT_TEMPLATE in deploy/manager.yaml."""
         from tpu_composer.api.types import Node
 
         def resolver(node: str) -> str:
             obj = store.try_get(Node, node)
-            if obj is None or not obj.spec.agent_endpoint:
-                raise AgentError(f"node {node}: no agent endpoint registered")
-            return obj.spec.agent_endpoint
+            if obj is not None and obj.spec.agent_endpoint:
+                return obj.spec.agent_endpoint
+            if endpoint_template:
+                return endpoint_template.format(node=node)
+            raise AgentError(f"node {node}: no agent endpoint registered")
 
         return cls(resolver, timeout=timeout)
 

@@ -101,12 +101,19 @@ def pick_node_agent(store: Optional[Store] = None) -> NodeAgent:
         return LocalNodeAgent()
     if kind == "REMOTE":
         # Cluster mode: route to each node's agent DaemonSet pod via
-        # Node.spec.agent_endpoint (deploy/node-agent.yaml).
+        # Node.spec.agent_endpoint, with NODE_AGENT_ENDPOINT_TEMPLATE as
+        # the fallback for nodes that never registered one
+        # (deploy/node-agent.yaml hostPort).
         from tpu_composer.agent.remote import RemoteNodeAgent
 
         if store is None:
             raise SystemExit("NODE_AGENT=REMOTE requires the store")
-        return RemoteNodeAgent.from_store(store)
+        return RemoteNodeAgent.from_store(
+            store,
+            endpoint_template=os.environ.get(
+                "NODE_AGENT_ENDPOINT_TEMPLATE", "{node}:9444"
+            ),
+        )
     if kind == "FAKE":
         # Wired to the mock pool when that is the provider, so visibility
         # follows attachment in single-box/bench runs.

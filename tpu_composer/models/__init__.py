@@ -1,5 +1,6 @@
-"""Model zoo: the flagship decoder-only transformer used as the
-slice-acceptance workload and benchmark subject."""
+"""Model zoo: the flagship decoder-only transformer (dense) and its
+mixture-of-experts sibling, used as the slice-acceptance workloads and
+benchmark subjects."""
 
 from tpu_composer.models.transformer import (
     ModelConfig,
@@ -8,5 +9,13 @@ from tpu_composer.models.transformer import (
     loss_fn,
     param_specs,
 )
+from tpu_composer.models.moe import MoEConfig
 
-__all__ = ["ModelConfig", "forward", "init_params", "loss_fn", "param_specs"]
+__all__ = [
+    "ModelConfig",
+    "MoEConfig",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "param_specs",
+]

@@ -1,0 +1,265 @@
+"""Mixture-of-Experts transformer — the expert-parallel ('ep') model family.
+
+GShard/Switch-style MoE built for GSPMD: routing, dispatch and combine are
+dense einsums over a static capacity dimension, so the whole layer is
+fixed-shape and XLA inserts the expert all-to-all on real meshes (experts
+sharded over 'ep', tokens sharded over ('dp','ep')). No data-dependent
+control flow — overflowed tokens are dropped by masking, the standard
+capacity-factor trade.
+
+Layout (see param_specs):
+  - expert weights (E, D, F): E over 'ep', F over 'tp' — each device holds
+    E/ep experts' tp-shard;
+  - attention/dense layers identical to models.transformer, tp-sharded;
+  - router weights replicated (tiny).
+
+No reference analog: the reference operator contains no ML-framework code
+(SURVEY.md §2 "no parallelism strategies"); this is first-class here per the
+build spec (models/ + parallel/ are the JAX workload layer the composed TPU
+slices exist to serve).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpu_composer.models.transformer import (
+    AttnFn,
+    ModelConfig,
+    _rmsnorm,
+    _select_attn,
+    attention_block,
+    swiglu_ffn,
+)
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    """Flagship MoE variant. Dense-layer fields mirror ModelConfig."""
+
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 1408
+    max_seq: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "reference"
+    rope_theta: float = 10000.0
+
+    n_experts: int = 8
+    top_k: int = 2  # 1 (Switch) or 2 (GShard)
+    capacity_factor: float = 1.25
+    moe_period: int = 2  # every moe_period-th layer is MoE (1 = all)
+    router_aux_weight: float = 1e-2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def is_moe_layer(self, i: int) -> bool:
+        return i % self.moe_period == self.moe_period - 1
+
+    def capacity(self, seq: int) -> int:
+        """Per-expert token slots for one batch row (the routing group)."""
+        cap = int(self.capacity_factor * seq * self.top_k / self.n_experts)
+        return max(cap, self.top_k)
+
+    def dense(self) -> ModelConfig:
+        """The equivalent dense config (attention/embed dims match)."""
+        return ModelConfig(
+            vocab_size=self.vocab_size, d_model=self.d_model,
+            n_layers=self.n_layers, n_heads=self.n_heads, d_ff=self.d_ff,
+            max_seq=self.max_seq, dtype=self.dtype, attn_impl=self.attn_impl,
+            rope_theta=self.rope_theta,
+        )
+
+
+def init_params(config: MoEConfig, key) -> Dict:
+    c = config
+    k_embed, k_layers = jax.random.split(key)
+    init = jax.nn.initializers.normal(stddev=0.02)
+
+    def dense(k, shape):
+        return init(k, shape, jnp.float32).astype(c.dtype)
+
+    layers = []
+    for i, lk in enumerate(jax.random.split(k_layers, c.n_layers)):
+        k1, k2, k3, k4, k5, k6 = jax.random.split(lk, 6)
+        layer = {
+            "ln1": jnp.ones((c.d_model,), jnp.float32),
+            "wqkv": dense(k1, (c.d_model, 3, c.n_heads, c.head_dim)),
+            "wo": dense(k2, (c.n_heads, c.head_dim, c.d_model)),
+            "ln2": jnp.ones((c.d_model,), jnp.float32),
+        }
+        if c.is_moe_layer(i):
+            layer.update({
+                # Router in fp32: tiny, and gating noise in bf16 visibly
+                # degrades load balance.
+                "w_router": init(k6, (c.d_model, c.n_experts), jnp.float32),
+                "w_gate": dense(k3, (c.n_experts, c.d_model, c.d_ff)),
+                "w_up": dense(k4, (c.n_experts, c.d_model, c.d_ff)),
+                "w_down": dense(k5, (c.n_experts, c.d_ff, c.d_model)),
+            })
+        else:
+            layer.update({
+                "w_gate": dense(k3, (c.d_model, c.d_ff)),
+                "w_up": dense(k4, (c.d_model, c.d_ff)),
+                "w_down": dense(k5, (c.d_ff, c.d_model)),
+            })
+        layers.append(layer)
+    return {
+        "embed": dense(k_embed, (c.vocab_size, c.d_model)),
+        "layers": layers,
+        "ln_f": jnp.ones((c.d_model,), jnp.float32),
+    }
+
+
+def param_specs(config: MoEConfig) -> Dict:
+    """PartitionSpec pytree: 'ep' shards the expert dim, 'tp' heads/ffn."""
+    c = config
+    layers = []
+    for i in range(c.n_layers):
+        layer = {
+            "ln1": P(),
+            "wqkv": P(None, None, "tp", None),
+            "wo": P("tp", None, None),
+            "ln2": P(),
+        }
+        if c.is_moe_layer(i):
+            layer.update({
+                "w_router": P(),
+                "w_gate": P("ep", None, "tp"),
+                "w_up": P("ep", None, "tp"),
+                "w_down": P("ep", "tp", None),
+            })
+        else:
+            layer.update({
+                "w_gate": P(None, "tp"),
+                "w_up": P(None, "tp"),
+                "w_down": P("tp", None),
+            })
+        layers.append(layer)
+    return {"embed": P("tp", None), "layers": layers, "ln_f": P()}
+
+
+def _top_k_routing(
+    logits: jax.Array, top_k: int, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dense dispatch/combine tensors from router logits.
+
+    logits: (B, S, E) fp32. Returns (dispatch (B,S,E,C) bool-ish float,
+    combine (B,S,E,C) fp32, aux_loss scalar). Each batch row is a routing
+    group; slot positions are first-come-first-served in sequence order and
+    tokens past the capacity are dropped (their combine weight is zero, so
+    the residual stream just passes them through).
+    """
+    b, s, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)  # (B,S,E)
+
+    gates = []  # [(gate (B,S), expert-mask (B,S,E))]
+    masked = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)  # (B,S)
+        mask = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        gate = jnp.sum(probs * mask, axis=-1)
+        gates.append((gate, mask))
+        masked = masked * (1.0 - mask)
+
+    # Normalize the chosen gates so they sum to 1 per token.
+    denom = sum(g for g, _ in gates) + 1e-9
+    gates = [(g / denom, m) for g, m in gates]
+
+    # Slot assignment: cumulative count of earlier claims on the same expert,
+    # k-th choices queue behind all (k-1)-th choices (GShard's ordering).
+    dispatch = jnp.zeros((b, s, e, capacity), jnp.float32)
+    combine = jnp.zeros((b, s, e, capacity), jnp.float32)
+    claimed = jnp.zeros((b, 1, e), jnp.float32)  # running per-expert count
+    for gate, mask in gates:
+        pos = jnp.cumsum(mask, axis=1) - mask + claimed  # (B,S,E)
+        claimed = claimed + jnp.sum(mask, axis=1, keepdims=True)
+        in_cap = (pos < capacity).astype(jnp.float32) * mask
+        slot = jax.nn.one_hot(
+            jnp.sum(pos * mask, axis=-1).astype(jnp.int32), capacity,
+            dtype=jnp.float32,
+        )  # (B,S,C)
+        dispatch = dispatch + in_cap[..., None] * slot[:, :, None, :]
+        combine = combine + (gate[..., None] * in_cap)[..., None] * slot[:, :, None, :]
+
+    # Switch-style load-balancing loss: E * <tokens-fraction * prob-mass>.
+    top1_mask = gates[0][1]
+    frac = jnp.mean(top1_mask, axis=1)  # (B,E) fraction routed (top-1)
+    pmass = jnp.mean(probs, axis=1)  # (B,E) mean router prob
+    aux = e * jnp.mean(jnp.sum(frac * pmass, axis=-1))
+    return dispatch, combine, aux
+
+
+def _moe_ffn(x: jax.Array, layer: Dict, config: MoEConfig) -> Tuple[jax.Array, jax.Array]:
+    """x: (B, S, D) -> (out (B,S,D), aux scalar). SwiGLU experts."""
+    c = config
+    b, s, _ = x.shape
+    cap = c.capacity(s)
+    logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), layer["w_router"])
+    dispatch, combine, aux = _top_k_routing(logits, c.top_k, cap)
+
+    # Dispatch: (B,S,E,C) x (B,S,D) -> (E, B, C, D). On a real mesh B is
+    # sharded over (dp,ep) and E over ep — GSPMD lowers this einsum to the
+    # expert all-to-all.
+    xin = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(c.dtype), x)
+    gate = jax.nn.silu(
+        jnp.einsum("ebcd,edf->ebcf", xin, layer["w_gate"]).astype(jnp.float32)
+    )
+    up = jnp.einsum("ebcd,edf->ebcf", xin, layer["w_up"]).astype(jnp.float32)
+    xout = jnp.einsum("ebcf,efd->ebcd", (gate * up).astype(c.dtype), layer["w_down"])
+    out = jnp.einsum("bsec,ebcd->bsd", combine.astype(c.dtype), xout)
+    return out, aux
+
+
+def forward(
+    params: Dict,
+    tokens: jax.Array,
+    config: MoEConfig,
+    attn_fn: Optional[AttnFn] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (logits (B,S,V) fp32, aux_loss scalar)."""
+    c = config
+    attn = _select_attn(c, attn_fn)  # type: ignore[arg-type]
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+    aux_total = jnp.zeros((), jnp.float32)
+    for i, layer in enumerate(params["layers"]):
+        x = attention_block(layer, x, positions, c, attn)
+        h = _rmsnorm(x, layer["ln2"])
+        if c.is_moe_layer(i):
+            delta, aux = _moe_ffn(h, layer, c)
+            x = x + delta
+            aux_total = aux_total + aux
+        else:
+            x = x + swiglu_ffn(h, layer, c.dtype)
+
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
+    n_moe = sum(1 for i in range(c.n_layers) if c.is_moe_layer(i))
+    return logits, aux_total / max(n_moe, 1)
+
+
+def loss_fn(
+    params: Dict,
+    tokens: jax.Array,
+    config: MoEConfig,
+    attn_fn: Optional[AttnFn] = None,
+) -> jax.Array:
+    """Next-token CE + router load-balancing aux."""
+    logits, aux = forward(params, tokens, config, attn_fn)
+    logits = logits[:, :-1]
+    targets = tokens[:, 1:]
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold) + config.router_aux_weight * aux

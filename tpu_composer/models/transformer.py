@@ -119,6 +119,47 @@ def _select_attn(config: ModelConfig, attn_fn: Optional[AttnFn]) -> AttnFn:
     return mha_reference
 
 
+def attention_block(
+    layer: Dict,
+    x: jax.Array,  # (B, S, D)
+    positions: jax.Array,  # (B, S)
+    config,  # ModelConfig or MoEConfig (needs .dtype/.rope_theta)
+    attn: AttnFn,
+) -> jax.Array:
+    """Pre-RMSNorm causal attention with residual — the half of the block
+    shared by the dense and MoE model families."""
+    c = config
+    h = _rmsnorm(x, layer["ln1"])
+    qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"])
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    q = _rope(q, positions, c.rope_theta)
+    k = _rope(k, positions, c.rope_theta)
+    o = attn(q, k, v, causal=True)
+    return x + jnp.einsum("bshk,hkd->bsd", o.astype(c.dtype), layer["wo"])
+
+
+def swiglu_ffn(h: jax.Array, layer: Dict, dtype) -> jax.Array:
+    """Dense SwiGLU MLP (no residual): silu(h@w_gate) * (h@w_up) @ w_down."""
+    gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]).astype(jnp.float32))
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"]).astype(jnp.float32)
+    return jnp.einsum("bsf,fd->bsd", (gate * up).astype(dtype), layer["w_down"])
+
+
+def block_forward(
+    layer: Dict,
+    x: jax.Array,  # (B, S, D)
+    positions: jax.Array,  # (B, S)
+    config: ModelConfig,
+    attn: AttnFn,
+) -> jax.Array:
+    """One transformer block (attention + SwiGLU MLP, pre-RMSNorm residual).
+    Factored out so the pipeline-parallel path can lax.scan it over a stacked
+    stage of layers (parallel/pipeline.py)."""
+    x = attention_block(layer, x, positions, config, attn)
+    h = _rmsnorm(x, layer["ln2"])
+    return x + swiglu_ffn(h, layer, config.dtype)
+
+
 def forward(
     params: Dict,
     tokens: jax.Array,  # (B, S) int32
@@ -134,18 +175,7 @@ def forward(
 
     x = jnp.take(params["embed"], tokens, axis=0)  # (B, S, D)
     for layer in params["layers"]:
-        h = _rmsnorm(x, layer["ln1"])
-        qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"])
-        q, k, v = qkv[0], qkv[1], qkv[2]
-        q = _rope(q, positions, c.rope_theta)
-        k = _rope(k, positions, c.rope_theta)
-        o = attn(q, k, v, causal=True)
-        x = x + jnp.einsum("bshk,hkd->bsd", o.astype(c.dtype), layer["wo"])
-
-        h = _rmsnorm(x, layer["ln2"])
-        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]).astype(jnp.float32))
-        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"]).astype(jnp.float32)
-        x = x + jnp.einsum("bsf,fd->bsd", (gate * up).astype(c.dtype), layer["w_down"])
+        x = block_forward(layer, x, positions, c, attn)
 
     x = _rmsnorm(x, params["ln_f"])
     # Tied output head (embed^T), fp32 logits for a stable softmax.

@@ -17,6 +17,15 @@ from tpu_composer.parallel.collectives import (
     ring_shift,
 )
 from tpu_composer.parallel.ring_attention import ring_attention
+from tpu_composer.parallel.ulysses import ulysses_attention
+from tpu_composer.parallel.pipeline import (
+    pipeline_apply,
+    pipelined_forward,
+    pipelined_loss_fn,
+    stack_layers,
+    stacked_layer_specs,
+    transformer_stage_fn,
+)
 from tpu_composer.parallel.train import TrainConfig, make_train_state, make_train_step
 
 __all__ = [
@@ -28,6 +37,13 @@ __all__ = [
     "reduce_scatter",
     "ring_shift",
     "ring_attention",
+    "ulysses_attention",
+    "pipeline_apply",
+    "pipelined_forward",
+    "pipelined_loss_fn",
+    "stack_layers",
+    "stacked_layer_specs",
+    "transformer_stage_fn",
     "TrainConfig",
     "make_train_state",
     "make_train_step",

@@ -14,16 +14,26 @@ def solve_mesh_axes(
     dp: int = 0,
     sp: int = 0,
     tp: int = 0,
+    pp: int = 0,
+    ep: int = 0,
 ) -> Dict[str, int]:
-    """Factor `n_devices` into (dp, sp, tp) axis sizes.
+    """Factor `n_devices` into named parallelism axis sizes.
 
-    Fixed (nonzero) degrees are honored; free axes absorb the remainder with
-    preference order tp ≤ 8 (keep tensor-parallel groups inside one ICI
-    neighborhood), then sp, then dp takes what's left. Raises if the fixed
-    degrees don't divide the device count.
+    Always solves (dp, sp, tp); pipeline (pp) and expert (ep) axes join the
+    mesh only when explicitly requested (nonzero) — they are workload
+    choices, not something to infer from a device count. Fixed (nonzero)
+    degrees are honored; free axes absorb the remainder with preference
+    order tp ≤ 8 (keep tensor-parallel groups inside one ICI neighborhood),
+    then sp, then dp takes what's left. Raises if the fixed degrees don't
+    divide the device count.
+
+    Axis order in the returned dict (== mesh order) is dp, ep, pp, sp, tp:
+    the fastest-varying (innermost, best-ICI-adjacency) axis is tp, then sp
+    — the axes whose collectives are per-layer — while dp/ep/pp tolerate the
+    longer hops.
     """
     remaining = n_devices
-    for name, v in (("dp", dp), ("sp", sp), ("tp", tp)):
+    for name, v in (("dp", dp), ("ep", ep), ("pp", pp), ("sp", sp), ("tp", tp)):
         if v:
             if remaining % v != 0:
                 raise ValueError(
@@ -43,11 +53,19 @@ def solve_mesh_axes(
     if dp == 0:
         dp = remaining
         remaining = 1
-    if dp * sp * tp != n_devices:
+    total = dp * max(ep, 1) * max(pp, 1) * sp * tp
+    if total != n_devices:
         raise ValueError(
-            f"dp*sp*tp = {dp}*{sp}*{tp} != device count {n_devices}"
+            f"axis product {total} != device count {n_devices}"
         )
-    return {"dp": dp, "sp": sp, "tp": tp}
+    axes = {"dp": dp}
+    if ep:
+        axes["ep"] = ep
+    if pp:
+        axes["pp"] = pp
+    axes["sp"] = sp
+    axes["tp"] = tp
+    return axes
 
 
 def make_mesh(

@@ -1,0 +1,231 @@
+"""Pipeline parallelism — GPipe microbatch schedule over the 'pp' mesh axis.
+
+The transformer's blocks are split into `pp` stages; each device holds
+n_layers/pp layers with the stage dimension sharded over 'pp'
+(P('pp', ...) on the stacked-layer pytree). The schedule runs inside a
+partial-manual ``shard_map`` (``axis_names={'pp'}``) so the stage handoff is
+an explicit ``ppermute`` hop over ICI while dp/sp/tp stay under GSPMD —
+einsums inside a stage still get their tensor-parallel collectives inserted
+by XLA.
+
+Schedule: plain GPipe. M microbatches flow through P stages over M+P-1
+ticks; each tick every device applies its stage to its current buffer and
+ppermutes the activation to the next stage. The first P-1 ticks per device
+are bubble (computed on garbage and discarded), the standard GPipe
+efficiency M/(M+P-1). The whole loop is a ``lax.scan``, so it is one XLA
+computation and reverse-mode differentiation runs the reverse schedule
+automatically (ppermute transposes to the opposite shift).
+
+No reference analog: SURVEY.md §2 records the reference has no parallelism
+code of any kind; pipeline parallelism is first-class here per the build
+spec.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_composer.models.transformer import (
+    AttnFn,
+    ModelConfig,
+    _rmsnorm,
+    _select_attn,
+    block_forward,
+)
+
+# stage_fn(stage_params, x) -> x: applies this device's layers. stage_params
+# carries a leading layers-per-stage axis.
+StageFn = Callable[[Dict, jax.Array], jax.Array]
+
+
+def stack_layers(layers: List[Dict]) -> Dict:
+    """[{w: (..)}, ...] -> {w: (L, ..)} — the stage axis the mesh shards."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def stacked_layer_specs(
+    layer_spec: Dict, axis_name: str = "pp", mesh: Optional[Mesh] = None
+) -> Dict:
+    """Prepend the stage axis to a single layer's PartitionSpec pytree.
+    When `mesh` is given, axis names the mesh lacks are dropped (so tp-aware
+    specs also work on a pp-only mesh)."""
+
+    def adapt(spec: P) -> P:
+        dims = tuple(
+            d if mesh is None or d is None or d in mesh.shape else None
+            for d in spec
+        )
+        return P(axis_name, *dims)
+
+    return jax.tree.map(adapt, layer_spec, is_leaf=lambda x: isinstance(x, P))
+
+
+def transformer_stage_fn(
+    config: ModelConfig,
+    attn_fn: Optional[AttnFn] = None,
+    seq_axis: Optional[str] = None,
+) -> StageFn:
+    """Stage = lax.scan of the dense transformer block over stacked layers.
+
+    `seq_axis`: when the sequence dimension is *manually* sharded over that
+    mesh axis (pipeline + sequence parallelism share one manual region —
+    shardy cannot nest a second manual axis set inside the 'pp' one), RoPE
+    positions are offset to this shard's global range and `attn_fn` must be
+    a raw collective attention (ring/ulysses) over the same axis."""
+    attn = _select_attn(config, attn_fn)
+
+    def stage(stacked: Dict, x: jax.Array) -> jax.Array:
+        b, s = x.shape[0], x.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        if seq_axis is not None:
+            positions = positions + lax.axis_index(seq_axis) * s
+
+        def body(h, layer):
+            return block_forward(layer, h, positions, config, attn), None
+
+        x, _ = lax.scan(body, x, stacked)
+        return x
+
+    return stage
+
+
+def _pipeline_local(
+    stage_fn: StageFn, axis_name: str, stacked: Dict, x: jax.Array
+) -> jax.Array:
+    """Per-device GPipe loop. x: (M, mb...) microbatches, replicated over
+    the pp axis; returns the same shape with every microbatch fully
+    processed (broadcast back from the last stage)."""
+    n_stages = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = x.shape[0]
+
+    buf0 = jnp.zeros(x.shape[1:], x.dtype)
+    out0 = jnp.zeros_like(x)
+
+    def tick(carry, t):
+        buf, out = carry
+        # Stage 0 pulls microbatch t from the input (clamped past the end —
+        # those ticks produce garbage that drains after the loop ends and is
+        # never written to `out`).
+        inject = lax.dynamic_index_in_dim(
+            x, jnp.minimum(t, n_micro - 1), 0, keepdims=False
+        )
+        cur = jnp.where(idx == 0, inject, buf)
+        y = stage_fn(stacked, cur)
+        # The last stage finishes microbatch t-(P-1) at tick t.
+        out_idx = t - (n_stages - 1)
+        safe = jnp.maximum(out_idx, 0)
+        prev = lax.dynamic_index_in_dim(out, safe, 0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(out_idx >= 0, y, prev), safe, 0
+        )
+        # Hand the activation to the next stage (non-circular: stage 0
+        # receives zeros, which `inject` overwrites).
+        nxt = lax.ppermute(
+            y, axis_name, [(i, i + 1) for i in range(n_stages - 1)]
+        )
+        return (nxt, out), None
+
+    (_, out), _ = lax.scan(tick, (buf0, out0), jnp.arange(n_micro + n_stages - 1))
+    # Only the last stage holds real outputs — broadcast them to every stage
+    # (masked psum; ppermute can't do one-to-many) so the replicated-over-pp
+    # head can run anywhere.
+    return lax.psum(
+        jnp.where(idx == n_stages - 1, out, jnp.zeros_like(out)), axis_name
+    )
+
+
+def pipeline_apply(
+    stage_fn: StageFn,
+    stacked: Dict,
+    x: jax.Array,  # (B, S, D) or any (B, ...) activation
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = "pp",
+    seq_axis: Optional[str] = None,
+) -> jax.Array:
+    """Run `x` through the pipelined stack. `stacked` must already be laid
+    out with its leading (stage) axis sharded over `axis_name`; dp/tp
+    shardings on `x` pass through untouched (auto axes). With `seq_axis`
+    set, the sequence dimension (dim 1 of x) joins the manual region too
+    and stage_fn is responsible for its collectives (see
+    transformer_stage_fn)."""
+    n_stages = mesh.shape[axis_name]
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError(f"batch {batch} not divisible by {n_microbatches} microbatches")
+    if n_stages > 1:
+        # Interleave so every microbatch carries an equal share of each data
+        # shard (batch is laid out dp-major by the caller's sharding).
+        mb = batch // n_microbatches
+        xm = x.reshape(mb, n_microbatches, *x.shape[1:]).swapaxes(0, 1)
+        manual = {axis_name} | ({seq_axis} if seq_axis else set())
+        # xm is (M, mb, S, ...): sequence is dim 2 here (dim 1 of x).
+        x_spec = (
+            P(None, None, seq_axis) if seq_axis else P()
+        )
+        inner = shard_map(
+            functools.partial(_pipeline_local, stage_fn, axis_name),
+            mesh=mesh,
+            axis_names=manual,
+            in_specs=(jax.tree.map(lambda _: P(axis_name), stacked), x_spec),
+            out_specs=x_spec,
+            check_vma=False,
+        )
+        ym = inner(stacked, xm)
+        return ym.swapaxes(0, 1).reshape(x.shape[0], *ym.shape[2:])
+    # pp=1: no pipeline — apply the whole stack directly.
+    return stage_fn(stacked, x)
+
+
+def pipelined_forward(
+    params: Dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = "pp",
+    attn_fn: Optional[AttnFn] = None,
+    seq_axis: Optional[str] = None,
+) -> jax.Array:
+    """Dense-transformer forward with the block stack pipelined over `pp`.
+
+    Embedding, final norm and the tied head run replicated over pp (they are
+    a small fraction of the FLOPs); params['layers'] must be the *stacked*
+    pytree (see stack_layers). `seq_axis`/`attn_fn`: manual sequence
+    parallelism inside the stages (attn_fn must then be a raw ring/ulysses
+    collective over that axis)."""
+    c = config
+    x = jnp.take(params["embed"], tokens, axis=0)
+    x = pipeline_apply(
+        transformer_stage_fn(c, attn_fn, seq_axis=seq_axis), params["layers"],
+        x, mesh, n_microbatches, axis_name, seq_axis=seq_axis,
+    )
+    x = _rmsnorm(x, params["ln_f"])
+    return jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
+
+
+def pipelined_loss_fn(
+    params: Dict,
+    tokens: jax.Array,
+    config: ModelConfig,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = "pp",
+    attn_fn: Optional[AttnFn] = None,
+    seq_axis: Optional[str] = None,
+) -> jax.Array:
+    logits = pipelined_forward(
+        params, tokens, config, mesh, n_microbatches, axis_name, attn_fn,
+        seq_axis,
+    )[:, :-1]
+    targets = tokens[:, 1:]
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

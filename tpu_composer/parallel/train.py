@@ -1,12 +1,18 @@
-"""Sharded training step over a (dp, sp, tp) mesh.
+"""Sharded training step over a (dp[, ep][, pp], sp, tp) mesh.
 
 The idiomatic JAX/TPU recipe (scaling-book style): params carry
-NamedShardings from models.param_specs (tp shards heads/ffn), the batch is
-sharded (dp over batch, sp over sequence), the whole step — forward, loss,
-grads, AdamW update — is one jit, and XLA/GSPMD inserts the ICI collectives.
-Sequence parallelism is explicit where it matters: attention runs as
-ring_attention inside shard_map over the 'sp' axis, so K/V only ever live
-1/sp per device (long-context path).
+NamedShardings from the model's param_specs (tp shards heads/ffn, ep shards
+experts), the batch is sharded (dp — and ep for MoE — over batch, sp over
+sequence), the whole step — forward, loss, grads, AdamW update — is one jit,
+and XLA/GSPMD inserts the ICI collectives. The explicitly-scheduled paths
+sit in shard_map islands:
+
+- sequence parallelism: attention runs as ring_attention (ppermute ring) or
+  ulysses_attention (all-to-all head scatter) partial-manual over 'sp', so
+  K/V only ever live 1/sp per device (long-context path);
+- pipeline parallelism: the block stack runs the GPipe microbatch schedule
+  partial-manual over 'pp' (parallel/pipeline.py) while dp/sp/tp stay under
+  GSPMD inside each stage.
 
 This is the full training step that ``__graft_entry__.dryrun_multichip``
 compiles over an N-device mesh.
@@ -16,7 +22,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -24,22 +30,37 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from tpu_composer.models.transformer import (
-    ModelConfig,
-    init_params,
-    loss_fn,
-    param_specs,
+from tpu_composer.models import moe as moe_mod
+from tpu_composer.models import transformer as dense_mod
+from tpu_composer.models.moe import MoEConfig
+from tpu_composer.models.transformer import ModelConfig
+from tpu_composer.parallel.pipeline import (
+    pipelined_loss_fn,
+    stack_layers,
+    stacked_layer_specs,
 )
 from tpu_composer.parallel.ring_attention import ring_attention
+from tpu_composer.parallel.ulysses import ulysses_attention
 
 
 @dataclass(frozen=True)
 class TrainConfig:
-    model: ModelConfig = ModelConfig()
+    model: Union[ModelConfig, MoEConfig] = ModelConfig()
     learning_rate: float = 3e-4
     weight_decay: float = 0.01
-    # Ring attention kicks in when the mesh's sp axis is > 1.
-    use_ring_attention: bool = True
+    # Sequence parallelism kicks in when the mesh's sp axis is > 1.
+    use_ring_attention: bool = True  # False = replicate K/V (gather) instead
+    sp_impl: str = "ring"  # ring | ulysses
+    # GPipe over the 'pp' mesh axis when > 0 and the mesh has pp > 1
+    # (dense model only; microbatches must divide the global batch).
+    pipeline_microbatches: int = 0
+
+    @property
+    def is_moe(self) -> bool:
+        return isinstance(self.model, MoEConfig)
+
+    def _model_mod(self):
+        return moe_mod if self.is_moe else dense_mod
 
 
 def _optimizer(tc: TrainConfig):
@@ -52,12 +73,41 @@ def _shard_pytree(tree, specs, mesh: Mesh):
     )
 
 
+def _pipelined(tc: TrainConfig, mesh: Optional[Mesh]) -> bool:
+    if tc.pipeline_microbatches <= 0 or mesh is None:
+        return False
+    if mesh.shape.get("pp", 1) <= 1:
+        return False
+    if tc.is_moe:
+        raise ValueError("pipeline parallelism currently supports the dense model only")
+    return True
+
+
+def _param_specs(tc: TrainConfig, mesh: Mesh):
+    specs = tc._model_mod().param_specs(tc.model)
+    if _pipelined(tc, mesh):
+        specs = {
+            "embed": specs["embed"],
+            "layers": stacked_layer_specs(specs["layers"][0], mesh=mesh),
+            "ln_f": specs["ln_f"],
+        }
+    return specs
+
+
 def make_train_state(tc: TrainConfig, key, mesh: Optional[Mesh] = None) -> Dict:
-    """{'params': ..., 'opt': ...}, sharded over the mesh when given."""
-    params = init_params(tc.model, key)
+    """{'params': ..., 'opt': ...}, sharded over the mesh when given. With
+    pipelining enabled the layer list is stacked on a leading stage axis
+    sharded over 'pp'."""
+    params = tc._model_mod().init_params(tc.model, key)
+    if _pipelined(tc, mesh):
+        params = {
+            "embed": params["embed"],
+            "layers": stack_layers(params["layers"]),
+            "ln_f": params["ln_f"],
+        }
     opt_state = _optimizer(tc).init(params)
     if mesh is not None:
-        specs = param_specs(tc.model)
+        specs = _param_specs(tc, mesh)
         params = _shard_pytree(params, specs, mesh)
 
         # Adam moments mirror the param layout; scalar counts replicate.
@@ -72,18 +122,27 @@ def make_train_state(tc: TrainConfig, key, mesh: Optional[Mesh] = None) -> Dict:
     return {"params": params, "opt": opt_state}
 
 
-def _ring_attn_fn(mesh: Mesh):
-    spec = P("dp", "sp", "tp", None)  # (B, S, H, D)
+def _sp_attn_fn(mesh: Mesh, impl: str):
+    """Sequence-parallel attention as a partial-manual shard_map over 'sp'
+    only — dp/ep/tp shardings flow through under GSPMD, so the same wrapper
+    serves the plain, MoE, and pipelined (nested inside 'pp'-manual) paths."""
+    spec = P(None, "sp", None, None)  # (B, S, H, D)
+    inner = ring_attention if impl == "ring" else ulysses_attention
 
-    @functools.partial(
-        shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
-    )
-    def attn(q, k, v):
-        return ring_attention(q, k, v, axis_name="sp", causal=True)
+    def body(q, k, v):
+        return inner(q, k, v, axis_name="sp", causal=True)
 
     def wrapped(q, k, v, causal=True):
-        assert causal, "ring attention path is causal-only here"
+        assert causal, "sequence-parallel attention path is causal-only here"
+        # Inside another partial-manual region (the 'pp' GPipe stage) the
+        # trace carries an abstract context mesh; shard_map must then bind
+        # to it rather than the concrete mesh it was built with.
+        ctx = jax.sharding.get_abstract_mesh()
+        use_mesh = None if (ctx is not None and not ctx.empty) else mesh
+        attn = shard_map(
+            body, mesh=use_mesh, axis_names={"sp"},
+            in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
+        )
         return attn(q, k, v)
 
     return wrapped
@@ -92,22 +151,41 @@ def _ring_attn_fn(mesh: Mesh):
 def make_train_step(tc: TrainConfig, mesh: Mesh):
     """Returns (step_fn, batch_sharding). step_fn: (state, tokens) ->
     (state, metrics) — jitted with explicit output shardings."""
+    if tc.sp_impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sp_impl {tc.sp_impl!r}")
     opt = _optimizer(tc)
-    use_ring = tc.use_ring_attention and mesh.shape.get("sp", 1) > 1
-    attn_fn = _ring_attn_fn(mesh) if use_ring else None
+    use_sp = tc.use_ring_attention and mesh.shape.get("sp", 1) > 1
+    sp_inner = ring_attention if tc.sp_impl == "ring" else ulysses_attention
 
-    batch_sharding = NamedSharding(mesh, P("dp", None))
+    # MoE batches shard over both data axes (ep doubles as a data axis for
+    # the non-expert params); dense batches shard over dp alone.
+    batch_axes = ("dp", "ep") if tc.is_moe and mesh.shape.get("ep", 1) > 1 else "dp"
+    batch_sharding = NamedSharding(mesh, P(batch_axes, None))
+
+    if _pipelined(tc, mesh):
+        # pp and sp share one manual region (shardy rejects nested manual
+        # axis sets), so the stage gets the raw collective attention.
+        loss = functools.partial(
+            pipelined_loss_fn, config=tc.model, mesh=mesh,
+            n_microbatches=tc.pipeline_microbatches,
+            attn_fn=(
+                functools.partial(sp_inner, axis_name="sp") if use_sp else None
+            ),
+            seq_axis="sp" if use_sp else None,
+        )
+    else:
+        attn_fn = _sp_attn_fn(mesh, tc.sp_impl) if use_sp else None
+        mod = tc._model_mod()
+        loss = functools.partial(mod.loss_fn, config=tc.model, attn_fn=attn_fn)
 
     def step(state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state["params"], tokens, tc.model, attn_fn
-        )
+        loss_val, grads = jax.value_and_grad(loss)(state["params"], tokens)
         updates, new_opt = opt.update(grads, state["opt"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
         grad_norm = optax.global_norm(grads)
         return (
             {"params": new_params, "opt": new_opt},
-            {"loss": loss, "grad_norm": grad_norm},
+            {"loss": loss_val, "grad_norm": grad_norm},
         )
 
     return jax.jit(step, donate_argnums=(0,)), batch_sharding

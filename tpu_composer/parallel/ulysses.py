@@ -1,0 +1,63 @@
+"""Ulysses-style sequence parallelism — all-to-all over the 'sp' axis.
+
+The alternative long-context strategy to ring attention (DeepSpeed-Ulysses
+pattern): instead of rotating K/V around a ring, one ``all_to_all``
+re-shards Q/K/V from sequence-sharded (B, S/n, H, D) to head-sharded
+(B, S, H/n, D), every device runs *full-sequence* attention over its head
+subset with any local kernel (einsum reference or the Pallas flash kernel),
+and a second ``all_to_all`` restores sequence sharding. Two collectives per
+layer instead of n ppermute hops — the better trade when heads >= sp and
+the interconnect favors few large transfers (DCN-reaching slices), while
+ring attention wins when per-device memory for full-S scores is the binding
+constraint.
+
+Use inside shard_map with the sequence axis sharded, e.g.:
+
+    shard_map(
+        functools.partial(ulysses_attention, axis_name="sp", causal=True),
+        mesh=mesh,
+        in_specs=(P(None, "sp", None, None),) * 3,
+        out_specs=P(None, "sp", None, None),
+    )(q, k, v)
+
+No reference analog (SURVEY.md §5: long-context parallelism is absent
+there); first-class here per the build spec.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+
+from tpu_composer.ops.attention import mha_reference
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    axis_name: str = "sp",
+    causal: bool = False,
+    attn_fn: Optional[Callable] = None,
+):
+    """All-to-all sequence-parallel attention. Local shapes (B, S/n, H, D);
+    the global sequence is the concatenation of shards in axis order. The
+    head count must be divisible by the axis size."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return (attn_fn or mha_reference)(q, k, v, causal=causal)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"n_heads {h} not divisible by sp={n}")
+    attn = attn_fn or mha_reference
+
+    # (B, S/n, H, D) -> (B, S, H/n, D): scatter heads, gather sequence.
+    def fwd(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    qg, kg, vg = fwd(q), fwd(k), fwd(v)
+    og = attn(qg, kg, vg, causal=causal)
+    # (B, S, H/n, D) -> (B, S/n, H, D): gather heads, scatter sequence.
+    return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2, tiled=True)
