@@ -1,0 +1,294 @@
+"""Control-plane fault injection + crash/resume.
+
+The MyClient analog: the reference wraps client.Client with per-call
+MockGet/MockUpdate/MockStatusUpdate/... hooks to fail individual K8s API
+operations (suite_test.go:244-294; e.g. the status-update failure entries at
+composabilityrequest_controller_test.go:419). ``FaultyStore`` does the same
+for our store, and these tests assert the two properties the reference's
+entries pin down:
+
+1. an API failure mid-transition surfaces (reconcile raises, status is never
+   silently corrupted), and
+2. the very next reconcile is idempotent — it re-drives the same transition
+   to the same end state without double-attaching fabric devices or leaking
+   children (CRD-as-checkpoint resume, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import pytest
+
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    ComposableResourceSpec,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.types import (
+    FINALIZER,
+    LABEL_MANAGED_BY,
+    REQUEST_STATE_NODE_ALLOCATING,
+    REQUEST_STATE_RUNNING,
+    REQUEST_STATE_UPDATING,
+    RESOURCE_STATE_ATTACHING,
+    RESOURCE_STATE_DELETING,
+    RESOURCE_STATE_ONLINE,
+)
+from tpu_composer.controllers.request_controller import ComposabilityRequestReconciler
+from tpu_composer.controllers.resource_controller import ComposableResourceReconciler
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.store import Store, StoreError
+
+
+class FaultyStore(Store):
+    """Store with per-operation injected failures (the MyClient seam)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._faults: Dict[str, int] = {}
+
+    def fail(self, op: str, times: int = 1) -> None:
+        self._faults[op] = self._faults.get(op, 0) + times
+
+    def _maybe_fail(self, op: str) -> None:
+        if self._faults.get(op, 0) > 0:
+            self._faults[op] -= 1
+            raise StoreError(f"injected {op} failure")
+
+    def create(self, obj):
+        self._maybe_fail("create")
+        return super().create(obj)
+
+    def update(self, obj):
+        self._maybe_fail("update")
+        return super().update(obj)
+
+    def update_status(self, obj):
+        self._maybe_fail("update_status")
+        return super().update_status(obj)
+
+    def delete(self, cls, name):
+        self._maybe_fail("delete")
+        return super().delete(cls, name)
+
+    def list(self, *a, **kw):
+        self._maybe_fail("list")
+        return super().list(*a, **kw)
+
+
+@pytest.fixture()
+def world():
+    store = FaultyStore()
+    for i in range(4):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 8
+        n.status.milli_cpu = 8000
+        n.status.memory = 64 << 30
+        n.status.allowed_pod_number = 100
+        store.create(n)
+    pool = InMemoryPool()
+    agent = FakeNodeAgent(pool=pool)
+    req_rec = ComposabilityRequestReconciler(store, pool)
+    res_rec = ComposableResourceReconciler(store, pool, agent)
+    return store, pool, agent, req_rec, res_rec
+
+
+def make_cr(store, pool, name="r0", node="worker-0"):
+    pool.reserve_slice("s1", "tpu-v4", "2x2x1", [node])
+    return store.create(ComposableResource(
+        metadata=ObjectMeta(name=name),
+        spec=ComposableResourceSpec(
+            type="tpu", model="tpu-v4", target_node=node, chip_count=4,
+            slice_name="s1", worker_id=0, topology="2x2x1",
+        ),
+    ))
+
+
+def make_request(store, name="req-1", size=4):
+    return store.create(ComposabilityRequest(
+        metadata=ObjectMeta(name=name),
+        spec=ComposabilityRequestSpec(
+            resource=ResourceDetails(type="tpu", model="tpu-v4", size=size)
+        ),
+    ))
+
+
+def pump(store, req_rec, res_rec, name="req-1", steps=40):
+    for _ in range(steps):
+        req_rec.reconcile(name)
+        for c in store.list(ComposableResource):
+            res_rec.reconcile(c.metadata.name)
+        if store.get(ComposabilityRequest, name).status.state == REQUEST_STATE_RUNNING:
+            return
+    raise AssertionError("never reached Running")
+
+
+# ---------------------------------------------------------------------------
+# ComposableResource controller vs store faults
+# ---------------------------------------------------------------------------
+
+class TestResourceStoreFaults:
+    def test_finalizer_update_failure_then_retry(self, world):
+        store, pool, agent, _, res_rec = world
+        make_cr(store, pool)
+        store.fail("update")
+        with pytest.raises(StoreError):
+            res_rec.reconcile("r0")
+        cr = store.get(ComposableResource, "r0")
+        assert cr.status.state == ""  # transition never half-applied
+        res_rec.reconcile("r0")
+        cr = store.get(ComposableResource, "r0")
+        assert cr.has_finalizer(FINALIZER)
+        assert cr.status.state == RESOURCE_STATE_ATTACHING
+
+    def test_status_update_failure_after_fabric_attach_is_idempotent(self, world):
+        """The dangerous window: fabric attach committed, then the status
+        write recording the device ids fails. The retry must re-drive the
+        attach idempotently — same devices, no second allocation."""
+        store, pool, agent, _, res_rec = world
+        make_cr(store, pool)
+        res_rec.reconcile("r0")  # "" -> Attaching
+        store.fail("update_status")
+        with pytest.raises(StoreError):
+            res_rec.reconcile("r0")
+        cr = store.get(ComposableResource, "r0")
+        assert cr.status.state == RESOURCE_STATE_ATTACHING
+        assert cr.status.device_ids == []  # write failed; status untouched
+        attached_now = pool.attached_to("worker-0")
+        assert len(attached_now) == 4  # but the fabric side DID commit
+        res_rec.reconcile("r0")  # retry
+        cr = store.get(ComposableResource, "r0")
+        assert cr.status.state == RESOURCE_STATE_ONLINE
+        assert cr.status.device_ids == attached_now  # adopted, not re-added
+        assert len(pool.attached_to("worker-0")) == 4  # no double attach
+
+    def test_detach_status_failure_then_retry_releases_once(self, world):
+        store, pool, agent, _, res_rec = world
+        make_cr(store, pool)
+        res_rec.reconcile("r0")
+        res_rec.reconcile("r0")
+        assert store.get(ComposableResource, "r0").status.state == RESOURCE_STATE_ONLINE
+        store.delete(ComposableResource, "r0")
+        res_rec.reconcile("r0")  # Online -> Detaching
+        store.fail("update_status")
+        with pytest.raises(StoreError):
+            res_rec.reconcile("r0")
+        # Fabric release may have committed; the retry must converge anyway.
+        res_rec.reconcile("r0")
+        cr = store.get(ComposableResource, "r0")
+        assert cr.status.state == RESOURCE_STATE_DELETING
+        assert pool.attached_to("worker-0") == []
+        res_rec.reconcile("r0")
+        assert store.try_get(ComposableResource, "r0") is None
+
+
+# ---------------------------------------------------------------------------
+# ComposabilityRequest controller vs store faults
+# ---------------------------------------------------------------------------
+
+class TestRequestStoreFaults:
+    def test_child_create_failure_no_duplicate_children(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store)
+        req_rec.reconcile("req-1")  # "" -> NodeAllocating
+        req_rec.reconcile("req-1")  # NodeAllocating -> Updating
+        store.fail("create")
+        with pytest.raises(StoreError):
+            req_rec.reconcile("req-1")  # Updating: child create blows up
+        pump(store, req_rec, res_rec)  # retry converges
+        kids = store.list(ComposableResource,
+                          label_selector={LABEL_MANAGED_BY: "req-1"})
+        assert len(kids) == 1  # single-host 2x2 slice -> exactly one group
+        req = store.get(ComposabilityRequest, "req-1")
+        assert req.status.state == REQUEST_STATE_RUNNING
+        assert req.status.error == ""
+
+    def test_status_write_failure_in_allocating_retries_cleanly(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store)
+        req_rec.reconcile("req-1")
+        store.fail("update_status")
+        with pytest.raises(StoreError):
+            req_rec.reconcile("req-1")
+        req = store.get(ComposabilityRequest, "req-1")
+        assert req.status.state == REQUEST_STATE_NODE_ALLOCATING
+        pump(store, req_rec, res_rec)
+        assert store.get(ComposabilityRequest, "req-1").status.state == REQUEST_STATE_RUNNING
+
+    def test_cleanup_delete_failure_retries_until_empty(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store)
+        pump(store, req_rec, res_rec)
+        store.delete(ComposabilityRequest, "req-1")
+        req_rec.reconcile("req-1")  # enter Cleaning
+        store.fail("delete")
+        # Child-delete faults are absorbed (each child retried next pass,
+        # the reference's requeue-until-none loop at :588-612) — the faulted
+        # pass must leave the children in place rather than half-deleting.
+        req_rec.reconcile("req-1")
+        assert store.list(ComposableResource,
+                          label_selector={LABEL_MANAGED_BY: "req-1"})
+        for _ in range(20):
+            if store.try_get(ComposabilityRequest, "req-1") is None:
+                break
+            req_rec.reconcile("req-1")
+            for c in store.list(ComposableResource):
+                res_rec.reconcile(c.metadata.name)
+        assert store.try_get(ComposabilityRequest, "req-1") is None
+        assert store.list(ComposableResource) == []
+        assert pool.free_chips("tpu-v4") == 64  # everything released
+
+
+# ---------------------------------------------------------------------------
+# Operator crash / restart resume (CRD-as-checkpoint, SURVEY.md §5)
+# ---------------------------------------------------------------------------
+
+class TestCrashResume:
+    def pump_once(self, store, req_rec, res_rec, name="req-1"):
+        req_rec.reconcile(name)
+        for c in store.list(ComposableResource):
+            res_rec.reconcile(c.metadata.name)
+
+    def restart(self, store, pool):
+        """Fresh controller instances over the same store — the reference's
+        'operator restart resumes mid-state-machine for free'."""
+        agent = FakeNodeAgent(pool=pool)
+        return (ComposabilityRequestReconciler(store, pool),
+                ComposableResourceReconciler(store, pool, agent))
+
+    @pytest.mark.parametrize("crash_after_steps", [1, 2, 3])
+    def test_restart_mid_attach_resumes_to_running(self, world, crash_after_steps):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store)
+        for _ in range(crash_after_steps):
+            self.pump_once(store, req_rec, res_rec)
+        req_rec2, res_rec2 = self.restart(store, pool)
+        pump(store, req_rec2, res_rec2)
+        req = store.get(ComposabilityRequest, "req-1")
+        assert req.status.state == REQUEST_STATE_RUNNING
+        assert all(r.state == RESOURCE_STATE_ONLINE
+                   for r in req.status.resources.values())
+        # Exactly one slice's worth of chips attached, despite the restart.
+        assert sum(len(c.status.device_ids)
+                   for c in store.list(ComposableResource)) == 4
+
+    def test_restart_mid_teardown_finishes_cleanup(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store)
+        pump(store, req_rec, res_rec)
+        store.delete(ComposabilityRequest, "req-1")
+        self.pump_once(store, req_rec, res_rec)  # Cleaning begins
+        req_rec2, res_rec2 = self.restart(store, pool)
+        for _ in range(20):
+            if store.try_get(ComposabilityRequest, "req-1") is None:
+                break
+            self.pump_once(store, req_rec2, res_rec2)
+        assert store.try_get(ComposabilityRequest, "req-1") is None
+        assert store.list(ComposableResource) == []
+        assert pool.free_chips("tpu-v4") == 64
