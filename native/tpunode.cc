@@ -15,9 +15,12 @@
 // Build: make -C native   (produces native/build/libtpunode.so)
 
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <dirent.h>
+#include <poll.h>
 #include <string>
+#include <sys/inotify.h>
 #include <sys/types.h>
 #include <unistd.h>
 #include <vector>
@@ -111,6 +114,108 @@ int tpun_read_file(const char* path, char* buf, int buflen) {
   std::fclose(f);
   buf[n] = '\0';
   return (int)n;
+}
+
+// Scan proc_dir ONCE for processes holding any of the newline-separated
+// dev_paths open. A 4-chip group drain needs the holder sets of 4 device
+// nodes; the per-path scan costs 4 full /proc sweeps (and the reference's
+// exec'd `ls -l /proc/*/fd` pipeline costs a process spawn per check,
+// gpus.go:416-439) where one sweep has all the answers. Writes
+// (pid, path_index) pairs into `pairs` (2 ints per hit, up to max_pairs
+// pairs) and returns the total hit count — which may exceed max_pairs, in
+// which case the overflow hits are counted but not recorded — or -1 on
+// error (callers must treat error as UNKNOWN, never as idle: this guards
+// drains).
+int tpun_fd_holders_multi(const char* dev_paths, const char* proc_dir,
+                          int* pairs, int max_pairs) {
+  std::vector<std::string> paths;
+  {
+    const char* start = dev_paths;
+    for (const char* p = dev_paths;; ++p) {
+      if (*p == '\n' || *p == '\0') {
+        if (p > start) paths.emplace_back(start, p - start);
+        if (*p == '\0') break;
+        start = p + 1;
+      }
+    }
+  }
+
+  DIR* proc = opendir(proc_dir);
+  if (!proc) return -1;
+  int total = 0;
+  struct dirent* pe;
+  char fd_dir[512], link_path[768], target[768];
+  while ((pe = readdir(proc)) != nullptr) {
+    if (!all_digits(pe->d_name)) continue;
+    std::snprintf(fd_dir, sizeof fd_dir, "%s/%s/fd", proc_dir, pe->d_name);
+    DIR* fds = opendir(fd_dir);
+    if (!fds) continue;  // permission or exited — same as the Python fallback
+    std::vector<bool> hit(paths.size(), false);
+    struct dirent* fe;
+    while ((fe = readdir(fds)) != nullptr) {
+      if (fe->d_name[0] == '.') continue;
+      std::snprintf(link_path, sizeof link_path, "%s/%s", fd_dir, fe->d_name);
+      ssize_t n = readlink(link_path, target, sizeof target - 1);
+      if (n <= 0) continue;
+      target[n] = '\0';
+      for (size_t i = 0; i < paths.size(); ++i) {
+        if (!hit[i] && paths[i] == target) {
+          hit[i] = true;
+          if (total < max_pairs) {
+            pairs[2 * total] = std::atoi(pe->d_name);
+            pairs[2 * total + 1] = (int)i;
+          }
+          ++total;
+        }
+      }
+    }
+    closedir(fds);
+  }
+  closedir(proc);
+  return total;
+}
+
+// Read the short command name of a pid (proc_dir/<pid>/comm, trailing
+// newline stripped) into buf; returns its length or -1. Lets drain-refusal
+// diagnostics name the offending workload, as the reference's
+// `nvidia-smi --query-compute-apps=pid,process_name` output does
+// (gpus.go:241-350).
+int tpun_proc_name(const char* proc_dir, int pid, char* buf, int buflen) {
+  char path[512];
+  std::snprintf(path, sizeof path, "%s/%d/comm", proc_dir, pid);
+  int n = tpun_read_file(path, buf, buflen);
+  if (n <= 0) return n;
+  while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == '\r')) buf[--n] = '\0';
+  return n;
+}
+
+// Block until something is created/deleted/moved under dev_dir (inotify) or
+// timeout_ms elapses. Returns 1 on an event, 0 on timeout, -1 on error.
+// This is the event-driven alternative to the visibility poll: instead of
+// re-enumerating /dev on a fixed cadence (the reference's 30s requeue,
+// composableresource_controller.go:298), the node agent sleeps here and the
+// controller is nudged the instant the fabric materializes the device node.
+int tpun_watch_dev(const char* dev_dir, int timeout_ms) {
+  int fd = inotify_init1(IN_NONBLOCK);
+  if (fd < 0) return -1;
+  int wd = inotify_add_watch(
+      fd, dev_dir, IN_CREATE | IN_DELETE | IN_MOVED_TO | IN_MOVED_FROM | IN_ATTRIB);
+  if (wd < 0) {
+    close(fd);
+    return -1;
+  }
+  struct pollfd pfd = {fd, POLLIN, 0};
+  int rc = poll(&pfd, 1, timeout_ms);
+  int result = 0;
+  if (rc < 0) {
+    result = -1;
+  } else if (rc > 0 && (pfd.revents & POLLIN)) {
+    char evbuf[4096];
+    result = read(fd, evbuf, sizeof evbuf) > 0 ? 1 : -1;
+  }
+  inotify_rm_watch(fd, wd);
+  close(fd);
+  return result;
 }
 
 }  // extern "C"

@@ -31,6 +31,17 @@ class _NativeLib:
         ]
         self._c.tpun_read_file.restype = ctypes.c_int
         self._c.tpun_read_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        self._c.tpun_fd_holders_multi.restype = ctypes.c_int
+        self._c.tpun_fd_holders_multi.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ]
+        self._c.tpun_proc_name.restype = ctypes.c_int
+        self._c.tpun_proc_name.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        self._c.tpun_watch_dev.restype = ctypes.c_int
+        self._c.tpun_watch_dev.argtypes = [ctypes.c_char_p, ctypes.c_int]
 
     def version(self) -> str:
         return self._c.tpun_version().decode()
@@ -55,6 +66,42 @@ class _NativeLib:
         if n < 0:
             return None
         return buf.value.decode(errors="replace")
+
+    def fd_holders_multi(self, dev_paths: List[str], proc_dir: str) -> "dict[str, List[int]]":
+        """Holder pids per device path, attributed in a single /proc sweep:
+        the C side emits (pid, path_index) pairs directly. Raises OSError on
+        a failed sweep — callers guard drains, so an error must surface as
+        UNKNOWN, never read as idle (matching the fallback, which propagates
+        anything but a missing proc dir)."""
+        if not dev_paths:
+            return {}
+        max_pairs = 4096
+        pairs = (ctypes.c_int * (2 * max_pairs))()
+        total = self._c.tpun_fd_holders_multi(
+            "\n".join(dev_paths).encode(), proc_dir.encode(), pairs, max_pairs
+        )
+        out: dict[str, List[int]] = {p: [] for p in dev_paths}
+        if total < 0:
+            if not os.path.isdir(proc_dir):
+                return out  # absent proc tree = no holders (fallback parity)
+            raise OSError(f"native fd sweep of {proc_dir} failed")
+        for i in range(min(total, max_pairs)):
+            pid, idx = pairs[2 * i], pairs[2 * i + 1]
+            if 0 <= idx < len(dev_paths):
+                out[dev_paths[idx]].append(pid)
+        return out
+
+    def proc_name(self, proc_dir: str, pid: int) -> str:
+        buf = ctypes.create_string_buffer(256)
+        n = self._c.tpun_proc_name(proc_dir.encode(), pid, buf, len(buf))
+        if n <= 0:
+            return ""
+        return buf.value.decode(errors="replace")
+
+    def watch_dev(self, dev_dir: str, timeout_ms: int) -> int:
+        """1 = a device node changed under dev_dir, 0 = timeout, -1 = error
+        (caller falls back to polling)."""
+        return self._c.tpun_watch_dev(dev_dir.encode(), timeout_ms)
 
 
 def _candidate_paths() -> List[str]:

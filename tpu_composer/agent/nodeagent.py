@@ -46,6 +46,12 @@ class DriverType:
     CONTAINER = "container"  # libtpu supplied by a driver container
 
 
+# Ceiling on a single wait_device_event block, shared by both sides of the
+# RPC seam (serve.py enforces it, remote.py clamps to it so a client never
+# believes a longer watch was held than the server actually armed).
+MAX_WATCH_S = 30.0
+
+
 class NodeAgent:
     """All methods take the node name; implementations may ignore it (a local
     agent serves exactly one node) or route RPC (a cluster agent client)."""
@@ -85,6 +91,12 @@ class NodeAgent:
         spec write/remove (replaces daemonset restarts,
         composableresource_controller.go:252-286)."""
         raise NotImplementedError
+
+    def wait_device_event(self, node: str = "", timeout: float = 1.0) -> bool:
+        """Block until a device node appears/vanishes on the node, or
+        timeout; True iff an event fired. Default: no watch capability —
+        callers degrade to polling."""
+        return False
 
     # -- scheduling quarantine (DeviceTaintRule analog, gpus.go:894-977) ---
     def create_device_taint(self, node: str, device_ids: List[str], reason: str) -> None:
@@ -212,13 +224,21 @@ class LocalNodeAgent(NodeAgent):
         return len(present) >= len(device_ids) and bool(device_ids)
 
     def _holders(self, dev_path: str) -> List[int]:
+        return self._holders_multi([dev_path]).get(dev_path, [])
+
+    def _holders_multi(self, dev_paths: List[str]) -> Dict[str, List[int]]:
+        """Holder pids for every path in ONE /proc sweep (a group drain
+        checks 4+ device nodes; per-path sweeps scale O(paths x processes))."""
+        if not dev_paths:
+            return {}
         if self._native is not None:
-            return self._native.fd_holders(dev_path, self.proc_dir)
-        pids: List[int] = []
+            return self._native.fd_holders_multi(dev_paths, self.proc_dir)
+        wanted = set(dev_paths)
+        out: Dict[str, List[int]] = {p: [] for p in dev_paths}
         try:
             entries = os.listdir(self.proc_dir)
         except FileNotFoundError:
-            return pids
+            return out
         for entry in entries:
             if not entry.isdigit():
                 continue
@@ -226,29 +246,47 @@ class LocalNodeAgent(NodeAgent):
             try:
                 for fd in os.listdir(fd_dir):
                     try:
-                        if os.readlink(os.path.join(fd_dir, fd)) == dev_path:
-                            pids.append(int(entry))
-                            break
+                        target = os.readlink(os.path.join(fd_dir, fd))
                     except OSError:
                         continue
+                    if target in wanted and int(entry) not in out[target]:
+                        out[target].append(int(entry))
             except OSError:
                 continue
-        return pids
+        return out
+
+    def _proc_name(self, pid: int) -> str:
+        if self._native is not None:
+            return self._native.proc_name(self.proc_dir, pid)
+        try:
+            with open(os.path.join(self.proc_dir, str(pid), "comm")) as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+    def _describe_holders(self, busy: Dict[str, List[int]]) -> str:
+        """'/dev/accel0 held by 1234(python3)' — named-workload diagnostics,
+        the parity point for the reference's query-compute-apps pid+name
+        reporting (gpus.go:241-350)."""
+        parts = []
+        for path in sorted(busy):
+            procs = ", ".join(
+                f"{pid}({self._proc_name(pid) or '?'})" for pid in busy[path]
+            )
+            parts.append(f"{path} held by {procs}")
+        return "; ".join(parts)
 
     def check_no_loads(self, node: str, device_ids: List[str], group: str = "") -> bool:
-        for path in self._group_paths(group, len(device_ids)):
-            if self._holders(path):
-                return False
-        return True
+        holders = self._holders_multi(self._group_paths(group, len(device_ids)))
+        return not any(holders.values())
 
     def drain(self, node: str, device_ids: List[str], force: bool = False,
               group: str = "") -> None:
         paths = self._group_paths(group, len(device_ids))
         if not force:
-            busy = {p: self._holders(p) for p in paths}
-            busy = {p: h for p, h in busy.items() if h}
+            busy = {p: h for p, h in self._holders_multi(paths).items() if h}
             if busy:
-                raise DeviceBusyError(f"open fds on {sorted(busy)}: {busy}")
+                raise DeviceBusyError(self._describe_holders(busy))
         # On a real fabric the unbind happens through the fabric manager; the
         # host-side publication retraction is targeted per group via
         # refresh_device_stack(remove_name=...) — drain must NOT touch CDI
@@ -261,6 +299,36 @@ class LocalNodeAgent(NodeAgent):
         if remove_name:
             cdimod.remove_cdi_spec(self.cdi_dir, remove_name)
             self._drop_claim(remove_name)
+
+    def wait_device_event(self, node: str = "", timeout: float = 1.0) -> bool:
+        """Block until a device node appears/vanishes under dev_dir, or
+        timeout. True iff an event fired. ``node`` is ignored (a local agent
+        serves exactly one host). Native path is inotify (tpun_watch_dev);
+        the fallback compares directory snapshots on a 50ms cadence. This
+        powers the DeviceEventWatcher runnable that replaces fixed
+        visibility polling with event-driven reconciles (BASELINE.md's
+        biggest latency lever)."""
+        timeout = max(0.0, timeout)
+        if self._native is not None:
+            rc = self._native.watch_dev(self.dev_dir, int(timeout * 1000))
+            if rc >= 0:
+                return rc == 1
+            # fall through to the polling fallback on error
+        import time as _time
+
+        def snapshot():
+            try:
+                return set(os.listdir(self.dev_dir))
+            except OSError:
+                return set()
+
+        before = snapshot()
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            _time.sleep(0.05)
+            if snapshot() != before:
+                return True
+        return False
 
     # -- taints are marker files under state_dir ------------------------
     def _taint_path(self, device_id: str) -> str:

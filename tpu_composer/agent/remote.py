@@ -19,7 +19,12 @@ import urllib.request
 from typing import Callable, List, Optional
 
 from tpu_composer.agent import cdi as cdimod
-from tpu_composer.agent.nodeagent import AgentError, DeviceBusyError, NodeAgent
+from tpu_composer.agent.nodeagent import (
+    MAX_WATCH_S,
+    AgentError,
+    DeviceBusyError,
+    NodeAgent,
+)
 from tpu_composer.agent.serve import spec_to_wire
 
 
@@ -57,7 +62,7 @@ class RemoteNodeAgent(NodeAgent):
         return cls(resolver, timeout=timeout)
 
     # ------------------------------------------------------------------
-    def _call(self, node: str, method: str, **args):
+    def _call(self, node: str, method: str, _transport_timeout=None, **args):
         endpoint = self._resolve(node)
         url = f"http://{endpoint}/v1/{method}"
         body = json.dumps({"node": node, **args}).encode()
@@ -66,7 +71,9 @@ class RemoteNodeAgent(NodeAgent):
             method="POST",
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=_transport_timeout or self.timeout
+            ) as resp:
                 return json.loads(resp.read()).get("result")
         except urllib.error.HTTPError as e:
             try:
@@ -119,3 +126,14 @@ class RemoteNodeAgent(NodeAgent):
 
     def has_device_taint(self, node: str, device_id: str) -> bool:
         return bool(self._call(node, "has_device_taint", device_id=device_id))
+
+    def wait_device_event(self, node: str, timeout: float = 1.0) -> bool:
+        """Long-poll the node's /dev watch. A per-node DeviceEventWatcher
+        wraps this for event-driven reconciles in cluster mode. The timeout
+        is clamped to the shared MAX_WATCH_S cap the server enforces — a
+        larger request would silently become unwatched sleep on this side —
+        and the transport timeout is padded to outlive the server-side
+        wait."""
+        timeout = min(max(0.0, timeout), MAX_WATCH_S)
+        return bool(self._call(node, "wait_device_event", timeout=timeout,
+                               _transport_timeout=timeout + 5.0))

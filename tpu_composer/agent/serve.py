@@ -27,6 +27,7 @@ from typing import Optional
 
 from tpu_composer.agent import cdi as cdimod
 from tpu_composer.agent.nodeagent import (
+    MAX_WATCH_S,
     AgentError,
     DeviceBusyError,
     LocalNodeAgent,
@@ -44,6 +45,7 @@ _METHODS = frozenset(
         "create_device_taint",
         "delete_device_taint",
         "has_device_taint",
+        "wait_device_event",
     }
 )
 
@@ -152,6 +154,17 @@ class AgentServer:
             return True
         if method == "has_device_taint":
             return self.agent.has_device_taint(node, args.get("device_id", ""))
+        if method == "wait_device_event":
+            # Long-poll: blocks this handler thread (ThreadingHTTPServer) up
+            # to the capped timeout. Agents without a watch capability
+            # (NodeAgent's default) answer False so callers degrade to
+            # polling — the DeviceEventWatcher throttles that fast-False.
+            try:
+                timeout = min(max(0.0, float(args.get("timeout", 1.0))),
+                              MAX_WATCH_S)
+            except (TypeError, ValueError) as e:
+                raise AgentError(f"bad wait_device_event timeout: {e}") from e
+            return bool(self.agent.wait_device_event(node, timeout=timeout))
         raise AgentError(f"unhandled method {method}")  # pragma: no cover
 
     @property

@@ -1,0 +1,145 @@
+"""Device event watchers — event-driven reconcile nudges from /dev changes.
+
+The reference detects device visibility only by re-running its checks on a
+fixed 30s requeue (composableresource_controller.go:298) — the dominant term
+in its attach-to-Ready latency (BASELINE.md). These runnables invert that:
+they block in the node agent's ``wait_device_event`` (inotify via
+native/tpunode.cc's ``tpun_watch_dev`` locally; HTTP long-poll via
+serve.py/remote.py in cluster mode) and, the instant a device node appears
+or vanishes, enqueue every non-terminal ComposableResource on the affected
+host so the controller re-checks visibility immediately.
+
+Polling requeues stay in place as the safety net; the watchers just make the
+happy path latency-bound by the fabric, not by a poll quantum.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from tpu_composer.api.types import (
+    ComposableResource,
+    Node,
+    RESOURCE_STATE_DELETING,
+)
+from tpu_composer.runtime.controller import Controller
+
+
+class DeviceEventWatcher:
+    """Manager runnable: device-node churn -> resource-controller enqueues.
+
+    ``node_name`` scopes both the agent call and the nudges to one host
+    (empty nudges every non-terminal resource). ``should_run`` lets an owner
+    (MultiNodeWatcher) retire this watcher when its node leaves the cluster.
+    """
+
+    def __init__(
+        self,
+        agent,  # NodeAgent: wait_device_event(node, timeout) -> bool
+        controller: Controller,
+        node_name: str = "",
+        wait_timeout: float = 1.0,
+        should_run: Optional[Callable[[], bool]] = None,
+    ) -> None:
+        self.agent = agent
+        self.controller = controller
+        self.node_name = node_name
+        self.wait_timeout = wait_timeout
+        self.should_run = should_run
+        self.log = logging.getLogger("DeviceEventWatcher")
+        self.events_seen = 0
+
+    def _targets(self):
+        out = []
+        for res in self.controller.store.list(ComposableResource):
+            if res.status.state == RESOURCE_STATE_DELETING:
+                continue
+            if self.node_name and res.spec.target_node != self.node_name:
+                continue
+            out.append(res.metadata.name)
+        return out
+
+    def nudge(self) -> int:
+        """Enqueue all candidate resources; returns how many."""
+        names = self._targets()
+        for name in names:
+            self.controller.queue.add(name)
+        return len(names)
+
+    def __call__(self, stop_event: threading.Event) -> None:
+        while not stop_event.is_set():
+            if self.should_run is not None and not self.should_run():
+                return
+            started = time.monotonic()
+            fired = False
+            try:
+                fired = self.agent.wait_device_event(self.node_name,
+                                                     timeout=self.wait_timeout)
+                if fired:
+                    self.events_seen += 1
+                    n = self.nudge()
+                    self.log.debug("device event -> nudged %d resource(s)", n)
+            except Exception as e:  # watcher must never kill the manager
+                self.log.warning("device watch on %r failed: %s",
+                                 self.node_name, e)
+            if fired:
+                # Re-arm the watch immediately: device attaches arrive in
+                # bursts (one inotify event per chip of a group), and the
+                # per-call watch is torn down between waits.
+                continue
+            # Throttle: an agent without watch capability answers False
+            # immediately (NodeAgent's default) — sleep out the remainder of
+            # the window instead of spinning an unthrottled poll/RPC loop.
+            remainder = self.wait_timeout - (time.monotonic() - started)
+            if remainder > 0 and stop_event.wait(remainder):
+                return
+
+
+class MultiNodeWatcher:
+    """Cluster-mode runnable: one DeviceEventWatcher thread per Node in the
+    store, long-polling that node's agent (RemoteNodeAgent -> serve.py).
+    Rescans the node list every ``refresh`` seconds, starting watchers for
+    new nodes and retiring watchers whose node is gone."""
+
+    def __init__(
+        self,
+        agent,
+        controller: Controller,
+        wait_timeout: float = 5.0,
+        refresh: float = 10.0,
+    ) -> None:
+        self.agent = agent
+        self.controller = controller
+        self.wait_timeout = wait_timeout
+        self.refresh = refresh
+        self.log = logging.getLogger("MultiNodeWatcher")
+        self._live: set = set()  # node names with an active watcher
+
+    def _nodes(self) -> set:
+        return {n.metadata.name for n in self.controller.store.list(Node)}
+
+    def __call__(self, stop_event: threading.Event) -> None:
+        threads = {}
+        while not stop_event.is_set():
+            current = self._nodes()
+            self._live = current
+            for node in current - set(threads):
+                w = DeviceEventWatcher(
+                    self.agent, self.controller, node_name=node,
+                    wait_timeout=self.wait_timeout,
+                    should_run=lambda n=node: n in self._live,
+                )
+                t = threading.Thread(target=w, args=(stop_event,),
+                                     name=f"dev-watch-{node}", daemon=True)
+                t.start()
+                threads[node] = t
+            for node, t in list(threads.items()):
+                if not t.is_alive():
+                    del threads[node]
+            if stop_event.wait(self.refresh):
+                break
+        for t in threads.values():
+            t.join(timeout=self.wait_timeout + 1.0)

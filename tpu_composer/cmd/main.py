@@ -141,11 +141,28 @@ def build_manager(args: argparse.Namespace) -> Manager:
     )
     mgr.add_controller(ComposabilityRequestReconciler(store, fabric,
                                                       recorder=mgr.recorder))
-    mgr.add_controller(ComposableResourceReconciler(store, fabric, agent,
-                                                    recorder=mgr.recorder))
+    res_rec = ComposableResourceReconciler(store, fabric, agent,
+                                           recorder=mgr.recorder)
+    mgr.add_controller(res_rec)
     mgr.add_runnable(UpstreamSyncer(store, fabric, period=args.sync_period,
                                     grace=args.sync_grace,
                                     recorder=mgr.recorder))
+    # Event-driven visibility: /dev change events nudge the resource
+    # controller instead of waiting out a poll quantum (BASELINE.md) —
+    # inotify directly for a local agent, HTTP long-poll per node for the
+    # cluster RemoteNodeAgent. Fakes keep the polling safety net only.
+    if isinstance(agent, LocalNodeAgent):
+        from tpu_composer.agent.watcher import DeviceEventWatcher
+
+        mgr.add_runnable(DeviceEventWatcher(
+            agent, res_rec, node_name=os.environ.get("NODE_NAME", "")
+        ))
+    else:
+        from tpu_composer.agent.remote import RemoteNodeAgent
+        from tpu_composer.agent.watcher import MultiNodeWatcher
+
+        if isinstance(agent, RemoteNodeAgent):
+            mgr.add_runnable(MultiNodeWatcher(agent, res_rec))
     if os.environ.get("ENABLE_WEBHOOKS", "").lower() != "false":
         register_validating_webhooks(store)
     return mgr
