@@ -1,0 +1,136 @@
+"""Pallas flash attention: forward AND backward parity with the reference
+einsum implementation (interpret mode on the CPU mesh; the same kernels
+compile to Mosaic on TPU). The backward runs the standard dQ / dK+dV
+two-kernel split off the forward's logsumexp — these tests pin the custom
+VJP to the autodiff of the reference implementation."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_composer.ops.attention import flash_attention, mha_reference
+
+
+def make_qkv(b=2, s=256, h=4, d=64, dtype=jnp.float32):
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d), dtype)
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d), dtype)
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d), dtype)
+    return q, k, v
+
+
+class TestForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = make_qkv()
+        ref = mha_reference(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal)
+        assert out.shape == q.shape
+        assert float(jnp.abs(ref - out).max()) < 2e-5
+
+    def test_multi_block_both_axes(self):
+        q, k, v = make_qkv(b=1, s=256, h=2)
+        ref = mha_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        assert float(jnp.abs(ref - out).max()) < 2e-5
+
+    def test_cross_attention_lengths(self):
+        q, _, _ = make_qkv(s=128)
+        _, k, v = make_qkv(s=256)
+        ref = mha_reference(q, k, v)
+        out = flash_attention(q, k, v, block_q=64, block_k=64)
+        assert float(jnp.abs(ref - out).max()) < 2e-5
+
+    def test_rejects_indivisible_seq(self):
+        q, k, v = make_qkv(s=192)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, v, block_q=128, block_k=128)
+
+    def test_bf16_io(self):
+        q, k, v = make_qkv(dtype=jnp.bfloat16)
+        ref = mha_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True)
+        assert out.dtype == jnp.bfloat16
+        assert float(jnp.abs(ref.astype(jnp.float32)
+                             - out.astype(jnp.float32)).max()) < 0.05
+
+
+class TestBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, causal):
+        q, k, v = make_qkv()
+
+        def loss_ref(q, k, v):
+            return (mha_reference(q, k, v, causal=causal) ** 2).sum()
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=causal) ** 2).sum()
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gr, gf):
+            err = float(jnp.abs(a - b).max())
+            scale = float(jnp.abs(a).max())
+            assert err < 1e-3 * max(scale, 1.0), f"d{name}: {err} vs {scale}"
+
+    def test_grads_multi_block(self):
+        q, k, v = make_qkv(b=1, s=256, h=2)
+
+        def loss(fn):
+            return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+        gr = jax.grad(loss(lambda q, k, v: mha_reference(q, k, v, causal=True)),
+                      argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(
+            loss(lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                 block_q=64, block_k=64)),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gf):
+            assert float(jnp.abs(a - b).max()) < 1e-3
+
+    def test_value_and_grad_jits(self):
+        """The custom VJP must be jittable end to end (the train step wraps
+        it in jit + grad)."""
+        q, k, v = make_qkv(b=1, s=128, h=2)
+
+        @jax.jit
+        def step(q, k, v):
+            def loss(q):
+                return (flash_attention(q, k, v, causal=True) ** 2).sum()
+            return jax.value_and_grad(loss)(q)
+
+        val, grad = step(q, k, v)
+        assert float(val) > 0
+        assert grad.shape == q.shape
+        assert bool(jnp.isfinite(grad).all())
+
+
+class TestTrainStepIntegration:
+    def test_flash_train_step_runs_and_matches_reference_loss(self):
+        """A full train step with attn_impl=flash must be differentiable and
+        agree with the reference implementation's loss."""
+        from tpu_composer.models.transformer import ModelConfig
+        from tpu_composer.parallel.mesh import make_mesh
+        from tpu_composer.parallel.train import (
+            TrainConfig,
+            make_train_state,
+            make_train_step,
+        )
+
+        losses = {}
+        for impl in ("reference", "flash"):
+            mc = ModelConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                             d_ff=128, max_seq=128, dtype=jnp.float32,
+                             attn_impl=impl)
+            tc = TrainConfig(model=mc)
+            mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1},
+                             devices=jax.devices()[:1])
+            state = make_train_state(tc, jax.random.key(0), mesh)
+            step_fn, sharding = make_train_step(tc, mesh)
+            tokens = jax.device_put(
+                jax.random.randint(jax.random.key(1), (2, 128), 0, 256),
+                sharding,
+            )
+            state, metrics = step_fn(state, tokens)
+            losses[impl] = float(metrics["loss"])
+            assert losses[impl] == losses[impl]  # finite
+        assert abs(losses["flash"] - losses["reference"]) < 1e-3

@@ -1,12 +1,15 @@
 """Multi-head attention: reference einsum implementation + Pallas flash
-kernel.
+kernel (forward AND backward), differentiable end-to-end.
 
-The flash kernel follows the online-softmax (FlashAttention) recurrence:
-stream K/V blocks through VMEM, keep the running row-max ``m``, normalizer
-``l`` and fp32 accumulator in registers/VMEM, and never materialize the
-(Sq, Sk) score matrix in HBM. Matmuls hit the MXU with
-``preferred_element_type=float32``; block shapes default to the 128-lane
-tile the MXU wants (pallas_guide.md "Tiling Constraints").
+The flash kernels follow the FlashAttention recurrence: stream K/V blocks
+through VMEM on the innermost ("arbitrary") grid axis, keep the running
+row-max ``m``, normalizer ``l`` and an fp32 accumulator in VMEM scratch, and
+never materialize the (Sq, Sk) score matrix in HBM. The forward additionally
+emits the per-row logsumexp so the backward can rebuild probabilities
+blockwise (the standard dQ / dK+dV two-kernel split) instead of saving them.
+Matmuls hit the MXU with ``preferred_element_type=float32``; block shapes
+default to the 128-lane tile the MXU wants (pallas_guide.md "Tiling
+Constraints"); fully-masked causal blocks are skipped with ``pl.when``.
 """
 
 from __future__ import annotations
@@ -17,11 +20,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # present on CPU builds too
 
-try:  # TPU backend module exists even on CPU builds of current JAX
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover
-    pltpu = None
+NEG_INF = -1e30
 
 
 def mha_reference(q, k, v, causal: bool = False):
@@ -33,54 +34,289 @@ def mha_reference(q, k, v, causal: bool = False):
         sq, sk = scores.shape[-2], scores.shape[-1]
         qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        scores = jnp.where(qi >= ki, scores, -1e30)
+        scores = jnp.where(qi >= ki, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
     return out
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sk: int):
-    qi = pl.program_id(1)
-    block_q = q_ref.shape[1]
+def _causal_mask(s, qi, ki, block_q, block_k):
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_k
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, causal: bool, block_q: int, block_k: int, nk: int):
+    qi, ki = pl.program_id(1), pl.program_id(2)
     d = q_ref.shape[2]
     scale = 1.0 / (d ** 0.5)
-    q = q_ref[0].astype(jnp.float32) * scale  # (bq, D)
 
-    nk = sk // block_k
-    # Causal: K blocks entirely above the diagonal are fully masked — skip
-    # them instead of paying two MXU matmuls for -inf scores. The last block
-    # that can contain an unmasked entry for this q block is
-    # ceil(((qi+1) * block_q) / block_k).
-    if causal:
-        nk = jnp.minimum(nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    # Causal: K blocks entirely above the diagonal contribute nothing — skip
+    # both MXU matmuls (the reference einsum pays for them all).
+    live = True if not causal else ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (bq, bk)
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            q_pos = qi * block_q + rows
-            k_pos = j * block_k + cols
-            s = jnp.where(q_pos >= k_pos, s, -1e30)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[...] = jnp.broadcast_to(
+            l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True),
+            l_scr.shape,
+        )
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
 
-    m0 = jnp.full((block_q, 1), -1e30, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _flush():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        # Lane-replicated (block_q, 128) layout, matching JAX's own TPU
+        # flash kernels (flash_attention.py MIN_BLOCK_SIZE): Mosaic rejects
+        # a (1, block_q) block over a (BH, S) array because the
+        # second-to-last block dim must be divisible by 8 or equal the
+        # array dim, so the per-row scalar costs 128 lanes either way.
+        lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
+                                      lse_ref.shape[1:])
+
+
+def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret):
+    """(BH, S, D) inputs -> (out, lse). The 3D-grid streaming core."""
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    grid = (bh, nq, nk)
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k, nk=nk
+    )
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    scratch = [
+        pltpu.VMEM((block_q, 128), jnp.float32),  # running row-max m
+        pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer l
+        pltpu.VMEM((block_q, d), jnp.float32),  # fp32 output accumulator
+    ]
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ),
+        scratch_shapes=scratch,
+        interpret=interpret,
+        **kwargs,
+    )(q3, k3, v3)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, causal: bool, block_q: int, block_k: int, nk: int):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    d = q_ref.shape[2]
+    scale = 1.0 / (d ** 0.5)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = True if not causal else ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])  # normalized probabilities
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0][:, :1])
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == nk - 1)
+    def _flush():
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, causal: bool, block_q: int, block_k: int, nq: int):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    d = q_ref.shape[2]
+    scale = 1.0 / (d ** 0.5)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = True if not causal else qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0][:, :1])
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)  # q carried the scale
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(res, g, causal, block_q, block_k, interpret):
+    q3, k3, v3, out, lse = res
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    do = g
+    # delta_i = rowsum(dO_i * O_i), lane-replicated to the same (bh, sq, 128)
+    # layout as lse (one cheap XLA reduce + broadcast).
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+                keepdims=True),
+        (do.shape[0], do.shape[1], 128),
+    )
+
+    sem = {}
+    if not interpret:
+        sem["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, block_q=block_q,
+                          block_k=block_k, nk=nk),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+        **sem,
+    )(q3, k3, v3, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, block_q=block_q,
+                          block_k=block_k, nq=nq),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+        ),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **sem,
+    )(q3, k3, v3, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q3, k3, v3, causal, block_q, block_k, interpret):
+    out, _ = _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_core_fwd(q3, k3, v3, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret)
+    return out, (q3, k3, v3, out, lse)
+
+
+def _flash_core_bwd(causal, block_q, block_k, interpret, res, g):
+    return _flash_backward(res, g, causal, block_q, block_k, interpret)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(
@@ -92,10 +328,11 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ):
-    """FlashAttention via Pallas. Shapes: (B, S, H, D) -> (B, S, H, D).
+    """FlashAttention via Pallas, differentiable (custom VJP with flash
+    backward kernels). Shapes: (B, S, H, D) -> (B, S, H, D).
 
-    ``interpret`` defaults to True off-TPU so the kernel is testable on the
-    CPU mesh; on TPU it compiles to a Mosaic kernel.
+    ``interpret`` defaults to True off-TPU so the kernels are testable on
+    the CPU mesh; on TPU they compile to Mosaic kernels.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -104,25 +341,13 @@ def flash_attention(
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     if sq % block_q or sk % block_k:
-        raise ValueError(f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
+        raise ValueError(
+            f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})"
+        )
 
     # Collapse (B, H) into one grid axis; move seq next to head_dim.
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-
-    grid = (b * h, sq // block_q)
-    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal, sk=sk)
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        interpret=interpret,
-    )(qt, kt, vt)
+    out = _flash_core(qt, kt, vt, causal, block_q, block_k, interpret)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -12,6 +12,8 @@ rate. ``qualify_slice`` runs the two north-star probes (BASELINE.md):
 
 from __future__ import annotations
 
+import dataclasses
+import logging
 import time
 from typing import Dict, Optional
 
@@ -48,24 +50,46 @@ def qualify_slice(
     if mesh is None:
         mesh = make_mesh(solve_mesh_axes(len(jax.devices())))
     mc = model_config or ModelConfig(
-        vocab_size=8192, d_model=512, n_layers=4, n_heads=8, d_ff=1408, max_seq=seq
+        vocab_size=8192, d_model=512, n_layers=4, n_heads=8, d_ff=1408, max_seq=seq,
+        # Flash is the Mosaic fast path; in interpret mode (CPU smoke runs)
+        # it would be a Python-looped slow path, so qualify with the fused
+        # XLA reference there instead.
+        attn_impl="flash" if jax.default_backend() == "tpu" else "reference",
     )
-    tc = TrainConfig(model=mc)
 
     results: Dict[str, float] = {
         "n_devices": float(int(np.prod(mesh.devices.shape))),
         "allreduce_gbps": allreduce_bandwidth_gbps(mesh, size_mb=allreduce_mb),
     }
 
-    state = make_train_state(tc, jax.random.key(0), mesh)
-    step_fn, batch_sharding = make_train_step(tc, mesh)
-    tokens = jax.device_put(
-        jax.random.randint(jax.random.key(1), (batch, seq), 0, mc.vocab_size),
-        batch_sharding,
-    )
+    def build(cfg):
+        tc = TrainConfig(model=cfg)
+        st = make_train_state(tc, jax.random.key(0), mesh)
+        fn, sharding = make_train_step(tc, mesh)
+        toks = jax.device_put(
+            jax.random.randint(jax.random.key(1), (batch, seq), 0, cfg.vocab_size),
+            sharding,
+        )
+        st, met = fn(st, toks)  # compile + first step
+        jax.block_until_ready(met)
+        return st, fn, toks, met
 
-    state, metrics = step_fn(state, tokens)  # compile + first step
-    jax.block_until_ready(metrics)
+    try:
+        state, step_fn, tokens, metrics = build(mc)
+    except Exception:
+        # The Pallas kernels are the fast path, never the only path: a
+        # Mosaic lowering regression must degrade the number, not the
+        # bench. The traceback is logged — a silent fallback would bury the
+        # regression behind plausible-looking reference numbers.
+        if mc.attn_impl == "reference":
+            raise
+        logging.getLogger("qualify_slice").warning(
+            "attn_impl=%s failed to build; falling back to reference",
+            mc.attn_impl, exc_info=True,
+        )
+        mc = dataclasses.replace(mc, attn_impl="reference")
+        state, step_fn, tokens, metrics = build(mc)
+    results["attn_impl"] = mc.attn_impl  # type: ignore[assignment]
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step_fn(state, tokens)
