@@ -4,7 +4,7 @@
 PYTHON ?= python
 IMG ?= tpu-composer:latest
 
-.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean
+.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean build-installer bundle
 
 all: native test
 
@@ -45,5 +45,13 @@ lint:
 	$(PYTHON) -m compileall -q tpu_composer tests bench.py __graft_entry__.py
 
 clean:
-	rm -rf native/build
+	rm -rf native/build dist bundle
 	find . -name __pycache__ -type d -exec rm -rf {} +
+
+## build-installer: consolidated apply-able YAML (dist/install.yaml)
+build-installer: manifests
+	$(PYTHON) -m tpu_composer.api.packaging installer --out dist/install.yaml
+
+## bundle: OLM-style bundle dir (manifests/ + metadata/annotations.yaml)
+bundle: manifests
+	$(PYTHON) -m tpu_composer.api.packaging bundle --out bundle

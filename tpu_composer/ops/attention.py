@@ -93,24 +93,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     def _flush():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
-        # Lane-replicated (block_q, 128) layout, matching JAX's own TPU
-        # flash kernels (flash_attention.py MIN_BLOCK_SIZE): Mosaic rejects
-        # a (1, block_q) block over a (BH, S) array because the
-        # second-to-last block dim must be divisible by 8 or equal the
-        # array dim, so the per-row scalar costs 128 lanes either way.
-        lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
-                                      lse_ref.shape[1:])
+        if lse_ref is not None:
+            # Lane-replicated (block_q, 128) layout, matching JAX's own TPU
+            # flash kernels (flash_attention.py MIN_BLOCK_SIZE): Mosaic
+            # rejects a (1, block_q) block over a (BH, S) array because the
+            # second-to-last block dim must be divisible by 8 or equal the
+            # array dim, so the per-row scalar costs 128 lanes either way.
+            lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
+                                          lse_ref.shape[1:])
 
 
-def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret):
-    """(BH, S, D) inputs -> (out, lse). The 3D-grid streaming core."""
+def _fwd_kernel_nolse(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, **kw):
+    """Inference variant: no logsumexp residual written (the primal path
+    discards it, so don't pay the (BH, S, 128) fp32 HBM write)."""
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, None, m_scr, l_scr, acc_scr, **kw)
+
+
+def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret,
+                   with_lse=True):
+    """(BH, S, D) inputs -> (out, lse | None). The 3D-grid streaming core.
+    ``with_lse=False`` (inference / primal-only) skips the residual output
+    entirely."""
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     nq, nk = sq // block_q, sk // block_k
     grid = (bh, nq, nk)
-    kernel = functools.partial(
-        _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k, nk=nk
-    )
+    kw = dict(causal=causal, block_q=block_q, block_k=block_k, nk=nk)
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
@@ -121,27 +129,38 @@ def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret):
         pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer l
         pltpu.VMEM((block_q, d), jnp.float32),  # fp32 output accumulator
     ]
-    out, lse = pl.pallas_call(
-        kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
-        ),
+    o_shape = jax.ShapeDtypeStruct((bh, sq, d), q3.dtype)
+    o_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    lse_spec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    if with_lse:
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel, **kw),
+            out_shape=(o_shape,
+                       jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32)),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=(o_spec, lse_spec),
+            scratch_shapes=scratch,
+            interpret=interpret,
+            **kwargs,
+        )(q3, k3, v3)
+        return out, lse
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel_nolse, **kw),
+        out_shape=o_shape,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
-        ),
+        in_specs=in_specs,
+        out_specs=o_spec,
         scratch_shapes=scratch,
         interpret=interpret,
         **kwargs,
     )(q3, k3, v3)
-    return out, lse
+    return out, None
 
 
 # ---------------------------------------------------------------------------
@@ -303,7 +322,8 @@ def _flash_backward(res, g, causal, block_q, block_k, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_core(q3, k3, v3, causal, block_q, block_k, interpret):
-    out, _ = _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret)
+    out, _ = _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret,
+                            with_lse=False)
     return out
 
 
