@@ -87,6 +87,23 @@ def build_parser() -> argparse.ArgumentParser:
         default=600.0,
         help="grace before orphaned fabric devices are force-detached (reference: 600)",
     )
+    p.add_argument(
+        "--webhook-bind-address",
+        default=os.environ.get("WEBHOOK_BIND_ADDRESS", ""),
+        help="host:port for the AdmissionReview webhook server "
+             "(reference serves :9443; empty disables the HTTP server — "
+             "in-process hooks still run)",
+    )
+    p.add_argument(
+        "--webhook-cert",
+        default=os.environ.get("WEBHOOK_TLS_CERT", ""),
+        help="TLS certificate for the webhook server (cert-manager mount)",
+    )
+    p.add_argument(
+        "--webhook-key",
+        default=os.environ.get("WEBHOOK_TLS_KEY", ""),
+        help="TLS key for the webhook server",
+    )
     p.add_argument("--log-level", default="info",
                    choices=["debug", "info", "warning", "error"])
     return p
@@ -165,6 +182,40 @@ def build_manager(args: argparse.Namespace) -> Manager:
             mgr.add_runnable(MultiNodeWatcher(agent, res_rec))
     if os.environ.get("ENABLE_WEBHOOKS", "").lower() != "false":
         register_validating_webhooks(store)
+        if args.webhook_bind_address:
+            # The AdmissionReview wire server (reference :9443 webhook
+            # server, cmd/main.go:101-103): validating + pod-mutating
+            # endpoints for the API server to call.
+            from tpu_composer.admission.server import AdmissionServer
+
+            def serve_webhooks(stop_event):
+                certfile = args.webhook_cert or None
+                log = logging.getLogger("webhook")
+                if certfile:
+                    # cert-manager writes the serving cert after our pod
+                    # starts (the secret mount is optional) — hold the
+                    # listener until it appears. The API server sees
+                    # connection-refused and retries, so admission
+                    # self-heals the moment the cert lands; serving plain
+                    # HTTP instead would fail every TLS handshake forever.
+                    warned = False
+                    while not os.path.exists(certfile):
+                        if not warned:
+                            log.warning("waiting for webhook cert %s", certfile)
+                            warned = True
+                        if stop_event.wait(2.0):
+                            return
+                webhook = AdmissionServer(
+                    store,
+                    bind=args.webhook_bind_address,
+                    certfile=certfile,
+                    keyfile=(args.webhook_key or None) if certfile else None,
+                )
+                log.info("admission webhooks serving on %s (tls=%s)",
+                         webhook.address, webhook.tls)
+                webhook.run(stop_event)
+
+            mgr.add_runnable(serve_webhooks)
     return mgr
 
 
