@@ -1,0 +1,355 @@
+"""KubeStore against the fake kube-apiserver — the operator *as an operator*.
+
+Round-1 verdict item #2: nothing validated that a ``kubectl apply``-ed
+ComposabilityRequest reaches the operator. Here the full manager (both
+controllers + syncer) runs with ``KubeStore`` as its only client, against a
+server enforcing real apiserver semantics over HTTP (tests/fake_apiserver.py,
+the envtest analog per SURVEY.md §4), and a request seeded straight into the
+server — exactly what kubectl would do — reconciles to Running and cleans up.
+
+Reference analog: internal/controller/suite_test.go:357-385 (envtest) and the
+full-lifecycle entries of composabilityrequest_controller_test.go.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tpu_composer import GROUP, VERSION
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.controllers import (
+    ComposabilityRequestReconciler,
+    ComposableResourceReconciler,
+    RequestTiming,
+    ResourceTiming,
+    UpstreamSyncer,
+)
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.kubestore import CHIP_RESOURCE, KubeConfig, KubeStore
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
+
+from tests.fake_apiserver import FakeApiServer
+
+CR_PREFIX = f"/apis/{GROUP}/{VERSION}/composabilityrequests"
+RES_PREFIX = f"/apis/{GROUP}/{VERSION}/composableresources"
+NODE_PREFIX = "/api/v1/nodes"
+
+
+def core_node(name: str, chips: int = 4) -> dict:
+    """A core-v1-shaped Node as kubelet would publish it."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "status": {
+            "allocatable": {
+                "cpu": "8",
+                "memory": "32Gi",
+                "ephemeral-storage": "100Gi",
+                "pods": "110",
+                CHIP_RESOURCE: str(chips),
+            },
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+@pytest.fixture()
+def apiserver():
+    srv = FakeApiServer(
+        {
+            CR_PREFIX: {
+                "kind": "ComposabilityRequest",
+                "apiVersion": f"{GROUP}/{VERSION}",
+            },
+            RES_PREFIX: {
+                "kind": "ComposableResource",
+                "apiVersion": f"{GROUP}/{VERSION}",
+            },
+            NODE_PREFIX: {"kind": "Node", "apiVersion": "v1"},
+        }
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def kstore(apiserver):
+    ks = KubeStore(
+        config=KubeConfig(host=apiserver.url), watch_reconnect_s=0.05
+    )
+    yield ks
+    ks.close()
+
+
+def wait_for(predicate, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestKubeStoreCrud:
+    def test_create_get_roundtrip(self, kstore):
+        req = ComposabilityRequest(
+            metadata=ObjectMeta(name="r1", labels={"a": "b"}),
+            spec=ComposabilityRequestSpec(
+                resource=ResourceDetails(type="tpu", model="tpu-v4", size=4)
+            ),
+        )
+        created = kstore.create(req)
+        assert created.metadata.uid
+        assert created.metadata.resource_version > 0
+        got = kstore.get(ComposabilityRequest, "r1")
+        assert got.spec.resource.size == 4
+        assert got.metadata.labels == {"a": "b"}
+
+    def test_duplicate_create_is_already_exists(self, kstore):
+        req = ComposabilityRequest(
+            metadata=ObjectMeta(name="dup"),
+            spec=ComposabilityRequestSpec(
+                resource=ResourceDetails(type="tpu", model="tpu-v4", size=1)
+            ),
+        )
+        kstore.create(req)
+        with pytest.raises(AlreadyExistsError):
+            kstore.create(req)
+
+    def test_stale_rv_update_conflicts(self, kstore):
+        req = kstore.create(
+            ComposabilityRequest(
+                metadata=ObjectMeta(name="c1"),
+                spec=ComposabilityRequestSpec(
+                    resource=ResourceDetails(type="tpu", model="tpu-v4", size=1)
+                ),
+            )
+        )
+        fresh = kstore.get(ComposabilityRequest, "c1")
+        fresh.spec.resource.size = 2
+        kstore.update(fresh)
+        stale = req  # has the pre-update RV
+        stale.spec.resource.size = 8
+        with pytest.raises(ConflictError):
+            kstore.update(stale)
+
+    def test_status_subresource_is_isolated(self, kstore):
+        kstore.create(
+            ComposabilityRequest(
+                metadata=ObjectMeta(name="s1"),
+                spec=ComposabilityRequestSpec(
+                    resource=ResourceDetails(type="tpu", model="tpu-v4", size=1)
+                ),
+            )
+        )
+        obj = kstore.get(ComposabilityRequest, "s1")
+        obj.status.state = "Running"
+        kstore.update_status(obj)
+        # spec PUT must not clobber status; status PUT must not clobber spec
+        obj2 = kstore.get(ComposabilityRequest, "s1")
+        assert obj2.status.state == "Running"
+        obj2.spec.resource.size = 2
+        kstore.update(obj2)
+        obj3 = kstore.get(ComposabilityRequest, "s1")
+        assert obj3.status.state == "Running"
+        assert obj3.spec.resource.size == 2
+        # spec change bumped generation
+        assert obj3.metadata.generation == 2
+
+    def test_finalizer_gated_delete(self, kstore):
+        obj = kstore.create(
+            ComposabilityRequest(
+                metadata=ObjectMeta(name="f1", finalizers=["tpu.composer.dev/fin"]),
+                spec=ComposabilityRequestSpec(
+                    resource=ResourceDetails(type="tpu", model="tpu-v4", size=1)
+                ),
+            )
+        )
+        kstore.delete(ComposabilityRequest, "f1")
+        terminating = kstore.get(ComposabilityRequest, "f1")
+        assert terminating.being_deleted
+        terminating.remove_finalizer("tpu.composer.dev/fin")
+        kstore.update(terminating)
+        assert kstore.try_get(ComposabilityRequest, "f1") is None
+        with pytest.raises(NotFoundError):
+            kstore.get(ComposabilityRequest, "f1")
+
+    def test_label_selector_list(self, kstore):
+        for i, team in enumerate(["red", "blue", "red"]):
+            kstore.create(
+                ComposabilityRequest(
+                    metadata=ObjectMeta(name=f"l{i}", labels={"team": team}),
+                    spec=ComposabilityRequestSpec(
+                        resource=ResourceDetails(type="tpu", model="tpu-v4", size=1)
+                    ),
+                )
+            )
+        reds = kstore.list(ComposabilityRequest, label_selector={"team": "red"})
+        assert [o.metadata.name for o in reds] == ["l0", "l2"]
+
+    def test_core_nodes_translate(self, apiserver, kstore):
+        apiserver.put_object(NODE_PREFIX, core_node("worker-0", chips=4))
+        apiserver.put_object(NODE_PREFIX, core_node("worker-1", chips=0))
+        nodes = kstore.list(Node)
+        byname = {n.metadata.name: n for n in nodes}
+        assert byname["worker-0"].status.tpu_slots == 4
+        assert byname["worker-0"].status.ready
+        assert byname["worker-0"].status.milli_cpu == 8000
+        assert byname["worker-1"].status.tpu_slots == 0
+
+    def test_watch_streams_events(self, kstore):
+        q = kstore.watch("ComposabilityRequest")
+        try:
+            kstore.create(
+                ComposabilityRequest(
+                    metadata=ObjectMeta(name="w1"),
+                    spec=ComposabilityRequestSpec(
+                        resource=ResourceDetails(type="tpu", model="tpu-v4", size=1)
+                    ),
+                )
+            )
+            evt = q.get(timeout=5)
+            assert evt.type == "ADDED"
+            assert evt.obj.metadata.name == "w1"
+            obj = kstore.get(ComposabilityRequest, "w1")
+            obj.status.state = "Running"
+            kstore.update_status(obj)
+            evt = q.get(timeout=5)
+            assert evt.type == "MODIFIED"
+            assert evt.obj.status.state == "Running"
+        finally:
+            kstore.stop_watch(q)
+
+
+class TestKubeconfigLoading:
+    def test_build_store_selects_kubestore(self, apiserver, tmp_path):
+        """--kubeconfig routes cmd/main.py's store to the cluster."""
+        import yaml
+
+        from tpu_composer.cmd.main import build_parser, build_store
+
+        kc = tmp_path / "kubeconfig"
+        kc.write_text(
+            yaml.safe_dump(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Config",
+                    "current-context": "test",
+                    "contexts": [
+                        {"name": "test", "context": {"cluster": "c", "user": "u"}}
+                    ],
+                    "clusters": [{"name": "c", "cluster": {"server": apiserver.url}}],
+                    "users": [{"name": "u", "user": {"token": "dummy"}}],
+                }
+            )
+        )
+        args = build_parser().parse_args(["--kubeconfig", str(kc)])
+        store = build_store(args)
+        assert isinstance(store, KubeStore)
+        # it actually reaches the server
+        assert store.list(ComposabilityRequest) == []
+        store.close()
+
+
+class TestOperatorOnCluster:
+    """The full operator loop running against the cluster-shaped API."""
+
+    @pytest.fixture()
+    def operator(self, apiserver, kstore):
+        for i in range(4):
+            apiserver.put_object(NODE_PREFIX, core_node(f"worker-{i}", chips=4))
+        pool = InMemoryPool()
+        agent = FakeNodeAgent(pool=pool)
+        mgr = Manager(store=kstore)
+        mgr.add_controller(
+            ComposabilityRequestReconciler(
+                kstore,
+                pool,
+                timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.05),
+            )
+        )
+        mgr.add_controller(
+            ComposableResourceReconciler(
+                kstore,
+                pool,
+                agent,
+                timing=ResourceTiming(
+                    attach_poll=0.05,
+                    visibility_poll=0.05,
+                    detach_poll=0.05,
+                    detach_fast=0.05,
+                    busy_poll=0.05,
+                ),
+            )
+        )
+        mgr.add_runnable(UpstreamSyncer(kstore, pool, period=0.1, grace=0.5))
+        mgr.start(workers_per_controller=2)
+        yield apiserver, kstore, pool, agent, mgr
+        mgr.stop()
+
+    def test_kubectl_applied_request_reaches_running(self, operator):
+        apiserver, kstore, pool, agent, mgr = operator
+        # What `kubectl apply -f request.yaml` does: the object appears in the
+        # apiserver, NOT through any operator-side API.
+        apiserver.put_object(
+            CR_PREFIX,
+            {
+                "apiVersion": f"{GROUP}/{VERSION}",
+                "kind": "ComposabilityRequest",
+                "metadata": {"name": "from-kubectl"},
+                "spec": {
+                    "resource": {"type": "tpu", "model": "tpu-v4", "size": 8}
+                },
+            },
+        )
+
+        def running():
+            obj = apiserver.get_object(CR_PREFIX, "from-kubectl")
+            return obj and obj.get("status", {}).get("state") == "Running"
+
+        assert wait_for(running), (
+            "kubectl-applied request never reached Running; last="
+            f"{apiserver.get_object(CR_PREFIX, 'from-kubectl')}"
+        )
+        obj = apiserver.get_object(CR_PREFIX, "from-kubectl")
+        assert len(obj["status"]["resources"]) >= 1
+        # children exist in the apiserver too
+        children = [
+            o
+            for (p, _), o in apiserver.state.objects.items()
+            if p == RES_PREFIX
+        ]
+        assert children, "no ComposableResource children on the apiserver"
+
+        # kubectl delete → full teardown
+        url = f"{apiserver.url}{CR_PREFIX}/from-kubectl"
+        req = urllib.request.Request(url, method="DELETE")
+        urllib.request.urlopen(req)
+        assert wait_for(
+            lambda: apiserver.get_object(CR_PREFIX, "from-kubectl") is None
+        ), "request not purged after kubectl delete"
+        assert wait_for(
+            lambda: not [
+                o for (p, _), o in apiserver.state.objects.items() if p == RES_PREFIX
+            ]
+        ), "children not purged after kubectl delete"
+        assert not pool.get_resources(), "pool still holds attachments"

@@ -30,6 +30,6 @@ finalizers, watches).
 
 __version__ = "0.1.0"
 
-GROUP = "cro.tpu.composer.dev"
+GROUP = "tpu.composer.dev"
 VERSION = "v1alpha1"
 API_VERSION = f"{GROUP}/{VERSION}"

@@ -19,8 +19,7 @@ from tpu_composer.api.types import (
     DEVICE_TYPES,
 )
 
-GROUP = "tpu.composer.dev"
-VERSION = "v1alpha1"
+from tpu_composer import GROUP, VERSION  # single source of truth for the API group
 
 
 def _str(desc: str = "", enum: List[str] = None, min_length: int = 0) -> Dict:

@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Type
 
+from tpu_composer.api.lease import Lease
 from tpu_composer.api.meta import ApiObject
 from tpu_composer.api.types import ComposabilityRequest, ComposableResource, Node
 
@@ -58,4 +59,5 @@ def default_scheme() -> Scheme:
     s.register(ComposabilityRequest)
     s.register(ComposableResource)
     s.register(Node)
+    s.register(Lease)
     return s

