@@ -1,0 +1,233 @@
+"""Lease-based leader election (VERDICT r1 #6).
+
+Reference analog: cmd/main.go:142-155 — controller-runtime Lease election.
+Acceptance (VERDICT "Next round" #6): two managers against one store, exactly
+one reconciles, failover on release. Exercised both on the in-proc store and
+through KubeStore against the fake apiserver (the cluster path that actually
+matters for HA across nodes).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tpu_composer import GROUP, VERSION
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.lease import Lease
+from tpu_composer.controllers import (
+    ComposabilityRequestReconciler,
+    ComposableResourceReconciler,
+    RequestTiming,
+    ResourceTiming,
+)
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.leases import LeaseElector
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.store import Store
+
+from tests.fake_apiserver import FakeApiServer
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestLeaseElector:
+    def test_single_winner_and_failover(self, store):
+        a = LeaseElector(store, identity="replica-a",
+                         lease_duration_s=1.0, renew_period_s=0.2)
+        b = LeaseElector(store, identity="replica-b",
+                         lease_duration_s=1.0, renew_period_s=0.2)
+        assert a.try_acquire()
+        assert not b.try_acquire(), "two leaders at once"
+        assert a.is_leader and not b.is_leader
+        lease = store.get(Lease, a.name)
+        assert lease.spec.holder_identity == "replica-a"
+
+        # voluntary release → instant failover
+        a.release()
+        assert wait_for(b.try_acquire, timeout=3)
+        assert b.is_leader
+        lease = store.get(Lease, a.name)
+        assert lease.spec.holder_identity == "replica-b"
+        assert lease.spec.lease_transitions >= 1
+        b.release()
+
+    def test_expired_lease_is_stolen(self, store):
+        a = LeaseElector(store, identity="replica-a",
+                         lease_duration_s=1.0, renew_period_s=10.0)
+        b = LeaseElector(store, identity="replica-b",
+                         lease_duration_s=1.0, renew_period_s=0.1)
+        assert a.try_acquire()
+        # Simulate a crashed leader: stop its renew loop without releasing.
+        a._stop_renew.set()
+        assert not b.try_acquire(), "stole a live lease"
+        assert wait_for(b.try_acquire, timeout=5), "never stole expired lease"
+        assert b.is_leader
+
+    def test_deposed_leader_stands_down(self, store):
+        a = LeaseElector(store, identity="replica-a",
+                         lease_duration_s=1.0, renew_period_s=0.1)
+        assert a.try_acquire()
+        # Another replica force-takes the lease (as after a partition heals).
+        lease = store.get(Lease, a.name)
+        lease.spec.holder_identity = "replica-b"
+        store.update(lease)
+        assert wait_for(lambda: not a.is_leader, timeout=3), (
+            "old leader still claims leadership after losing the lease"
+        )
+
+
+class TestManagersFailover:
+    """Two full managers on one store: only the leader reconciles."""
+
+    def _manager(self, store, pool, ident):
+        agent = FakeNodeAgent(pool=pool)
+        mgr = Manager(
+            store=store,
+            leader_elector=LeaseElector(
+                store, identity=ident, lease_duration_s=1.0, renew_period_s=0.2
+            ),
+        )
+        mgr.add_controller(ComposabilityRequestReconciler(
+            store, pool, timing=RequestTiming(updating_poll=0.05,
+                                              cleaning_poll=0.05)))
+        mgr.add_controller(ComposableResourceReconciler(
+            store, pool, agent,
+            timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
+                                  detach_poll=0.05, detach_fast=0.05,
+                                  busy_poll=0.05)))
+        return mgr
+
+    def test_exactly_one_reconciles_then_failover(self, store):
+        for i in range(2):
+            n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+            n.status.tpu_slots = 4
+            store.create(n)
+        pool = InMemoryPool()
+        m1 = self._manager(store, pool, "replica-1")
+        m2 = self._manager(store, pool, "replica-2")
+        m1.start(workers_per_controller=1)
+        # m2 blocks on the lease in a thread (Manager.start blocks until
+        # acquired) — run it in the background like a second pod.
+        t2 = threading.Thread(target=m2.start, daemon=True)
+        t2.start()
+        try:
+            assert wait_for(lambda: m1._elector.is_leader, timeout=5)
+            assert not m2._elector.is_leader
+
+            store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name="r1"),
+                spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                    type="tpu", model="tpu-v4", size=4)),
+            ))
+            assert wait_for(
+                lambda: store.get(ComposabilityRequest, "r1").status.state
+                == "Running", timeout=10,
+            ), "leader never reconciled the request"
+
+            # leader dies → standby takes over and keeps reconciling
+            m1.stop()
+            assert wait_for(lambda: m2._elector.is_leader, timeout=10), (
+                "standby never became leader after failover"
+            )
+            store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name="r2"),
+                spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                    type="tpu", model="tpu-v4", size=4)),
+            ))
+            assert wait_for(
+                lambda: store.get(ComposabilityRequest, "r2").status.state
+                == "Running", timeout=10,
+            ), "new leader never reconciled"
+        finally:
+            m1.stop()
+            m2.stop()
+            t2.join(timeout=5)
+
+
+class TestDeposedManagerStopsDriving:
+    """Fencing enforcement: a manager whose lease is stolen must stop its
+    controllers (split-brain guard — client-go's analog exits the process)."""
+
+    def test_watchdog_stops_manager_on_lost_lease(self, store):
+        n = Node(metadata=ObjectMeta(name="worker-0"))
+        n.status.tpu_slots = 4
+        store.create(n)
+        pool = InMemoryPool()
+        agent = FakeNodeAgent(pool=pool)
+        mgr = Manager(
+            store=store,
+            leader_elector=LeaseElector(
+                store, identity="old-leader",
+                lease_duration_s=1.0, renew_period_s=0.1,
+            ),
+        )
+        mgr.add_controller(ComposabilityRequestReconciler(
+            store, pool, timing=RequestTiming(updating_poll=0.05,
+                                              cleaning_poll=0.05)))
+        mgr.start(workers_per_controller=1)
+        try:
+            assert mgr._elector.is_leader
+            # Another replica force-takes the lease (post-partition).
+            lease = store.get(Lease, mgr._elector.name)
+            lease.spec.holder_identity = "usurper"
+            store.update(lease)
+            assert wait_for(lambda: mgr.lost_leadership, timeout=5), (
+                "manager never noticed lost leadership"
+            )
+            assert wait_for(
+                lambda: all(
+                    not t.is_alive() for t in mgr._controllers[0]._threads
+                ),
+                timeout=5,
+            ), "controllers still running after losing the lease"
+        finally:
+            mgr.stop()
+
+
+class TestLeaseOnKubeStore:
+    """The cluster path: Lease CAS through the apiserver wire protocol."""
+
+    @pytest.fixture()
+    def kstore(self):
+        from tpu_composer.runtime.kubestore import KubeConfig, KubeStore
+
+        prefix = "/apis/coordination.k8s.io/v1/namespaces/tpu-composer-system/leases"
+        srv = FakeApiServer({
+            prefix: {"kind": "Lease", "apiVersion": "coordination.k8s.io/v1"},
+        })
+        srv.start()
+        ks = KubeStore(config=KubeConfig(host=srv.url), watch_reconnect_s=0.05)
+        yield ks
+        ks.close()
+        srv.stop()
+
+    def test_cas_over_the_wire(self, kstore):
+        a = LeaseElector(kstore, identity="pod-a",
+                         lease_duration_s=1.0, renew_period_s=0.2)
+        b = LeaseElector(kstore, identity="pod-b",
+                         lease_duration_s=1.0, renew_period_s=0.2)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        got = kstore.get(Lease, a.name)
+        assert got.spec.holder_identity == "pod-a"
+        a.release()
+        assert wait_for(b.try_acquire, timeout=3)
+        assert kstore.get(Lease, b.name).spec.holder_identity == "pod-b"
+        b.release()

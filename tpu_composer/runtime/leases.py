@@ -1,0 +1,204 @@
+"""Lease-based leader election over any Store (in-proc or kube-apiserver).
+
+Reference analog: cmd/main.go:142-155 — controller-runtime leader election
+with ID ``c5744f42.hpsys.ibm.ie.com``, which is client-go's leaderelection
+package under the hood: a coordination.k8s.io Lease CAS'd with
+resourceVersion preconditions, renewed every ``renew_period``, stealable once
+``lease_duration`` elapses without a renewal. ``LeaseElector`` implements
+exactly that loop against our ``Store`` interface, so the same code elects
+across replicas on a real cluster (KubeStore) and across processes sharing a
+persistent standalone store. The file-lock ``LeaderElector`` remains for
+single-host standalone deployments without a shared store.
+
+Interface-compatible with ``runtime.leader.LeaderElector``:
+``try_acquire() / acquire() / release() / is_leader``; additionally runs a
+background renew thread while leading, and drops ``is_leader`` if renewal
+fails longer than the lease duration (the fencing contract: a partitioned
+leader stops acting before a successor can take over).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+import socket
+import threading
+import uuid
+from typing import Optional
+
+from tpu_composer.api.lease import Lease, LeaseSpec
+from tpu_composer.api.meta import ObjectMeta, now_iso, parse_iso
+from tpu_composer.runtime.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    StoreError,
+)
+
+LEADER_ELECTION_ID = "c5744f42.tpu.composer.dev"
+
+
+def default_identity() -> str:
+    """hostname_uuid — the same shape client-go uses (id must be unique per
+    replica even on one host)."""
+    return f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+
+
+class LeaseElector:
+    def __init__(
+        self,
+        store,
+        name: str = LEADER_ELECTION_ID,
+        identity: str = "",
+        lease_duration_s: float = 15.0,
+        renew_period_s: float = 5.0,
+    ) -> None:
+        self.store = store
+        self.name = name
+        self.identity = identity or default_identity()
+        self.lease_duration_s = lease_duration_s
+        self.renew_period_s = renew_period_s
+        self.log = logging.getLogger("LeaseElector")
+        self._lock = threading.Lock()
+        self._leading = False
+        self._stop_renew = threading.Event()
+        self._renew_thread: Optional[threading.Thread] = None
+        # Parity with LeaderElector's log line
+        self.lock_path = f"lease/{name}"
+
+    # ------------------------------------------------------------------
+    def _now(self) -> datetime.datetime:
+        return datetime.datetime.now(datetime.timezone.utc)
+
+    def _expired(self, spec: LeaseSpec) -> bool:
+        if not spec.holder_identity:
+            return True
+        if not spec.renew_time:
+            return True
+        try:
+            renewed = parse_iso(spec.renew_time)
+        except ValueError:
+            return True
+        age = (self._now() - renewed).total_seconds()
+        return age > spec.lease_duration_seconds
+
+    def try_acquire(self) -> bool:
+        """One CAS attempt: create the Lease, renew our own, or steal an
+        expired one. Never blocks beyond the store round trip."""
+        with self._lock:
+            if self._leading:
+                return True
+            now = now_iso()
+            try:
+                existing = self.store.try_get(Lease, self.name)
+                if existing is None:
+                    self.store.create(
+                        Lease(
+                            metadata=ObjectMeta(name=self.name),
+                            spec=LeaseSpec(
+                                holder_identity=self.identity,
+                                lease_duration_seconds=max(1, round(self.lease_duration_s)),
+                                acquire_time=now,
+                                renew_time=now,
+                            ),
+                        )
+                    )
+                elif existing.spec.holder_identity == self.identity:
+                    existing.spec.renew_time = now
+                    self.store.update(existing)
+                elif self._expired(existing.spec):
+                    existing.spec.holder_identity = self.identity
+                    existing.spec.acquire_time = now
+                    existing.spec.renew_time = now
+                    existing.spec.lease_transitions += 1
+                    self.store.update(existing)  # CAS via resourceVersion
+                else:
+                    return False
+            except (AlreadyExistsError, ConflictError):
+                return False  # another replica won the race
+            except StoreError as e:
+                self.log.warning("lease acquire failed: %s", e)
+                return False
+            self._leading = True
+            self._start_renewing()
+            return True
+
+    def acquire(
+        self,
+        poll_interval: float = 0.5,
+        stop_event: Optional[threading.Event] = None,
+    ) -> bool:
+        """Block until leadership is acquired (or stop_event is set)."""
+        while True:
+            if self.try_acquire():
+                return True
+            if stop_event is not None and stop_event.wait(poll_interval):
+                return False
+            if stop_event is None:
+                import time
+
+                time.sleep(poll_interval)
+
+    # ------------------------------------------------------------------
+    def _start_renewing(self) -> None:
+        self._stop_renew.clear()
+        self._renew_thread = threading.Thread(
+            target=self._renew_loop, name="lease-renew", daemon=True
+        )
+        self._renew_thread.start()
+
+    def _renew_loop(self) -> None:
+        last_success = self._now()
+        while not self._stop_renew.wait(self.renew_period_s):
+            try:
+                lease = self.store.get(Lease, self.name)
+                if lease.spec.holder_identity != self.identity:
+                    # someone stole it (we must have been expired) — stand down
+                    self.log.warning(
+                        "lease lost to %s", lease.spec.holder_identity
+                    )
+                    with self._lock:
+                        self._leading = False
+                    return
+                lease.spec.renew_time = now_iso()
+                self.store.update(lease)
+                last_success = self._now()
+            except (ConflictError, NotFoundError, StoreError) as e:
+                # Fencing: if we cannot renew for a full lease duration,
+                # another replica may already lead — stop claiming we do.
+                failing_for = (self._now() - last_success).total_seconds()
+                self.log.warning(
+                    "lease renew failed (%.0fs): %s", failing_for, e
+                )
+                if failing_for > self.lease_duration_s:
+                    with self._lock:
+                        self._leading = False
+                    return
+
+    # ------------------------------------------------------------------
+    def release(self) -> None:
+        """Give the lease up voluntarily (clean shutdown → instant failover,
+        like client-go's ReleaseOnCancel)."""
+        with self._lock:
+            was_leading = self._leading
+            self._leading = False
+        self._stop_renew.set()
+        if self._renew_thread is not None:
+            self._renew_thread.join(timeout=self.renew_period_s + 1)
+            self._renew_thread = None
+        if not was_leading:
+            return
+        try:
+            lease = self.store.try_get(Lease, self.name)
+            if lease is not None and lease.spec.holder_identity == self.identity:
+                lease.spec.holder_identity = ""
+                lease.spec.renew_time = ""
+                self.store.update(lease)
+        except StoreError:
+            pass  # expiry will free it
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self._leading
