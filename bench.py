@@ -11,7 +11,10 @@ reconcile requeues (composableresource_controller.go:236,298; BASELINE.md
 single most favorable quantum — 30 s — as the reference p50; vs_baseline is
 baseline_ms / our_p50_ms. The fabric itself is mocked identically for both
 sides of the comparison (the reference's latency floor comes from its control
-loop, not the fabric).
+loop, not the fabric). The headline p50 is measured with an injected 10 ms
+apiserver-like round trip on every store op — charging our control loop the
+network toll the reference's client-go calls pay — and the raw in-process
+number is reported alongside in ``extra.raw_inproc_p50_ms``.
 
 The `extra` block carries the TPU-side qualification numbers (allreduce busbw
 over the device mesh — 0.0 on a single chip, where no ICI exists — and the
@@ -27,8 +30,13 @@ import time
 REFERENCE_P50_MS = 30_000.0  # one reference requeue quantum (BASELINE.md)
 
 
-def bench_attach_to_ready(cycles: int = 40, size: int = 8):
-    """Full request lifecycle through the live threaded operator."""
+def bench_attach_to_ready(cycles: int = 40, size: int = 8, store_latency_s: float = 0.0):
+    """Full request lifecycle through the live threaded operator.
+
+    ``store_latency_s`` > 0 injects an apiserver-like round trip into every
+    store op (VERDICT r1 #7): the reference pays a networked kube-apiserver
+    on each of its ~dozens of client calls per attach, so the honest
+    comparison charges our control loop the same toll."""
     from tpu_composer.api import (
         ComposabilityRequest,
         ComposabilityRequestSpec,
@@ -48,7 +56,7 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8):
     from tpu_composer.runtime.manager import Manager
     from tpu_composer.runtime.store import Store
 
-    store = Store()
+    store = Store(latency_s=store_latency_s)
     for i in range(8):
         n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
         n.status.tpu_slots = 4
@@ -101,58 +109,45 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8):
     }
 
 
-_ACCEL_PROBE = """
-import json, sys
-import jax
-from tpu_composer.workload.acceptance import qualify_slice
-results = qualify_slice(batch=4, seq=512, allreduce_mb=16.0, steps=5)
-results["backend"] = jax.default_backend()
-print("ACCEL_RESULT " + json.dumps(results))
-"""
+def bench_accelerator():
+    """Staged slice qualification on the local accelerator (VERDICT r1 #1).
 
-
-def bench_accelerator(timeout_s: float = 420.0):
-    """Slice qualification on the local accelerator, run in a subprocess with
-    a hard timeout — a hung device tunnel must not sink the headline metric."""
+    Each stage (backend init, matmul, on-chip flash-attention validation,
+    full qualify) has its own deadline and reports the moment it completes,
+    so a hung device tunnel costs one stage's timeout and still yields every
+    earlier stage's numbers plus a named-stage diagnosis."""
     import os
-    import subprocess
-    import sys
 
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) + os.pathsep + env.get(
-        "PYTHONPATH", ""
+    from tpu_composer.workload.probe import staged_accelerator_probe
+
+    return staged_accelerator_probe(
+        repo_root=os.path.dirname(os.path.abspath(__file__))
     )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _ACCEL_PROBE],
-            capture_output=True, text=True, timeout=timeout_s, env=env,
-        )
-    except subprocess.TimeoutExpired:
-        return {"error": f"accelerator probe timed out after {timeout_s:.0f}s"}
-    for line in proc.stdout.splitlines():
-        if line.startswith("ACCEL_RESULT "):
-            return json.loads(line[len("ACCEL_RESULT "):])
-    tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
-    return {"error": f"accelerator probe failed (rc={proc.returncode}): {' | '.join(tail)}"}
+
+
+APISERVER_RTT_S = 0.010  # injected per-op latency: typical in-cluster apiserver RTT
 
 
 def main():
-    attach = bench_attach_to_ready()
+    attach_raw = bench_attach_to_ready()
+    # Honest comparison mode (VERDICT r1 #7): charge every store op an
+    # apiserver-like 10 ms round trip, as the reference's client-go calls pay.
+    attach_inj = bench_attach_to_ready(cycles=20, store_latency_s=APISERVER_RTT_S)
     accel = bench_accelerator()
     out = {
         "metric": "attach_to_ready_p50",
-        "value": round(attach["p50"], 3),
+        "value": round(attach_inj["p50"], 3),
         "unit": "ms",
-        "vs_baseline": round(REFERENCE_P50_MS / attach["p50"], 1),
+        "vs_baseline": round(REFERENCE_P50_MS / attach_inj["p50"], 1),
         "extra": {
-            "attach_p90_ms": round(attach["p90"], 3),
-            "attach_max_ms": round(attach["max"], 3),
-            "cycles": attach["cycles"],
+            "attach_p90_ms": round(attach_inj["p90"], 3),
+            "attach_max_ms": round(attach_inj["max"], 3),
+            "cycles": attach_inj["cycles"],
+            "injected_store_latency_ms": APISERVER_RTT_S * 1e3,
+            "raw_inproc_p50_ms": round(attach_raw["p50"], 3),
+            "raw_inproc_p90_ms": round(attach_raw["p90"], 3),
             "baseline_p50_ms": REFERENCE_P50_MS,
-            "accelerator": {
-                k: (round(v, 3) if isinstance(v, float) else v)
-                for k, v in accel.items()
-            },
+            "accelerator": accel,
         },
     }
     print(json.dumps(out))

@@ -78,8 +78,16 @@ class Store:
         self,
         scheme: Optional[Scheme] = None,
         persist_dir: Optional[str] = None,
+        latency_s: float = 0.0,
     ) -> None:
+        """``latency_s`` injects an apiserver-like round-trip delay at the
+        entry of every CRUD call (outside the lock, so concurrent clients
+        overlap their waits the way HTTP requests to a real apiserver do).
+        Used by bench.py for the honest reference comparison: the reference
+        pays a networked kube-apiserver on every store op, the in-proc store
+        pays nanoseconds — the injected mode levels that."""
         self._scheme = scheme or default_scheme()
+        self._latency_s = latency_s
         self._lock = threading.RLock()
         # (kind, name) -> object. All objects are cluster-scoped, like the
         # reference's CRDs (+kubebuilder:resource:scope=Cluster).
@@ -180,7 +188,14 @@ class Store:
         self._rv_counter += 1
         return self._rv_counter
 
+    def _rtt(self) -> None:
+        if self._latency_s:
+            import time
+
+            time.sleep(self._latency_s)
+
     def create(self, obj: T) -> T:
+        self._rtt()
         obj = obj.deepcopy()
         if not obj.metadata.name:
             raise StoreError("metadata.name is required")
@@ -204,6 +219,7 @@ class Store:
             return obj.deepcopy()
 
     def get(self, cls: Type[T], name: str) -> T:
+        self._rtt()
         with self._lock:
             try:
                 obj = self._objects[(cls.KIND, name)]
@@ -222,6 +238,7 @@ class Store:
         cls: Type[T],
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[T]:
+        self._rtt()
         with self._lock:
             out: List[T] = []
             for (kind, _), obj in sorted(self._objects.items()):
@@ -247,6 +264,7 @@ class Store:
         If the object is terminating and this update removes the last
         finalizer, the object is purged (DELETED event) — K8s semantics.
         """
+        self._rtt()
         obj = obj.deepcopy()
         with self._lock:
             key = (obj.KIND, obj.metadata.name)
@@ -280,6 +298,7 @@ class Store:
 
     def update_status(self, obj: T) -> T:
         """Persist only ``status`` (status subresource semantics)."""
+        self._rtt()
         obj = obj.deepcopy()
         with self._lock:
             key = (obj.KIND, obj.metadata.name)
@@ -302,6 +321,7 @@ class Store:
         controllers run their teardown states (the reference's Cleaning /
         Detaching paths). Without: purges immediately.
         """
+        self._rtt()
         with self._lock:
             key = (cls.KIND, name)
             stored = self._objects.get(key)

@@ -1,0 +1,288 @@
+"""Staged accelerator probe — produce numbers *or* a named-stage diagnosis.
+
+Round 1's bench ran the whole slice qualification in one subprocess under one
+420 s timeout and returned nothing when the device tunnel hung — so the bench
+carried zero accelerator evidence (VERDICT.md "What's weak" #1). This module
+splits the probe into ordered stages, each reported the moment it completes:
+
+  devnodes      device-node / env / lockfile enumeration (pure os, in-process)
+  backend_init  ``jax.devices()`` — PJRT plugin + tunnel handshake
+  matmul        one tiny jitted bf16 matmul (compiler + executor round trip)
+  flash_attn    Pallas flash fwd+bwd vs the XLA reference (numerics on-chip)
+  qualify       full ``qualify_slice`` (allreduce busbw + train-step TFLOPS)
+
+Stages after ``devnodes`` run in ONE subprocess that prints a
+``STAGE_RESULT <json>`` line per completed stage; the parent tails the pipe
+with a per-stage deadline. A hang therefore costs only the hanging stage's
+timeout and still yields every earlier stage's numbers plus the name of the
+stage that died and the subprocess's stderr tail.
+
+Reference analog: the reference's only device health probe is `nvidia-smi`
+answering over pod-exec (/root/reference/internal/utils/gpus.go:207-239);
+it has no staged diagnosis at all — a hang there surfaces as a generic
+reconcile timeout.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# Each stage gets its own deadline, measured from the previous stage's
+# completion. backend_init dominates: a cold PJRT tunnel handshake plus the
+# first compile is the documented slow path.
+STAGE_TIMEOUTS_S: Dict[str, float] = {
+    "backend_init": 240.0,
+    "matmul": 120.0,
+    "flash_attn": 240.0,
+    "qualify": 300.0,
+}
+
+_CHILD = r"""
+import json, os, time
+
+def emit(stage, t0, **kv):
+    kv["stage"] = stage
+    kv["seconds"] = round(time.time() - t0, 2)
+    print("STAGE_RESULT " + json.dumps(kv), flush=True)
+
+t0 = time.time()
+import jax
+# The image's sitecustomize registers the accelerator platform at interpreter
+# start and the env var alone is read too late to override it — honor an
+# explicit JAX_PLATFORMS through the live config (same dance as
+# tests/conftest.py), so CPU smoke runs of this probe exercise every stage.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+devs = jax.devices()
+try:
+    version = jax.extend.backend.get_backend().platform_version
+except Exception:
+    version = "unknown"
+emit("backend_init", t0, backend=jax.default_backend(),
+     n_devices=len(devs), device_kind=devs[0].device_kind,
+     platform_version=version)
+
+t0 = time.time()
+import jax.numpy as jnp
+x = jnp.ones((512, 512), jnp.bfloat16)
+y = jax.jit(lambda a: a @ a)(x)
+y.block_until_ready()
+emit("matmul", t0, ok=True, result_dtype=str(y.dtype))
+
+t0 = time.time()
+try:
+    from tpu_composer.workload.probe import flash_attention_on_chip
+    emit("flash_attn", t0, **flash_attention_on_chip())
+except Exception as e:  # noqa: BLE001 - diagnosis, not control flow
+    emit("flash_attn", t0, error=f"{type(e).__name__}: {e}")
+
+t0 = time.time()
+from tpu_composer.workload.acceptance import qualify_slice
+results = qualify_slice(batch=4, seq=512, allreduce_mb=16.0, steps=5)
+results["backend"] = jax.default_backend()
+emit("qualify", t0, **results)
+"""
+
+
+def probe_devnodes() -> Dict[str, Any]:
+    """Stage a: what does the host itself say about accelerators?
+
+    Pure filesystem/env enumeration — cannot hang, runs in-process. Mirrors
+    what `native/tpunode.cc` scans, plus the libtpu/PJRT environment that
+    decides which backend ``jax.devices()`` will try to bring up.
+    """
+    out: Dict[str, Any] = {
+        "accel_nodes": sorted(glob.glob("/dev/accel*")),
+        "vfio_nodes": sorted(glob.glob("/dev/vfio/*")),
+        "libtpu_lockfile": os.path.exists("/tmp/libtpu_lockfile"),
+        "env": {
+            k: v
+            for k, v in os.environ.items()
+            if k.startswith(("JAX_", "TPU_", "XLA_", "PJRT_", "LIBTPU"))
+            or "AXON" in k
+        },
+    }
+    try:
+        import importlib.util
+
+        out["libtpu_installed"] = importlib.util.find_spec("libtpu") is not None
+    except Exception:
+        out["libtpu_installed"] = False
+    return out
+
+
+def flash_attention_on_chip(
+    batch: int = 2, heads: int = 4, seq: int = 1024, head_dim: int = 128
+) -> Dict[str, Any]:
+    """Validate the Pallas flash kernels on the live backend (VERDICT #4).
+
+    Runs fwd+bwd through both the flash path and the XLA einsum reference,
+    asserts numerics, and times both at the given seq. Only meaningful on a
+    TPU backend (Mosaic lowering); on CPU it reports the backend and skips.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": f"backend is {jax.default_backend()}, not tpu"}
+
+    from tpu_composer.ops.attention import flash_attention, mha_reference
+
+    key = jax.random.key(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (batch, heads, seq, head_dim)
+    q = jax.random.normal(kq, shape, jnp.bfloat16)
+    k = jax.random.normal(kk, shape, jnp.bfloat16)
+    v = jax.random.normal(kv, shape, jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    def loss_ref(q, k, v):
+        return mha_reference(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    f_fwd = jax.jit(lambda *a: flash_attention(*a, causal=True))
+    r_fwd = jax.jit(lambda *a: mha_reference(*a, causal=True))
+    f_grad = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+    r_grad = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))
+
+    of = f_fwd(q, k, v).block_until_ready()
+    orf = r_fwd(q, k, v).block_until_ready()
+    fwd_err = float(
+        jnp.max(jnp.abs(of.astype(jnp.float32) - orf.astype(jnp.float32)))
+    )
+    gf = jax.block_until_ready(f_grad(q, k, v))
+    gr = jax.block_until_ready(r_grad(q, k, v))
+    bwd_err = max(
+        float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+        for a, b in zip(gf, gr)
+    )
+
+    def bench(fn, *args, iters=20):
+        fn(*args)  # warm
+        jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    flash_ms = bench(f_fwd, q, k, v)
+    ref_ms = bench(r_fwd, q, k, v)
+    flash_bwd_ms = bench(f_grad, q, k, v)
+    ref_bwd_ms = bench(r_grad, q, k, v)
+
+    # bf16 tolerance: sums over seq-length dot products accumulate ~1e-2.
+    ok = fwd_err < 0.1 and bwd_err < 0.5
+    return {
+        "numerics_ok": ok,
+        "fwd_max_err": round(fwd_err, 5),
+        "bwd_max_err": round(bwd_err, 5),
+        "seq": seq,
+        "flash_fwd_ms": round(flash_ms, 3),
+        "ref_fwd_ms": round(ref_ms, 3),
+        "flash_bwd_ms": round(flash_bwd_ms, 3),
+        "ref_bwd_ms": round(ref_bwd_ms, 3),
+        "fwd_speedup": round(ref_ms / flash_ms, 2),
+        "bwd_speedup": round(ref_bwd_ms / flash_bwd_ms, 2),
+    }
+
+
+def staged_accelerator_probe(
+    repo_root: Optional[str] = None,
+    timeouts: Optional[Dict[str, float]] = None,
+) -> Dict[str, Any]:
+    """Run all stages; return {stages: {...}, completed: [...], failed_stage,
+    diagnosis}. Never raises, never hangs past the per-stage deadlines."""
+    timeouts = {**STAGE_TIMEOUTS_S, **(timeouts or {})}
+    stages: Dict[str, Any] = {"devnodes": probe_devnodes()}
+    completed: List[str] = ["devnodes"]
+    order = ["backend_init", "matmul", "flash_attn", "qualify"]
+
+    env = dict(os.environ)
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", _CHILD],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+    )
+
+    stderr_buf: List[str] = []
+    t_err = threading.Thread(
+        target=lambda: stderr_buf.extend(proc.stderr), daemon=True  # type: ignore[arg-type]
+    )
+    t_err.start()
+
+    lines: "list[str]" = []
+    done = threading.Event()
+
+    def reader():
+        for line in proc.stdout:  # type: ignore[union-attr]
+            lines.append(line)
+        done.set()
+
+    t_out = threading.Thread(target=reader, daemon=True)
+    t_out.start()
+
+    failed_stage: Optional[str] = None
+    idx = 0
+
+    def drain() -> None:
+        nonlocal idx
+        while idx < len(lines):
+            line = lines[idx]
+            idx += 1
+            if line.startswith("STAGE_RESULT "):
+                rec = json.loads(line[len("STAGE_RESULT "):])
+                stages[rec.pop("stage")] = rec
+
+    for stage in order:
+        deadline = time.monotonic() + timeouts[stage]
+        while time.monotonic() < deadline:
+            drain()
+            if stage in stages or done.is_set():
+                break
+            time.sleep(0.2)
+        # The reader may have appended final lines between the last drain and
+        # observing done — drain once more before declaring a stage failed.
+        drain()
+        if stage in stages:
+            completed.append(stage)
+        else:
+            failed_stage = stage
+            proc.kill()
+            break
+
+    if failed_stage is None:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        if proc.returncode not in (0, None) and order[-1] not in stages:
+            failed_stage = next(s for s in order if s not in stages)
+
+    t_err.join(timeout=5)
+    result: Dict[str, Any] = {"stages": stages, "completed": completed}
+    if failed_stage:
+        result["failed_stage"] = failed_stage
+        tail = "".join(stderr_buf).strip().splitlines()[-6:]
+        result["diagnosis"] = {
+            "timeout_s": timeouts.get(failed_stage),
+            "stderr_tail": tail,
+            "libtpu_lockfile": os.path.exists("/tmp/libtpu_lockfile"),
+            "accel_nodes_present": bool(stages["devnodes"]["accel_nodes"]),
+        }
+    return result
