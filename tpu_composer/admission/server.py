@@ -28,6 +28,7 @@ import base64
 import copy
 import json
 import logging
+import socket
 import ssl
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -58,6 +59,9 @@ class _TlsPerConnectionServer(ThreadingHTTPServer):
     ssl_context: Optional[ssl.SSLContext] = None
     daemon_threads = True
     handshake_timeout = 10.0
+    # Post-handshake read timeout: long enough for the API server's
+    # keep-alive reuse, short enough that dead peers release threads.
+    io_timeout = 65.0
 
     def finish_request(self, request, client_address):
         if self.ssl_context is not None:
@@ -70,8 +74,39 @@ class _TlsPerConnectionServer(ThreadingHTTPServer):
                 except OSError:
                     pass
                 return
-            request.settimeout(self.handshake_timeout)
+            # wrap_socket detached the original socket, so ThreadingMixIn's
+            # shutdown_request (which still holds the pre-wrap object) can
+            # never shut the wrapped SSLSocket down — do it here, and reset
+            # the handshake timeout so idle keep-alive connections are not
+            # killed after 10s.
+            request.settimeout(self.io_timeout)
+            try:
+                super().finish_request(request, client_address)
+            finally:
+                try:
+                    request.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+                try:
+                    request.close()
+                except OSError:
+                    pass
+            return
         super().finish_request(request, client_address)
+
+    def handle_error(self, request, client_address):
+        """Expected disconnects (client closed mid-request, TLS teardown,
+        idle timeout) are connection noise, not server errors — log at debug
+        instead of dumping tracebacks to stderr."""
+        import sys
+
+        exc = sys.exception()
+        if isinstance(exc, (ConnectionError, TimeoutError, ssl.SSLError, OSError)):
+            logging.getLogger("AdmissionServer").debug(
+                "connection from %s dropped: %s", client_address, exc
+            )
+            return
+        super().handle_error(request, client_address)
 
 
 def _review_response(uid: str, allowed: bool, message: str = "",

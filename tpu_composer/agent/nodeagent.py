@@ -300,6 +300,12 @@ class LocalNodeAgent(NodeAgent):
             cdimod.remove_cdi_spec(self.cdi_dir, remove_name)
             self._drop_claim(remove_name)
 
+    def _dev_snapshot(self) -> set:
+        try:
+            return set(os.listdir(self.dev_dir))
+        except OSError:
+            return set()
+
     def wait_device_event(self, node: str = "", timeout: float = 1.0) -> bool:
         """Block until a device node appears/vanishes under dev_dir, or
         timeout. True iff an event fired. ``node`` is ignored (a local agent
@@ -307,26 +313,35 @@ class LocalNodeAgent(NodeAgent):
         the fallback compares directory snapshots on a 50ms cadence. This
         powers the DeviceEventWatcher runnable that replaces fixed
         visibility polling with event-driven reconciles (BASELINE.md's
-        biggest latency lever)."""
+        biggest latency lever).
+
+        The native inotify watch is armed per call, so an event landing in
+        the gap between two calls would be invisible to inotify; a
+        cross-call directory snapshot diff catches exactly those (advisor
+        round-1 finding): any change since the previous call reports as an
+        immediate event."""
         timeout = max(0.0, timeout)
+        current = self._dev_snapshot()
+        last = getattr(self, "_last_dev_snapshot", None)
+        self._last_dev_snapshot = current
+        if last is not None and current != last:
+            return True
         if self._native is not None:
             rc = self._native.watch_dev(self.dev_dir, int(timeout * 1000))
             if rc >= 0:
-                return rc == 1
+                if rc == 1:
+                    self._last_dev_snapshot = self._dev_snapshot()
+                    return True
+                return False
             # fall through to the polling fallback on error
         import time as _time
 
-        def snapshot():
-            try:
-                return set(os.listdir(self.dev_dir))
-            except OSError:
-                return set()
-
-        before = snapshot()
         deadline = _time.monotonic() + timeout
         while _time.monotonic() < deadline:
             _time.sleep(0.05)
-            if snapshot() != before:
+            now = self._dev_snapshot()
+            if now != current:
+                self._last_dev_snapshot = now
                 return True
         return False
 

@@ -79,8 +79,10 @@ def qualify_slice(
     except Exception:
         # The Pallas kernels are the fast path, never the only path: a
         # Mosaic lowering regression must degrade the number, not the
-        # bench. The traceback is logged — a silent fallback would bury the
-        # regression behind plausible-looking reference numbers.
+        # bench. The traceback is logged AND the result is tagged
+        # (attn_fallback=1) so bench consumers see a degraded run without
+        # log scraping — a silent fallback would bury the regression behind
+        # plausible-looking reference numbers.
         if mc.attn_impl == "reference":
             raise
         logging.getLogger("qualify_slice").warning(
@@ -88,6 +90,7 @@ def qualify_slice(
             mc.attn_impl, exc_info=True,
         )
         mc = dataclasses.replace(mc, attn_impl="reference")
+        results["attn_fallback"] = 1.0
         state, step_fn, tokens, metrics = build(mc)
     results["attn_impl"] = mc.attn_impl  # type: ignore[assignment]
     t0 = time.perf_counter()
