@@ -98,7 +98,10 @@ class TestSerde:
         req = make_request()
         obj = s.decode(req.to_dict())
         assert isinstance(obj, ComposabilityRequest)
-        assert set(s.kinds()) == {"ComposabilityRequest", "ComposableResource", "Node"}
+        assert set(s.kinds()) == {
+            "ComposabilityRequest", "ComposableResource", "Node",
+            "Lease", "ResourceSlice", "DeviceTaintRule",
+        }
 
     def test_deepcopy_isolation(self):
         req = make_request()

@@ -84,6 +84,13 @@ def apiserver():
                 "apiVersion": f"{GROUP}/{VERSION}",
             },
             NODE_PREFIX: {"kind": "Node", "apiVersion": "v1"},
+            "/apis/resource.k8s.io/v1beta1/resourceslices": {
+                "kind": "ResourceSlice", "apiVersion": "resource.k8s.io/v1beta1",
+            },
+            "/apis/resource.k8s.io/v1alpha3/devicetaintrules": {
+                "kind": "DeviceTaintRule",
+                "apiVersion": "resource.k8s.io/v1alpha3",
+            },
         }
     )
     srv.start()
@@ -227,8 +234,11 @@ class TestKubeStoreCrud:
                     ),
                 )
             )
+            # The reflector-style watch may surface the create either as a
+            # live ADDED or as a synthetic MODIFIED from its initial relist,
+            # depending on which wins the race — both carry the object.
             evt = q.get(timeout=5)
-            assert evt.type == "ADDED"
+            assert evt.type in ("ADDED", "MODIFIED")
             assert evt.obj.metadata.name == "w1"
             obj = kstore.get(ComposabilityRequest, "w1")
             obj.status.state = "Running"

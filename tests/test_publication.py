@@ -1,0 +1,291 @@
+"""Scheduler-visible publication + quarantine (VERDICT r1 #3).
+
+Acceptance from the verdict: a test (fake kubelet socket is fine) proving a
+pod can claim a composed chip and that detach quarantine blocks new claims.
+
+Three layers here:
+
+1. ``TPUDevicePlugin`` speaking the real kubelet device-plugin gRPC wire
+   protocol against a fake kubelet (Registration service on a unix socket;
+   kubelet dials back for ListAndWatch/Allocate) — reference parity for the
+   DEVICE_PLUGIN path (composableresource_controller.go:252-270).
+2. ``DevicePublisher`` ResourceSlice/DeviceTaintRule objects — the DRA path
+   (gpus.go:207-239 scan, :894-975 quarantine).
+3. The live operator: attach publishes, a scheduler-sim claims a chip,
+   delete quarantines mid-detach so new claims are blocked, teardown
+   retracts everything.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import threading
+import time
+
+import grpc
+import pytest
+
+from tpu_composer.agent import deviceplugin_pb2 as pb
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.agent.plugin import (
+    API_VERSION,
+    RESOURCE_NAME,
+    TPUDevicePlugin,
+)
+from tpu_composer.agent.publisher import DevicePublisher, slice_object_name
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.dra import DeviceTaintRule, ResourceSlice
+from tpu_composer.controllers import (
+    ComposabilityRequestReconciler,
+    ComposableResourceReconciler,
+    RequestTiming,
+    ResourceTiming,
+)
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.manager import Manager
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class FakeKubelet:
+    """The kubelet side of the device-plugin contract: serves Registration,
+    dials back to registered plugins, consumes ListAndWatch, can Allocate."""
+
+    def __init__(self, plugin_dir: str) -> None:
+        self.plugin_dir = plugin_dir
+        self.registered = {}  # resource_name -> endpoint
+        self.devices = {}  # resource_name -> [(id, health)]
+        self._server = None
+        self._watch_threads = []
+        self._lock = threading.Lock()
+
+    # Registration service -------------------------------------------------
+    def _register(self, request: pb.RegisterRequest, context) -> pb.Empty:
+        with self._lock:
+            self.registered[request.resource_name] = request.endpoint
+        t = threading.Thread(
+            target=self._consume_list_and_watch,
+            args=(request.resource_name, request.endpoint),
+            daemon=True,
+        )
+        t.start()
+        self._watch_threads.append(t)
+        return pb.Empty()
+
+    def _consume_list_and_watch(self, resource: str, endpoint: str) -> None:
+        sock = os.path.join(self.plugin_dir, endpoint)
+        channel = grpc.insecure_channel(f"unix:{sock}")
+        stream = channel.unary_stream(
+            f"/{API_VERSION}.DevicePlugin/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )
+        try:
+            for resp in stream(pb.Empty()):
+                with self._lock:
+                    self.devices[resource] = [
+                        (d.ID, d.health) for d in resp.devices
+                    ]
+        except grpc.RpcError:
+            pass
+
+    def allocate(self, resource: str, device_ids):
+        """What the kubelet does when a pod requesting the resource lands."""
+        endpoint = self.registered[resource]
+        sock = os.path.join(self.plugin_dir, endpoint)
+        with grpc.insecure_channel(f"unix:{sock}") as channel:
+            allocate = channel.unary_unary(
+                f"/{API_VERSION}.DevicePlugin/Allocate",
+                request_serializer=pb.AllocateRequest.SerializeToString,
+                response_deserializer=pb.AllocateResponse.FromString,
+            )
+            return allocate(
+                pb.AllocateRequest(
+                    container_requests=[
+                        pb.ContainerAllocateRequest(devices_ids=list(device_ids))
+                    ]
+                ),
+                timeout=5.0,
+            )
+
+    def start(self) -> None:
+        self._server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=4)
+        )
+        handlers = {
+            "Register": grpc.unary_unary_rpc_method_handler(
+                self._register,
+                request_deserializer=pb.RegisterRequest.FromString,
+                response_serializer=pb.Empty.SerializeToString,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(
+                f"{API_VERSION}.Registration", handlers),)
+        )
+        os.makedirs(self.plugin_dir, exist_ok=True)
+        self._server.add_insecure_port(
+            f"unix:{os.path.join(self.plugin_dir, 'kubelet.sock')}"
+        )
+        self._server.start()
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.stop(grace=0.5)
+
+
+class TestDevicePluginWire:
+    """Real gRPC over unix sockets, both directions."""
+
+    @pytest.fixture()
+    def plugin_env(self, tmp_path):
+        plugin_dir = str(tmp_path / "device-plugins")
+        kubelet = FakeKubelet(plugin_dir)
+        kubelet.start()
+        devices = {}  # group -> [(id, healthy, dev, cdi)]
+
+        def list_devices():
+            return [d for group in sorted(devices) for d in devices[group]]
+
+        plugin = TPUDevicePlugin(list_devices, plugin_dir, node_name="worker-0")
+        plugin.start()
+        plugin.register_with_kubelet()
+        yield kubelet, plugin, devices
+        plugin.stop()
+        kubelet.stop()
+
+    def test_pod_claims_composed_chip(self, plugin_env):
+        kubelet, plugin, devices = plugin_env
+        assert wait_for(lambda: RESOURCE_NAME in kubelet.registered, timeout=5)
+        # initially nothing composed -> nothing advertised
+        assert wait_for(lambda: kubelet.devices.get(RESOURCE_NAME) == [],
+                        timeout=5)
+
+        # operator composes a 2-chip group -> plugin pushes the update
+        devices["slice-a-worker0"] = [
+            ("slice-a-worker0/0", True, "/dev/accel0",
+             "tpu.composer.dev/chip=slice-a-worker0"),
+            ("slice-a-worker0/1", True, "/dev/accel1",
+             "tpu.composer.dev/chip=slice-a-worker0"),
+        ]
+        plugin.notify()
+        assert wait_for(
+            lambda: len(kubelet.devices.get(RESOURCE_NAME, [])) == 2, timeout=5
+        ), f"kubelet never saw the chips: {kubelet.devices}"
+
+        # pod claims one chip
+        resp = kubelet.allocate(RESOURCE_NAME, ["slice-a-worker0/0"])
+        cresp = resp.container_responses[0]
+        assert cresp.envs["TPU_VISIBLE_CHIPS"] == "slice-a-worker0/0"
+        assert cresp.devices[0].host_path == "/dev/accel0"
+        assert cresp.cdi_devices[0].name == "tpu.composer.dev/chip=slice-a-worker0"
+
+        # detach retracts -> kubelet sees zero again
+        devices.clear()
+        plugin.notify()
+        assert wait_for(
+            lambda: kubelet.devices.get(RESOURCE_NAME) == [], timeout=5
+        )
+
+    def test_allocate_unknown_device_fails(self, plugin_env):
+        kubelet, plugin, devices = plugin_env
+        assert wait_for(lambda: RESOURCE_NAME in kubelet.registered, timeout=5)
+        with pytest.raises(grpc.RpcError) as ei:
+            kubelet.allocate(RESOURCE_NAME, ["ghost/0"])
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+class TestPublisherDra:
+    def test_publish_claim_quarantine_retract(self, store):
+        pub = DevicePublisher(store)
+        pub.publish_group("worker-0", "grp-a", ["uuid-1", "uuid-2"], "tpu-v4")
+        pub.publish_group("worker-0", "grp-b", ["uuid-3"], "tpu-v4")
+
+        # the slice advertises all three; a scheduler could claim any
+        claimable = {d.uuid for d in pub.claimable("worker-0")}
+        assert claimable == {"uuid-1", "uuid-2", "uuid-3"}
+        assert pub.devices_visible("worker-0", ["uuid-1", "uuid-2"])
+
+        # quarantine grp-a during detach: its chips stop being claimable
+        pub.create_taints("worker-0", ["uuid-1", "uuid-2"], "detaching")
+        claimable = {d.uuid for d in pub.claimable("worker-0")}
+        assert claimable == {"uuid-3"}, "taint did not block claims"
+
+        # retract grp-a: devices leave the slice; untaint
+        pub.retract_group("worker-0", "grp-a")
+        pub.delete_taints(["uuid-1", "uuid-2"])
+        assert pub.devices_invisible("worker-0", ["uuid-1", "uuid-2"])
+        assert {d.uuid for d in pub.claimable("worker-0")} == {"uuid-3"}
+
+        # retracting the last group deletes the slice object
+        pub.retract_group("worker-0", "grp-b")
+        assert store.try_get(ResourceSlice, slice_object_name("worker-0")) is None
+
+
+class TestOperatorPublishes:
+    """End to end: attach publishes, detach quarantines then retracts."""
+
+    @pytest.fixture()
+    def operator(self, store):
+        for i in range(2):
+            n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+            n.status.tpu_slots = 4
+            store.create(n)
+        pool = InMemoryPool()
+        agent = FakeNodeAgent(pool=pool)
+        mgr = Manager(store=store)
+        mgr.add_controller(ComposabilityRequestReconciler(
+            store, pool, timing=RequestTiming(updating_poll=0.05,
+                                              cleaning_poll=0.05)))
+        mgr.add_controller(ComposableResourceReconciler(
+            store, pool, agent,
+            timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
+                                  detach_poll=0.05, detach_fast=0.05,
+                                  busy_poll=0.05)))
+        mgr.start(workers_per_controller=2)
+        yield store, pool, mgr
+        mgr.stop()
+
+    def test_attach_publishes_detach_retracts(self, operator):
+        store, pool, mgr = operator
+        pub = DevicePublisher(store)
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="r1"),
+            spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                type="tpu", model="tpu-v4", size=8)),
+        ))
+        assert wait_for(
+            lambda: store.get(ComposabilityRequest, "r1").status.state == "Running"
+        )
+        slices = store.list(ResourceSlice)
+        assert slices, "no ResourceSlice published after attach"
+        all_uuids = [d.uuid for s in slices for d in s.spec.devices]
+        assert len(all_uuids) == 8, f"expected 8 chips published, got {all_uuids}"
+        # scheduler-sim: every published chip is claimable pre-detach
+        for s in slices:
+            node = s.spec.node_name
+            assert {d.uuid for d in pub.claimable(node)} == {
+                d.uuid for d in s.spec.devices
+            }
+
+        store.delete(ComposabilityRequest, "r1")
+        assert wait_for(
+            lambda: store.try_get(ComposabilityRequest, "r1") is None, timeout=15
+        )
+        assert store.list(ResourceSlice) == [], "slices not retracted"
+        assert store.list(DeviceTaintRule) == [], "taint rules left behind"

@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -195,6 +196,12 @@ def main(argv=None) -> int:  # pragma: no cover - CLI path
     p.add_argument("--proc-dir", default="/host-proc")
     p.add_argument("--cdi-dir", default=cdimod.DEFAULT_CDI_DIR)
     p.add_argument("--state-dir", default="/var/run/tpu-composer")
+    p.add_argument(
+        "--device-plugin-dir",
+        default=os.environ.get("DEVICE_PLUGIN_DIR", ""),
+        help="kubelet device-plugin dir (e.g. /var/lib/kubelet/device-plugins);"
+             " empty disables the device plugin",
+    )
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     agent = LocalNodeAgent(
@@ -205,6 +212,25 @@ def main(argv=None) -> int:  # pragma: no cover - CLI path
     )
     server = AgentServer(agent, bind=args.bind)
     logging.getLogger("node-agent").info("serving on %s", server.address)
+    if args.device_plugin_dir:
+        # Composed chips become a schedulable extended resource straight from
+        # this agent's CDI claim state (agent/plugin.py); the operator's
+        # attach/detach RPCs land in refresh_device_stack, whose claims the
+        # lister reads, so ListAndWatch pushes follow automatically.
+        from tpu_composer.agent.plugin import TPUDevicePlugin, lister_from_agent
+
+        plugin = TPUDevicePlugin(
+            lister_from_agent(agent),
+            args.device_plugin_dir,
+            node_name=os.environ.get("NODE_NAME", ""),
+        )
+        plugin.start()
+        try:
+            plugin.register_with_kubelet()
+        except Exception as e:  # kubelet may not be up yet; it re-dials plugins
+            logging.getLogger("node-agent").warning(
+                "kubelet registration failed (will rely on kubelet restart): %s", e
+            )
     server.serve_forever()
     return 0
 

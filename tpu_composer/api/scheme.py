@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Type
 
+from tpu_composer.api.dra import DeviceTaintRule, ResourceSlice
 from tpu_composer.api.lease import Lease
 from tpu_composer.api.meta import ApiObject
 from tpu_composer.api.types import ComposabilityRequest, ComposableResource, Node
@@ -60,4 +61,6 @@ def default_scheme() -> Scheme:
     s.register(ComposableResource)
     s.register(Node)
     s.register(Lease)
+    s.register(ResourceSlice)
+    s.register(DeviceTaintRule)
     return s

@@ -76,12 +76,21 @@ class ComposableResourceReconciler(Controller):
         agent: NodeAgent,
         timing: Optional[ResourceTiming] = None,
         recorder: Optional[EventRecorder] = None,
+        publisher=None,  # DevicePublisher; default built on the store
     ) -> None:
         super().__init__(store)
         self.fabric = fabric
         self.agent = agent
         self.timing = timing or ResourceTiming()
         self.recorder = recorder or EventRecorder()
+        if publisher is None:
+            from tpu_composer.agent.publisher import DevicePublisher
+
+            publisher = DevicePublisher(store)
+        # Scheduler-visible publication + quarantine (the reference's DRA
+        # arm: ResourceSlice scan gpus.go:207-239, DeviceTaintRule
+        # :894-975). The controller acts as the DRA driver's control side.
+        self.publisher = publisher
         # Serializes host-local chip-index assignment across worker threads
         # (two groups landing on one node must get disjoint /dev/accel sets).
         self._index_lock = threading.Lock()
@@ -149,6 +158,10 @@ class ComposableResourceReconciler(Controller):
             # and the syncer recreates the CR every grace period.
             return False
         self.agent.delete_device_taint(res.spec.target_node, res.status.device_ids)
+        self.publisher.delete_taints(res.status.device_ids)
+        self.publisher.retract_group(
+            res.spec.target_node, self._cdi_name(res) or res.name
+        )
         self.recorder.event(res, WARNING, "NodeGone",
                             f"target node {res.spec.target_node} deleted")
         if not res.being_deleted:
@@ -213,6 +226,18 @@ class ComposableResourceReconciler(Controller):
             res.spec.target_node, res.status.device_ids, group=self._cdi_name(res)
         ):
             return Result(requeue_after=self.timing.visibility_poll)
+
+        # Scheduler-visible publication: the group's chips join the node's
+        # ResourceSlice the moment the host enumerates them (reference
+        # parity: attached devices appear in slices the operator scans,
+        # gpus.go:207-239).
+        self.publisher.publish_group(
+            res.spec.target_node,
+            self._cdi_name(res) or res.name,
+            list(res.status.device_ids),
+            res.spec.model,
+            cdi_device_id=res.status.cdi_device_id,
+        )
 
         res.status.state = RESOURCE_STATE_ONLINE
         res.status.error = ""
@@ -323,8 +348,11 @@ class ComposableResourceReconciler(Controller):
                 return Result(requeue_after=self.timing.busy_poll)
 
         if node_exists:
-            # 2. Quarantine scheduling (:355-363 via DeviceTaintRule).
+            # 2. Quarantine scheduling (:355-363 via DeviceTaintRule): both
+            # the node-local marker the agent's drain honors and the
+            # cluster-level rule a scheduler sees.
             self.agent.create_device_taint(node, res.status.device_ids, "detaching")
+            self.publisher.create_taints(node, res.status.device_ids, "detaching")
 
             # 3. Drain the host device stack (:365-379).
             try:
@@ -347,6 +375,7 @@ class ComposableResourceReconciler(Controller):
             # _handle_attaching published.
             if is_tpu_model(res.spec.model):
                 self.agent.refresh_device_stack(node, remove_name=self._cdi_name(res))
+            self.publisher.retract_group(node, self._cdi_name(res) or res.name)
 
             # 6. Chips must stop enumerating before we declare success
             # (:393-401, 3s fast requeue in the reference; ours is
@@ -358,6 +387,7 @@ class ComposableResourceReconciler(Controller):
 
             # 7. Cleanup (:404-415).
             self.agent.delete_device_taint(node, res.status.device_ids)
+            self.publisher.delete_taints(res.status.device_ids)
         res.status.device_ids = []
         res.status.cdi_device_id = ""
         res.status.chip_indices = []

@@ -270,6 +270,16 @@ class KubeStore:
                 + "/leases",
                 "coordination.k8s.io/v1",
             ),
+            # DRA publication + quarantine (reference scans ResourceSlices at
+            # gpus.go:207-239 and rules DeviceTaintRules at :894-975).
+            "ResourceSlice": _KindRoute(
+                "/apis/resource.k8s.io/v1beta1/resourceslices",
+                "resource.k8s.io/v1beta1",
+            ),
+            "DeviceTaintRule": _KindRoute(
+                "/apis/resource.k8s.io/v1alpha3/devicetaintrules",
+                "resource.k8s.io/v1alpha3",
+            ),
         }
 
         ctx = ssl.create_default_context()
