@@ -80,6 +80,69 @@ class TestLeaseElector:
         assert wait_for(b.try_acquire, timeout=5), "never stole expired lease"
         assert b.is_leader
 
+    def test_partitioned_leader_stands_down_before_lease_stealable(self, store):
+        """Fencing margin (ADVICE r2 high): renew_deadline < lease_duration.
+
+        A leader that cannot renew must stop claiming leadership while its
+        last-written renew_time still fences contenders out — otherwise both
+        replicas drive the fabric concurrently for the gap between lease
+        expiry and the old leader's stand-down (client-go closes this with
+        RenewDeadline=10s < LeaseDuration=15s).
+        """
+
+        partitioned = threading.Event()
+        real_get = store.get
+        real_update = store.update
+
+        def failing_get(cls, name):
+            if partitioned.is_set() and cls is Lease:
+                from tpu_composer.runtime.store import StoreError
+
+                raise StoreError("injected partition")
+            return real_get(cls, name)
+
+        def failing_update(obj):
+            if partitioned.is_set() and isinstance(obj, Lease):
+                from tpu_composer.runtime.store import StoreError
+
+                raise StoreError("injected partition")
+            return real_update(obj)
+
+        store.get = failing_get
+        store.update = failing_update
+
+        a = LeaseElector(store, identity="replica-a",
+                         lease_duration_s=3.0, renew_period_s=0.1,
+                         renew_deadline_s=1.0)
+        b = LeaseElector(store, identity="replica-b",
+                         lease_duration_s=3.0, renew_period_s=0.1,
+                         renew_deadline_s=1.0)
+        assert a.try_acquire()
+        t_partition = time.monotonic()
+        partitioned.set()
+        assert wait_for(lambda: not a.is_leader, timeout=5), (
+            "partitioned leader never stood down"
+        )
+        stood_down_after = time.monotonic() - t_partition
+        assert stood_down_after < a.lease_duration_s, (
+            f"stood down {stood_down_after:.1f}s after partition — the lease "
+            f"was already stealable (duration {a.lease_duration_s}s)"
+        )
+        # Heal the partition: the lease on the wire must still fence
+        # contenders (renew_time is at most renew_deadline + slack old).
+        partitioned.clear()
+        assert not b.try_acquire(), (
+            "contender stole the lease before it expired — no fencing margin"
+        )
+        # …and once it genuinely expires, failover proceeds.
+        assert wait_for(b.try_acquire, timeout=6), "failover never happened"
+        assert b.is_leader
+
+    def test_renew_deadline_must_be_less_than_duration(self, store):
+        with pytest.raises(ValueError):
+            LeaseElector(store, identity="x", lease_duration_s=10.0,
+                         renew_deadline_s=10.0)
+
     def test_deposed_leader_stands_down(self, store):
         a = LeaseElector(store, identity="replica-a",
                          lease_duration_s=1.0, renew_period_s=0.1)

@@ -53,12 +53,25 @@ class LeaseElector:
         identity: str = "",
         lease_duration_s: float = 15.0,
         renew_period_s: float = 5.0,
+        renew_deadline_s: float = 0.0,
     ) -> None:
         self.store = store
         self.name = name
         self.identity = identity or default_identity()
         self.lease_duration_s = lease_duration_s
         self.renew_period_s = renew_period_s
+        # Fencing contract (client-go: RenewDeadline < LeaseDuration): we must
+        # stop acting strictly BEFORE the lease becomes stealable, leaving the
+        # gap (lease_duration - renew_deadline) to absorb the failure-retry
+        # granularity, the manager watchdog poll, and controller stop time.
+        if renew_deadline_s <= 0:
+            renew_deadline_s = lease_duration_s * 2.0 / 3.0
+        if renew_deadline_s >= lease_duration_s:
+            raise ValueError(
+                f"renew_deadline_s ({renew_deadline_s}) must be < "
+                f"lease_duration_s ({lease_duration_s})"
+            )
+        self.renew_deadline_s = renew_deadline_s
         self.log = logging.getLogger("LeaseElector")
         self._lock = threading.Lock()
         self._leading = False
@@ -150,7 +163,13 @@ class LeaseElector:
 
     def _renew_loop(self) -> None:
         last_success = self._now()
-        while not self._stop_renew.wait(self.renew_period_s):
+        # After a failed renew, poll fast (1s) so the renew_deadline check
+        # fires promptly instead of one renew_period late; the stand-down
+        # must land inside (lease_duration - renew_deadline) before the
+        # lease becomes stealable by a contender.
+        wait_s = self.renew_period_s
+        fail_retry_s = min(1.0, self.renew_period_s)
+        while not self._stop_renew.wait(wait_s):
             try:
                 lease = self.store.get(Lease, self.name)
                 if lease.spec.holder_identity != self.identity:
@@ -164,17 +183,22 @@ class LeaseElector:
                 lease.spec.renew_time = now_iso()
                 self.store.update(lease)
                 last_success = self._now()
+                wait_s = self.renew_period_s
             except (ConflictError, NotFoundError, StoreError) as e:
-                # Fencing: if we cannot renew for a full lease duration,
-                # another replica may already lead — stop claiming we do.
+                # Fencing: if we cannot renew past the renew deadline (which
+                # is strictly less than the lease duration), another replica
+                # may be about to lead — stop claiming we do while the lease
+                # is still OURS on the wire, so both replicas never drive the
+                # fabric concurrently.
                 failing_for = (self._now() - last_success).total_seconds()
                 self.log.warning(
                     "lease renew failed (%.0fs): %s", failing_for, e
                 )
-                if failing_for > self.lease_duration_s:
+                if failing_for >= self.renew_deadline_s:
                     with self._lock:
                         self._leading = False
                     return
+                wait_s = fail_retry_s
 
     # ------------------------------------------------------------------
     def release(self) -> None:
