@@ -84,6 +84,9 @@ class FakeApiServer:
         self.resources = resources
         self.state = _State()
         self.fail_hooks: List[Any] = []  # callables (method, path) -> Optional[(code, reason, msg)]
+        # Wire-level request log [(method, path)] — the envtest-style probe
+        # for how chatty a client is (cache-efficiency assertions).
+        self.request_log: List[Tuple[str, str]] = []
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -124,6 +127,8 @@ class FakeApiServer:
                 return None
 
             def _maybe_fail(self) -> bool:
+                with server.state.lock:
+                    server.request_log.append((self.command, self.path))
                 for hook in server.fail_hooks:
                     out = hook(self.command, self.path)
                     if out:
