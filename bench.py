@@ -120,9 +120,51 @@ def bench_accelerator():
 
     from tpu_composer.workload.probe import staged_accelerator_probe
 
-    return staged_accelerator_probe(
+    out = staged_accelerator_probe(
         repo_root=os.path.dirname(os.path.abspath(__file__))
     )
+    # The axon tunnel relay dies from time to time (r01/r02 benches both hit
+    # it; r03 diagnosed the hang to make_c_api_client against a dead relay).
+    # When the live probe could not reach the chip, attach the most recent
+    # archived on-TPU probe (refreshed whenever the relay is up during the
+    # round) so the round still carries real-hardware evidence — clearly
+    # labeled with its capture time, never passed off as a live run.
+    backend = out.get("stages", {}).get("backend_init", {}).get("backend")
+    art = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "bench_artifacts", "last_tpu_probe.json",
+    )
+    if backend == "tpu":
+        # Refresh the archive so the next relay outage serves numbers no
+        # staler than the last time the chip was reachable.
+        try:
+            os.makedirs(os.path.dirname(art), exist_ok=True)
+            with open(art, "w") as f:
+                json.dump(
+                    {
+                        "captured_at": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                        ),
+                        "note": (
+                            "Live on-TPU staged probe, archived because the "
+                            "axon tunnel relay dies intermittently and "
+                            "end-of-round bench runs then cannot reach the "
+                            "chip. All numbers ran on backend=tpu."
+                        ),
+                        "stages": out["stages"],
+                        "completed": out["completed"],
+                    },
+                    f, indent=1,
+                )
+        except OSError:
+            pass
+    else:
+        try:
+            with open(art) as f:
+                out["archived_tpu_probe"] = json.load(f)
+        except (OSError, ValueError):
+            pass
+    return out
 
 
 APISERVER_RTT_S = 0.010  # injected per-op latency: typical in-cluster apiserver RTT

@@ -1,0 +1,75 @@
+"""Staged accelerator probe — the failure path must produce evidence.
+
+BENCH_r01/r02 both died in backend_init with an empty stderr tail (VERDICT r2
+weak #1): the probe's entire value is that a wedge yields a named stage, a
+thread stack dump, pool-endpoint reachability, and a retry record. These
+tests drive the parent driver against scripted children so the diagnosis
+machinery is pinned without needing a real hang on real hardware.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import tpu_composer.workload.probe as probe
+
+# A child that completes every stage instantly.
+_FAST_CHILD = r"""
+import json, time
+for stage in ("backend_init", "matmul", "flash_attn", "qualify"):
+    print("STAGE_RESULT " + json.dumps({"stage": stage, "seconds": 0.0, "ok": True}),
+          flush=True)
+"""
+
+# A child that wedges inside backend_init, with the real child's watchdog.
+_WEDGED_CHILD = r"""
+import faulthandler, os, time
+_budget = float(os.environ.get("TPUC_PROBE_STAGE_BUDGET_S", "480"))
+faulthandler.dump_traceback_later(max(_budget - 10.0, 2.0), exit=True)
+time.sleep(600)
+"""
+
+
+def test_all_stages_complete(monkeypatch):
+    monkeypatch.setattr(probe, "_CHILD", _FAST_CHILD)
+    r = probe.staged_accelerator_probe(timeouts={"backend_init": 10.0})
+    assert r["completed"] == ["devnodes", "backend_init", "matmul",
+                              "flash_attn", "qualify"]
+    assert "failed_stage" not in r
+
+
+def test_wedged_backend_init_yields_stack_and_retries(monkeypatch):
+    monkeypatch.setattr(probe, "_CHILD", _WEDGED_CHILD)
+    r = probe.staged_accelerator_probe(timeouts={"backend_init": 8.0}, retries=1)
+    assert r["failed_stage"] == "backend_init"
+    d = r["diagnosis"]
+    # One retry happened and each attempt's evidence is kept.
+    assert d["attempts"] == 2
+    assert len(d["earlier_attempts"]) == 1
+    # The in-child faulthandler dump reached the recorded stderr tail —
+    # the exact blocking line must be visible.
+    assert any("time.sleep" in line or "Thread" in line
+               for line in d["stderr_tail"]), d["stderr_tail"]
+    # Preflight reachability of the pool/tunnel endpoints is part of the
+    # diagnosis (empty list is fine when no pool env is set).
+    assert "pool_endpoints" in d
+
+
+def test_pool_endpoint_parsing(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1:1, 198.51.100.7:80")
+    monkeypatch.delenv("AXON_POOL_SVC_OVERRIDE", raising=False)
+    recs = probe.probe_pool_endpoints(timeout_s=0.2)
+    eps = {r["endpoint"] for r in recs}
+    # Explicit host:port entries are used verbatim (no port guessing).
+    assert eps == {"127.0.0.1:1", "198.51.100.7:80"}
+    # Port 1 on loopback is closed: must report unreachable, not raise.
+    rec = next(r for r in recs if r["endpoint"] == "127.0.0.1:1")
+    assert rec["reachable"] is False and "error" in rec
+
+
+def test_bare_host_scans_candidate_ports(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.delenv("AXON_POOL_SVC_OVERRIDE", raising=False)
+    recs = probe.probe_pool_endpoints(timeout_s=0.2)
+    assert len(recs) == 4  # the relay's known candidate ports
+    assert all(r["endpoint"].startswith("127.0.0.1:") for r in recs)
