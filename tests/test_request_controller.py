@@ -343,3 +343,94 @@ class TestScalarRecovery:
         with pytest.raises(AllocationError):
             req_rec.reconcile("req-1")
         assert "free device ports" in get_req(store).status.error
+
+
+class TestLiveResize:
+    """Live slice grow/shrink (SURVEY §7 M4, VERDICT r2 ask #3): when
+    chips_per_host is unchanged and survivors form a stable worker prefix,
+    resize keeps existing children alive — child UIDs, chips and TPU_*
+    worker coordinates all survive. Reference contrast: device reuse on
+    spec drift (composabilityrequest_controller.go:254-305); dissolve is
+    reserved for incompatible reshapes."""
+
+    def test_grow_keeps_existing_children(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=4)  # v4: one host tray, 1x2x2
+        run_to_ready(store, req_rec, res_rec)
+        orig = children_of(store)
+        assert len(orig) == 1
+        orig_uid = orig[0].metadata.uid
+        orig_devices = list(orig[0].status.device_ids)
+        orig_node = orig[0].spec.target_node
+
+        req = get_req(store)
+        req.spec.resource.size = 8  # -> 2x2x2, two hosts
+        store.update(req)
+        run_to_ready(store, req_rec, res_rec)
+
+        kids = sorted(children_of(store), key=lambda c: c.spec.worker_id)
+        assert len(kids) == 2
+        survivor, added = kids
+        # The original member was never deleted: same object, same chips.
+        assert survivor.metadata.uid == orig_uid
+        assert list(survivor.status.device_ids) == orig_devices
+        assert survivor.spec.worker_id == 0
+        assert survivor.spec.topology == "2x2x2"
+        assert added.spec.worker_id == 1
+        assert added.spec.target_node != orig_node
+        sl = get_req(store).status.slice
+        assert sl.num_hosts == 2 and sl.topology == "2x2x2"
+        # Stable prefix: worker 0's hostname (already injected into pods
+        # as TPU_WORKER_HOSTNAMES[0]) is unchanged.
+        assert sl.worker_hostnames[0] == orig_node
+
+    def test_shrink_keeps_surviving_prefix(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=8)
+        run_to_ready(store, req_rec, res_rec)
+        kids = sorted(children_of(store), key=lambda c: c.spec.worker_id)
+        keeper_uid = kids[0].metadata.uid
+        keeper_devices = list(kids[0].status.device_ids)
+        free_before = pool.free_chips("tpu-v4")
+
+        req = get_req(store)
+        req.spec.resource.size = 4
+        store.update(req)
+        run_to_ready(store, req_rec, res_rec)
+
+        kids = children_of(store)
+        assert len(kids) == 1
+        assert kids[0].metadata.uid == keeper_uid
+        assert list(kids[0].status.device_ids) == keeper_devices
+        assert kids[0].spec.topology == "1x2x2"
+        sl = get_req(store).status.slice
+        assert sl.num_hosts == 1 and sl.topology == "1x2x2"
+        # The dropped worker's chips went back to the pool.
+        assert pool.free_chips("tpu-v4") == free_before + 4
+
+    def test_chips_per_host_change_dissolves(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=2)  # standalone sub-host group: 2 chips
+        run_to_ready(store, req_rec, res_rec)
+        orig_uid = children_of(store)[0].metadata.uid
+
+        req = get_req(store)
+        req.spec.resource.size = 8  # chips_per_host 2 -> 4: no live path
+        store.update(req)
+        run_to_ready(store, req_rec, res_rec)
+
+        kids = children_of(store)
+        assert len(kids) == 2
+        assert all(c.metadata.uid != orig_uid for c in kids)
+
+    def test_grow_of_node_pinned_request_is_rejected(self, world):
+        store, pool, agent, req_rec, res_rec = world
+        make_request(store, size=4, target_node="worker-0")
+        run_to_ready(store, req_rec, res_rec)
+        req = get_req(store)
+        req.spec.resource.size = 8  # needs 2 hosts; pin allows 1
+        store.update(req)
+        req_rec.reconcile("req-1")  # Running -> NodeAllocating
+        with pytest.raises(AllocationError):
+            req_rec.reconcile("req-1")
+        assert "single-host" in get_req(store).status.error

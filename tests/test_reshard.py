@@ -1,0 +1,95 @@
+"""Live-resize reshard continuity (SURVEY §7 M4 / VERDICT r2 ask #3).
+
+The operator's grow path keeps slice workers 0..k-1 alive and appends new
+hosts; the workload follows by rebuilding its mesh and resharding the train
+state. These tests pin the contract on the virtual 8-device CPU mesh: a
+4-device training run resharded onto 8 devices mid-stream produces the SAME
+next-step loss as the run that never resized — parameters, optimizer moments
+and data order all survive the move.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_composer.models.transformer import ModelConfig
+from tpu_composer.parallel import (
+    TrainConfig,
+    make_mesh,
+    make_train_state,
+    make_train_step,
+)
+from tpu_composer.parallel.train import reshard_train_state
+
+
+@pytest.fixture(scope="module")
+def tc():
+    return TrainConfig(
+        model=ModelConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                          d_ff=128, max_seq=32, dtype=jnp.float32)
+    )
+
+
+def _batches(tc, n, batch=4, seq=32):
+    key = jax.random.key(7)
+    return [
+        jax.random.randint(jax.random.fold_in(key, i), (batch, seq), 0,
+                           tc.model.vocab_size)
+        for i in range(n)
+    ]
+
+
+def _run(tc, mesh, state, tokens_list):
+    step_fn, batch_sharding = make_train_step(tc, mesh)
+    losses = []
+    for tokens in tokens_list:
+        state, metrics = step_fn(state, jax.device_put(tokens, batch_sharding))
+        losses.append(float(metrics["loss"]))
+    return state, losses
+
+
+def test_grow_4_to_8_is_loss_continuous(tc):
+    devices = jax.devices()
+    assert len(devices) >= 8
+    mesh4 = make_mesh({"dp": 2, "sp": 1, "tp": 2}, devices=devices[:4])
+    mesh8 = make_mesh({"dp": 2, "sp": 2, "tp": 2}, devices=devices[:8])
+    batches = _batches(tc, 5)
+
+    # Control: never resized.
+    state_c = make_train_state(tc, jax.random.key(0), mesh4)
+    state_c, losses_c = _run(tc, mesh4, state_c, batches)
+
+    # Resized: 3 steps on 4 devices, grow, 2 more steps on 8.
+    state_r = make_train_state(tc, jax.random.key(0), mesh4)
+    state_r, losses_a = _run(tc, mesh4, state_r, batches[:3])
+    state_r = reshard_train_state(tc, state_r, mesh8)
+    # Every leaf actually lives on the grown mesh now.
+    leaf = jax.tree.leaves(state_r["params"])[0]
+    assert set(leaf.sharding.mesh.devices.flat) == set(devices[:8])
+    state_r, losses_b = _run(tc, mesh8, state_r, batches[3:])
+
+    resized = losses_a + losses_b
+    assert resized == pytest.approx(losses_c, rel=2e-4), (
+        f"loss diverged across reshard: {resized} vs {losses_c}"
+    )
+    # And training is actually progressing, not frozen.
+    assert losses_c[-1] < losses_c[0]
+
+
+def test_shrink_8_to_4_is_loss_continuous(tc):
+    devices = jax.devices()
+    mesh8 = make_mesh({"dp": 2, "sp": 2, "tp": 2}, devices=devices[:8])
+    mesh4 = make_mesh({"dp": 2, "sp": 1, "tp": 2}, devices=devices[:4])
+    batches = _batches(tc, 4)
+
+    state_c = make_train_state(tc, jax.random.key(0), mesh8)
+    state_c, losses_c = _run(tc, mesh8, state_c, batches)
+
+    state_r = make_train_state(tc, jax.random.key(0), mesh8)
+    state_r, losses_a = _run(tc, mesh8, state_r, batches[:2])
+    state_r = reshard_train_state(tc, state_r, mesh4)
+    state_r, losses_b = _run(tc, mesh4, state_r, batches[2:])
+
+    assert losses_a + losses_b == pytest.approx(losses_c, rel=2e-4)

@@ -57,7 +57,11 @@ from tpu_composer.api.types import (
     ResourceStatus,
     SliceStatus,
 )
-from tpu_composer.fabric.provider import FabricError, FabricProvider
+from tpu_composer.fabric.provider import (
+    FabricError,
+    FabricProvider,
+    UnsupportedResize,
+)
 from tpu_composer.runtime.controller import Controller, Result
 from tpu_composer.runtime.events import WARNING, EventRecorder
 from tpu_composer.runtime.metrics import attach_to_ready_seconds, reconcile_total
@@ -259,33 +263,89 @@ class ComposabilityRequestReconciler(Controller):
             return self._shrink_to_zero(req, children)
         shape = solve_slice(res.model, res.size, res.topology)
         slice_name = self._slice_name(req)
+        # A node-pinned request can never span hosts — enforced here so the
+        # grow path errors the same way a fresh allocation does.
+        if res.target_node and shape.num_hosts > 1:
+            raise AllocationError(
+                f"topology {shape.topology} spans {shape.num_hosts} hosts;"
+                " target_node only supports single-host slices"
+            )
 
-        # Children that don't fit the solved shape must go first — a slice is
-        # valid only as a whole (keep/discard analog of :254-305, but
-        # all-or-nothing).
-        matching = [
+        # Children that can't belong to ANY shape of this slice go first:
+        # wrong model/flags, or their node is gone. Topology and member
+        # count are judged separately below — a resize keeps survivors
+        # (reference contrast: device reuse on drift,
+        # composabilityrequest_controller.go:254-305; our live-resize
+        # extends it to connected slices).
+        healthy = [
             c for c in children
             if not c.being_deleted
             and c.spec.model == res.model
             and c.spec.slice_name == slice_name
-            and c.spec.topology == shape.topology
-            and c.spec.chip_count == shape.chips_per_host
             and c.spec.force_detach == res.force_detach
             and self.store.try_get(Node, c.spec.target_node) is not None
         ]
-        stale = [c for c in children if c not in matching]
+        stale = [c for c in children if c not in healthy]
         if stale:
             self._delete_children(req, stale)
             return Result(requeue_after=self.timing.cleaning_poll)
 
-        if len(matching) == shape.num_hosts:
-            nodes = [c.spec.target_node for c in sorted(matching, key=lambda c: c.spec.worker_id)]
-        else:
-            if matching:
-                # Partial group from a previous shape — dissolve before
-                # re-reserving (atomicity over reuse).
-                self._delete_children(req, matching)
+        healthy.sort(key=lambda c: c.spec.worker_id)
+        # Reuse is only sound when the survivors are exactly workers
+        # 0..k-1 with the new shape's chips_per_host: worker_ids (and the
+        # TPU_* coordinates already injected into pods) must stay a stable
+        # prefix, and a chips_per_host change reshapes every host's chip
+        # group. Anything else dissolves (atomicity over reuse).
+        reusable = (
+            [c.spec.worker_id for c in healthy] == list(range(len(healthy)))
+            and all(c.spec.chip_count == shape.chips_per_host for c in healthy)
+        )
+        if healthy and not reusable:
+            self._delete_children(req, healthy)
+            return Result(requeue_after=self.timing.cleaning_poll)
+
+        cur_hosts = [c.spec.target_node for c in healthy]
+        if len(healthy) > shape.num_hosts:
+            # Shrink: drain the highest worker_ids first; the fabric
+            # reservation is trimmed on the next pass once they're gone.
+            victims = healthy[shape.num_hosts:]
+            self._delete_children(req, victims)
+            return Result(requeue_after=self.timing.cleaning_poll)
+        if len(healthy) == shape.num_hosts:
+            nodes = cur_hosts
+            # any(): a child whose topology rewrite failed last pass (update
+            # conflict) must be retried, not just the first worker's.
+            if any(c.spec.topology != shape.topology for c in healthy):
+                # Same members, new shape (post-shrink trim, or a pure
+                # topology change like 1x2x2 -> 2x2x1): reprogram ICI links
+                # around the live members.
+                try:
+                    self.fabric.resize_slice(
+                        slice_name, res.model, shape.topology, nodes
+                    )
+                except UnsupportedResize:
+                    self._delete_children(req, healthy)
+                    return Result(requeue_after=self.timing.cleaning_poll)
+                self._retopologize(healthy, shape.topology)
+        elif healthy:
+            # Grow: survivors keep their worker_ids/chips; reserve only the
+            # delta on fresh hosts appended after the stable prefix. A
+            # provider without live resize forces the dissolve-and-rebuild
+            # path instead (release+reserve under running pods is unsafe).
+            extra = self._pick_extra_nodes(
+                req, shape, exclude=set(cur_hosts),
+                count=shape.num_hosts - len(healthy),
+            )
+            nodes = cur_hosts + extra
+            try:
+                self.fabric.resize_slice(
+                    slice_name, res.model, shape.topology, nodes
+                )
+            except UnsupportedResize:
+                self._delete_children(req, healthy)
                 return Result(requeue_after=self.timing.cleaning_poll)
+            self._retopologize(healthy, shape.topology)
+        else:
             self.fabric.release_slice(slice_name)
             nodes = self._pick_nodes(req, shape)
             try:
@@ -293,17 +353,17 @@ class ComposabilityRequestReconciler(Controller):
             except FabricError:
                 raise
         # Placeholders + authoritative coordinates (:471-484, plus slice
-        # block for webhook injection).
+        # block for webhook injection). Kept children retain their status
+        # rows; only the added workers get placeholders.
         req.status.resources = {
             c.name: req.status.resources.get(c.name, ResourceStatus(node_name=c.spec.target_node))
-            for c in matching
+            for c in healthy
         }
-        if not matching:
-            for w, node in enumerate(nodes):
-                placeholder = generate_resource_name(res.type)
-                req.status.resources[placeholder] = ResourceStatus(
-                    node_name=node, worker_id=w
-                )
+        for w in range(len(healthy), shape.num_hosts):
+            placeholder = generate_resource_name(res.type)
+            req.status.resources[placeholder] = ResourceStatus(
+                node_name=nodes[w], worker_id=w
+            )
         req.status.slice = SliceStatus(
             name=slice_name,
             topology=shape.topology,
@@ -345,20 +405,48 @@ class ComposabilityRequestReconciler(Controller):
         # policy is honored as a placement preference: samenode/topology pack
         # least-loaded-first; differentnode is identical for slices since
         # workers always land on distinct hosts.
+        return self._pick_extra_nodes(
+            req, shape, exclude=set(), count=shape.num_hosts
+        )
+
+    def _retopologize(self, children: List[ComposableResource], topology: str) -> None:
+        """Rewrite spec.topology on surviving members after a live resize.
+        Their chips, worker_id and node are untouched — only the slice shape
+        they report (and the agent republishes in CDI/ResourceSlice form)
+        changes."""
+        for c in children:
+            if c.spec.topology != topology:
+                c.spec.topology = topology
+                try:
+                    self.store.update(c)
+                except Exception:
+                    pass  # next reconcile retries; the child is still valid
+
+    def _pick_extra_nodes(
+        self, req: ComposabilityRequest, shape: SliceShape,
+        exclude: set, count: int,
+    ) -> List[str]:
+        """Slice placement: `count` hosts with capacity for one worker's
+        chip group each. Fresh allocations pass exclude=∅ and the full host
+        count; the grow path excludes surviving members' hosts and asks for
+        only the delta — one filter/sort, so placement policy can't diverge
+        between the two."""
         used = self._used_slots_map(req.name)
         candidates = [
             n for n in self.store.list(Node)
-            if n.status.ready and not n.spec.unschedulable
+            if n.metadata.name not in exclude
+            and n.status.ready and not n.spec.unschedulable
             and self._node_fits(req, n, shape.chips_per_host, used)
         ]
-        if len(candidates) < shape.num_hosts:
+        if len(candidates) < count:
             raise AllocationError(
-                f"need {shape.num_hosts} hosts with {shape.chips_per_host} free"
-                f" TPU ports, only {len(candidates)} available"
+                f"need {count} {'more ' if exclude else ''}hosts with"
+                f" {shape.chips_per_host} free TPU ports for"
+                f" {shape.topology}, only {len(candidates)} available"
             )
         # Least-loaded first so slices pack breadth-first across the fabric.
         candidates.sort(key=lambda n: (used.get(n.name, 0), n.name))
-        return [n.metadata.name for n in candidates[: shape.num_hosts]]
+        return [n.metadata.name for n in candidates[:count]]
 
     def _used_slots_map(self, exclude_request: str = "") -> Dict[str, int]:
         """node -> chips already claimed there: instantiated children PLUS

@@ -112,6 +112,65 @@ class InMemoryPool(FabricProvider):
                 model=model, topology=topology, nodes=list(nodes), groups=groups
             )
 
+    def resize_slice(
+        self, slice_name: str, model: str, topology: str, nodes: List[str]
+    ) -> None:
+        """Live grow/shrink: keep the chip groups of surviving workers
+        (stable prefix of `nodes`), carve or free only the delta. Attached
+        chips on a removed worker are a caller bug — the controller drains
+        those members first — and raise rather than silently leak."""
+        with self._lock:
+            resv = self._slices.get(slice_name)
+            if resv is None:
+                return self.reserve_slice(slice_name, model, topology, nodes)
+            shape = solve_slice(model, _chips_in(topology), topology)
+            if len(nodes) != shape.num_hosts:
+                raise FabricError(
+                    f"slice {slice_name}: topology {topology} needs"
+                    f" {shape.num_hosts} hosts, got {len(nodes)}"
+                )
+            old_cph = len(next(iter(resv.groups.values()), []))
+            if old_cph and old_cph != shape.chips_per_host:
+                raise FabricError(
+                    f"slice {slice_name}: resize cannot change chips_per_host"
+                    f" ({old_cph} -> {shape.chips_per_host}); dissolve instead"
+                )
+            survivors = 0
+            while (
+                survivors < len(nodes)
+                and survivors < len(resv.nodes)
+                and nodes[survivors] == resv.nodes[survivors]
+            ):
+                survivors += 1
+            attached_ids = {
+                d for a in self._attachments.values() if a.slice_name == slice_name
+                for d in a.device_ids
+            }
+            # Validate EVERYTHING before mutating: a raise mid-mutation
+            # would leak popped chip groups from inventory.
+            removed = range(survivors, len(resv.nodes))
+            for w in removed:
+                if any(c in attached_ids for c in resv.groups.get(w, [])):
+                    raise FabricError(
+                        f"slice {slice_name}: worker {w} still has attached"
+                        " chips; drain before resize"
+                    )
+            new_workers = range(survivors, shape.num_hosts)
+            need = len(new_workers) * shape.chips_per_host
+            free = self._free.get(model, [])
+            if len(free) < need:
+                raise FabricError(
+                    f"slice {slice_name}: pool has {len(free)} free {model}"
+                    f" chips, resize needs {need} more"
+                )
+            # Commit: free the dropped workers' groups, carve the new ones.
+            for w in removed:
+                self._free[resv.model].extend(resv.groups.pop(w, []))
+            for w in new_workers:
+                resv.groups[w] = [free.pop(0) for _ in range(shape.chips_per_host)]
+            resv.topology = topology
+            resv.nodes = list(nodes)
+
     def release_slice(self, slice_name: str) -> None:
         with self._lock:
             resv = self._slices.pop(slice_name, None)

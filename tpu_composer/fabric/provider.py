@@ -38,6 +38,10 @@ class WaitingDeviceAttaching(FabricError):
     """Attach accepted but still in progress; requeue (client.go:41-42)."""
 
 
+class UnsupportedResize(FabricError):
+    """The provider cannot reshape a reservation in place; dissolve instead."""
+
+
 class WaitingDeviceDetaching(FabricError):
     """Detach accepted but still in progress; requeue (client.go:43-44)."""
 
@@ -116,3 +120,23 @@ class FabricProvider(abc.ABC):
 
     def release_slice(self, slice_name: str) -> None:
         """Tear down a slice reservation and any remaining attachments."""
+
+    def resize_slice(
+        self, slice_name: str, model: str, topology: str, nodes: List[str]
+    ) -> None:
+        """Reprogram an existing reservation to a new topology while
+        preserving the chip groups of hosts present in both the old and new
+        node lists (live grow/shrink — the reference's closest analog is
+        device reuse on spec drift, composabilityrequest_controller.go:
+        254-305, which our atomic slice model otherwise forbids). Surviving
+        hosts MUST form a stable prefix so worker_ids (and the TPU_* env
+        already injected into running pods) stay valid.
+
+        Providers without native ICI reprogramming MUST NOT emulate this
+        with release+reserve — releasing tears down the survivors' chip
+        reservations out from under running pods. The default refuses; the
+        controller catches UnsupportedResize and falls back to its
+        dissolve-and-rebuild path."""
+        raise UnsupportedResize(
+            f"{type(self).__name__} has no live slice resize"
+        )

@@ -106,20 +106,33 @@ def make_train_state(tc: TrainConfig, key, mesh: Optional[Mesh] = None) -> Dict:
             "ln_f": params["ln_f"],
         }
     opt_state = _optimizer(tc).init(params)
+    state = {"params": params, "opt": opt_state}
     if mesh is not None:
-        specs = _param_specs(tc, mesh)
-        params = _shard_pytree(params, specs, mesh)
+        state = reshard_train_state(tc, state, mesh)
+    return state
 
-        # Adam moments mirror the param layout; scalar counts replicate.
-        def shard_opt(entry):
-            if isinstance(entry, dict):  # mu/nu pytrees shaped like params
-                return _shard_pytree(entry, specs, mesh)
-            return jax.device_put(entry, NamedSharding(mesh, P()))
 
-        opt_state = jax.tree.map(
-            shard_opt, opt_state, is_leaf=lambda x: isinstance(x, dict)
-        )
-    return {"params": params, "opt": opt_state}
+def reshard_train_state(tc: TrainConfig, state: Dict, mesh: Mesh) -> Dict:
+    """Move a live train state onto a different mesh — the workload half of
+    the operator's live slice resize (request_controller._allocate_tpu keeps
+    workers 0..k-1 alive through a grow/shrink; the job then rebuilds its
+    mesh and calls this). Same pytree, new NamedShardings: jax.device_put
+    performs the cross-layout transfer, which XLA lowers to resharding
+    collectives on a real slice. Training continues bit-for-bit — the
+    continuity test asserts the next step's loss matches the un-resized
+    run's."""
+    specs = _param_specs(tc, mesh)
+    params = _shard_pytree(state["params"], specs, mesh)
+
+    def shard_opt(entry):
+        if isinstance(entry, dict):
+            return _shard_pytree(entry, specs, mesh)
+        return jax.device_put(entry, NamedSharding(mesh, P()))
+
+    opt = jax.tree.map(
+        shard_opt, state["opt"], is_leaf=lambda x: isinstance(x, dict)
+    )
+    return {"params": params, "opt": opt}
 
 
 def _sp_attn_fn(mesh: Mesh, impl: str):
