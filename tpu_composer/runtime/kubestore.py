@@ -656,7 +656,7 @@ class _WatchThread(threading.Thread):
         kind: str,
         out: "queue.Queue[Any]",
         reconnect_s: float,
-        sync_sentinel: Optional[object] = None,
+        emit_relist_complete: bool = False,
     ) -> None:
         super().__init__(daemon=True, name=f"kubewatch-{kind}")
         self._store = store
@@ -665,7 +665,7 @@ class _WatchThread(threading.Thread):
         self._reconnect_s = reconnect_s
         self._stop = threading.Event()
         self._resp = None
-        self._sync_sentinel = sync_sentinel
+        self._emit_relist_complete = emit_relist_complete
         # Last-known object per name, maintained across the stream. Lets
         # _relist synthesize DELETED for objects that vanished during a watch
         # gap (client-go's DeletedFinalStateUnknown analog — without it a
@@ -729,8 +729,8 @@ class _WatchThread(threading.Thread):
                 if need_relist:
                     last_rv = self._relist()
                     need_relist = False
-                    if self._sync_sentinel is not None:
-                        self._out.put(self._sync_sentinel)
+                    if self._emit_relist_complete:
+                        self._out.put(_RelistComplete(frozenset(self._known)))
                 path = f"{route.path_prefix}?watch=true"
                 if last_rv:
                     path += f"&resourceVersion={last_rv}"
@@ -792,9 +792,16 @@ class _WatchThread(threading.Thread):
                 self._stop.wait(backoff if not connected else self._reconnect_s)
 
 
-# Queue sentinel a _WatchThread emits after its initial relist: everything
-# before it is the full current collection, so the cache behind it is synced.
-_SYNCED = object()
+@dataclass(frozen=True)
+class _RelistComplete:
+    """Queue marker a _WatchThread emits after each relist: everything
+    before it is the full current collection (so the cache behind it is
+    synced), and `names` is that collection's exact name set — the consumer
+    evicts cache entries outside it. The _known-based DELETED synthesis
+    can't cover objects that entered the cache via note_write while the
+    watch was down (the watch thread never saw them); this does."""
+
+    names: frozenset
 
 
 class _Reflector:
@@ -822,7 +829,7 @@ class _Reflector:
         self._synced = threading.Event()
         self._stopped = threading.Event()
         self._watch = _WatchThread(
-            store, kind, self._events, reconnect_s, sync_sentinel=_SYNCED
+            store, kind, self._events, reconnect_s, emit_relist_complete=True
         )
         self._consumer = threading.Thread(
             target=self._run, daemon=True, name=f"kubecache-{kind}"
@@ -842,7 +849,17 @@ class _Reflector:
             evt = self._events.get()
             if evt is None:
                 continue
-            if evt is _SYNCED:
+            if isinstance(evt, _RelistComplete):
+                # The relist names are authoritative: evict anything else
+                # (e.g. entries note_write folded in while the watch was in
+                # a 410 gap, whose DELETED the _known synthesis can't see).
+                # An object created concurrently with the relist may be
+                # evicted transiently — its watch ADDED (at a later RV than
+                # the relist) re-adds it.
+                with self._lock:
+                    for name in list(self._cache):
+                        if name not in evt.names:
+                            del self._cache[name]
                 self._synced.set()
                 continue
             name = evt.obj.metadata.name
