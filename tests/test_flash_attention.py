@@ -134,3 +134,91 @@ class TestTrainStepIntegration:
             losses[impl] = float(metrics["loss"])
             assert losses[impl] == losses[impl]  # finite
         assert abs(losses["flash"] - losses["reference"]) < 1e-3
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif(
+    # Env-only check at collection: calling jax.default_backend() here
+    # would initialize the real backend — and HANG, not error, when the
+    # tunnel relay is down (the exact failure mode probe.py diagnoses).
+    __import__("os").environ.get("TPUC_TESTS_ON_TPU") != "1",
+    reason="needs real TPU (TPUC_TESTS_ON_TPU=1 and a live chip)",
+)
+class TestOnHardware:
+    """Mosaic-compiled numerics + speed on the live chip (VERDICT r2 ask #5).
+
+    Interpret mode proves the math; only the real compiler proves the
+    kernels. seq spans 2k-8k — the long-context regime flash exists for,
+    where the reference einsum materializes up to (8k)^2 scores per head.
+    """
+
+    @pytest.fixture(autouse=True)
+    def _require_live_chip(self):
+        from tpu_composer.workload.probe import probe_pool_endpoints
+
+        eps = probe_pool_endpoints(timeout_s=1.0)
+        if eps and not any(e.get("reachable") for e in eps):
+            pytest.skip("axon tunnel relay down — backend init would hang")
+        if jax.default_backend() != "tpu":
+            pytest.skip(f"backend is {jax.default_backend()}, not tpu")
+
+    @pytest.mark.parametrize("seq", [2048, 4096, 8192])
+    def test_fwd_bwd_numerics_long_seq(self, seq):
+        b, h, d = 1, 4, 128
+        q = jax.random.normal(jax.random.key(0), (b, seq, h, d), jnp.bfloat16)
+        k = jax.random.normal(jax.random.key(1), (b, seq, h, d), jnp.bfloat16)
+        v = jax.random.normal(jax.random.key(2), (b, seq, h, d), jnp.bfloat16)
+
+        out = jax.jit(
+            lambda *a: flash_attention(*a, causal=True)
+        )(q, k, v).block_until_ready()
+        ref = jax.jit(
+            lambda *a: mha_reference(*a, causal=True)
+        )(q, k, v).block_until_ready()
+        fwd_err = float(
+            jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)).max()
+        )
+        assert fwd_err < 0.1, f"seq={seq} fwd err {fwd_err}"
+
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+        def loss_ref(q, k, v):
+            return mha_reference(q, k, v, causal=True).astype(jnp.float32).sum()
+
+        gf = jax.block_until_ready(
+            jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        )
+        gr = jax.block_until_ready(
+            jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        )
+        bwd_err = max(
+            float(jnp.abs(a.astype(jnp.float32) - b_.astype(jnp.float32)).max())
+            for a, b_ in zip(gf, gr)
+        )
+        assert bwd_err < 0.5, f"seq={seq} bwd err {bwd_err}"
+
+    def test_flash_beats_reference_at_long_seq(self):
+        import time as _time
+
+        b, h, d, seq = 1, 4, 128, 4096
+        q = jax.random.normal(jax.random.key(0), (b, seq, h, d), jnp.bfloat16)
+        k = jax.random.normal(jax.random.key(1), (b, seq, h, d), jnp.bfloat16)
+        v = jax.random.normal(jax.random.key(2), (b, seq, h, d), jnp.bfloat16)
+
+        def bench(fn, iters=10):
+            fn(q, k, v)
+            jax.block_until_ready(fn(q, k, v))
+            t0 = _time.perf_counter()
+            for _ in range(iters):
+                out = fn(q, k, v)
+            jax.block_until_ready(out)
+            return (_time.perf_counter() - t0) / iters
+
+        flash_t = bench(jax.jit(lambda *a: flash_attention(*a, causal=True)))
+        ref_t = bench(jax.jit(lambda *a: mha_reference(*a, causal=True)))
+        # The causal-block skip alone should put flash ahead at 4k.
+        assert flash_t < ref_t, (
+            f"flash {flash_t*1e3:.2f}ms not faster than reference"
+            f" {ref_t*1e3:.2f}ms at seq={seq}"
+        )

@@ -167,7 +167,7 @@ def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret,
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
                dq_scr, *, causal: bool, block_q: int, block_k: int, nk: int):
     qi, ki = pl.program_id(1), pl.program_id(2)
     d = q_ref.shape[2]
@@ -194,7 +194,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_ref[0][:, :1])
+        # delta_i = rowsum(dO_i * O_i), recomputed in-VMEM from blocks the
+        # kernel already streams: one (block_q, d) fused multiply-reduce per
+        # step (~1/384 of the step's matmul FLOPs) instead of a whole
+        # (BH, S, 128) fp32 residual array in HBM (r2 advisor finding — at
+        # seq 8k training that array was hundreds of MB per pass).
+        delta = jnp.sum(
+            do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True
+        )
+        ds = p * (dp - delta)
         dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -204,7 +212,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
                 *, causal: bool, block_q: int, block_k: int, nq: int):
     ki, qi = pl.program_id(1), pl.program_id(2)
@@ -236,7 +244,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_ref[0][:, :1])
+        # In-VMEM delta recompute — see _dq_kernel.
+        delta = jnp.sum(
+            do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True
+        )
+        ds = p * (dp - delta)
         dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -253,14 +265,6 @@ def _flash_backward(res, g, causal, block_q, block_k, interpret):
     sk = k3.shape[1]
     nq, nk = sq // block_q, sk // block_k
     do = g
-    # delta_i = rowsum(dO_i * O_i), lane-replicated to the same (bh, sq, 128)
-    # layout as lse (one cheap XLA reduce + broadcast).
-    delta = jnp.broadcast_to(
-        jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
-                keepdims=True),
-        (do.shape[0], do.shape[1], 128),
-    )
-
     sem = {}
     if not interpret:
         sem["compiler_params"] = pltpu.CompilerParams(
@@ -277,14 +281,14 @@ def _flash_backward(res, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
         **sem,
-    )(q3, k3, v3, do, lse, delta)
+    )(q3, k3, v3, do, out, lse)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, block_q=block_q,
@@ -299,7 +303,7 @@ def _flash_backward(res, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=(
@@ -312,7 +316,7 @@ def _flash_backward(res, g, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
         **sem,
-    )(q3, k3, v3, do, lse, delta)
+    )(q3, k3, v3, do, out, lse)
     return dq, dk, dv
 
 
