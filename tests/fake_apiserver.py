@@ -24,13 +24,46 @@ Used by test_kubestore.py for the full operator e2e on a cluster-shaped API.
 
 from __future__ import annotations
 
+import base64
 import json
+import ssl
 import threading
 import time
+import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlparse
+
+
+def _apply_jsonpatch(obj: Dict[str, Any], patch: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Minimal RFC 6902 apply (add/replace/remove) — what a real apiserver
+    does with a mutating webhook's JSONPatch response."""
+    out = json.loads(json.dumps(obj))
+    for op in patch:
+        parts = [p.replace("~1", "/").replace("~0", "~")
+                 for p in op["path"].lstrip("/").split("/")]
+        parent = out
+        for p in parts[:-1]:
+            parent = parent[int(p)] if isinstance(parent, list) else parent.setdefault(p, {})
+        leaf = parts[-1]
+        if op["op"] in ("add", "replace"):
+            if isinstance(parent, list):
+                if leaf == "-":
+                    parent.append(op["value"])
+                else:
+                    parent.insert(int(leaf), op["value"]) if op["op"] == "add" \
+                        else parent.__setitem__(int(leaf), op["value"])
+            else:
+                parent[leaf] = op["value"]
+        elif op["op"] == "remove":
+            if isinstance(parent, list):
+                parent.pop(int(leaf))
+            else:
+                parent.pop(leaf, None)
+        else:
+            raise ValueError(f"unsupported JSONPatch op {op['op']!r}")
+    return out
 
 
 def _status_body(code: int, reason: str, message: str) -> bytes:
@@ -87,6 +120,19 @@ class FakeApiServer:
         # Wire-level request log [(method, path)] — the envtest-style probe
         # for how chatty a client is (cache-efficiency assertions).
         self.request_log: List[Tuple[str, str]] = []
+        # Admission webhook registrations, called out over the wire exactly
+        # as a real apiserver would (the envtest WebhookInstallOptions
+        # analog — /root/reference/internal/webhook/v1alpha1/
+        # webhook_suite_test.go:74-144). Each entry:
+        #   {"prefix": <resource path prefix>, "url": <webhook endpoint>,
+        #    "operations": {"CREATE", "UPDATE"}}
+        # A denied review fails the API call with 403; a JSONPatch response
+        # is applied to the object before it is stored.
+        self.webhooks: List[Dict[str, Any]] = []
+        # Injected per-request latency (seconds) — models apiserver RTT for
+        # latency benchmarks. Applied once per HTTP request (streaming watch
+        # events after connect are push, not request/response).
+        self.latency_s: float = 0.0
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -129,6 +175,8 @@ class FakeApiServer:
             def _maybe_fail(self) -> bool:
                 with server.state.lock:
                     server.request_log.append((self.command, self.path))
+                if server.latency_s:
+                    time.sleep(server.latency_s)
                 for hook in server.fail_hooks:
                     out = hook(self.command, self.path)
                     if out:
@@ -219,6 +267,58 @@ class FakeApiServer:
                 n = int(self.headers.get("Content-Length", "0"))
                 return json.loads(self.rfile.read(n) or b"{}")
 
+            def _admit(self, prefix: str, operation: str,
+                       obj: Dict[str, Any],
+                       old: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+                """Run registered webhooks over the wire. Returns the
+                (possibly patched) object, or None after sending a denial."""
+                for hook in server.webhooks:
+                    if hook["prefix"] != prefix:
+                        continue
+                    if operation not in hook.get("operations", {"CREATE", "UPDATE"}):
+                        continue
+                    review = {
+                        "apiVersion": "admission.k8s.io/v1",
+                        "kind": "AdmissionReview",
+                        "request": {
+                            "uid": str(uuid.uuid4()),
+                            "operation": operation,
+                            "object": obj,
+                            "oldObject": old,
+                        },
+                    }
+                    data = json.dumps(review).encode()
+                    req = urllib.request.Request(
+                        hook["url"], data=data, method="POST",
+                        headers={"Content-Type": "application/json"},
+                    )
+                    kwargs: Dict[str, Any] = {"timeout": 10}
+                    if hook["url"].startswith("https"):
+                        ctx = ssl.create_default_context()
+                        ctx.check_hostname = False
+                        ctx.verify_mode = ssl.CERT_NONE  # self-signed test certs
+                        kwargs["context"] = ctx
+                    try:
+                        with urllib.request.urlopen(req, **kwargs) as resp:
+                            out = json.loads(resp.read())
+                    except (OSError, ValueError) as e:
+                        # failurePolicy: Fail — the reference's default for
+                        # its validating webhook.
+                        self._deny(500, "InternalError",
+                                   f"webhook {hook['url']} unreachable: {e}")
+                        return None
+                    response = out.get("response") or {}
+                    if not response.get("allowed", False):
+                        msg = ((response.get("status") or {}).get("message")
+                               or "admission denied")
+                        self._deny(403, "Forbidden", msg)
+                        return None
+                    if response.get("patch"):
+                        patch = json.loads(
+                            base64.b64decode(response["patch"]))
+                        obj = _apply_jsonpatch(obj, patch)
+                return obj
+
             def do_POST(self) -> None:
                 if self._maybe_fail():
                     return
@@ -233,6 +333,10 @@ class FakeApiServer:
                 oname = meta.get("name", "")
                 if not oname:
                     return self._deny(422, "Invalid", "metadata.name required")
+                obj = self._admit(prefix, "CREATE", obj, None)
+                if obj is None:
+                    return  # webhook denied; response already sent
+                meta = obj.setdefault("metadata", {})
                 st = server.state
                 with st.lock:
                     if (prefix, oname) in st.objects:
@@ -262,6 +366,15 @@ class FakeApiServer:
                     return self._deny(405, "MethodNotAllowed", "PUT to collection")
                 incoming = self._read_body()
                 st = server.state
+                # Admission sees spec updates, not status subresource writes
+                # (matching real webhook rules scoped to the main resource).
+                if not is_status:
+                    with st.lock:
+                        old = st.objects.get((prefix, name))
+                        old = json.loads(json.dumps(old)) if old else None
+                    incoming = self._admit(prefix, "UPDATE", incoming, old)
+                    if incoming is None:
+                        return
                 with st.lock:
                     stored = st.objects.get((prefix, name))
                     if stored is None:
@@ -389,3 +502,44 @@ class FakeApiServer:
             obj = st.objects.pop((prefix, name), None)
             if obj:
                 st.notify(prefix, "DELETED", obj)
+
+
+def operator_resources(group: str, version: str) -> Dict[str, Dict[str, Any]]:
+    """The standard route map for operator-on-cluster harnesses — ONE
+    definition shared by the e2e fixtures and bench.py so a new published
+    resource can't silently diverge between them."""
+    return {
+        f"/apis/{group}/{version}/composabilityrequests": {
+            "kind": "ComposabilityRequest", "apiVersion": f"{group}/{version}",
+        },
+        f"/apis/{group}/{version}/composableresources": {
+            "kind": "ComposableResource", "apiVersion": f"{group}/{version}",
+        },
+        "/api/v1/nodes": {"kind": "Node", "apiVersion": "v1"},
+        "/apis/resource.k8s.io/v1beta1/resourceslices": {
+            "kind": "ResourceSlice", "apiVersion": "resource.k8s.io/v1beta1",
+        },
+        "/apis/resource.k8s.io/v1alpha3/devicetaintrules": {
+            "kind": "DeviceTaintRule", "apiVersion": "resource.k8s.io/v1alpha3",
+        },
+    }
+
+
+def core_node_doc(name: str, chips: int = 4,
+                  chip_resource: str = "tpu.composer.dev/chips") -> Dict[str, Any]:
+    """A core-v1-shaped Node as kubelet would publish it."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "status": {
+            "allocatable": {
+                "cpu": "8",
+                "memory": "32Gi",
+                "ephemeral-storage": "100Gi",
+                "pods": "110",
+                chip_resource: str(chips),
+            },
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }

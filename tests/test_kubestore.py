@@ -45,7 +45,7 @@ from tpu_composer.runtime.store import (
     NotFoundError,
 )
 
-from tests.fake_apiserver import FakeApiServer
+from tests.fake_apiserver import FakeApiServer, core_node_doc, operator_resources
 
 CR_PREFIX = f"/apis/{GROUP}/{VERSION}/composabilityrequests"
 RES_PREFIX = f"/apis/{GROUP}/{VERSION}/composableresources"
@@ -54,45 +54,12 @@ NODE_PREFIX = "/api/v1/nodes"
 
 def core_node(name: str, chips: int = 4) -> dict:
     """A core-v1-shaped Node as kubelet would publish it."""
-    return {
-        "apiVersion": "v1",
-        "kind": "Node",
-        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
-        "status": {
-            "allocatable": {
-                "cpu": "8",
-                "memory": "32Gi",
-                "ephemeral-storage": "100Gi",
-                "pods": "110",
-                CHIP_RESOURCE: str(chips),
-            },
-            "conditions": [{"type": "Ready", "status": "True"}],
-        },
-    }
+    return core_node_doc(name, chips=chips, chip_resource=CHIP_RESOURCE)
 
 
 @pytest.fixture()
 def apiserver():
-    srv = FakeApiServer(
-        {
-            CR_PREFIX: {
-                "kind": "ComposabilityRequest",
-                "apiVersion": f"{GROUP}/{VERSION}",
-            },
-            RES_PREFIX: {
-                "kind": "ComposableResource",
-                "apiVersion": f"{GROUP}/{VERSION}",
-            },
-            NODE_PREFIX: {"kind": "Node", "apiVersion": "v1"},
-            "/apis/resource.k8s.io/v1beta1/resourceslices": {
-                "kind": "ResourceSlice", "apiVersion": "resource.k8s.io/v1beta1",
-            },
-            "/apis/resource.k8s.io/v1alpha3/devicetaintrules": {
-                "kind": "DeviceTaintRule",
-                "apiVersion": "resource.k8s.io/v1alpha3",
-            },
-        }
-    )
+    srv = FakeApiServer(operator_resources(GROUP, VERSION))
     srv.start()
     yield srv
     srv.stop()
@@ -528,5 +495,5 @@ class TestWireEfficiency:
         # Reads: cache-served — nothing beyond stray reflector (re)lists.
         assert len(reads) <= 3, f"read side chatty again: {reads}"
         # Writes: child creates + status updates for a size-4 slice
-        # (measured 14 with the cache; slack for scheduling variance).
-        assert len(writes) <= 30, f"write side exploded: {writes}"
+        # (measured 10 after the transaction diet; slack for variance).
+        assert len(writes) <= 20, f"write side exploded: {writes}"

@@ -182,6 +182,10 @@ class ComposableResourceReconciler(Controller):
             res.status.device_ids = [leaked]
             res.status.state = RESOURCE_STATE_ONLINE
         else:
+            # NOT fused into the attach pass (unlike the request's ""
+            # state): Attaching must be durably visible before the fabric
+            # call — async providers (CM flavor) sit in it for whole
+            # requeue cycles and operators watch it.
             res.status.state = RESOURCE_STATE_ATTACHING
         self.store.update_status(res)
         return Result(requeue_after=0.0 if not res.being_deleted else self.timing.detach_fast)
@@ -205,15 +209,28 @@ class ComposableResourceReconciler(Controller):
             fabric_requests_total.inc(op="add", outcome="waiting")
             return Result(requeue_after=self.timing.attach_poll)
 
-        if res.status.device_ids != attach.device_ids or res.status.cdi_device_id != attach.cdi_device_id:
+        changed = (
+            res.status.device_ids != attach.device_ids
+            or res.status.cdi_device_id != attach.cdi_device_id
+        )
+        if changed:
             res.status.device_ids = list(attach.device_ids)
             res.status.cdi_device_id = attach.cdi_device_id
+        # Chip indices are assigned under the same lock that persists them:
+        # one status write is both the fabric-attachment durability point
+        # AND the index claim, and a concurrently-attaching co-located group
+        # cannot observe the gap between assignment and persistence.
+        if is_tpu_model(res.spec.model):
+            with self._index_lock:
+                changed = self._assign_chip_indices(res) or changed
+                if changed:
+                    res = self.store.update_status(res)
+        elif changed:
             res = self.store.update_status(res)
 
         # Publish to workloads: CDI spec with TPU_* coordinates (:252-286's
         # TPU-native replacement).
         if is_tpu_model(res.spec.model):
-            res = self._ensure_chip_indices(res)
             spec = generate_cdi_spec(
                 slice_name=res.spec.slice_name or res.name,
                 worker_id=res.spec.worker_id,
@@ -249,9 +266,13 @@ class ComposableResourceReconciler(Controller):
                             f"{len(res.status.device_ids)} chip(s) online on {res.spec.target_node}")
         return Result()
 
-    def _ensure_chip_indices(self, res: ComposableResource) -> ComposableResource:
+    def _assign_chip_indices(self, res: ComposableResource) -> bool:
         """Assign host-local /dev/accel indices disjoint from every other
-        group on the same node, and persist them in status.
+        group on the same node. Caller MUST hold _index_lock across this
+        call AND the status write that persists it — otherwise a
+        concurrently-attaching co-located group could compute the same
+        indices from the not-yet-written store state. Returns whether
+        anything changed.
 
         Without this, co-located groups would all publish accel0..N-1 and
         hand containers the same physical chips (and deadlock each other's
@@ -259,23 +280,22 @@ class ComposableResourceReconciler(Controller):
         exactly one controller instance is active (leader election)."""
         need = len(res.status.device_ids)
         if len(res.status.chip_indices) == need and need > 0:
-            return res
-        with self._index_lock:
-            used = {
-                i
-                for other in self.store.list(ComposableResource)
-                if other.metadata.name != res.metadata.name
-                and other.spec.target_node == res.spec.target_node
-                for i in other.status.chip_indices
-            }
-            indices: List[int] = []
-            candidate = 0
-            while len(indices) < need:
-                if candidate not in used:
-                    indices.append(candidate)
-                candidate += 1
-            res.status.chip_indices = indices
-            return self.store.update_status(res)
+            return False
+        used = {
+            i
+            for other in self.store.list(ComposableResource)
+            if other.metadata.name != res.metadata.name
+            and other.spec.target_node == res.spec.target_node
+            for i in other.status.chip_indices
+        }
+        indices: List[int] = []
+        candidate = 0
+        while len(indices) < need:
+            if candidate not in used:
+                indices.append(candidate)
+            candidate += 1
+        res.status.chip_indices = indices
+        return True
 
     def _cdi_name(self, res: ComposableResource) -> str:
         """The CDI publication name for a tpu group ('' for gpu compat) —
