@@ -40,6 +40,16 @@ run:
 docker-build:
 	docker build -t $(IMG) .
 
+# Multi-arch image build (reference Makefile:162): cross-compiles the
+# native node library per platform inside the Dockerfile.
+PLATFORMS ?= linux/arm64,linux/amd64,linux/s390x,linux/ppc64le
+## docker-buildx: build+push the image for every PLATFORMS entry
+docker-buildx:
+	- docker buildx create --name tpu-composer-builder
+	docker buildx use tpu-composer-builder
+	- docker buildx build --push --platform=$(PLATFORMS) --tag $(IMG) .
+	- docker buildx rm tpu-composer-builder
+
 ## lint: syntax check every module
 lint:
 	$(PYTHON) -m compileall -q tpu_composer tests bench.py __graft_entry__.py
@@ -55,3 +65,21 @@ build-installer: manifests
 ## bundle: OLM-style bundle dir (manifests/ + metadata/annotations.yaml)
 bundle: manifests
 	$(PYTHON) -m tpu_composer.api.packaging bundle --out bundle
+
+# OLM catalog (reference Makefile:275-329): a File-Based Catalog directory
+# rendered from the bundle, buildable into a catalog image for
+# CatalogSource installs.
+BUNDLE_IMG ?= tpu-composer-bundle:latest
+CATALOG_IMG ?= tpu-composer-catalog:latest
+## catalog: render a File-Based Catalog from the bundle (dist/catalog/)
+catalog: bundle
+	$(PYTHON) -m tpu_composer.api.packaging catalog --bundle bundle \
+		--bundle-image $(BUNDLE_IMG) --out dist/catalog
+
+## catalog-build: containerize the FBC (requires docker + opm base image)
+catalog-build: catalog
+	docker build -f dist/catalog/catalog.Dockerfile -t $(CATALOG_IMG) dist
+
+## validate-manifests: schema-check deploy/crds + dist/install.yaml (CI gate)
+validate-manifests: build-installer
+	$(PYTHON) -m tpu_composer.api.validate_manifests deploy/crds dist/install.yaml

@@ -218,3 +218,32 @@ class TestGroupClaims:
         with pytest.raises(DeviceBusyError):
             agent.drain("n0", ["a1", "a2"], group="sA-worker0")
         agent.drain("n0", ["b1", "b2"], group="sB-worker0")  # B drains fine
+
+
+class TestPluginAdapterAgainstRealAgent:
+    """ADVICE r2: the device-plugin adapter must consume the agent's public
+    list_composed_devices() contract — exercised here against a REAL
+    LocalNodeAgent with an on-disk claim, not a fake."""
+
+    def test_lister_reflects_cdi_claims(self, fake_host):
+        from tpu_composer.agent.cdi import generate_cdi_spec
+        from tpu_composer.agent.plugin import lister_from_agent
+
+        agent = make_agent(fake_host)
+        spec = generate_cdi_spec(
+            slice_name="train-slice", worker_id=0, chip_indices=[0, 1],
+            env={"TPU_WORKER_ID": "0"},
+        )
+        agent.refresh_device_stack("n0", spec=spec)
+
+        devices = lister_from_agent(agent)()
+        assert len(devices) == 2
+        ids = {d[0] for d in devices}
+        assert all("train-slice" in i for i in ids)
+        # Healthy flags and real /dev paths from the claim.
+        assert all(d[1] for d in devices)
+        assert all("/accel" in d[2] or "/vfio" in d[2] for d in devices)
+
+        # Claim removal empties the advertised list.
+        agent.refresh_device_stack("n0", remove_name=spec.name)
+        assert lister_from_agent(agent)() == []

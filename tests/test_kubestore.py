@@ -210,9 +210,14 @@ class TestKubeStoreCrud:
             obj = kstore.get(ComposabilityRequest, "w1")
             obj.status.state = "Running"
             kstore.update_status(obj)
-            evt = q.get(timeout=5)
-            assert evt.type == "MODIFIED"
-            assert evt.obj.status.state == "Running"
+            # Tolerate interleaved replay events (and scheduler delay under
+            # parallel test load): drain until the status write surfaces.
+            deadline = time.monotonic() + 10
+            while True:
+                evt = q.get(timeout=max(0.1, deadline - time.monotonic()))
+                if evt.obj.status.state == "Running":
+                    assert evt.type == "MODIFIED"
+                    break
         finally:
             kstore.stop_watch(q)
 

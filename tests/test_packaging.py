@@ -82,3 +82,106 @@ class TestBundle:
         with open(os.path.join(out, "metadata", "annotations.yaml")) as f:
             ann = yaml.safe_load(f)["annotations"]
         assert ann["operators.operatorframework.io.bundle.package.v1"] == "tpu-composer"
+
+
+class TestManifestValidation:
+    """The CI schema gate (VERDICT r2 ask #9): CRDs must satisfy the
+    structural rules an apiserver enforces at install time, and the shipped
+    examples must validate against those schemas — so generation drift
+    fails in CI, not on a cluster."""
+
+    def test_real_artifacts_validate(self, tmp_path):
+        from tpu_composer.api.packaging import build_installer
+        from tpu_composer.api.validate_manifests import validate_all
+
+        install = tmp_path / "install.yaml"
+        build_installer("deploy", str(install))
+        errs = validate_all("deploy/crds", str(install))
+        assert errs == []
+
+    def test_nonstructural_crd_is_caught(self, tmp_path):
+        from tpu_composer.api.validate_manifests import validate_crd
+
+        with open("deploy/crds/tpu.composer.dev_composabilityrequests.yaml") as f:
+            crd = yaml.safe_load(f)
+        # Break structurality: drop a nested property's type.
+        schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+        del schema["properties"]["spec"]["type"]
+        errs = validate_crd(crd, "broken.yaml")
+        assert any("missing 'type'" in e for e in errs)
+
+    def test_two_storage_versions_is_caught(self):
+        from tpu_composer.api.validate_manifests import validate_crd
+
+        with open("deploy/crds/tpu.composer.dev_composableresources.yaml") as f:
+            crd = yaml.safe_load(f)
+        v = dict(crd["spec"]["versions"][0])
+        v["name"] = "v1alpha2"
+        crd["spec"]["versions"].append(v)  # second storage=true
+        errs = validate_crd(crd, "broken.yaml")
+        assert any("exactly one storage version" in e for e in errs)
+
+    def test_example_with_typo_field_is_caught(self, tmp_path):
+        from tpu_composer.api.validate_manifests import validate_all
+        from tpu_composer.api.packaging import build_installer
+
+        ex = tmp_path / "examples"
+        ex.mkdir()
+        (ex / "bad.yaml").write_text(
+            "apiVersion: tpu.composer.dev/v1alpha1\n"
+            "kind: ComposabilityRequest\n"
+            "metadata:\n  name: bad\n"
+            "spec:\n  resource:\n    type: tpu\n    model: tpu-v4\n"
+            "    size: 4\n    allocation_polcy: samenode\n"  # typo
+        )
+        install = tmp_path / "install.yaml"
+        build_installer("deploy", str(install))
+        errs = validate_all("deploy/crds", str(install), examples_dir=str(ex))
+        assert any("allocation_polcy" in e for e in errs)
+
+    def test_enum_violation_is_caught(self, tmp_path):
+        from tpu_composer.api.validate_manifests import validate_all
+        from tpu_composer.api.packaging import build_installer
+
+        ex = tmp_path / "examples"
+        ex.mkdir()
+        (ex / "bad.yaml").write_text(
+            "apiVersion: tpu.composer.dev/v1alpha1\n"
+            "kind: ComposabilityRequest\n"
+            "metadata:\n  name: bad\n"
+            "spec:\n  resource:\n    type: quantum\n    model: tpu-v4\n"
+            "    size: 4\n"
+        )
+        install = tmp_path / "install.yaml"
+        build_installer("deploy", str(install))
+        errs = validate_all("deploy/crds", str(install), examples_dir=str(ex))
+        assert any("enum" in e for e in errs)
+
+
+class TestCatalog:
+    def test_catalog_renders_fbc(self, tmp_path):
+        import json as _json
+
+        from tpu_composer.api.packaging import build_bundle, build_catalog
+
+        bundle = tmp_path / "bundle"
+        build_bundle("deploy", str(bundle))
+        out = tmp_path / "catalog"
+        build_catalog(str(bundle), str(out), "reg.example/bundle:v1")
+        # The FBC file is concatenated JSON documents; raw_decode walks them.
+        text = (out / "catalog.json").read_text()
+        decoder = _json.JSONDecoder()
+        docs, idx = [], 0
+        while idx < len(text):
+            while idx < len(text) and text[idx].isspace():
+                idx += 1
+            if idx >= len(text):
+                break
+            doc, end = decoder.raw_decode(text, idx)
+            docs.append(doc)
+            idx = end
+        schemas = {d["schema"] for d in docs}
+        assert schemas == {"olm.package", "olm.channel", "olm.bundle"}
+        bundle_doc = next(d for d in docs if d["schema"] == "olm.bundle")
+        assert bundle_doc["image"] == "reg.example/bundle:v1"
+        assert (out / "catalog.Dockerfile").exists()

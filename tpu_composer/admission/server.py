@@ -48,6 +48,15 @@ VALIDATE_PATH = "/validate-tpu-composer-dev-v1alpha1-composabilityrequest"
 MUTATE_PATH = "/mutate-v1-pod"
 
 
+def make_server_tls_context(certfile: str, keyfile: Optional[str]) -> ssl.SSLContext:
+    """Server-side TLS context from a cert/key pair — shared by the
+    admission webhook and the secure metrics endpoint so cert-handling
+    fixes land in one place."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile, keyfile)
+    return ctx
+
+
 class _TlsPerConnectionServer(ThreadingHTTPServer):
     """TLS handshakes happen per connection in the worker thread, never in
     the accept loop: wrapping the *listening* socket makes SSLSocket.accept
@@ -178,9 +187,7 @@ class AdmissionServer:
             Handler,
         )
         if certfile:
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(certfile, keyfile)
-            self._httpd.ssl_context = ctx
+            self._httpd.ssl_context = make_server_tls_context(certfile, keyfile)
         self.tls = bool(certfile)
         self._thread: Optional[threading.Thread] = None
 

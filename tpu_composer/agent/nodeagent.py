@@ -194,6 +194,15 @@ class LocalNodeAgent(NodeAgent):
         except FileNotFoundError:
             pass
 
+    def list_composed_devices(self) -> Dict[str, List[str]]:
+        """Public claim inventory: composed group name -> its device nodes.
+
+        This is the contract the kubelet device plugin builds its device
+        list from (agent/plugin.py lister_from_agent) — a stable accessor,
+        not internal state (ADVICE r2: the plugin previously reached into
+        _claims())."""
+        return self._claims()
+
     def _claims(self) -> Dict[str, List[str]]:
         out: Dict[str, List[str]] = {}
         try:

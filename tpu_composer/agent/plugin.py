@@ -219,11 +219,12 @@ def lister_from_agent(agent) -> DeviceLister:
 
     Each claimed group contributes its chips; device id = ``<group>/<idx>``
     with the CDI qualified name for runtime injection. Unclaimed chips are
-    not advertised — the scheduler only sees what the operator composed."""
+    not advertised — the scheduler only sees what the operator composed.
+    Consumes the agent's public list_composed_devices() contract."""
 
     def list_devices():
         out = []
-        for group, dev_nodes in sorted(agent._claims().items()):
+        for group, dev_nodes in sorted(agent.list_composed_devices().items()):
             for idx, dev in enumerate(sorted(dev_nodes)):
                 out.append(
                     (f"{group}/{idx}", True, dev, f"tpu.composer.dev/chip={group}")

@@ -54,6 +54,27 @@ def build_parser() -> argparse.ArgumentParser:
         default=":8081",
         help="host:port for /healthz, /readyz and /metrics (empty to disable)",
     )
+    # Secure metrics (reference cmd/main.go:109-127: HTTPS + authn/authz
+    # filter; here TLS + bearer-token authorization from a mounted secret).
+    p.add_argument(
+        "--metrics-bind-address",
+        default="",
+        help="host:port for the dedicated secure /metrics endpoint"
+             " (empty: metrics stay on the health port, plain HTTP)",
+    )
+    p.add_argument(
+        "--metrics-cert", default="",
+        help="TLS certificate for the metrics endpoint",
+    )
+    p.add_argument(
+        "--metrics-key", default="",
+        help="TLS key for the metrics endpoint",
+    )
+    p.add_argument(
+        "--metrics-token-file", default="",
+        help="file holding the bearer token scrapers must present"
+             " (re-read per request; empty disables authorization)",
+    )
     p.add_argument(
         "--leader-elect",
         action="store_true",
@@ -226,12 +247,19 @@ def build_manager(args: argparse.Namespace) -> Manager:
             from tpu_composer.runtime.leases import LeaseElector
 
             elector = LeaseElector(store)
+    maddr = args.metrics_bind_address or None
+    if maddr and maddr.startswith(":"):
+        maddr = "0.0.0.0" + maddr
     mgr = Manager(
         store=store,
         leader_elect=args.leader_elect,
         leader_lock_path=args.leader_lock_path,
         health_addr=addr,
         leader_elector=elector,
+        metrics_addr=maddr,
+        metrics_certfile=args.metrics_cert or None,
+        metrics_keyfile=args.metrics_key or None,
+        metrics_token_file=args.metrics_token_file or None,
     )
     mgr.add_controller(ComposabilityRequestReconciler(store, fabric,
                                                       recorder=mgr.recorder))

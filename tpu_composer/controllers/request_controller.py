@@ -272,14 +272,14 @@ class ComposabilityRequestReconciler(Controller):
     def _handle_none(self, req: ComposabilityRequest) -> Result:
         if req.add_finalizer(FINALIZER):
             req = self.store.update(req)
-        req.status.state = REQUEST_STATE_NODE_ALLOCATING
-        req.status.error = ""
         # Fall straight into allocation: the NodeAllocating hop is not
         # persisted separately — the allocator's own status write records
         # both transitions, saving one sequential wire RTT on the
-        # attach-critical path. (The allocator re-reads under its lock, so
-        # a failed allocation leaves the server-side state at "" and the
-        # next reconcile retries from the top — same recovery semantics.)
+        # attach-critical path. No in-memory state mutation here either:
+        # the allocator re-reads under its lock anyway, and a mutated
+        # caller object is exactly what the fold-fallback write must never
+        # accidentally persist. A failed allocation leaves the server-side
+        # state at "" and the next reconcile retries from the top.
         return self._handle_node_allocating(req)
 
     def _handle_node_allocating(self, req: ComposabilityRequest) -> Result:

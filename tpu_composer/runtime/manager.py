@@ -8,6 +8,7 @@ and a metrics endpoint serves Prometheus text.
 
 from __future__ import annotations
 
+import hmac
 import http.server
 import logging
 import threading
@@ -24,19 +25,8 @@ from tpu_composer.runtime.store import Store
 Runnable = Callable[[threading.Event], None]
 
 
-class _HealthHandler(http.server.BaseHTTPRequestHandler):
-    manager: "Manager"
-
-    def do_GET(self):  # noqa: N802
-        if self.path == "/healthz":
-            self._respond(200, "ok")
-        elif self.path == "/readyz":
-            ready = self.manager.ready()
-            self._respond(200 if ready else 503, "ok" if ready else "not ready")
-        elif self.path == "/metrics":
-            self._respond(200, global_registry.expose_text())
-        else:
-            self._respond(404, "not found")
+class _PlainTextHandler(http.server.BaseHTTPRequestHandler):
+    """Shared response plumbing for the health and metrics handlers."""
 
     def _respond(self, code: int, body: str) -> None:
         data = body.encode()
@@ -50,6 +40,61 @@ class _HealthHandler(http.server.BaseHTTPRequestHandler):
         pass
 
 
+class _HealthHandler(_PlainTextHandler):
+    manager: "Manager"
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/healthz":
+            self._respond(200, "ok")
+        elif self.path == "/readyz":
+            ready = self.manager.ready()
+            self._respond(200 if ready else 503, "ok" if ready else "not ready")
+        elif self.path == "/metrics":
+            # With a dedicated (TLS/authenticated) metrics server running,
+            # the plain health port must not leak the same data (the
+            # reference's probe port likewise serves no metrics,
+            # cmd/main.go:109-127 vs :205-212).
+            if self.manager._metrics_server is not None:
+                self._respond(404, "metrics served on the secure metrics port")
+            else:
+                self._respond(200, global_registry.expose_text())
+        else:
+            self._respond(404, "not found")
+
+
+class _MetricsHandler(_PlainTextHandler):
+    """Dedicated metrics endpoint with bearer-token authorization.
+
+    The reference protects its metrics with controller-runtime's
+    authn/authz filter (TokenReview + SubjectAccessReview delegation,
+    cmd/main.go:120-127). The standalone analog: the scraper presents a
+    bearer token matched against a mounted secret (re-read per request so
+    rotation needs no restart); TLS comes from the per-connection-handshake
+    server wrapper shared with the admission webhook."""
+
+    manager: "Manager"
+    token_file: Optional[str] = None
+
+    def do_GET(self):  # noqa: N802
+        if self.path != "/metrics":
+            return self._respond(404, "not found")
+        if self.token_file:
+            try:
+                with open(self.token_file) as f:
+                    expected = f.read().strip()
+            except OSError:
+                return self._respond(500, "metrics token file unreadable")
+            presented = self.headers.get("Authorization", "")
+            # Constant-time comparison: anything reaching this port (any
+            # pod the NetworkPolicy admits) must not be able to recover
+            # the scrape secret through a timing side channel.
+            if not expected or not hmac.compare_digest(
+                presented, f"Bearer {expected}"
+            ):
+                return self._respond(401, "unauthorized")
+        self._respond(200, global_registry.expose_text())
+
+
 class Manager:
     def __init__(
         self,
@@ -58,6 +103,10 @@ class Manager:
         leader_lock_path: Optional[str] = None,
         health_addr: Optional[str] = None,  # "host:port" or None to disable
         leader_elector=None,  # custom elector (e.g. runtime.leases.LeaseElector)
+        metrics_addr: Optional[str] = None,  # dedicated secure metrics port
+        metrics_certfile: Optional[str] = None,
+        metrics_keyfile: Optional[str] = None,
+        metrics_token_file: Optional[str] = None,
     ) -> None:
         # `is not None`, not `or`: an EMPTY store is falsy (Store.__len__),
         # and silently swapping in a fresh one would orphan the caller's
@@ -78,6 +127,11 @@ class Manager:
         )
         self._health_addr = health_addr
         self._health_server: Optional[http.server.ThreadingHTTPServer] = None
+        self._metrics_addr = metrics_addr
+        self._metrics_certfile = metrics_certfile
+        self._metrics_keyfile = metrics_keyfile
+        self._metrics_token_file = metrics_token_file
+        self._metrics_server: Optional[http.server.ThreadingHTTPServer] = None
 
     def add_controller(self, controller: Controller) -> None:
         self._controllers.append(controller)
@@ -94,7 +148,41 @@ class Manager:
             return None
         return self._health_server.server_address[1]
 
+    @property
+    def metrics_port(self) -> Optional[int]:
+        if self._metrics_server is None:
+            return None
+        return self._metrics_server.server_address[1]
+
     def start(self, workers_per_controller: int = 1) -> None:
+        if self._metrics_addr is not None:
+            # Dedicated metrics server FIRST so the health handler's
+            # "/metrics moved" answer is accurate from the first request.
+            from tpu_composer.admission.server import (
+                _TlsPerConnectionServer,
+                make_server_tls_context,
+            )
+
+            host, _, port = self._metrics_addr.rpartition(":")
+            handler = type(
+                "BoundMetricsHandler",
+                (_MetricsHandler,),
+                {"manager": self, "token_file": self._metrics_token_file},
+            )
+            self._metrics_server = _TlsPerConnectionServer(
+                (host or "127.0.0.1", int(port)), handler
+            )
+            if self._metrics_certfile:
+                self._metrics_server.ssl_context = make_server_tls_context(
+                    self._metrics_certfile, self._metrics_keyfile
+                )
+            t = threading.Thread(
+                target=self._metrics_server.serve_forever, name="metrics",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
         if self._health_addr is not None:
             host, _, port = self._health_addr.rpartition(":")
             handler = type("BoundHealthHandler", (_HealthHandler,), {"manager": self})
@@ -151,6 +239,10 @@ class Manager:
             self._health_server.shutdown()
             self._health_server.server_close()
             self._health_server = None
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server.server_close()
+            self._metrics_server = None
         for t in self._threads:
             t.join(timeout=5)
         self._threads.clear()
