@@ -47,7 +47,7 @@ PLATFORMS ?= linux/arm64,linux/amd64,linux/s390x,linux/ppc64le
 docker-buildx:
 	- docker buildx create --name tpu-composer-builder
 	docker buildx use tpu-composer-builder
-	- docker buildx build --push --platform=$(PLATFORMS) --tag $(IMG) .
+	docker buildx build --push --platform=$(PLATFORMS) --tag $(IMG) .
 	- docker buildx rm tpu-composer-builder
 
 ## lint: syntax check every module
@@ -78,7 +78,7 @@ catalog: bundle
 
 ## catalog-build: containerize the FBC (requires docker + opm base image)
 catalog-build: catalog
-	docker build -f dist/catalog/catalog.Dockerfile -t $(CATALOG_IMG) dist
+	docker build -f dist/catalog.Dockerfile -t $(CATALOG_IMG) dist
 
 ## validate-manifests: schema-check deploy/crds + dist/install.yaml (CI gate)
 validate-manifests: build-installer

@@ -74,7 +74,9 @@ def kstore(apiserver):
     ks.close()
 
 
-def wait_for(predicate, timeout=15.0, interval=0.02):
+# 40s: generous because CI/parallel-load CPU contention has flaked the
+# operator e2e at 15s; the predicate loop exits early when satisfied.
+def wait_for(predicate, timeout=40.0, interval=0.02):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if predicate():

@@ -184,4 +184,9 @@ class TestCatalog:
         assert schemas == {"olm.package", "olm.channel", "olm.bundle"}
         bundle_doc = next(d for d in docs if d["schema"] == "olm.bundle")
         assert bundle_doc["image"] == "reg.example/bundle:v1"
-        assert (out / "catalog.Dockerfile").exists()
+        dockerfile = tmp_path / "catalog.Dockerfile"  # parent of configs dir
+        assert dockerfile.exists()
+        # opm parses every file under the ADDed dir as FBC: the Dockerfile
+        # must NOT be inside it.
+        assert not (out / "catalog.Dockerfile").exists()
+        assert "ADD catalog /configs" in dockerfile.read_text()

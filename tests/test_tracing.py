@@ -1,0 +1,178 @@
+"""Control-plane tracing (runtime/tracing.py) — a subsystem the reference
+lacks entirely (SURVEY.md §5: no pprof, no otel). Spans over reconciles and
+fabric verbs, nested via a thread-local stack, exported as Chrome
+trace-event JSON from the health server's /debug/traces."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.controllers import (
+    ComposabilityRequestReconciler,
+    ComposableResourceReconciler,
+    RequestTiming,
+    ResourceTiming,
+)
+from tpu_composer.fabric.adapter import TracedFabricProvider
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime import tracing
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.store import Store
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    tracing.reset()
+    yield
+    tracing.reset()
+
+
+class TestSpans:
+    def test_span_records_duration_and_attrs(self):
+        with tracing.span("work", cat="test", object="x") as sp:
+            sp["outcome"] = "ok"
+        (evt,) = tracing.snapshot()
+        assert evt["name"] == "work" and evt["cat"] == "test"
+        assert evt["ph"] == "X" and evt["dur"] >= 0
+        assert evt["args"]["object"] == "x"
+        assert evt["args"]["outcome"] == "ok"
+
+    def test_nesting_links_parent(self):
+        with tracing.span("outer"):
+            with tracing.span("inner"):
+                pass
+        inner, outer = tracing.snapshot()  # inner closes first
+        assert inner["name"] == "inner"
+        assert inner["args"]["parent_span"] == outer["id"]
+        assert "parent_span" not in outer["args"]
+
+    def test_exception_recorded_and_reraised(self):
+        with pytest.raises(ValueError):
+            with tracing.span("boom"):
+                raise ValueError("nope")
+        (evt,) = tracing.snapshot()
+        assert "ValueError" in evt["args"]["error"]
+
+    def test_ring_is_bounded(self):
+        tracing.configure(100)
+        try:
+            for i in range(250):
+                with tracing.span(f"s{i}"):
+                    pass
+            events = tracing.snapshot()
+            assert len(events) == 100
+            assert events[-1]["name"] == "s249"  # newest kept, oldest gone
+        finally:
+            tracing.configure(10_000)
+
+    def test_threads_do_not_cross_link(self):
+        done = threading.Event()
+
+        def other():
+            with tracing.span("other-thread"):
+                done.wait(2)
+
+        t = threading.Thread(target=other)
+        with tracing.span("main-thread"):
+            t.start()
+            done.set()
+            t.join()
+        by_name = {e["name"]: e for e in tracing.snapshot()}
+        assert "parent_span" not in by_name["other-thread"]["args"]
+
+    def test_chrome_export_shape(self):
+        with tracing.span("a"):
+            pass
+        doc = json.loads(tracing.export_chrome())
+        assert doc["traceEvents"][0]["ph"] == "X"
+
+    def test_summarize(self):
+        for _ in range(3):
+            with tracing.span("repeat", cat="c1"):
+                pass
+        s = tracing.summarize(cat="c1")
+        assert s["repeat"]["count"] == 3
+        assert s["repeat"]["total_ms"] >= s["repeat"]["max_ms"]
+
+
+class TestWiring:
+    def test_fabric_wrapper_spans_every_verb(self):
+        pool = TracedFabricProvider(InMemoryPool())
+        pool.reserve_slice("s", "tpu-v4", "1x2x2", ["n0"])
+        pool.get_resources()
+        pool.release_slice("s")
+        names = [e["name"] for e in tracing.snapshot()]
+        assert names == [
+            "fabric.reserve_slice", "fabric.get_resources",
+            "fabric.release_slice",
+        ]
+        assert all(
+            e["args"]["provider"] == "InMemoryPool" for e in tracing.snapshot()
+        )
+
+    def test_reconcile_spans_nest_fabric_calls_and_serve_over_http(self):
+        store = Store()
+        n = Node(metadata=ObjectMeta(name="worker-0"))
+        n.status.tpu_slots = 4
+        store.create(n)
+        pool = TracedFabricProvider(InMemoryPool())
+        mgr = Manager(store=store, health_addr="127.0.0.1:0")
+        mgr.add_controller(ComposabilityRequestReconciler(
+            store, pool, timing=RequestTiming(updating_poll=0.02)))
+        mgr.add_controller(ComposableResourceReconciler(
+            store, pool, FakeNodeAgent(pool=pool._inner),
+            timing=ResourceTiming(attach_poll=0.02, visibility_poll=0.02,
+                                  detach_poll=0.02, detach_fast=0.02,
+                                  busy_poll=0.02)))
+        mgr.start()
+        try:
+            store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name="traced"),
+                spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                    type="tpu", model="tpu-v4", size=4)),
+            ))
+            import time
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if store.get(ComposabilityRequest, "traced").status.state == "Running":
+                    break
+                time.sleep(0.01)
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{mgr.health_port}/debug/traces").read())
+            events = doc["traceEvents"]
+            recs = [e for e in events if e["name"] == "reconcile"]
+            fabs = [e for e in events if e["name"].startswith("fabric.")]
+            assert recs and fabs
+            # A fabric call made inside a reconcile carries that span as
+            # its parent — the nesting that makes the trace readable.
+            rec_ids = {e["id"] for e in recs}
+            assert any(f["args"].get("parent_span") in rec_ids for f in fabs)
+            summary = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{mgr.health_port}/debug/traces/summary"
+            ).read())
+            assert summary["reconcile"]["count"] >= 1
+        finally:
+            mgr.stop()
+
+    def test_trace_file_written_on_stop(self, tmp_path, monkeypatch):
+        path = tmp_path / "trace.json"
+        monkeypatch.setenv("TPUC_TRACE_FILE", str(path))
+        mgr = Manager(store=Store())
+        mgr.start()
+        with tracing.span("before-stop"):
+            pass
+        mgr.stop()
+        doc = json.loads(path.read_text())
+        assert any(e["name"] == "before-stop" for e in doc["traceEvents"])

@@ -231,7 +231,12 @@ def build_store(args: argparse.Namespace):
 
 def build_manager(args: argparse.Namespace) -> Manager:
     store = build_store(args)
-    fabric = new_fabric_provider()
+    from tpu_composer.fabric.adapter import TracedFabricProvider
+
+    # Every fabric verb becomes a trace span (runtime/tracing.py); the
+    # wrapper delegates everything else, so pick_node_agent's
+    # InMemoryPool-identity check keeps seeing the shared mock directly.
+    fabric = TracedFabricProvider(new_fabric_provider())
     agent = pick_node_agent(store)
 
     addr = args.health_probe_bind_address or None
@@ -250,6 +255,14 @@ def build_manager(args: argparse.Namespace) -> Manager:
     maddr = args.metrics_bind_address or None
     if maddr and maddr.startswith(":"):
         maddr = "0.0.0.0" + maddr
+    if maddr and args.metrics_token_file and not args.metrics_cert:
+        # The whole point of the token is that it is a secret; serving it
+        # over plaintext would broadcast it to the pod network on every
+        # scrape. Refuse loudly instead of degrading silently.
+        raise SystemExit(
+            "--metrics-token-file requires --metrics-cert/--metrics-key:"
+            " bearer tokens must not transit plain HTTP"
+        )
     mgr = Manager(
         store=store,
         leader_elect=args.leader_elect,

@@ -27,6 +27,38 @@ class AdapterError(ValueError):
     pass
 
 
+_TRACED_METHODS = (
+    "add_resource", "remove_resource", "check_resource", "get_resources",
+    "reserve_slice", "release_slice", "resize_slice",
+)
+
+
+class TracedFabricProvider:
+    """Transparent tracing wrapper: every fabric verb becomes a span, so a
+    slow attach shows WHICH fabric call ate the time (the reference has no
+    tracing at all — SURVEY.md §5). Wraps by delegation, so it composes
+    with any provider including ones defining only the base-class
+    resize_slice default."""
+
+    def __init__(self, inner: FabricProvider) -> None:
+        self._inner = inner
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name in _TRACED_METHODS and callable(attr):
+            from tpu_composer.runtime import tracing
+
+            provider = type(self._inner).__name__
+
+            def traced(*args, **kwargs):
+                with tracing.span(f"fabric.{name}", cat="fabric",
+                                  provider=provider):
+                    return attr(*args, **kwargs)
+
+            return traced
+        return attr
+
+
 def new_fabric_provider(provider_type: Optional[str] = None) -> FabricProvider:
     """Build the provider named by `provider_type` or $CDI_PROVIDER_TYPE.
 

@@ -24,6 +24,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
 
+from tpu_composer.runtime import tracing
 from tpu_composer.runtime.queue import RateLimitingQueue
 from tpu_composer.runtime.store import ConflictError, Store, WatchEvent
 
@@ -140,7 +141,15 @@ class Controller:
             if key is None:
                 continue
             try:
-                result = self.reconcile(key)  # type: ignore[arg-type]
+                with tracing.span(
+                    "reconcile", cat="controller",
+                    controller=self.name, object=key,
+                ) as sp:
+                    result = self.reconcile(key)  # type: ignore[arg-type]
+                    sp["outcome"] = (
+                        f"requeue:{result.requeue_after:g}s"
+                        if result and result.requeue_after > 0 else "done"
+                    )
             except ConflictError:
                 # Stale read — immediate retry with fresh state (controller-
                 # runtime requeues conflicts without logging an error).

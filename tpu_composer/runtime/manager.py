@@ -11,12 +11,14 @@ from __future__ import annotations
 import hmac
 import http.server
 import logging
+import os
 import threading
 from typing import Callable, List, Optional
 
 from tpu_composer.runtime.controller import Controller
 from tpu_composer.runtime.events import EventRecorder
 from tpu_composer.runtime.leader import LeaderElector
+from tpu_composer.runtime import tracing
 from tpu_composer.runtime.metrics import global_registry
 from tpu_composer.runtime.store import Store
 
@@ -50,14 +52,30 @@ class _HealthHandler(_PlainTextHandler):
             ready = self.manager.ready()
             self._respond(200 if ready else 503, "ok" if ready else "not ready")
         elif self.path == "/metrics":
-            # With a dedicated (TLS/authenticated) metrics server running,
-            # the plain health port must not leak the same data (the
-            # reference's probe port likewise serves no metrics,
-            # cmd/main.go:109-127 vs :205-212).
-            if self.manager._metrics_server is not None:
+            # With a dedicated (TLS/authenticated) metrics server
+            # CONFIGURED — even one still waiting for its cert — the plain
+            # health port must not leak the same data (the reference's
+            # probe port likewise serves no metrics, cmd/main.go:109-127
+            # vs :205-212).
+            if self.manager._metrics_addr is not None:
                 self._respond(404, "metrics served on the secure metrics port")
             else:
                 self._respond(200, global_registry.expose_text())
+        elif self.path == "/debug/traces":
+            # Chrome trace-event JSON of recent control-plane spans
+            # (chrome://tracing / Perfetto). Names and durations only — no
+            # secrets — mirroring Go's /debug/pprof convention the
+            # reference never wired up.
+            data = tracing.export_chrome().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        elif self.path == "/debug/traces/summary":
+            import json as _json
+
+            self._respond(200, _json.dumps(tracing.summarize(), indent=1))
         else:
             self._respond(404, "not found")
 
@@ -154,34 +172,56 @@ class Manager:
             return None
         return self._metrics_server.server_address[1]
 
+    def _start_metrics_server(self) -> None:
+        from tpu_composer.admission.server import (
+            _TlsPerConnectionServer,
+            make_server_tls_context,
+        )
+
+        host, _, port = self._metrics_addr.rpartition(":")  # type: ignore[union-attr]
+        handler = type(
+            "BoundMetricsHandler",
+            (_MetricsHandler,),
+            {"manager": self, "token_file": self._metrics_token_file},
+        )
+        server = _TlsPerConnectionServer((host or "127.0.0.1", int(port)), handler)
+        if self._metrics_certfile:
+            server.ssl_context = make_server_tls_context(
+                self._metrics_certfile, self._metrics_keyfile
+            )
+        self._metrics_server = server
+        t = threading.Thread(target=server.serve_forever, name="metrics",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _serve_metrics_when_cert_lands(self) -> None:
+        """cert-manager writes the serving cert AFTER the pod starts (the
+        secret mount is optional) — same dance as the webhook server in
+        cmd/main. Crashing on the missing file would crash-loop every
+        fresh install until the issuer caught up."""
+        warned = False
+        while not os.path.exists(self._metrics_certfile):  # type: ignore[arg-type]
+            if not warned:
+                self.log.warning(
+                    "waiting for metrics cert %s", self._metrics_certfile
+                )
+                warned = True
+            if self._stop.wait(2.0):
+                return
+        self._start_metrics_server()
+
     def start(self, workers_per_controller: int = 1) -> None:
         if self._metrics_addr is not None:
-            # Dedicated metrics server FIRST so the health handler's
-            # "/metrics moved" answer is accurate from the first request.
-            from tpu_composer.admission.server import (
-                _TlsPerConnectionServer,
-                make_server_tls_context,
-            )
-
-            host, _, port = self._metrics_addr.rpartition(":")
-            handler = type(
-                "BoundMetricsHandler",
-                (_MetricsHandler,),
-                {"manager": self, "token_file": self._metrics_token_file},
-            )
-            self._metrics_server = _TlsPerConnectionServer(
-                (host or "127.0.0.1", int(port)), handler
-            )
-            if self._metrics_certfile:
-                self._metrics_server.ssl_context = make_server_tls_context(
-                    self._metrics_certfile, self._metrics_keyfile
+            if self._metrics_certfile and not os.path.exists(self._metrics_certfile):
+                t = threading.Thread(
+                    target=self._serve_metrics_when_cert_lands,
+                    name="metrics-cert-wait", daemon=True,
                 )
-            t = threading.Thread(
-                target=self._metrics_server.serve_forever, name="metrics",
-                daemon=True,
-            )
-            t.start()
-            self._threads.append(t)
+                t.start()
+                self._threads.append(t)
+            else:
+                self._start_metrics_server()
 
         if self._health_addr is not None:
             host, _, port = self._health_addr.rpartition(":")
@@ -249,6 +289,11 @@ class Manager:
         if self._elector is not None:
             self._elector.release()
         self._started = False
+        # Headless runs: persist the span ring if $TPUC_TRACE_FILE is set.
+        try:
+            tracing.write_file()
+        except OSError:
+            self.log.warning("trace file write failed", exc_info=True)
 
     def wait(self) -> None:  # pragma: no cover - used by cmd/main
         try:

@@ -1,0 +1,143 @@
+"""Lightweight tracing for the control plane — spans over reconciles,
+fabric calls and agent actuation, exported as Chrome trace-event JSON.
+
+The reference has NO tracing at all (SURVEY.md §5: no pprof, no otel — its
+only observability is logs plus default metrics), which makes attach-path
+latency regressions archaeology. This subsystem exceeds that bar with ~150
+lines and zero dependencies:
+
+- ``span(name, **attrs)``: context manager recording wall-time begin/end
+  with attributes; spans nest via a thread-local stack, so a reconcile's
+  fabric call shows up as a child of the reconcile span.
+- A bounded in-memory ring (default 10k events — old traffic falls off
+  rather than growing the heap) shared process-wide.
+- ``export_chrome()``: the whole ring as Chrome trace-event JSON ("cat"
+  = component, thread = worker) — load it in chrome://tracing or Perfetto.
+- The manager's health server exposes ``/debug/traces`` (same port as
+  healthz; read-only, no secrets — attribute values are names/counts).
+- ``TPUC_TRACE_FILE``: write the ring to a file at manager stop, for
+  headless runs.
+
+The workload side (JAX) keeps its own richer profiler: ``jax.profiler``
+traces device execution; this module covers the operator half the device
+profiler can't see.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Deque, Dict, Iterator, List, Optional
+
+_lock = threading.Lock()
+_events: Deque[Dict[str, Any]] = deque(maxlen=10_000)
+_tls = threading.local()
+_t0 = time.perf_counter()
+# Monotonically-increasing ids so Perfetto can pair nested spans cheaply.
+_next_id = 0
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def configure(capacity: int) -> None:
+    """Resize the ring (drops current contents)."""
+    global _events
+    with _lock:
+        _events = deque(maxlen=capacity)
+
+
+def reset() -> None:
+    with _lock:
+        _events.clear()
+
+
+def _depth() -> int:
+    return len(getattr(_tls, "stack", ()))
+
+
+@contextmanager
+def span(name: str, cat: str = "operator", **attrs: Any) -> Iterator[Dict[str, Any]]:
+    """Record one complete span. Yields the attribute dict so callers can
+    attach results discovered mid-span (e.g. outcome="requeued")."""
+    global _next_id
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    with _lock:
+        _next_id += 1
+        sid = _next_id
+    parent = _tls.stack[-1] if _tls.stack else None
+    _tls.stack.append(sid)
+    args: Dict[str, Any] = dict(attrs)
+    if parent is not None:
+        args["parent_span"] = parent
+    begin = _now_us()
+    try:
+        yield args
+    except BaseException as e:
+        args["error"] = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        _tls.stack.pop()
+        end = _now_us()
+        evt = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",  # complete event
+            "ts": begin,
+            "dur": end - begin,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 1_000_000,
+            "id": sid,
+            "args": {k: _safe(v) for k, v in args.items()},
+        }
+        with _lock:
+            _events.append(evt)
+
+
+def _safe(v: Any) -> Any:
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def snapshot() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_events)
+
+
+def export_chrome() -> str:
+    """Chrome trace-event format (the JSON Array flavor) — open in
+    chrome://tracing or https://ui.perfetto.dev."""
+    return json.dumps({"traceEvents": snapshot(), "displayTimeUnit": "ms"})
+
+
+def write_file(path: Optional[str] = None) -> Optional[str]:
+    """Dump the ring to ``path`` (default $TPUC_TRACE_FILE); returns the
+    path written or None when tracing-to-file is not configured."""
+    path = path or os.environ.get("TPUC_TRACE_FILE")
+    if not path:
+        return None
+    with open(path, "w") as f:
+        f.write(export_chrome())
+    return path
+
+
+def summarize(cat: Optional[str] = None) -> Dict[str, Dict[str, float]]:
+    """Per-span-name count/total/max durations (ms) — the quick look that
+    answers 'where did the attach time go' without leaving the terminal."""
+    out: Dict[str, Dict[str, float]] = {}
+    for e in snapshot():
+        if cat and e["cat"] != cat:
+            continue
+        s = out.setdefault(e["name"], {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+        dur_ms = e["dur"] / 1e3
+        s["count"] += 1
+        s["total_ms"] += dur_ms
+        s["max_ms"] = max(s["max_ms"], dur_ms)
+    return out
