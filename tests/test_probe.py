@@ -16,7 +16,8 @@ import tpu_composer.workload.probe as probe
 # A child that completes every stage instantly.
 _FAST_CHILD = r"""
 import json, time
-for stage in ("backend_init", "matmul", "flash_attn", "qualify"):
+for stage in ("backend_init", "matmul", "flash_attn", "qualify",
+              "qualify_large"):
     print("STAGE_RESULT " + json.dumps({"stage": stage, "seconds": 0.0, "ok": True}),
           flush=True)
 """
@@ -34,7 +35,7 @@ def test_all_stages_complete(monkeypatch):
     monkeypatch.setattr(probe, "_CHILD", _FAST_CHILD)
     r = probe.staged_accelerator_probe(timeouts={"backend_init": 10.0})
     assert r["completed"] == ["devnodes", "backend_init", "matmul",
-                              "flash_attn", "qualify"]
+                              "flash_attn", "qualify", "qualify_large"]
     assert "failed_stage" not in r
 
 

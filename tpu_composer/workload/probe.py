@@ -44,6 +44,7 @@ STAGE_TIMEOUTS_S: Dict[str, float] = {
     "matmul": 120.0,
     "flash_attn": 240.0,
     "qualify": 420.0,
+    "qualify_large": 420.0,
 }
 
 _CHILD = r"""
@@ -108,6 +109,26 @@ from tpu_composer.workload.acceptance import qualify_slice
 results = qualify_slice(batch=4, seq=512, allreduce_mb=16.0, steps=5)
 results["backend"] = jax.default_backend()
 emit("qualify", t0, **results)
+
+# MXU-sized pass, TPU only: the tiny config above validates the stack but
+# utilizes a few percent of the MXU; the headline TFLOPS number needs
+# matmuls big enough to tile the systolic array (d_model 2048, ffn 8192,
+# bf16, seq 2048 — ~200M params, ~20 TFLOP/step).
+rearm(_timeouts.get("qualify_large", 420.0))
+t0 = time.time()
+if jax.default_backend() == "tpu":
+    import jax.numpy as jnp
+    from tpu_composer.models.transformer import ModelConfig
+    big = ModelConfig(vocab_size=32768, d_model=2048, n_layers=4, n_heads=16,
+                      d_ff=8192, max_seq=2048, dtype=jnp.bfloat16,
+                      attn_impl="flash")
+    results = qualify_slice(batch=8, seq=2048, model_config=big,
+                            allreduce_mb=64.0, steps=3)
+    results["backend"] = jax.default_backend()
+    emit("qualify_large", t0, **results)
+else:
+    emit("qualify_large", t0,
+         skipped="MXU-sized pass is meaningful on tpu only")
 faulthandler.cancel_dump_traceback_later()
 """
 
@@ -273,7 +294,7 @@ def staged_accelerator_probe(
     diagnosis is preserved under ``diagnosis.attempts``."""
     timeouts = {**STAGE_TIMEOUTS_S, **(timeouts or {})}
     devnodes = probe_devnodes()
-    order = ["backend_init", "matmul", "flash_attn", "qualify"]
+    order = ["backend_init", "matmul", "flash_attn", "qualify", "qualify_large"]
 
     env = dict(os.environ)
     root = repo_root or os.path.dirname(
