@@ -113,9 +113,10 @@ def bench_accelerator():
     """Staged slice qualification on the local accelerator (VERDICT r1 #1).
 
     Each stage (backend init, matmul, on-chip flash-attention validation,
-    full qualify) has its own deadline and reports the moment it completes,
-    so a hung device tunnel costs one stage's timeout and still yields every
-    earlier stage's numbers plus a named-stage diagnosis."""
+    full qualify, MXU-sized qualify_large) has its own deadline and reports
+    the moment it completes, so a hung device tunnel costs one stage's
+    timeout and still yields every earlier stage's numbers plus a
+    named-stage diagnosis."""
     import os
 
     from tpu_composer.workload.probe import staged_accelerator_probe

@@ -5,11 +5,12 @@ Round 1's bench ran the whole slice qualification in one subprocess under one
 carried zero accelerator evidence (VERDICT.md "What's weak" #1). This module
 splits the probe into ordered stages, each reported the moment it completes:
 
-  devnodes      device-node / env / lockfile enumeration (pure os, in-process)
-  backend_init  ``jax.devices()`` — PJRT plugin + tunnel handshake
-  matmul        one tiny jitted bf16 matmul (compiler + executor round trip)
-  flash_attn    Pallas flash fwd+bwd vs the XLA reference (numerics on-chip)
-  qualify       full ``qualify_slice`` (allreduce busbw + train-step TFLOPS)
+  devnodes       device-node / env / pool-endpoint preflight (pure os, in-process)
+  backend_init   ``jax.devices()`` — PJRT plugin + tunnel handshake
+  matmul         one tiny jitted bf16 matmul (compiler + executor round trip)
+  flash_attn     Pallas flash fwd+bwd vs the XLA reference (numerics on-chip)
+  qualify        full ``qualify_slice`` (allreduce busbw + train-step TFLOPS)
+  qualify_large  MXU-sized bf16 pass (TPU only; degrades to an error record)
 
 Stages after ``devnodes`` run in ONE subprocess that prints a
 ``STAGE_RESULT <json>`` line per completed stage; the parent tails the pipe
@@ -116,19 +117,24 @@ emit("qualify", t0, **results)
 # bf16, seq 2048 — ~200M params, ~20 TFLOP/step).
 rearm(_timeouts.get("qualify_large", 420.0))
 t0 = time.time()
-if jax.default_backend() == "tpu":
-    import jax.numpy as jnp
-    from tpu_composer.models.transformer import ModelConfig
-    big = ModelConfig(vocab_size=32768, d_model=2048, n_layers=4, n_heads=16,
-                      d_ff=8192, max_seq=2048, dtype=jnp.bfloat16,
-                      attn_impl="flash")
-    results = qualify_slice(batch=8, seq=2048, model_config=big,
-                            allreduce_mb=64.0, steps=3)
-    results["backend"] = jax.default_backend()
-    emit("qualify_large", t0, **results)
-else:
-    emit("qualify_large", t0,
-         skipped="MXU-sized pass is meaningful on tpu only")
+try:
+    if jax.default_backend() == "tpu":
+        import jax.numpy as jnp
+        from tpu_composer.models.transformer import ModelConfig
+        big = ModelConfig(vocab_size=32768, d_model=2048, n_layers=4,
+                          n_heads=16, d_ff=8192, max_seq=2048,
+                          dtype=jnp.bfloat16, attn_impl="flash")
+        results = qualify_slice(batch=8, seq=2048, model_config=big,
+                                allreduce_mb=64.0, steps=3)
+        results["backend"] = jax.default_backend()
+        emit("qualify_large", t0, **results)
+    else:
+        emit("qualify_large", t0,
+             skipped="MXU-sized pass is meaningful on tpu only")
+except Exception as e:  # noqa: BLE001 - enhancement pass degrades, never fails
+    # (e.g. OOM on a small-HBM chip): the five core stages already carry
+    # their evidence; record the error instead of failing the probe.
+    emit("qualify_large", t0, error=f"{type(e).__name__}: {e}")
 faulthandler.cancel_dump_traceback_later()
 """
 
