@@ -26,7 +26,12 @@ from tpu_composer.parallel.pipeline import (
     stacked_layer_specs,
     transformer_stage_fn,
 )
-from tpu_composer.parallel.train import TrainConfig, make_train_state, make_train_step
+from tpu_composer.parallel.train import (
+    TrainConfig,
+    make_train_state,
+    make_train_step,
+    reshard_train_state,
+)
 
 __all__ = [
     "make_mesh",
@@ -47,4 +52,5 @@ __all__ = [
     "TrainConfig",
     "make_train_state",
     "make_train_step",
+    "reshard_train_state",
 ]

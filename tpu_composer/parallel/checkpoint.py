@@ -1,0 +1,89 @@
+"""Sharded train-state checkpointing — the workload half of checkpoint/
+resume (SURVEY.md §5: on the operator side the CRDs are the checkpoint;
+this is the side the reference never had, because it never ran models).
+
+Built on orbax (the JAX-native checkpointer): each device writes only its
+own shards, and restore is sharding-aware — the state can come back on a
+DIFFERENT mesh than it was saved from, which is exactly what the
+operator's live slice resize needs for the crash/restart path:
+
+    save(dir, state, step=n)                # on the 4-chip mesh
+    ... slice grows, job restarts ...
+    state = restore(dir, tc, mesh8)         # restored straight onto 8 chips
+
+(The in-flight path needs no checkpoint: reshard_train_state moves a LIVE
+state across meshes. This module covers restarts and failures.)
+
+Layout notes: the saved tree is {step, params, opt} with optax state
+flattened by orbax's standard pytree handler; restore rebuilds the target
+structure from make_train_state on the new mesh, so optimizer moments land
+with the same NamedShardings as their parameters.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import orbax.checkpoint as ocp
+from jax.sharding import Mesh
+
+from tpu_composer.parallel.train import TrainConfig, abstract_train_state
+
+
+def save(directory: str, state: Dict[str, Any], step: int) -> str:
+    """Write one sharded checkpoint under ``directory/step_<n>``. Returns
+    the checkpoint path. Synchronous (wait_until_finished) — the caller
+    decides cadence; async wrapping belongs in the training loop."""
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, {"step": step, "state": state})
+        ckptr.wait_until_finished()
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Highest step with a complete checkpoint, or None."""
+    try:
+        entries = os.listdir(os.path.abspath(directory))
+    except FileNotFoundError:
+        return None
+    steps = []
+    root = os.path.abspath(directory)
+    for e in entries:
+        if e.startswith("step_") and e[5:].isdigit():
+            # orbax finalizes a checkpoint by writing _CHECKPOINT_METADATA;
+            # a step dir without it is a partial write from a crash (on
+            # stores without atomic rename the tmp-dir never disappears) —
+            # skip it so restore falls back to the last COMPLETE step.
+            if os.path.exists(os.path.join(root, e, "_CHECKPOINT_METADATA")):
+                steps.append(int(e[5:]))
+    return max(steps) if steps else None
+
+
+def restore(
+    directory: str,
+    tc: TrainConfig,
+    mesh: Mesh,
+    step: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Restore ``{'step': n, 'state': {...}}`` resharded onto ``mesh``.
+
+    The target structure (shapes, dtypes AND NamedShardings) is built
+    abstractly for the destination mesh (no allocation), so a checkpoint written by a
+    4-worker slice restores directly onto the 8-worker slice the operator
+    grew — orbax reads each shard exactly once onto its new owner.
+    """
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    # Abstract template: shapes/dtypes/NamedShardings with NOTHING
+    # allocated — materializing a throwaway state here would double peak
+    # HBM on restart, an OOM for any model over half the chip's memory.
+    target = {"step": step, "state": abstract_train_state(tc, mesh)}
+    with ocp.StandardCheckpointer() as ckptr:
+        out = ckptr.restore(path, target)
+    return out

@@ -135,6 +135,39 @@ def reshard_train_state(tc: TrainConfig, state: Dict, mesh: Mesh) -> Dict:
     return {"params": params, "opt": opt}
 
 
+def abstract_train_state(tc: TrainConfig, mesh: Mesh) -> Dict:
+    """ShapeDtypeStructs carrying the mesh's NamedShardings — the zero-
+    allocation restore template (checkpoint.restore): materializing a real
+    state just to describe shapes would double peak HBM on restart."""
+    shaped = jax.eval_shape(lambda: make_train_state(tc, jax.random.key(0)))
+    specs = _param_specs(tc, mesh)
+
+    def abstract(tree, spec_tree):
+        return jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=NamedSharding(mesh, s)
+            ),
+            tree, spec_tree,
+        )
+
+    params = abstract(shaped["params"], specs)
+
+    def shard_opt(entry):
+        if isinstance(entry, dict):
+            return abstract(entry, specs)
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=NamedSharding(mesh, P())
+            ),
+            entry,
+        )
+
+    opt = jax.tree.map(
+        shard_opt, shaped["opt"], is_leaf=lambda x: isinstance(x, dict)
+    )
+    return {"params": params, "opt": opt}
+
+
 def _sp_attn_fn(mesh: Mesh, impl: str):
     """Sequence-parallel attention as a partial-manual shard_map over 'sp'
     only — dp/ep/tp shardings flow through under GSPMD, so the same wrapper
