@@ -42,19 +42,19 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(s, carry):
-        k_cur, v_cur, m, l, acc = carry
-        # After s shifts we hold the chunk originally on device (my_idx - s).
-        src = (my_idx - s) % n
+    def attend(k_cur, v_cur, m, l, acc, masked_src=None):
+        """One online-softmax block update. ``masked_src`` (trace-time
+        None or a traced source index) applies the causal mask — only the
+        diagonal block (src == my_idx) ever needs one."""
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
-        if causal:
+        if masked_src is not None:
             q_pos = my_idx * s_local + jax.lax.broadcasted_iota(
                 jnp.int32, (s_local, s_local), 0
             )
-            k_pos = src * s_local + jax.lax.broadcasted_iota(
+            k_pos = masked_src * s_local + jax.lax.broadcasted_iota(
                 jnp.int32, (s_local, s_local), 1
             )
             scores = jnp.where(q_pos >= k_pos, scores, -1e30)
@@ -67,16 +67,54 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
             preferred_element_type=jnp.float32,
         )
         acc_new = acc * alpha.transpose(0, 2, 1, 3) + pv
+        return m_new, l_new, acc_new
+
+    def step(s, carry):
+        k_cur, v_cur, m, l, acc = carry
+        # After s shifts we hold the chunk originally on device (my_idx - s).
+        src = (my_idx - s) % n
+        if causal:
+            # Chunks from ring sources AHEAD of this device (src > my_idx)
+            # are entirely above the causal diagonal: every score would be
+            # masked. Skip both MXU matmuls for the whole step instead of
+            # computing and discarding them. Honesty note: with the
+            # contiguous sequence layout this saves FLOPs/energy, not
+            # wall-clock — device n-1 is live every step and each ppermute
+            # round is gated by it. Cutting step LATENCY needs a balanced
+            # (zigzag/striped) sequence layout where every device holds
+            # chunks from both ends of the sequence; that is a data-layout
+            # contract change for callers, left as the documented next
+            # step. Off-diagonal live blocks need no mask (strictly below
+            # the diagonal), so none is computed here — the masked
+            # diagonal block ran before the loop. The ppermute stays
+            # outside the cond: every device must keep rotating.
+            m, l, acc = jax.lax.cond(
+                src < my_idx,
+                lambda m, l, acc: attend(k_cur, v_cur, m, l, acc),
+                lambda m, l, acc: (m, l, acc),
+                m, l, acc,
+            )
+        else:
+            m, l, acc = attend(k_cur, v_cur, m, l, acc)
         # Rotate K/V to the next device; the final rotation restores the
         # original placement (and XLA overlaps it with the next step's math).
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, m_new, l_new, acc_new
+        return k_nxt, v_nxt, m, l, acc
 
     m0 = jnp.full((b, h, s_local, 1), -1e30, jnp.float32)
     l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
     acc0 = jnp.zeros((b, s_local, h, d), jnp.float32)
-    _, _, m, l, acc = jax.lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
+    if causal:
+        # Step 0 is the diagonal block (src == my_idx) — the only one that
+        # needs a mask; hoisting it keeps the iota/select out of all other
+        # steps.
+        m0, l0, acc0 = attend(k, v, m0, l0, acc0, masked_src=my_idx)
+        k1 = jax.lax.ppermute(k, axis_name, perm)
+        v1 = jax.lax.ppermute(v, axis_name, perm)
+        _, _, m, l, acc = jax.lax.fori_loop(1, n, step, (k1, v1, m0, l0, acc0))
+    else:
+        _, _, m, l, acc = jax.lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
 
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)
     return out.astype(q.dtype)
