@@ -16,7 +16,10 @@ from tpu_composer.parallel.collectives import (
     reduce_scatter,
     ring_shift,
 )
-from tpu_composer.parallel.ring_attention import ring_attention
+from tpu_composer.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_zigzag,
+)
 from tpu_composer.parallel.ulysses import ulysses_attention
 from tpu_composer.parallel.pipeline import (
     pipeline_apply,
@@ -42,6 +45,7 @@ __all__ = [
     "reduce_scatter",
     "ring_shift",
     "ring_attention",
+    "ring_attention_zigzag",
     "ulysses_attention",
     "pipeline_apply",
     "pipelined_forward",

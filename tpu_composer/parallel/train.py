@@ -39,8 +39,19 @@ from tpu_composer.parallel.pipeline import (
     stack_layers,
     stacked_layer_specs,
 )
-from tpu_composer.parallel.ring_attention import ring_attention
+from tpu_composer.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_zigzag,
+)
 from tpu_composer.parallel.ulysses import ulysses_attention
+
+# Sequence-parallel attention strategies: ppermute ring (contiguous layout),
+# zigzag ring (compute-balanced causal schedule), all-to-all Ulysses.
+_SP_IMPLS = {
+    "ring": ring_attention,
+    "zigzag": ring_attention_zigzag,
+    "ulysses": ulysses_attention,
+}
 
 
 @dataclass(frozen=True)
@@ -50,7 +61,7 @@ class TrainConfig:
     weight_decay: float = 0.01
     # Sequence parallelism kicks in when the mesh's sp axis is > 1.
     use_ring_attention: bool = True  # False = replicate K/V (gather) instead
-    sp_impl: str = "ring"  # ring | ulysses
+    sp_impl: str = "ring"  # ring | zigzag (balanced causal ring) | ulysses
     # GPipe over the 'pp' mesh axis when > 0 and the mesh has pp > 1
     # (dense model only; microbatches must divide the global batch).
     pipeline_microbatches: int = 0
@@ -173,7 +184,7 @@ def _sp_attn_fn(mesh: Mesh, impl: str):
     only — dp/ep/tp shardings flow through under GSPMD, so the same wrapper
     serves the plain, MoE, and pipelined (nested inside 'pp'-manual) paths."""
     spec = P(None, "sp", None, None)  # (B, S, H, D)
-    inner = ring_attention if impl == "ring" else ulysses_attention
+    inner = _SP_IMPLS[impl]
 
     def body(q, k, v):
         return inner(q, k, v, axis_name="sp", causal=True)
@@ -197,11 +208,11 @@ def _sp_attn_fn(mesh: Mesh, impl: str):
 def make_train_step(tc: TrainConfig, mesh: Mesh):
     """Returns (step_fn, batch_sharding). step_fn: (state, tokens) ->
     (state, metrics) — jitted with explicit output shardings."""
-    if tc.sp_impl not in ("ring", "ulysses"):
-        raise ValueError(f"unknown sp_impl {tc.sp_impl!r}")
+    if tc.sp_impl not in _SP_IMPLS:
+        raise ValueError(f"unknown sp_impl {tc.sp_impl!r} (want one of {sorted(_SP_IMPLS)})")
     opt = _optimizer(tc)
     use_sp = tc.use_ring_attention and mesh.shape.get("sp", 1) > 1
-    sp_inner = ring_attention if tc.sp_impl == "ring" else ulysses_attention
+    sp_inner = _SP_IMPLS[tc.sp_impl]
 
     # MoE batches shard over both data axes (ep doubles as a data axis for
     # the non-expert params); dense batches shard over dp alone.
