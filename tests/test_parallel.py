@@ -20,6 +20,7 @@ from tpu_composer.parallel import (
     ring_attention,
     solve_mesh_axes,
     TrainConfig,
+    ring_attention_zigzag,
 )
 
 
@@ -233,10 +234,6 @@ class TestAcceptance:
         assert np.isfinite(res["train_loss"])
 
 
-from tpu_composer.parallel import ring_attention_zigzag  # noqa: E402
-from tpu_composer.parallel.mesh import make_mesh as _make_mesh  # noqa: E402
-
-
 class TestZigzagRingAttention:
     """Compute-balanced causal ring attention: same contiguous contract as
     ring_attention, zigzag redistribution inside. Numerics must match the
@@ -251,7 +248,7 @@ class TestZigzagRingAttention:
     @pytest.mark.parametrize("sp", [2, 4])
     def test_matches_reference(self, sp):
 
-        mesh = _make_mesh({"sp": sp}, devices=jax.devices()[:sp])
+        mesh = make_mesh({"sp": sp}, devices=jax.devices()[:sp])
         b, s, h, d = 2, 16 * sp, 2, 32
         q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.float32)
         k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)
@@ -269,7 +266,7 @@ class TestZigzagRingAttention:
     def test_gradients_match_reference(self):
 
         sp = 4
-        mesh = _make_mesh({"sp": sp}, devices=jax.devices()[:sp])
+        mesh = make_mesh({"sp": sp}, devices=jax.devices()[:sp])
         b, s, h, d = 1, 8 * sp, 2, 16
         q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.float32)
         k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)
@@ -288,7 +285,7 @@ class TestZigzagRingAttention:
 
     def test_noncausal_delegates(self):
 
-        mesh = _make_mesh({"sp": 2}, devices=jax.devices()[:2])
+        mesh = make_mesh({"sp": 2}, devices=jax.devices()[:2])
         b, s, h, d = 1, 32, 2, 16
         q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.float32)
         k = jax.random.normal(jax.random.key(1), (b, s, h, d), jnp.float32)
