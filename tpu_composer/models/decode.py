@@ -1,4 +1,4 @@
-"""KV-cached autoregressive decoding for the dense transformer.
+"""KV-cached autoregressive decoding for the dense AND MoE transformers.
 
 The inference half of the workload layer (training lives in
 parallel/train.py): prefill runs the prompt once and captures each layer's
@@ -19,18 +19,31 @@ the build spec's "complete framework" bar.
 
 from __future__ import annotations
 
-from typing import Dict, NamedTuple, Optional, Tuple
+from typing import Dict, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
 from tpu_composer.ops.attention import mha_reference
-from tpu_composer.models.transformer import (
-    ModelConfig,
-    _rmsnorm,
-    _rope,
-    swiglu_ffn,
-)
+from tpu_composer.models.moe import MoEConfig, ffn_delta
+from tpu_composer.models.transformer import ModelConfig, _rmsnorm, _rope
+
+AnyConfig = Union[ModelConfig, MoEConfig]
+
+# MoE capacity semantics at decode time: forward() routes the WHOLE
+# sequence as one group and drops tokens past each expert's capacity(S);
+# decode_step routes one token with no competition (capacity(1) >= top_k),
+# so it NEVER drops. The two agree exactly whenever the forward pass was
+# drop-free (generous capacity_factor); under saturation, decode is the
+# more faithful computation — serving stacks do not replicate training's
+# capacity-drop artifact. The parity tests pin the drop-free case.
+
+
+def _ffn_delta(h, layer, layer_idx: int, c: AnyConfig):
+    """FFN residual via the shared MoE-vs-dense branch (models/moe.py);
+    aux loss discarded — inference doesn't train the router."""
+    delta, _aux = ffn_delta(h, layer, layer_idx, c)
+    return delta
 
 
 class KVCache(NamedTuple):
@@ -42,7 +55,7 @@ class KVCache(NamedTuple):
     length: jax.Array
 
 
-def init_kv_cache(config: ModelConfig, batch: int, max_seq: Optional[int] = None) -> KVCache:
+def init_kv_cache(config: AnyConfig, batch: int, max_seq: Optional[int] = None) -> KVCache:
     c = config
     s = max_seq or c.max_seq
     shape = (c.n_layers, batch, s, c.n_heads, c.head_dim)
@@ -75,7 +88,7 @@ def _cached_attention(q, k_cache, v_cache, valid_len, c):
 
 
 def prefill(
-    params: Dict, tokens: jax.Array, config: ModelConfig,
+    params: Dict, tokens: jax.Array, config: AnyConfig,
     max_seq: Optional[int] = None,
 ) -> Tuple[jax.Array, KVCache]:
     """Run the prompt (B, S_prompt), filling the cache. Returns the last
@@ -88,7 +101,7 @@ def prefill(
     positions = jnp.broadcast_to(jnp.arange(s_p, dtype=jnp.int32), (b, s_p))
     x = jnp.take(params["embed"], tokens, axis=0)
     ks, vs = [], []
-    for layer in params["layers"]:
+    for li, layer in enumerate(params["layers"]):
         q, k, v = _project_qkv(layer, x, positions, c)
         ks.append(k)
         vs.append(v)
@@ -97,7 +110,7 @@ def prefill(
         o = mha_reference(q, k, v, causal=True).astype(c.dtype)
         x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"])
         h = _rmsnorm(x, layer["ln2"])
-        x = x + swiglu_ffn(h, layer, c.dtype)
+        x = x + _ffn_delta(h, layer, li, c)
     x = _rmsnorm(x, params["ln_f"])
     logits = jnp.einsum("bd,vd->bv", x[:, -1], params["embed"]).astype(jnp.float32)
 
@@ -112,7 +125,7 @@ def prefill(
 
 
 def decode_step(
-    params: Dict, cache: KVCache, token: jax.Array, config: ModelConfig
+    params: Dict, cache: KVCache, token: jax.Array, config: AnyConfig
 ) -> Tuple[jax.Array, KVCache]:
     """One token (B,) in, next-token logits (B, vocab) out, cache advanced.
     Static shapes: the cache is full-length; masking handles validity."""
@@ -137,7 +150,7 @@ def decode_step(
         o = _cached_attention(q, k_cache, v_cache, pos + 1, c)
         x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"])
         h = _rmsnorm(x, layer["ln2"])
-        x = x + swiglu_ffn(h, layer, c.dtype)
+        x = x + _ffn_delta(h, layer, li, c)
     x = _rmsnorm(x, params["ln_f"])
     logits = jnp.einsum("bd,vd->bv", x[:, 0], params["embed"]).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v, length=pos + 1)
@@ -146,7 +159,7 @@ def decode_step(
 def generate(
     params: Dict,
     prompt: jax.Array,  # (B, S_prompt) int32
-    config: ModelConfig,
+    config: AnyConfig,
     max_new_tokens: int,
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,

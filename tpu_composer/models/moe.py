@@ -220,6 +220,16 @@ def _moe_ffn(x: jax.Array, layer: Dict, config: MoEConfig) -> Tuple[jax.Array, j
     return out, aux
 
 
+def ffn_delta(h: jax.Array, layer: Dict, layer_idx: int, config) -> Tuple[jax.Array, jax.Array]:
+    """The block's FFN residual with the MoE-vs-dense branch in ONE place
+    (forward and the KV-cached decode path both call this): expert dispatch
+    on MoE layers, SwiGLU otherwise. Returns (delta, aux_loss)."""
+    c = config
+    if isinstance(c, MoEConfig) and c.is_moe_layer(layer_idx):
+        return _moe_ffn(h, layer, c)
+    return swiglu_ffn(h, layer, c.dtype), jnp.zeros((), jnp.float32)
+
+
 def forward(
     params: Dict,
     tokens: jax.Array,
@@ -237,12 +247,9 @@ def forward(
     for i, layer in enumerate(params["layers"]):
         x = attention_block(layer, x, positions, c, attn)
         h = _rmsnorm(x, layer["ln2"])
-        if c.is_moe_layer(i):
-            delta, aux = _moe_ffn(h, layer, c)
-            x = x + delta
-            aux_total = aux_total + aux
-        else:
-            x = x + swiglu_ffn(h, layer, c.dtype)
+        delta, aux = ffn_delta(h, layer, i, c)
+        x = x + delta
+        aux_total = aux_total + aux
 
     x = _rmsnorm(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
