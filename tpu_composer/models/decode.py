@@ -78,8 +78,10 @@ def _cached_attention(q, k_cache, v_cache, valid_len, c):
     """One query block against the cache. q: (B, Sq, H, Dh); cache:
     (B, S, H, Dh); positions >= valid_len are masked out."""
     s = k_cache.shape[1]
+    # Operands stay in the cache dtype (bf16 MXU rate; decode is KV-cache
+    # bandwidth bound anyway) with fp32 score accumulation.
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+        "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
     ) / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
     k_pos = jnp.arange(s)[None, None, None, :]
     scores = jnp.where(k_pos < valid_len[:, None, None, None], scores, -1e30)

@@ -7,9 +7,22 @@ row-max ``m``, normalizer ``l`` and an fp32 accumulator in VMEM scratch, and
 never materialize the (Sq, Sk) score matrix in HBM. The forward additionally
 emits the per-row logsumexp so the backward can rebuild probabilities
 blockwise (the standard dQ / dK+dV two-kernel split) instead of saving them.
-Matmuls hit the MXU with ``preferred_element_type=float32``; block shapes
-default to the 128-lane tile the MXU wants (pallas_guide.md "Tiling
-Constraints"); fully-masked causal blocks are skipped with ``pl.when``.
+
+Matmuls feed the MXU in the *input* dtype with
+``preferred_element_type=float32`` accumulation: on v5e the MXU runs bf16
+matmuls at ~4x its fp32 rate, so upcasting bf16 operands to fp32 before a
+``dot_general`` (as an earlier revision did) quarters attainable FLOPs for
+zero forward-precision gain — the operands were already rounded to bf16.
+The only dtype-sensitive spots are the softmax recurrence (kept in fp32
+scratch) and the ``p @ v`` / ``ds @ k`` operands, which are rounded to the
+input dtype exactly like the published FlashAttention TPU kernels. The
+score scale is applied to the (bq, bk) logits tile rather than pre-scaling
+q, so bf16 q keeps its full mantissa.
+
+Block shapes default to MXU-friendly tiles (pallas_guide.md "Tiling
+Constraints") sized well above the 128 minimum — bigger K/V tiles amortize
+the recurrence and keep the systolic array busy; fully-masked causal blocks
+are skipped with ``pl.when``.
 """
 
 from __future__ import annotations
@@ -68,12 +81,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(live)
     def _body():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0]  # input dtype: bf16 operands run the MXU at full rate
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (bq, bk)
+        ) * scale  # (bq, bk) fp32 logits
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
         m_prev = m_scr[:, :1]
@@ -85,7 +98,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             l_scr.shape,
         )
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
 
@@ -94,18 +108,80 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
         if lse_ref is not None:
-            # Lane-replicated (block_q, 128) layout, matching JAX's own TPU
-            # flash kernels (flash_attention.py MIN_BLOCK_SIZE): Mosaic
-            # rejects a (1, block_q) block over a (BH, S) array because the
-            # second-to-last block dim must be divisible by 8 or equal the
-            # array dim, so the per-row scalar costs 128 lanes either way.
-            lse_ref[0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l),
-                                          lse_ref.shape[1:])
+            lse_ref[0, 0] = _pack_lse(m_scr[:, :1] + jnp.log(l),
+                                      lse_ref.shape[2], block_q)
+
+
+def _lse_rows(block_q: int) -> int:
+    """Rows of the packed-lse tile one q-block occupies (see _pack_lse)."""
+    return (block_q + 127) // 128
+
+
+def _pack_lse(col, rows: int, block_q: int):
+    """Repack a (block_q, 1) per-row-scalar column into a dense
+    (rows, 128) fp32 tile — ``rows = ceil(block_q / 128)``.
+
+    Mosaic cannot write a (1, block_q) block over a (BH, S) array (the
+    sublane block dim must be 8-divisible or equal the array dim), so a
+    per-row scalar output costs a full 128-lane tile either way. An earlier
+    revision paid that cost by lane-REPLICATING the scalar into
+    (block_q, 128) — 128x the required HBM bytes (hundreds of MB per pass
+    at seq 8k training; r2 advisor finding). Packing instead lays the
+    block_q scalars out row-major across the tile's lanes, so the residual
+    array holds exactly S scalars (plus tail padding only when
+    128 ∤ block_q). The lse array is 4D (BH, nq, rows, 128) so the block's
+    sublane dim always EQUALS the array dim (legal tiling for any rows,
+    where a 3D (BH, nq*rows, 128) array would need 8 | rows). The repack
+    itself is a VMEM relayout, amortized over the whole K/V stream (it
+    runs once per q-block, at flush)."""
+    flat = col
+    pad = rows * 128 - block_q
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad, 1), jnp.float32)], axis=0
+        )
+    # Sublanes -> lanes without tpu.reshape (Mosaic rejects cross-lane
+    # reshapes like (256,1)->(2,128)): for each output row r, a one-hot
+    # band mask G[i,c] = [i == r*128 + c] turns the relayout into an
+    # elementwise multiply + sublane reduction — all core Mosaic ops.
+    n = rows * 128
+    i_idx = jax.lax.broadcasted_iota(jnp.int32, (n, 128), 0)
+    c_idx = jax.lax.broadcasted_iota(jnp.int32, (n, 128), 1)
+    rep = jnp.broadcast_to(flat, (n, 128))
+    out_rows = []
+    for r in range(rows):
+        band = jnp.where(i_idx == r * 128 + c_idx, rep, 0.0)
+        out_rows.append(jnp.sum(band, axis=0, keepdims=True))  # (1, 128)
+    return jnp.concatenate(out_rows, axis=0) if rows > 1 else out_rows[0]
+
+
+def _unpack_lse(tile, block_q: int):
+    """Inverse of _pack_lse: (rows, 128) tile -> (block_q, 1) column.
+
+    Same masked-reduction trick in reverse (lanes -> sublanes): select row
+    r with a one-hot sublane mask, lane-broadcast it square, then a
+    diagonal mask + lane reduction yields the 128 scalars as a column."""
+    rows = tile.shape[0]
+    r_idx = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+    c_idx = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+    row_sel = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
+    cols = []
+    for r in range(rows):
+        row_r = jnp.sum(
+            jnp.where(row_sel == r, tile, 0.0), axis=0, keepdims=True
+        )  # (1, 128)
+        rep = jnp.broadcast_to(row_r, (128, 128))
+        cols.append(
+            jnp.sum(jnp.where(r_idx == c_idx, rep, 0.0),
+                    axis=1, keepdims=True)
+        )  # (128, 1)
+    col = jnp.concatenate(cols, axis=0) if rows > 1 else cols[0]
+    return col[:block_q]
 
 
 def _fwd_kernel_nolse(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, **kw):
     """Inference variant: no logsumexp residual written (the primal path
-    discards it, so don't pay the (BH, S, 128) fp32 HBM write)."""
+    discards it, so don't pay even the packed HBM write)."""
     _fwd_kernel(q_ref, k_ref, v_ref, o_ref, None, m_scr, l_scr, acc_scr, **kw)
 
 
@@ -131,7 +207,8 @@ def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret,
     ]
     o_shape = jax.ShapeDtypeStruct((bh, sq, d), q3.dtype)
     o_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    lse_spec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+    rows = _lse_rows(block_q)
+    lse_spec = pl.BlockSpec((1, 1, rows, 128), lambda b, i, j: (b, i, 0, 0))
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -141,7 +218,8 @@ def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret,
         out, lse = pl.pallas_call(
             functools.partial(_fwd_kernel, **kw),
             out_shape=(o_shape,
-                       jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32)),
+                       jax.ShapeDtypeStruct((bh, nq, rows, 128),
+                                            jnp.float32)),
             grid=grid,
             in_specs=in_specs,
             out_specs=(o_spec, lse_spec),
@@ -181,16 +259,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
 
     @pl.when(live)
     def _body():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        ) * scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0][:, :1])  # normalized probabilities
+        # normalized probabilities straight off the packed logsumexp
+        p = jnp.exp(s - _unpack_lse(lse_ref[0, 0], block_q))
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -200,9 +279,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         # (BH, S, 128) fp32 residual array in HBM (r2 advisor finding — at
         # seq 8k training that array was hundreds of MB per pass).
         delta = jnp.sum(
-            do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True
+            do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=-1, keepdims=True,
         )
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k.dtype)
         dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -228,34 +308,36 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _body():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (bq, bk)
+        ) * scale  # (bq, bk)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0][:, :1])
+        p = jnp.exp(s - _unpack_lse(lse_ref[0, 0], block_q))
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         # In-VMEM delta recompute — see _dq_kernel.
         delta = jnp.sum(
-            do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True
+            do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=-1, keepdims=True,
         )
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
     @pl.when(qi == nq - 1)
     def _flush():
-        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)  # q carried the scale
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
@@ -271,6 +353,7 @@ def _flash_backward(res, g, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")
         )
 
+    rows = _lse_rows(block_q)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, block_q=block_q,
                           block_k=block_k, nk=nk),
@@ -282,7 +365,7 @@ def _flash_backward(res, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, rows, 128), lambda b, i, j: (b, i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
@@ -304,7 +387,7 @@ def _flash_backward(res, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, rows, 128), lambda b, j, i: (b, i, 0, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -343,17 +426,51 @@ def _flash_core_bwd(causal, block_q, block_k, interpret, res, g):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
+
+
+def _fit_block(explicit: Optional[int], s: int, default: int) -> int:
+    """Resolve a block size against sequence length ``s``. Explicit sizes are
+    clamped to ``s`` and must divide it (caller error otherwise); the
+    defaults self-shrink (by halving) until they divide, so any
+    power-of-two-friendly seq length gets the largest MXU-efficient tile
+    without the caller thinking about tiling."""
+    if explicit is not None:
+        b = min(explicit, s)
+        if s % b:
+            raise ValueError(f"block {b} must divide seq length {s}")
+        return b
+    b = min(default, s)
+    while b > 8 and s % b:
+        b //= 2
+    if s % b:
+        # No >=8 divisor in the halving chain (e.g. s=300 or prime): fail
+        # fast with the real constraint instead of degrading to a block
+        # Mosaic's sublane tiling rules reject anyway.
+        raise ValueError(
+            f"seq length {s} has no power-of-two-friendly block <= {default};"
+            " pass explicit block_q/block_k that divide it"
+        )
+    return b
+
+
 def flash_attention(
     q,
     k,
     v,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
     """FlashAttention via Pallas, differentiable (custom VJP with flash
     backward kernels). Shapes: (B, S, H, D) -> (B, S, H, D).
+
+    Block sizes default to (256, 512): the K/V tile is the streamed
+    ("arbitrary") axis, so a bigger tile amortizes the softmax recurrence
+    over more MXU work per step — measured faster than 128x128 on v5e.
+    Pass explicit sizes to override (they must then divide the seq length).
 
     ``interpret`` defaults to True off-TPU so the kernels are testable on
     the CPU mesh; on TPU they compile to Mosaic kernels.
@@ -362,12 +479,8 @@ def flash_attention(
         interpret = jax.default_backend() != "tpu"
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        raise ValueError(
-            f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})"
-        )
+    block_q = _fit_block(block_q, sq, DEFAULT_BLOCK_Q)
+    block_k = _fit_block(block_k, sk, DEFAULT_BLOCK_K)
 
     # Collapse (B, H) into one grid axis; move seq next to head_dim.
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
