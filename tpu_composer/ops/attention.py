@@ -28,6 +28,7 @@ are skipped with ``pl.when``.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -473,10 +474,22 @@ def flash_attention(
     Pass explicit sizes to override (they must then divide the seq length).
 
     ``interpret`` defaults to True off-TPU so the kernels are testable on
-    the CPU mesh; on TPU they compile to Mosaic kernels.
+    the CPU mesh; on TPU they compile to Mosaic kernels. The
+    ``TPUC_FLASH_INTERPRET`` env var (0/1) overrides the auto-detection —
+    needed when AOT-compiling for a TPU *topology* from a CPU-backend
+    process (tests/test_flash_aot_tpu.py), where the default backend lies
+    about the lowering target.
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        env = os.environ.get("TPUC_FLASH_INTERPRET")
+        if env not in (None, "", "0", "1"):
+            raise ValueError(
+                f"TPUC_FLASH_INTERPRET must be '0' or '1', got {env!r}"
+            )
+        if env in ("0", "1"):
+            interpret = env == "1"
+        else:
+            interpret = jax.default_backend() != "tpu"
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = _fit_block(block_q, sq, DEFAULT_BLOCK_Q)

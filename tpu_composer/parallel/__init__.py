@@ -32,6 +32,7 @@ from tpu_composer.parallel.pipeline import (
 from tpu_composer.parallel.train import (
     TrainConfig,
     make_train_state,
+    abstract_train_state,
     make_train_step,
     reshard_train_state,
 )
@@ -55,6 +56,7 @@ __all__ = [
     "transformer_stage_fn",
     "TrainConfig",
     "make_train_state",
+    "abstract_train_state",
     "make_train_step",
     "reshard_train_state",
 ]

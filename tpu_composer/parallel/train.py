@@ -105,10 +105,10 @@ def _param_specs(tc: TrainConfig, mesh: Mesh):
     return specs
 
 
-def make_train_state(tc: TrainConfig, key, mesh: Optional[Mesh] = None) -> Dict:
-    """{'params': ..., 'opt': ...}, sharded over the mesh when given. With
-    pipelining enabled the layer list is stacked on a leading stage axis
-    sharded over 'pp'."""
+def _build_state(tc: TrainConfig, key, mesh: Optional[Mesh]) -> Dict:
+    """Unsharded state construction shared by the real and abstract paths —
+    the layer-stacking decision must match the mesh the state will live on
+    (pipelined meshes stack the layer list on a leading 'pp' stage axis)."""
     params = tc._model_mod().init_params(tc.model, key)
     if _pipelined(tc, mesh):
         params = {
@@ -117,7 +117,14 @@ def make_train_state(tc: TrainConfig, key, mesh: Optional[Mesh] = None) -> Dict:
             "ln_f": params["ln_f"],
         }
     opt_state = _optimizer(tc).init(params)
-    state = {"params": params, "opt": opt_state}
+    return {"params": params, "opt": opt_state}
+
+
+def make_train_state(tc: TrainConfig, key, mesh: Optional[Mesh] = None) -> Dict:
+    """{'params': ..., 'opt': ...}, sharded over the mesh when given. With
+    pipelining enabled the layer list is stacked on a leading stage axis
+    sharded over 'pp'."""
+    state = _build_state(tc, key, mesh)
     if mesh is not None:
         state = reshard_train_state(tc, state, mesh)
     return state
@@ -150,7 +157,11 @@ def abstract_train_state(tc: TrainConfig, mesh: Mesh) -> Dict:
     """ShapeDtypeStructs carrying the mesh's NamedShardings — the zero-
     allocation restore template (checkpoint.restore): materializing a real
     state just to describe shapes would double peak HBM on restart."""
-    shaped = jax.eval_shape(lambda: make_train_state(tc, jax.random.key(0)))
+    # Build against the TARGET mesh's layout (a pipelined mesh stacks the
+    # layer list), or the spec trees won't line up with the shape tree.
+    shaped = jax.eval_shape(
+        lambda: _build_state(tc, jax.random.key(0), mesh)
+    )
     specs = _param_specs(tc, mesh)
 
     def abstract(tree, spec_tree):
