@@ -75,6 +75,23 @@ class TestFlashCompilesForTPU:
         )
         assert fn.lower(q, q, q).compile() is not None
 
+    def test_grad_gqa_group_fanin(self):
+        """Grouped-query path: K/V BlockSpec index maps fan one kv head
+        into 4 query heads, and the dK/dV grid folds the group into its
+        streaming axis — the index arithmetic must survive Mosaic."""
+        q = _sds((2, 1024, 8, 128), jnp.bfloat16)
+        kv = _sds((2, 1024, 2, 128), jnp.bfloat16)
+
+        def loss(q, k, v):
+            return flash_attention(
+                q, k, v, causal=True, interpret=False
+            ).astype(jnp.float32).sum()
+
+        compiled = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
+            q, kv, kv
+        ).compile()
+        assert compiled is not None
+
     def test_grad_sub128_block_pad_path(self):
         """block_q=64 < 128: _pack_lse pads the column with a (64,1) zeros
         concat and _unpack_lse slices it back — the in-kernel sublane

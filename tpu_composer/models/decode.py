@@ -26,7 +26,12 @@ import jax.numpy as jnp
 
 from tpu_composer.ops.attention import mha_reference
 from tpu_composer.models.moe import MoEConfig, ffn_delta
-from tpu_composer.models.transformer import ModelConfig, _rmsnorm, _rope
+from tpu_composer.models.transformer import (
+    ModelConfig,
+    _rmsnorm,
+    _rope,
+    project_qkv,
+)
 
 AnyConfig = Union[ModelConfig, MoEConfig]
 
@@ -47,7 +52,9 @@ def _ffn_delta(h, layer, layer_idx: int, c: AnyConfig):
 
 
 class KVCache(NamedTuple):
-    """Per-layer stacked K/V: (n_layers, B, max_seq, H, Dh)."""
+    """Per-layer stacked K/V: (n_layers, B, max_seq, KV, Dh). With grouped
+    query heads KV < H this is the point of GQA — the cache (decode's HBM
+    bandwidth bound) shrinks by the group factor."""
 
     k: jax.Array
     v: jax.Array
@@ -58,7 +65,7 @@ class KVCache(NamedTuple):
 def init_kv_cache(config: AnyConfig, batch: int, max_seq: Optional[int] = None) -> KVCache:
     c = config
     s = max_seq or c.max_seq
-    shape = (c.n_layers, batch, s, c.n_heads, c.head_dim)
+    shape = (c.n_layers, batch, s, c.kv_heads, c.head_dim)
     return KVCache(
         k=jnp.zeros(shape, c.dtype),
         v=jnp.zeros(shape, c.dtype),
@@ -68,25 +75,31 @@ def init_kv_cache(config: AnyConfig, batch: int, max_seq: Optional[int] = None) 
 
 def _project_qkv(layer: Dict, x, positions, c):
     h = _rmsnorm(x, layer["ln1"])
-    qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"])
-    q = _rope(qkv[0], positions, c.rope_theta)
-    k = _rope(qkv[1], positions, c.rope_theta)
-    return q, k, qkv[2]
+    q, k, v = project_qkv(layer, h)
+    q = _rope(q, positions, c.rope_theta)
+    k = _rope(k, positions, c.rope_theta)
+    return q, k, v
 
 
 def _cached_attention(q, k_cache, v_cache, valid_len, c):
     """One query block against the cache. q: (B, Sq, H, Dh); cache:
-    (B, S, H, Dh); positions >= valid_len are masked out."""
-    s = k_cache.shape[1]
+    (B, S, KV, Dh); positions >= valid_len are masked out. Query heads are
+    viewed as (KV, group) so grouped caches are read once, not repeated."""
+    b, sq, h, dh = q.shape
+    s, hk = k_cache.shape[1], k_cache.shape[2]
+    qg = q.reshape(b, sq, hk, h // hk, dh)
     # Operands stay in the cache dtype (bf16 MXU rate; decode is KV-cache
     # bandwidth bound anyway) with fp32 score accumulation.
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+        "bqkgd,bskd->bkgqs", qg, k_cache, preferred_element_type=jnp.float32
     ) / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
-    k_pos = jnp.arange(s)[None, None, None, :]
-    scores = jnp.where(k_pos < valid_len[:, None, None, None], scores, -1e30)
+    k_pos = jnp.arange(s)[None, None, None, None, :]
+    scores = jnp.where(
+        k_pos < valid_len[:, None, None, None, None], scores, -1e30
+    )
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(c.dtype), v_cache)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs.astype(c.dtype), v_cache)
+    return out.reshape(b, sq, h, dh)
 
 
 def prefill(
@@ -116,7 +129,7 @@ def prefill(
     x = _rmsnorm(x, params["ln_f"])
     logits = jnp.einsum("bd,vd->bv", x[:, -1], params["embed"]).astype(jnp.float32)
 
-    k_stack = jnp.stack(ks)  # (L, B, S_p, H, Dh)
+    k_stack = jnp.stack(ks)  # (L, B, S_p, KV, Dh)
     v_stack = jnp.stack(vs)
     cache = KVCache(
         k=jax.lax.dynamic_update_slice(cache.k, k_stack, (0, 0, 0, 0, 0)),

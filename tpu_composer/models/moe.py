@@ -46,6 +46,7 @@ class MoEConfig:
     d_model: int = 512
     n_layers: int = 4
     n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # grouped-query attention; None = MHA
     d_ff: int = 1408
     max_seq: int = 2048
     dtype: Any = jnp.bfloat16
@@ -62,6 +63,15 @@ class MoEConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads or self.n_heads
+        if self.n_heads % kv:
+            raise ValueError(
+                f"n_kv_heads {kv} must divide n_heads {self.n_heads}"
+            )
+        return kv
+
     def is_moe_layer(self, i: int) -> bool:
         return i % self.moe_period == self.moe_period - 1
 
@@ -74,7 +84,8 @@ class MoEConfig:
         """The equivalent dense config (attention/embed dims match)."""
         return ModelConfig(
             vocab_size=self.vocab_size, d_model=self.d_model,
-            n_layers=self.n_layers, n_heads=self.n_heads, d_ff=self.d_ff,
+            n_layers=self.n_layers, n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads, d_ff=self.d_ff,
             max_seq=self.max_seq, dtype=self.dtype, attn_impl=self.attn_impl,
             rope_theta=self.rope_theta,
         )
@@ -93,10 +104,16 @@ def init_params(config: MoEConfig, key) -> Dict:
         k1, k2, k3, k4, k5, k6 = jax.random.split(lk, 6)
         layer = {
             "ln1": jnp.ones((c.d_model,), jnp.float32),
-            "wqkv": dense(k1, (c.d_model, 3, c.n_heads, c.head_dim)),
             "wo": dense(k2, (c.n_heads, c.head_dim, c.d_model)),
             "ln2": jnp.ones((c.d_model,), jnp.float32),
         }
+        if c.kv_heads == c.n_heads:
+            layer["wqkv"] = dense(k1, (c.d_model, 3, c.n_heads, c.head_dim))
+        else:  # grouped-query split, matching models.transformer; fold_in
+            # keeps MHA configs' same-seed param stream unchanged.
+            layer["wq"] = dense(k1, (c.d_model, c.n_heads, c.head_dim))
+            layer["wkv"] = dense(jax.random.fold_in(k1, 1),
+                                 (c.d_model, 2, c.kv_heads, c.head_dim))
         if c.is_moe_layer(i):
             layer.update({
                 # Router in fp32: tiny, and gating noise in bf16 visibly
@@ -127,10 +144,14 @@ def param_specs(config: MoEConfig) -> Dict:
     for i in range(c.n_layers):
         layer = {
             "ln1": P(),
-            "wqkv": P(None, None, "tp", None),
             "wo": P("tp", None, None),
             "ln2": P(),
         }
+        if c.kv_heads == c.n_heads:
+            layer["wqkv"] = P(None, None, "tp", None)
+        else:
+            layer["wq"] = P(None, "tp", None)
+            layer["wkv"] = P(None, None, "tp", None)
         if c.is_moe_layer(i):
             layer.update({
                 "w_router": P(),

@@ -30,6 +30,10 @@ class ModelConfig:
     d_model: int = 512
     n_layers: int = 4
     n_heads: int = 8
+    # Grouped-query attention: KV heads < query heads shrink the KV cache
+    # (the decode-time HBM bound) and the K/V projection by n_heads/kv
+    # while every query head keeps its own Q projection. None = MHA.
+    n_kv_heads: Optional[int] = None
     d_ff: int = 1408  # ~2.75x, SwiGLU-style
     max_seq: int = 2048
     dtype: Any = jnp.bfloat16
@@ -40,9 +44,21 @@ class ModelConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads or self.n_heads
+        if self.n_heads % kv:
+            raise ValueError(
+                f"n_kv_heads {kv} must divide n_heads {self.n_heads}"
+            )
+        return kv
+
 
 def init_params(config: ModelConfig, key) -> Dict:
-    """Pytree: {embed, layers: [{ln1, wqkv, wo, ln2, w_gate, w_up, w_down}], ln_f}."""
+    """Pytree: {embed, layers: [...], ln_f}. MHA layers carry one fused
+    {wqkv}; grouped-query layers (kv_heads < n_heads) split into {wq, wkv}
+    so the K/V projection is physically n_heads/kv smaller, not a sliced
+    view of a full-width tensor."""
     c = config
     k_embed, k_layers = jax.random.split(key)
     init = jax.nn.initializers.normal(stddev=0.02)
@@ -53,15 +69,23 @@ def init_params(config: ModelConfig, key) -> Dict:
     layers = []
     for lk in jax.random.split(k_layers, c.n_layers):
         k1, k2, k3, k4, k5 = jax.random.split(lk, 5)
-        layers.append({
+        layer = {
             "ln1": jnp.ones((c.d_model,), jnp.float32),
-            "wqkv": dense(k1, (c.d_model, 3, c.n_heads, c.head_dim)),
             "wo": dense(k2, (c.n_heads, c.head_dim, c.d_model)),
             "ln2": jnp.ones((c.d_model,), jnp.float32),
             "w_gate": dense(k3, (c.d_model, c.d_ff)),
             "w_up": dense(k4, (c.d_model, c.d_ff)),
             "w_down": dense(k5, (c.d_ff, c.d_model)),
-        })
+        }
+        if c.kv_heads == c.n_heads:
+            layer["wqkv"] = dense(k1, (c.d_model, 3, c.n_heads, c.head_dim))
+        else:
+            # fold_in rather than widening the split: MHA configs keep the
+            # exact same-seed param stream they had before GQA existed.
+            layer["wq"] = dense(k1, (c.d_model, c.n_heads, c.head_dim))
+            layer["wkv"] = dense(jax.random.fold_in(k1, 1),
+                                 (c.d_model, 2, c.kv_heads, c.head_dim))
+        layers.append(layer)
     return {
         "embed": dense(k_embed, (c.vocab_size, c.d_model)),
         "layers": layers,
@@ -71,21 +95,39 @@ def init_params(config: ModelConfig, key) -> Dict:
 
 def param_specs(config: ModelConfig) -> Dict:
     """PartitionSpec pytree matching init_params — 'tp' shards heads/ffn,
-    'dp'/'sp' never touch params (they shard batch/sequence)."""
+    'dp'/'sp' never touch params (they shard batch/sequence). With grouped
+    query heads 'tp' shards the kv-head axis of wkv; when tp does not
+    divide kv_heads (e.g. MQA's single head under tp=2), the train step's
+    spec legalization replicates wkv instead (parallel/train._legalize_spec)."""
     layer = {
         "ln1": P(),
-        "wqkv": P(None, None, "tp", None),
         "wo": P("tp", None, None),
         "ln2": P(),
         "w_gate": P(None, "tp"),
         "w_up": P(None, "tp"),
         "w_down": P("tp", None),
     }
+    if config.kv_heads == config.n_heads:
+        layer["wqkv"] = P(None, None, "tp", None)
+    else:
+        layer["wq"] = P(None, "tp", None)
+        layer["wkv"] = P(None, None, "tp", None)
     return {
         "embed": P("tp", None),
         "layers": [dict(layer) for _ in range(config.n_layers)],
         "ln_f": P(),
     }
+
+
+def project_qkv(layer: Dict, h: jax.Array):
+    """(B, S, D) normed activations -> q (B, S, H, hd), k/v (B, S, KV, hd),
+    handling both the fused-MHA and split-GQA parameter layouts."""
+    if "wqkv" in layer:
+        qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"])
+        return qkv[0], qkv[1], qkv[2]
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+    kv = jnp.einsum("bsd,dthk->tbshk", h, layer["wkv"])
+    return q, kv[0], kv[1]
 
 
 def _rmsnorm(x, gamma, eps=1e-6):
@@ -130,8 +172,7 @@ def attention_block(
     shared by the dense and MoE model families."""
     c = config
     h = _rmsnorm(x, layer["ln1"])
-    qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"])
-    q, k, v = qkv[0], qkv[1], qkv[2]
+    q, k, v = project_qkv(layer, h)
     q = _rope(q, positions, c.rope_theta)
     k = _rope(k, positions, c.rope_theta)
     o = attn(q, k, v, causal=True)

@@ -39,8 +39,24 @@ from jax.experimental.pallas import tpu as pltpu  # present on CPU builds too
 NEG_INF = -1e30
 
 
+def repeat_kv(q, k, v):
+    """Broadcast grouped K/V heads up to the query head count — the
+    fallback GQA path for implementations whose einsums want equal head
+    axes (reference, ring, ulysses). The flash kernels never call this:
+    they fan grouped K/V through BlockSpec index maps instead."""
+    h, hk = q.shape[2], k.shape[2]
+    if h == hk:
+        return k, v
+    if h % hk:
+        raise ValueError(f"kv heads {hk} must divide query heads {h}")
+    g = h // hk
+    return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+
+
 def mha_reference(q, k, v, causal: bool = False):
-    """Plain attention. Shapes: (B, S, H, D) -> (B, S, H, D)."""
+    """Plain attention. q (B, S, H, D), k/v (B, S, H or KV, D) ->
+    (B, S, H, D); grouped K/V heads are broadcast up."""
+    k, v = repeat_kv(q, k, v)
     d = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
@@ -186,11 +202,23 @@ def _fwd_kernel_nolse(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, **kw):
     _fwd_kernel(q_ref, k_ref, v_ref, o_ref, None, m_scr, l_scr, acc_scr, **kw)
 
 
-def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret,
-                   with_lse=True):
-    """(BH, S, D) inputs -> (out, lse | None). The 3D-grid streaming core.
-    ``with_lse=False`` (inference / primal-only) skips the residual output
-    entirely."""
+def _kv_index(b, heads: int, kv_heads: int):
+    """Map a flattened (batch*q_head) grid index to its (batch*kv_head)
+    block index — the grouped-query fan-in. Identity when heads == kv_heads
+    (the index maps stay trivial for the MHA case)."""
+    if heads == kv_heads:
+        return b
+    group = heads // kv_heads
+    return (b // heads) * kv_heads + (b % heads) // group
+
+
+def _flash_forward(q3, k3, v3, heads, kv_heads, causal, block_q, block_k,
+                   interpret, with_lse=True):
+    """q3 (B*H, S, D), k3/v3 (B*KV, S, D) -> (out, lse | None). The 3D-grid
+    streaming core; with grouped-query attention (KV < H) the K/V block
+    specs fan one kv head into H/KV query heads via the index map — no
+    repeated K/V in HBM. ``with_lse=False`` (inference / primal-only)
+    skips the residual output entirely."""
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     nq, nk = sq // block_q, sk // block_k
@@ -210,10 +238,11 @@ def _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret,
     o_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     rows = _lse_rows(block_q)
     lse_spec = pl.BlockSpec((1, 1, rows, 128), lambda b, i, j: (b, i, 0, 0))
+    kv = functools.partial(_kv_index, heads=heads, kv_heads=kv_heads)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0)),
     ]
     if with_lse:
         out, lse = pl.pallas_call(
@@ -295,12 +324,20 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, causal: bool, block_q: int, block_k: int, nq: int):
-    ki, qi = pl.program_id(1), pl.program_id(2)
+                *, causal: bool, block_q: int, block_k: int, nq: int,
+                q_steps: int):
+    """dK/dV accumulation. The grid's arbitrary axis runs ``q_steps =
+    group * nq`` steps: with grouped-query attention every kv head receives
+    gradient from all ``group`` query heads in its group, so the group
+    members are folded into the same streaming accumulation (flushing once
+    per kv head) instead of racing ``group`` grid cells on one output
+    block. ``qi`` below is the q-block index within the current member."""
+    ki, t = pl.program_id(1), pl.program_id(2)
+    qi = t % nq
     d = q_ref.shape[2]
     scale = 1.0 / (d ** 0.5)
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -336,17 +373,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == nq - 1)
+    @pl.when(t == q_steps - 1)
     def _flush():
         dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(res, g, causal, block_q, block_k, interpret):
+def _flash_backward(res, g, heads, kv_heads, causal, block_q, block_k,
+                    interpret):
     q3, k3, v3, out, lse = res
     bh, sq, d = q3.shape
-    sk = k3.shape[1]
+    bkv, sk, _ = k3.shape
     nq, nk = sq // block_q, sk // block_k
+    group = heads // kv_heads
     do = g
     sem = {}
     if not interpret:
@@ -355,6 +394,7 @@ def _flash_backward(res, g, causal, block_q, block_k, interpret):
         )
 
     rows = _lse_rows(block_q)
+    kv = functools.partial(_kv_index, heads=heads, kv_heads=kv_heads)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, block_q=block_q,
                           block_k=block_k, nk=nk),
@@ -362,8 +402,8 @@ def _flash_backward(res, g, causal, block_q, block_k, interpret):
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, rows, 128), lambda b, i, j: (b, i, 0, 0)),
@@ -374,25 +414,37 @@ def _flash_backward(res, g, causal, block_q, block_k, interpret):
         **sem,
     )(q3, k3, v3, do, out, lse)
 
+    # dK/dV grid runs over KV batch-heads; the arbitrary axis streams
+    # group*nq steps (every q head of the group x every q block), so one
+    # grid cell owns each output block — no cross-cell accumulation races.
+    def qb(b, t):
+        if group == 1:
+            return b
+        return (b // kv_heads) * heads + (b % kv_heads) * group + t // nq
+
+    def qi_(t):
+        return t % nq  # == t when group == 1 (the axis is then nq long)
+
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, block_q=block_q,
-                          block_k=block_k, nq=nq),
+                          block_k=block_k, nq=nq, q_steps=group * nq),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+            jax.ShapeDtypeStruct((bkv, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bkv, sk, d), v3.dtype),
         ),
-        grid=(bh, nk, nq),
+        grid=(bkv, nk, group * nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, rows, 128), lambda b, j, i: (b, i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, t: (qb(b, t), qi_(t), 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, t: (qb(b, t), qi_(t), 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, t: (qb(b, t), qi_(t), 0)),
+            pl.BlockSpec((1, 1, rows, 128),
+                         lambda b, j, t: (qb(b, t), qi_(t), 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -408,20 +460,25 @@ def _flash_backward(res, g, causal, block_q, block_k, interpret):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q3, k3, v3, causal, block_q, block_k, interpret):
-    out, _ = _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret,
-                            with_lse=False)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_core(q3, k3, v3, heads, kv_heads, causal, block_q, block_k,
+                interpret):
+    out, _ = _flash_forward(q3, k3, v3, heads, kv_heads, causal, block_q,
+                            block_k, interpret, with_lse=False)
     return out
 
 
-def _flash_core_fwd(q3, k3, v3, causal, block_q, block_k, interpret):
-    out, lse = _flash_forward(q3, k3, v3, causal, block_q, block_k, interpret)
+def _flash_core_fwd(q3, k3, v3, heads, kv_heads, causal, block_q, block_k,
+                    interpret):
+    out, lse = _flash_forward(q3, k3, v3, heads, kv_heads, causal, block_q,
+                              block_k, interpret)
     return out, (q3, k3, v3, out, lse)
 
 
-def _flash_core_bwd(causal, block_q, block_k, interpret, res, g):
-    return _flash_backward(res, g, causal, block_q, block_k, interpret)
+def _flash_core_bwd(heads, kv_heads, causal, block_q, block_k, interpret,
+                    res, g):
+    return _flash_backward(res, g, heads, kv_heads, causal, block_q, block_k,
+                           interpret)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -466,7 +523,12 @@ def flash_attention(
     interpret: Optional[bool] = None,
 ):
     """FlashAttention via Pallas, differentiable (custom VJP with flash
-    backward kernels). Shapes: (B, S, H, D) -> (B, S, H, D).
+    backward kernels). Shapes: q (B, S, H, D), k/v (B, S, KV, D) ->
+    (B, S, H, D), where KV may be any divisor of H (grouped-query /
+    multi-query attention): K/V blocks are fanned into their H/KV query
+    heads through the BlockSpec index maps, so grouped K/V are never
+    materialized at H width in HBM, and dK/dV accumulate the whole group
+    inside one grid cell's streaming axis.
 
     Block sizes default to (256, 512): the K/V tile is the streamed
     ("arbitrary") axis, so a bigger tile amortizes the softmax recurrence
@@ -491,13 +553,15 @@ def flash_attention(
         else:
             interpret = jax.default_backend() != "tpu"
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, hk = k.shape[1], k.shape[2]
+    if h % hk:
+        raise ValueError(f"kv heads {hk} must divide query heads {h}")
     block_q = _fit_block(block_q, sq, DEFAULT_BLOCK_Q)
     block_k = _fit_block(block_k, sk, DEFAULT_BLOCK_K)
 
     # Collapse (B, H) into one grid axis; move seq next to head_dim.
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    out = _flash_core(qt, kt, vt, causal, block_q, block_k, interpret)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
+    out = _flash_core(qt, kt, vt, h, hk, causal, block_q, block_k, interpret)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -26,6 +26,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from tpu_composer.ops.attention import repeat_kv
+
 
 def _block_update(q, k_cur, v_cur, m, l, acc, scale, mask=None):
     """One online-softmax block update shared by both ring variants:
@@ -62,6 +64,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     my_idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
+    # Grouped K/V heads broadcast up before entering the ring (the ring
+    # rotates K/V shards; per-device memory stays O(S/n * H)).
+    k, v = repeat_kv(q, k, v)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -154,6 +159,7 @@ def ring_attention_zigzag(q, k, v, axis_name: str, causal: bool = False):
     n = jax.lax.axis_size(axis_name)
     if n == 1:
         return ring_attention(q, k, v, axis_name=axis_name, causal=True)
+    k, v = repeat_kv(q, k, v)
     my = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     if s_local % 2:

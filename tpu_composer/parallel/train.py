@@ -78,9 +78,33 @@ def _optimizer(tc: TrainConfig):
     return optax.adamw(tc.learning_rate, weight_decay=tc.weight_decay)
 
 
+def _legalize_spec(spec, shape, mesh: Mesh):
+    """Drop (replicate) any spec axis whose mesh size does not divide the
+    corresponding array dim — e.g. MQA's single kv head under tp=2, or a
+    layer stack shallower than 'pp'. GSPMD would reject the sharding
+    outright; replicating the odd tensor out is the conventional fallback
+    and costs only that tensor's duplication."""
+    if not isinstance(spec, P):
+        return spec
+    dims = []
+    for i, ax in enumerate(spec):
+        if ax is None:
+            dims.append(None)
+            continue
+        names = ax if isinstance(ax, tuple) else (ax,)
+        total = 1
+        for name in names:
+            total *= mesh.shape.get(name, 1)
+        dims.append(ax if shape[i] % total == 0 else None)
+    return P(*dims)
+
+
 def _shard_pytree(tree, specs, mesh: Mesh):
     return jax.tree.map(
-        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), tree, specs
+        lambda x, spec: jax.device_put(
+            x, NamedSharding(mesh, _legalize_spec(spec, x.shape, mesh))
+        ),
+        tree, specs,
     )
 
 
@@ -167,7 +191,10 @@ def abstract_train_state(tc: TrainConfig, mesh: Mesh) -> Dict:
     def abstract(tree, spec_tree):
         return jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=NamedSharding(mesh, s)
+                x.shape, x.dtype,
+                sharding=NamedSharding(
+                    mesh, _legalize_spec(s, x.shape, mesh)
+                ),
             ),
             tree, spec_tree,
         )

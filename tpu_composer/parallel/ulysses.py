@@ -31,7 +31,7 @@ from typing import Callable, Optional
 import jax
 from jax import lax
 
-from tpu_composer.ops.attention import mha_reference
+from tpu_composer.ops.attention import mha_reference, repeat_kv
 
 
 def ulysses_attention(
@@ -44,13 +44,18 @@ def ulysses_attention(
 ):
     """All-to-all sequence-parallel attention. Local shapes (B, S/n, H, D);
     the global sequence is the concatenation of shards in axis order. The
-    head count must be divisible by the axis size."""
+    head count must be divisible by the axis size. Grouped K/V heads stay
+    grouped through the all-to-all when sp divides them (each device then
+    attends H/n query heads against KV/n kv heads — the GQA bandwidth
+    saving survives the collective); otherwise they broadcast up first."""
     n = lax.axis_size(axis_name)
     if n == 1:
         return (attn_fn or mha_reference)(q, k, v, causal=causal)
     h = q.shape[2]
     if h % n:
         raise ValueError(f"n_heads {h} not divisible by sp={n}")
+    if k.shape[2] % n:
+        k, v = repeat_kv(q, k, v)
     attn = attn_fn or mha_reference
 
     # (B, S/n, H, D) -> (B, S, H/n, D): scatter heads, gather sequence.

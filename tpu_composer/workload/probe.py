@@ -385,7 +385,89 @@ def staged_accelerator_probe(
                 fb["failed_stage"] = fb_failed
                 fb["stderr_tail"] = fb_tail
             result["cpu_fallback"] = fb
+            # Compile-time hardware evidence that needs no hardware: run
+            # the full XLA:TPU + Mosaic pipeline against a device-less v5e
+            # topology (jax.experimental.topologies + installed libtpu) —
+            # the flash grad kernels and the 8-chip sharded train step.
+            # Proves the TPU programs this framework emits are compilable
+            # for the target even when the tunnel relay is dead.
+            result["tpu_aot_compile"] = aot_compile_probe(env)
     return result
+
+
+_AOT_CHILD = r"""
+import json, os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import topologies
+from jax.sharding import Mesh, SingleDeviceSharding
+
+from tpu_composer.ops.attention import flash_attention
+from tpu_composer.models import ModelConfig
+from tpu_composer.parallel import (
+    TrainConfig, abstract_train_state, make_train_step, solve_mesh_axes,
+)
+
+out = {}
+
+t0 = time.time()
+dev = topologies.get_topology_desc("v5e:2x2", "tpu").devices[0]
+q = jax.ShapeDtypeStruct((2, 2048, 4, 128), jnp.bfloat16,
+                         sharding=SingleDeviceSharding(dev))
+loss = lambda q, k, v: flash_attention(
+    q, k, v, causal=True, interpret=False).astype(jnp.float32).sum()
+jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, q, q).compile()
+out["flash_grad_v5e"] = {"ok": True, "seconds": round(time.time() - t0, 2),
+                         "shape": "B2 S2048 H4 D128 bf16 causal"}
+
+t0 = time.time()
+devs = topologies.get_topology_desc("v5e:2x4", "tpu").devices
+axes = solve_mesh_axes(8, sp=2, tp=2)
+mesh = Mesh(np.array(devs).reshape([axes[a] for a in axes]), tuple(axes))
+tc = TrainConfig(
+    model=ModelConfig(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+                      d_ff=256, max_seq=64, dtype=jnp.bfloat16),
+    sp_impl="zigzag",
+)
+state = abstract_train_state(tc, mesh)
+step_fn, batch_sharding = make_train_step(tc, mesh)
+tokens = jax.ShapeDtypeStruct((2 * axes["dp"], 64), jnp.int32,
+                              sharding=batch_sharding)
+step_fn.lower(state, tokens).compile()
+out["train_step_v5e_2x4"] = {
+    "ok": True, "seconds": round(time.time() - t0, 2),
+    "mesh": dict(axes), "sp_impl": "zigzag",
+}
+print("AOT_RESULT " + json.dumps(out), flush=True)
+"""
+
+
+def aot_compile_probe(env: Dict[str, str], timeout_s: float = 420.0) -> Dict[str, Any]:
+    """AOT-compile the flash kernels + the 8-chip sharded train step for a
+    real v5e topology in a CPU-backend subprocess. Returns per-target
+    timings, or {error/stderr_tail} — never raises, bounded by timeout_s.
+    Same pipeline as tests/test_flash_aot_tpu.py / test_multichip_aot_tpu.py,
+    run at bench time so BENCH artifacts carry compile evidence for rounds
+    where the chip itself is unreachable."""
+    child_env = dict(env)
+    child_env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", "-c", _AOT_CHILD],
+            capture_output=True, text=True, timeout=timeout_s, env=child_env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s}s"}
+    for line in proc.stdout.splitlines():
+        if line.startswith("AOT_RESULT "):
+            return json.loads(line[len("AOT_RESULT "):])
+    return {
+        "error": f"exit {proc.returncode}",
+        "stderr_tail": proc.stderr.strip().splitlines()[-15:],
+    }
 
 
 def _drive_child(
