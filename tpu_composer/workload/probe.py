@@ -43,7 +43,11 @@ from typing import Any, Dict, List, Optional
 STAGE_TIMEOUTS_S: Dict[str, float] = {
     "backend_init": 480.0,
     "matmul": 120.0,
-    "flash_attn": 240.0,
+    # flash_attn compiles 8 functions (4 standalone numerics + 4 chained
+    # timing scans) through the remote-compile tunnel; the persistent
+    # compilation cache makes repeat probes cheap but the first live run
+    # needs headroom.
+    "flash_attn": 600.0,
     "qualify": 420.0,
     "qualify_large": 420.0,
 }
@@ -256,19 +260,40 @@ def flash_attention_on_chip(
         for a, b in zip(gf, gr)
     )
 
-    def bench(fn, *args, iters=20):
-        fn(*args)  # warm
-        jax.block_until_ready(fn(*args))
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / iters * 1e3
+    def bench(fn, *args, iters=8, reps=2, pick=lambda out: out):
+        """Per-iteration device time via a lax.scan chain INSIDE one jit:
+        iteration i+1's q depends on iteration i's output, so the device
+        executes them back-to-back and one dispatch covers all of them.
+        Per-call host timing (the previous approach) measured the axon
+        tunnel's per-dispatch round trip, not the kernel — flash and
+        reference came out within noise of the same number because both
+        were gated on the same ~4 ms relay hop."""
+
+        @jax.jit
+        def chained(q, k, v):
+            def body(c, _):
+                out = pick(fn(c, k, v))
+                return (c + 1e-6 * out).astype(c.dtype), ()
+
+            c, _ = jax.lax.scan(body, q, None, length=iters)
+            return c
+
+        chained(*args).block_until_ready()  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            chained(*args).block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        return best / iters * 1e3
 
     flash_ms = bench(f_fwd, q, k, v)
     ref_ms = bench(r_fwd, q, k, v)
-    flash_bwd_ms = bench(f_grad, q, k, v)
-    ref_bwd_ms = bench(r_grad, q, k, v)
+    # Sum ALL three grads into the carry: feeding only g[0] back would let
+    # jaxpr DCE delete the dead dk/dv computation (the entire dkv
+    # pallas_call on the flash path) and time half a backward.
+    full = lambda g: g[0] + g[1] + g[2]
+    flash_bwd_ms = bench(f_grad, q, k, v, pick=full)
+    ref_bwd_ms = bench(r_grad, q, k, v, pick=full)
 
     # bf16 tolerance: sums over seq-length dot products accumulate ~1e-2.
     ok = fwd_err < 0.1 and bwd_err < 0.5
@@ -312,6 +337,13 @@ def staged_accelerator_probe(
     # Verbose runtime/plugin logging: on the happy path it is merely chatty
     # stderr we never show; on a wedge it is the only record of how far the
     # PJRT handshake got. (TF_CPP covers XLA/PJRT C++, TPU_* covers libtpu.)
+    import tempfile
+
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(),
+                     f"tpuc_jax_cache_{os.getuid()}"),
+    )
     env.setdefault("TF_CPP_MIN_LOG_LEVEL", "0")
     env.setdefault("TPU_STDERR_LOG_LEVEL", "0")
     env.setdefault("TPU_MIN_LOG_LEVEL", "0")
