@@ -131,3 +131,101 @@ class TestMoEDecode:
         out = gen(params, prompt)
         assert out.shape == (2, 5)
         assert (out == gen(params, prompt)).all()
+
+
+class TestSamplingFilters:
+    """top-k / top-p logit filtering (models/decode.py) — the standard
+    serving sampling controls, composed filter-then-sample."""
+
+    def test_top_k_keeps_exactly_k(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_composer.models.decode import filter_top_k
+
+        logits = jax.random.normal(jax.random.key(0), (3, 50))
+        out = filter_top_k(logits, 5)
+        finite = jnp.isfinite(out).sum(axis=-1)
+        assert [int(x) for x in finite] == [5, 5, 5]
+        # Survivors are exactly the 5 largest per row.
+        top5 = jax.lax.top_k(logits, 5)[1]
+        for r in range(3):
+            kept = set(int(i) for i in jnp.where(jnp.isfinite(out[r]))[0])
+            assert kept == set(int(i) for i in top5[r])
+
+    def test_top_k_ge_vocab_is_identity(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_composer.models.decode import filter_top_k
+
+        logits = jax.random.normal(jax.random.key(0), (2, 8))
+        assert bool((filter_top_k(logits, 8) == logits).all())
+
+    def test_top_p_nucleus_mass(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_composer.models.decode import filter_top_p
+
+        logits = jax.random.normal(jax.random.key(1), (4, 100)) * 3
+        out = filter_top_p(logits, 0.9)
+        probs = jax.nn.softmax(logits, axis=-1)
+        kept_mass = jnp.where(jnp.isfinite(out), probs, 0.0).sum(axis=-1)
+        # The nucleus covers >= 0.9; dropping its smallest member would
+        # fall below (minimality).
+        assert bool((kept_mass >= 0.9).all())
+        for r in range(4):
+            kept = jnp.where(jnp.isfinite(out[r]), probs[r], jnp.inf)
+            smallest = float(jnp.min(kept))
+            assert float(kept_mass[r]) - smallest < 0.9 + 1e-6
+
+    def test_top_p_always_keeps_argmax(self):
+        import jax.numpy as jnp
+
+        from tpu_composer.models.decode import filter_top_p
+
+        # One dominant token, tiny p: argmax must survive.
+        logits = jnp.array([[10.0, 0.0, -1.0, -2.0]])
+        out = filter_top_p(logits, 0.01)
+        assert bool(jnp.isfinite(out[0, 0]))
+        assert not bool(jnp.isfinite(out[0, 1:]).any())
+
+    def test_generate_with_sampling_stays_in_topk_set(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_composer.models.decode import generate
+        from tpu_composer.models.transformer import ModelConfig, init_params
+
+        c = ModelConfig(vocab_size=64, d_model=64, n_layers=1, n_heads=4,
+                        d_ff=96, max_seq=32, dtype=jnp.float32)
+        params = init_params(c, jax.random.key(0))
+        prompt = jnp.array([[3, 9]], jnp.int32)
+        toks_k1 = generate(params, prompt, c, max_new_tokens=6,
+                           temperature=1.0, top_k=1, max_seq=16,
+                           key=jax.random.key(5))
+        greedy = generate(params, prompt, c, max_new_tokens=6, max_seq=16)
+        # top_k=1 sampling IS greedy decoding.
+        assert toks_k1.tolist() == greedy.tolist()
+        toks = generate(params, prompt, c, max_new_tokens=6,
+                        temperature=1.2, top_k=4, top_p=0.95, max_seq=16,
+                        key=jax.random.key(6))
+        assert toks.shape == (1, 6)
+
+    def test_generate_rejects_bad_sampling_params(self):
+        import jax.numpy as jnp
+        import pytest
+
+        from tpu_composer.models.decode import generate
+        from tpu_composer.models.transformer import ModelConfig, init_params
+        import jax
+
+        c = ModelConfig(vocab_size=32, d_model=32, n_layers=1, n_heads=2,
+                        d_ff=48, max_seq=16, dtype=jnp.float32)
+        params = init_params(c, jax.random.key(0))
+        prompt = jnp.array([[1]], jnp.int32)
+        with pytest.raises(ValueError):
+            generate(params, prompt, c, max_new_tokens=2, top_k=0)
+        with pytest.raises(ValueError):
+            generate(params, prompt, c, max_new_tokens=2, top_p=0.0)

@@ -171,17 +171,51 @@ def decode_step(
     return logits, KVCache(k=new_k, v=new_v, length=pos + 1)
 
 
+def filter_top_k(logits: jax.Array, top_k: int) -> jax.Array:
+    """Keep the top_k logits per row, set the rest to -inf. Static k —
+    one lax.top_k + a threshold compare, no gather/scatter (TPU-friendly)."""
+    if top_k >= logits.shape[-1]:
+        return logits
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]  # per-row k-th largest
+    return jnp.where(logits >= kth, logits, -jnp.inf)
+
+
+def filter_top_p(logits: jax.Array, top_p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest prefix of the descending
+    softmax whose mass reaches top_p (always at least the argmax). Full
+    sort + cumsum over the vocab — dense fixed shapes, scan-safe."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Token i stays if the mass BEFORE it is < top_p (so the first token
+    # always survives and the nucleus includes the boundary token).
+    keep_sorted = (cum - probs) < top_p
+    # Map back to vocab order via the per-row logit threshold: the cut is
+    # the smallest kept logit.
+    cut = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= cut, logits, -jnp.inf)
+
+
 def generate(
     params: Dict,
     prompt: jax.Array,  # (B, S_prompt) int32
     config: AnyConfig,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     key: Optional[jax.Array] = None,
     max_seq: Optional[int] = None,
 ) -> jax.Array:
     """Greedy (temperature 0) or sampled generation, one jittable program:
-    prefill + lax.scan of decode steps. Returns (B, max_new_tokens)."""
+    prefill + lax.scan of decode steps. Returns (B, max_new_tokens).
+
+    Sampling controls compose the standard serving way: logits are
+    filtered by ``top_k`` then ``top_p`` (nucleus), then divided by
+    ``temperature`` and sampled; temperature 0 ignores both and is greedy
+    argmax."""
     c = config
     cap = max_seq or c.max_seq
     if prompt.shape[1] + max_new_tokens > cap:
@@ -190,6 +224,10 @@ def generate(
             f" exceeds the KV cache capacity ({cap}); decoding past it would"
             " silently clamp dynamic_update_slice and corrupt the cache"
         )
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if key is None:
         key = jax.random.key(0)
     logits, cache = prefill(params, prompt, c, max_seq=max_seq)
@@ -197,9 +235,19 @@ def generate(
     def pick(logits, k):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(k, logits / temperature).astype(jnp.int32)
+        # Temperature first, THEN the filters: the top-p nucleus must be
+        # chosen on the distribution actually being sampled (hotter =
+        # flatter = larger nucleus), matching standard serving stacks.
+        # top_k is rank-preserving so its position doesn't matter.
+        logits = logits / temperature
+        if top_k is not None:
+            logits = filter_top_k(logits, top_k)
+        if top_p is not None and top_p < 1.0:
+            logits = filter_top_p(logits, top_p)
+        return jax.random.categorical(k, logits).astype(jnp.int32)
 
-    first = pick(logits, key)
+    key, first_key = jax.random.split(key)  # use-once key discipline
+    first = pick(logits, first_key)
 
     def step(carry, k):
         cache, token = carry
