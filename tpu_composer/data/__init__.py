@@ -1,0 +1,5 @@
+"""Input pipeline: deterministic packed-LM batching + sharded device feed."""
+
+from tpu_composer.data.pipeline import PackedLMDataset, ShardedLoader
+
+__all__ = ["PackedLMDataset", "ShardedLoader"]

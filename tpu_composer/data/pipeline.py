@@ -1,0 +1,201 @@
+"""Input pipeline — deterministic packed-LM batching, sharded device feed.
+
+TPU-first by construction:
+
+- **Static shapes**: documents are packed into fixed (batch, seq) token
+  blocks (loss_fn shifts inputs/targets internally), so every training
+  step compiles once; no ragged batches, no padding-ratio drift.
+- **Deterministic & resumable**: the whole stream is a pure function of
+  (seed, epoch, step) — `state_dict()`/`load_state_dict()` restore the
+  exact stream position, matching the checkpoint/resume story of the rest
+  of the framework (parallel/checkpoint.py). A restored run consumes the
+  same batches the uninterrupted run would have.
+- **Sharded host->device feed**: batches land directly in the train step's
+  batch sharding (dp/ep over batch rows) via `jax.device_put`, and a
+  one-deep prefetch thread overlaps the next batch's host work and
+  transfer with the current step's compute — the standard TPU input
+  recipe (device_put is async; the thread only pays host-side cost).
+
+The reference has no data layer at all (SURVEY.md §2: no ML-framework
+code); this is first-class here because composed slices exist to train on
+something.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class PackedLMDataset:
+    """Pack variable-length token documents into fixed-size LM blocks.
+
+    Documents are concatenated in a seeded per-epoch order, separated by
+    ``eos_id``, and sliced into ``seq_len``-token blocks, matching the
+    train step's convention (loss_fn shifts inputs/targets internally, so
+    batches are plain (B, S) and S keeps its sp/block divisibility). The
+    tail that doesn't fill a block is dropped (standard practice; at most
+    seq_len - 1 tokens per epoch).
+
+    Packing (vs. one-doc-per-row + padding) keeps every MXU cycle on real
+    tokens — padding ratios of 30-60% are typical for padded batching on
+    natural document-length distributions.
+    """
+
+    def __init__(
+        self,
+        documents: Sequence[Sequence[int]],
+        seq_len: int,
+        eos_id: int = 0,
+        seed: int = 0,
+    ):
+        if seq_len < 1:
+            raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+        if not documents:
+            raise ValueError("documents must be non-empty")
+        self._docs: List[np.ndarray] = [
+            np.asarray(d, dtype=np.int32) for d in documents
+        ]
+        self.seq_len = seq_len
+        self.eos_id = eos_id
+        self.seed = seed
+        self.blocks_per_epoch = (
+            sum(len(d) + 1 for d in self._docs) // seq_len
+        )
+
+    def epoch_blocks(self, epoch: int) -> np.ndarray:
+        """All (n_blocks, seq_len) blocks of one epoch, deterministically
+        shuffled by (seed, epoch). Pure function — the resume anchor."""
+        order = np.random.default_rng(
+            np.random.SeedSequence([self.seed, epoch])
+        ).permutation(len(self._docs))
+        stream: List[np.ndarray] = []
+        eos = np.array([self.eos_id], np.int32)
+        for di in order:
+            stream.append(self._docs[di])
+            stream.append(eos)
+        tokens = np.concatenate(stream)
+        block = self.seq_len
+        n_blocks = len(tokens) // block
+        if n_blocks == 0:
+            raise ValueError(
+                f"epoch holds {len(tokens)} tokens < one block ({block})"
+            )
+        return tokens[: n_blocks * block].reshape(n_blocks, block)
+
+
+class ShardedLoader:
+    """Iterate (global_batch, seq_len) int32 batches placed in a given
+    sharding, with one-deep background prefetch.
+
+    The stream is a pure function of one integer — the global batch step:
+    every epoch packs the same token count, so the per-epoch batch count
+    is constant and ``batch(step)`` resolves to
+    ``epoch_blocks(step // bpe)[(step % bpe) * B : ...]`` directly. Resume
+    is therefore exact by construction: ``state_dict()`` is just
+    ``{"step": n}`` and a restored loader yields the same batches the
+    uninterrupted run would have. Blocks beyond the last full batch of an
+    epoch are dropped (< global_batch blocks per epoch, the same class of
+    loss as the dataset's own tail rule).
+    """
+
+    def __init__(
+        self,
+        dataset: PackedLMDataset,
+        global_batch: int,
+        sharding=None,
+        prefetch: bool = True,
+    ):
+        if global_batch < 1:
+            raise ValueError(f"global_batch must be >= 1, got {global_batch}")
+        self.dataset = dataset
+        self.global_batch = global_batch
+        self.sharding = sharding
+        self.prefetch = prefetch
+        self._step = 0
+        # Every epoch packs the same token count (shuffle permutes docs),
+        # so the block count is pure arithmetic — don't pack a throwaway
+        # epoch just to measure it.
+        n_blocks = dataset.blocks_per_epoch
+        self.batches_per_epoch = n_blocks // global_batch
+        if self.batches_per_epoch == 0:
+            raise ValueError(
+                f"epoch has {n_blocks} blocks < global_batch {global_batch}"
+            )
+
+    # -- resume ------------------------------------------------------------
+    def state_dict(self) -> Dict[str, int]:
+        return {"step": self._step}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        self._step = int(state["step"])
+
+    # -- iteration ---------------------------------------------------------
+    def _host_batches(self, start_step: int) -> Iterator[tuple]:
+        """Yield (step, batch) from start_step on. Tracks its own cursor so
+        a prefetching worker can run ahead of the consumer; the consumer
+        commits self._step only for batches it actually yielded (state
+        must not count prefetched-but-unconsumed work)."""
+        step = start_step
+        blocks = None
+        blocks_epoch = -1
+        while True:
+            epoch, offset = divmod(step, self.batches_per_epoch)
+            if epoch != blocks_epoch:
+                blocks = self.dataset.epoch_blocks(epoch)
+                blocks_epoch = epoch
+            start = offset * self.global_batch
+            yield step, blocks[start: start + self.global_batch]
+            step += 1
+
+    def _place(self, batch: np.ndarray):
+        if self.sharding is None:
+            return jax.numpy.asarray(batch)
+        return jax.device_put(batch, self.sharding)
+
+    def __iter__(self):
+        host = self._host_batches(self._step)
+        if not self.prefetch:
+            for s, b in host:
+                out = self._place(b)
+                self._step = s + 1
+                yield out
+            return
+        # One-deep prefetch: the worker stays a single batch ahead, so at
+        # most one batch of host memory + one in-flight transfer
+        # (device_put is async — the worker only pays host-side cost). The
+        # sentinel/shutdown path keeps the thread from outliving the
+        # iterator.
+        q: "queue.Queue" = queue.Queue(maxsize=1)
+        stop = threading.Event()
+
+        def worker():
+            try:
+                for s, b in host:
+                    if stop.is_set():
+                        return
+                    q.put((s, self._place(b)))
+            except Exception as e:  # surface errors at the consumer
+                q.put(e)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if isinstance(item, Exception):
+                    raise item
+                s, out = item
+                self._step = s + 1
+                yield out
+        finally:
+            stop.set()
+            # Unblock a worker waiting on the full queue.
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass

@@ -212,10 +212,11 @@ def generate(
     """Greedy (temperature 0) or sampled generation, one jittable program:
     prefill + lax.scan of decode steps. Returns (B, max_new_tokens).
 
-    Sampling controls compose the standard serving way: logits are
-    filtered by ``top_k`` then ``top_p`` (nucleus), then divided by
-    ``temperature`` and sampled; temperature 0 ignores both and is greedy
-    argmax."""
+    Sampling controls compose the standard serving way: logits are divided
+    by ``temperature`` first (the nucleus must be chosen on the
+    distribution actually sampled), then filtered by ``top_k`` and
+    ``top_p`` (nucleus), then sampled; temperature 0 ignores both and is
+    greedy argmax."""
     c = config
     cap = max_seq or c.max_seq
     if prompt.shape[1] + max_new_tokens > cap:

@@ -1,0 +1,132 @@
+"""Training loop — loader + sharded train step + checkpoint/resume, tied
+into one resumable `fit` call.
+
+The user-facing top of the workload layer: everything below it already
+exists as composable pieces (data/pipeline.py feeds, parallel/train.py
+steps, parallel/checkpoint.py persists); this loop owns the glue rules a
+correct resumable run needs:
+
+- **One source of truth for progress**: the checkpointed step. On resume,
+  the loader is fast-forwarded to exactly that step (the data stream is a
+  pure function of the step — data/pipeline.py), so the restored run
+  consumes the same batches the uninterrupted run would have. Losses are
+  bit-comparable across a kill/restart (test-pinned).
+- **Async-friendly cadence**: metrics are pulled to host only every
+  ``log_every`` steps and checkpoints written every ``checkpoint_every``;
+  between those, steps stay fully async on device (JAX dispatch pipelining
+  — a per-step float(loss) would serialize every step on the tunnel).
+
+The reference's analog is CRDs-as-checkpoint for the control plane
+(SURVEY.md §5); the workload side has no analog there — first-class here.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+from jax.sharding import Mesh
+
+from tpu_composer.data.pipeline import PackedLMDataset, ShardedLoader
+from tpu_composer.parallel import checkpoint as ckpt
+from tpu_composer.parallel.train import (
+    TrainConfig,
+    make_train_state,
+    make_train_step,
+)
+
+log = logging.getLogger("tpu_composer.trainer")
+
+
+@dataclass
+class FitResult:
+    state: Dict[str, Any]
+    step: int
+    history: List[Dict[str, float]] = field(default_factory=list)
+    resumed_from: Optional[int] = None
+
+
+def fit(
+    tc: TrainConfig,
+    mesh: Mesh,
+    dataset: PackedLMDataset,
+    total_steps: int,
+    global_batch: int,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 0,
+    log_every: int = 10,
+    seed: int = 0,
+) -> FitResult:
+    """Train for ``total_steps`` optimizer steps, resuming from the newest
+    complete checkpoint under ``checkpoint_dir`` when one exists.
+
+    Returns the final state, the step reached, and the logged metric
+    history (step, loss, grad_norm, steps_per_s at each log point).
+    """
+    if checkpoint_every and not checkpoint_dir:
+        raise ValueError("checkpoint_every needs checkpoint_dir")
+    step_fn, batch_sharding = make_train_step(tc, mesh)
+    loader = ShardedLoader(dataset, global_batch, sharding=batch_sharding)
+
+    start_step = 0
+    resumed_from: Optional[int] = None
+    if checkpoint_dir and (latest := ckpt.latest_step(checkpoint_dir)) is not None:
+        restored = ckpt.restore(checkpoint_dir, tc, mesh, step=latest)
+        state = restored["state"]
+        start_step = int(restored["step"])
+        resumed_from = start_step
+        log.info("resumed from %s at step %d", checkpoint_dir, start_step)
+    else:
+        state = make_train_state(tc, jax.random.key(seed), mesh)
+    loader.load_state_dict({"step": start_step})
+
+    history: List[Dict[str, float]] = []
+    step = start_step
+    # A checkpoint already exists at the resume step — the trailing save
+    # must not re-write it (orbax refuses to overwrite a finalized dir).
+    last_saved = start_step if resumed_from is not None else -1
+    t_mark = time.perf_counter()
+    step_mark = step
+    metrics = None
+    batches = iter(loader)
+    while step < total_steps:
+        # Pull only when a step will actually run: the for-in shape would
+        # pack (and with prefetch, device_put) one batch past the end.
+        batch = next(batches)
+        state, metrics = step_fn(state, batch)
+        step += 1
+        if log_every and (step % log_every == 0 or step == total_steps):
+            # The only host sync point: pull the latest metrics once.
+            m = jax.device_get(metrics)
+            now = time.perf_counter()
+            rec = {
+                "step": float(step),
+                "loss": float(m["loss"]),
+                "grad_norm": float(m["grad_norm"]),
+                "steps_per_s": (step - step_mark) / max(now - t_mark, 1e-9),
+            }
+            history.append(rec)
+            log.info(
+                "step %d loss %.4f grad_norm %.3f %.2f steps/s",
+                step, rec["loss"], rec["grad_norm"], rec["steps_per_s"],
+            )
+            t_mark, step_mark = now, step
+        if checkpoint_every and step % checkpoint_every == 0:
+            ckpt.save(checkpoint_dir, state, step=step)
+            last_saved = step
+    if checkpoint_every and step > last_saved and step > 0:
+        ckpt.save(checkpoint_dir, state, step=step)
+    if metrics is not None and not history:
+        m = jax.device_get(metrics)
+        history.append({
+            "step": float(step),
+            "loss": float(m["loss"]),
+            "grad_norm": float(m["grad_norm"]),
+            "steps_per_s": 0.0,
+        })
+    return FitResult(
+        state=state, step=step, history=history, resumed_from=resumed_from
+    )
