@@ -113,3 +113,34 @@ class TestTrainStepCompilesForV5eSlice:
         tc = TrainConfig(model=ModelConfig(max_seq=64, **_COMMON),
                          sp_impl="ulysses")
         _aot_compile(tc, axes, seq=64)
+
+
+class TestHBMFitGate:
+    def test_qualify_large_fits_single_v5e_chip(self, monkeypatch):
+        """The bench's MXU-sized qualify config (probe.py qualify_large:
+        d_model 2048, ffn 8192, seq 2048, batch 8, bf16, flash) must fit a
+        single v5e chip's 16 GB HBM — asserted from the compiled program's
+        memory analysis, so an OOM regression is caught at compile time in
+        CI instead of as a dead bench stage on the one day the chip is
+        reachable."""
+        monkeypatch.setenv("TPUC_FLASH_INTERPRET", "0")
+        axes = solve_mesh_axes(1)
+        mesh = _mesh(axes)
+        big = ModelConfig(vocab_size=32768, d_model=2048, n_layers=4,
+                          n_heads=16, d_ff=8192, max_seq=2048,
+                          dtype=jnp.bfloat16, attn_impl="flash")
+        tc = TrainConfig(model=big)
+        state = abstract_train_state(tc, mesh)
+        step_fn, batch_sharding = make_train_step(tc, mesh)
+        tokens = jax.ShapeDtypeStruct((8, 2048), jnp.int32,
+                                      sharding=batch_sharding)
+        compiled = step_fn.lower(state, tokens).compile()
+        ma = compiled.memory_analysis()
+        peak = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+                + ma.generated_code_size_in_bytes
+                + ma.output_size_in_bytes - ma.alias_size_in_bytes)
+        v5e_hbm = 16 * 1024**3
+        assert peak < 0.9 * v5e_hbm, (
+            f"qualify_large peak {peak/2**30:.2f} GiB exceeds 90% of v5e"
+            f" HBM ({v5e_hbm/2**30:.0f} GiB)"
+        )

@@ -473,6 +473,32 @@ out["train_step_v5e_2x4"] = {
     "ok": True, "seconds": round(time.time() - t0, 2),
     "mesh": dict(axes), "sp_impl": "zigzag",
 }
+
+# HBM-fit check for the bench's MXU-sized qualify config: the compiled
+# program's own memory accounting vs a v5e chip's 16 GB, so the bench
+# cannot OOM-surprise on the one day the chip is reachable.
+t0 = time.time()
+os.environ["TPUC_FLASH_INTERPRET"] = "0"
+axes1 = solve_mesh_axes(1)
+mesh1 = Mesh(np.array(devs[:1]).reshape([axes1[a] for a in axes1]),
+             tuple(axes1))
+big = ModelConfig(vocab_size=32768, d_model=2048, n_layers=4, n_heads=16,
+                  d_ff=8192, max_seq=2048, dtype=jnp.bfloat16,
+                  attn_impl="flash")
+tc1 = TrainConfig(model=big)
+state1 = abstract_train_state(tc1, mesh1)
+step1, bs1 = make_train_step(tc1, mesh1)
+toks1 = jax.ShapeDtypeStruct((8, 2048), jnp.int32, sharding=bs1)
+ma = step1.lower(state1, toks1).compile().memory_analysis()
+peak = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+        + ma.generated_code_size_in_bytes
+        + ma.output_size_in_bytes - ma.alias_size_in_bytes)
+out["qualify_large_hbm"] = {
+    "ok": peak < 0.9 * 16 * 1024**3,
+    "peak_gib": round(peak / 2**30, 2),
+    "hbm_gib": 16,
+    "seconds": round(time.time() - t0, 2),
+}
 print("AOT_RESULT " + json.dumps(out), flush=True)
 """
 
