@@ -48,6 +48,12 @@ def main() -> None:
     mesh = Mesh(
         np.array(devices).reshape([axes[a] for a in axes]), tuple(axes)
     )
+    # The data-parallel axis shards the batch: round the requested batch up
+    # to a multiple of dp so the run works at any device count.
+    dp = axes.get("dp", 1)
+    if args.global_batch % dp:
+        args.global_batch = ((args.global_batch + dp - 1) // dp) * dp
+        print(f"global batch rounded up to {args.global_batch} (dp={dp})")
     print(f"mesh: {dict(axes)} on {devices[0].device_kind}")
 
     # Synthetic corpus: Zipf-ish random documents. Swap in real tokenized

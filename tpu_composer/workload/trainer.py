@@ -69,6 +69,19 @@ def fit(
     if checkpoint_every and not checkpoint_dir:
         raise ValueError("checkpoint_every needs checkpoint_dir")
     step_fn, batch_sharding = make_train_step(tc, mesh)
+    # Fail with arithmetic, not a deep device_put error: the batch axis is
+    # laid over the data axes of the mesh, so their product must divide it.
+    spec0 = batch_sharding.spec[0]
+    names = spec0 if isinstance(spec0, tuple) else (spec0,)
+    data_div = 1
+    for name in names:
+        if name is not None:
+            data_div *= mesh.shape[name]
+    if global_batch % data_div:
+        raise ValueError(
+            f"global_batch {global_batch} must be divisible by the mesh's"
+            f" data-axis product {data_div} ({'x'.join(str(n) for n in names)})"
+        )
     loader = ShardedLoader(dataset, global_batch, sharding=batch_sharding)
 
     start_step = 0
