@@ -229,3 +229,91 @@ class TestSamplingFilters:
             generate(params, prompt, c, max_new_tokens=2, top_k=0)
         with pytest.raises(ValueError):
             generate(params, prompt, c, max_new_tokens=2, top_p=0.0)
+
+
+class TestInt8KVCache:
+    """int8 quantized KV cache: ~2x off the decode bandwidth bound on top
+    of GQA; per-(position, head) symmetric scales folded into scores and
+    probabilities (models/decode.py)."""
+
+    def _cfg(self, **kw):
+        import jax.numpy as jnp
+
+        from tpu_composer.models.transformer import ModelConfig
+
+        base = dict(vocab_size=128, d_model=128, n_layers=2, n_heads=8,
+                    n_kv_heads=2, d_ff=192, max_seq=64, dtype=jnp.float32)
+        base.update(kw)
+        return ModelConfig(**base)
+
+    def test_quantize_roundtrip_error_bounded(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_composer.models.decode import quantize_kv
+
+        x = jax.random.normal(jax.random.key(0), (4, 16, 2, 64))
+        q, scale = quantize_kv(x)
+        assert q.dtype == jnp.int8
+        deq = q.astype(jnp.float32) * scale[..., None]
+        rel = float(jnp.max(jnp.abs(deq - x)) / jnp.max(jnp.abs(x)))
+        assert rel < 1.0 / 100  # 8-bit symmetric: ~1/254 of the row max
+
+    def test_cache_dtype_and_scales(self):
+        import jax.numpy as jnp
+
+        from tpu_composer.models.decode import init_kv_cache
+
+        c = self._cfg()
+        cache = init_kv_cache(c, batch=2, max_seq=32, quant=True)
+        assert cache.k.dtype == jnp.int8 and cache.v.dtype == jnp.int8
+        assert cache.quantized
+        assert cache.k_scale.shape == (c.n_layers, 2, 32, c.kv_heads)
+
+    def test_quantized_decode_tracks_fp_decode(self):
+        """int8-cache decode must closely track fp decode: near-identical
+        next-token logits after one cached step, and a high greedy
+        argmax-agreement rate over a longer roll (exact token equality
+        would be brittle to backend accumulation-order changes near
+        argmax ties)."""
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_composer.models.decode import decode_step, generate, prefill
+        from tpu_composer.models.transformer import init_params
+
+        c = self._cfg()
+        params = init_params(c, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, c.vocab_size)
+
+        # One decode step: logits from the two caches differ only by the
+        # int8 cache noise (~0.4% of attention outputs).
+        lf, cf = prefill(params, prompt, c, max_seq=32)
+        lq, cq = prefill(params, prompt, c, max_seq=32, quant=True)
+        tok = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+        sf, _ = decode_step(params, cf, tok, c)
+        sq, _ = decode_step(params, cq, tok, c)
+        rel = float(jnp.max(jnp.abs(sf - sq)) / jnp.max(jnp.abs(sf)))
+        assert rel < 0.05, rel
+
+        fp = generate(params, prompt, c, max_new_tokens=12, max_seq=32)
+        q8 = generate(params, prompt, c, max_new_tokens=12, max_seq=32,
+                      kv_quant=True)
+        agree = float(jnp.mean(fp == q8))
+        assert agree >= 0.75, f"argmax agreement {agree}"
+
+    def test_quantized_prefill_logits_close(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_composer.models.decode import prefill
+        from tpu_composer.models.transformer import init_params
+
+        c = self._cfg()
+        params = init_params(c, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(1), (1, 12), 0, c.vocab_size)
+        lf, _ = prefill(params, prompt, c, max_seq=16)
+        lq, cache = prefill(params, prompt, c, max_seq=16, quant=True)
+        # Prefill logits are computed BEFORE the cache quantization — equal.
+        assert float(jnp.abs(lf - lq).max()) == 0.0
+        assert cache.quantized

@@ -54,22 +54,71 @@ def _ffn_delta(h, layer, layer_idx: int, c: AnyConfig):
 class KVCache(NamedTuple):
     """Per-layer stacked K/V: (n_layers, B, max_seq, KV, Dh). With grouped
     query heads KV < H this is the point of GQA — the cache (decode's HBM
-    bandwidth bound) shrinks by the group factor."""
+    bandwidth bound) shrinks by the group factor.
+
+    ``quant=True`` stores K/V as int8 with per-(position, head) fp32
+    scales (``k_scale``/``v_scale``, (L, B, S, KV)): another ~2x off the
+    cache bytes on top of GQA. Scales add 1/(2*Dh) overhead. The einsums
+    read int8 straight from HBM and upconvert in-register; the scale
+    multiplies fold into scores (k side) and probabilities (v side)."""
 
     k: jax.Array
     v: jax.Array
     # Number of valid positions per sequence (B,) — decode appends here.
     length: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
-def init_kv_cache(config: AnyConfig, batch: int, max_seq: Optional[int] = None) -> KVCache:
+def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-(position, head) int8 quantization over the Dh axis.
+    x: (..., Dh) -> (int8 values, fp32 scale (...,))."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def _append_quantized(vals, scales, layer_idx: int, new, pos):
+    """Quantize ``new`` and write values + scales into layer ``layer_idx``
+    of the stacked caches at position ``pos`` — the single spelling of the
+    paired 4-index value / 3-index scale update (k and v, prefill and
+    decode_step all go through here, so they cannot drift)."""
+    q, sc = quantize_kv(new)
+    layer_vals = jax.lax.dynamic_update_slice(vals[layer_idx], q,
+                                              (0, pos, 0, 0))
+    layer_scales = jax.lax.dynamic_update_slice(scales[layer_idx], sc,
+                                                (0, pos, 0))
+    return (vals.at[layer_idx].set(layer_vals),
+            scales.at[layer_idx].set(layer_scales),
+            layer_vals, layer_scales)
+
+
+def init_kv_cache(
+    config: AnyConfig,
+    batch: int,
+    max_seq: Optional[int] = None,
+    quant: bool = False,
+) -> KVCache:
     c = config
     s = max_seq or c.max_seq
     shape = (c.n_layers, batch, s, c.kv_heads, c.head_dim)
+    if not quant:
+        return KVCache(
+            k=jnp.zeros(shape, c.dtype),
+            v=jnp.zeros(shape, c.dtype),
+            length=jnp.zeros((batch,), jnp.int32),
+        )
     return KVCache(
-        k=jnp.zeros(shape, c.dtype),
-        v=jnp.zeros(shape, c.dtype),
+        k=jnp.zeros(shape, jnp.int8),
+        v=jnp.zeros(shape, jnp.int8),
         length=jnp.zeros((batch,), jnp.int32),
+        k_scale=jnp.zeros(shape[:-1], jnp.float32),
+        v_scale=jnp.zeros(shape[:-1], jnp.float32),
     )
 
 
@@ -81,38 +130,55 @@ def _project_qkv(layer: Dict, x, positions, c):
     return q, k, v
 
 
-def _cached_attention(q, k_cache, v_cache, valid_len, c):
+def _cached_attention(q, k_cache, v_cache, valid_len, c,
+                      k_scale=None, v_scale=None):
     """One query block against the cache. q: (B, Sq, H, Dh); cache:
     (B, S, KV, Dh); positions >= valid_len are masked out. Query heads are
-    viewed as (KV, group) so grouped caches are read once, not repeated."""
+    viewed as (KV, group) so grouped caches are read once, not repeated.
+
+    With an int8 cache (``k_scale``/``v_scale`` given, (B, S, KV)), the
+    dequant scales never touch the (S, Dh)-sized tensors: the k scale is a
+    per-(position, head) multiply on the scores, the v scale folds into
+    the probabilities — both on score-shaped arrays 1/Dh the size."""
     b, sq, h, dh = q.shape
     s, hk = k_cache.shape[1], k_cache.shape[2]
     qg = q.reshape(b, sq, hk, h // hk, dh)
     # Operands stay in the cache dtype (bf16 MXU rate; decode is KV-cache
-    # bandwidth bound anyway) with fp32 score accumulation.
+    # bandwidth bound anyway) with fp32 score accumulation. int8 caches
+    # upconvert in-register off the halved HBM read.
+    kc = k_cache if k_scale is None else k_cache.astype(c.dtype)
     scores = jnp.einsum(
-        "bqkgd,bskd->bkgqs", qg, k_cache, preferred_element_type=jnp.float32
+        "bqkgd,bskd->bkgqs", qg, kc, preferred_element_type=jnp.float32
     ) / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
+    if k_scale is not None:
+        scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
     k_pos = jnp.arange(s)[None, None, None, None, :]
     scores = jnp.where(
         k_pos < valid_len[:, None, None, None, None], scores, -1e30
     )
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgqs,bskd->bqkgd", probs.astype(c.dtype), v_cache)
+    if v_scale is not None:
+        probs = probs * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
+        vc = v_cache.astype(c.dtype)
+    else:
+        vc = v_cache
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs.astype(c.dtype), vc)
     return out.reshape(b, sq, h, dh)
 
 
 def prefill(
     params: Dict, tokens: jax.Array, config: AnyConfig,
     max_seq: Optional[int] = None,
+    quant: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     """Run the prompt (B, S_prompt), filling the cache. Returns the last
     position's logits (B, vocab) and the primed cache. The prompt pass uses
     ordinary causal attention (it IS the training forward), then the
-    computed K/V land in the cache for the decode loop."""
+    computed K/V land in the cache for the decode loop. ``quant=True``
+    stores the cache int8 (see KVCache)."""
     c = config
     b, s_p = tokens.shape
-    cache = init_kv_cache(c, b, max_seq)
+    cache = init_kv_cache(c, b, max_seq, quant=quant)
     positions = jnp.broadcast_to(jnp.arange(s_p, dtype=jnp.int32), (b, s_p))
     x = jnp.take(params["embed"], tokens, axis=0)
     ks, vs = [], []
@@ -131,10 +197,22 @@ def prefill(
 
     k_stack = jnp.stack(ks)  # (L, B, S_p, KV, Dh)
     v_stack = jnp.stack(vs)
+    length = jnp.full((b,), s_p, jnp.int32)
+    if not quant:
+        cache = KVCache(
+            k=jax.lax.dynamic_update_slice(cache.k, k_stack, (0, 0, 0, 0, 0)),
+            v=jax.lax.dynamic_update_slice(cache.v, v_stack, (0, 0, 0, 0, 0)),
+            length=length,
+        )
+        return logits, cache
+    kq, k_sc = quantize_kv(k_stack)
+    vq, v_sc = quantize_kv(v_stack)
     cache = KVCache(
-        k=jax.lax.dynamic_update_slice(cache.k, k_stack, (0, 0, 0, 0, 0)),
-        v=jax.lax.dynamic_update_slice(cache.v, v_stack, (0, 0, 0, 0, 0)),
-        length=jnp.full((b,), s_p, jnp.int32),
+        k=jax.lax.dynamic_update_slice(cache.k, kq, (0, 0, 0, 0, 0)),
+        v=jax.lax.dynamic_update_slice(cache.v, vq, (0, 0, 0, 0, 0)),
+        length=length,
+        k_scale=jax.lax.dynamic_update_slice(cache.k_scale, k_sc, (0, 0, 0, 0)),
+        v_scale=jax.lax.dynamic_update_slice(cache.v_scale, v_sc, (0, 0, 0, 0)),
     )
     return logits, cache
 
@@ -150,25 +228,37 @@ def decode_step(
     positions = pos[:, None]
     x = jnp.take(params["embed"], token[:, None], axis=0)  # (B, 1, D)
     new_k, new_v = cache.k, cache.v
+    new_ks, new_vs = cache.k_scale, cache.v_scale
     for li, layer in enumerate(params["layers"]):
         q, k, v = _project_qkv(layer, x, positions, c)
         # Append this token's K/V at position `pos` (uniform across batch:
         # scan-carried decode keeps lengths aligned).
-        k_cache = jax.lax.dynamic_update_slice(
-            new_k[li], k, (0, pos[0], 0, 0)
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            new_v[li], v, (0, pos[0], 0, 0)
-        )
-        new_k = new_k.at[li].set(k_cache)
-        new_v = new_v.at[li].set(v_cache)
-        o = _cached_attention(q, k_cache, v_cache, pos + 1, c)
+        if cache.quantized:
+            new_k, new_ks, k_cache, ks_cache = _append_quantized(
+                new_k, new_ks, li, k, pos[0]
+            )
+            new_v, new_vs, v_cache, vs_cache = _append_quantized(
+                new_v, new_vs, li, v, pos[0]
+            )
+        else:
+            ks_cache = vs_cache = None
+            k_cache = jax.lax.dynamic_update_slice(
+                new_k[li], k, (0, pos[0], 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                new_v[li], v, (0, pos[0], 0, 0)
+            )
+            new_k = new_k.at[li].set(k_cache)
+            new_v = new_v.at[li].set(v_cache)
+        o = _cached_attention(q, k_cache, v_cache, pos + 1, c,
+                              k_scale=ks_cache, v_scale=vs_cache)
         x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"])
         h = _rmsnorm(x, layer["ln2"])
         x = x + _ffn_delta(h, layer, li, c)
     x = _rmsnorm(x, params["ln_f"])
     logits = jnp.einsum("bd,vd->bv", x[:, 0], params["embed"]).astype(jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, length=pos + 1)
+    return logits, KVCache(k=new_k, v=new_v, length=pos + 1,
+                           k_scale=new_ks, v_scale=new_vs)
 
 
 def filter_top_k(logits: jax.Array, top_k: int) -> jax.Array:
@@ -208,6 +298,7 @@ def generate(
     top_p: Optional[float] = None,
     key: Optional[jax.Array] = None,
     max_seq: Optional[int] = None,
+    kv_quant: bool = False,
 ) -> jax.Array:
     """Greedy (temperature 0) or sampled generation, one jittable program:
     prefill + lax.scan of decode steps. Returns (B, max_new_tokens).
@@ -231,7 +322,8 @@ def generate(
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if key is None:
         key = jax.random.key(0)
-    logits, cache = prefill(params, prompt, c, max_seq=max_seq)
+    logits, cache = prefill(params, prompt, c, max_seq=max_seq,
+                            quant=kv_quant)
 
     def pick(logits, k):
         if temperature <= 0.0:
