@@ -1,0 +1,123 @@
+"""Weight-only int8 quantization for serving (models/quant.py): per-output-
+channel scales, transparent resolve() at every weight-use site, combined
+with the int8 KV cache for the full quantized-decode path."""
+
+import jax
+import jax.numpy as jnp
+
+from tpu_composer.models.decode import generate, prefill
+from tpu_composer.models.moe import MoEConfig
+from tpu_composer.models.moe import init_params as moe_init
+from tpu_composer.models.quant import (
+    QTensor,
+    embedding_lookup,
+    quantize_decode_params,
+    quantize_weight,
+    resolve,
+)
+from tpu_composer.models.transformer import (
+    ModelConfig,
+    forward,
+    init_params,
+)
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=128, d_model=128, n_layers=2, n_heads=8,
+                n_kv_heads=2, d_ff=192, max_seq=64, dtype=jnp.float32)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+class TestQuantizeWeight:
+    def test_roundtrip_error_bounded(self):
+        w = jax.random.normal(jax.random.key(0), (64, 3, 8, 16))
+        qt = quantize_weight(w, (0,))
+        assert qt.q.dtype == jnp.int8
+        assert qt.scale.shape == (1, 3, 8, 16)
+        deq = resolve(qt, jnp.float32)
+        # Per-channel symmetric int8: error <= scale/2 = absmax/254.
+        per_chan_max = jnp.max(jnp.abs(w), axis=0, keepdims=True)
+        assert bool((jnp.abs(deq - w) <= per_chan_max / 127.0).all())
+
+    def test_resolve_identity_for_arrays(self):
+        w = jnp.ones((4, 4), jnp.bfloat16)
+        assert resolve(w, jnp.bfloat16) is w
+
+    def test_embedding_lookup_quantized(self):
+        embed = jax.random.normal(jax.random.key(1), (50, 16))
+        qt = quantize_weight(embed, (1,))
+        toks = jnp.array([[3, 7], [11, 0]], jnp.int32)
+        out = embedding_lookup(qt, toks, jnp.float32)
+        ref = jnp.take(embed, toks, axis=0)
+        err = float(jnp.abs(out - ref).max())
+        assert err < float(jnp.abs(embed).max()) / 100
+
+
+class TestQuantizedDenseServing:
+    def test_tree_shape_and_dtypes(self):
+        c = _cfg()
+        params = init_params(c, jax.random.key(0))
+        qp = quantize_decode_params(params)
+        layer = qp["layers"][0]
+        assert isinstance(layer["wq"], QTensor)
+        assert isinstance(layer["wkv"], QTensor)
+        assert isinstance(layer["wo"], QTensor)
+        assert isinstance(qp["embed"], QTensor)
+        # Norms stay fp.
+        assert not isinstance(layer["ln1"], QTensor)
+        # int8 + scales is ~4x smaller than the fp32 original.
+        orig = params["layers"][0]["w_gate"].nbytes
+        quant = (layer["w_gate"].q.nbytes + layer["w_gate"].scale.nbytes)
+        assert quant < 0.3 * orig
+
+    def test_forward_logits_close(self):
+        c = _cfg()
+        params = init_params(c, jax.random.key(0))
+        qp = quantize_decode_params(params)
+        toks = jax.random.randint(jax.random.key(1), (2, 16), 0, c.vocab_size)
+        lf = forward(params, toks, c)
+        lq = forward(qp, toks, c)
+        denom = float(jnp.abs(lf).max())
+        assert float(jnp.abs(lf - lq).max()) / denom < 0.1
+
+    def test_fully_quantized_generate(self):
+        """Weights int8 AND the KV cache int8 — the full serving config."""
+        c = _cfg()
+        params = init_params(c, jax.random.key(0))
+        qp = quantize_decode_params(params)
+        prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, c.vocab_size)
+        fp = generate(params, prompt, c, max_new_tokens=10, max_seq=32)
+        q8 = generate(qp, prompt, c, max_new_tokens=10, max_seq=32,
+                      kv_quant=True)
+        assert q8.shape == fp.shape
+        agree = float(jnp.mean(fp == q8))
+        assert agree >= 0.6, f"greedy agreement {agree}"
+
+    def test_quantized_prefill_logits_close(self):
+        c = _cfg()
+        params = init_params(c, jax.random.key(0))
+        qp = quantize_decode_params(params)
+        prompt = jax.random.randint(jax.random.key(1), (1, 12), 0, c.vocab_size)
+        lf, _ = prefill(params, prompt, c, max_seq=16)
+        lq, _ = prefill(qp, prompt, c, max_seq=16)
+        denom = float(jnp.abs(lf).max())
+        assert float(jnp.abs(lf - lq).max()) / denom < 0.1
+
+
+class TestQuantizedMoEServing:
+    def test_moe_quantized_generate(self):
+        c = MoEConfig(vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=96, max_seq=32, dtype=jnp.float32,
+                      n_experts=2, top_k=1, capacity_factor=4.0, moe_period=2)
+        params = moe_init(c, jax.random.key(0))
+        qp = quantize_decode_params(params)
+        # Expert stacks quantize per-(expert, channel); router stays fp32.
+        moe_layer = qp["layers"][1]
+        assert isinstance(moe_layer["w_gate"], QTensor)
+        assert moe_layer["w_gate"].scale.shape[0] == c.n_experts
+        assert not isinstance(moe_layer["w_router"], QTensor)
+        prompt = jax.random.randint(jax.random.key(1), (1, 6), 0, c.vocab_size)
+        toks = generate(qp, prompt, c, max_new_tokens=4, max_seq=16,
+                        kv_quant=True)
+        assert toks.shape == (1, 4)

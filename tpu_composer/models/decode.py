@@ -24,6 +24,11 @@ from typing import Dict, NamedTuple, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from tpu_composer.models.quant import (
+    embedding_lookup,
+    quantize_weight,
+    resolve,
+)
 from tpu_composer.ops.attention import mha_reference
 from tpu_composer.models.moe import MoEConfig, ffn_delta
 from tpu_composer.models.transformer import (
@@ -76,11 +81,10 @@ class KVCache(NamedTuple):
 
 def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Symmetric per-(position, head) int8 quantization over the Dh axis.
-    x: (..., Dh) -> (int8 values, fp32 scale (...,))."""
-    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-    scale = jnp.maximum(absmax, 1e-8) / 127.0
-    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
-    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+    x: (..., Dh) -> (int8 values, fp32 scale (...,)). One routine shared
+    with weight quantization (models/quant.py) so the two cannot drift."""
+    qt = quantize_weight(x, (-1,))
+    return qt.q, qt.scale[..., 0]
 
 
 def _append_quantized(vals, scales, layer_idx: int, new, pos):
@@ -180,7 +184,7 @@ def prefill(
     b, s_p = tokens.shape
     cache = init_kv_cache(c, b, max_seq, quant=quant)
     positions = jnp.broadcast_to(jnp.arange(s_p, dtype=jnp.int32), (b, s_p))
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = embedding_lookup(params["embed"], tokens, c.dtype)
     ks, vs = [], []
     for li, layer in enumerate(params["layers"]):
         q, k, v = _project_qkv(layer, x, positions, c)
@@ -189,11 +193,12 @@ def prefill(
         # Causal self-attention within the prompt (no cache yet) — the
         # same reference attention forward() uses, not a re-derivation.
         o = mha_reference(q, k, v, causal=True).astype(c.dtype)
-        x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"])
+        x = x + jnp.einsum("bshk,hkd->bsd", o, resolve(layer["wo"], c.dtype))
         h = _rmsnorm(x, layer["ln2"])
         x = x + _ffn_delta(h, layer, li, c)
     x = _rmsnorm(x, params["ln_f"])
-    logits = jnp.einsum("bd,vd->bv", x[:, -1], params["embed"]).astype(jnp.float32)
+    logits = jnp.einsum("bd,vd->bv", x[:, -1],
+                        resolve(params["embed"], c.dtype)).astype(jnp.float32)
 
     k_stack = jnp.stack(ks)  # (L, B, S_p, KV, Dh)
     v_stack = jnp.stack(vs)
@@ -226,7 +231,7 @@ def decode_step(
     b = token.shape[0]
     pos = cache.length  # (B,) — uniform in practice (no ragged batches yet)
     positions = pos[:, None]
-    x = jnp.take(params["embed"], token[:, None], axis=0)  # (B, 1, D)
+    x = embedding_lookup(params["embed"], token[:, None], c.dtype)  # (B, 1, D)
     new_k, new_v = cache.k, cache.v
     new_ks, new_vs = cache.k_scale, cache.v_scale
     for li, layer in enumerate(params["layers"]):
@@ -252,11 +257,12 @@ def decode_step(
             new_v = new_v.at[li].set(v_cache)
         o = _cached_attention(q, k_cache, v_cache, pos + 1, c,
                               k_scale=ks_cache, v_scale=vs_cache)
-        x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"])
+        x = x + jnp.einsum("bshk,hkd->bsd", o, resolve(layer["wo"], c.dtype))
         h = _rmsnorm(x, layer["ln2"])
         x = x + _ffn_delta(h, layer, li, c)
     x = _rmsnorm(x, params["ln_f"])
-    logits = jnp.einsum("bd,vd->bv", x[:, 0], params["embed"]).astype(jnp.float32)
+    logits = jnp.einsum("bd,vd->bv", x[:, 0],
+                        resolve(params["embed"], c.dtype)).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v, length=pos + 1,
                            k_scale=new_ks, v_scale=new_vs)
 

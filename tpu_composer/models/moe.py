@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from tpu_composer.models.quant import embedding_lookup, resolve
 from tpu_composer.models.transformer import (
     AttnFn,
     ModelConfig,
@@ -233,10 +234,13 @@ def _moe_ffn(x: jax.Array, layer: Dict, config: MoEConfig) -> Tuple[jax.Array, j
     # expert all-to-all.
     xin = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(c.dtype), x)
     gate = jax.nn.silu(
-        jnp.einsum("ebcd,edf->ebcf", xin, layer["w_gate"]).astype(jnp.float32)
+        jnp.einsum("ebcd,edf->ebcf", xin,
+                   resolve(layer["w_gate"], c.dtype)).astype(jnp.float32)
     )
-    up = jnp.einsum("ebcd,edf->ebcf", xin, layer["w_up"]).astype(jnp.float32)
-    xout = jnp.einsum("ebcf,efd->ebcd", (gate * up).astype(c.dtype), layer["w_down"])
+    up = jnp.einsum("ebcd,edf->ebcf", xin,
+                    resolve(layer["w_up"], c.dtype)).astype(jnp.float32)
+    xout = jnp.einsum("ebcf,efd->ebcd", (gate * up).astype(c.dtype),
+                      resolve(layer["w_down"], c.dtype))
     out = jnp.einsum("bsec,ebcd->bsd", combine.astype(c.dtype), xout)
     return out, aux
 
@@ -263,7 +267,7 @@ def forward(
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
 
-    x = jnp.take(params["embed"], tokens, axis=0)
+    x = embedding_lookup(params["embed"], tokens, c.dtype)
     aux_total = jnp.zeros((), jnp.float32)
     for i, layer in enumerate(params["layers"]):
         x = attention_block(layer, x, positions, c, attn)
@@ -273,7 +277,8 @@ def forward(
         aux_total = aux_total + aux
 
     x = _rmsnorm(x, params["ln_f"])
-    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
+    logits = jnp.einsum("bsd,vd->bsv", x,
+                        resolve(params["embed"], c.dtype)).astype(jnp.float32)
     n_moe = sum(1 for i in range(c.n_layers) if c.is_moe_layer(i))
     return logits, aux_total / max(n_moe, 1)
 

@@ -1,0 +1,105 @@
+"""Weight-only int8 quantization for serving.
+
+Small-batch decode is weight-bandwidth-bound: every step streams every
+parameter once from HBM while doing almost no math on it. Storing weights
+as int8 with per-output-channel fp32 scales halves those bytes; the
+dequant (convert + one multiply) fuses into the consuming matmul, so the
+bf16 weight never round-trips HBM.
+
+Scheme: symmetric per-OUTPUT-channel — the scale covers every axis that
+survives the weight's contraction, so ``einsum(x, q) * scale`` is exactly
+``einsum(x, w_dequant)`` (the scale is constant over the contracted
+axes). Quantized leaves are :class:`QTensor` pytrees; every weight-use
+site goes through :func:`resolve`, which is the identity for plain
+arrays — the same model code serves fp training and int8 decode.
+
+No reference analog (the reference runs no models); standard TPU serving
+practice (weight-only int8 is the bandwidth half of quantization —
+activations stay bf16, so no calibration data is needed).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QTensor(NamedTuple):
+    """int8 values + fp32 scale broadcastable over the original shape."""
+
+    q: jax.Array
+    scale: jax.Array
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+
+def quantize_weight(w: jax.Array, contract_axes: Tuple[int, ...]) -> QTensor:
+    """Symmetric int8 over the contracted axes: scale has the weight's
+    shape with contracted axes reduced to 1 (kept for broadcast)."""
+    absmax = jnp.max(
+        jnp.abs(w.astype(jnp.float32)), axis=contract_axes, keepdims=True
+    )
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return QTensor(q=q.astype(jnp.int8), scale=scale)
+
+
+def resolve(w: Any, dtype) -> jax.Array:
+    """Materialize a weight for compute: dequantize QTensors (the convert
+    and multiply fuse into the consuming einsum), pass arrays through."""
+    if isinstance(w, QTensor):
+        return (w.q.astype(dtype) * w.scale.astype(dtype)).astype(dtype)
+    return w
+
+
+# Which axes each known weight contracts in its einsum (everything else is
+# an output channel and keeps its own scale). Covers both model families;
+# norms, router (deliberately fp32) and anything unlisted stay unquantized.
+_CONTRACT_AXES = {
+    "wqkv": (0,),      # bsd,dthk->tbshk
+    "wq": (0,),        # bsd,dhk->bshk
+    "wkv": (0,),       # bsd,dthk->tbshk
+    "wo": (0, 1),      # bshk,hkd->bsd
+    "embed": (1,),     # bsd,vd->bsv (and row-lookup, same per-row scale)
+}
+_DENSE_FFN = {"w_gate": (0,), "w_up": (0,), "w_down": (0,)}
+_MOE_FFN = {"w_gate": (1,), "w_up": (1,), "w_down": (1,)}  # ebcd,edf->ebcf
+
+
+def quantize_decode_params(params: Dict) -> Dict:
+    """Quantize a model pytree's matmul weights for decode. Works for both
+    the dense and MoE families (expert stacks get per-(expert, channel)
+    scales); layer norms and MoE routers stay fp."""
+
+    def q_layer(layer: Dict) -> Dict:
+        out = {}
+        for name, w in layer.items():
+            if name in _CONTRACT_AXES:
+                out[name] = quantize_weight(w, _CONTRACT_AXES[name])
+            elif name in _DENSE_FFN:
+                axes = _MOE_FFN[name] if w.ndim == 3 else _DENSE_FFN[name]
+                out[name] = quantize_weight(w, axes)
+            else:
+                out[name] = w
+        return out
+
+    return {
+        "embed": quantize_weight(params["embed"], _CONTRACT_AXES["embed"]),
+        "layers": [q_layer(layer) for layer in params["layers"]],
+        "ln_f": params["ln_f"],
+    }
+
+
+def embedding_lookup(embed: Any, tokens: jax.Array, dtype) -> jax.Array:
+    """Row lookup that keeps a quantized embedding quantized in HBM: take
+    the int8 rows and their per-row scales, multiply after the gather —
+    the full-vocab bf16 table is never materialized."""
+    if isinstance(embed, QTensor):
+        rows = jnp.take(embed.q, tokens, axis=0).astype(dtype)
+        scales = jnp.take(embed.scale[:, 0], tokens, axis=0).astype(dtype)
+        return rows * scales[..., None]
+    return jnp.take(embed, tokens, axis=0)

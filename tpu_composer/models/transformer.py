@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from tpu_composer.models.quant import embedding_lookup, resolve
 from tpu_composer.ops.attention import flash_attention, mha_reference
 
 
@@ -121,12 +122,13 @@ def param_specs(config: ModelConfig) -> Dict:
 
 def project_qkv(layer: Dict, h: jax.Array):
     """(B, S, D) normed activations -> q (B, S, H, hd), k/v (B, S, KV, hd),
-    handling both the fused-MHA and split-GQA parameter layouts."""
+    handling both the fused-MHA and split-GQA parameter layouts (weights
+    may be int8 QTensors — models/quant.py — resolved at use)."""
     if "wqkv" in layer:
-        qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"])
+        qkv = jnp.einsum("bsd,dthk->tbshk", h, resolve(layer["wqkv"], h.dtype))
         return qkv[0], qkv[1], qkv[2]
-    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
-    kv = jnp.einsum("bsd,dthk->tbshk", h, layer["wkv"])
+    q = jnp.einsum("bsd,dhk->bshk", h, resolve(layer["wq"], h.dtype))
+    kv = jnp.einsum("bsd,dthk->tbshk", h, resolve(layer["wkv"], h.dtype))
     return q, kv[0], kv[1]
 
 
@@ -176,14 +178,18 @@ def attention_block(
     q = _rope(q, positions, c.rope_theta)
     k = _rope(k, positions, c.rope_theta)
     o = attn(q, k, v, causal=True)
-    return x + jnp.einsum("bshk,hkd->bsd", o.astype(c.dtype), layer["wo"])
+    return x + jnp.einsum("bshk,hkd->bsd", o.astype(c.dtype),
+                          resolve(layer["wo"], c.dtype))
 
 
 def swiglu_ffn(h: jax.Array, layer: Dict, dtype) -> jax.Array:
     """Dense SwiGLU MLP (no residual): silu(h@w_gate) * (h@w_up) @ w_down."""
-    gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]).astype(jnp.float32))
-    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"]).astype(jnp.float32)
-    return jnp.einsum("bsf,fd->bsd", (gate * up).astype(dtype), layer["w_down"])
+    gate = jax.nn.silu(jnp.einsum(
+        "bsd,df->bsf", h, resolve(layer["w_gate"], dtype)).astype(jnp.float32))
+    up = jnp.einsum("bsd,df->bsf", h,
+                    resolve(layer["w_up"], dtype)).astype(jnp.float32)
+    return jnp.einsum("bsf,fd->bsd", (gate * up).astype(dtype),
+                      resolve(layer["w_down"], dtype))
 
 
 def block_forward(
@@ -214,13 +220,14 @@ def forward(
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
 
-    x = jnp.take(params["embed"], tokens, axis=0)  # (B, S, D)
+    x = embedding_lookup(params["embed"], tokens, c.dtype)  # (B, S, D)
     for layer in params["layers"]:
         x = block_forward(layer, x, positions, c, attn)
 
     x = _rmsnorm(x, params["ln_f"])
     # Tied output head (embed^T), fp32 logits for a stable softmax.
-    return jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
+    return jnp.einsum("bsd,vd->bsv", x,
+                      resolve(params["embed"], c.dtype)).astype(jnp.float32)
 
 
 def loss_fn(
