@@ -1,0 +1,138 @@
+"""Speculative decoding: greedy draft-and-verify must reproduce target
+greedy decoding EXACTLY, for any draft — that is the correctness contract
+that makes the speedup free."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_composer.models.decode import generate
+from tpu_composer.models.quant import quantize_decode_params
+from tpu_composer.models.speculative import speculative_generate
+from tpu_composer.models.transformer import ModelConfig, init_params
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=128, d_model=128, n_layers=2, n_heads=8,
+                n_kv_heads=2, d_ff=192, max_seq=96, dtype=jnp.float32)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+class TestSpeculativeExactness:
+    @pytest.mark.parametrize("gamma", [1, 3, 4])
+    def test_matches_target_greedy_with_weak_draft(self, gamma):
+        """Draft = a DIFFERENT (smaller) model: acceptance is imperfect,
+        output must still be byte-identical to target-only greedy."""
+        c = _cfg()
+        dc = _cfg(n_layers=1, d_ff=96)
+        params = init_params(c, jax.random.key(0))
+        draft = init_params(dc, jax.random.key(7))
+        prompt = jax.random.randint(jax.random.key(1), (1, 6), 0, c.vocab_size)
+        ref = generate(params, prompt, c, max_new_tokens=16, max_seq=96)
+        spec = speculative_generate(
+            params, draft, prompt, c, draft_config=dc,
+            max_new_tokens=16, gamma=gamma, max_seq=96,
+        )
+        assert spec.tolist() == ref.tolist()
+
+    def test_perfect_draft_accepts_everything(self):
+        """Draft == target: every round accepts all gamma drafts, so the
+        loop runs ~max_new/(gamma+1) verify rounds — and is still exact."""
+        c = _cfg()
+        params = init_params(c, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(1), (1, 4), 0, c.vocab_size)
+        ref = generate(params, prompt, c, max_new_tokens=12, max_seq=96)
+        spec = speculative_generate(
+            params, params, prompt, c, max_new_tokens=12, gamma=4, max_seq=96,
+        )
+        assert spec.tolist() == ref.tolist()
+
+    def test_quantized_draft(self):
+        """The natural free draft: the target's own int8-quantized weights.
+        Exactness still holds — the draft only proposes."""
+        c = _cfg()
+        params = init_params(c, jax.random.key(0))
+        draft = quantize_decode_params(params)
+        prompt = jax.random.randint(jax.random.key(1), (1, 5), 0, c.vocab_size)
+        ref = generate(params, prompt, c, max_new_tokens=12, max_seq=96)
+        spec = speculative_generate(
+            params, draft, prompt, c, max_new_tokens=12, gamma=3, max_seq=96,
+        )
+        assert spec.tolist() == ref.tolist()
+
+    def test_gqa_and_mqa_targets(self):
+        c = _cfg(n_kv_heads=1)
+        params = init_params(c, jax.random.key(2))
+        draft = init_params(_cfg(n_kv_heads=1, n_layers=1), jax.random.key(3))
+        prompt = jnp.array([[9, 4, 17]], jnp.int32)
+        ref = generate(params, prompt, c, max_new_tokens=10, max_seq=96)
+        spec = speculative_generate(
+            params, draft, prompt, c,
+            draft_config=_cfg(n_kv_heads=1, n_layers=1),
+            max_new_tokens=10, gamma=2, max_seq=96,
+        )
+        assert spec.tolist() == ref.tolist()
+
+    def test_rejects_batch_and_capacity_errors(self):
+        c = _cfg()
+        params = init_params(c, jax.random.key(0))
+        two = jnp.zeros((2, 4), jnp.int32)
+        with pytest.raises(ValueError):
+            speculative_generate(params, params, two, c, max_new_tokens=4)
+        long_prompt = jnp.zeros((1, 90), jnp.int32)
+        with pytest.raises(ValueError):
+            speculative_generate(params, params, long_prompt, c,
+                                 max_new_tokens=16, gamma=4, max_seq=96)
+
+
+class TestDecodeChunk:
+    def test_chunk_equals_stepwise(self):
+        """decode_chunk(T) must equal T successive decode_steps — same
+        logits, same cache contents (the verify step's correctness)."""
+        from tpu_composer.models.decode import decode_chunk, decode_step, prefill
+
+        c = _cfg()
+        params = init_params(c, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(1), (2, 5), 0, c.vocab_size)
+        toks = jax.random.randint(jax.random.key(2), (2, 3), 0, c.vocab_size)
+
+        _, cache_a = prefill(params, prompt, c, max_seq=32)
+        chunk_logits, cache_a = decode_chunk(params, cache_a, toks, c)
+
+        _, cache_b = prefill(params, prompt, c, max_seq=32)
+        step_logits = []
+        for i in range(3):
+            lg, cache_b = decode_step(params, cache_b, toks[:, i], c)
+            step_logits.append(lg)
+        for i in range(3):
+            assert float(jnp.abs(chunk_logits[:, i] - step_logits[i]).max()) < 2e-4
+        assert int(cache_a.length[0]) == int(cache_b.length[0])
+        assert float(jnp.abs(cache_a.k - cache_b.k).max()) < 1e-5
+
+    def test_rejects_moe_targets(self):
+        """MoE verify chunks change expert-capacity semantics (capacity(T)
+        vs never-dropping single steps) — gated until drop-free chunked
+        capacity exists, instead of silently breaking exactness."""
+        from tpu_composer.models.moe import MoEConfig
+        from tpu_composer.models.moe import init_params as moe_init
+
+        mc = MoEConfig(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+                       d_ff=96, max_seq=64, dtype=jnp.float32, n_experts=2,
+                       top_k=1, capacity_factor=2.0, moe_period=2)
+        mp = moe_init(mc, jax.random.key(0))
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError):
+            speculative_generate(mp, mp, prompt, mc, max_new_tokens=4)
+
+    def test_draft_max_seq_bounds_capacity(self):
+        """A draft whose max_seq is smaller than the target's must bound
+        the run (its cache would otherwise silently overflow)."""
+        c = _cfg(max_seq=256)
+        dc = _cfg(max_seq=32, n_layers=1)
+        params = init_params(c, jax.random.key(0))
+        draft = init_params(dc, jax.random.key(1))
+        prompt = jnp.zeros((1, 20), jnp.int32)
+        with pytest.raises(ValueError):
+            speculative_generate(params, draft, prompt, c, draft_config=dc,
+                                 max_new_tokens=16, gamma=4)

@@ -135,10 +135,15 @@ def _project_qkv(layer: Dict, x, positions, c):
 
 
 def _cached_attention(q, k_cache, v_cache, valid_len, c,
-                      k_scale=None, v_scale=None):
+                      k_scale=None, v_scale=None, q_positions=None):
     """One query block against the cache. q: (B, Sq, H, Dh); cache:
     (B, S, KV, Dh); positions >= valid_len are masked out. Query heads are
     viewed as (KV, group) so grouped caches are read once, not repeated.
+
+    ``q_positions`` (B, Sq) switches to per-query causal limits — query i
+    sees cache positions <= q_positions[i] — which is what a multi-token
+    chunk needs (each chunk token attends the cache plus its own prefix of
+    the chunk). Without it every query sees [0, valid_len).
 
     With an int8 cache (``k_scale``/``v_scale`` given, (B, S, KV)), the
     dequant scales never touch the (S, Dh)-sized tensors: the k scale is a
@@ -157,9 +162,11 @@ def _cached_attention(q, k_cache, v_cache, valid_len, c,
     if k_scale is not None:
         scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
     k_pos = jnp.arange(s)[None, None, None, None, :]
-    scores = jnp.where(
-        k_pos < valid_len[:, None, None, None, None], scores, -1e30
-    )
+    if q_positions is None:
+        keep = k_pos < valid_len[:, None, None, None, None]
+    else:
+        keep = k_pos <= q_positions[:, None, None, :, None]
+    scores = jnp.where(keep, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     if v_scale is not None:
         probs = probs * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
@@ -222,21 +229,31 @@ def prefill(
     return logits, cache
 
 
-def decode_step(
-    params: Dict, cache: KVCache, token: jax.Array, config: AnyConfig
+def decode_chunk(
+    params: Dict, cache: KVCache, tokens: jax.Array, config: AnyConfig
 ) -> Tuple[jax.Array, KVCache]:
-    """One token (B,) in, next-token logits (B, vocab) out, cache advanced.
-    Static shapes: the cache is full-length; masking handles validity."""
+    """T tokens (B, T) in, per-position next-token logits (B, T, vocab)
+    out, cache advanced by T. Token i attends the cache plus chunk tokens
+    0..i (per-query causal limits). This is single-step decoding at T=1
+    and the verify step of speculative decoding (and chunked prefill) at
+    T>1. Static shapes: the cache is full-length; masking handles
+    validity.
+
+    MoE caveat: a T>1 chunk routes its tokens as one group with
+    capacity(T) — matching the training forward's semantics, NOT T
+    single-token steps (which never drop; see the capacity note at the
+    top of this module). Exactness-sensitive callers (speculative
+    verify) must use dense models or drop-free capacity."""
     c = config
-    b = token.shape[0]
+    b, t = tokens.shape
     pos = cache.length  # (B,) — uniform in practice (no ragged batches yet)
-    positions = pos[:, None]
-    x = embedding_lookup(params["embed"], token[:, None], c.dtype)  # (B, 1, D)
+    positions = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    x = embedding_lookup(params["embed"], tokens, c.dtype)  # (B, T, D)
     new_k, new_v = cache.k, cache.v
     new_ks, new_vs = cache.k_scale, cache.v_scale
     for li, layer in enumerate(params["layers"]):
         q, k, v = _project_qkv(layer, x, positions, c)
-        # Append this token's K/V at position `pos` (uniform across batch:
+        # Append this chunk's K/V at `pos` (uniform across batch:
         # scan-carried decode keeps lengths aligned).
         if cache.quantized:
             new_k, new_ks, k_cache, ks_cache = _append_quantized(
@@ -255,16 +272,26 @@ def decode_step(
             )
             new_k = new_k.at[li].set(k_cache)
             new_v = new_v.at[li].set(v_cache)
-        o = _cached_attention(q, k_cache, v_cache, pos + 1, c,
-                              k_scale=ks_cache, v_scale=vs_cache)
+        o = _cached_attention(q, k_cache, v_cache, pos + t, c,
+                              k_scale=ks_cache, v_scale=vs_cache,
+                              q_positions=positions)
         x = x + jnp.einsum("bshk,hkd->bsd", o, resolve(layer["wo"], c.dtype))
         h = _rmsnorm(x, layer["ln2"])
         x = x + _ffn_delta(h, layer, li, c)
     x = _rmsnorm(x, params["ln_f"])
-    logits = jnp.einsum("bd,vd->bv", x[:, 0],
+    logits = jnp.einsum("bsd,vd->bsv", x,
                         resolve(params["embed"], c.dtype)).astype(jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, length=pos + 1,
+    return logits, KVCache(k=new_k, v=new_v, length=pos + t,
                            k_scale=new_ks, v_scale=new_vs)
+
+
+def decode_step(
+    params: Dict, cache: KVCache, token: jax.Array, config: AnyConfig
+) -> Tuple[jax.Array, KVCache]:
+    """One token (B,) in, next-token logits (B, vocab) out, cache advanced.
+    The T=1 specialization of decode_chunk."""
+    logits, cache = decode_chunk(params, cache, token[:, None], config)
+    return logits[:, 0], cache
 
 
 def filter_top_k(logits: jax.Array, top_k: int) -> jax.Array:

@@ -1,0 +1,161 @@
+"""Speculative decoding — draft-and-verify generation.
+
+A small draft model proposes ``gamma`` tokens autoregressively; the target
+model scores all of them in ONE chunked forward (``decode_chunk`` with
+per-query causal limits) and accepts the longest agreeing prefix plus one
+bonus token from its own distribution. Greedy verification reproduces the
+target's greedy decode EXACTLY (test-pinned) while running the big model
+once per ~(accepted+1) tokens — the standard latency lever when decode is
+bound by streaming the target's weights per step.
+
+Orchestration is host-driven: the acceptance length is data-dependent, so
+the loop runs in Python while the three hot pieces — draft roll (a jitted
+``lax.scan``), target verify chunk, draft catch-up chunk — are each one
+fixed-shape jitted program (compiled once per shape; the draft catch-up
+has two shapes, 1 and 2 tokens). Production serving stacks drive the same
+loop from the host; a fully-fused ``lax.while_loop`` variant would trade
+this code's clarity for dispatch-latency savings and is deliberately
+future work.
+
+No reference analog (the reference runs no models).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_composer.models.decode import AnyConfig, decode_chunk, prefill
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def _verify_chunk(params: Dict, cache, chunk, config):
+    """Target scores the chunk; returns (greedy next-token ids (B, T),
+    advanced cache)."""
+    logits, cache = decode_chunk(params, cache, chunk, config)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+
+@functools.partial(jax.jit, static_argnames=("config", "gamma"))
+def _draft_roll(params: Dict, cache, pending, config, gamma: int):
+    """Draft consumes the pending tokens (the accepted suffix its cache
+    hasn't seen), then greedily extends: returns (gamma drafted tokens
+    (B, gamma), cache advanced past pending + the first gamma-1 drafts —
+    the last draft's K/V is never computed, mirroring how the newest
+    accepted token always stays one step ahead of the caches)."""
+    logits, cache = decode_chunk(params, cache, pending, config)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        cache, tok = carry
+        lg, cache = decode_chunk(params, cache, tok[:, None], config)
+        nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+        return (cache, nxt), nxt
+
+    (cache, _), rest = jax.lax.scan(
+        step, (cache, first), None, length=gamma - 1
+    )
+    drafts = jnp.concatenate([first[:, None], rest.T], axis=1)  # (B, gamma)
+    return drafts, cache
+
+
+def speculative_generate(
+    params: Dict,
+    draft_params: Dict,
+    prompt: jax.Array,  # (1, S_prompt) int32
+    config: AnyConfig,
+    draft_config: Optional[AnyConfig] = None,
+    max_new_tokens: int = 32,
+    gamma: int = 4,
+    max_seq: Optional[int] = None,
+    kv_quant: bool = False,
+) -> jax.Array:
+    """Greedy speculative generation. Returns (1, max_new_tokens) — the
+    exact tokens target-only greedy decoding would produce.
+
+    Batch is 1 per call (acceptance lengths diverge per sequence; serving
+    stacks run one speculation loop per in-flight sequence). ``kv_quant``
+    applies to both caches. The draft may be any config/params pair with
+    the same vocabulary — typically fewer layers/heads, or the same model
+    quantized (models/quant.py)."""
+    dc = draft_config or config
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            f"speculative decoding runs per-sequence (batch 1), got batch"
+            f" {prompt.shape[0]}"
+        )
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    from tpu_composer.models.moe import MoEConfig
+
+    if isinstance(config, MoEConfig) or isinstance(dc, MoEConfig):
+        # The verify chunk routes T tokens as one MoE group with
+        # capacity(T), which can drop tokens single-step decode never
+        # drops (decode.py's capacity-semantics note) — that would break
+        # the exact-greedy contract silently. Gate until chunked MoE
+        # decode carries drop-free capacity.
+        raise ValueError(
+            "speculative decoding currently supports dense models only"
+            " (MoE verify chunks change expert-capacity semantics)"
+        )
+    # Both caches must hold the whole run: the draft's own max_seq bounds
+    # its cache when max_seq is not given explicitly.
+    cap = max_seq or min(config.max_seq, dc.max_seq)
+    # The verify chunk may overshoot the accepted sequence by gamma slots.
+    need = prompt.shape[1] + max_new_tokens + gamma + 1
+    if need > cap:
+        raise ValueError(
+            f"prompt + max_new_tokens + gamma overshoot ({need}) exceeds the"
+            f" cache capacity ({cap})"
+        )
+
+    t_logits, t_cache = prefill(params, prompt, config, max_seq=max_seq,
+                                quant=kv_quant)
+    _, d_cache = prefill(draft_params, prompt, dc, max_seq=max_seq,
+                         quant=kv_quant)
+
+    out: List[int] = [int(jnp.argmax(t_logits, axis=-1)[0])]
+    # Invariant: both caches cover the prompt plus out[:covered]; the
+    # still-uncovered suffix of `out` is what the draft consumes next (1
+    # token normally, 2 after a fully-accepted round) and the target's
+    # verify chunk always starts at its own first uncovered token.
+    covered_d = 0
+    covered_t = 0
+    while len(out) < max_new_tokens:
+        pending_d = jnp.asarray([out[covered_d:]], jnp.int32)
+        drafts, d_cache = _draft_roll(draft_params, d_cache, pending_d, dc,
+                                      gamma)
+
+        chunk = jnp.concatenate(
+            [jnp.asarray([out[covered_t:]], jnp.int32), drafts], axis=1
+        )
+        greedy, t_cache = _verify_chunk(params, t_cache, chunk, config)
+        # greedy[:, i] is the target's choice AFTER chunk[:, :i+1]; drafts
+        # start at chunk position (len(out) - covered_t).
+        off = len(out) - covered_t
+        d_np = np.asarray(drafts[0])
+        g_np = np.asarray(greedy[0])
+        a = 0
+        while a < gamma and d_np[a] == g_np[off - 1 + a]:
+            a += 1
+        accepted = list(d_np[:a]) + [int(g_np[off - 1 + a])]
+        prev_len = len(out)
+        out.extend(int(x) for x in accepted)
+
+        # Cache bookkeeping: the verify chunk wrote off+gamma entries but
+        # only off+a are real; the draft wrote pending+gamma-1 of which
+        # pending+min(a, gamma-1) are real. Lengths rewind to the valid
+        # prefix — stale K/V beyond it is masked and later overwritten.
+        covered_t = prev_len + a
+        t_cache = t_cache._replace(
+            length=jnp.full_like(t_cache.length, prompt.shape[1] + covered_t)
+        )
+        covered_d = prev_len + min(a, gamma - 1)
+        d_cache = d_cache._replace(
+            length=jnp.full_like(d_cache.length, prompt.shape[1] + covered_d)
+        )
+    return jnp.asarray([out[:max_new_tokens]], jnp.int32)
