@@ -17,7 +17,7 @@ import tpu_composer.workload.probe as probe
 _FAST_CHILD = r"""
 import json, time
 for stage in ("backend_init", "matmul", "flash_attn", "qualify",
-              "qualify_large"):
+              "qualify_large", "decode"):
     print("STAGE_RESULT " + json.dumps({"stage": stage, "seconds": 0.0, "ok": True}),
           flush=True)
 """
@@ -35,7 +35,8 @@ def test_all_stages_complete(monkeypatch):
     monkeypatch.setattr(probe, "_CHILD", _FAST_CHILD)
     r = probe.staged_accelerator_probe(timeouts={"backend_init": 10.0})
     assert r["completed"] == ["devnodes", "backend_init", "matmul",
-                              "flash_attn", "qualify", "qualify_large"]
+                              "flash_attn", "qualify", "qualify_large",
+                              "decode"]
     assert "failed_stage" not in r
 
 

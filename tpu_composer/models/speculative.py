@@ -4,9 +4,13 @@ A small draft model proposes ``gamma`` tokens autoregressively; the target
 model scores all of them in ONE chunked forward (``decode_chunk`` with
 per-query causal limits) and accepts the longest agreeing prefix plus one
 bonus token from its own distribution. Greedy verification reproduces the
-target's greedy decode EXACTLY (test-pinned) while running the big model
-once per ~(accepted+1) tokens — the standard latency lever when decode is
-bound by streaming the target's weights per step.
+target's greedy decode (test-pinned) while running the big model once per
+~(accepted+1) tokens — the standard latency lever when decode is bound by
+streaming the target's weights per step. Equivalence caveat: the chunked
+forward accumulates in a different order than T single steps (~1e-4 logit
+drift), so a position whose top-2 logits are closer than that can break a
+tie differently — inherent to chunked verification on floats, not a logic
+divergence.
 
 Orchestration is host-driven: the acceptance length is data-dependent, so
 the loop runs in Python while the three hot pieces — draft roll (a jitted
@@ -105,8 +109,11 @@ def speculative_generate(
     # Both caches must hold the whole run: the draft's own max_seq bounds
     # its cache when max_seq is not given explicitly.
     cap = max_seq or min(config.max_seq, dc.max_seq)
-    # The verify chunk may overshoot the accepted sequence by gamma slots.
-    need = prompt.shape[1] + max_new_tokens + gamma + 1
+    # Tight bound: the last loop entry has len(out) = max_new_tokens - 1
+    # and its verify chunk writes 1 + gamma entries starting at
+    # prompt + len(out) - 1, so the highest slot written is
+    # prompt + max_new_tokens + gamma - 2.
+    need = prompt.shape[1] + max_new_tokens + gamma - 1
     if need > cap:
         raise ValueError(
             f"prompt + max_new_tokens + gamma overshoot ({need}) exceeds the"

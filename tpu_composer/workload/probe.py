@@ -50,6 +50,7 @@ STAGE_TIMEOUTS_S: Dict[str, float] = {
     "flash_attn": 600.0,
     "qualify": 420.0,
     "qualify_large": 420.0,
+    "decode": 420.0,
 }
 
 _CHILD = r"""
@@ -139,6 +140,19 @@ except Exception as e:  # noqa: BLE001 - enhancement pass degrades, never fails
     # (e.g. OOM on a small-HBM chip): the five core stages already carry
     # their evidence; record the error instead of failing the probe.
     emit("qualify_large", t0, error=f"{type(e).__name__}: {e}")
+
+# Serving throughput, TPU only: KV-cached greedy decode tokens/s for the
+# bf16 baseline vs the fully-quantized path (int8 weights + int8 cache).
+rearm(_timeouts.get("decode", 420.0))
+t0 = time.time()
+try:
+    if jax.default_backend() == "tpu":
+        from tpu_composer.workload.probe import decode_throughput_on_chip
+        emit("decode", t0, **decode_throughput_on_chip())
+    else:
+        emit("decode", t0, skipped="decode bench is meaningful on tpu only")
+except Exception as e:  # noqa: BLE001 - enhancement pass degrades, never fails
+    emit("decode", t0, error=f"{type(e).__name__}: {e}")
 faulthandler.cancel_dump_traceback_later()
 """
 
@@ -311,6 +325,62 @@ def flash_attention_on_chip(
     }
 
 
+def decode_throughput_on_chip(
+    batch: int = 8,
+    prompt_len: int = 128,
+    new_tokens: int = 128,
+) -> Dict[str, Any]:
+    """KV-cached greedy decode tokens/s: bf16 baseline vs the fully
+    quantized serving path (int8 weights + int8 KV cache). A mid-size
+    config (d_model 1024, 8 layers, GQA 2) so weight streaming — the
+    small-batch decode bound the quantization halves — dominates.
+
+    generate() is one jitted program (prefill + lax.scan), so wall-clock
+    around a single block_until_ready is honest device time (no per-token
+    dispatch in the loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": f"backend is {jax.default_backend()}, not tpu"}
+
+    from tpu_composer.models.decode import generate
+    from tpu_composer.models.quant import quantize_decode_params
+    from tpu_composer.models.transformer import ModelConfig, init_params
+
+    c = ModelConfig(vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
+                    n_kv_heads=4, d_ff=4096,
+                    max_seq=prompt_len + new_tokens, dtype=jnp.bfloat16)
+    params = init_params(c, jax.random.key(0))
+    qparams = quantize_decode_params(params)
+    prompt = jax.random.randint(jax.random.key(1), (batch, prompt_len), 0,
+                                c.vocab_size)
+
+    out: Dict[str, Any] = {
+        "batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "model": "d1024 L8 H16 kv4 ff4096 bf16",
+    }
+    for tag, p, quant in (("bf16", params, False),
+                          ("int8_w_int8_kv", qparams, True)):
+        fn = jax.jit(
+            lambda pp, tk, q=quant: generate(
+                pp, tk, c, max_new_tokens=new_tokens, kv_quant=q
+            )
+        )
+        fn(p, prompt).block_until_ready()  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn(p, prompt).block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        out[f"{tag}_tokens_per_s"] = round(batch * new_tokens / best, 1)
+        out[f"{tag}_ms_per_token"] = round(best / new_tokens * 1e3, 3)
+    out["quant_speedup"] = round(
+        out["int8_w_int8_kv_tokens_per_s"] / out["bf16_tokens_per_s"], 2
+    )
+    return out
+
+
 def staged_accelerator_probe(
     repo_root: Optional[str] = None,
     timeouts: Optional[Dict[str, float]] = None,
@@ -325,7 +395,8 @@ def staged_accelerator_probe(
     diagnosis is preserved under ``diagnosis.attempts``."""
     timeouts = {**STAGE_TIMEOUTS_S, **(timeouts or {})}
     devnodes = probe_devnodes()
-    order = ["backend_init", "matmul", "flash_attn", "qualify", "qualify_large"]
+    order = ["backend_init", "matmul", "flash_attn", "qualify",
+             "qualify_large", "decode"]
 
     env = dict(os.environ)
     root = repo_root or os.path.dirname(
