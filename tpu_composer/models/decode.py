@@ -87,16 +87,27 @@ def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return qt.q, qt.scale[..., 0]
 
 
+def _rowwise_update(cache_layer, new, pos):
+    """Write ``new`` (B, T, ...) into ``cache_layer`` (B, S, ...) starting
+    at per-ROW position ``pos`` (B,) — vmapped dynamic_update_slice, so
+    ragged batches (every sequence at its own length) write correctly.
+    XLA lowers this to a scatter; decode is read-bandwidth bound and the
+    written block is T x KV x Dh — negligible either way."""
+    def one(row, n, p):
+        start = (p,) + (0,) * (n.ndim - 1)
+        return jax.lax.dynamic_update_slice(row, n, start)
+
+    return jax.vmap(one)(cache_layer, new, pos)
+
+
 def _append_quantized(vals, scales, layer_idx: int, new, pos):
     """Quantize ``new`` and write values + scales into layer ``layer_idx``
-    of the stacked caches at position ``pos`` — the single spelling of the
-    paired 4-index value / 3-index scale update (k and v, prefill and
+    of the stacked caches at per-row positions ``pos`` (B,) — the single
+    spelling of the paired value/scale update (k and v, prefill and
     decode_step all go through here, so they cannot drift)."""
     q, sc = quantize_kv(new)
-    layer_vals = jax.lax.dynamic_update_slice(vals[layer_idx], q,
-                                              (0, pos, 0, 0))
-    layer_scales = jax.lax.dynamic_update_slice(scales[layer_idx], sc,
-                                                (0, pos, 0))
+    layer_vals = _rowwise_update(vals[layer_idx], q, pos)
+    layer_scales = _rowwise_update(scales[layer_idx], sc, pos)
     return (vals.at[layer_idx].set(layer_vals),
             scales.at[layer_idx].set(layer_scales),
             layer_vals, layer_scales)
@@ -181,14 +192,45 @@ def prefill(
     params: Dict, tokens: jax.Array, config: AnyConfig,
     max_seq: Optional[int] = None,
     quant: bool = False,
+    prompt_lens: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, KVCache]:
-    """Run the prompt (B, S_prompt), filling the cache. Returns the last
-    position's logits (B, vocab) and the primed cache. The prompt pass uses
-    ordinary causal attention (it IS the training forward), then the
-    computed K/V land in the cache for the decode loop. ``quant=True``
-    stores the cache int8 (see KVCache)."""
+    """Run the prompt (B, S_prompt), filling the cache. Returns each row's
+    LAST-real-position logits (B, vocab) and the primed cache. The prompt
+    pass uses ordinary causal attention (it IS the training forward), then
+    the computed K/V land in the cache for the decode loop. ``quant=True``
+    stores the cache int8 (see KVCache).
+
+    Ragged batches: right-pad the prompts and pass ``prompt_lens`` (B,).
+    In the dense model causality keeps pad positions from influencing real
+    ones; each row's cache length starts at its own prompt length, so
+    pad-slot K/V is masked out and overwritten as that row decodes.
+    MoE configs are rejected: expert routing treats the whole padded row
+    as one capacity group, so pads WOULD influence real tokens (inflated
+    claims can push a real token's expert assignment past capacity) —
+    per-row composability would silently break."""
     c = config
     b, s_p = tokens.shape
+    if prompt_lens is not None:
+        if isinstance(c, MoEConfig):
+            raise ValueError(
+                "ragged prompts are dense-only: MoE routing shares one"
+                " capacity group across the padded row, so pad tokens"
+                " would affect real ones"
+            )
+        if prompt_lens.shape != (b,):
+            raise ValueError(
+                f"prompt_lens shape {prompt_lens.shape} != ({b},)"
+            )
+        try:  # value checks only when concrete (skipped under jit)
+            import numpy as _np
+
+            pl = _np.asarray(prompt_lens)
+            if (pl < 1).any() or (pl > s_p).any():
+                raise ValueError(
+                    f"prompt_lens must be in [1, {s_p}], got {pl.tolist()}"
+                )
+        except jax.errors.TracerArrayConversionError:
+            pass
     cache = init_kv_cache(c, b, max_seq, quant=quant)
     positions = jnp.broadcast_to(jnp.arange(s_p, dtype=jnp.int32), (b, s_p))
     x = embedding_lookup(params["embed"], tokens, c.dtype)
@@ -204,12 +246,19 @@ def prefill(
         h = _rmsnorm(x, layer["ln2"])
         x = x + _ffn_delta(h, layer, li, c)
     x = _rmsnorm(x, params["ln_f"])
-    logits = jnp.einsum("bd,vd->bv", x[:, -1],
+    if prompt_lens is None:
+        x_last = x[:, -1]
+    else:
+        x_last = jnp.take_along_axis(
+            x, (prompt_lens - 1)[:, None, None], axis=1
+        )[:, 0]
+    logits = jnp.einsum("bd,vd->bv", x_last,
                         resolve(params["embed"], c.dtype)).astype(jnp.float32)
 
     k_stack = jnp.stack(ks)  # (L, B, S_p, KV, Dh)
     v_stack = jnp.stack(vs)
-    length = jnp.full((b,), s_p, jnp.int32)
+    length = (jnp.full((b,), s_p, jnp.int32) if prompt_lens is None
+              else prompt_lens.astype(jnp.int32))
     if not quant:
         cache = KVCache(
             k=jax.lax.dynamic_update_slice(cache.k, k_stack, (0, 0, 0, 0, 0)),
@@ -246,30 +295,25 @@ def decode_chunk(
     verify) must use dense models or drop-free capacity."""
     c = config
     b, t = tokens.shape
-    pos = cache.length  # (B,) — uniform in practice (no ragged batches yet)
+    pos = cache.length  # (B,) — per-row; ragged batches decode correctly
     positions = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
     x = embedding_lookup(params["embed"], tokens, c.dtype)  # (B, T, D)
     new_k, new_v = cache.k, cache.v
     new_ks, new_vs = cache.k_scale, cache.v_scale
     for li, layer in enumerate(params["layers"]):
         q, k, v = _project_qkv(layer, x, positions, c)
-        # Append this chunk's K/V at `pos` (uniform across batch:
-        # scan-carried decode keeps lengths aligned).
+        # Append this chunk's K/V at each row's own position.
         if cache.quantized:
             new_k, new_ks, k_cache, ks_cache = _append_quantized(
-                new_k, new_ks, li, k, pos[0]
+                new_k, new_ks, li, k, pos
             )
             new_v, new_vs, v_cache, vs_cache = _append_quantized(
-                new_v, new_vs, li, v, pos[0]
+                new_v, new_vs, li, v, pos
             )
         else:
             ks_cache = vs_cache = None
-            k_cache = jax.lax.dynamic_update_slice(
-                new_k[li], k, (0, pos[0], 0, 0)
-            )
-            v_cache = jax.lax.dynamic_update_slice(
-                new_v[li], v, (0, pos[0], 0, 0)
-            )
+            k_cache = _rowwise_update(new_k[li], k, pos)
+            v_cache = _rowwise_update(new_v[li], v, pos)
             new_k = new_k.at[li].set(k_cache)
             new_v = new_v.at[li].set(v_cache)
         o = _cached_attention(q, k_cache, v_cache, pos + t, c,
@@ -332,6 +376,7 @@ def generate(
     key: Optional[jax.Array] = None,
     max_seq: Optional[int] = None,
     kv_quant: bool = False,
+    prompt_lens: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Greedy (temperature 0) or sampled generation, one jittable program:
     prefill + lax.scan of decode steps. Returns (B, max_new_tokens).
@@ -340,7 +385,10 @@ def generate(
     by ``temperature`` first (the nucleus must be chosen on the
     distribution actually sampled), then filtered by ``top_k`` and
     ``top_p`` (nucleus), then sampled; temperature 0 ignores both and is
-    greedy argmax."""
+    greedy argmax.
+
+    Ragged batches: right-pad the prompts and pass ``prompt_lens`` (B,) —
+    every row then continues from its own real last token (see prefill)."""
     c = config
     cap = max_seq or c.max_seq
     if prompt.shape[1] + max_new_tokens > cap:
@@ -356,7 +404,7 @@ def generate(
     if key is None:
         key = jax.random.key(0)
     logits, cache = prefill(params, prompt, c, max_seq=max_seq,
-                            quant=kv_quant)
+                            quant=kv_quant, prompt_lens=prompt_lens)
 
     def pick(logits, k):
         if temperature <= 0.0:
