@@ -110,20 +110,28 @@ class TestDecodeChunk:
         assert int(cache_a.length[0]) == int(cache_b.length[0])
         assert float(jnp.abs(cache_a.k - cache_b.k).max()) < 1e-5
 
-    def test_rejects_moe_targets(self):
-        """MoE verify chunks change expert-capacity semantics (capacity(T)
-        vs never-dropping single steps) — gated until drop-free chunked
-        capacity exists, instead of silently breaking exactness."""
+    def test_moe_target_is_exact(self):
+        """MoE targets verify exactly: decode chunks route with drop-free
+        capacity (T*top_k), so a chunk computes what T single steps would
+        and the greedy-equivalence contract extends to the MoE family."""
         from tpu_composer.models.moe import MoEConfig
         from tpu_composer.models.moe import init_params as moe_init
 
         mc = MoEConfig(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
-                       d_ff=96, max_seq=64, dtype=jnp.float32, n_experts=2,
-                       top_k=1, capacity_factor=2.0, moe_period=2)
+                       n_kv_heads=2, d_ff=96, max_seq=96, dtype=jnp.float32,
+                       n_experts=2, top_k=1, capacity_factor=2.0,
+                       moe_period=2)
         mp = moe_init(mc, jax.random.key(0))
-        prompt = jnp.zeros((1, 4), jnp.int32)
-        with pytest.raises(ValueError):
-            speculative_generate(mp, mp, prompt, mc, max_new_tokens=4)
+        dc = MoEConfig(vocab_size=64, d_model=64, n_layers=1, n_heads=4,
+                       n_kv_heads=2, d_ff=96, max_seq=96, dtype=jnp.float32,
+                       n_experts=2, top_k=1, capacity_factor=2.0,
+                       moe_period=2)
+        dp = moe_init(dc, jax.random.key(5))
+        prompt = jnp.array([[9, 4, 17, 2]], jnp.int32)
+        ref = generate(mp, prompt, mc, max_new_tokens=10, max_seq=96)
+        spec = speculative_generate(mp, dp, prompt, mc, draft_config=dc,
+                                    max_new_tokens=10, gamma=3, max_seq=96)
+        assert spec.tolist() == ref.tolist()
 
     def test_draft_max_seq_bounds_capacity(self):
         """A draft whose max_seq is smaller than the target's must bound

@@ -40,19 +40,27 @@ from tpu_composer.models.transformer import (
 
 AnyConfig = Union[ModelConfig, MoEConfig]
 
-# MoE capacity semantics at decode time: forward() routes the WHOLE
-# sequence as one group and drops tokens past each expert's capacity(S);
-# decode_step routes one token with no competition (capacity(1) >= top_k),
-# so it NEVER drops. The two agree exactly whenever the forward pass was
-# drop-free (generous capacity_factor); under saturation, decode is the
-# more faithful computation — serving stacks do not replicate training's
+# MoE capacity semantics at decode time: forward() (and prefill, which IS
+# the training forward) routes the whole sequence as one group and drops
+# tokens past each expert's capacity(S). The decode path (decode_chunk /
+# decode_step, any chunk size) instead routes with DROP-FREE capacity
+# (ffn_delta drop_free=True: capacity = chunk length, which no expert can
+# overflow), so a T-token chunk computes exactly what T single steps
+# would — the invariant speculative verify relies on. Training forward
+# and decode agree exactly whenever the forward pass was drop-free
+# (generous capacity_factor); under saturation, decode is the more
+# faithful computation — serving stacks do not replicate training's
 # capacity-drop artifact. The parity tests pin the drop-free case.
 
 
-def _ffn_delta(h, layer, layer_idx: int, c: AnyConfig):
+def _ffn_delta(h, layer, layer_idx: int, c: AnyConfig,
+               drop_free: bool = False):
     """FFN residual via the shared MoE-vs-dense branch (models/moe.py);
-    aux loss discarded — inference doesn't train the router."""
-    delta, _aux = ffn_delta(h, layer, layer_idx, c)
+    aux loss discarded — inference doesn't train the router. The decode
+    loop passes drop_free=True (capacity = chunk length, routing never
+    drops) so a T-token chunk computes the same function as T single
+    steps; prefill keeps the training forward's capacity semantics."""
+    delta, _aux = ffn_delta(h, layer, layer_idx, c, drop_free=drop_free)
     return delta
 
 
@@ -288,11 +296,10 @@ def decode_chunk(
     T>1. Static shapes: the cache is full-length; masking handles
     validity.
 
-    MoE caveat: a T>1 chunk routes its tokens as one group with
-    capacity(T) — matching the training forward's semantics, NOT T
-    single-token steps (which never drop; see the capacity note at the
-    top of this module). Exactness-sensitive callers (speculative
-    verify) must use dense models or drop-free capacity."""
+    MoE chunks route with DROP-FREE capacity (T*top_k): a chunk computes
+    exactly what T single-token steps would (see the capacity note at the
+    top of this module), which is what speculative verify's exactness
+    requires."""
     c = config
     b, t = tokens.shape
     pos = cache.length  # (B,) — per-row; ragged batches decode correctly
@@ -321,7 +328,7 @@ def decode_chunk(
                               q_positions=positions)
         x = x + jnp.einsum("bshk,hkd->bsd", o, resolve(layer["wo"], c.dtype))
         h = _rmsnorm(x, layer["ln2"])
-        x = x + _ffn_delta(h, layer, li, c)
+        x = x + _ffn_delta(h, layer, li, c, drop_free=True)
     x = _rmsnorm(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x,
                         resolve(params["embed"], c.dtype)).astype(jnp.float32)

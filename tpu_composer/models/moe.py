@@ -221,11 +221,16 @@ def _top_k_routing(
     return dispatch, combine, aux
 
 
-def _moe_ffn(x: jax.Array, layer: Dict, config: MoEConfig) -> Tuple[jax.Array, jax.Array]:
-    """x: (B, S, D) -> (out (B,S,D), aux scalar). SwiGLU experts."""
+def _moe_ffn(
+    x: jax.Array, layer: Dict, config: MoEConfig,
+    capacity: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """x: (B, S, D) -> (out (B,S,D), aux scalar). SwiGLU experts.
+    ``capacity`` overrides the config's capacity-factor rule (the decode
+    path passes the drop-free s*top_k)."""
     c = config
     b, s, _ = x.shape
-    cap = c.capacity(s)
+    cap = capacity if capacity is not None else c.capacity(s)
     logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), layer["w_router"])
     dispatch, combine, aux = _top_k_routing(logits, c.top_k, cap)
 
@@ -245,13 +250,23 @@ def _moe_ffn(x: jax.Array, layer: Dict, config: MoEConfig) -> Tuple[jax.Array, j
     return out, aux
 
 
-def ffn_delta(h: jax.Array, layer: Dict, layer_idx: int, config) -> Tuple[jax.Array, jax.Array]:
+def ffn_delta(
+    h: jax.Array, layer: Dict, layer_idx: int, config,
+    drop_free: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
     """The block's FFN residual with the MoE-vs-dense branch in ONE place
     (forward and the KV-cached decode path both call this): expert dispatch
-    on MoE layers, SwiGLU otherwise. Returns (delta, aux_loss)."""
+    on MoE layers, SwiGLU otherwise. Returns (delta, aux_loss).
+
+    ``drop_free=True`` sizes expert capacity at s (each token claims a
+    given expert at most once, so s slots can never overflow) — routing
+    then NEVER drops a token: the decode-step/chunk semantic (a serving
+    stack does not replicate training's capacity-drop artifact, and
+    chunked verify must compute the same function as T single steps)."""
     c = config
     if isinstance(c, MoEConfig) and c.is_moe_layer(layer_idx):
-        return _moe_ffn(h, layer, c)
+        cap = h.shape[1] if drop_free else None
+        return _moe_ffn(h, layer, c, capacity=cap)
     return swiglu_ffn(h, layer, c.dtype), jnp.zeros((), jnp.float32)
 
 

@@ -94,20 +94,10 @@ def speculative_generate(
         )
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
-    from tpu_composer.models.moe import MoEConfig
-
-    if isinstance(config, MoEConfig) or isinstance(dc, MoEConfig):
-        # The verify chunk routes T tokens as one MoE group with
-        # capacity(T), which can drop tokens single-step decode never
-        # drops (decode.py's capacity-semantics note) — that would break
-        # the exact-greedy contract silently. Gate until chunked MoE
-        # decode carries drop-free capacity.
-        raise ValueError(
-            "speculative decoding currently supports dense models only"
-            " (MoE verify chunks change expert-capacity semantics)"
-        )
     # Both caches must hold the whole run: the draft's own max_seq bounds
-    # its cache when max_seq is not given explicitly.
+    # its cache when max_seq is not given explicitly. (MoE models verify
+    # correctly: decode chunks route with drop-free capacity, so a chunk
+    # computes exactly what single steps would.)
     cap = max_seq or min(config.max_seq, dc.max_seq)
     # Tight bound: the last loop entry has len(out) = max_new_tokens - 1
     # and its verify chunk writes 1 + gamma entries starting at
