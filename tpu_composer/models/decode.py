@@ -29,12 +29,12 @@ from tpu_composer.models.quant import (
     quantize_weight,
     resolve,
 )
-from tpu_composer.ops.attention import mha_reference
 from tpu_composer.models.moe import MoEConfig, ffn_delta
 from tpu_composer.models.transformer import (
     ModelConfig,
     _rmsnorm,
     _rope,
+    _select_attn,
     project_qkv,
 )
 
@@ -217,6 +217,7 @@ def prefill(
     claims can push a real token's expert assignment past capacity) —
     per-row composability would silently break."""
     c = config
+    attn = _select_attn(c, None)
     b, s_p = tokens.shape
     if prompt_lens is not None:
         if isinstance(c, MoEConfig):
@@ -248,8 +249,10 @@ def prefill(
         ks.append(k)
         vs.append(v)
         # Causal self-attention within the prompt (no cache yet) — the
-        # same reference attention forward() uses, not a re-derivation.
-        o = mha_reference(q, k, v, causal=True).astype(c.dtype)
+        # same attention impl forward() selects (config.attn_impl: flash
+        # on TPU for long prompts, einsum reference otherwise), not a
+        # re-derivation.
+        o = attn(q, k, v, causal=True).astype(c.dtype)
         x = x + jnp.einsum("bshk,hkd->bsd", o, resolve(layer["wo"], c.dtype))
         h = _rmsnorm(x, layer["ln2"])
         x = x + _ffn_delta(h, layer, li, c)
