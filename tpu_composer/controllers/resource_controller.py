@@ -49,7 +49,13 @@ from tpu_composer.fabric.provider import (
 from tpu_composer.runtime.controller import Controller, Result
 from tpu_composer.runtime.events import WARNING, EventRecorder
 from tpu_composer.runtime.metrics import composed_chips, fabric_requests_total, reconcile_total
-from tpu_composer.runtime.store import Store, WatchEvent
+from tpu_composer.runtime.store import (
+    ConflictError,
+    NotFoundError,
+    Store,
+    WatchEvent,
+    delete_tolerant,
+)
 from tpu_composer.topology.slices import is_tpu_model
 
 
@@ -165,10 +171,14 @@ class ComposableResourceReconciler(Controller):
         self.recorder.event(res, WARNING, "NodeGone",
                             f"target node {res.spec.target_node} deleted")
         if not res.being_deleted:
-            self.store.delete(ComposableResource, res.name)
-            res = self.store.get(ComposableResource, res.name)
+            res = delete_tolerant(self.store, ComposableResource, res.name)
+            if res is None:
+                return True  # finalizer-less object purged outright — done
         res.status.state = RESOURCE_STATE_DELETING
-        self.store.update_status(res)
+        try:
+            self.store.update_status(res)
+        except NotFoundError:
+            pass  # purged between the delete and the status PUT — done
         return True
 
     def _handle_none(self, res: ComposableResource) -> Result:
@@ -336,10 +346,14 @@ class ComposableResourceReconciler(Controller):
         if res.being_deleted or res.metadata.labels.get(LABEL_READY_TO_DETACH):
             if not res.being_deleted:
                 # Syncer detach-CR: begin teardown immediately (:310-315).
-                self.store.delete(ComposableResource, res.name)
-                res = self.store.get(ComposableResource, res.name)
+                res = delete_tolerant(self.store, ComposableResource, res.name)
+                if res is None:
+                    return Result()  # already purged — nothing left to detach
             res.status.state = RESOURCE_STATE_DETACHING
-            self.store.update_status(res)
+            try:
+                self.store.update_status(res)
+            except NotFoundError:
+                return Result()  # purged concurrently — teardown already won
             return Result(requeue_after=self.timing.detach_fast)
 
         health = self.fabric.check_resource(res)
@@ -413,7 +427,10 @@ class ComposableResourceReconciler(Controller):
         res.status.chip_indices = []
         res.status.error = ""
         res.status.state = RESOURCE_STATE_DELETING
-        self.store.update_status(res)
+        try:
+            self.store.update_status(res)
+        except NotFoundError:
+            pass  # purged concurrently — the fabric release still happened
         composed_chips.set(len(self.fabric_attached(node)), node=node)
         self.recorder.event(res, "Normal", "Detached", f"released from {node}")
         return Result(requeue_after=self.timing.detach_fast)
@@ -422,10 +439,18 @@ class ComposableResourceReconciler(Controller):
         if not res.being_deleted:
             # GC-forced teardown finished but nobody asked the store to
             # delete the object yet — do it ourselves.
-            self.store.delete(ComposableResource, res.name)
-            res = self.store.get(ComposableResource, res.name)
+            res = delete_tolerant(self.store, ComposableResource, res.name)
+            if res is None:
+                return Result()  # purged concurrently — deletion complete
         if res.remove_finalizer(FINALIZER):
-            self.store.update(res)  # purges (last finalizer, terminating)
+            try:
+                self.store.update(res)  # purges (last finalizer, terminating)
+            except NotFoundError:
+                # Purged between the cache read and the PUT (e.g. a stale
+                # watch-cache copy still carrying the finalizer after the
+                # server already released the object) — deletion is complete.
+                # This exact race crashed BENCH_r03; 404 here means success.
+                pass
         return Result()
 
     def _set_error(self, name: str, msg: str) -> None:
@@ -435,5 +460,5 @@ class ComposableResourceReconciler(Controller):
         res.status.error = msg
         try:
             self.store.update_status(res)
-        except Exception:  # conflict — next reconcile will surface it
-            pass
+        except (ConflictError, NotFoundError):
+            pass  # stale read or object gone — next reconcile re-surfaces it

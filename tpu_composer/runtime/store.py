@@ -61,6 +61,23 @@ MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 
 
+def delete_tolerant(store: "Store", cls, name: str):
+    """Delete ``name`` tolerating a concurrent purge, then re-read.
+
+    Returns the surviving (terminating, finalizer-bearing) object, or None
+    when it is already gone — either the delete hit 404 or the object had no
+    finalizer and purged outright. Deletion-path reconcile steps use this so
+    an object vanishing between the cache read and the API call means "done",
+    not an exception — the reference wraps every deletion-path call in
+    client.IgnoreNotFound (composableresource_controller.go:87,143,160;
+    composabilityrequest_controller.go:153-157)."""
+    try:
+        store.delete(cls, name)
+    except NotFoundError:
+        return None
+    return store.try_get(cls, name)
+
+
 @dataclass
 class WatchEvent:
     type: str  # ADDED | MODIFIED | DELETED
