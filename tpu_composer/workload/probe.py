@@ -43,11 +43,11 @@ from typing import Any, Dict, List, Optional
 STAGE_TIMEOUTS_S: Dict[str, float] = {
     "backend_init": 480.0,
     "matmul": 120.0,
-    # flash_attn compiles 8 functions (4 standalone numerics + 4 chained
-    # timing scans) through the remote-compile tunnel; the persistent
-    # compilation cache makes repeat probes cheap but the first live run
-    # needs headroom.
-    "flash_attn": 600.0,
+    # flash_attn sweeps 4 configs (seq 1k-8k, MHA/GQA/MQA), each compiling
+    # up to 4 chained timing scans plus numerics jits on the short ones,
+    # through the remote-compile tunnel; the persistent compilation cache
+    # makes repeat probes cheap but the first live run needs headroom.
+    "flash_attn": 900.0,
     "qualify": 420.0,
     "qualify_large": 420.0,
     "decode": 420.0,
@@ -101,11 +101,11 @@ y = jax.jit(lambda a: a @ a)(x)
 y.block_until_ready()
 emit("matmul", t0, ok=True, result_dtype=str(y.dtype))
 
-rearm(_timeouts.get("flash_attn", 240.0))
+rearm(_timeouts.get("flash_attn", 900.0))
 t0 = time.time()
 try:
-    from tpu_composer.workload.probe import flash_attention_on_chip
-    emit("flash_attn", t0, **flash_attention_on_chip())
+    from tpu_composer.workload.probe import flash_sweep_on_chip
+    emit("flash_attn", t0, **flash_sweep_on_chip())
 except Exception as e:  # noqa: BLE001 - diagnosis, not control flow
     emit("flash_attn", t0, error=f"{type(e).__name__}: {e}")
 
@@ -228,13 +228,21 @@ def probe_devnodes() -> Dict[str, Any]:
 
 
 def flash_attention_on_chip(
-    batch: int = 2, heads: int = 4, seq: int = 1024, head_dim: int = 128
+    batch: int = 2, heads: int = 8, seq: int = 1024, head_dim: int = 128,
+    kv_heads: Optional[int] = None, check_numerics: bool = True,
 ) -> Dict[str, Any]:
     """Validate the Pallas flash kernels on the live backend (VERDICT #4).
 
     Runs fwd+bwd through both the flash path and the XLA einsum reference,
     asserts numerics, and times both at the given seq. Only meaningful on a
     TPU backend (Mosaic lowering); on CPU it reports the backend and skips.
+
+    NOTE the argument order into the attention APIs is (B, S, H, D). The
+    r3 probe built tensors as (batch, heads, seq, head_dim) — i.e. it
+    benchmarked a degenerate seq-4, 1024-head attention where the flash
+    grid collapses to thousands of (4 x 128) micro-kernels, and archived
+    flash "losing" 0.91x/0.64x on a shape no model runs (VERDICT r3
+    missing #3 traces to exactly this).
     """
     import jax
     import jax.numpy as jnp
@@ -246,10 +254,10 @@ def flash_attention_on_chip(
 
     key = jax.random.key(0)
     kq, kk, kv = jax.random.split(key, 3)
-    shape = (batch, heads, seq, head_dim)
-    q = jax.random.normal(kq, shape, jnp.bfloat16)
-    k = jax.random.normal(kk, shape, jnp.bfloat16)
-    v = jax.random.normal(kv, shape, jnp.bfloat16)
+    hk = kv_heads or heads
+    q = jax.random.normal(kq, (batch, seq, heads, head_dim), jnp.bfloat16)
+    k = jax.random.normal(kk, (batch, seq, hk, head_dim), jnp.bfloat16)
+    v = jax.random.normal(kv, (batch, seq, hk, head_dim), jnp.bfloat16)
 
     def loss_flash(q, k, v):
         return flash_attention(q, k, v, causal=True).astype(jnp.float32).sum()
@@ -262,17 +270,19 @@ def flash_attention_on_chip(
     f_grad = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
     r_grad = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))
 
-    of = f_fwd(q, k, v).block_until_ready()
-    orf = r_fwd(q, k, v).block_until_ready()
-    fwd_err = float(
-        jnp.max(jnp.abs(of.astype(jnp.float32) - orf.astype(jnp.float32)))
-    )
-    gf = jax.block_until_ready(f_grad(q, k, v))
-    gr = jax.block_until_ready(r_grad(q, k, v))
-    bwd_err = max(
-        float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
-        for a, b in zip(gf, gr)
-    )
+    fwd_err = bwd_err = None
+    if check_numerics:
+        of = f_fwd(q, k, v).block_until_ready()
+        orf = r_fwd(q, k, v).block_until_ready()
+        fwd_err = float(
+            jnp.max(jnp.abs(of.astype(jnp.float32) - orf.astype(jnp.float32)))
+        )
+        gf = jax.block_until_ready(f_grad(q, k, v))
+        gr = jax.block_until_ready(r_grad(q, k, v))
+        bwd_err = max(
+            float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+            for a, b in zip(gf, gr)
+        )
 
     def bench(fn, *args, iters=8, reps=2, pick=lambda out: out):
         """Per-iteration device time via a lax.scan chain INSIDE one jit:
@@ -302,20 +312,19 @@ def flash_attention_on_chip(
 
     flash_ms = bench(f_fwd, q, k, v)
     ref_ms = bench(r_fwd, q, k, v)
-    # Sum ALL three grads into the carry: feeding only g[0] back would let
-    # jaxpr DCE delete the dead dk/dv computation (the entire dkv
-    # pallas_call on the flash path) and time half a backward.
-    full = lambda g: g[0] + g[1] + g[2]
+    # Keep ALL three grads live in the carry: feeding only g[0] back would
+    # let jaxpr DCE delete the dead dk/dv computation (the entire dkv
+    # pallas_call on the flash path) and time half a backward. dk/dv are
+    # head-summed so GQA shapes (KV < H) broadcast-add into the q carry.
+    full = lambda g: g[0] + jnp.sum(g[1] + g[2], axis=2, keepdims=True)
     flash_bwd_ms = bench(f_grad, q, k, v, pick=full)
     ref_bwd_ms = bench(r_grad, q, k, v, pick=full)
 
-    # bf16 tolerance: sums over seq-length dot products accumulate ~1e-2.
-    ok = fwd_err < 0.1 and bwd_err < 0.5
-    return {
-        "numerics_ok": ok,
-        "fwd_max_err": round(fwd_err, 5),
-        "bwd_max_err": round(bwd_err, 5),
+    rec = {
         "seq": seq,
+        "batch": batch,
+        "heads": heads,
+        "kv_heads": hk,
         "flash_fwd_ms": round(flash_ms, 3),
         "ref_fwd_ms": round(ref_ms, 3),
         "flash_bwd_ms": round(flash_bwd_ms, 3),
@@ -323,6 +332,51 @@ def flash_attention_on_chip(
         "fwd_speedup": round(ref_ms / flash_ms, 2),
         "bwd_speedup": round(ref_bwd_ms / flash_bwd_ms, 2),
     }
+    if check_numerics:
+        # bf16 tolerance: sums over seq-length dot products accumulate ~1e-2.
+        rec["numerics_ok"] = fwd_err < 0.1 and bwd_err < 0.5
+        rec["fwd_max_err"] = round(fwd_err, 5)
+        rec["bwd_max_err"] = round(bwd_err, 5)
+    return rec
+
+
+def flash_sweep_on_chip() -> Dict[str, Any]:
+    """The flash kernel's report card across its operating envelope
+    (VERDICT r3 ask #2): realistic head counts, seq 1k-8k, GQA/MQA fan-in.
+    Numerics are asserted on the short configs (cheap); the long configs
+    are timing-only — their numerics are pinned by the CPU-mesh tests
+    (tests/test_flash_attention.py seq 2k-8k) and the v5e AOT compile
+    gates. Headline fields summarize the long-seq regime (>= 4096) where
+    the streaming kernel structurally beats the S^2-materializing
+    reference."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": f"backend is {jax.default_backend()}, not tpu"}
+    configs = [
+        dict(batch=2, heads=8, seq=1024, check_numerics=True),
+        dict(batch=2, heads=8, kv_heads=2, seq=2048, check_numerics=True),
+        dict(batch=1, heads=8, kv_heads=2, seq=4096, check_numerics=False),
+        dict(batch=1, heads=4, kv_heads=1, seq=8192, check_numerics=False),
+    ]
+    out: Dict[str, Any] = {"configs": []}
+    for c in configs:
+        try:
+            rec = flash_attention_on_chip(**c)
+        except Exception as e:  # noqa: BLE001 - keep earlier configs' data
+            rec = {"seq": c["seq"], "error": f"{type(e).__name__}: {e}"}
+        out["configs"].append(rec)
+    longs = [r for r in out["configs"]
+             if r.get("seq", 0) >= 4096 and "fwd_speedup" in r]
+    if longs:
+        # min(): the headline must surface a regression in ANY long config,
+        # not let one winning config mask a losing one.
+        out["fwd_speedup_long"] = min(r["fwd_speedup"] for r in longs)
+        out["bwd_speedup_long"] = min(r["bwd_speedup"] for r in longs)
+    nums = [r for r in out["configs"] if "numerics_ok" in r]
+    if nums:
+        out["numerics_ok"] = all(r["numerics_ok"] for r in nums)
+    return out
 
 
 def decode_throughput_on_chip(
