@@ -317,6 +317,75 @@ class TestPoolFaultMatrix:
         w.rec.reconcile("r0")
         assert w.store.try_get(ComposableResource, "r0") is None
 
+    def test_still_visible_chips_loop_until_gone(self, world):
+        """Fabric released the chips but the host still enumerates them:
+        detach must fast-requeue in Detaching (reference "ResourceSlice is
+        still visible", composableresource_controller_test.go:5533), keep
+        the quarantine taints up, and only reach Deleting once the device
+        nodes drop."""
+        w = world
+        make_tpu_cr(w)
+        to_online(w)
+        w.store.delete(ComposableResource, "r0")
+        w.rec.reconcile("r0")  # Online -> Detaching
+        w.agent.set_lingering("worker-0", 2)
+        for _ in range(2):
+            r = w.rec.reconcile("r0")
+            assert r.requeue_after == w.rec.timing.detach_fast
+            cr = get(w)
+            assert cr.status.state == RESOURCE_STATE_DETACHING
+            assert cr.status.device_ids  # not cleared while visible
+            assert all(w.agent.has_device_taint("worker-0", d)
+                       for d in cr.status.device_ids)
+        w.rec.reconcile("r0")  # enumeration gone -> Deleting
+        cr = get(w)
+        assert cr.status.state == RESOURCE_STATE_DELETING
+        assert w.agent.taints() == {}
+
+    def test_load_probe_failure_surfaces_then_retry(self, world):
+        """The load CHECK itself erroring (nvidia-smi failing in the
+        reference, :4303) is an agent error, not 'busy': it must surface in
+        status and the next pass must retry the full detach."""
+        from tpu_composer.agent.nodeagent import AgentError
+
+        w = world
+        make_tpu_cr(w)
+        to_online(w)
+        w.store.delete(ComposableResource, "r0")
+        w.rec.reconcile("r0")  # Online -> Detaching
+        w.agent.fail_load_check("worker-0")
+        with pytest.raises(AgentError):
+            w.rec.reconcile("r0")
+        cr = get(w)
+        assert cr.status.state == RESOURCE_STATE_DETACHING
+        assert "load probe failed" in cr.status.error
+        assert w.pool.attached_to("worker-0")  # nothing released on error
+        w.rec.reconcile("r0")
+        assert get(w).status.state == RESOURCE_STATE_DELETING
+
+    def test_taint_cleanup_failure_surfaces_then_retry(self, world):
+        """Detach completed on the fabric but the quarantine cleanup fails:
+        the error surfaces, the resource stays Detaching, and the retry
+        (fabric remove is idempotent) finishes the cleanup."""
+        from tpu_composer.agent.nodeagent import AgentError
+
+        w = world
+        make_tpu_cr(w)
+        to_online(w)
+        w.store.delete(ComposableResource, "r0")
+        w.rec.reconcile("r0")  # Online -> Detaching
+        w.agent.fail_taint_cleanup("worker-0")
+        with pytest.raises(AgentError):
+            w.rec.reconcile("r0")
+        cr = get(w)
+        assert cr.status.state == RESOURCE_STATE_DETACHING
+        assert "taint cleanup failed" in cr.status.error
+        assert w.agent.taints()  # quarantine still in place
+        w.rec.reconcile("r0")
+        cr = get(w)
+        assert cr.status.state == RESOURCE_STATE_DELETING
+        assert w.agent.taints() == {}
+
     def test_leaked_attachment_reclaimed_via_detach_cr(self, world):
         """The syncer's synthetic detach-CR must run the full reclaim path
         through every dialect (upstreamsyncer_controller.go:140-165 +

@@ -141,6 +141,33 @@ class TestValidatingOverTheWire:
         assert exc.value.code == 403
 
 
+class TestSamenodeEffectiveTargetOverTheWire:
+    def test_unpinned_incoming_with_allocated_node_denied(self, world):
+        """The incoming request's node resolves via status when its spec
+        has no target (VERDICT r3 missing #5), and the denial travels the
+        full apiserver -> TLS webhook -> 403 wire path."""
+        from tpu_composer.api.types import ResourceStatus
+
+        store, webhook, srv = world
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="pinned"),
+            spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                type="gpu", model="gpu-a100", size=1,
+                allocation_policy="samenode", target_node="worker-3"))))
+        unpinned = ComposabilityRequest(
+            metadata=ObjectMeta(name="unpinned"),
+            spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                type="gpu", model="gpu-a100", size=1,
+                allocation_policy="samenode")))
+        unpinned.status.resources["gpu-y"] = ResourceStatus(
+            state="Online", node_name="worker-3")
+        doc = unpinned.to_dict()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            api_post(srv, CR_PREFIX, doc)
+        assert exc.value.code == 403
+        assert "already targets worker-3" in json.loads(exc.value.read())["message"]
+
+
 class TestMutatingOverTheWire:
     def test_tpu_pod_gets_coordinates_injected(self, world):
         store, webhook, srv = world

@@ -56,10 +56,16 @@ def validate_request(store: Store, req: ComposabilityRequest) -> None:
                     f" model {res.model} already exists"
                 )
         elif res.allocation_policy == "samenode":
-            if _effective_target(other) == res.target_node and res.target_node:
+            # The incoming request's node is resolved the same way the
+            # other's is: explicit target_node, else the node its allocator
+            # already chose (composabilityrequest_webhook.go:108-128). An
+            # unpinned, never-allocated request has no node yet — no
+            # conflict to detect.
+            mine = _effective_target(req)
+            if mine and _effective_target(other) == mine:
                 raise AdmissionDenied(
                     f"composabilityRequest {other.name} with type {res.type} and"
-                    f" model {res.model} already targets {res.target_node}"
+                    f" model {res.model} already targets {mine}"
                 )
 
 

@@ -35,6 +35,11 @@ class FakeNodeAgent(NodeAgent):
         self._taints: Dict[str, str] = {}  # device id -> reason
         self._published: Dict[str, Dict[str, CdiSpec]] = {}  # node -> name -> spec
         self.drain_calls: List[tuple] = []
+        # Detach-path failure personas (the reference's Detaching-tree
+        # canned failures, composableresource_controller_test.go):
+        self._linger: Dict[str, int] = {}  # node -> polls chips keep enumerating
+        self._load_check_fails: Dict[str, int] = {}  # node -> raising polls
+        self._taint_cleanup_fails: Dict[str, int] = {}  # node -> raising calls
 
     # ------------------------------------------------------------------
     # NodeAgent interface
@@ -47,6 +52,12 @@ class FakeNodeAgent(NodeAgent):
 
     def check_visible(self, node: str, device_ids: List[str], group: str = "") -> bool:
         with self._lock:
+            if self._linger.get(node, 0) > 0 and device_ids:
+                # Fabric already released the chips but the host's device
+                # nodes haven't dropped yet ("ResourceSlice is still
+                # visible", reference :5533) — detach must loop, not finish.
+                self._linger[node] -= 1
+                return True
             delay = self._visibility_delay.get(node, 0)
             if delay > 0:
                 self._visibility_delay[node] = delay - 1
@@ -59,6 +70,11 @@ class FakeNodeAgent(NodeAgent):
 
     def check_no_loads(self, node: str, device_ids: List[str], group: str = "") -> bool:
         with self._lock:
+            if self._load_check_fails.get(node, 0) > 0:
+                # The probe itself failing (nvidia-smi erroring in the
+                # reference, :4303) is an AgentError, not "busy".
+                self._load_check_fails[node] -= 1
+                raise AgentError(f"load probe failed on {node}")
             busy = self._loads.get(node, set())
             return not (busy & set(device_ids))
 
@@ -87,6 +103,9 @@ class FakeNodeAgent(NodeAgent):
 
     def delete_device_taint(self, node, device_ids):
         with self._lock:
+            if self._taint_cleanup_fails.get(node, 0) > 0:
+                self._taint_cleanup_fails[node] -= 1
+                raise AgentError(f"taint cleanup failed on {node}")
             for d in device_ids:
                 self._taints.pop(d, None)
 
@@ -97,6 +116,19 @@ class FakeNodeAgent(NodeAgent):
     # ------------------------------------------------------------------
     # test knobs
     # ------------------------------------------------------------------
+    def set_lingering(self, node: str, polls: int) -> None:
+        """Chips keep enumerating for N visibility polls after detach."""
+        with self._lock:
+            self._linger[node] = polls
+
+    def fail_load_check(self, node: str, times: int = 1) -> None:
+        with self._lock:
+            self._load_check_fails[node] = times
+
+    def fail_taint_cleanup(self, node: str, times: int = 1) -> None:
+        with self._lock:
+            self._taint_cleanup_fails[node] = times
+
     def set_no_driver(self, node: str, missing: bool = True) -> None:
         with self._lock:
             if missing:

@@ -219,6 +219,14 @@ def prefill(
     c = config
     attn = _select_attn(c, None)
     b, s_p = tokens.shape
+    cap = max_seq or c.max_seq
+    if s_p > cap:
+        # dynamic_update_slice would silently clamp and truncate the stored
+        # K/V; generate()/speculative_generate() guard at their level, but
+        # direct prefill callers must get the same protection (ADVICE r3).
+        raise ValueError(
+            f"prompt length {s_p} exceeds cache capacity {cap}"
+        )
     if prompt_lens is not None:
         if isinstance(c, MoEConfig):
             raise ValueError(
@@ -299,7 +307,8 @@ def decode_chunk(
     T>1. Static shapes: the cache is full-length; masking handles
     validity.
 
-    MoE chunks route with DROP-FREE capacity (T*top_k): a chunk computes
+    MoE chunks route with DROP-FREE capacity (= chunk length T,
+    matching ffn_delta(drop_free=True)): a chunk computes
     exactly what T single-token steps would (see the capacity note at the
     top of this module), which is what speculative verify's exactness
     requires."""

@@ -227,7 +227,8 @@ def _moe_ffn(
 ) -> Tuple[jax.Array, jax.Array]:
     """x: (B, S, D) -> (out (B,S,D), aux scalar). SwiGLU experts.
     ``capacity`` overrides the config's capacity-factor rule (the decode
-    path passes the drop-free s*top_k)."""
+    path passes the drop-free capacity = chunk length s: top-k picks
+    distinct experts per token, so s slots can never overflow)."""
     c = config
     b, s, _ = x.shape
     cap = capacity if capacity is not None else c.capacity(s)
