@@ -181,3 +181,39 @@ class TestCoordinateInjection:
             assert spec.env["TPU_WORKER_ID"] == str(w)
             assert spec.env["TPU_WORKER_HOSTNAMES"] == ",".join(hosts)
             assert spec.env["TPU_TOPOLOGY"] == got.status.slice.topology
+
+
+class TestDeletionPathNeverDenied:
+    def test_terminating_request_finalizer_removal_not_wedged(self, guarded_store):
+        """The webhook must never deny a terminating request's updates
+        (finalizer-removal PUTs) or it wedges Deleting forever: an
+        allocated-but-unpinned samenode request being deleted can
+        legitimately share its status node with a successor placed while
+        it terminates (the allocator stops counting terminating requests).
+        Review finding on the r4 status-fallback change."""
+        from tpu_composer.api.types import FINALIZER, ResourceStatus
+
+        a = guarded_store.create(req("a"))
+        a.metadata.finalizers = [FINALIZER]
+        a = guarded_store.update(a)
+        a.status.resources["gpu-x"] = ResourceStatus(
+            state="Online", node_name="worker-3"
+        )
+        guarded_store.update_status(a)
+        guarded_store.delete(ComposabilityRequest, "a")  # terminating
+        # Successor lands on the same node while A terminates.
+        guarded_store.create(req("b", target="worker-3"))
+        a = guarded_store.get(ComposabilityRequest, "a")
+        a.metadata.finalizers = []
+        guarded_store.update(a)  # must NOT raise AdmissionDenied
+        assert guarded_store.try_get(ComposabilityRequest, "a") is None
+
+    def test_terminating_other_does_not_block_newcomer(self, guarded_store):
+        from tpu_composer.api.types import FINALIZER, ResourceStatus
+
+        a = guarded_store.create(req("a", target="worker-3"))
+        a.metadata.finalizers = [FINALIZER]
+        a = guarded_store.update(a)
+        guarded_store.delete(ComposabilityRequest, "a")
+        # A still exists (finalizer) but is terminating: no longer a conflict.
+        guarded_store.create(req("b", target="worker-3"))

@@ -38,13 +38,20 @@ def _effective_target(req: ComposabilityRequest) -> str:
 def validate_request(store: Store, req: ComposabilityRequest) -> None:
     res = req.spec.resource
 
+    if req.being_deleted:
+        # Deletion-path updates (finalizer removal PUTs) must never be
+        # denied: a conflict verdict here would wedge the object in
+        # Deleting forever. The allocator likewise stops counting
+        # terminating requests, so there is nothing left to protect.
+        return
+
     if res.allocation_policy == "differentnode" and res.target_node:
         raise AdmissionDenied(
             "target_node cannot be specified when allocation_policy is 'differentnode'"
         )
 
     for other in store.list(ComposabilityRequest):
-        if other.name == req.name:
+        if other.name == req.name or other.being_deleted:
             continue
         o = other.spec.resource
         if o.type != res.type or o.model != res.model:

@@ -79,7 +79,12 @@ from tpu_composer.topology.slices import SliceShape, TopologyError, is_tpu_model
 @dataclass
 class RequestTiming:
     updating_poll: float = 0.5  # children-not-ready re-check (30s, :558)
-    running_poll: float = 30.0  # drift/health re-check (30s, :585)
+    # Running is EVENT-driven: child watch events (fold + mapper) wake the
+    # request at delivery latency on member loss/degradation — proven by
+    # tests/test_e2e_operator.py::TestEventDrivenRunning with this poll at
+    # 600 s. The 30 s pass is only a safety net for missed events; the
+    # reference's fixed requeue (:585) is its primary detection quantum.
+    running_poll: float = 30.0
     cleaning_poll: float = 0.3  # children-still-terminating re-check (30s, :611)
 
 
