@@ -29,7 +29,9 @@ from tpu_composer.parallel.train import TrainConfig, make_train_state, make_trai
 
 
 def _model_flops_per_token(c: ModelConfig) -> float:
-    """~6 * params matmul FLOPs per token for fwd+bwd (standard estimate)."""
+    """~6 * params matmul FLOPs per token for fwd+bwd (standard estimate;
+    excludes the attention S*d term, so derived MFU is slightly
+    conservative at long seq)."""
     per_layer = (
         3 * c.d_model * c.n_heads * c.head_dim  # qkv
         + c.n_heads * c.head_dim * c.d_model  # out proj
@@ -37,6 +39,34 @@ def _model_flops_per_token(c: ModelConfig) -> float:
     )
     params = c.n_layers * per_layer + c.vocab_size * c.d_model
     return 6.0 * params
+
+
+# Per-chip dense bf16 peaks (public spec sheets), matched against
+# device_kind prefixes. BASELINE.md's north star is an explicit MFU line:
+# achieved TFLOPS / (n_devices * peak).
+_BF16_PEAK_TFLOPS = (
+    ("TPU v5 lite", 197.0),  # v5e
+    ("TPU v5e", 197.0),
+    ("TPU v5p", 459.0),
+    ("TPU v5", 459.0),  # after v5e/v5p prefixes: bare v5 reports as p
+    ("TPU v4 lite", 137.0),
+    ("TPU v4", 275.0),
+    ("TPU v6 lite", 918.0),  # Trillium / v6e
+    ("TPU v6e", 918.0),
+)
+
+
+def _bf16_peak_tflops() -> Optional[float]:
+    import jax
+
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 - no backend, no peak
+        return None
+    for prefix, peak in _BF16_PEAK_TFLOPS:
+        if kind.startswith(prefix):
+            return peak
+    return None
 
 
 def qualify_slice(
@@ -104,4 +134,7 @@ def qualify_slice(
     results["train_loss"] = float(metrics["loss"])
     results["tokens_per_s"] = tokens_per_step / dt
     results["tflops"] = _model_flops_per_token(mc) * tokens_per_step / dt / 1e12
+    peak = _bf16_peak_tflops()
+    if peak:
+        results["mfu"] = results["tflops"] / (results["n_devices"] * peak)
     return results
