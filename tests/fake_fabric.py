@@ -226,6 +226,17 @@ class _FabricHandler(BaseHTTPRequestHandler):
                 except FabricError as e:
                     return self._send(409, {"error": str(e)})
                 return self._send(201, {"name": name})
+            if method == "PATCH":
+                # Live resize: surviving hosts keep their chip groups.
+                body = self._body()
+                try:
+                    pool.resize_slice(
+                        name, body.get("model", ""), body.get("topology", ""),
+                        list(body.get("nodes", [])),
+                    )
+                except FabricError as e:
+                    return self._send(409, {"error": str(e)})
+                return self._send(200, {"name": name})
             if method == "DELETE":
                 # Strict server behavior: unknown slice is 404 (clients must
                 # treat release as idempotent on their side).

@@ -521,3 +521,67 @@ class TestWireFaultMatrix:
             assert w.server.token_requests >= 2
         finally:
             w.close()
+
+
+# ---------------------------------------------------------------------------
+# Request-level allocator through every dialect: reserve / live-resize /
+# release ride the wire, not just the in-process pool
+# ---------------------------------------------------------------------------
+
+class TestRequestLifecycleMatrix:
+    LIVE_RESIZE = {"mock", "rest_cm", "rest_fm", "layout"}  # redfish: no op
+
+    def _pump(self, w, req_rec, name):
+        from tests.test_fault_injection import pump
+
+        return pump(w.store, req_rec, w.rec, name=name)
+
+    def test_slice_reserve_grow_release_over_the_wire(self, world):
+        """8-chip grow of a running 4-chip slice: dialects with the PATCH
+        endpoint (pool API) keep worker 0's chips live; redfish (no
+        composition-zone resize) falls back to dissolve-and-rebuild. Both
+        end Running with 8 chips, and deletion releases everything."""
+        from tpu_composer.api.types import (
+            ComposabilityRequest,
+            ComposabilityRequestSpec,
+            ResourceDetails,
+        )
+        from tpu_composer.controllers.request_controller import (
+            ComposabilityRequestReconciler,
+        )
+
+        w = world
+        req_rec = ComposabilityRequestReconciler(w.store, w.fabric)
+        w.store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="job"),
+            spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                type="tpu", model="tpu-v4", size=4)),
+        ))
+        req = self._pump(w, req_rec, "job")
+        first_child = sorted(req.status.resources)[0]
+        first_ids = list(req.status.resources[first_child].device_ids)
+
+        req = w.store.get(ComposabilityRequest, "job")
+        req.spec.resource.size = 8
+        w.store.update(req)
+        req = self._pump(w, req_rec, "job")
+        assert req.status.slice.num_hosts == 2
+        assert sum(len(rs.device_ids)
+                   for rs in req.status.resources.values()) == 8
+        if w.backend in self.LIVE_RESIZE:
+            # Worker 0 survived the grow with its chips untouched.
+            assert first_child in req.status.resources
+            assert req.status.resources[first_child].device_ids == first_ids
+        else:
+            assert first_child not in req.status.resources
+
+        free_before_release = w.pool.free_chips("tpu-v4")
+        w.store.delete(ComposabilityRequest, "job")
+        for _ in range(60):
+            if w.store.try_get(ComposabilityRequest, "job") is None:
+                break
+            req_rec.reconcile("job")
+            for c in w.store.list(ComposableResource):
+                w.rec.reconcile(c.metadata.name)
+        assert w.store.try_get(ComposabilityRequest, "job") is None
+        assert w.pool.free_chips("tpu-v4") == free_before_release + 8

@@ -119,14 +119,21 @@ def make_request(store, name="req-1", size=4):
     ))
 
 
-def pump(store, req_rec, res_rec, name="req-1", steps=40):
+def pump(store, req_rec, res_rec, name="req-1", steps=40,
+         want_state=REQUEST_STATE_RUNNING):
+    """Reconcile both controllers until the request reaches want_state;
+    returns the request. Shared with the cross-backend matrix suite."""
     for _ in range(steps):
         req_rec.reconcile(name)
         for c in store.list(ComposableResource):
             res_rec.reconcile(c.metadata.name)
-        if store.get(ComposabilityRequest, name).status.state == REQUEST_STATE_RUNNING:
-            return
-    raise AssertionError("never reached Running")
+        req = store.get(ComposabilityRequest, name)
+        if req.status.state == want_state:
+            return req
+    raise AssertionError(
+        f"{name} never reached {want_state}:"
+        f" {store.get(ComposabilityRequest, name).status.to_dict()}"
+    )
 
 
 # ---------------------------------------------------------------------------

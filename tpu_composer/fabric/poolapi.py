@@ -12,7 +12,12 @@ from typing import List
 
 from tpu_composer.api.types import ComposableResource
 from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient
-from tpu_composer.fabric.provider import DeviceHealth, FabricDevice, FabricError
+from tpu_composer.fabric.provider import (
+    DeviceHealth,
+    FabricDevice,
+    FabricError,
+    UnsupportedResize,
+)
 
 
 class PoolApiMixin:
@@ -38,6 +43,43 @@ class PoolApiMixin:
             if e.code == 404:
                 return  # unknown slice: idempotent no-op (InMemoryPool parity)
             raise
+
+    def resize_slice(
+        self, slice_name: str, model: str, topology: str, nodes: List[str]
+    ) -> None:
+        """Live grow/shrink over the wire: PATCH the slice with the new
+        shape; the pool service keeps surviving hosts' chip groups (same
+        contract as InMemoryPool.resize_slice). A pool service without the
+        endpoint (404/405/501) surfaces as UnsupportedResize so the
+        controller falls back to dissolve-and-rebuild instead of tearing
+        survivors down via release+reserve."""
+        try:
+            status, _ = self._http.request(
+                "PATCH",
+                f"/slices/{slice_name}",
+                {"model": model, "topology": topology, "nodes": list(nodes)},
+            )
+        except HttpStatusError as e:
+            if e.code in (405, 501):
+                raise UnsupportedResize(
+                    f"pool service has no live-resize endpoint ({e.code})"
+                ) from None
+            if e.code == 404:
+                # Ambiguous: unknown slice (InMemoryPool contract says
+                # resize-of-unknown reserves it) OR a service without the
+                # PATCH route. Reserving disambiguates — a service that
+                # actually holds the slice 409s the PUT, which means the
+                # 404 was the missing route.
+                try:
+                    return self.reserve_slice(slice_name, model, topology, nodes)
+                except FabricError:
+                    raise UnsupportedResize(
+                        f"pool service 404s resize of {slice_name} and the"
+                        " slice already exists — no live-resize support"
+                    ) from None
+            raise FabricError(f"resize_slice {slice_name}: {e}") from e
+        if not 200 <= status < 300:
+            raise FabricError(f"resize_slice {slice_name}: HTTP {status}")
 
     def check_resource(self, resource: ComposableResource) -> DeviceHealth:
         name = resource.metadata.name

@@ -57,8 +57,6 @@ _BF16_PEAK_TFLOPS = (
 
 
 def _bf16_peak_tflops() -> Optional[float]:
-    import jax
-
     try:
         kind = jax.devices()[0].device_kind
     except Exception:  # noqa: BLE001 - no backend, no peak
