@@ -1,0 +1,130 @@
+"""Teardown soak: the round-3 bench crasher as a permanent regression gate.
+
+BENCH_r03 died with "bench-9 teardown never completed" — a NotFound race
+on the deletion path under concurrent load (VERDICT r3 missing #1). This
+test runs the same storm shape through the live threaded manager: four
+concurrent lanes of create -> Running -> delete -> purged cycles, with
+every fifth cycle adversarially yanking child finalizers mid-teardown to
+force the purged-between-read-and-PUT interleaving. 200 cycles complete
+in a few seconds; any wedged teardown fails the lane by timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.types import LABEL_MANAGED_BY
+from tpu_composer.controllers.request_controller import (
+    ComposabilityRequestReconciler,
+    RequestTiming,
+)
+from tpu_composer.controllers.syncer import UpstreamSyncer
+from tpu_composer.controllers.resource_controller import (
+    ComposableResourceReconciler,
+    ResourceTiming,
+)
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.store import Store
+
+LANES = 4
+CYCLES_PER_LANE = 50
+
+
+def test_200_cycle_teardown_storm_with_purge_races():
+    store = Store()
+    for i in range(8):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 8
+        store.create(n)
+    pool = InMemoryPool(chips={"tpu-v4": 64})
+    agent = FakeNodeAgent(pool=pool)
+    mgr = Manager(store, health_addr="127.0.0.1:0")
+    mgr.add_controller(ComposabilityRequestReconciler(
+        store, pool,
+        timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.02,
+                             running_poll=5.0)))
+    mgr.add_controller(ComposableResourceReconciler(
+        store, pool, agent,
+        timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.02,
+                              detach_poll=0.05, detach_fast=0.02,
+                              busy_poll=0.05)))
+    # The adversarial purges orphan fabric attachments by design (a child
+    # deleted without running detach) — reclaiming those is the
+    # UpstreamSyncer's anti-drift job, so the soak runs the full system.
+    mgr.add_runnable(UpstreamSyncer(store, pool, period=0.05, grace=0.1))
+    mgr.start(workers_per_controller=2)
+
+    fails: list = []
+
+    def cycle(i: int) -> None:
+        name = f"soak-{i}"
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name=name),
+            spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                type="tpu", model="tpu-v4", size=4)),
+        ))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            r = store.try_get(ComposabilityRequest, name)
+            if r is not None and r.status.state == "Running":
+                break
+            time.sleep(0.01)
+        else:
+            fails.append(f"{name}: never Running")
+            return
+        store.delete(ComposabilityRequest, name)
+        if i % 5 == 0:
+            # Adversary: purge children out from under the teardown.
+            time.sleep(0.01)
+            for c in store.list(ComposableResource):
+                if (c.metadata.labels.get(LABEL_MANAGED_BY) == name
+                        and c.being_deleted):
+                    c.metadata.finalizers = []
+                    try:
+                        store.update(c)
+                    except Exception:  # noqa: BLE001 - racing the controller
+                        pass
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if store.try_get(ComposabilityRequest, name) is None:
+                return
+            time.sleep(0.01)
+        fails.append(f"{name}: teardown never completed")
+
+    lanes = []
+    for lane in range(LANES):
+        def run(lane=lane):
+            for j in range(CYCLES_PER_LANE):
+                cycle(lane * CYCLES_PER_LANE + j)
+
+        t = threading.Thread(target=run)
+        t.start()
+        lanes.append(t)
+    for t in lanes:
+        t.join()
+    assert not fails, fails[:10]
+    # Settle: the syncer needs a few grace periods to reclaim attachments
+    # orphaned by the adversarial purges.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if (pool.free_chips("tpu-v4") == 64
+                and not store.list(ComposableResource)):
+            break
+        time.sleep(0.05)
+    mgr.stop()
+
+    assert pool.free_chips("tpu-v4") == 64  # every chip reclaimed
+    leftovers = [k for k in store.keys()
+                 if k[0] in ("ComposabilityRequest", "ComposableResource")]
+    assert leftovers == [], leftovers[:10]
