@@ -68,7 +68,7 @@ def test_200_cycle_teardown_storm_with_purge_races():
     fails: list = []
 
     def cycle(i: int) -> None:
-        name = f"soak-{i}"
+        name = f"soak-{i}"  # body wrapped by the lane runner's except
         store.create(ComposabilityRequest(
             metadata=ObjectMeta(name=name),
             spec=ComposabilityRequestSpec(resource=ResourceDetails(
@@ -102,28 +102,35 @@ def test_200_cycle_teardown_storm_with_purge_races():
             time.sleep(0.01)
         fails.append(f"{name}: teardown never completed")
 
-    lanes = []
-    for lane in range(LANES):
-        def run(lane=lane):
-            for j in range(CYCLES_PER_LANE):
-                cycle(lane * CYCLES_PER_LANE + j)
+    try:
+        lanes = []
+        for lane in range(LANES):
+            def run(lane=lane):
+                for j in range(CYCLES_PER_LANE):
+                    i = lane * CYCLES_PER_LANE + j
+                    try:
+                        cycle(i)
+                    except Exception as e:  # noqa: BLE001 - a dead lane must FAIL
+                        fails.append(f"soak-{i}: lane crashed: {e!r}")
+                        return
 
-        t = threading.Thread(target=run)
-        t.start()
-        lanes.append(t)
-    for t in lanes:
-        t.join()
+            t = threading.Thread(target=run)
+            t.start()
+            lanes.append(t)
+        for t in lanes:
+            t.join()
+        # Settle: the syncer needs a few grace periods to reclaim
+        # attachments orphaned by the adversarial purges.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if (pool.free_chips("tpu-v4") == 64
+                    and not store.list(ComposableResource)):
+                break
+            time.sleep(0.05)
+    finally:
+        mgr.stop()
+
     assert not fails, fails[:10]
-    # Settle: the syncer needs a few grace periods to reclaim attachments
-    # orphaned by the adversarial purges.
-    deadline = time.monotonic() + 20
-    while time.monotonic() < deadline:
-        if (pool.free_chips("tpu-v4") == 64
-                and not store.list(ComposableResource)):
-            break
-        time.sleep(0.05)
-    mgr.stop()
-
     assert pool.free_chips("tpu-v4") == 64  # every chip reclaimed
     leftovers = [k for k in store.keys()
                  if k[0] in ("ComposabilityRequest", "ComposableResource")]

@@ -272,7 +272,8 @@ def prefill(
             x, (prompt_lens - 1)[:, None, None], axis=1
         )[:, 0]
     logits = jnp.einsum("bd,vd->bv", x_last,
-                        resolve(params["embed"], c.dtype)).astype(jnp.float32)
+                        resolve(params["embed"], c.dtype),
+                        preferred_element_type=jnp.float32)
 
     k_stack = jnp.stack(ks)  # (L, B, S_p, KV, Dh)
     v_stack = jnp.stack(vs)
@@ -343,7 +344,8 @@ def decode_chunk(
         x = x + _ffn_delta(h, layer, li, c, drop_free=True)
     x = _rmsnorm(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x,
-                        resolve(params["embed"], c.dtype)).astype(jnp.float32)
+                        resolve(params["embed"], c.dtype),
+                        preferred_element_type=jnp.float32)
     return logits, KVCache(k=new_k, v=new_v, length=pos + t,
                            k_scale=new_ks, v_scale=new_vs)
 

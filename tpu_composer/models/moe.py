@@ -294,7 +294,8 @@ def forward(
 
     x = _rmsnorm(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x,
-                        resolve(params["embed"], c.dtype)).astype(jnp.float32)
+                        resolve(params["embed"], c.dtype),
+                        preferred_element_type=jnp.float32)
     n_moe = sum(1 for i in range(c.n_layers) if c.is_moe_layer(i))
     return logits, aux_total / max(n_moe, 1)
 

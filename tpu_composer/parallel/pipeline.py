@@ -208,7 +208,8 @@ def pipelined_forward(
         x, mesh, n_microbatches, axis_name, seq_axis=seq_axis,
     )
     x = _rmsnorm(x, params["ln_f"])
-    return jnp.einsum("bsd,vd->bsv", x, params["embed"]).astype(jnp.float32)
+    return jnp.einsum("bsd,vd->bsv", x, params["embed"],
+                      preferred_element_type=jnp.float32)
 
 
 def pipelined_loss_fn(
