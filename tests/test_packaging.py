@@ -190,3 +190,28 @@ class TestCatalog:
         # must NOT be inside it.
         assert not (out / "catalog.Dockerfile").exists()
         assert "ADD catalog /configs" in dockerfile.read_text()
+
+
+class TestExampleScripts:
+    """The runnable examples stay runnable: both scripts execute end to end
+    on the CPU backend in a subprocess (the exact invocation the README
+    advertises), tiny shapes for speed."""
+
+    @pytest.mark.parametrize("cmd", [
+        ["examples/train_lm.py", "--steps", "2", "--global-batch", "2",
+         "--seq-len", "32"],
+        ["examples/serve_lm.py", "--batch", "2", "--prompt-len", "8",
+         "--new-tokens", "4", "--gamma", "2"],
+    ])
+    def test_example_runs(self, cmd):
+        import subprocess
+        import sys
+
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": REPO,
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, *cmd[0].split("/")), *cmd[1:]],
+            capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
