@@ -37,13 +37,20 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    # Pin cpu BEFORE the first backend probe when the TPU tunnel relay is
-    # down — its PJRT handshake hangs with no connect timeout (docs/PERF.md).
+    # Honor an explicit non-tunnel JAX_PLATFORMS (the image's sitecustomize
+    # can pin the tunneled platform and read the env var too late — same
+    # dance as train_lm.py). A tunneled platform (or none) is TCP-preflighted
+    # first: its PJRT handshake hangs with no connect timeout when the relay
+    # is down (docs/PERF.md), so a dead relay degrades to cpu instead.
     from tpu_composer.workload.probe import probe_pool_endpoints
 
-    endpoints = probe_pool_endpoints()
-    if endpoints and not any(e.get("reachable") for e in endpoints):
-        jax.config.update("jax_platforms", "cpu")
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and "axon" not in want and "tpu" not in want:
+        jax.config.update("jax_platforms", want)
+    else:
+        endpoints = probe_pool_endpoints()
+        if endpoints and not any(e.get("reachable") for e in endpoints):
+            jax.config.update("jax_platforms", "cpu")
 
     from tpu_composer.models.decode import generate
     from tpu_composer.models.speculative import speculative_generate

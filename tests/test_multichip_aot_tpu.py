@@ -107,6 +107,32 @@ class TestTrainStepCompilesForV5eSlice:
         )
         _aot_compile(tc, axes, seq=256)
 
+    def test_ring_flash_inner_sp_tp(self, monkeypatch):
+        """Ring attention with the Pallas flash kernel per block (merged
+        via its lse output) INSIDE the sp shard_map, compiled for the
+        slice: Mosaic kernels under partial-manual collectives in one
+        program — the long-context flagship path."""
+        monkeypatch.setenv("TPUC_FLASH_INTERPRET", "0")
+        axes = solve_mesh_axes(8, sp=2, tp=2)
+        tc = TrainConfig(
+            model=ModelConfig(max_seq=512,
+                              **{**_COMMON, "d_model": 512, "n_heads": 4,
+                                 "d_ff": 1024}),
+            sp_impl="ring", sp_inner="flash",
+        )
+        _aot_compile(tc, axes, seq=512)
+
+    def test_zigzag_flash_inner_sp_tp(self, monkeypatch):
+        monkeypatch.setenv("TPUC_FLASH_INTERPRET", "0")
+        axes = solve_mesh_axes(8, sp=2, tp=2)
+        tc = TrainConfig(
+            model=ModelConfig(max_seq=512,
+                              **{**_COMMON, "d_model": 512, "n_heads": 4,
+                                 "d_ff": 1024}),
+            sp_impl="zigzag", sp_inner="flash",
+        )
+        _aot_compile(tc, axes, seq=512)
+
     def test_ulysses_all_to_all(self):
         """Ulysses head-scatter all-to-alls over 'sp', compiled for ICI."""
         axes = solve_mesh_axes(8, sp=2, tp=2)

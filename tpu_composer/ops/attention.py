@@ -376,7 +376,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
 
 
 def _flash_backward(res, g, heads, kv_heads, causal, block_q, block_k,
-                    interpret):
+                    interpret, g_lse=None):
+    """``g_lse`` (packed like the lse residual) is the cotangent of the lse
+    OUTPUT when the caller differentiates through flash_attention_with_lse.
+    It needs no kernel changes: for row r, dL/dlse_r enters ds as
+    +p * g_lse_r (dlse/ds is the softmax), i.e. the kernels' existing
+    ``ds = p * (dp - delta)`` absorbs it as delta_eff = delta - g_lse."""
     q3, k3, v3, out, lse = res
     bh, sq, d = q3.shape
     bkv, sk, _ = k3.shape
@@ -401,6 +406,8 @@ def _flash_backward(res, g, heads, kv_heads, causal, block_q, block_k,
     if pad:
         delta = jnp.pad(delta, ((0, 0), (0, 0), (0, pad)))
     dlt2 = delta.reshape(bh, nq_f * rows, 1, 128)
+    if g_lse is not None:
+        dlt2 = dlt2 - _row_view(g_lse, bh, nq_f, rows)
 
     # Backward q-blocks are one residual row each: 128 when the forward
     # block was 128-aligned, else the (sub-128) forward block itself.
@@ -498,6 +505,33 @@ def _flash_core_bwd(heads, kv_heads, causal, block_q, block_k, interpret,
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_core_lse(q3, k3, v3, heads, kv_heads, causal, block_q, block_k,
+                    interpret):
+    """Like _flash_core but the packed logsumexp is a real (differentiable)
+    OUTPUT, for callers that merge partial attention results — ring
+    attention's flash inner (parallel/ring_attention.py)."""
+    return _flash_forward(q3, k3, v3, heads, kv_heads, causal, block_q,
+                          block_k, interpret)
+
+
+def _flash_core_lse_fwd(q3, k3, v3, heads, kv_heads, causal, block_q,
+                        block_k, interpret):
+    out, lse = _flash_forward(q3, k3, v3, heads, kv_heads, causal, block_q,
+                              block_k, interpret)
+    return (out, lse), (q3, k3, v3, out, lse)
+
+
+def _flash_core_lse_bwd(heads, kv_heads, causal, block_q, block_k, interpret,
+                        res, g):
+    g_out, g_lse = g
+    return _flash_backward(res, g_out, heads, kv_heads, causal, block_q,
+                           block_k, interpret, g_lse=g_lse)
+
+
+_flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
+
+
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 
@@ -556,6 +590,15 @@ def flash_attention(
     process (tests/test_flash_aot_tpu.py), where the default backend lies
     about the lowering target.
     """
+    qt, kt, vt, dims = _flash_prep(q, k, v, block_q, block_k, interpret)
+    b, h, hk, sq, d, block_q, block_k, interpret = dims
+    out = _flash_core(qt, kt, vt, h, hk, causal, block_q, block_k, interpret)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _flash_prep(q, k, v, block_q, block_k, interpret):
+    """Shared prologue: interpret resolution, block fitting/validation, and
+    the (B, S, H, D) -> (B*H, S, D) collapse both public entry points use."""
     if interpret is None:
         env = os.environ.get("TPUC_FLASH_INTERPRET")
         if env not in (None, "", "0", "1"):
@@ -570,11 +613,16 @@ def flash_attention(
     sk, hk = k.shape[1], k.shape[2]
     if h % hk:
         raise ValueError(f"kv heads {hk} must divide query heads {h}")
+    explicit_q = block_q is not None
     block_q = _fit_block(block_q, sq, DEFAULT_BLOCK_Q)
     block_k = _fit_block(block_k, sk, DEFAULT_BLOCK_K)
+    # The backward's row-packed residual view needs q-blocks that are
+    # whole 128-lane rows (or a single sub-128 row). Self-shrink fitted
+    # sizes (e.g. seq 192 fits block 192 -> halve to 96); explicit sizes
+    # are the caller's contract and fail loudly.
+    while not explicit_q and block_q > 128 and block_q % 128:
+        block_q //= 2
     if block_q > 128 and block_q % 128:
-        # The backward's row-packed residual view needs q-blocks that are
-        # whole 128-lane rows (or a single sub-128 row).
         raise ValueError(
             f"block_q {block_q} > 128 must be a multiple of 128"
         )
@@ -583,5 +631,36 @@ def flash_attention(
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
-    out = _flash_core(qt, kt, vt, h, hk, causal, block_q, block_k, interpret)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return qt, kt, vt, (b, h, hk, sq, d, block_q, block_k, interpret)
+
+
+def flash_attention_with_lse(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """flash_attention variant that ALSO returns the per-row logsumexp
+    (B, H, S) fp32, differentiable through both outputs — the building
+    block for merging partial attention results across K/V shards (ring
+    attention's flash inner): two blocks' (out, lse) pairs combine with
+    the standard online-softmax rescale, so a ring step never needs the
+    raw scores. The lse gradient costs the backward nothing extra (it
+    folds into the existing delta term — see _flash_backward)."""
+    qt, kt, vt, dims = _flash_prep(q, k, v, block_q, block_k, interpret)
+    b, h, hk, sq, d, block_q, block_k, interpret = dims
+    out3, lse_p = _flash_core_lse(qt, kt, vt, h, hk, causal, block_q,
+                                  block_k, interpret)
+    out = out3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    # Packed (BH, nq, rows, 128) -> (B, H, S): each 128-lane row holds
+    # min(block_q, 128) q positions (plus pad lanes only when
+    # block_q < 128); the slice drops the pad, the reshapes are free.
+    rows = _lse_rows(block_q)
+    nq_f = sq // block_q
+    bq_eff = 128 if block_q % 128 == 0 else block_q
+    lse = lse_p.reshape(b * h, nq_f * rows, 128)[:, :, :bq_eff]
+    lse = lse.reshape(b, h, sq)
+    return out, lse

@@ -26,7 +26,34 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from tpu_composer.ops.attention import repeat_kv
+from tpu_composer.ops.attention import flash_attention_with_lse, repeat_kv
+
+
+def _flash_block_update(qh, k_cur, v_cur, m, l, acc, causal_block: bool):
+    """Flash-inner block update: the Pallas kernel computes this Q shard
+    against one K/V chunk entirely in VMEM (never materializing the
+    (S_q, S_k) scores in HBM, unlike the einsum path) and returns
+    (out_i, lse_i); the pair merges into the running online-softmax state
+    with the standard rescale — for a fully-computed block, exp(lse_i - m)
+    IS its normalizer contribution and out_i * exp(lse_i - m) its
+    accumulator contribution. Grouped K/V need no repeat_kv here: the
+    kernel fans kv heads through its BlockSpec index maps, so the ring
+    rotates 1/group the bytes."""
+    out_i, lse_i = flash_attention_with_lse(qh, k_cur, v_cur,
+                                            causal=causal_block)
+    lse_col = lse_i[..., None]  # (B, H, S, 1)
+    m_new = jnp.maximum(m, lse_col)
+    alpha = jnp.exp(m - m_new)
+    w = jnp.exp(lse_col - m_new)
+    l_new = l * alpha + w
+    acc_new = (acc * alpha.transpose(0, 2, 1, 3)
+               + out_i.astype(jnp.float32) * w.transpose(0, 2, 1, 3))
+    return m_new, l_new, acc_new
+
+
+def _check_inner(inner: str) -> None:
+    if inner not in ("einsum", "flash"):
+        raise ValueError(f"unknown ring inner {inner!r} (einsum|flash)")
 
 
 def _block_update(q, k_cur, v_cur, m, l, acc, scale, mask=None):
@@ -54,26 +81,39 @@ def _block_update(q, k_cur, v_cur, m, l, acc, scale, mask=None):
     return m_new, l_new, acc_new
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   inner: str = "einsum"):
     """Blockwise ring attention. Local shapes: (B, S_local, H, D).
 
     The global sequence is the concatenation of shards in ring order
     (axis index 0..n-1). Causal masking uses global positions.
+
+    ``inner`` selects the per-block attention: "einsum" (fused XLA online
+    softmax — the safe default everywhere) or "flash" (the Pallas kernel
+    per block, merged via its logsumexp output — the long-context TPU
+    path: S_local^2 scores never touch HBM, and grouped K/V rotate the
+    ring UN-repeated, cutting ICI bytes by the group factor).
     """
+    _check_inner(inner)
     n = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    # Grouped K/V heads broadcast up before entering the ring (the ring
-    # rotates K/V shards; per-device memory stays O(S/n * H)).
-    k, v = repeat_kv(q, k, v)
+    if inner == "einsum":
+        # Grouped K/V heads broadcast up before entering the ring (the
+        # einsum wants equal head axes; flash fans them in-kernel).
+        k, v = repeat_kv(q, k, v)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def attend(k_cur, v_cur, m, l, acc, masked_src=None):
         """Block update; ``masked_src`` (trace-time None or a traced source
         index) applies the causal mask — only the diagonal block
-        (src == my_idx) ever needs one."""
+        (src == my_idx) ever needs one, and on the diagonal the local
+        causal mask equals the global one (same chunk offsets)."""
+        if inner == "flash":
+            return _flash_block_update(q, k_cur, v_cur, m, l, acc,
+                                       causal_block=masked_src is not None)
         mask = None
         if masked_src is not None:
             q_pos = my_idx * s_local + jax.lax.broadcasted_iota(
@@ -134,7 +174,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     return out.astype(q.dtype)
 
 
-def ring_attention_zigzag(q, k, v, axis_name: str, causal: bool = False):
+def ring_attention_zigzag(q, k, v, axis_name: str, causal: bool = False,
+                          inner: str = "einsum"):
     """Compute-BALANCED causal ring attention via the zigzag layout.
 
     Plain causal ring attention on the contiguous layout is load-imbalanced:
@@ -153,13 +194,17 @@ def ring_attention_zigzag(q, k, v, axis_name: str, causal: bool = False):
     Inputs/outputs use the SAME contiguous (B, S_local, H, D) contract as
     ring_attention — the zigzag lives entirely inside this function.
     """
+    _check_inner(inner)
     if not causal:
         # Without masking there is nothing to balance.
-        return ring_attention(q, k, v, axis_name=axis_name, causal=False)
+        return ring_attention(q, k, v, axis_name=axis_name, causal=False,
+                              inner=inner)
     n = jax.lax.axis_size(axis_name)
     if n == 1:
-        return ring_attention(q, k, v, axis_name=axis_name, causal=True)
-    k, v = repeat_kv(q, k, v)
+        return ring_attention(q, k, v, axis_name=axis_name, causal=True,
+                              inner=inner)
+    if inner == "einsum":
+        k, v = repeat_kv(q, k, v)
     my = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     if s_local % 2:
@@ -193,6 +238,9 @@ def ring_attention_zigzag(q, k, v, axis_name: str, causal: bool = False):
     ve, vl = to_zigzag(v)
 
     def upd(qh, k_cur, v_cur, m, l, acc, diag_mask):
+        if inner == "flash":
+            return _flash_block_update(qh, k_cur, v_cur, m, l, acc,
+                                       causal_block=diag_mask)
         mask = None
         if diag_mask:
             r = jax.lax.broadcasted_iota(jnp.int32, (half, half), 0)

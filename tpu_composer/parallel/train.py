@@ -62,6 +62,12 @@ class TrainConfig:
     # Sequence parallelism kicks in when the mesh's sp axis is > 1.
     use_ring_attention: bool = True  # False = replicate K/V (gather) instead
     sp_impl: str = "ring"  # ring | zigzag (balanced causal ring) | ulysses
+    # Per-block attention inside the sp strategy: "einsum" (fused XLA — the
+    # safe default everywhere) or "flash" (the Pallas kernel: ring blocks
+    # merge via its logsumexp output, ulysses runs it on the gathered
+    # sequence). Flash is the long-context TPU path — S_local^2 scores
+    # never touch HBM and grouped K/V ride the collectives un-repeated.
+    sp_inner: str = "einsum"
     # GPipe over the 'pp' mesh axis when > 0 and the mesh has pp > 1
     # (dense model only; microbatches must divide the global batch).
     pipeline_microbatches: int = 0
@@ -217,15 +223,41 @@ def abstract_train_state(tc: TrainConfig, mesh: Mesh) -> Dict:
     return {"params": params, "opt": opt}
 
 
-def _sp_attn_fn(mesh: Mesh, impl: str):
-    """Sequence-parallel attention as a partial-manual shard_map over 'sp'
-    only — dp/ep/tp shardings flow through under GSPMD, so the same wrapper
-    serves the plain, MoE, and pipelined (nested inside 'pp'-manual) paths."""
+def _sp_kwargs(impl: str, inner: str) -> dict:
+    """Strategy-specific spelling of the per-block attention choice: the
+    ring variants take inner= directly; ulysses takes a local attn_fn."""
+    if inner == "einsum":
+        return {}
+    if impl == "ulysses":
+        from tpu_composer.ops.attention import flash_attention
+
+        return {"attn_fn": flash_attention}
+    return {"inner": inner}
+
+
+def _sp_attn_fn(mesh: Mesh, impl: str, inner: str = "einsum"):
+    """Sequence-parallel attention as a shard_map over 'sp'.
+
+    einsum inner: partial-manual over 'sp' only — dp/ep/tp shardings flow
+    through under GSPMD, so the same wrapper serves the plain, MoE, and
+    pipelined (nested inside 'pp'-manual) paths.
+
+    flash inner: Mosaic kernels cannot be auto-partitioned, so the region
+    must be manual over EVERY mesh axis — the layout is spelled explicitly:
+    batch over the data axes, seq over 'sp', heads over 'tp' only when both
+    H and KV divide it (contiguous head slicing keeps the GQA group->kv
+    mapping correct per tp rank; otherwise heads replicate and GSPMD
+    reshards around the region)."""
     spec = P(None, "sp", None, None)  # (B, S, H, D)
-    inner = _SP_IMPLS[impl]
+    sp_fn = _SP_IMPLS[impl]
+    kw = _sp_kwargs(impl, inner)
 
     def body(q, k, v):
-        return inner(q, k, v, axis_name="sp", causal=True)
+        return sp_fn(q, k, v, axis_name="sp", causal=True, **kw)
+
+    batch_axes = tuple(
+        a for a in ("dp", "ep") if mesh.shape.get(a, 1) > 1
+    ) or None
 
     def wrapped(q, k, v, causal=True):
         assert causal, "sequence-parallel attention path is causal-only here"
@@ -234,6 +266,24 @@ def _sp_attn_fn(mesh: Mesh, impl: str):
         # to it rather than the concrete mesh it was built with.
         ctx = jax.sharding.get_abstract_mesh()
         use_mesh = None if (ctx is not None and not ctx.empty) else mesh
+        if inner == "flash":
+            tp = mesh.shape.get("tp", 1)
+            ok_tp = (tp > 1 and q.shape[2] % tp == 0
+                     and k.shape[2] % tp == 0)
+            if ok_tp and impl == "ulysses":
+                # Ulysses splits the PER-RANK heads over sp with its
+                # all_to_all; tp-slicing must leave that divisible.
+                sp_sz = mesh.shape.get("sp", 1)
+                ok_tp = ((q.shape[2] // tp) % sp_sz == 0
+                         and (k.shape[2] // tp) % sp_sz == 0)
+            head_ax = "tp" if ok_tp else None
+            qs = P(batch_axes, "sp", head_ax, None)
+            ks = P(batch_axes, "sp", head_ax, None)
+            attn = shard_map(
+                body, mesh=use_mesh,
+                in_specs=(qs, ks, ks), out_specs=qs, check_vma=False,
+            )
+            return attn(q, k, v)
         attn = shard_map(
             body, mesh=use_mesh, axis_names={"sp"},
             in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
@@ -248,9 +298,18 @@ def make_train_step(tc: TrainConfig, mesh: Mesh):
     (state, metrics) — jitted with explicit output shardings."""
     if tc.sp_impl not in _SP_IMPLS:
         raise ValueError(f"unknown sp_impl {tc.sp_impl!r} (want one of {sorted(_SP_IMPLS)})")
+    if tc.sp_inner not in ("einsum", "flash"):
+        raise ValueError(f"unknown sp_inner {tc.sp_inner!r} (einsum|flash)")
+    if tc.sp_inner == "flash" and _pipelined(tc, mesh):
+        # The GPipe stage is already a partial-manual region; a Mosaic
+        # kernel inside it would need yet another nested full-manual
+        # region, which shard_map does not support.
+        raise ValueError(
+            "sp_inner='flash' is not supported with pipeline parallelism"
+        )
     opt = _optimizer(tc)
     use_sp = tc.use_ring_attention and mesh.shape.get("sp", 1) > 1
-    sp_inner = _SP_IMPLS[tc.sp_impl]
+    sp_fn = _SP_IMPLS[tc.sp_impl]
 
     # MoE batches shard over both data axes (ep doubles as a data axis for
     # the non-expert params); dense batches shard over dp alone.
@@ -264,12 +323,15 @@ def make_train_step(tc: TrainConfig, mesh: Mesh):
             pipelined_loss_fn, config=tc.model, mesh=mesh,
             n_microbatches=tc.pipeline_microbatches,
             attn_fn=(
-                functools.partial(sp_inner, axis_name="sp") if use_sp else None
+                functools.partial(sp_fn, axis_name="sp",
+                                  **_sp_kwargs(tc.sp_impl, tc.sp_inner))
+                if use_sp else None
             ),
             seq_axis="sp" if use_sp else None,
         )
     else:
-        attn_fn = _sp_attn_fn(mesh, tc.sp_impl) if use_sp else None
+        attn_fn = (_sp_attn_fn(mesh, tc.sp_impl, tc.sp_inner)
+                   if use_sp else None)
         mod = tc._model_mod()
         loss = functools.partial(mod.loss_fn, config=tc.model, attn_fn=attn_fn)
 
