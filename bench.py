@@ -165,6 +165,20 @@ def bench_accelerator():
                 out["archived_tpu_probe"] = json.load(f)
         except (OSError, ValueError):
             pass
+        else:
+            stages = out["archived_tpu_probe"].get("stages", {})
+            fa = stages.get("flash_attn", {})
+            if "configs" not in fa and "fwd_speedup" in fa:
+                # Archive predates the r4 probe fix: its flash numbers were
+                # measured on tensors built (B, H, S, D) against APIs taking
+                # (B, S, H, D) — a degenerate seq-4, 1024-head shape (see
+                # docs/PERF.md "What the r3 archived numbers really
+                # measured"). Numerics_ok stands; the timings do not.
+                fa["stale_shape_bug"] = (
+                    "speedups measured on a transposed degenerate shape"
+                    " (seq 4, heads 1024); superseded by the r4 sweep —"
+                    " see docs/PERF.md"
+                )
     return out
 
 
