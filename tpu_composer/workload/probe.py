@@ -50,7 +50,10 @@ STAGE_TIMEOUTS_S: Dict[str, float] = {
     "flash_attn": 900.0,
     "qualify": 420.0,
     "qualify_large": 420.0,
-    "decode": 420.0,
+    # decode now compiles ~8 programs on a cold cache (batch-8 + batch-1
+    # generate, prefills, draft roll, verify chunks) through the
+    # remote-compile tunnel — same headroom rationale as flash_attn.
+    "decode": 900.0,
 }
 
 _CHILD = r"""
@@ -143,7 +146,7 @@ except Exception as e:  # noqa: BLE001 - enhancement pass degrades, never fails
 
 # Serving throughput, TPU only: KV-cached greedy decode tokens/s for the
 # bf16 baseline vs the fully-quantized path (int8 weights + int8 cache).
-rearm(_timeouts.get("decode", 420.0))
+rearm(_timeouts.get("decode", 900.0))
 t0 = time.time()
 try:
     if jax.default_backend() == "tpu":
@@ -432,6 +435,46 @@ def decode_throughput_on_chip(
     out["quant_speedup"] = round(
         out["int8_w_int8_kv_tokens_per_s"] / out["bf16_tokens_per_s"], 2
     )
+
+    # Speculative decoding (int8 self-draft, batch 1 — its latency-mode
+    # shape) vs plain greedy at batch 1: the serving stack's third lever,
+    # so its on-chip claim carries hardware numbers like the other two.
+    # Guarded so a failure here cannot discard the decode evidence already
+    # in ``out`` (same keep-earlier-data pattern as the flash sweep).
+    try:
+        from tpu_composer.models.speculative import speculative_generate
+
+        p1 = prompt[:1]
+        base = jax.jit(
+            lambda pp, tk: generate(pp, tk, c, max_new_tokens=new_tokens)
+        )
+
+        def spec(pp, qp, tk):
+            # No outer jit: the draft-accept loop is host-driven by design
+            # (acceptance counts are data-dependent); its prefill/verify
+            # chunks are jitted inside. That host round-trip is part of
+            # the honest serving latency.
+            return speculative_generate(
+                pp, qp, tk, c, max_new_tokens=new_tokens, gamma=4,
+                # The verify chunk can write up to gamma past the last
+                # kept token; the cache must hold it.
+                max_seq=prompt_len + new_tokens + 4,
+            )
+        base(params, p1).block_until_ready()
+        spec(params, qparams, p1).block_until_ready()
+        best_b = best_s = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            base(params, p1).block_until_ready()
+            best_b = min(best_b, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            spec(params, qparams, p1).block_until_ready()
+            best_s = min(best_s, time.perf_counter() - t0)
+        out["greedy_b1_tokens_per_s"] = round(new_tokens / best_b, 1)
+        out["spec_b1_tokens_per_s"] = round(new_tokens / best_s, 1)
+        out["spec_speedup"] = round(best_b / best_s, 2)
+    except Exception as e:  # noqa: BLE001 - keep the quant evidence
+        out["spec_error"] = f"{type(e).__name__}: {e}"
     return out
 
 
