@@ -440,10 +440,15 @@ def decode_throughput_on_chip(
     # shape) vs plain greedy at batch 1: the serving stack's third lever,
     # so its on-chip claim carries hardware numbers like the other two.
     # Guarded so a failure here cannot discard the decode evidence already
-    # in ``out`` (same keep-earlier-data pattern as the flash sweep).
+    # in ``out`` (same keep-earlier-data pattern as the flash sweep), and
+    # the quant numbers are emitted as a partial stage record FIRST — a
+    # watchdog hard-exit mid-spec (which no try/except survives) must not
+    # take minutes of already-measured evidence with it.
+    print("STAGE_PARTIAL decode " + __import__("json").dumps(out), flush=True)
     try:
         from tpu_composer.models.speculative import speculative_generate
 
+        gamma = 4
         p1 = prompt[:1]
         base = jax.jit(
             lambda pp, tk: generate(pp, tk, c, max_new_tokens=new_tokens)
@@ -455,10 +460,10 @@ def decode_throughput_on_chip(
             # chunks are jitted inside. That host round-trip is part of
             # the honest serving latency.
             return speculative_generate(
-                pp, qp, tk, c, max_new_tokens=new_tokens, gamma=4,
+                pp, qp, tk, c, max_new_tokens=new_tokens, gamma=gamma,
                 # The verify chunk can write up to gamma past the last
                 # kept token; the cache must hold it.
-                max_seq=prompt_len + new_tokens + 4,
+                max_seq=prompt_len + new_tokens + gamma,
             )
         base(params, p1).block_until_ready()
         spec(params, qparams, p1).block_until_ready()
@@ -667,6 +672,51 @@ out["qualify_large_hbm"] = {
     "hbm_gib": 16,
     "seconds": round(time.time() - t0, 2),
 }
+
+# Serving path: the decode-stage model's generate() programs (bf16 and the
+# fully-quantized int8-weights + int8-KV variant) compile for the v5e
+# target — the whole prefill + lax.scan decode loop lowers through
+# XLA:TPU, so the serving claims carry compile evidence on relay-dead
+# rounds too. Guarded: a regression in this newest target must not
+# discard the three compile-evidence targets already in ``out``.
+t0 = time.time()
+try:
+    from tpu_composer.models.decode import generate
+    from tpu_composer.models.quant import quantize_decode_params
+    from tpu_composer.models.transformer import init_params
+
+    def abs_on_dev(tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=SingleDeviceSharding(devs[0])
+            ),
+            tree,
+        )
+
+    sc = ModelConfig(vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
+                     n_kv_heads=4, d_ff=4096, max_seq=256,
+                     dtype=jnp.bfloat16)
+    prompt = jax.ShapeDtypeStruct((8, 128), jnp.int32,
+                                  sharding=SingleDeviceSharding(devs[0]))
+    sp0 = jax.eval_shape(lambda: init_params(sc, jax.random.key(0)))
+    jax.jit(
+        lambda pp, tk: generate(pp, tk, sc, max_new_tokens=128)
+    ).lower(abs_on_dev(sp0), prompt).compile()
+    qp = abs_on_dev(jax.eval_shape(quantize_decode_params, sp0))
+    jax.jit(
+        lambda pp, tk: generate(pp, tk, sc, max_new_tokens=128,
+                                kv_quant=True)
+    ).lower(qp, prompt).compile()
+    out["decode_serving_v5e"] = {
+        "ok": True, "seconds": round(time.time() - t0, 2),
+        "model": "d1024 L8 H16 kv4 ff4096",
+        "variants": ["bf16", "int8_w_int8_kv"],
+    }
+except Exception as e:
+    out["decode_serving_v5e"] = {
+        "ok": False, "seconds": round(time.time() - t0, 2),
+        "error": f"{type(e).__name__}: {e}",
+    }
 print("AOT_RESULT " + json.dumps(out), flush=True)
 """
 
@@ -731,6 +781,7 @@ def _drive_child(
 
     failed_stage: Optional[str] = None
     idx = 0
+    partials: Dict[str, Any] = {}
 
     def drain() -> None:
         nonlocal idx
@@ -740,6 +791,17 @@ def _drive_child(
             if line.startswith("STAGE_RESULT "):
                 rec = json.loads(line[len("STAGE_RESULT "):])
                 stages[rec.pop("stage")] = rec
+            elif line.startswith("STAGE_PARTIAL "):
+                # Provisional evidence a stage emits before entering a
+                # risky section (e.g. decode's quant numbers before the
+                # speculative bench): preserved if the stage later dies in
+                # a way no in-child except can catch (watchdog hard-exit,
+                # parent kill); superseded by the stage's final record.
+                name, _, payload = line[len("STAGE_PARTIAL "):].partition(" ")
+                try:
+                    partials[name] = json.loads(payload)
+                except ValueError:
+                    pass
 
     for stage in order:
         deadline = time.monotonic() + timeouts[stage]
@@ -765,6 +827,12 @@ def _drive_child(
             proc.kill()
         if proc.returncode not in (0, None) and order[-1] not in stages:
             failed_stage = next(s for s in order if s not in stages)
+
+    # Fold in partials for stages that never produced a final record —
+    # marked so consumers know the stage died after these numbers.
+    for name, rec in partials.items():
+        if name not in stages:
+            stages[name] = {**rec, "partial": True}
 
     t_err.join(timeout=5)
     # 40 lines of tail: enough to keep a full faulthandler thread dump (the
