@@ -135,3 +135,105 @@ def test_200_cycle_teardown_storm_with_purge_races():
     leftovers = [k for k in store.keys()
                  if k[0] in ("ComposabilityRequest", "ComposableResource")]
     assert leftovers == [], leftovers[:10]
+
+
+def test_wire_path_teardown_cycles():
+    """The same storm through the KubeStore + fake-apiserver wire path —
+    the exact stack BENCH_r03 crashed on (watch-cache staleness made the
+    finalizer-removal PUT 404 loop). Fewer cycles than the in-proc storm:
+    each cycle pays real HTTP round trips."""
+    from tests.fake_apiserver import (
+        FakeApiServer,
+        core_node_doc,
+        operator_resources,
+    )
+
+    from tpu_composer import GROUP, VERSION
+    from tpu_composer.runtime.kubestore import (
+        CHIP_RESOURCE,
+        KubeConfig,
+        KubeStore,
+    )
+
+    srv = FakeApiServer(operator_resources(GROUP, VERSION))
+    srv.start()
+    store = None
+    mgr = None
+    try:
+        for i in range(4):
+            srv.put_object(
+                "/api/v1/nodes",
+                core_node_doc(f"worker-{i}", chips=8,
+                              chip_resource=CHIP_RESOURCE),
+            )
+        store = KubeStore(config=KubeConfig(host=srv.url),
+                          watch_reconnect_s=0.05)
+        pool = InMemoryPool(chips={"tpu-v4": 32})
+        agent = FakeNodeAgent(pool=pool)
+        mgr = Manager(store, health_addr="127.0.0.1:0")
+        mgr.add_controller(ComposabilityRequestReconciler(
+            store, pool,
+            timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.02,
+                                 running_poll=5.0)))
+        mgr.add_controller(ComposableResourceReconciler(
+            store, pool, agent,
+            timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.02,
+                                  detach_poll=0.05, detach_fast=0.02,
+                                  busy_poll=0.05)))
+        mgr.start(workers_per_controller=2)
+
+        fails: list = []
+
+        def cycle(i: int) -> None:
+            name = f"wire-{i}"
+            store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name=name),
+                spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                    type="tpu", model="tpu-v4", size=4)),
+            ))
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                r = store.try_get(ComposabilityRequest, name)
+                if r is not None and r.status.state == "Running":
+                    break
+                time.sleep(0.01)
+            else:
+                fails.append(f"{name}: never Running")
+                return
+            store.delete(ComposabilityRequest, name)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if store.try_get(ComposabilityRequest, name) is None:
+                    return
+                time.sleep(0.01)
+            fails.append(f"{name}: teardown never completed")
+
+        lanes = []
+        for lane in range(2):
+            def run(lane=lane):
+                for j in range(15):
+                    i = lane * 15 + j
+                    try:
+                        cycle(i)
+                    except Exception as e:  # noqa: BLE001 - lane must FAIL
+                        fails.append(f"wire-{i}: lane crashed: {e!r}")
+                        return
+
+            t = threading.Thread(target=run)
+            t.start()
+            lanes.append(t)
+        for t in lanes:
+            t.join()
+        assert not fails, fails[:10]
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if pool.free_chips("tpu-v4") == 32:
+                break
+            time.sleep(0.05)
+        assert pool.free_chips("tpu-v4") == 32
+    finally:
+        if mgr is not None:
+            mgr.stop()
+        if store is not None:
+            store.close()
+        srv.stop()

@@ -269,6 +269,12 @@ class KubeStore:
         # _encode can write back the server's exact token instead of dropping
         # the precondition (which would turn CAS PUTs into blind overwrites).
         self._rv_raw: Dict[Tuple[str, str], Tuple[int, str]] = {}
+        # True once any non-numeric resourceVersion is seen: the crc32
+        # digests standing in for opaque RVs are NOT ordered, so every
+        # rv-comparison optimization (reflector tombstones, newer-wins
+        # folds) must disable itself and fall back to stream-order-only
+        # semantics.
+        self._opaque_rv = False
 
         base = f"/apis/{GROUP}/{VERSION}"
         self._routes: Dict[str, _KindRoute] = {
@@ -392,6 +398,7 @@ class KubeStore:
             # remember the raw token for faithful write-back (ADVICE r2).
             digest = zlib.crc32(rv.encode()) or 1
             d.setdefault("metadata", {})["resourceVersion"] = digest
+            self._opaque_rv = True
             name = str(meta.get("name", ""))
             if name:
                 with self._lock:
@@ -610,7 +617,9 @@ class KubeStore:
                     if decoded.metadata.finalizers:
                         refl.note_write(decoded)
                     else:
-                        refl.note_delete(name)
+                        refl.note_delete(
+                            name, decoded.metadata.resource_version
+                        )
                 except Exception:
                     refl.note_delete(name)
 
@@ -849,9 +858,18 @@ class _Reflector:
     events are applied in stream order by a single consumer thread."""
 
     def __init__(self, store: "KubeStore", kind: str, reconnect_s: float) -> None:
+        self._store = store
         self._kind = kind
         self._events: "queue.Queue[Any]" = queue.Queue()
         self._cache: Dict[str, ApiObject] = {}
+        # name -> rv at deletion. A write RESPONSE folded by note_write can
+        # race the object's purge: without a tombstone, a response carrying
+        # rv N landing after the DELETED(rv > N) pops the entry re-inserts
+        # a zombie the server no longer has — controllers then reconcile a
+        # child that cannot be deleted, wedging teardown (found by the
+        # wire-path soak). rvs grow monotonically (ours and etcd's), so a
+        # re-created same-name object always clears its tombstone.
+        self._tombstones: Dict[str, int] = {}
         self._subs: List["queue.Queue[WatchEvent]"] = []
         self._lock = threading.Lock()
         self._synced = threading.Event()
@@ -891,11 +909,23 @@ class _Reflector:
                 self._synced.set()
                 continue
             name = evt.obj.metadata.name
+            rv = evt.obj.metadata.resource_version
             with self._lock:
+                ordered = not self._store._opaque_rv
                 if evt.type == DELETED:
                     self._cache.pop(name, None)
-                else:
+                    if ordered:
+                        self._note_tombstone(name, rv)
+                elif not ordered:
+                    # Opaque (digested) RVs are unordered: apply events in
+                    # stream order unconditionally, as before tombstones.
                     self._cache[name] = evt.obj
+                else:
+                    cur = self._cache.get(name)
+                    if (rv > self._tombstones.get(name, -1)
+                            and (cur is None
+                                 or cur.metadata.resource_version <= rv)):
+                        self._cache[name] = evt.obj
                 subs = list(self._subs)
             for q in subs:
                 q.put(WatchEvent(evt.type, evt.obj.deepcopy()))
@@ -921,24 +951,55 @@ class _Reflector:
     def note_write(self, obj: ApiObject) -> None:
         """Fold a write *response* into the cache so a reconcile that writes
         then immediately re-reads sees its own write. RV-guarded: never
-        regress state a newer watch event already applied. A response whose
+        regress state a newer watch event already applied, and never
+        resurrect past a deletion tombstone (a response in flight while the
+        object purges must not re-insert a zombie). A response whose
         deletionTimestamp is set with no finalizers left means the server
         purged the object on this write (the remove-last-finalizer PUT)."""
         name = obj.metadata.name
         rv = obj.metadata.resource_version
         purged = obj.metadata.deletion_timestamp and not obj.metadata.finalizers
+        ordered = not self._store._opaque_rv
         with self._lock:
+            if ordered and rv <= self._tombstones.get(name, -1):
+                return  # raced a deletion the cache already observed
             cur = self._cache.get(name)
             if purged:
                 if cur is None or cur.metadata.resource_version <= rv:
                     self._cache.pop(name, None)
+                if ordered:
+                    self._note_tombstone(name, rv)
                 return
             if cur is None or cur.metadata.resource_version <= rv:
                 self._cache[name] = obj.deepcopy()
 
-    def note_delete(self, name: str) -> None:
+    def note_delete(self, name: str, rv: Optional[int] = None) -> None:
+        """``rv``: the purged object's final resourceVersion when the
+        DELETE response carried one — tombstoning at it closes the
+        resurrect window even when the object was never cached. Falls back
+        to the cached copy's rv (blocks responses no newer than that; the
+        terminating MODIFIED still lands). Residual corner: undecodable
+        response AND uncached object leaves no tombstone."""
+        if self._store._opaque_rv:
+            with self._lock:
+                self._cache.pop(name, None)
+            return
         with self._lock:
-            self._cache.pop(name, None)
+            cur = self._cache.pop(name, None)
+            if rv is not None:
+                self._note_tombstone(name, rv)
+            elif cur is not None:
+                self._note_tombstone(name, cur.metadata.resource_version)
+
+    def _note_tombstone(self, name: str, rv: int) -> None:
+        """Record (monotonic max) a deletion rv; caller holds _lock."""
+        self._tombstones[name] = max(rv, self._tombstones.get(name, -1))
+        if len(self._tombstones) > 4096:
+            # Bounded memory: drop the oldest half (insertion order). Old
+            # tombstones only matter while writes from that object's era
+            # can still be in flight — seconds, not thousands of objects.
+            for key in list(self._tombstones)[:2048]:
+                del self._tombstones[key]
 
     # ------------------------------------------------------------------
     # fan-out subscriptions (KubeStore.watch)
