@@ -11,8 +11,11 @@ in a few seconds; any wedged teardown fails the lane by timeout.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+
+import pytest
 
 from tpu_composer.agent.fake import FakeNodeAgent
 from tpu_composer.api import (
@@ -232,6 +235,173 @@ def test_wire_path_teardown_cycles():
             time.sleep(0.05)
         assert pool.free_chips("tpu-v4") == 32
     finally:
+        if mgr is not None:
+            mgr.stop()
+        if store is not None:
+            store.close()
+        srv.stop()
+
+
+@pytest.mark.skipif(
+    os.environ.get("TPUC_CHAOS") != "1",
+    reason="chaos storm is opt-in (TPUC_CHAOS=1): ~90s per seed",
+)
+def test_wire_chaos_storm():
+    """Opt-in chaos: create/resize/delete lanes racing a node
+    delete/recreate adversary over the wire path, with the syncer
+    reclaiming orphans. Ran clean on 7 seeds when the r4 tombstone fix
+    landed; kept runnable for future race hunts."""
+    import random
+
+    from tests.fake_apiserver import (
+        FakeApiServer,
+        core_node_doc,
+        operator_resources,
+    )
+
+    from tpu_composer import GROUP, VERSION
+    from tpu_composer.api.types import Node
+    from tpu_composer.runtime.kubestore import (
+        CHIP_RESOURCE,
+        KubeConfig,
+        KubeStore,
+    )
+    from tpu_composer.runtime.store import ConflictError, NotFoundError
+
+    seed = int(os.environ.get("TPUC_CHAOS_SEED", "1"))
+    # Per-thread rngs: one shared Random across 4 threads would make the
+    # seed knob non-reproducible (draw order depends on interleaving).
+    lane_rngs = [random.Random(seed * 100 + i) for i in range(3)]
+    chaos_rng = random.Random(seed * 100 + 99)
+    srv = FakeApiServer(operator_resources(GROUP, VERSION))
+    srv.start()
+    node_prefix = "/api/v1/nodes"
+    store = mgr = None
+    stop = threading.Event()
+    try:
+        for i in range(6):
+            srv.put_object(node_prefix, core_node_doc(
+                f"worker-{i}", chips=8, chip_resource=CHIP_RESOURCE))
+        store = KubeStore(config=KubeConfig(host=srv.url),
+                          watch_reconnect_s=0.05)
+        pool = InMemoryPool(chips={"tpu-v4": 48})
+        agent = FakeNodeAgent(pool=pool)
+        mgr = Manager(store, health_addr="127.0.0.1:0")
+        mgr.add_controller(ComposabilityRequestReconciler(
+            store, pool, timing=RequestTiming(
+                updating_poll=0.05, cleaning_poll=0.02, running_poll=2.0)))
+        mgr.add_controller(ComposableResourceReconciler(
+            store, pool, agent, timing=ResourceTiming(
+                attach_poll=0.05, visibility_poll=0.02, detach_poll=0.05,
+                detach_fast=0.02, busy_poll=0.05, health_poll=1.0)))
+        mgr.add_runnable(UpstreamSyncer(store, pool, period=0.1, grace=0.3))
+        mgr.start(workers_per_controller=2)
+
+        fails: list = []
+
+        def lane(lane_id: int) -> None:
+            rng = lane_rngs[lane_id]
+            for j in range(8):
+                name = f"chaos-{lane_id}-{j}"
+                size = rng.choice([4, 8])
+                try:
+                    store.create(ComposabilityRequest(
+                        metadata=ObjectMeta(name=name),
+                        spec=ComposabilityRequestSpec(
+                            resource=ResourceDetails(
+                                type="tpu", model="tpu-v4", size=size))))
+                except Exception as e:  # noqa: BLE001
+                    fails.append(f"{name}: create {e!r}")
+                    continue
+                deadline = time.monotonic() + 40
+                while time.monotonic() < deadline:
+                    r = store.try_get(ComposabilityRequest, name)
+                    if r is not None and r.status.state == "Running":
+                        break
+                    time.sleep(0.02)
+                else:
+                    fails.append(f"{name}: never Running")
+                    continue
+                if rng.random() < 0.5:
+                    for _ in range(10):
+                        try:
+                            r = store.get(ComposabilityRequest, name)
+                            r.spec.resource.size = 8 if size == 4 else 4
+                            store.update(r)
+                            break
+                        except (ConflictError, NotFoundError):
+                            time.sleep(0.02)
+                    deadline = time.monotonic() + 40
+                    while time.monotonic() < deadline:
+                        r = store.try_get(ComposabilityRequest, name)
+                        if r is None or (
+                            r.status.state == "Running"
+                            and sum(len(rs.device_ids)
+                                    for rs in r.status.resources.values())
+                            == r.spec.resource.size
+                        ):
+                            break
+                        time.sleep(0.02)
+                    else:
+                        fails.append(f"{name}: resize never settled")
+                        continue
+                try:
+                    store.delete(ComposabilityRequest, name)
+                except NotFoundError:
+                    pass
+                deadline = time.monotonic() + 40
+                while time.monotonic() < deadline:
+                    if store.try_get(ComposabilityRequest, name) is None:
+                        break
+                    time.sleep(0.02)
+                else:
+                    fails.append(f"{name}: teardown never completed")
+
+        def node_chaos() -> None:
+            rng = chaos_rng
+            while not stop.is_set():
+                time.sleep(rng.uniform(1.5, 3.0))
+                nm = f"worker-{rng.randrange(6)}"
+                try:
+                    store.delete(Node, nm)
+                except Exception:  # noqa: BLE001 - adversary, best effort
+                    pass
+                time.sleep(rng.uniform(0.3, 0.8))
+                try:
+                    srv.put_object(node_prefix, core_node_doc(
+                        nm, chips=8, chip_resource=CHIP_RESOURCE))
+                except Exception:  # noqa: BLE001
+                    pass
+
+        def lane_guard(i: int) -> None:
+            try:
+                lane(i)
+            except Exception as e:  # noqa: BLE001 - a dead lane must FAIL
+                fails.append(f"lane-{i} crashed: {e!r}")
+
+        lanes = [threading.Thread(target=lane_guard, args=(i,))
+                 for i in range(3)]
+        nc = threading.Thread(target=node_chaos)
+        for t in lanes:
+            t.start()
+        nc.start()
+        for t in lanes:
+            t.join()
+        stop.set()
+        nc.join()
+        assert not fails, fails[:8]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if (not store.list(ComposabilityRequest)
+                    and not store.list(ComposableResource)
+                    and pool.free_chips("tpu-v4") == 48):
+                break
+            time.sleep(0.1)
+        assert pool.free_chips("tpu-v4") == 48
+        assert store.list(ComposabilityRequest) == []
+        assert store.list(ComposableResource) == []
+    finally:
+        stop.set()
         if mgr is not None:
             mgr.stop()
         if store is not None:
