@@ -520,3 +520,54 @@ class TestTransportErrors:
         with pytest.raises(StoreError):
             ks.list(ComposabilityRequest)
         ks.close()
+
+
+class TestReflectorTombstones:
+    def test_stale_write_response_cannot_resurrect_purged_object(self, kstore):
+        """The r4 wire-soak find, pinned deterministically: a write
+        RESPONSE (note_write) carrying a pre-purge rv that lands AFTER the
+        purge's DELETED popped the cache must not re-insert a zombie —
+        controllers would reconcile an object the server no longer has and
+        teardown would wedge."""
+        # Spin the reflector up FIRST (a controller's cache is live long
+        # before the racing objects exist).
+        assert kstore.try_get(ComposabilityRequest, "zombie") is None
+        req = kstore.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="zombie"),
+            spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                type="tpu", model="tpu-v4", size=1)),
+        ))
+        stale = req.deepcopy()  # rv N: the in-flight response's payload
+        kstore.delete(ComposabilityRequest, "zombie")  # purges (no finalizer)
+        assert wait_for(
+            lambda: kstore.try_get(ComposabilityRequest, "zombie") is None
+        )
+        refl = kstore._reflectors["ComposabilityRequest"]
+        refl.note_write(stale)  # the raced response lands last
+        assert kstore.try_get(ComposabilityRequest, "zombie") is None, (
+            "stale write response resurrected a purged object"
+        )
+
+    def test_recreated_name_clears_its_tombstone(self, kstore):
+        """A new incarnation under the same name has a higher rv than the
+        tombstone and must be fully visible."""
+        def make():
+            return ComposabilityRequest(
+                metadata=ObjectMeta(name="phoenix"),
+                spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                    type="tpu", model="tpu-v4", size=1)),
+            )
+
+        kstore.create(make())
+        kstore.delete(ComposabilityRequest, "phoenix")
+        assert wait_for(
+            lambda: kstore.try_get(ComposabilityRequest, "phoenix") is None
+        )
+        kstore.create(make())
+        # Stays visible: the rv-guarded DELETED pop cannot evict the new
+        # incarnation, and its rv clears the old tombstone.
+        assert wait_for(
+            lambda: kstore.try_get(ComposabilityRequest, "phoenix") is not None
+        )
+        time.sleep(0.3)  # let any straggler DELETED from round 1 drain
+        assert kstore.try_get(ComposabilityRequest, "phoenix") is not None

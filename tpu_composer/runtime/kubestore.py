@@ -913,7 +913,15 @@ class _Reflector:
             with self._lock:
                 ordered = not self._store._opaque_rv
                 if evt.type == DELETED:
-                    self._cache.pop(name, None)
+                    cur = self._cache.get(name)
+                    # rv-guarded pop: a late DELETED for a PREVIOUS
+                    # incarnation must not evict a newer same-name object a
+                    # write response already folded in (transient but real
+                    # read-None window). The tombstone still lands at the
+                    # delete's rv — it only blocks writes <= that rv.
+                    if (not ordered or cur is None
+                            or cur.metadata.resource_version <= rv):
+                        self._cache.pop(name, None)
                     if ordered:
                         self._note_tombstone(name, rv)
                 elif not ordered:
