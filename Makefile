@@ -20,6 +20,11 @@ test-fast:
 bench:
 	$(PYTHON) bench.py
 
+## watch-relay: poll the TPU tunnel relay; auto-capture the full on-chip
+## probe to bench_artifacts/ the moment it answers (run at round start)
+watch-relay:
+	$(PYTHON) -m tpu_composer.workload.relay_watch
+
 ## manifests: regenerate CRD YAML from api/types.py (controller-gen analog)
 manifests:
 	$(PYTHON) -m tpu_composer.api.crdgen deploy/crds

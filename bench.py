@@ -120,6 +120,7 @@ def bench_accelerator():
     import os
 
     from tpu_composer.workload.probe import staged_accelerator_probe
+    from tpu_composer.workload.relay_watch import archive_tpu_probe
 
     out = staged_accelerator_probe(
         repo_root=os.path.dirname(os.path.abspath(__file__))
@@ -128,8 +129,10 @@ def bench_accelerator():
     # it; r03 diagnosed the hang to make_c_api_client against a dead relay).
     # When the live probe could not reach the chip, attach the most recent
     # archived on-TPU probe (refreshed whenever the relay is up during the
-    # round) so the round still carries real-hardware evidence — clearly
-    # labeled with its capture time, never passed off as a live run.
+    # round — the relay watcher captures mid-round, see
+    # workload/relay_watch.py) so the round still carries real-hardware
+    # evidence — clearly labeled with its capture time, never passed off as
+    # a live run.
     backend = out.get("stages", {}).get("backend_init", {}).get("backend")
     art = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
@@ -139,24 +142,16 @@ def bench_accelerator():
         # Refresh the archive so the next relay outage serves numbers no
         # staler than the last time the chip was reachable.
         try:
-            os.makedirs(os.path.dirname(art), exist_ok=True)
-            with open(art, "w") as f:
-                json.dump(
-                    {
-                        "captured_at": time.strftime(
-                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
-                        ),
-                        "note": (
-                            "Live on-TPU staged probe, archived because the "
-                            "axon tunnel relay dies intermittently and "
-                            "end-of-round bench runs then cannot reach the "
-                            "chip. All numbers ran on backend=tpu."
-                        ),
-                        "stages": out["stages"],
-                        "completed": out["completed"],
-                    },
-                    f, indent=1,
-                )
+            archive_tpu_probe(
+                out,
+                note=(
+                    "Live on-TPU staged probe, archived because the "
+                    "axon tunnel relay dies intermittently and "
+                    "end-of-round bench runs then cannot reach the "
+                    "chip. All numbers ran on backend=tpu."
+                ),
+                path=art,
+            )
         except OSError:
             pass
     else:
@@ -280,29 +275,120 @@ def bench_attach_cluster(cycles: int = 20, size: int = 8,
     }
 
 
+# The driver records only the last 2000 characters of bench output and
+# parses the final JSON line out of that tail; BENCH_r04.json came back
+# parsed=null because the headline line embedded the multi-KB accelerator
+# blob and the tail began mid-line (VERDICT r4 missing #2). The headline is
+# therefore summarized to a hard budget and the full blob goes to
+# bench_artifacts/bench_full.json.
+HEADLINE_BUDGET_CHARS = 1800
+
+
+def _stage_summary(stages: dict) -> dict:
+    """Headline-worthy fields per stage — numbers only, never blobs."""
+    out: dict = {}
+    picks = {
+        "backend_init": ("backend", "n_devices", "device_kind"),
+        "flash_attn": ("fwd_speedup_long", "bwd_speedup_long", "numerics_ok",
+                       "skipped", "error"),
+        "qualify": ("tflops", "mfu", "tokens_per_s", "allreduce_gbps",
+                    "backend"),
+        "qualify_large": ("tflops", "mfu", "tokens_per_s", "skipped",
+                          "error"),
+        "decode": ("bf16_tokens_per_s", "int8_w_int8_kv_tokens_per_s",
+                   "quant_speedup", "spec_speedup", "skipped", "error"),
+    }
+    for stage, keys in picks.items():
+        rec = stages.get(stage)
+        if not isinstance(rec, dict):
+            continue
+        kept = {k: rec[k] for k in keys if k in rec}
+        if "error" in kept:
+            kept["error"] = str(kept["error"])[:120]
+        if kept:
+            out[stage] = kept
+    return out
+
+
+def summarize_accelerator(accel: dict) -> dict:
+    """Compact accelerator summary for the headline: stage names + headline
+    fields only. The full record (configs, diagnoses, env, AOT details)
+    lives in bench_artifacts/bench_full.json."""
+    out: dict = {
+        "completed": accel.get("completed", []),
+        "stages": _stage_summary(accel.get("stages", {})),
+    }
+    if accel.get("failed_stage"):
+        out["failed_stage"] = accel["failed_stage"]
+    arch = accel.get("archived_tpu_probe")
+    if isinstance(arch, dict):
+        out["archived_tpu_probe"] = {
+            "captured_at": arch.get("captured_at"),
+            "completed": arch.get("completed", []),
+            "stages": _stage_summary(arch.get("stages", {})),
+        }
+    aot = accel.get("tpu_aot_compile")
+    if isinstance(aot, dict):
+        out["tpu_aot_compile"] = {
+            k: v.get("ok") if isinstance(v, dict) else v
+            for k, v in aot.items()
+        }
+    return out
+
+
 def main():
+    import os
+
     attach_raw = bench_attach_to_ready()
     # Honest comparison mode: the full cluster path (KubeStore + fake
     # apiserver) with a 10 ms RTT charged on every wire request.
     attach_inj = bench_attach_cluster(cycles=20, rtt_s=APISERVER_RTT_S)
     accel = bench_accelerator()
+    extra = {
+        "attach_p90_ms": round(attach_inj["p90"], 3),
+        "attach_max_ms": round(attach_inj["max"], 3),
+        "cycles": attach_inj["cycles"],
+        "injected_store_latency_ms": APISERVER_RTT_S * 1e3,
+        "raw_inproc_p50_ms": round(attach_raw["p50"], 3),
+        "raw_inproc_p90_ms": round(attach_raw["p90"], 3),
+        "baseline_p50_ms": REFERENCE_P50_MS,
+        "accelerator": summarize_accelerator(accel),
+        "full_record": "bench_artifacts/bench_full.json",
+    }
     out = {
         "metric": "attach_to_ready_p50",
         "value": round(attach_inj["p50"], 3),
         "unit": "ms",
         "vs_baseline": round(REFERENCE_P50_MS / attach_inj["p50"], 1),
-        "extra": {
-            "attach_p90_ms": round(attach_inj["p90"], 3),
-            "attach_max_ms": round(attach_inj["max"], 3),
-            "cycles": attach_inj["cycles"],
-            "injected_store_latency_ms": APISERVER_RTT_S * 1e3,
-            "raw_inproc_p50_ms": round(attach_raw["p50"], 3),
-            "raw_inproc_p90_ms": round(attach_raw["p90"], 3),
-            "baseline_p50_ms": REFERENCE_P50_MS,
-            "accelerator": accel,
-        },
+        "extra": extra,
     }
-    print(json.dumps(out))
+
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_artifacts")
+    try:
+        os.makedirs(art_dir, exist_ok=True)
+        with open(os.path.join(art_dir, "bench_full.json"), "w") as f:
+            json.dump({"headline": {k: v for k, v in out.items()
+                                    if k != "extra"},
+                       "extra": {**extra, "accelerator": accel}}, f, indent=1)
+    except OSError:
+        pass
+
+    line = json.dumps(out)
+    if len(line) > HEADLINE_BUDGET_CHARS:
+        # Degrade the summary, never the attach numbers: drop the nested
+        # stage summaries first, then the whole accelerator block.
+        extra["accelerator"] = {
+            "completed": accel.get("completed", []),
+            "failed_stage": accel.get("failed_stage"),
+            "archived_captured_at": (accel.get("archived_tpu_probe") or {})
+            .get("captured_at"),
+        }
+        line = json.dumps(out)
+        if len(line) > HEADLINE_BUDGET_CHARS:
+            del out["extra"]["accelerator"]
+            line = json.dumps(out)
+    print(line)
 
 
 if __name__ == "__main__":

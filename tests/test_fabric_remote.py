@@ -327,6 +327,72 @@ class TestAdapterFactory:
 # End-to-end: full operator over the wire
 # ---------------------------------------------------------------------------
 
+class TestResizeDisambiguation:
+    """resize_slice's 404 fallback (fabric/poolapi.py): only a 409 from the
+    disambiguating PUT proves "slice exists, no live-resize route" — an
+    UnsupportedResize verdict is permanent (the controller answers it by
+    dissolving the slice, tearing down surviving workers), so a transient
+    transport/5xx failure of the fallback must stay a retryable FabricError
+    (ADVICE r4)."""
+
+    class _ScriptedHttp:
+        def __init__(self, script):
+            self.script = list(script)
+            self.calls = []
+
+        def request(self, method, path, body=None):
+            self.calls.append((method, path))
+            step = self.script.pop(0)
+            if isinstance(step, Exception):
+                raise step
+            return step
+
+    def _client(self, script):
+        from tpu_composer.fabric.poolapi import PoolApiMixin
+
+        c = PoolApiMixin()
+        c._http = self._ScriptedHttp(script)
+        return c
+
+    def test_conflicting_put_means_no_resize_route(self):
+        from tpu_composer.fabric.httpx import HttpStatusError
+        from tpu_composer.fabric.provider import UnsupportedResize
+
+        c = self._client([HttpStatusError(404, "no PATCH route"),
+                          HttpStatusError(409, "slice exists")])
+        with pytest.raises(UnsupportedResize):
+            c.resize_slice("s", "tpu-v4", "2x2", ["worker-0"])
+
+    def test_transient_5xx_on_fallback_stays_retryable(self):
+        from tpu_composer.fabric.httpx import HttpStatusError
+        from tpu_composer.fabric.provider import UnsupportedResize
+
+        c = self._client([HttpStatusError(404, "unknown"),
+                          HttpStatusError(503, "pool manager restarting")])
+        with pytest.raises(FabricError) as ei:
+            c.resize_slice("s", "tpu-v4", "2x2", ["worker-0"])
+        assert not isinstance(ei.value, UnsupportedResize)
+
+    def test_transport_failure_on_fallback_stays_retryable(self):
+        from tpu_composer.fabric.httpx import HttpStatusError
+        from tpu_composer.fabric.provider import UnsupportedResize
+
+        c = self._client([HttpStatusError(404, "unknown"),
+                          FabricError("connection reset mid-PUT")])
+        with pytest.raises(FabricError) as ei:
+            c.resize_slice("s", "tpu-v4", "2x2", ["worker-0"])
+        assert not isinstance(ei.value, UnsupportedResize)
+
+    def test_resize_of_unknown_slice_reserves_it(self):
+        from tpu_composer.fabric.httpx import HttpStatusError
+
+        c = self._client([HttpStatusError(404, "unknown"), (201, {})])
+        c.resize_slice("s", "tpu-v4", "2x2", ["worker-0"])
+        assert c._http.calls == [
+            ("PATCH", "/slices/s"), ("PUT", "/slices/s"),
+        ]
+
+
 class TestOperatorOverRest:
     """The whole control plane (request + resource controllers + syncer)
     driving the fabric through HTTP — the closest analog to the reference's

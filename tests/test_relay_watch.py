@@ -1,0 +1,200 @@
+"""Relay watcher + bench headline hygiene.
+
+Two round-4 losses motivate these pins (VERDICT r4 missing #1/#2): the TPU
+relay's uptime windows never coincided with a bench run, so no on-chip
+numbers landed; and the one number the round did earn was unparseable
+because the headline JSON line outgrew the driver's 2000-char tail. The
+watcher must capture the moment the relay answers, and the headline must
+stay under budget no matter how much evidence the probe returns.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+import tpu_composer.workload.probe as probe
+import tpu_composer.workload.relay_watch as rw
+
+
+def _full_tpu_result():
+    return {
+        "stages": {
+            "backend_init": {"backend": "tpu", "n_devices": 1,
+                             "device_kind": "TPU v5e"},
+            "matmul": {"ok": True},
+            "flash_attn": {"configs": [{"seq": 4096, "fwd_speedup": 1.4}],
+                           "fwd_speedup_long": 1.4, "bwd_speedup_long": 1.1,
+                           "numerics_ok": True},
+            "qualify": {"tflops": 44.0, "mfu": 0.22, "backend": "tpu"},
+            "qualify_large": {"tflops": 120.0, "mfu": 0.45},
+            "decode": {"bf16_tokens_per_s": 900.0,
+                       "int8_w_int8_kv_tokens_per_s": 1700.0,
+                       "quant_speedup": 1.9},
+        },
+        "completed": ["devnodes", "backend_init", "matmul", "flash_attn",
+                      "qualify", "qualify_large", "decode"],
+    }
+
+
+def _paths(tmp_path):
+    return dict(
+        log_path=str(tmp_path / "watch.jsonl"),
+        archive_path=str(tmp_path / "probe.json"),
+        pid_path=str(tmp_path / "watch.pid"),
+    )
+
+
+def test_watch_captures_on_first_reachable_poll(tmp_path, monkeypatch):
+    polls = iter([
+        [{"endpoint": "127.0.0.1:8082", "reachable": False}],
+        [{"endpoint": "127.0.0.1:8082", "reachable": False}],
+        [{"endpoint": "127.0.0.1:8082", "reachable": True}],
+    ])
+    monkeypatch.setattr(probe, "probe_pool_endpoints", lambda **kw: next(polls))
+    monkeypatch.setattr(probe, "staged_accelerator_probe",
+                        lambda **kw: _full_tpu_result())
+    p = _paths(tmp_path)
+    rc = rw.watch_relay(poll_s=0.01, max_hours=0.01, **p)
+    assert rc == 0  # full capture → clean exit
+    arch = json.loads(open(p["archive_path"]).read())
+    assert arch["stages"]["flash_attn"]["fwd_speedup_long"] == 1.4
+    assert "captured_at" in arch and "relay watcher" in arch["note"]
+    events = [json.loads(l) for l in open(p["log_path"])]
+    kinds = [e.get("event") for e in events]
+    assert "capture_start" in kinds and "capture_done" in kinds
+    # The two down polls were logged before the capture — the attempt log
+    # is the round's evidence when the relay never answers.
+    assert [e["up"] for e in events if "up" in e][:3] == [False, False, True]
+
+
+def test_partial_capture_archived_but_watch_continues(tmp_path, monkeypatch):
+    """A relay that flaps mid-probe still yields an archive (better than
+    nothing) but the watcher keeps polling for a full capture."""
+    partial = {
+        "stages": {"backend_init": {"backend": "tpu"}, "matmul": {"ok": True}},
+        "completed": ["devnodes", "backend_init", "matmul"],
+        "failed_stage": "flash_attn",
+    }
+    monkeypatch.setattr(
+        probe, "probe_pool_endpoints",
+        lambda **kw: [{"endpoint": "e", "reachable": True}],
+    )
+    monkeypatch.setattr(probe, "staged_accelerator_probe", lambda **kw: partial)
+    p = _paths(tmp_path)
+    rc = rw.watch_relay(poll_s=0.005, max_hours=0.05 / 3600.0,
+                        min_capture_gap_s=0.0, **p)
+    assert rc == 1  # deadline, not capture_complete
+    arch = json.loads(open(p["archive_path"]).read())
+    assert arch["failed_stage"] == "flash_attn"
+    events = [json.loads(l) for l in open(p["log_path"])]
+    dones = [e for e in events if e.get("event") == "capture_done"]
+    assert dones and all(d["full"] is False for d in dones)
+
+
+def test_non_tpu_probe_never_overwrites_archive(tmp_path, monkeypatch):
+    """A capture attempt that fell back to CPU (relay died between poll and
+    handshake) must not clobber a real on-TPU archive."""
+    cpu = {"stages": {"backend_init": {"backend": "cpu"}},
+           "completed": ["devnodes", "backend_init"]}
+    monkeypatch.setattr(
+        probe, "probe_pool_endpoints",
+        lambda **kw: [{"endpoint": "e", "reachable": True}],
+    )
+    monkeypatch.setattr(probe, "staged_accelerator_probe", lambda **kw: cpu)
+    p = _paths(tmp_path)
+    with open(p["archive_path"], "w") as f:
+        json.dump({"captured_at": "X", "stages": {}}, f)
+    rc = rw.watch_relay(poll_s=0.005, max_hours=0.05 / 3600.0,
+                        min_capture_gap_s=0.0, **p)
+    assert rc == 1
+    assert json.loads(open(p["archive_path"]).read())["captured_at"] == "X"
+
+
+def test_second_watcher_refuses_to_start(tmp_path, monkeypatch):
+    p = _paths(tmp_path)
+    with open(p["pid_path"], "w") as f:
+        f.write(str(os.getpid()))  # a live pid
+    rc = rw.watch_relay(poll_s=0.01, max_hours=0.001, **p)
+    assert rc == 2
+    # A stale pidfile (dead pid) must not block.
+    with open(p["pid_path"], "w") as f:
+        f.write("999999999")
+    monkeypatch.setattr(
+        probe, "probe_pool_endpoints",
+        lambda **kw: [{"endpoint": "e", "reachable": False}],
+    )
+    rc = rw.watch_relay(poll_s=0.005, max_hours=0.02 / 3600.0, **p)
+    assert rc == 1
+
+
+def test_full_capture_predicate():
+    assert rw.probe_is_full_tpu_capture(_full_tpu_result())
+    r = _full_tpu_result()
+    r["stages"]["backend_init"]["backend"] = "cpu"
+    assert not rw.probe_is_full_tpu_capture(r)
+    r = _full_tpu_result()
+    r["completed"].remove("decode")
+    assert not rw.probe_is_full_tpu_capture(r)
+    r = _full_tpu_result()
+    del r["stages"]["flash_attn"]["fwd_speedup_long"]
+    assert not rw.probe_is_full_tpu_capture(r)
+
+
+def test_headline_stays_under_driver_tail_budget():
+    """The exact failure of BENCH_r04: the headline embedded a multi-KB
+    probe blob. Build a worst-case accelerator record (live failure + big
+    archive + AOT block + CPU fallback) and assert the summarized headline
+    fits the driver's tail with margin."""
+    import bench
+
+    archived = _full_tpu_result()
+    archived["captured_at"] = "2026-07-30T00:00:00Z"
+    # Bloat the raw record the way real probes do.
+    archived["stages"]["devnodes"] = {"env": {f"K{i}": "v" * 40
+                                              for i in range(40)}}
+    archived["stages"]["flash_attn"]["configs"] = [
+        {"seq": s, "flash_fwd_ms": 1.0, "ref_fwd_ms": 2.0,
+         "flash_bwd_ms": 3.0, "ref_bwd_ms": 4.0, "fwd_speedup": 1.5,
+         "bwd_speedup": 1.2} for s in (1024, 2048, 4096, 8192)
+    ]
+    accel = {
+        "stages": {"devnodes": archived["stages"]["devnodes"],
+                   "backend_init": {"backend": "cpu"}},
+        "completed": ["devnodes"],
+        "failed_stage": "backend_init",
+        "diagnosis": {"stderr_tail": ["x" * 80] * 40,
+                      "blocked_call": "y" * 200},
+        "archived_tpu_probe": archived,
+        "cpu_fallback": {"stages": {"qualify": {"tflops": 0.1}},
+                         "completed": ["backend_init", "qualify"]},
+        "tpu_aot_compile": {
+            "flash_grad_v5e": {"ok": True, "seconds": 30.0},
+            "train_step_v5e_2x4": {"ok": True, "mesh": {"dp": 2, "sp": 2,
+                                                        "tp": 2}},
+            "qualify_large_hbm": {"ok": True, "peak_gib": 9.3},
+            "decode_serving_v5e": {"ok": True},
+        },
+    }
+    out = {
+        "metric": "attach_to_ready_p50", "value": 123.456, "unit": "ms",
+        "vs_baseline": 242.7,
+        "extra": {
+            "attach_p90_ms": 127.9, "attach_max_ms": 130.0, "cycles": 20,
+            "injected_store_latency_ms": 10.0, "raw_inproc_p50_ms": 40.0,
+            "raw_inproc_p90_ms": 45.0, "baseline_p50_ms": 30000.0,
+            "accelerator": bench.summarize_accelerator(accel),
+            "full_record": "bench_artifacts/bench_full.json",
+        },
+    }
+    line = json.dumps(out)
+    assert len(line) <= bench.HEADLINE_BUDGET_CHARS, len(line)
+    # And the summary still carries the evidence that matters.
+    acc = out["extra"]["accelerator"]
+    assert acc["archived_tpu_probe"]["stages"]["flash_attn"][
+        "fwd_speedup_long"] == 1.4
+    assert acc["archived_tpu_probe"]["stages"]["decode"][
+        "quant_speedup"] == 1.9
+    assert acc["tpu_aot_compile"]["qualify_large_hbm"] is True

@@ -63,11 +63,14 @@ def validate_request(store: Store, req: ComposabilityRequest) -> None:
                     f" model {res.model} already exists"
                 )
         elif res.allocation_policy == "samenode":
-            # The incoming request's node is resolved the same way the
-            # other's is: explicit target_node, else the node its allocator
-            # already chose (composabilityrequest_webhook.go:108-128). An
-            # unpinned, never-allocated request has no node yet — no
-            # conflict to detect.
+            # Deliberate deviation from composabilityrequest_webhook.go:
+            # 108-128, which compares against the incoming SPEC target_node
+            # only (so two unpinned never-allocated requests collide on
+            # "" == "" and an allocated-unpinned update is checked at "").
+            # Here BOTH sides resolve spec-then-status: an unpinned,
+            # never-allocated request has no node yet — no conflict to
+            # detect — while updates are checked at the node the request
+            # actually occupies. Recorded in docs/PARITY.md row 15.
             mine = _effective_target(req)
             if mine and _effective_target(other) == mine:
                 raise AdmissionDenied(

@@ -69,14 +69,23 @@ class PoolApiMixin:
                 # resize-of-unknown reserves it) OR a service without the
                 # PATCH route. Reserving disambiguates — a service that
                 # actually holds the slice 409s the PUT, which means the
-                # 404 was the missing route.
+                # 404 was the missing route. ONLY the conflict proves that;
+                # a transport failure or 5xx from the fallback PUT is
+                # transient and must stay retryable — UnsupportedResize is
+                # permanent (the controller answers it by dissolving the
+                # slice and tearing down surviving workers).
                 try:
                     return self.reserve_slice(slice_name, model, topology, nodes)
-                except FabricError:
-                    raise UnsupportedResize(
-                        f"pool service 404s resize of {slice_name} and the"
-                        " slice already exists — no live-resize support"
-                    ) from None
+                except HttpStatusError as re:
+                    if re.code == 409:
+                        raise UnsupportedResize(
+                            f"pool service 404s resize of {slice_name} and"
+                            " the slice already exists — no live-resize"
+                            " support"
+                        ) from None
+                    raise FabricError(
+                        f"resize_slice {slice_name}: fallback reserve: {re}"
+                    ) from re
             raise FabricError(f"resize_slice {slice_name}: {e}") from e
         if not 200 <= status < 300:
             raise FabricError(f"resize_slice {slice_name}: HTTP {status}")

@@ -1001,9 +1001,14 @@ class _Reflector:
 
     def _note_tombstone(self, name: str, rv: int) -> None:
         """Record (monotonic max) a deletion rv; caller holds _lock."""
-        self._tombstones[name] = max(rv, self._tombstones.get(name, -1))
+        rv = max(rv, self._tombstones.pop(name, -1))
+        # pop-then-set moves a refreshed entry to the end of the dict, so
+        # the eviction below is LRU-by-refresh: a same-name object cycling
+        # under sustained churn stays hot instead of being dropped for
+        # merely having been first inserted long ago (ADVICE r4).
+        self._tombstones[name] = rv
         if len(self._tombstones) > 4096:
-            # Bounded memory: drop the oldest half (insertion order). Old
+            # Bounded memory: drop the coldest half (refresh order). Old
             # tombstones only matter while writes from that object's era
             # can still be in flight — seconds, not thousands of objects.
             for key in list(self._tombstones)[:2048]:

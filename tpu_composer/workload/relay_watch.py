@@ -1,0 +1,223 @@
+"""Relay watcher — capture on-chip evidence the moment the TPU tunnel answers.
+
+The axon tunnel relay behind ``PALLAS_AXON_POOL_IPS`` dies for whole rounds
+(r03/r04: every end-of-round bench found it down, so zero live-TPU numbers
+landed despite the full probe harness being ready). The failure mode is
+timing: the relay's uptime windows never coincided with a bench run. This
+watcher removes the coincidence requirement — started at round begin, it
+polls the relay endpoints with pure bounded sockets every ``poll_s`` and, on
+the first poll that finds an endpoint accepting TCP, fires the full staged
+probe (``workload/probe.py``) and archives the result to
+``bench_artifacts/last_tpu_probe.json``, which ``bench.py`` attaches to the
+round artifact whenever the end-of-round probe itself cannot reach the chip.
+
+Every poll is appended to ``bench_artifacts/relay_watch.jsonl`` — if the
+relay never answers, that attempt log is the round's evidence that the
+outage, not the harness, withheld the numbers.
+
+Reference analog: none — the reference has no hardware-evidence capture at
+all (SURVEY.md §6: it publishes no benchmark numbers). This subsystem exists
+because our bar does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+ARTIFACT_DIR = os.path.join(REPO_ROOT, "bench_artifacts")
+ARCHIVE_PATH = os.path.join(ARTIFACT_DIR, "last_tpu_probe.json")
+LOG_PATH = os.path.join(ARTIFACT_DIR, "relay_watch.jsonl")
+PID_PATH = os.path.join(ARTIFACT_DIR, "relay_watch.pid")
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def archive_tpu_probe(result: Dict[str, Any], note: str,
+                      path: str = ARCHIVE_PATH) -> None:
+    """Write a staged-probe result as the canonical on-TPU archive record.
+
+    Shared by bench.py (end-of-round live capture) and this watcher
+    (mid-round opportunistic capture) so both produce the same shape the
+    bench attaches on relay-dead rounds.
+
+    Quality-guarded: a PARTIAL capture (relay flapped mid-probe) never
+    replaces an archived FULL capture — the best hardware evidence of the
+    round must survive later, worse attempts by either caller."""
+    if not probe_is_full_tpu_capture(result):
+        try:
+            with open(path) as f:
+                existing = json.load(f)
+        except (OSError, ValueError):
+            existing = None
+        if existing is not None and probe_is_full_tpu_capture(existing):
+            return
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {
+                "captured_at": _now(),
+                "note": note,
+                "stages": result.get("stages", {}),
+                "completed": result.get("completed", []),
+                "failed_stage": result.get("failed_stage"),
+            },
+            f, indent=1,
+        )
+    os.replace(tmp, path)
+
+
+def probe_is_full_tpu_capture(result: Dict[str, Any]) -> bool:
+    """True when the probe ran on backend=tpu and every evidence stage the
+    VERDICT asks for landed: the flash sweep with long-seq headline fields,
+    qualify_large, and the decode bench."""
+    stages = result.get("stages", {})
+    if stages.get("backend_init", {}).get("backend") != "tpu":
+        return False
+    completed = set(result.get("completed", []))
+    if not {"flash_attn", "qualify", "qualify_large", "decode"} <= completed:
+        return False
+    return "fwd_speedup_long" in stages.get("flash_attn", {})
+
+
+def _log(rec: Dict[str, Any], log_path: str) -> None:
+    rec = {"t": _now(), **rec}
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    with open(log_path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _another_watcher_alive(pid_path: str) -> Optional[int]:
+    try:
+        with open(pid_path) as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return None  # stale pidfile, process gone
+    except PermissionError:
+        pass  # alive, owned by another user — still a live watcher
+    except OSError:
+        return None
+    # Guard against pid reuse after a SIGKILL'd watcher left its pidfile:
+    # only count the pid as a watcher if its cmdline says so.
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            if b"relay_watch" not in f.read():
+                return None
+    except OSError:
+        pass  # no /proc (or unreadable): err on the safe side, treat as alive
+    return pid
+
+
+def watch_relay(
+    poll_s: float = 60.0,
+    max_hours: float = 11.5,
+    min_capture_gap_s: float = 600.0,
+    log_path: str = LOG_PATH,
+    archive_path: str = ARCHIVE_PATH,
+    pid_path: str = PID_PATH,
+    once: bool = False,
+) -> int:
+    """Poll until the relay answers, then capture; exit 0 after a full
+    capture (all evidence stages on backend=tpu), 1 on deadline with no
+    relay, 2 if another watcher already runs.
+
+    A partial capture (relay flapped mid-probe) is still archived — it
+    supersedes nothing-at-all — and the watcher keeps polling, retrying a
+    capture no more often than ``min_capture_gap_s``."""
+    from tpu_composer.workload.probe import (
+        probe_pool_endpoints,
+        staged_accelerator_probe,
+    )
+
+    other = _another_watcher_alive(pid_path)
+    if other is not None:
+        print(f"relay_watch: already running as pid {other}", file=sys.stderr)
+        return 2
+    os.makedirs(os.path.dirname(pid_path), exist_ok=True)
+    with open(pid_path, "w") as f:
+        f.write(str(os.getpid()))
+
+    deadline = time.monotonic() + max_hours * 3600.0
+    last_capture_at = -float("inf")
+    polls = 0
+    _log({"event": "start", "pid": os.getpid(), "poll_s": poll_s,
+          "max_hours": max_hours}, log_path)
+    try:
+        while time.monotonic() < deadline:
+            eps = probe_pool_endpoints()
+            up = [e["endpoint"] for e in eps if e.get("reachable")]
+            polls += 1
+            _log({"up": bool(up), "reachable": up, "poll": polls}, log_path)
+            if up and time.monotonic() - last_capture_at >= min_capture_gap_s:
+                last_capture_at = time.monotonic()
+                _log({"event": "capture_start", "reachable": up}, log_path)
+                result = staged_accelerator_probe(repo_root=REPO_ROOT)
+                backend = (
+                    result.get("stages", {})
+                    .get("backend_init", {})
+                    .get("backend")
+                )
+                full = probe_is_full_tpu_capture(result)
+                _log(
+                    {
+                        "event": "capture_done",
+                        "backend": backend,
+                        "completed": result.get("completed", []),
+                        "failed_stage": result.get("failed_stage"),
+                        "full": full,
+                    },
+                    log_path,
+                )
+                if backend == "tpu":
+                    archive_tpu_probe(
+                        result,
+                        note=(
+                            "Live on-TPU staged probe captured mid-round by "
+                            "the relay watcher (workload/relay_watch.py) the "
+                            "moment the axon tunnel relay answered. All "
+                            "numbers ran on backend=tpu."
+                        ),
+                        path=archive_path,
+                    )
+                    if full or once:
+                        _log({"event": "exit", "reason": "capture_complete"},
+                             log_path)
+                        return 0
+            time.sleep(poll_s)
+        _log({"event": "exit", "reason": "deadline", "polls": polls}, log_path)
+        return 1
+    finally:
+        try:
+            os.unlink(pid_path)
+        except OSError:
+            pass
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--poll-s", type=float, default=60.0)
+    p.add_argument("--max-hours", type=float, default=11.5)
+    p.add_argument("--min-capture-gap-s", type=float, default=600.0)
+    p.add_argument("--once", action="store_true",
+                   help="exit after the first backend=tpu capture, full or not")
+    a = p.parse_args(argv)
+    return watch_relay(poll_s=a.poll_s, max_hours=a.max_hours,
+                       min_capture_gap_s=a.min_capture_gap_s, once=a.once)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
