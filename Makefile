@@ -12,6 +12,12 @@ all: native test
 test:
 	$(PYTHON) -m pytest tests/ -q
 
+## test-par: the suite across N workers (multi-core boxes / CI; the AOT
+## files share one worker via xdist_group — libtpu aborts on concurrent
+## topology init). Single-core boxes should use plain `make test`.
+test-par:
+	$(PYTHON) -m pytest tests/ -q -n $(or $(WORKERS),4) --dist loadgroup
+
 ## test-fast: stop at first failure
 test-fast:
 	$(PYTHON) -m pytest tests/ -x -q
@@ -24,6 +30,11 @@ bench:
 ## probe to bench_artifacts/ the moment it answers (run at round start)
 watch-relay:
 	$(PYTHON) -m tpu_composer.workload.relay_watch
+
+## collectives: AOT-compile the v5e multi-chip train steps and record
+## per-axis collective bytes/step to bench_artifacts/collectives_v5e.json
+collectives:
+	$(PYTHON) -m tpu_composer.workload.hlo_collectives
 
 ## manifests: regenerate CRD YAML from api/types.py (controller-gen analog)
 manifests:

@@ -1,0 +1,31 @@
+"""Cross-process serialization for libtpu topology access.
+
+libtpu guards itself with /tmp/libtpu_lockfile and ABORTS when two
+processes touch the TPU topology machinery concurrently. Under
+pytest-xdist every worker imports the AOT test modules at collection time
+— each calling ``topologies.get_topology_desc`` — so without external
+serialization the workers race, one aborts, and the module-level
+capability probe silently converts a worker's whole AOT suite into skips.
+An flock around the probe makes collection queue instead of race; the
+runtime compiles are kept on one worker via ``xdist_group("libtpu")``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import os
+import tempfile
+
+
+@contextlib.contextmanager
+def libtpu_serialized():
+    path = os.path.join(
+        tempfile.gettempdir(), f"tpuc_libtpu_serial_{os.getuid()}.flock"
+    )
+    with open(path, "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)

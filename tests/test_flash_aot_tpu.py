@@ -22,29 +22,41 @@ import pytest
 from tpu_composer.ops.attention import flash_attention
 
 
+# Deferred to test time for the same reason as test_multichip_aot_tpu.py:
+# collection-time libtpu inits in every xdist worker either abort on the
+# multi-process lockfile or silently convert this file into skips.
+_TOPO = {"dev": None, "err": None, "probed": False}
+
+
 def _v5e_device():
-    from jax.experimental import topologies
+    if not _TOPO["probed"]:
+        _TOPO["probed"] = True
+        try:
+            from jax.experimental import topologies
 
-    topo = topologies.get_topology_desc("v5e:2x2", "tpu")
-    return topo.devices[0]
+            from tests._libtpu_serial import libtpu_serialized
+
+            with libtpu_serialized():
+                topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+            _TOPO["dev"] = topo.devices[0]
+        except Exception as e:  # noqa: BLE001 - capability probe
+            _TOPO["err"] = f"{type(e).__name__}: {e}"
+    if _TOPO["dev"] is None:
+        pytest.skip(f"no device-less TPU topology available: {_TOPO['err']}")
+    return _TOPO["dev"]
 
 
-try:
-    _DEV = _v5e_device()
-    _TOPO_ERR = None
-except Exception as e:  # noqa: BLE001 - capability probe
-    _DEV = None
-    _TOPO_ERR = f"{type(e).__name__}: {e}"
-
-pytestmark = pytest.mark.skipif(
-    _DEV is None, reason=f"no device-less TPU topology available: {_TOPO_ERR}"
-)
+# Shares one xdist worker with test_multichip_aot_tpu.py: concurrent
+# libtpu topology inits abort on the multi-process lockfile.
+pytestmark = pytest.mark.xdist_group("libtpu")
 
 
 def _sds(shape, dtype):
     from jax.sharding import SingleDeviceSharding
 
-    return jax.ShapeDtypeStruct(shape, dtype, sharding=SingleDeviceSharding(_DEV))
+    return jax.ShapeDtypeStruct(
+        shape, dtype, sharding=SingleDeviceSharding(_v5e_device())
+    )
 
 
 class TestFlashCompilesForTPU:

@@ -1,0 +1,160 @@
+"""Collective-traffic accounting from HLO text (workload/hlo_collectives).
+
+The parser is the evidence path for the multi-chip claims (VERDICT r4 ask
+#4): these tests pin it against the HLO spellings XLA actually emits —
+explicit replica_groups, iota ``[4,2]<=[8]`` form, transposed iota, tuple
+gradient buckets with TPU layout annotations (whose nested parentheses
+defeated the first regex), async -start/-done pairs, and ppermute rings —
+on synthetic text, so a silent format drift fails fast without a compile.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_composer.workload.hlo_collectives import (
+    _axis_partitions,
+    _shape_bytes,
+    collective_summary,
+)
+
+AXES = {"dp": 2, "sp": 2, "tp": 2}  # flat ids row-major: tp fastest
+
+
+def summarize(lines):
+    return collective_summary("\n".join(lines), AXES)
+
+
+class TestShapeBytes:
+    def test_simple_and_layout(self):
+        assert _shape_bytes("bf16[2,64,128]{2,1,0}") == 2 * 64 * 128 * 2
+        # TPU layout annotations with nested parens must not break parsing.
+        assert _shape_bytes(
+            "bf16[256,128]{1,0:T(8,128)(2,1)S(1)}"
+        ) == 256 * 128 * 2
+        assert _shape_bytes("f32[]") == 4
+
+    def test_tuple(self):
+        s = "(bf16[256,128]{1,0:T(8,128)(2,1)}, f32[128]{0:T(128)})"
+        assert _shape_bytes(s) == 256 * 128 * 2 + 128 * 4
+
+
+class TestAxisPartitions:
+    def test_single_axes(self):
+        parts = _axis_partitions(AXES, list(range(8)))
+        assert parts["tp"] == frozenset(
+            {frozenset({0, 1}), frozenset({2, 3}), frozenset({4, 5}),
+             frozenset({6, 7})}
+        )
+        assert parts["dp"] == frozenset(
+            {frozenset({0, 4}), frozenset({1, 5}), frozenset({2, 6}),
+             frozenset({3, 7})}
+        )
+
+    def test_combined_axes(self):
+        parts = _axis_partitions(AXES, list(range(8)))
+        assert parts["dp+sp"] == frozenset(
+            {frozenset({0, 2, 4, 6}), frozenset({1, 3, 5, 7})}
+        )
+        assert parts["dp+sp+tp"] == frozenset({frozenset(range(8))})
+
+
+class TestCollectiveSummary:
+    def test_explicit_replica_groups_map_to_axis(self):
+        s = summarize([
+            "%all-reduce.1 = bf16[128,128]{1,0} all-reduce(%p0), "
+            "channel_id=1, replica_groups={{0,1},{2,3},{4,5},{6,7}}, "
+            "to_apply=%add",
+        ])
+        (rec,) = s["ops"]
+        assert rec["op"] == "all-reduce"
+        assert rec["axis"] == "tp"
+        assert rec["bytes_per_instance"] == 128 * 128 * 2
+        assert s["per_axis_bytes"] == {"tp": 128 * 128 * 2}
+
+    def test_iota_replica_groups(self):
+        # [4,2]<=[8]: 4 groups of 2 consecutive ids — the tp partition.
+        s = summarize([
+            "%all-reduce.2 = f32[64]{0} all-reduce(%x), channel_id=2, "
+            "replica_groups=[4,2]<=[8], use_global_device_ids=true, "
+            "to_apply=%add",
+        ])
+        assert s["ops"][0]["axis"] == "tp"
+
+    def test_transposed_iota_replica_groups(self):
+        # [2,4]<=[2,2,2]T(1,2,0): ids reshaped (2,2,2), transposed
+        # (1,2,0), reshaped (2,4) gives rows {0,1,4,5} and {2,3,6,7} —
+        # dp and tp vary within a row, sp is fixed: the dp+tp partition.
+        s = summarize([
+            "%all-gather.1 = bf16[64,64]{1,0} all-gather(%x), "
+            "channel_id=3, replica_groups=[2,4]<=[2,2,2]T(1,2,0), "
+            "dimensions={0}",
+        ])
+        assert s["ops"][0]["op"] == "all-gather"
+        assert s["ops"][0]["axis"] == "dp+tp"
+
+    def test_tuple_gradient_bucket_with_tpu_layouts(self):
+        """The exact spelling that broke the first parser: tuple result,
+        layout annotations with nested parens, grad bucket over dp+sp."""
+        s = summarize([
+            "%all-reduce.49 = (bf16[256,128]{1,0:T(8,128)(2,1)S(1)}, "
+            "bf16[128,128]{1,0:T(8,128)(2,1)S(1)}) all-reduce(%a, %b), "
+            "channel_id=7, replica_groups={{0,2,4,6},{1,3,5,7}}, "
+            "use_global_device_ids=true, to_apply=%add.1.clone, "
+            'metadata={op_name="jit(step)/transpose(jvp(bsd,vd->bsv))"}',
+        ])
+        (rec,) = s["ops"]
+        assert rec["axis"] == "dp+sp"
+        assert rec["bytes_per_instance"] == (256 * 128 + 128 * 128) * 2
+
+    def test_async_start_done_counted_once(self):
+        s = summarize([
+            "%all-reduce-start.1 = bf16[128]{0} all-reduce-start(%x), "
+            "channel_id=4, replica_groups={{0,1},{2,3},{4,5},{6,7}}, "
+            "to_apply=%add",
+            "%all-reduce-done.1 = bf16[128]{0} all-reduce-done("
+            "%all-reduce-start.1)",
+        ])
+        assert s["op_counts"] == {"all-reduce": 1}
+
+    def test_operand_references_not_counted(self):
+        """A get-tuple-element referencing %all-reduce.N is not an
+        instruction; neither is a metadata op_name mentioning one."""
+        s = summarize([
+            "%get-tuple-element.7244 = bf16[256,128]{1,0} "
+            "get-tuple-element(%all-reduce.47), index=0",
+        ])
+        assert s["op_counts"] == {}
+
+    def test_permute_ring_maps_to_axis(self):
+        # sp neighbors differ by 2 in flat id (tp fastest): a ring over sp.
+        s = summarize([
+            "%collective-permute.1 = bf16[2,32,128]{2,1,0} "
+            "collective-permute(%kv), channel_id=5, "
+            "source_target_pairs={{0,2},{2,0},{1,3},{3,1},{4,6},{6,4},"
+            "{5,7},{7,5}}",
+        ])
+        (rec,) = s["ops"]
+        assert rec["op"] == "collective-permute"
+        assert rec["axis"] == "sp"
+        assert rec["group_size"] == 2
+
+    def test_subgroup_labeled_within_axis(self):
+        # Groups smaller than any full axis partition: half the tp pairs.
+        s = summarize([
+            "%all-reduce.9 = f32[16]{0} all-reduce(%x), channel_id=9, "
+            "replica_groups={{0,1}}, to_apply=%add",
+        ])
+        assert s["ops"][0]["axis"].startswith("within-")
+
+    def test_instances_aggregate_and_totals(self):
+        line = (
+            "%all-reduce.{i} = bf16[128,128]{{1,0}} all-reduce(%x), "
+            "channel_id={i}, replica_groups={{{{0,1}},{{2,3}},{{4,5}},"
+            "{{6,7}}}}, to_apply=%add"
+        )
+        s = summarize([line.format(i=i) for i in (1, 2, 3)])
+        (rec,) = s["ops"]
+        assert rec["instances"] == 3
+        assert s["total_bytes"] == 3 * 128 * 128 * 2
+        assert s["op_counts"] == {"all-reduce": 3}

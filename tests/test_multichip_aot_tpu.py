@@ -33,22 +33,38 @@ from tpu_composer.parallel import (
 )
 
 
+# Topology probing is deferred to TEST time, not module import: under
+# pytest-xdist every worker imports this module during collection, and a
+# collection-time libtpu init in each worker either aborts on libtpu's
+# multi-process lockfile or — worse — aborts quietly inside a try/except
+# capability probe and converts the whole file into skips on whichever
+# worker actually executes it. Only the executing worker (pinned by the
+# xdist_group below under --dist loadgroup) ever touches libtpu, and the
+# flock in tests/_libtpu_serial.py serializes it against any OTHER
+# process's probe (e.g. the relay watcher's AOT stage).
+_TOPO = {"devs": None, "err": None, "probed": False}
+
+
 def _topology_devices():
-    from jax.experimental import topologies
+    if not _TOPO["probed"]:
+        _TOPO["probed"] = True
+        try:
+            from jax.experimental import topologies
 
-    return topologies.get_topology_desc("v5e:2x4", "tpu").devices
+            from tests._libtpu_serial import libtpu_serialized
+
+            with libtpu_serialized():
+                _TOPO["devs"] = topologies.get_topology_desc(
+                    "v5e:2x4", "tpu"
+                ).devices
+        except Exception as e:  # noqa: BLE001 - capability probe
+            _TOPO["err"] = f"{type(e).__name__}: {e}"
+    if _TOPO["devs"] is None:
+        pytest.skip(f"no device-less TPU topology available: {_TOPO['err']}")
+    return _TOPO["devs"]
 
 
-try:
-    _DEVS = _topology_devices()
-    _TOPO_ERR = None
-except Exception as e:  # noqa: BLE001 - capability probe
-    _DEVS = None
-    _TOPO_ERR = f"{type(e).__name__}: {e}"
-
-pytestmark = pytest.mark.skipif(
-    _DEVS is None, reason=f"no device-less TPU topology available: {_TOPO_ERR}"
-)
+pytestmark = pytest.mark.xdist_group("libtpu")
 
 _COMMON = dict(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
                d_ff=256, dtype=jnp.bfloat16)
@@ -56,7 +72,9 @@ _COMMON = dict(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
 
 def _mesh(axes):
     sizes = [axes[name] for name in axes]
-    devs = np.array(_DEVS[: int(np.prod(sizes))]).reshape(sizes)
+    devs = np.array(
+        _topology_devices()[: int(np.prod(sizes))]
+    ).reshape(sizes)
     return Mesh(devs, tuple(axes))
 
 
@@ -139,6 +157,68 @@ class TestTrainStepCompilesForV5eSlice:
         tc = TrainConfig(model=ModelConfig(max_seq=64, **_COMMON),
                          sp_impl="ulysses")
         _aot_compile(tc, axes, seq=64)
+
+
+class TestCollectiveEvidence:
+    """The compiled program's collective schedule IS the multi-chip
+    evidence (VERDICT r4 ask #4): assert the v5e-compiled train steps
+    carry the collectives the parallelism design promises, attributed to
+    the right mesh axes, with nonzero bytes — so the numbers cited in
+    docs/PERF.md and archived by `make collectives` cannot silently rot."""
+
+    def test_dense_zigzag_collectives_attributed(self):
+        from tpu_composer.workload.hlo_collectives import collective_summary
+
+        axes = solve_mesh_axes(8, sp=2, tp=2)
+        tc = TrainConfig(model=ModelConfig(max_seq=64, **_COMMON),
+                         sp_impl="zigzag")
+        compiled = _aot_compile(tc, axes, seq=64)
+        mesh = _mesh(axes)
+        s = collective_summary(
+            compiled.as_text(), dict(axes),
+            [d.id for d in np.array(mesh.devices).flatten()],
+        )
+        per_axis = s["per_axis_bytes"]
+        # Gradient synchronization spans the data-parallel axis (XLA may
+        # fold sp into the same groups since params are replicated over
+        # both): some all-reduce traffic on an axis set containing dp.
+        assert any("dp" in ax.split("+") for ax in per_axis), per_axis
+        # The zigzag ring's KV hops are collective-permutes over sp.
+        assert s["op_counts"].get("collective-permute", 0) > 0
+        assert any(
+            r["op"] == "collective-permute" and "sp" in r["axis"].split("+")
+            for r in s["ops"]
+        ), s["ops"]
+        # Tensor-parallel partial-sum reductions over tp.
+        assert per_axis.get("tp", 0) > 0, per_axis
+        # Nothing unattributable: every byte maps to a mesh axis.
+        assert "unmapped" not in per_axis, per_axis
+        assert s["total_bytes"] > 0
+
+    def test_moe_ep_dispatch_dominates_ep_axis(self):
+        from tpu_composer.workload.hlo_collectives import collective_summary
+
+        axes = solve_mesh_axes(8, ep=2, sp=2, tp=2)
+        tc = TrainConfig(
+            model=MoEConfig(max_seq=64, n_experts=4, top_k=2,
+                            capacity_factor=2.0, moe_period=2, **_COMMON)
+        )
+        compiled = _aot_compile(tc, axes, seq=64)
+        mesh = _mesh(axes)
+        s = collective_summary(
+            compiled.as_text(), dict(axes),
+            [d.id for d in np.array(mesh.devices).flatten()],
+        )
+        # Expert dispatch rides the ep axis: it must carry traffic, via
+        # all-to-all or the all-gather lowering XLA chooses.
+        ep_bytes = sum(
+            v for ax, v in s["per_axis_bytes"].items()
+            if "ep" in ax.split("+")
+        )
+        assert ep_bytes > 0, s["per_axis_bytes"]
+        assert (s["op_counts"].get("all-to-all", 0)
+                + s["op_counts"].get("all-gather", 0)) > 0, s["op_counts"]
+        assert "unmapped" not in s["per_axis_bytes"]
 
 
 class TestHBMFitGate:

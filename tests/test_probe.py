@@ -42,7 +42,11 @@ def test_all_stages_complete(monkeypatch):
 
 def test_wedged_backend_init_yields_stack_and_retries(monkeypatch):
     monkeypatch.setattr(probe, "_CHILD", _WEDGED_CHILD)
-    r = probe.staged_accelerator_probe(timeouts={"backend_init": 8.0}, retries=1)
+    # fallbacks=False: the cpu-fallback would just re-wedge the scripted
+    # child and the AOT compile path has its own suite — without it this
+    # test spent 90+ s of suite wall-clock proving nothing new.
+    r = probe.staged_accelerator_probe(timeouts={"backend_init": 8.0},
+                                       retries=1, fallbacks=False)
     assert r["failed_stage"] == "backend_init"
     d = r["diagnosis"]
     # One retry happened and each attempt's evidence is kept.

@@ -114,18 +114,35 @@ def test_non_tpu_probe_never_overwrites_archive(tmp_path, monkeypatch):
 
 
 def test_second_watcher_refuses_to_start(tmp_path, monkeypatch):
-    p = _paths(tmp_path)
-    with open(p["pid_path"], "w") as f:
-        f.write(str(os.getpid()))  # a live pid
-    rc = rw.watch_relay(poll_s=0.01, max_hours=0.001, **p)
-    assert rc == 2
-    # A stale pidfile (dead pid) must not block.
-    with open(p["pid_path"], "w") as f:
-        f.write("999999999")
     monkeypatch.setattr(
         probe, "probe_pool_endpoints",
         lambda **kw: [{"endpoint": "e", "reachable": False}],
     )
+    p = _paths(tmp_path)
+    # A live watcher (this very process, start time recorded) blocks.
+    with open(p["pid_path"], "w") as f:
+        start = rw._proc_start_time(os.getpid()) or ""
+        f.write(f"{os.getpid()} {start}")
+    rc = rw.watch_relay(poll_s=0.01, max_hours=0.001, **p)
+    assert rc == 2
+    # A dead pid must not block.
+    with open(p["pid_path"], "w") as f:
+        f.write("999999999")
+    rc = rw.watch_relay(poll_s=0.005, max_hours=0.02 / 3600.0, **p)
+    assert rc == 1
+    # A RECYCLED pid (alive, but different kernel start time than the
+    # pidfile recorded) must not block either — the SIGKILL'd-watcher +
+    # pid-reuse case that would otherwise silently cost a round of
+    # hardware evidence.
+    with open(p["pid_path"], "w") as f:
+        f.write(f"{os.getpid()} 12345")  # wrong start time on purpose
+    rc = rw.watch_relay(poll_s=0.005, max_hours=0.02 / 3600.0, **p)
+    assert rc == 1
+    # LEGACY pid-only pidfile whose pid was recycled by a non-watcher
+    # (this pytest process): no start time to compare, so the cmdline
+    # fallback must notice it isn't a watcher and let the new one start.
+    with open(p["pid_path"], "w") as f:
+        f.write(str(os.getpid()))
     rc = rw.watch_relay(poll_s=0.005, max_hours=0.02 / 3600.0, **p)
     assert rc == 1
 

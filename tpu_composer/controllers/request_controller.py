@@ -456,9 +456,9 @@ class ComposabilityRequestReconciler(Controller):
 
         # For tpu, allocation_policy does not constrain host count — the
         # topology dictates it (a 2x2x2 slice needs exactly 2 hosts). The
-        # policy is honored as a placement preference: samenode/topology pack
-        # least-loaded-first; differentnode is identical for slices since
-        # workers always land on distinct hosts.
+        # policy is honored as a placement preference: tightest-fit packing
+        # (see _pick_extra_nodes); differentnode is identical for slices
+        # since workers always land on distinct hosts.
         return self._pick_extra_nodes(
             req, shape, exclude=set(), count=shape.num_hosts
         )
@@ -503,8 +503,19 @@ class ComposabilityRequestReconciler(Controller):
                 f" {shape.chips_per_host} free TPU ports for"
                 f" {shape.topology}, only {len(candidates)} available"
             )
-        # Least-loaded first so slices pack breadth-first across the fabric.
-        candidates.sort(key=lambda n: (used.get(n.name, 0), n.name))
+        # Tightest-fit first (fewest ports left free after placement):
+        # sub-host chip groups pack onto already-fragmented hosts, keeping
+        # whole hosts intact for the topology shapes that need all their
+        # ports. The 256-node mixed-size storm exposed the opposite
+        # (least-loaded-first) policy deadlocking whole-host slices behind
+        # scattered singles — fragmentation the reference never sees
+        # because its devices are independent, while TPU workers are
+        # all-or-nothing port groups.
+        candidates.sort(
+            key=lambda n: (
+                n.status.tpu_slots - used.get(n.name, 0), n.name
+            )
+        )
         return [n.metadata.name for n in candidates[:count]]
 
     def _used_slots_map(self, exclude_request: str = "") -> Dict[str, int]:

@@ -1,0 +1,325 @@
+"""Collective-traffic accounting from compiled XLA programs.
+
+The single tunneled chip can never measure multi-chip allreduce GB/s, and
+the 8-device CPU mesh measures memcpy, not ICI. What CAN be extracted
+without hardware — and is exact, not modeled — is the collective schedule
+XLA actually compiled for the target slice: which collectives run per train
+step, over which mesh axis, moving how many bytes. This module parses the
+post-optimization HLO of an AOT-compiled program (the same v5e pipeline as
+tests/test_multichip_aot_tpu.py) and attributes every collective instance
+to the mesh axis its replica groups span — the best available proxy for
+the north-star "JAX allreduce GB/s on composed slice" until multi-chip
+hardware exists (VERDICT r4 missing #4 / ask #4).
+
+Caveats, stated so the numbers cannot overclaim:
+- Counts are static HLO instances. The dense/MoE paths unroll layers, so
+  static count == per-step executions; the pipeline path scans
+  microbatches, where an in-loop instance executes once per microbatch.
+- ``collective-permute`` (the ring-attention hop) reports bytes per hop;
+  a ring of size N executes N-1 hops per ring pass.
+
+Reference contrast: the reference has no data-plane collectives at all
+(SURVEY.md §5 — its "communication backend" is fabric REST + pod-exec).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+    "s32": 4, "u32": 4, "s64": 8, "u64": 8, "f8e4m3fn": 1, "f8e5m2": 1,
+    "bf16": 2, "f16": 2, "f32": 4, "f64": 8, "c64": 8, "c128": 16,
+}
+
+# One HLO shape like ``bf16[2,64,128]{2,1,0}`` or a scalar ``f32[]``.
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+_COLLECTIVE_OPS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+
+def _shape_bytes(shape_str: str) -> int:
+    """Total bytes of one shape or a (tuple, of, shapes) string."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _axis_partitions(mesh_axes: Dict[str, int],
+                     device_ids: Sequence[int]) -> Dict[str, frozenset]:
+    """For every mesh axis (and every combination of axes), the partition of
+    device ids into the groups a collective over that axis would use.
+
+    ``device_ids``: the mesh's device-id array flattened in mesh order
+    (row-major over the axes in dict order) — exactly how GSPMD numbers
+    participants in replica_groups for SPMD programs."""
+    names = list(mesh_axes)
+    sizes = [mesh_axes[n] for n in names]
+    grid = np.asarray(list(device_ids)).reshape(sizes)
+    out: Dict[str, frozenset] = {}
+    # Singles first, then pairs, etc. — first match wins in the caller, so
+    # a group set that IS a single axis is labeled as such even when it
+    # also equals some combined-axes partition (e.g. size-1 axes present).
+    from itertools import combinations
+
+    for r in range(1, len(names) + 1):
+        for combo in combinations(range(len(names)), r):
+            label = "+".join(names[i] for i in combo)
+            moved = np.moveaxis(grid, combo, range(len(combo)))
+            flat = moved.reshape(
+                int(np.prod([sizes[i] for i in combo])), -1
+            )
+            groups = frozenset(
+                frozenset(int(x) for x in flat[:, j])
+                for j in range(flat.shape[1])
+            )
+            out.setdefault(label, groups)
+    return out
+
+
+def _parse_groups(line: str) -> Optional[frozenset]:
+    m = re.search(r"replica_groups=\{(\{[0-9,{}\s]*\})\}", line)
+    if not m:
+        # Newer HLO may print replica_groups=[2,4]<=[8] (iota form).
+        m2 = re.search(
+            r"replica_groups=\[(\d+),(\d+)\]<=\[(\d+)\]", line
+        )
+        if m2:
+            rows, cols, total = (int(x) for x in m2.groups())
+            ids = np.arange(total).reshape(rows, cols)
+            return frozenset(
+                frozenset(int(x) for x in row) for row in ids
+            )
+        m3 = re.search(
+            r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]T\(([0-9,]+)\)",
+            line,
+        )
+        if m3:
+            rows, cols = int(m3.group(1)), int(m3.group(2))
+            dims = [int(x) for x in m3.group(3).split(",")]
+            perm = [int(x) for x in m3.group(4).split(",")]
+            ids = np.arange(int(np.prod(dims))).reshape(dims)
+            ids = np.transpose(ids, perm).reshape(rows, cols)
+            return frozenset(
+                frozenset(int(x) for x in row) for row in ids
+            )
+        return None
+    inner = m.group(1)
+    return frozenset(
+        frozenset(int(x) for x in grp.split(",") if x.strip())
+        for grp in re.findall(r"\{([0-9,\s]*)\}", inner)
+    )
+
+
+def _parse_permute_pairs(line: str) -> Optional[List[Tuple[int, int]]]:
+    m = re.search(r"source_target_pairs=\{([0-9,{}\s]*)\}", line)
+    if not m:
+        return None
+    return [
+        (int(a), int(b))
+        for a, b in re.findall(r"\{(\d+),(\d+)\}", m.group(1))
+    ]
+
+
+def _permute_axis(pairs: List[Tuple[int, int]],
+                  partitions: Dict[str, frozenset]) -> str:
+    """A ppermute ring stays inside one axis's groups: find the axis whose
+    partition contains every {src,dst} pair within a single group."""
+    for label, groups in sorted(partitions.items(),
+                                key=lambda kv: kv[0].count("+")):
+        bygroup = {d: g for g in groups for d in g}
+        if all(
+            dst in bygroup.get(src, frozenset()) for src, dst in pairs
+        ):
+            return label
+    return "unmapped"
+
+
+def collective_summary(
+    hlo_text: str,
+    mesh_axes: Dict[str, int],
+    device_ids: Optional[Sequence[int]] = None,
+) -> Dict[str, Any]:
+    """Summarize the collective ops in post-optimization HLO text.
+
+    Returns {"ops": [...], "per_axis_bytes": {...}, "total_bytes": N,
+    "op_counts": {...}} where each op record carries kind, axis label,
+    group size, static instance count and bytes per instance."""
+    if device_ids is None:
+        device_ids = list(range(int(np.prod(list(mesh_axes.values())))))
+    partitions = _axis_partitions(mesh_axes, device_ids)
+
+    # The op is located by name, not by parsing the result shape first:
+    # tuple results (gradient-bucket all-reduces) and TPU layout
+    # annotations like {1,0:T(8,128)(2,1)S(1)} embed parentheses that
+    # defeat any "match the shape then the op" regex. ``-done`` halves of
+    # async pairs never match (the op name is followed by "-done(", not
+    # "(" or "-start("), so each collective is counted exactly once.
+    op_re = re.compile(
+        r"=\s(.*?)\s(" + "|".join(_COLLECTIVE_OPS) + r")(?:-start)?\("
+    )
+    per_key: Dict[Tuple[str, str, int], Dict[str, Any]] = {}
+    for raw_line in hlo_text.splitlines():
+        line = raw_line.strip()
+        if not line.startswith(("%", "ROOT")):
+            continue
+        m = op_re.search(line)
+        if not m:
+            continue
+        kind = m.group(2)
+        nbytes = _shape_bytes(m.group(1))
+        if kind == "collective-permute":
+            pairs = _parse_permute_pairs(line)
+            axis = _permute_axis(pairs, partitions) if pairs else "unmapped"
+            gsize = 1
+            for part in axis.split("+"):
+                gsize *= mesh_axes.get(part, 1)
+            if axis == "unmapped":
+                gsize = 0
+        else:
+            groups = _parse_groups(line)
+            axis, gsize = "unmapped", 0
+            if groups:
+                gsize = max((len(g) for g in groups), default=0)
+                for label, part in sorted(
+                    partitions.items(), key=lambda kv: kv[0].count("+")
+                ):
+                    if groups == part:
+                        axis = label
+                        break
+                else:
+                    # Sub-axis or cross-axis grouping that is not a full
+                    # partition match (e.g. groups within one dp shard):
+                    # label by the smallest axis-combination whose groups
+                    # are supersets of these groups.
+                    for label, part in sorted(
+                        partitions.items(),
+                        key=lambda kv: kv[0].count("+"),
+                    ):
+                        bygroup = {d: g for g in part for d in g}
+                        if all(
+                            g <= bygroup.get(next(iter(g)), frozenset())
+                            for g in groups
+                        ):
+                            axis = f"within-{label}"
+                            break
+        key = (kind, axis, nbytes)
+        rec = per_key.setdefault(
+            key,
+            {"op": kind, "axis": axis, "group_size": gsize,
+             "bytes_per_instance": nbytes, "instances": 0},
+        )
+        rec["instances"] += 1
+
+    ops = sorted(
+        per_key.values(),
+        key=lambda r: -r["bytes_per_instance"] * r["instances"],
+    )
+    per_axis: Dict[str, int] = {}
+    op_counts: Dict[str, int] = {}
+    for r in ops:
+        total = r["bytes_per_instance"] * r["instances"]
+        per_axis[r["axis"]] = per_axis.get(r["axis"], 0) + total
+        op_counts[r["op"]] = op_counts.get(r["op"], 0) + r["instances"]
+    return {
+        "mesh_axes": dict(mesh_axes),
+        "ops": ops,
+        "per_axis_bytes": per_axis,
+        "op_counts": op_counts,
+        "total_bytes": sum(per_axis.values()),
+    }
+
+
+def _compile_and_summarize() -> Dict[str, Any]:
+    """AOT-compile the 8-chip dense (zigzag sp) and 16-chip MoE (ep) train
+    steps for real v5e topologies and summarize their collectives — the
+    generator behind bench_artifacts/collectives_v5e.json (cited by
+    docs/PERF.md) and the bench AOT stage's ``collectives`` fields."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    from tpu_composer.models import ModelConfig, MoEConfig
+    from tpu_composer.parallel import (
+        TrainConfig,
+        abstract_train_state,
+        make_train_step,
+        solve_mesh_axes,
+    )
+
+    common = dict(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+                  d_ff=256, max_seq=64, dtype=jnp.bfloat16)
+
+    def run(topo, axes, tc, batch):
+        devs = topologies.get_topology_desc(topo, "tpu").devices
+        mesh = Mesh(
+            np.array(devs).reshape([axes[a] for a in axes]), tuple(axes)
+        )
+        state = abstract_train_state(tc, mesh)
+        step_fn, bs = make_train_step(tc, mesh)
+        tokens = jax.ShapeDtypeStruct((batch, 64), jnp.int32, sharding=bs)
+        compiled = step_fn.lower(state, tokens).compile()
+        return collective_summary(
+            compiled.as_text(), dict(axes),
+            [d.id for d in np.array(mesh.devices).flatten()],
+        )
+
+    axes8 = solve_mesh_axes(8, sp=2, tp=2)
+    dense = run(
+        "v5e:2x4", axes8,
+        TrainConfig(model=ModelConfig(**common), sp_impl="zigzag"),
+        2 * axes8["dp"],
+    )
+    axes16 = solve_mesh_axes(16, ep=2, sp=2, tp=2)
+    moe = run(
+        "v5e:4x4", axes16,
+        TrainConfig(model=MoEConfig(n_experts=4, top_k=2,
+                                    capacity_factor=2.0, moe_period=2,
+                                    **common)),
+        2 * axes16["dp"] * axes16["ep"],
+    )
+    return {
+        "note": (
+            "Per-train-step collective traffic of the compiled XLA programs "
+            "for real v5e topologies (static HLO instances; layers are "
+            "unrolled so counts are per-step). Regenerate: make collectives"
+        ),
+        "dense_zigzag_v5e_2x4": dense,
+        "moe_ep_v5e_4x4": moe,
+    }
+
+
+if __name__ == "__main__":
+    import json
+    import os
+    import sys
+
+    out = _compile_and_summarize()
+    dest = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "bench_artifacts", "collectives_v5e.json",
+    )
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {dest}")

@@ -336,8 +336,14 @@ def flash_attention_on_chip(
         "bwd_speedup": round(ref_bwd_ms / flash_bwd_ms, 2),
     }
     if check_numerics:
-        # bf16 tolerance: sums over seq-length dot products accumulate ~1e-2.
-        rec["numerics_ok"] = fwd_err < 0.1 and bwd_err < 0.5
+        # bf16 tolerance: sums over seq-length dot products accumulate
+        # rounding error ~sqrt(S); anchor the envelope at the S=2048 bound
+        # that has held on-chip and scale it for the longer configs the
+        # sweep now also asserts (VERDICT r4 ask #6 — the first capture
+        # must validate Mosaic at the length the headline speedup is
+        # measured at, not only at seq <= 2048).
+        tol = max(1.0, (seq / 2048.0) ** 0.5)
+        rec["numerics_ok"] = fwd_err < 0.1 * tol and bwd_err < 0.5 * tol
         rec["fwd_max_err"] = round(fwd_err, 5)
         rec["bwd_max_err"] = round(bwd_err, 5)
     return rec
@@ -346,12 +352,12 @@ def flash_attention_on_chip(
 def flash_sweep_on_chip() -> Dict[str, Any]:
     """The flash kernel's report card across its operating envelope
     (VERDICT r3 ask #2): realistic head counts, seq 1k-8k, GQA/MQA fan-in.
-    Numerics are asserted on the short configs (cheap); the long configs
-    are timing-only — their numerics are pinned by the CPU-mesh tests
-    (tests/test_flash_attention.py seq 2k-8k) and the v5e AOT compile
-    gates. Headline fields summarize the long-seq regime (>= 4096) where
-    the streaming kernel structurally beats the S^2-materializing
-    reference."""
+    Numerics are asserted on-chip up to seq 4096 (the length the headline
+    speedup is measured at); only the 8192 config is timing-only, its
+    numerics pinned by the CPU-mesh tests (tests/test_flash_attention.py
+    seq 2k-8k) and the v5e AOT compile gates. Headline fields summarize
+    the long-seq regime (>= 4096) where the streaming kernel structurally
+    beats the S^2-materializing reference."""
     import jax
 
     if jax.default_backend() != "tpu":
@@ -359,7 +365,11 @@ def flash_sweep_on_chip() -> Dict[str, Any]:
     configs = [
         dict(batch=2, heads=8, seq=1024, check_numerics=True),
         dict(batch=2, heads=8, kv_heads=2, seq=2048, check_numerics=True),
-        dict(batch=1, heads=8, kv_heads=2, seq=4096, check_numerics=False),
+        # 4096 asserts numerics ON-CHIP too (one extra fwd+grad pair per
+        # side — cheap next to the timing reps): interpret-mode Pallas and
+        # Mosaic have diverged on real hardware before, and the headline
+        # long-seq speedup is measured at exactly this length.
+        dict(batch=1, heads=8, kv_heads=2, seq=4096, check_numerics=True),
         dict(batch=1, heads=4, kv_heads=1, seq=8192, check_numerics=False),
     ]
     out: Dict[str, Any] = {"configs": []}
@@ -487,6 +497,7 @@ def staged_accelerator_probe(
     repo_root: Optional[str] = None,
     timeouts: Optional[Dict[str, float]] = None,
     retries: int = 1,
+    fallbacks: bool = True,
 ) -> Dict[str, Any]:
     """Run all stages; return {stages: {...}, completed: [...], failed_stage,
     diagnosis}. Never raises, never hangs past the per-stage deadlines.
@@ -494,7 +505,12 @@ def staged_accelerator_probe(
     backend_init gets ``retries`` extra attempts (fresh subprocess each time):
     the axon tunnel handshake has shown transient wedges, and one clean retry
     is cheaper than a lost round of hardware evidence. Each attempt's
-    diagnosis is preserved under ``diagnosis.attempts``."""
+    diagnosis is preserved under ``diagnosis.attempts``.
+
+    ``fallbacks=False`` skips the CPU-stage rerun and the v5e AOT compile
+    that normally follow a dead backend_init — for unit tests driving
+    scripted children, where those minutes of real compilation would be
+    spent on paths covered by their own suites (test_multichip_aot_tpu)."""
     timeouts = {**STAGE_TIMEOUTS_S, **(timeouts or {})}
     devnodes = probe_devnodes()
     order = ["backend_init", "matmul", "flash_attn", "qualify",
@@ -577,10 +593,17 @@ def staged_accelerator_probe(
         # compute-stage numbers on the host backend so the round carries
         # *some* fresh measurements, explicitly tagged by their own
         # backend fields (qualify/backend_init each emit backend=cpu).
-        if failed_stage == "backend_init":
+        if failed_stage == "backend_init" and fallbacks:
             fb_env = dict(env)
             fb_env["JAX_PLATFORMS"] = "cpu"
-            fb_timeouts = {**timeouts, "backend_init": 90.0}
+            # CPU backend init is seconds, not a tunnel handshake: 90 s is
+            # plenty on real runs, but never MORE than the caller's own
+            # backend_init budget (a test driving a scripted wedge would
+            # otherwise burn 90 s re-wedging the fallback child).
+            fb_timeouts = {
+                **timeouts,
+                "backend_init": min(90.0, timeouts["backend_init"]),
+            }
             fb_env["TPUC_PROBE_STAGE_BUDGET_S"] = str(fb_timeouts["backend_init"])
             fb_stages, fb_completed, fb_failed, fb_tail = _drive_child(
                 fb_env, fb_timeouts, order
@@ -628,6 +651,20 @@ jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, q, q).compile()
 out["flash_grad_v5e"] = {"ok": True, "seconds": round(time.time() - t0, 2),
                          "shape": "B2 S2048 H4 D128 bf16 causal"}
 
+from tpu_composer.workload.hlo_collectives import collective_summary
+
+# Per-axis collective traffic of a compiled step (bytes, op counts): the
+# compiled-program evidence behind the multi-chip claims (VERDICT r4 ask
+# #4). Compact: per-axis totals + op counts, not the per-instance table.
+def _collectives(compiled, axes, mesh):
+    s = collective_summary(
+        compiled.as_text(), dict(axes),
+        [d.id for d in np.array(mesh.devices).flatten()],
+    )
+    return {"per_axis_bytes": s["per_axis_bytes"],
+            "op_counts": s["op_counts"],
+            "total_bytes": s["total_bytes"]}
+
 t0 = time.time()
 devs = topologies.get_topology_desc("v5e:2x4", "tpu").devices
 axes = solve_mesh_axes(8, sp=2, tp=2)
@@ -641,11 +678,46 @@ state = abstract_train_state(tc, mesh)
 step_fn, batch_sharding = make_train_step(tc, mesh)
 tokens = jax.ShapeDtypeStruct((2 * axes["dp"], 64), jnp.int32,
                               sharding=batch_sharding)
-step_fn.lower(state, tokens).compile()
+compiled_8 = step_fn.lower(state, tokens).compile()
 out["train_step_v5e_2x4"] = {
     "ok": True, "seconds": round(time.time() - t0, 2),
     "mesh": dict(axes), "sp_impl": "zigzag",
+    "collectives": _collectives(compiled_8, axes, mesh),
 }
+
+# 16-chip expert-parallel step (v5e 4x4): the ep all-to-all/all-gather
+# dispatch traffic per axis, recorded from the compiled program. Guarded:
+# a regression here must not discard the 8-chip evidence above.
+t0 = time.time()
+try:
+    from tpu_composer.models import MoEConfig
+
+    devs16 = topologies.get_topology_desc("v5e:4x4", "tpu").devices
+    axes16 = solve_mesh_axes(16, ep=2, sp=2, tp=2)
+    mesh16 = Mesh(np.array(devs16).reshape([axes16[a] for a in axes16]),
+                  tuple(axes16))
+    tc16 = TrainConfig(
+        model=MoEConfig(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+                        d_ff=256, max_seq=64, dtype=jnp.bfloat16,
+                        n_experts=4, top_k=2, capacity_factor=2.0,
+                        moe_period=2)
+    )
+    state16 = abstract_train_state(tc16, mesh16)
+    step16, bs16 = make_train_step(tc16, mesh16)
+    toks16 = jax.ShapeDtypeStruct(
+        (2 * axes16["dp"] * axes16["ep"], 64), jnp.int32, sharding=bs16
+    )
+    compiled_16 = step16.lower(state16, toks16).compile()
+    out["moe_train_step_v5e_4x4"] = {
+        "ok": True, "seconds": round(time.time() - t0, 2),
+        "mesh": dict(axes16),
+        "collectives": _collectives(compiled_16, axes16, mesh16),
+    }
+except Exception as e:
+    out["moe_train_step_v5e_4x4"] = {
+        "ok": False, "seconds": round(time.time() - t0, 2),
+        "error": f"{type(e).__name__}: {e}",
+    }
 
 # HBM-fit check for the bench's MXU-sized qualify config: the compiled
 # program's own memory accounting vs a v5e chip's 16 GB, so the bench

@@ -97,11 +97,33 @@ def _log(rec: Dict[str, Any], log_path: str) -> None:
         f.write(json.dumps(rec) + "\n")
 
 
+def _proc_start_time(pid: int) -> Optional[str]:
+    """Kernel start-time of a pid (field 22 of /proc/<pid>/stat) — the
+    exact pid-reuse discriminator: a recycled pid has a different start
+    time. None when unreadable (no /proc, or the process is gone)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            stat = f.read()
+    except OSError:
+        return None
+    # comm (field 2) may contain spaces/parens: split after the LAST ')'.
+    fields = stat.rsplit(")", 1)[-1].split()
+    return fields[19] if len(fields) > 19 else None
+
+
+def _write_pidfile(pid_path: str) -> None:
+    with open(pid_path, "w") as f:
+        start = _proc_start_time(os.getpid()) or ""
+        f.write(f"{os.getpid()} {start}")
+
+
 def _another_watcher_alive(pid_path: str) -> Optional[int]:
     try:
         with open(pid_path) as f:
-            pid = int(f.read().strip())
-    except (OSError, ValueError):
+            parts = f.read().split()
+        pid = int(parts[0])
+        recorded_start = parts[1] if len(parts) > 1 else None
+    except (OSError, ValueError, IndexError):
         return None
     try:
         os.kill(pid, 0)
@@ -111,14 +133,27 @@ def _another_watcher_alive(pid_path: str) -> Optional[int]:
         pass  # alive, owned by another user — still a live watcher
     except OSError:
         return None
-    # Guard against pid reuse after a SIGKILL'd watcher left its pidfile:
-    # only count the pid as a watcher if its cmdline says so.
+    # A SIGKILL'd watcher leaves its pidfile behind; if the pid has since
+    # been recycled by an unrelated process, its kernel start time cannot
+    # match the one recorded at pidfile-write. No cmdline heuristics in
+    # this path — an embedded watcher (tests, another operator process)
+    # is a watcher too.
+    if recorded_start:
+        current = _proc_start_time(pid)
+        if current is not None and current != recorded_start:
+            return None
+        return pid
+    # Legacy pid-only pidfile (or a platform without /proc at write time):
+    # no start time to compare, so a recycled pid would block every future
+    # watcher forever. Fall back to a cmdline check for the watcher's
+    # module path (how `make watch-relay` runs it) — "relay_watch" alone
+    # would also match e.g. a pytest invocation naming the TEST file.
     try:
         with open(f"/proc/{pid}/cmdline", "rb") as f:
-            if b"relay_watch" not in f.read():
+            if b"tpu_composer.workload.relay_watch" not in f.read():
                 return None
     except OSError:
-        pass  # no /proc (or unreadable): err on the safe side, treat as alive
+        pass  # no /proc: err on the safe side, treat as alive
     return pid
 
 
@@ -148,8 +183,7 @@ def watch_relay(
         print(f"relay_watch: already running as pid {other}", file=sys.stderr)
         return 2
     os.makedirs(os.path.dirname(pid_path), exist_ok=True)
-    with open(pid_path, "w") as f:
-        f.write(str(os.getpid()))
+    _write_pidfile(pid_path)
 
     deadline = time.monotonic() + max_hours * 3600.0
     last_capture_at = -float("inf")
