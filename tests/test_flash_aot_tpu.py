@@ -34,7 +34,7 @@ def _v5e_device():
         try:
             from jax.experimental import topologies
 
-            from tests._libtpu_serial import libtpu_serialized
+            from tpu_composer.workload.libtpu_serial import libtpu_serialized
 
             with libtpu_serialized():
                 topo = topologies.get_topology_desc("v5e:2x2", "tpu")

@@ -40,8 +40,9 @@ from tpu_composer.parallel import (
 # capability probe and converts the whole file into skips on whichever
 # worker actually executes it. Only the executing worker (pinned by the
 # xdist_group below under --dist loadgroup) ever touches libtpu, and the
-# flock in tests/_libtpu_serial.py serializes it against any OTHER
-# process's probe (e.g. the relay watcher's AOT stage).
+# flock in tpu_composer/workload/libtpu_serial.py serializes it against
+# any OTHER process's probe (the relay watcher's / bench's AOT child and
+# `make collectives` take the same lock).
 _TOPO = {"devs": None, "err": None, "probed": False}
 
 
@@ -51,7 +52,7 @@ def _topology_devices():
         try:
             from jax.experimental import topologies
 
-            from tests._libtpu_serial import libtpu_serialized
+            from tpu_composer.workload.libtpu_serial import libtpu_serialized
 
             with libtpu_serialized():
                 _TOPO["devs"] = topologies.get_topology_desc(

@@ -249,8 +249,11 @@ def test_wire_path_teardown_cycles():
 def test_wire_chaos_storm():
     """Opt-in chaos: create/resize/delete lanes racing a node
     delete/recreate adversary over the wire path, with the syncer
-    reclaiming orphans. Ran clean on 7 seeds when the r4 tombstone fix
-    landed; kept runnable for future race hunts."""
+    reclaiming orphans — and (r5) a wire adversary resetting every live
+    watch socket and compacting the server's event history mid-flight, so
+    the 410-Expired -> relist recovery runs with controllers mid-lifecycle,
+    not just in the dedicated hostile-wire tests. Ran clean on 7 seeds
+    when the r4 tombstone fix landed; kept runnable for race hunts."""
     import random
 
     from tests.fake_apiserver import (
@@ -373,6 +376,18 @@ def test_wire_chaos_storm():
                 except Exception:  # noqa: BLE001
                     pass
 
+        def wire_chaos() -> None:
+            # The r5 hostile-wire personas under full load: reset every
+            # live watch socket, and sometimes compact the event history so
+            # the reconnect resumes from behind the horizon and must take
+            # the 410 -> relist path with controllers mid-lifecycle.
+            rng = random.Random(seed * 100 + 98)
+            while not stop.is_set():
+                time.sleep(rng.uniform(2.0, 4.0))
+                if rng.random() < 0.4:
+                    srv.compact()
+                srv.kill_watch_connections()
+
         def lane_guard(i: int) -> None:
             try:
                 lane(i)
@@ -382,13 +397,16 @@ def test_wire_chaos_storm():
         lanes = [threading.Thread(target=lane_guard, args=(i,))
                  for i in range(3)]
         nc = threading.Thread(target=node_chaos)
+        wc = threading.Thread(target=wire_chaos)
         for t in lanes:
             t.start()
         nc.start()
+        wc.start()
         for t in lanes:
             t.join()
         stop.set()
         nc.join()
+        wc.join()
         assert not fails, fails[:8]
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:

@@ -269,8 +269,11 @@ def _compile_and_summarize() -> Dict[str, Any]:
     common = dict(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
                   d_ff=256, max_seq=64, dtype=jnp.bfloat16)
 
+    from tpu_composer.workload.libtpu_serial import libtpu_serialized
+
     def run(topo, axes, tc, batch):
-        devs = topologies.get_topology_desc(topo, "tpu").devices
+        with libtpu_serialized():
+            devs = topologies.get_topology_desc(topo, "tpu").devices
         mesh = Mesh(
             np.array(devs).reshape([axes[a] for a in axes]), tuple(axes)
         )

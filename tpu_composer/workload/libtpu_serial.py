@@ -1,13 +1,13 @@
 """Cross-process serialization for libtpu topology access.
 
 libtpu guards itself with /tmp/libtpu_lockfile and ABORTS when two
-processes touch the TPU topology machinery concurrently. Under
-pytest-xdist every worker imports the AOT test modules at collection time
-— each calling ``topologies.get_topology_desc`` — so without external
-serialization the workers race, one aborts, and the module-level
-capability probe silently converts a worker's whole AOT suite into skips.
-An flock around the probe makes collection queue instead of race; the
-runtime compiles are kept on one worker via ``xdist_group("libtpu")``.
+processes touch the TPU topology machinery concurrently (observed abort
+point: ``topologies.get_topology_desc``). Every device-less AOT user —
+the pytest-xdist workers' AOT suites, the bench/relay-watcher probe
+child, ``make collectives`` — must take this flock around topology init
+so they queue instead of racing. One-sided locking is worthless: a probe
+child initializing libtpu while a test worker holds the lock still
+aborts one of them (ADVICE r5 finding).
 """
 
 from __future__ import annotations

@@ -641,8 +641,14 @@ from tpu_composer.parallel import (
 
 out = {}
 
+# Same flock the xdist AOT suites take: concurrent libtpu topology inits
+# abort on libtpu's own multi-process lockfile, so every device-less AOT
+# user queues on this lock instead of racing.
+from tpu_composer.workload.libtpu_serial import libtpu_serialized
+
 t0 = time.time()
-dev = topologies.get_topology_desc("v5e:2x2", "tpu").devices[0]
+with libtpu_serialized():
+    dev = topologies.get_topology_desc("v5e:2x2", "tpu").devices[0]
 q = jax.ShapeDtypeStruct((2, 2048, 4, 128), jnp.bfloat16,
                          sharding=SingleDeviceSharding(dev))
 loss = lambda q, k, v: flash_attention(
@@ -666,7 +672,8 @@ def _collectives(compiled, axes, mesh):
             "total_bytes": s["total_bytes"]}
 
 t0 = time.time()
-devs = topologies.get_topology_desc("v5e:2x4", "tpu").devices
+with libtpu_serialized():
+    devs = topologies.get_topology_desc("v5e:2x4", "tpu").devices
 axes = solve_mesh_axes(8, sp=2, tp=2)
 mesh = Mesh(np.array(devs).reshape([axes[a] for a in axes]), tuple(axes))
 tc = TrainConfig(
@@ -692,7 +699,8 @@ t0 = time.time()
 try:
     from tpu_composer.models import MoEConfig
 
-    devs16 = topologies.get_topology_desc("v5e:4x4", "tpu").devices
+    with libtpu_serialized():
+        devs16 = topologies.get_topology_desc("v5e:4x4", "tpu").devices
     axes16 = solve_mesh_axes(16, ep=2, sp=2, tp=2)
     mesh16 = Mesh(np.array(devs16).reshape([axes16[a] for a in axes16]),
                   tuple(axes16))
