@@ -190,7 +190,12 @@ def test_headline_stays_under_driver_tail_budget():
         "tpu_aot_compile": {
             "flash_grad_v5e": {"ok": True, "seconds": 30.0},
             "train_step_v5e_2x4": {"ok": True, "mesh": {"dp": 2, "sp": 2,
-                                                        "tp": 2}},
+                                                        "tp": 2},
+                                   "collectives": {"per_axis_bytes":
+                                                   {"sp": 278756}}},
+            "moe_train_step_v5e_4x4": {"ok": True,
+                                       "collectives": {"per_axis_bytes":
+                                                       {"ep": 3166372}}},
             "qualify_large_hbm": {"ok": True, "peak_gib": 9.3},
             "decode_serving_v5e": {"ok": True},
         },

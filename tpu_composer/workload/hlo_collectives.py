@@ -243,6 +243,18 @@ def collective_summary(
     }
 
 
+def summarize_compiled(compiled, mesh_axes: Dict[str, int],
+                       mesh) -> Dict[str, Any]:
+    """One compiled-executable entry point shared by every producer of
+    collective evidence (bench AOT child, dryrun_multichip, `make
+    collectives`): the HLO-text / axes-dict / device-id-order convention
+    lives HERE, so the artifacts cannot silently diverge."""
+    return collective_summary(
+        compiled.as_text(), dict(mesh_axes),
+        [d.id for d in np.asarray(mesh.devices).flatten()],
+    )
+
+
 def _compile_and_summarize() -> Dict[str, Any]:
     """AOT-compile the 8-chip dense (zigzag sp) and 16-chip MoE (ep) train
     steps for real v5e topologies and summarize their collectives — the
@@ -281,10 +293,7 @@ def _compile_and_summarize() -> Dict[str, Any]:
         step_fn, bs = make_train_step(tc, mesh)
         tokens = jax.ShapeDtypeStruct((batch, 64), jnp.int32, sharding=bs)
         compiled = step_fn.lower(state, tokens).compile()
-        return collective_summary(
-            compiled.as_text(), dict(axes),
-            [d.id for d in np.array(mesh.devices).flatten()],
-        )
+        return summarize_compiled(compiled, axes, mesh)
 
     axes8 = solve_mesh_axes(8, sp=2, tp=2)
     dense = run(

@@ -657,16 +657,13 @@ jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, q, q).compile()
 out["flash_grad_v5e"] = {"ok": True, "seconds": round(time.time() - t0, 2),
                          "shape": "B2 S2048 H4 D128 bf16 causal"}
 
-from tpu_composer.workload.hlo_collectives import collective_summary
+from tpu_composer.workload.hlo_collectives import summarize_compiled
 
 # Per-axis collective traffic of a compiled step (bytes, op counts): the
 # compiled-program evidence behind the multi-chip claims (VERDICT r4 ask
 # #4). Compact: per-axis totals + op counts, not the per-instance table.
 def _collectives(compiled, axes, mesh):
-    s = collective_summary(
-        compiled.as_text(), dict(axes),
-        [d.id for d in np.array(mesh.devices).flatten()],
-    )
+    s = summarize_compiled(compiled, axes, mesh)
     return {"per_axis_bytes": s["per_axis_bytes"],
             "op_counts": s["op_counts"],
             "total_bytes": s["total_bytes"]}
