@@ -61,6 +61,40 @@ def test_wedged_backend_init_yields_stack_and_retries(monkeypatch):
     assert "pool_endpoints" in d
 
 
+def test_loopback_relay_disarms_tunnel_down_clamp(monkeypatch):
+    """r05 incident pin: with AXON_LOOPBACK_RELAY set, an all-refused TCP
+    preflight must NOT be read as 'relay provably down' (the loopback relay
+    owns no TCP listener) — backend_init keeps its budget and retries."""
+    monkeypatch.setattr(probe, "_CHILD", _WEDGED_CHILD)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1:1")
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    r = probe.staged_accelerator_probe(timeouts={"backend_init": 6.0},
+                                       retries=1, fallbacks=False)
+    d = r["diagnosis"]
+    assert d["tunnel_down"] is False
+    assert d["attempts"] == 2  # retries NOT zeroed by the clamp
+
+    # Control: same dead endpoints without loopback mode → clamp fires.
+    monkeypatch.delenv("AXON_LOOPBACK_RELAY")
+    r2 = probe.staged_accelerator_probe(timeouts={"backend_init": 6.0},
+                                        retries=1, fallbacks=False)
+    d2 = r2["diagnosis"]
+    assert d2["tunnel_down"] is True
+    assert d2["attempts"] == 1
+
+
+def test_loopback_relay_mode_spellings():
+    on = {"AXON_LOOPBACK_RELAY": "1"}
+    assert probe.loopback_relay_mode(on) is True
+    assert probe.loopback_relay_mode({"AXON_LOOPBACK_RELAY": "true"}) is True
+    # Conventional opt-out spellings must read as OFF — string truthiness
+    # would treat the explicit AXON_LOOPBACK_RELAY=0 as loopback mode.
+    for off in ("", "0", "false", "no", "off", " 0 "):
+        assert probe.loopback_relay_mode({"AXON_LOOPBACK_RELAY": off}) is False
+    assert probe.loopback_relay_mode({}) is False
+
+
 def test_pool_endpoint_parsing(monkeypatch):
     monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1:1, 198.51.100.7:80")
     monkeypatch.delenv("AXON_POOL_SVC_OVERRIDE", raising=False)

@@ -39,6 +39,15 @@ def _full_tpu_result():
     }
 
 
+@pytest.fixture(autouse=True)
+def _no_loopback_mode(monkeypatch):
+    """Clear AXON_LOOPBACK_RELAY by default: these tests script
+    reachability via probe_pool_endpoints, and loopback mode would
+    otherwise turn every scripted 'down' poll into a direct capture
+    attempt. Tests of the loopback path set the env themselves."""
+    monkeypatch.delenv("AXON_LOOPBACK_RELAY", raising=False)
+
+
 def _paths(tmp_path):
     return dict(
         log_path=str(tmp_path / "watch.jsonl"),
@@ -68,6 +77,162 @@ def test_watch_captures_on_first_reachable_poll(tmp_path, monkeypatch):
     # The two down polls were logged before the capture — the attempt log
     # is the round's evidence when the relay never answers.
     assert [e["up"] for e in events if "up" in e][:3] == [False, False, True]
+
+
+def test_loopback_mode_attempts_capture_when_tcp_refuses(tmp_path,
+                                                         monkeypatch):
+    """r05 incident pin: under AXON_LOOPBACK_RELAY the relay is in-process —
+    no TCP listener — so every preflight port refuses while the chip
+    answers. The watcher must attempt the staged probe directly (the PJRT
+    handshake inside backend_init IS the reachability test), bounded and
+    without the cpu-fallback stages."""
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    monkeypatch.setattr(
+        probe, "probe_pool_endpoints",
+        lambda **kw: [{"endpoint": "127.0.0.1:8082", "reachable": False}],
+    )
+    seen_kwargs = []
+
+    def _probe(**kw):
+        seen_kwargs.append(kw)
+        return _full_tpu_result()
+
+    monkeypatch.setattr(probe, "staged_accelerator_probe", _probe)
+    p = _paths(tmp_path)
+    rc = rw.watch_relay(poll_s=0.01, max_hours=0.01, **p)
+    assert rc == 0  # full capture through the loopback path → clean exit
+    arch = json.loads(open(p["archive_path"]).read())
+    assert arch["stages"]["flash_attn"]["fwd_speedup_long"] == 1.4
+    # The attempt was bounded: no retries, no cpu-fallback/AOT stages, and
+    # a handshake budget far below the full 480 s probe default.
+    kw = seen_kwargs[0]
+    assert kw["retries"] == 0 and kw["fallbacks"] is False
+    assert kw["timeouts"]["backend_init"] <= 180.0
+    events = [json.loads(l) for l in open(p["log_path"])]
+    assert any(e.get("loopback_attempt") for e in events)
+
+
+def test_loopback_attempt_not_made_without_env(tmp_path, monkeypatch):
+    """Outside loopback mode an all-refused preflight means the relay IS
+    down — the watcher must not burn PJRT handshakes on it."""
+    monkeypatch.setattr(
+        probe, "probe_pool_endpoints",
+        lambda **kw: [{"endpoint": "127.0.0.1:8082", "reachable": False}],
+    )
+
+    def _boom(**kw):
+        raise AssertionError("staged probe attempted without loopback env")
+
+    monkeypatch.setattr(probe, "staged_accelerator_probe", _boom)
+    p = _paths(tmp_path)
+    rc = rw.watch_relay(poll_s=0.01, max_hours=0.0001, **p)
+    assert rc == 1  # deadline, no relay
+
+
+def test_failed_loopback_attempt_cools_down(tmp_path, monkeypatch):
+    """Chip-down loopback mode — the watcher's dominant state: a failed
+    handshake must start a cooldown, not redial the relay every poll (the
+    relay has wedged on handshake churn, and each attempt costs minutes)."""
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    monkeypatch.setattr(
+        probe, "probe_pool_endpoints",
+        lambda **kw: [{"endpoint": "127.0.0.1:8082", "reachable": False}],
+    )
+    calls = []
+
+    def _probe(**kw):
+        calls.append(1)
+        return {"stages": {"backend_init": {"error": "hang"}},
+                "completed": ["devnodes"], "failed_stage": "backend_init"}
+
+    monkeypatch.setattr(probe, "staged_accelerator_probe", _probe)
+    p = _paths(tmp_path)
+    rc = rw.watch_relay(poll_s=0.01, max_hours=0.0003,  # ~1 s of polls
+                        min_capture_gap_s=0.0, **p)
+    assert rc == 1
+    events = [json.loads(l) for l in open(p["log_path"])]
+    n_polls = sum(1 for e in events if "up" in e)
+    assert n_polls > 10  # many polls happened...
+    assert len(calls) == 1  # ...but the relay was dialed once, then cooled
+
+
+def test_capture_marker_guards_concurrent_handshakes(tmp_path, monkeypatch):
+    """While the watcher's staged probe owns the relay, a concurrent
+    would-be client (bench.py) must see capture_in_progress() and wait —
+    overlapping PJRT handshakes have wedged the relay (r05)."""
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    monkeypatch.setattr(
+        probe, "probe_pool_endpoints",
+        lambda **kw: [{"endpoint": "127.0.0.1:8082", "reachable": False}],
+    )
+    p = _paths(tmp_path)
+    marker = str(tmp_path / "probe.json").replace(
+        "probe.json", "capture_in_progress.json"
+    )
+    seen_during = []
+
+    def _probe(**kw):
+        # The marker must be on disk exactly while the probe runs, naming
+        # this process — that is what a concurrent bench in ANOTHER
+        # process would read as in-progress. (From the same pid,
+        # capture_in_progress deliberately reads False: one's own marker
+        # cannot be a concurrent client.)
+        with open(marker) as f:
+            seen_during.append(json.load(f)["pid"])
+        assert rw.capture_in_progress(marker) is False  # own-pid exclusion
+        return _full_tpu_result()
+
+    monkeypatch.setattr(probe, "staged_accelerator_probe", _probe)
+    rc = rw.watch_relay(poll_s=0.01, max_hours=0.01, **p)
+    assert rc == 0
+    assert seen_during == [os.getpid()]
+    assert not os.path.exists(marker)  # cleared after
+    assert rw.wait_for_capture_idle(timeout_s=0.1, path=marker) is True
+
+
+def test_live_foreign_marker_reads_in_progress_and_defers_watcher(
+        tmp_path, monkeypatch):
+    """A marker naming a live OTHER process blocks clients — and a watcher
+    poll that finds the relay up must defer its capture, not dial."""
+    marker = str(tmp_path / "capture_in_progress.json")
+    # pid 1 is always alive; record its true start time so the pid-reuse
+    # check passes.
+    with open(marker, "w") as f:
+        json.dump({"pid": 1, "start": rw._proc_start_time(1)}, f)
+    assert rw.capture_in_progress(marker) is True
+    assert rw.wait_for_capture_idle(timeout_s=0.05, path=marker,
+                                    poll_s=0.01) is False
+
+    monkeypatch.setattr(
+        probe, "probe_pool_endpoints",
+        lambda **kw: [{"endpoint": "127.0.0.1:8082", "reachable": True}],
+    )
+
+    def _boom(**kw):
+        raise AssertionError("dialed the relay while another client held it")
+
+    monkeypatch.setattr(probe, "staged_accelerator_probe", _boom)
+    p = _paths(tmp_path)
+    rc = rw.watch_relay(poll_s=0.01, max_hours=0.0001, **p)
+    assert rc == 1  # deadline — every capture deferred
+    events = [json.loads(l) for l in open(p["log_path"])]
+    assert any(e.get("event") == "capture_deferred" for e in events)
+
+
+def test_stale_capture_marker_reads_idle(tmp_path):
+    marker = str(tmp_path / "capture_in_progress.json")
+    # Dead pid → stale marker → idle (a crashed watcher must not block
+    # every future bench for the round).
+    with open(marker, "w") as f:
+        json.dump({"pid": 2**22 + 1234, "start": "999999"}, f)
+    assert rw.capture_in_progress(marker) is False
+    # Garbage marker → idle.
+    with open(marker, "w") as f:
+        f.write("not json")
+    assert rw.capture_in_progress(marker) is False
+    # No marker → idle, and the wait returns immediately.
+    os.unlink(marker)
+    assert rw.wait_for_capture_idle(timeout_s=0.1, path=marker) is True
 
 
 def test_partial_capture_archived_but_watch_continues(tmp_path, monkeypatch):

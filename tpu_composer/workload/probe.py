@@ -202,6 +202,18 @@ def probe_pool_endpoints(timeout_s: float = 1.0) -> List[Dict[str, Any]]:
     return out
 
 
+def loopback_relay_mode(env: Optional[Dict[str, str]] = None) -> bool:
+    """True when AXON_LOOPBACK_RELAY requests in-process relay mode.
+    Conventional disable spellings ("0", "false", "no", "off", empty) are
+    OFF — plain string truthiness would read the explicit opt-out
+    AXON_LOOPBACK_RELAY=0 as loopback mode and disarm the tunnel-down
+    clamp on a box whose relay really is a dead TCP service."""
+    value = (env if env is not None else os.environ).get(
+        "AXON_LOOPBACK_RELAY", ""
+    )
+    return value.strip().lower() not in ("", "0", "false", "no", "off")
+
+
 def probe_devnodes() -> Dict[str, Any]:
     """Stage a: what does the host itself say about accelerators?
 
@@ -544,9 +556,17 @@ def staged_accelerator_probe(
     # budget × retries on a relay that is provably down wastes the whole
     # bench window; one short attempt still captures the canonical hang
     # stack for the record.
+    #
+    # Exception (r05): under AXON_LOOPBACK_RELAY the relay runs in-process
+    # with the PJRT plugin — there is no TCP listener at all, so an
+    # all-refused preflight says nothing about the chip (observed r05: every
+    # port refused while jax.devices() returned a live v5e). In loopback
+    # mode backend_init itself, with its own deadline, is the only honest
+    # reachability test — never clamp it.
     eps = devnodes.get("pool_endpoints", [])
     tunnel_down = bool(
         "axon" in env.get("JAX_PLATFORMS", "")
+        and not loopback_relay_mode(env)
         and eps
         and not any(e.get("reachable") for e in eps)
     )
@@ -759,9 +779,13 @@ try:
     ca = ca[0] if isinstance(ca, (list, tuple)) else ca
     xla_flops = float(ca.get("flops", 0.0))
     if xla_flops > 0:
+        # Raw compiler flops first: they must survive even if the shared
+        # peak-TFLOPS lookup below ever fails in the child env.
         out["qualify_large_hbm"]["xla_flops_per_step"] = xla_flops
+        from tpu_composer.workload.acceptance import _BF16_PEAK_TFLOPS
+        _peak_tflops = dict(_BF16_PEAK_TFLOPS)["TPU v5e"]
         out["qualify_large_hbm"]["min_step_ms_at_v5e_peak"] = round(
-            xla_flops / 197e12 * 1e3, 2
+            xla_flops / (_peak_tflops * 1e12) * 1e3, 2
         )
 except Exception:  # noqa: BLE001 - cost model availability varies by backend
     pass

@@ -117,6 +117,28 @@ def _write_pidfile(pid_path: str) -> None:
         f.write(f"{os.getpid()} {start}")
 
 
+def _pid_alive_with_start(pid: int, recorded_start: Optional[str]) -> bool:
+    """Is ``pid`` alive AND (when a start time was recorded) still the same
+    process — i.e. its /proc start time matches? The start-time comparison
+    is the pid-reuse discriminator: a SIGKILL'd process leaves its pid/
+    marker file behind, and a recycled pid must not read as alive.
+    ``recorded_start`` falsy skips the reuse check (caller decides how to
+    handle legacy records)."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False  # stale record, process gone
+    except PermissionError:
+        pass  # alive, owned by another user
+    except OSError:
+        return False
+    if recorded_start:
+        current = _proc_start_time(pid)
+        if current is not None and current != recorded_start:
+            return False
+    return True
+
+
 def _another_watcher_alive(pid_path: str) -> Optional[int]:
     try:
         with open(pid_path) as f:
@@ -125,23 +147,11 @@ def _another_watcher_alive(pid_path: str) -> Optional[int]:
         recorded_start = parts[1] if len(parts) > 1 else None
     except (OSError, ValueError, IndexError):
         return None
-    try:
-        os.kill(pid, 0)
-    except ProcessLookupError:
-        return None  # stale pidfile, process gone
-    except PermissionError:
-        pass  # alive, owned by another user — still a live watcher
-    except OSError:
+    # No cmdline heuristics on the start-time path — an embedded watcher
+    # (tests, another operator process) is a watcher too.
+    if not _pid_alive_with_start(pid, recorded_start):
         return None
-    # A SIGKILL'd watcher leaves its pidfile behind; if the pid has since
-    # been recycled by an unrelated process, its kernel start time cannot
-    # match the one recorded at pidfile-write. No cmdline heuristics in
-    # this path — an embedded watcher (tests, another operator process)
-    # is a watcher too.
     if recorded_start:
-        current = _proc_start_time(pid)
-        if current is not None and current != recorded_start:
-            return None
         return pid
     # Legacy pid-only pidfile (or a platform without /proc at write time):
     # no start time to compare, so a recycled pid would block every future
@@ -155,6 +165,60 @@ def _another_watcher_alive(pid_path: str) -> Optional[int]:
     except OSError:
         pass  # no /proc: err on the safe side, treat as alive
     return pid
+
+
+CAPTURE_MARKER_PATH = os.path.join(ARTIFACT_DIR, "capture_in_progress.json")
+
+
+def _mark_capture(path: str) -> None:
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"pid": os.getpid(),
+                       "start": _proc_start_time(os.getpid()),
+                       "t": _now()}, f)
+    except OSError:
+        pass
+
+
+def _clear_capture(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def capture_in_progress(path: str = CAPTURE_MARKER_PATH) -> bool:
+    """True while ANOTHER process's staged probe owns the relay. The axon
+    relay has wedged on concurrent PJRT handshakes (r05), so any would-be
+    client — watcher or bench — must wait this marker out rather than dial
+    in parallel. Stale markers (crashed writer, recycled pid) and the
+    caller's own marker (a crash-leftover from this very pid cannot be a
+    concurrent client) read as False."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        pid = int(rec["pid"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return False
+    if pid == os.getpid():
+        return False
+    return _pid_alive_with_start(pid, rec.get("start"))
+
+
+def wait_for_capture_idle(timeout_s: float = 1800.0,
+                          path: str = CAPTURE_MARKER_PATH,
+                          poll_s: float = 10.0) -> bool:
+    """Block until no watcher capture is in flight (True) or timeout_s
+    elapses (False). bench.py calls this before its own staged probe so an
+    end-of-round bench never handshakes concurrently with a mid-round
+    watcher capture — the overlap has wedged the relay for both."""
+    deadline = time.monotonic() + timeout_s
+    while capture_in_progress(path):
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(poll_s)
+    return True
 
 
 def watch_relay(
@@ -174,6 +238,7 @@ def watch_relay(
     supersedes nothing-at-all — and the watcher keeps polling, retrying a
     capture no more often than ``min_capture_gap_s``."""
     from tpu_composer.workload.probe import (
+        loopback_relay_mode,
         probe_pool_endpoints,
         staged_accelerator_probe,
     )
@@ -187,19 +252,79 @@ def watch_relay(
 
     deadline = time.monotonic() + max_hours * 3600.0
     last_capture_at = -float("inf")
+    last_negative_fallback_at = -float("inf")
+    # A failed loopback attempt costs a real (bounded) PJRT handshake, so
+    # in the chip-down state — the state the watcher exists to wait out —
+    # attempts run on a cooldown as well as the capture gap. Combined with
+    # min_capture_gap_s this means one ≤150 s handshake per gap when the
+    # relay is down: bounded detection latency, bounded relay poking.
+    negative_fallback_cooldown_s = 300.0
+    capture_marker_path = os.path.join(
+        os.path.dirname(archive_path), "capture_in_progress.json"
+    )
     polls = 0
     _log({"event": "start", "pid": os.getpid(), "poll_s": poll_s,
           "max_hours": max_hours}, log_path)
     try:
         while time.monotonic() < deadline:
+            capture_possible = (
+                time.monotonic() - last_capture_at >= min_capture_gap_s
+            )
             eps = probe_pool_endpoints()
             up = [e["endpoint"] for e in eps if e.get("reachable")]
+            # Loopback relay: in-process with the PJRT plugin, no TCP
+            # listener — an all-refused preflight is structurally
+            # meaningless (r05: every port refused while the chip
+            # answered). The only honest signal is a real PJRT handshake,
+            # and a successful handshake is already half a capture — so in
+            # loopback mode the watcher attempts the staged probe DIRECTLY
+            # (backend_init doubles as the reachability test) instead of
+            # spending a separate detection subprocess. One handshake per
+            # attempt also matters because the relay has wedged on
+            # concurrent/killed-mid-handshake clients (r05: two overlapping
+            # inits wedged a relay that had answered seconds earlier).
+            loopback_attempt = (
+                capture_possible
+                and not up
+                and loopback_relay_mode()
+                and time.monotonic() - last_negative_fallback_at
+                >= negative_fallback_cooldown_s
+            )
             polls += 1
-            _log({"up": bool(up), "reachable": up, "poll": polls}, log_path)
-            if up and time.monotonic() - last_capture_at >= min_capture_gap_s:
+            rec: Dict[str, Any] = {"up": bool(up), "reachable": up,
+                                   "poll": polls}
+            if loopback_attempt:
+                rec["loopback_attempt"] = True
+            _log(rec, log_path)
+            if (up or loopback_attempt) and capture_possible:
+                if capture_in_progress(capture_marker_path):
+                    # Another client (an end-of-round bench probe) already
+                    # holds the relay; dialing now would be the documented
+                    # overlapping-handshake wedge. Its capture refreshes
+                    # the same archive — defer, don't duplicate.
+                    _log({"event": "capture_deferred",
+                          "reason": "another client holds the relay"},
+                         log_path)
+                    time.sleep(poll_s)
+                    continue
                 last_capture_at = time.monotonic()
-                _log({"event": "capture_start", "reachable": up}, log_path)
-                result = staged_accelerator_probe(repo_root=REPO_ROOT)
+                _log({"event": "capture_start",
+                      "reachable": up or ["loopback-relay"]}, log_path)
+                kwargs: Dict[str, Any] = {}
+                if loopback_attempt:
+                    # Bound the handshake and skip the cpu-fallback/AOT
+                    # stages: a dead loopback relay must cost minutes per
+                    # attempt, not the full probe budget plus fallback
+                    # compiles, every capture gap for 11.5 h.
+                    kwargs = dict(timeouts={"backend_init": 150.0},
+                                  retries=0, fallbacks=False)
+                _mark_capture(capture_marker_path)
+                try:
+                    result = staged_accelerator_probe(
+                        repo_root=REPO_ROOT, **kwargs
+                    )
+                finally:
+                    _clear_capture(capture_marker_path)
                 backend = (
                     result.get("stages", {})
                     .get("backend_init", {})
@@ -231,6 +356,8 @@ def watch_relay(
                         _log({"event": "exit", "reason": "capture_complete"},
                              log_path)
                         return 0
+                elif loopback_attempt:
+                    last_negative_fallback_at = time.monotonic()
             time.sleep(poll_s)
         _log({"event": "exit", "reason": "deadline", "polls": polls}, log_path)
         return 1
