@@ -121,10 +121,8 @@ def bench_accelerator():
 
     from tpu_composer.workload.probe import staged_accelerator_probe
     from tpu_composer.workload.relay_watch import (
-        CAPTURE_MARKER_PATH,
-        _clear_capture,
-        _mark_capture,
         archive_tpu_probe,
+        hold_capture_marker,
         wait_for_capture_idle,
     )
 
@@ -132,26 +130,22 @@ def bench_accelerator():
     # axon relay has wedged on overlapping PJRT clients (r05), and the
     # watcher's capture is the same evidence this probe would gather. A
     # full capture can run ~50 min of stage budgets, so wait generously;
-    # if one is STILL in flight at timeout, skip the live probe entirely —
-    # the in-flight capture will refresh the same archive this bench would
-    # attach, and dialing anyway would wedge both.
+    # if one is STILL in flight at timeout — or the marker is lost to a
+    # watcher in the instant after the wait — skip the live probe
+    # entirely: the in-flight capture will refresh the same archive this
+    # bench would attach, and dialing anyway would wedge both.
+    skipped = ("another client held the relay; its capture supersedes a "
+               "live probe here")
     if not wait_for_capture_idle(timeout_s=3600.0):
-        out = {
-            "stages": {},
-            "completed": [],
-            "skipped": ("a relay-watcher capture was still in flight after "
-                        "3600 s; its result supersedes a live probe here"),
-        }
+        out = {"stages": {}, "completed": [], "skipped": skipped}
     else:
-        # Mark our own probe so a watcher poll that fires mid-bench defers
-        # instead of dialing in parallel (the guard is two-directional).
-        _mark_capture(CAPTURE_MARKER_PATH)
-        try:
-            out = staged_accelerator_probe(
-                repo_root=os.path.dirname(os.path.abspath(__file__))
-            )
-        finally:
-            _clear_capture(CAPTURE_MARKER_PATH)
+        with hold_capture_marker() as held:
+            if held:
+                out = staged_accelerator_probe(
+                    repo_root=os.path.dirname(os.path.abspath(__file__))
+                )
+            else:
+                out = {"stages": {}, "completed": [], "skipped": skipped}
     # The axon tunnel relay dies from time to time (r01/r02 benches both hit
     # it; r03 diagnosed the hang to make_c_api_client against a dead relay).
     # When the live probe could not reach the chip, attach the most recent

@@ -84,6 +84,27 @@ def test_loopback_relay_disarms_tunnel_down_clamp(monkeypatch):
     assert d2["attempts"] == 1
 
 
+def test_loopback_mode_caps_handshake_budget(monkeypatch):
+    """Loopback mode keeps retries but bounds backend_init (~15× a healthy
+    handshake): a wedged in-process relay must cost minutes per probe, not
+    480 s × attempts — the end-of-round bench runs on this path. Driven
+    with an instant-fail child: diagnosis.timeout_s records the budget the
+    parent computed for the stage."""
+    monkeypatch.setattr(probe, "_CHILD", "import sys; sys.exit(1)")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1:1")
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    r = probe.staged_accelerator_probe(retries=0, fallbacks=False)
+    d = r["diagnosis"]
+    assert r["failed_stage"] == "backend_init"
+    assert d["tunnel_down"] is False
+    assert d["timeout_s"] == 150.0  # capped from the 480 s default
+    # An explicit smaller caller budget still wins over the cap.
+    r2 = probe.staged_accelerator_probe(timeouts={"backend_init": 5.0},
+                                        retries=0, fallbacks=False)
+    assert r2["diagnosis"]["timeout_s"] == 5.0
+
+
 def test_loopback_relay_mode_spellings():
     on = {"AXON_LOOPBACK_RELAY": "1"}
     assert probe.loopback_relay_mode(on) is True

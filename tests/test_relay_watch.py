@@ -219,6 +219,30 @@ def test_live_foreign_marker_reads_in_progress_and_defers_watcher(
     assert any(e.get("event") == "capture_deferred" for e in events)
 
 
+def test_hold_capture_marker_acquire_semantics(tmp_path):
+    """The marker is claimed with O_CREAT|O_EXCL — check and claim are one
+    syscall — and a loser must never unlink the winner's marker."""
+    marker = str(tmp_path / "capture_in_progress.json")
+    with rw.hold_capture_marker(marker) as held:
+        assert held is True
+        rec = json.load(open(marker))
+        assert rec["pid"] == os.getpid()
+    assert not os.path.exists(marker)  # released on exit
+    # Foreign live marker → not acquired, and NOT cleared by the loser.
+    with open(marker, "w") as f:
+        json.dump({"pid": 1, "start": rw._proc_start_time(1)}, f)
+    with rw.hold_capture_marker(marker) as held:
+        assert held is False
+    assert os.path.exists(marker)
+    # Stale marker (dead pid) → reaped, then claimed.
+    with open(marker, "w") as f:
+        json.dump({"pid": 2**22 + 1234, "start": "999999"}, f)
+    with rw.hold_capture_marker(marker) as held:
+        assert held is True
+        assert json.load(open(marker))["pid"] == os.getpid()
+    assert not os.path.exists(marker)
+
+
 def test_stale_capture_marker_reads_idle(tmp_path):
     marker = str(tmp_path / "capture_in_progress.json")
     # Dead pid → stale marker → idle (a crashed watcher must not block

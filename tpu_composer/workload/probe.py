@@ -574,6 +574,18 @@ def staged_accelerator_probe(
         timeouts = {**timeouts, "backend_init": min(timeouts["backend_init"], 60.0)}
         env["TPUC_PROBE_STAGE_BUDGET_S"] = str(timeouts["backend_init"])
         retries = 0
+    elif "axon" in env.get("JAX_PLATFORMS", "") and loopback_relay_mode(env):
+        # Loopback mode has no preflight signal at all: a healthy
+        # in-process handshake completes in ~10 s, a wedged one blocks
+        # forever, and TCP says nothing either way. Cap the handshake so a
+        # dead relay costs minutes — not 480 s × (retries+1) — while
+        # keeping ~15× headroom over a healthy init. Callers' explicit
+        # smaller budgets still win (min).
+        timeouts = {
+            **timeouts,
+            "backend_init": min(timeouts["backend_init"], 150.0),
+        }
+        env["TPUC_PROBE_STAGE_BUDGET_S"] = str(timeouts["backend_init"])
 
     failed_attempts: List[Dict[str, Any]] = []
     for attempt in range(retries + 1):

@@ -23,6 +23,7 @@ because our bar does.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -170,22 +171,53 @@ def _another_watcher_alive(pid_path: str) -> Optional[int]:
 CAPTURE_MARKER_PATH = os.path.join(ARTIFACT_DIR, "capture_in_progress.json")
 
 
-def _mark_capture(path: str) -> None:
-    try:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as f:
-            json.dump({"pid": os.getpid(),
-                       "start": _proc_start_time(os.getpid()),
-                       "t": _now()}, f)
-    except OSError:
-        pass
-
-
 def _clear_capture(path: str) -> None:
     try:
         os.unlink(path)
     except OSError:
         pass
+
+
+def _try_acquire_marker(path: str) -> bool:
+    """Atomically create the capture marker (O_CREAT|O_EXCL — the check and
+    the claim are one syscall, so two clients cannot both win the race a
+    plain check-then-write leaves open). A marker that already exists but
+    is stale (dead/recycled pid, or this pid's own crash leftover) is
+    reaped and the claim retried once. On a filesystem that refuses the
+    marker entirely, proceed unguarded — a broken marker dir must not cost
+    a round's only capture window."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    for _ in range(2):
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            if capture_in_progress(path):
+                return False
+            _clear_capture(path)  # stale: reap, then retry the claim
+            continue
+        except OSError:
+            return True
+        with os.fdopen(fd, "w") as f:
+            json.dump({"pid": os.getpid(),
+                       "start": _proc_start_time(os.getpid()),
+                       "t": _now()}, f)
+        return True
+    return False
+
+
+@contextlib.contextmanager
+def hold_capture_marker(path: str = CAPTURE_MARKER_PATH):
+    """Serialize PJRT clients: yields True while this process holds the
+    capture marker (released on exit), False when another live client
+    holds it — the caller must then NOT dial the relay (overlapping
+    handshakes have wedged it, r05). The one shared acquisition protocol
+    for the watcher and bench.py."""
+    acquired = _try_acquire_marker(path)
+    try:
+        yield acquired
+    finally:
+        if acquired:
+            _clear_capture(path)
 
 
 def capture_in_progress(path: str = CAPTURE_MARKER_PATH) -> bool:
@@ -297,34 +329,32 @@ def watch_relay(
                 rec["loopback_attempt"] = True
             _log(rec, log_path)
             if (up or loopback_attempt) and capture_possible:
-                if capture_in_progress(capture_marker_path):
-                    # Another client (an end-of-round bench probe) already
-                    # holds the relay; dialing now would be the documented
-                    # overlapping-handshake wedge. Its capture refreshes
-                    # the same archive — defer, don't duplicate.
-                    _log({"event": "capture_deferred",
-                          "reason": "another client holds the relay"},
-                         log_path)
-                    time.sleep(poll_s)
-                    continue
-                last_capture_at = time.monotonic()
-                _log({"event": "capture_start",
-                      "reachable": up or ["loopback-relay"]}, log_path)
-                kwargs: Dict[str, Any] = {}
-                if loopback_attempt:
-                    # Bound the handshake and skip the cpu-fallback/AOT
-                    # stages: a dead loopback relay must cost minutes per
-                    # attempt, not the full probe budget plus fallback
-                    # compiles, every capture gap for 11.5 h.
-                    kwargs = dict(timeouts={"backend_init": 150.0},
-                                  retries=0, fallbacks=False)
-                _mark_capture(capture_marker_path)
-                try:
+                with hold_capture_marker(capture_marker_path) as held:
+                    if not held:
+                        # Another client (an end-of-round bench probe)
+                        # already holds the relay; dialing now would be the
+                        # documented overlapping-handshake wedge. Its
+                        # capture refreshes the same archive — defer,
+                        # don't duplicate.
+                        _log({"event": "capture_deferred",
+                              "reason": "another client holds the relay"},
+                             log_path)
+                        time.sleep(poll_s)
+                        continue
+                    last_capture_at = time.monotonic()
+                    _log({"event": "capture_start",
+                          "reachable": up or ["loopback-relay"]}, log_path)
+                    kwargs: Dict[str, Any] = {}
+                    if loopback_attempt:
+                        # Bound the handshake and skip the cpu-fallback/AOT
+                        # stages: a dead loopback relay must cost minutes
+                        # per attempt, not the full probe budget plus
+                        # fallback compiles, every capture gap for 11.5 h.
+                        kwargs = dict(timeouts={"backend_init": 150.0},
+                                      retries=0, fallbacks=False)
                     result = staged_accelerator_probe(
                         repo_root=REPO_ROOT, **kwargs
                     )
-                finally:
-                    _clear_capture(capture_marker_path)
                 backend = (
                     result.get("stages", {})
                     .get("backend_init", {})
