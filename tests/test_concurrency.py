@@ -143,6 +143,45 @@ class TestAllocationStorm:
         assert len(running) == 8, f"{len(running)} of 8 possible winners"
         check_invariants(store, pool)
 
+    def test_multi_host_children_created_concurrently(self, world):
+        """An 8-host slice's children go out as one concurrent wave of
+        creates, not 8 sequential store round-trips (each serial create
+        shifted its child's whole attach chain by one apiserver RTT)."""
+        store, pool, agent, mgr = world
+        windows = []
+        orig_create = store.create
+
+        def timed_create(obj):
+            t0 = time.monotonic()
+            try:
+                return orig_create(obj)
+            finally:
+                if isinstance(obj, ComposableResource):
+                    windows.append((t0, time.monotonic()))
+
+        store.create = timed_create
+        try:
+            store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name="wide"),
+                spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                    type="tpu", model="tpu-v4", size=CAPACITY)),
+            ))
+            settled(store, ["wide"])
+        finally:
+            store.create = orig_create
+        assert len(windows) == NODES
+        # Concurrency: the creates' time windows overlap — the span of all
+        # 8 is far less than the sum of their durations (serial execution
+        # would make span ≈ sum).
+        span = max(e for _, e in windows) - min(s for s, _ in windows)
+        total = sum(e - s for s, e in windows)
+        assert span < total * 0.75, (
+            f"creates look serial: span {span*1e3:.1f} ms vs "
+            f"sum {total*1e3:.1f} ms"
+        )
+        check_invariants(store, pool)
+        store.delete(ComposabilityRequest, "wide")
+
     def test_storm_then_total_teardown_conserves_chips(self, world):
         store, pool, agent, mgr = world
         names = [f"cycle-{i}" for i in range(8)]
