@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 
 import pytest
 
@@ -154,6 +155,51 @@ def test_failed_loopback_attempt_cools_down(tmp_path, monkeypatch):
     n_polls = sum(1 for e in events if "up" in e)
     assert n_polls > 10  # many polls happened...
     assert len(calls) == 1  # ...but the relay was dialed once, then cooled
+
+
+def test_failed_loopback_attempt_does_not_charge_capture_gap(tmp_path,
+                                                             monkeypatch):
+    """A failed handshake is a down-relay datum, not a capture: only the
+    cooldown prices it. Gap-pricing failures would sleep the watcher
+    through an uptime window the size of the one it exists to catch (the
+    r05 window was ~6 min; the default gap is 10)."""
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    monkeypatch.setattr(
+        probe, "probe_pool_endpoints",
+        lambda **kw: [{"endpoint": "127.0.0.1:8082", "reachable": False}],
+    )
+    calls = []
+
+    def _probe(**kw):
+        calls.append(1)
+        if len(calls) == 1:  # dead on the first dial...
+            return {"stages": {"backend_init": {"error": "hang"}},
+                    "completed": ["devnodes"],
+                    "failed_stage": "backend_init"}
+        return _full_tpu_result()  # ...the window opened by the second
+
+    monkeypatch.setattr(probe, "staged_accelerator_probe", _probe)
+    # Virtual clock: each sleep advances a minute, so the 180 s cooldown
+    # expires after a few polls while the 3600 s gap — which a failure
+    # must NOT charge — would outlast the whole watch if it did.
+    t = [0.0]
+    monkeypatch.setattr(time, "monotonic", lambda: t[0])
+    monkeypatch.setattr(time, "sleep",
+                        lambda s: t.__setitem__(0, t[0] + max(s, 60.0)))
+    p = _paths(tmp_path)
+    rc = rw.watch_relay(poll_s=0.01, max_hours=0.5,
+                        min_capture_gap_s=3600.0, **p)
+    assert rc == 0  # second dial captured despite the unexpired 3600s gap
+    assert len(calls) == 2
+    events = [json.loads(l) for l in open(p["log_path"])]
+    starts = [i for i, e in enumerate(events)
+              if e.get("event") == "capture_start"]
+    assert len(starts) == 2
+    # ...and the cooldown gated the redial: with sleeps advancing 60
+    # virtual seconds each and a 180 s cooldown, at least two non-attempt
+    # polls sit between the two capture_start events.
+    between = [e for e in events[starts[0] + 1:starts[1]] if "up" in e]
+    assert len(between) >= 2
 
 
 def test_capture_marker_guards_concurrent_handshakes(tmp_path, monkeypatch):

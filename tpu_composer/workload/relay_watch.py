@@ -287,10 +287,12 @@ def watch_relay(
     last_negative_fallback_at = -float("inf")
     # A failed loopback attempt costs a real (bounded) PJRT handshake, so
     # in the chip-down state — the state the watcher exists to wait out —
-    # attempts run on a cooldown as well as the capture gap. Combined with
-    # min_capture_gap_s this means one ≤150 s handshake per gap when the
-    # relay is down: bounded detection latency, bounded relay poking.
-    negative_fallback_cooldown_s = 300.0
+    # attempts run on a cooldown (the capture gap only prices attempts
+    # that actually reached the tpu backend). 180 s + the ≤90 s attempt
+    # itself ≈ one dial every ~4.5 min: tight enough to catch an uptime
+    # window the size of r05's observed ~6 min one, bounded enough not to
+    # hammer a wedged relay with kill-mid-handshake churn.
+    negative_fallback_cooldown_s = 180.0
     capture_marker_path = os.path.join(
         os.path.dirname(archive_path), "capture_in_progress.json"
     )
@@ -315,12 +317,13 @@ def watch_relay(
             # attempt also matters because the relay has wedged on
             # concurrent/killed-mid-handshake clients (r05: two overlapping
             # inits wedged a relay that had answered seconds earlier).
-            loopback_attempt = (
-                capture_possible
-                and not up
-                and loopback_relay_mode()
-                and time.monotonic() - last_negative_fallback_at
+            cooled = (
+                time.monotonic() - last_negative_fallback_at
                 >= negative_fallback_cooldown_s
+            )
+            loopback_attempt = (
+                capture_possible and cooled and not up
+                and loopback_relay_mode()
             )
             polls += 1
             rec: Dict[str, Any] = {"up": bool(up), "reachable": up,
@@ -328,7 +331,7 @@ def watch_relay(
             if loopback_attempt:
                 rec["loopback_attempt"] = True
             _log(rec, log_path)
-            if (up or loopback_attempt) and capture_possible:
+            if (up or loopback_attempt) and capture_possible and cooled:
                 with hold_capture_marker(capture_marker_path) as held:
                     if not held:
                         # Another client (an end-of-round bench probe)
@@ -341,16 +344,18 @@ def watch_relay(
                              log_path)
                         time.sleep(poll_s)
                         continue
+                    prev_capture_at = last_capture_at
                     last_capture_at = time.monotonic()
                     _log({"event": "capture_start",
                           "reachable": up or ["loopback-relay"]}, log_path)
                     kwargs: Dict[str, Any] = {}
                     if loopback_attempt:
                         # Bound the handshake and skip the cpu-fallback/AOT
-                        # stages: a dead loopback relay must cost minutes
+                        # stages: a dead loopback relay must cost ~a minute
                         # per attempt, not the full probe budget plus
                         # fallback compiles, every capture gap for 11.5 h.
-                        kwargs = dict(timeouts={"backend_init": 150.0},
+                        # (90 s is ~9× a healthy in-process handshake.)
+                        kwargs = dict(timeouts={"backend_init": 90.0},
                                       retries=0, fallbacks=False)
                     result = staged_accelerator_probe(
                         repo_root=REPO_ROOT, **kwargs
@@ -386,7 +391,15 @@ def watch_relay(
                         _log({"event": "exit", "reason": "capture_complete"},
                              log_path)
                         return 0
-                elif loopback_attempt:
+                else:
+                    # A failed handshake — loopback dial or a TCP-path
+                    # attempt whose relay died between preflight and
+                    # handshake — is a DOWN-relay datum, not a capture: it
+                    # pays only the (shorter) cooldown, never the capture
+                    # gap. The one observed relay-uptime window (r05) was
+                    # ~6 min; a gap-priced failure just before a window
+                    # opened would sleep straight through it.
+                    last_capture_at = prev_capture_at
                     last_negative_fallback_at = time.monotonic()
             time.sleep(poll_s)
         _log({"event": "exit", "reason": "deadline", "polls": polls}, log_path)
