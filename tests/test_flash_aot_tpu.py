@@ -59,6 +59,30 @@ def _sds(shape, dtype):
     )
 
 
+class TestPagedDecodeCompilesForTPU:
+    def test_paged_decode_kernel_bf16(self):
+        """The block-walking paged-decode kernel (scalar-prefetched table
+        index maps, ops/paged_attention.py) lowers through Mosaic for
+        v5e: serving-sized GQA decode — 8 query heads over 2 KV heads,
+        128-token blocks."""
+        import functools
+
+        from tpu_composer.ops.paged_attention import paged_decode_attention
+
+        n, bs, kv, dh, b, h, mb = 64, 128, 2, 128, 8, 8, 16
+        args = (
+            _sds((b, h, dh), jnp.bfloat16),        # q
+            _sds((n, bs, kv, dh), jnp.bfloat16),   # k_pool
+            _sds((n, bs, kv, dh), jnp.bfloat16),   # v_pool
+            _sds((b, mb), jnp.int32),              # block_tables
+            _sds((b,), jnp.int32),                 # lengths
+        )
+        compiled = jax.jit(functools.partial(
+            paged_decode_attention, interpret=False
+        )).lower(*args).compile()
+        assert compiled is not None
+
+
 class TestFlashCompilesForTPU:
     def test_grad_bf16_causal_default_blocks(self):
         """Training path: fwd (packed-lse write) + dq + dkv kernels, default

@@ -1,0 +1,255 @@
+"""Paged KV cache — the contract is EXACT equivalence with dense decode.
+
+Paging changes where bytes live, never what is attended: every test here
+pins paged output against the dense path (models/decode.py), and the
+pool-accounting tests pin that blocks are conserved across admit /
+extend / release churn — the serving analog of the operator's
+chip-conservation storms.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_composer.models import ModelConfig
+from tpu_composer.models.decode import generate, prefill, decode_step
+from tpu_composer.models.moe import MoEConfig
+from tpu_composer.models.paged import (
+    _extend_for_write,
+    admit,
+    init_paged_cache,
+    paged_decode_step,
+    paged_generate,
+    paged_prefill,
+    release,
+)
+from tpu_composer.models.transformer import init_params
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                d_ff=64, max_seq=64, dtype=jnp.float32)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def _params(c, seed=0):
+    return init_params(c, jax.random.key(seed))
+
+
+class TestParity:
+    def test_greedy_tokens_match_dense(self):
+        c = _cfg()
+        p = _params(c)
+        prompt = jax.random.randint(jax.random.key(1), (3, 7), 0, c.vocab_size)
+        dense = generate(p, prompt, c, max_new_tokens=12)
+        paged = paged_generate(p, prompt, c, max_new_tokens=12,
+                               num_blocks=32, block_size=4)
+        np.testing.assert_array_equal(np.asarray(dense), np.asarray(paged))
+
+    def test_ragged_prompts_match_dense(self):
+        c = _cfg()
+        p = _params(c)
+        prompt = jax.random.randint(jax.random.key(2), (3, 8), 0, c.vocab_size)
+        lens = jnp.array([3, 8, 5], jnp.int32)
+        dense = generate(p, prompt, c, max_new_tokens=9, prompt_lens=lens)
+        paged = paged_generate(p, prompt, c, max_new_tokens=9,
+                               num_blocks=24, block_size=8,
+                               prompt_lens=lens)
+        np.testing.assert_array_equal(np.asarray(dense), np.asarray(paged))
+
+    def test_block_size_one_and_large(self):
+        # Degenerate block sizes: 1 (a block per token) and >= the whole
+        # sequence (paging reduces to the dense layout).
+        c = _cfg()
+        p = _params(c)
+        prompt = jax.random.randint(jax.random.key(3), (2, 5), 0, c.vocab_size)
+        dense = generate(p, prompt, c, max_new_tokens=6)
+        for bs, nb in ((1, 32), (64, 4)):
+            paged = paged_generate(p, prompt, c, max_new_tokens=6,
+                                   num_blocks=nb, block_size=bs)
+            np.testing.assert_array_equal(np.asarray(dense),
+                                          np.asarray(paged))
+
+    def test_moe_decode_matches_dense(self):
+        from tpu_composer.models.moe import init_params as init_moe_params
+
+        c = MoEConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=64, max_seq=64,
+                      dtype=jnp.float32, n_experts=4, top_k=2)
+        p = init_moe_params(c, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(4), (2, 6), 0, c.vocab_size)
+        dense = generate(p, prompt, c, max_new_tokens=8)
+        paged = paged_generate(p, prompt, c, max_new_tokens=8,
+                               num_blocks=16, block_size=8)
+        np.testing.assert_array_equal(np.asarray(dense), np.asarray(paged))
+
+    def test_step_logits_match_dense_step(self):
+        # Beyond token equality: the logits themselves agree step by step.
+        c = _cfg()
+        p = _params(c)
+        prompt = jax.random.randint(jax.random.key(5), (2, 6), 0, c.vocab_size)
+        d_logits, d_cache = prefill(p, prompt, c)
+        cache = init_paged_cache(c, 2, num_blocks=32, block_size=4)
+        p_logits, cache, ok = paged_prefill(p, prompt, c, cache)
+        assert bool(ok)
+        np.testing.assert_allclose(np.asarray(d_logits),
+                                   np.asarray(p_logits), rtol=1e-5)
+        tok = jnp.argmax(p_logits, axis=-1).astype(jnp.int32)
+        for _ in range(4):
+            d_logits, d_cache = decode_step(p, d_cache, tok, c)
+            p_logits, cache, ok = paged_decode_step(p, cache, tok, c)
+            assert bool(ok)
+            np.testing.assert_allclose(np.asarray(d_logits),
+                                       np.asarray(p_logits), rtol=1e-5)
+            tok = jnp.argmax(p_logits, axis=-1).astype(jnp.int32)
+
+    def test_whole_generate_is_jittable(self):
+        c = _cfg()
+        p = _params(c)
+        prompt = jax.random.randint(jax.random.key(6), (2, 5), 0, c.vocab_size)
+        fast = jax.jit(lambda pp, t: paged_generate(
+            pp, t, c, max_new_tokens=6, num_blocks=16, block_size=8))
+        np.testing.assert_array_equal(
+            np.asarray(fast(p, prompt)),
+            np.asarray(generate(p, prompt, c, max_new_tokens=6)))
+
+
+class TestPoolAccounting:
+    def _empty(self, batch=4, num_blocks=16, bs=4):
+        return init_paged_cache(_cfg(), batch, num_blocks, bs)
+
+    def test_admit_allocates_ceil_blocks(self):
+        cache = self._empty()
+        cache, ok = admit(cache, jnp.array([1, 1, 0, 0]),
+                          jnp.array([5, 4, 0, 0], jnp.int32))
+        assert bool(ok)
+        assert cache.n_blocks.tolist() == [2, 1, 0, 0]  # ceil(5/4), 4/4
+        assert int(cache.free_top) == 13
+        # The three assigned blocks are distinct pool ids.
+        used = (list(cache.block_tables[0, :2].tolist())
+                + [int(cache.block_tables[1, 0])])
+        assert len(set(used)) == 3
+
+    def test_admit_over_capacity_is_all_or_nothing(self):
+        cache = self._empty(batch=2, num_blocks=3, bs=4)
+        before = jax.tree_util.tree_map(np.asarray, cache)
+        cache2, ok = admit(cache, jnp.array([1, 1]),
+                           jnp.array([8, 8], jnp.int32))  # wants 4 > 3
+        assert not bool(ok)
+        after = jax.tree_util.tree_map(np.asarray, cache2)
+        for a, b in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(after)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_release_returns_blocks_for_reuse(self):
+        cache = self._empty(batch=2, num_blocks=4, bs=4)
+        cache, ok = admit(cache, jnp.array([1, 1]),
+                          jnp.array([8, 8], jnp.int32))
+        assert bool(ok) and int(cache.free_top) == 0
+        cache = release(cache, jnp.array([1, 0]))
+        assert int(cache.free_top) == 2
+        assert cache.n_blocks.tolist() == [0, 2]
+        # The freed blocks are immediately re-admittable to the other row
+        # pattern — churn cannot leak blocks.
+        cache, ok = admit(cache, jnp.array([1, 0]),
+                          jnp.array([8, 0], jnp.int32))
+        assert bool(ok) and int(cache.free_top) == 0
+        # Every owned block id distinct across rows.
+        owned = (cache.block_tables[0, :2].tolist()
+                 + cache.block_tables[1, :2].tolist())
+        assert len(set(owned)) == 4
+
+    def test_decode_claims_block_only_on_boundary(self):
+        cache = self._empty(batch=1, num_blocks=4, bs=4)
+        cache, _ = admit(cache, jnp.array([1]), jnp.array([3], jnp.int32))
+        cache = cache._replace(length=jnp.array([3], jnp.int32))
+        free0 = int(cache.free_top)
+        cache, ok = _extend_for_write(cache, 1)  # pos 3 fits block 0
+        assert bool(ok) and int(cache.free_top) == free0
+        cache = cache._replace(length=jnp.array([4], jnp.int32))
+        cache, ok = _extend_for_write(cache, 1)  # pos 4 needs block 1
+        assert bool(ok) and int(cache.free_top) == free0 - 1
+        assert int(cache.n_blocks[0]) == 2
+
+    def test_churn_conserves_blocks(self):
+        # Admission/release storm: every cycle the pool must come back to
+        # its full free count, with no duplicate ids on the free stack.
+        cache = self._empty(batch=4, num_blocks=12, bs=4)
+        key = jax.random.key(7)
+        for i in range(20):
+            key, k1, k2 = jax.random.split(key, 3)
+            mask = jax.random.bernoulli(k1, 0.7, (4,)).astype(jnp.int32)
+            toks = jax.random.randint(k2, (4,), 1, 12)
+            cache2, ok = admit(cache, mask, toks)
+            if bool(ok):
+                cache = cache2
+            cache = release(cache, jnp.ones((4,), jnp.int32))
+            assert int(cache.free_top) == 12
+            free_ids = sorted(cache.free.tolist())
+            assert free_ids == list(range(12)), f"cycle {i}: {free_ids}"
+
+    def test_exhausted_step_is_a_cache_noop_and_flags(self):
+        """Pool exhaustion at a block boundary: the step must return
+        ok=False with the cache byte-identical — writing through the
+        unchanged tables would scatter into blocks OWNED BY OTHER ROWS
+        (the review-caught silent-corruption path)."""
+        c = _cfg()
+        p = _params(c)
+        # 2 rows, pool of exactly 2 blocks of 4: both rows fill their
+        # only block completely; the next step needs 2 new blocks.
+        prompt = jax.random.randint(jax.random.key(9), (2, 4), 0,
+                                    c.vocab_size)
+        cache = init_paged_cache(c, 2, num_blocks=2, block_size=4)
+        _, cache, ok = paged_prefill(p, prompt, c, cache)
+        assert bool(ok) and int(cache.free_top) == 0
+        before = jax.tree_util.tree_map(np.asarray, cache)
+        tok = jnp.zeros((2,), jnp.int32)
+        _, cache2, ok = paged_decode_step(p, cache, tok, c)
+        assert not bool(ok)
+        after = jax.tree_util.tree_map(np.asarray, cache2)
+        for a, b in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(after)):
+            np.testing.assert_array_equal(a, b)
+        # Releasing a row unblocks the other — the documented recovery.
+        cache3 = release(cache2, jnp.array([0, 1]))
+        _, cache4, ok = paged_decode_step(p, cache3, tok, c)
+        assert bool(ok) and int(cache4.length[0]) == 5
+
+    def test_prefill_over_capacity_flags_and_leaves_pool_clean(self):
+        c = _cfg()
+        p = _params(c)
+        prompt = jax.random.randint(jax.random.key(10), (2, 8), 0,
+                                    c.vocab_size)
+        cache = init_paged_cache(c, 2, num_blocks=2, block_size=4)  # wants 4
+        before = jax.tree_util.tree_map(np.asarray, cache)
+        _, cache2, ok = paged_prefill(p, prompt, c, cache)
+        assert not bool(ok)
+        after = jax.tree_util.tree_map(np.asarray, cache2)
+        for a, b in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(after)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_generate_pool_too_small_raises(self):
+        c = _cfg()
+        p = _params(c)
+        prompt = jnp.zeros((2, 5), jnp.int32)
+        with pytest.raises(ValueError, match="cannot cover the worst case"):
+            paged_generate(p, prompt, c, max_new_tokens=20,
+                           num_blocks=2, block_size=4)
+
+    def test_memory_footprint_is_the_point(self):
+        # The design claim, asserted: a pool sized for the ACTUAL tokens
+        # is a fraction of the dense B x max_seq cache.
+        c = _cfg(max_seq=4096)
+        from tpu_composer.models.decode import init_kv_cache
+
+        dense = init_kv_cache(c, batch=8)
+        paged = init_paged_cache(c, batch=8, num_blocks=64, block_size=16)
+        dense_bytes = dense.k.size * dense.k.dtype.itemsize * 2
+        paged_bytes = paged.k_pool.size * paged.k_pool.dtype.itemsize * 2
+        # 64 blocks x 16 = 1024 cached positions total vs 8 x 4096 dense.
+        assert paged_bytes * 8 <= dense_bytes

@@ -1,0 +1,128 @@
+"""Pallas paged-decode kernel vs the gather reference path.
+
+The kernel must compute the same attention the gather path computes —
+different reduction order, so tolerance-level agreement on outputs and
+EXACT agreement on greedy tokens through the full model.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_composer.models import ModelConfig
+from tpu_composer.models.decode import _cached_attention, generate
+from tpu_composer.models.paged import (
+    _paged_read,
+    admit,
+    init_paged_cache,
+    paged_generate,
+)
+from tpu_composer.models.transformer import init_params
+from tpu_composer.ops.paged_attention import paged_decode_attention
+
+
+def _rand_pool(key, n, bs, kv, dh, dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    return (jax.random.normal(k1, (n, bs, kv, dh), dtype),
+            jax.random.normal(k2, (n, bs, kv, dh), dtype))
+
+
+def _gather_reference(q, k_pool, v_pool, tables, lengths):
+    """The models/paged.py read path driven directly: gather + the dense
+    _cached_attention with a per-row length mask."""
+    c = ModelConfig(d_model=q.shape[1] * q.shape[2], n_heads=q.shape[1],
+                    n_kv_heads=k_pool.shape[2], dtype=q.dtype)
+    kg = _paged_read(k_pool, tables)
+    vg = _paged_read(v_pool, tables)
+    out = _cached_attention(
+        q[:, None], kg, vg, lengths, c,
+        q_positions=(lengths - 1)[:, None],
+    )
+    return out[:, 0]
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("h,kv", [(4, 2), (8, 8), (4, 1)])
+    def test_matches_gather_reference(self, h, kv):
+        dh, bs, n, b, mb = 64, 16, 12, 3, 3
+        key = jax.random.key(0)
+        k_pool, v_pool = _rand_pool(key, n, bs, kv, dh)
+        q = jax.random.normal(jax.random.key(1), (b, h, dh), jnp.float32)
+        tables = jnp.array([[4, 7, 2], [0, 3, 5], [8, 9, 1]], jnp.int32)
+        lengths = jnp.array([40, 17, 48], jnp.int32)  # ragged, mid-block
+        got = paged_decode_attention(
+            q, k_pool, v_pool, tables, lengths, interpret=True)
+        want = _gather_reference(q, k_pool, v_pool, tables, lengths)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_single_position_row(self):
+        # length 1: exactly one cache position visible — softmax over a
+        # single element must be numerically clean, not 0/0.
+        dh, bs, n, b, h, kv = 32, 8, 4, 2, 4, 2
+        k_pool, v_pool = _rand_pool(jax.random.key(2), n, bs, kv, dh)
+        q = jax.random.normal(jax.random.key(3), (b, h, dh), jnp.float32)
+        tables = jnp.array([[1, 2], [3, 0]], jnp.int32)
+        lengths = jnp.array([1, 9], jnp.int32)
+        got = paged_decode_attention(
+            q, k_pool, v_pool, tables, lengths, interpret=True)
+        want = _gather_reference(q, k_pool, v_pool, tables, lengths)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        assert np.isfinite(np.asarray(got)).all()
+
+    def test_stale_table_slots_never_leak(self):
+        # Slots past a row's valid blocks keep stale pool ids; the length
+        # mask alone must exclude them. Poison every unused block with
+        # huge values — output must not change.
+        dh, bs, n, b, h, kv = 32, 8, 8, 1, 2, 1
+        k_pool, v_pool = _rand_pool(jax.random.key(4), n, bs, kv, dh)
+        q = jax.random.normal(jax.random.key(5), (b, h, dh), jnp.float32)
+        tables = jnp.array([[2, 6]], jnp.int32)
+        lengths = jnp.array([11], jnp.int32)  # block 2 full, block 6 partial
+        base = paged_decode_attention(
+            q, k_pool, v_pool, tables, lengths, interpret=True)
+        poison = jnp.full_like(k_pool, 1e9)
+        keep = jnp.zeros((n,), bool).at[jnp.array([2, 6])].set(True)
+        k_p = jnp.where(keep[:, None, None, None], k_pool, poison)
+        v_p = jnp.where(keep[:, None, None, None], v_pool, poison)
+        # ...and poison the valid-but-past-length tail of block 6 too.
+        tail = jnp.arange(bs) >= 11 - bs  # positions 11.. in slot 1
+        k_p = k_p.at[6].set(jnp.where(tail[:, None, None], 1e9, k_p[6]))
+        v_p = v_p.at[6].set(jnp.where(tail[:, None, None], 1e9, v_p[6]))
+        got = paged_decode_attention(
+            q, k_p, v_p, tables, lengths, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(base),
+                                   rtol=1e-6)
+
+    def test_bf16_pool(self):
+        dh, bs, n, b, h, kv = 64, 16, 6, 2, 4, 2
+        k_pool, v_pool = _rand_pool(jax.random.key(6), n, bs, kv, dh,
+                                    jnp.bfloat16)
+        q = jax.random.normal(jax.random.key(7), (b, h, dh), jnp.bfloat16)
+        tables = jnp.array([[0, 1, 2], [3, 4, 5]], jnp.int32)
+        lengths = jnp.array([33, 48], jnp.int32)
+        got = paged_decode_attention(
+            q, k_pool, v_pool, tables, lengths, interpret=True)
+        want = _gather_reference(q, k_pool, v_pool, tables, lengths)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=2e-2, atol=2e-2)
+
+
+class TestEndToEnd:
+    def test_paged_generate_pallas_matches_dense_greedy(self):
+        c = ModelConfig(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, d_ff=64, max_seq=64,
+                        dtype=jnp.float32)
+        p = init_params(c, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(1), (2, 6), 0,
+                                    c.vocab_size)
+        dense = generate(p, prompt, c, max_new_tokens=8)
+        paged = paged_generate(p, prompt, c, max_new_tokens=8,
+                               num_blocks=16, block_size=8,
+                               attn_impl="pallas")
+        np.testing.assert_array_equal(np.asarray(dense), np.asarray(paged))

@@ -1,0 +1,396 @@
+"""Paged (block-table) KV cache for serving — HBM scales with tokens, not
+``B x max_seq``.
+
+The dense ``KVCache`` (models/decode.py) allocates every row its full
+``max_seq`` strip up front, so a batch of mostly-short sequences wastes
+the HBM that long-context serving is starved for. Here K/V live in a
+shared pool of fixed-size blocks (``(n_layers, num_blocks, block_size,
+KV, Dh)``); each row owns an ordered table of block indices and appends
+into its last block, claiming a new one from the free stack only when it
+crosses a block boundary. Rows admit and release independently, so the
+pool serves a churning request mix at its real total-token footprint —
+the design popularized by paged-attention GPU servers, rebuilt
+TPU-first: every shape is static, allocation is a vectorized stack
+pop/push (no host round-trip inside jit), and the attention read is
+either one gather (reference path, any backend) or the Pallas kernel in
+``ops/paged_attention.py`` that walks the block table in-kernel via
+scalar prefetch and never materializes the gathered cache.
+
+No reference analog (the reference runs no models); first-class here per
+the build spec (SURVEY §7: serving is a headline workload of composed
+slices).
+
+Semantics contract, pinned by tests/test_paged.py: a paged decode
+computes EXACTLY what the dense decode computes (same tokens greedy,
+logits equal up to dtype noise) — paging changes where bytes live, never
+what is attended.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_composer.models.decode import (
+    AnyConfig,
+    _cached_attention,
+    _ffn_delta,
+    _project_qkv,
+)
+from tpu_composer.models.moe import MoEConfig
+from tpu_composer.models.quant import embedding_lookup, resolve
+from tpu_composer.models.transformer import _rmsnorm, _select_attn
+
+
+class PagedKVCache(NamedTuple):
+    """Shared block pool + per-row block tables.
+
+    - ``k_pool``/``v_pool``: (L, N, Bs, KV, Dh) — all rows' blocks.
+    - ``block_tables``: (B, MB) int32 — row-major block ids; slot ``j``
+      holds the row's positions ``[j*Bs, (j+1)*Bs)``. Unassigned slots
+      keep stale ids — reads mask by ``length``, never by table content.
+    - ``length``: (B,) int32 — valid positions per row.
+    - ``n_blocks``: (B,) int32 — blocks currently owned per row.
+    - ``free``: (N,) int32 — stack of free block ids; ``free[:free_top]``
+      are free, popped from the top.
+    - ``free_top``: () int32.
+    """
+
+    k_pool: jax.Array
+    v_pool: jax.Array
+    block_tables: jax.Array
+    length: jax.Array
+    n_blocks: jax.Array
+    free: jax.Array
+    free_top: jax.Array
+
+    @property
+    def block_size(self) -> int:
+        return self.k_pool.shape[2]
+
+    @property
+    def capacity_per_row(self) -> int:
+        return self.block_tables.shape[1] * self.block_size
+
+
+def init_paged_cache(
+    config: AnyConfig,
+    batch: int,
+    num_blocks: int,
+    block_size: int = 16,
+    blocks_per_row: Optional[int] = None,
+) -> PagedKVCache:
+    """Empty pool. ``blocks_per_row`` bounds one row's table (default: the
+    whole pool — any single row may grow to every block)."""
+    c = config
+    mb = blocks_per_row or num_blocks
+    shape = (c.n_layers, num_blocks, block_size, c.kv_heads, c.head_dim)
+    return PagedKVCache(
+        k_pool=jnp.zeros(shape, c.dtype),
+        v_pool=jnp.zeros(shape, c.dtype),
+        block_tables=jnp.zeros((batch, mb), jnp.int32),
+        length=jnp.zeros((batch,), jnp.int32),
+        n_blocks=jnp.zeros((batch,), jnp.int32),
+        free=jnp.arange(num_blocks, dtype=jnp.int32),
+        free_top=jnp.asarray(num_blocks, jnp.int32),
+    )
+
+
+def _blocks_needed(tokens: jax.Array, block_size: int) -> jax.Array:
+    return -(-tokens // block_size)  # ceil
+
+
+def admit(
+    cache: PagedKVCache, row_mask: jax.Array, n_tokens: jax.Array
+) -> Tuple[PagedKVCache, jax.Array]:
+    """Assign ``ceil(n_tokens/Bs)`` fresh blocks to each masked row and
+    reset its length to 0 (the caller prefills next). Returns
+    ``(cache, ok)`` — ``ok`` False when the pool cannot cover the request,
+    in which case the cache is returned UNCHANGED (all-or-nothing, the
+    allocator discipline the operator's slice solver uses too).
+
+    Masked rows must be empty (released) — admission never frees."""
+    b, mb = cache.block_tables.shape
+    row_mask = row_mask.astype(bool)
+    want_rows = jnp.where(
+        row_mask, _blocks_needed(n_tokens, cache.block_size), 0
+    )
+    slot = jnp.arange(mb, dtype=jnp.int32)[None, :]
+    want = slot < want_rows[:, None]  # (B, MB) bool
+    flat = want.reshape(-1)
+    total = flat.sum()
+    ok = total <= cache.free_top
+    rank = jnp.cumsum(flat) - 1
+    pop_idx = cache.free_top - 1 - rank
+    popped = cache.free[jnp.clip(pop_idx, 0, cache.free.shape[0] - 1)]
+    tables_flat = jnp.where(flat, popped, cache.block_tables.reshape(-1))
+    new = PagedKVCache(
+        k_pool=cache.k_pool,
+        v_pool=cache.v_pool,
+        block_tables=tables_flat.reshape(b, mb),
+        length=jnp.where(row_mask, 0, cache.length),
+        n_blocks=jnp.where(row_mask, want_rows, cache.n_blocks),
+        free=cache.free,
+        free_top=cache.free_top - total,
+    )
+    # All-or-nothing: on overflow nothing changes (jnp.where over the
+    # pytree keeps shapes static under jit).
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new, cache
+    ), ok
+
+
+def release(cache: PagedKVCache, row_mask: jax.Array) -> PagedKVCache:
+    """Push the masked rows' blocks back on the free stack and zero the
+    rows. The pool data itself is left as-is — stale blocks are never
+    readable because reads mask by length."""
+    b, mb = cache.block_tables.shape
+    slot = jnp.arange(mb, dtype=jnp.int32)[None, :]
+    used = (slot < cache.n_blocks[:, None]) & row_mask[:, None].astype(bool)
+    flat = used.reshape(-1)
+    rank = jnp.cumsum(flat) - 1
+    push_idx = jnp.where(flat, cache.free_top + rank, cache.free.shape[0])
+    free = cache.free.at[push_idx].set(
+        cache.block_tables.reshape(-1), mode="drop"
+    )
+    return cache._replace(
+        free=free,
+        free_top=cache.free_top + flat.sum(),
+        length=jnp.where(row_mask, 0, cache.length),
+        n_blocks=jnp.where(row_mask, 0, cache.n_blocks),
+    )
+
+
+def _extend_for_write(
+    cache: PagedKVCache, t: int
+) -> Tuple[PagedKVCache, jax.Array]:
+    """Claim blocks so every active row can append ``t`` tokens at its
+    current length. Returns (cache, ok). Rows past their table capacity
+    make ``ok`` False (caller guards statically; tests pin it)."""
+    b, mb = cache.block_tables.shape
+    active = cache.n_blocks > 0
+    need_total = _blocks_needed(cache.length + t, cache.block_size)
+    need_total = jnp.where(active, need_total, 0)
+    slot = jnp.arange(mb, dtype=jnp.int32)[None, :]
+    want = (slot >= cache.n_blocks[:, None]) & (slot < need_total[:, None])
+    flat = want.reshape(-1)
+    total = flat.sum()
+    ok = (total <= cache.free_top) & jnp.all(need_total <= mb)
+    rank = jnp.cumsum(flat) - 1
+    pop_idx = cache.free_top - 1 - rank
+    popped = cache.free[jnp.clip(pop_idx, 0, cache.free.shape[0] - 1)]
+    tables_flat = jnp.where(flat, popped, cache.block_tables.reshape(-1))
+    new = cache._replace(
+        block_tables=tables_flat.reshape(b, mb),
+        n_blocks=jnp.maximum(cache.n_blocks, need_total),
+        free_top=cache.free_top - total,
+    )
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new, cache
+    ), ok
+
+
+def _paged_write(pool_layer, tables, new, pos):
+    """Scatter ``new`` (B, T, KV, Dh) into the pool at each row's
+    positions ``pos..pos+T``. Blocks are row-owned so the (block, offset)
+    pairs are distinct — scatter order is irrelevant."""
+    b, t = new.shape[0], new.shape[1]
+    bs = pool_layer.shape[1]
+    abs_pos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    blk_slot = abs_pos // bs  # (B, T) slot in the row's table
+    blk = jnp.take_along_axis(tables, blk_slot, axis=1)  # (B, T) pool ids
+    off = abs_pos % bs
+    return pool_layer.at[blk.reshape(-1), off.reshape(-1)].set(
+        new.reshape((-1,) + new.shape[2:])
+    )
+
+
+def _paged_read(pool_layer, tables):
+    """Gather a row-contiguous view (B, MB*Bs, KV, Dh) — the reference
+    attention path. Slot j of the table lands at positions [j*Bs,(j+1)*Bs)
+    by construction, so downstream masking-by-length is identical to the
+    dense cache. The Pallas kernel (ops/paged_attention.py) computes the
+    same function without materializing this gather."""
+    b, mb = tables.shape
+    g = pool_layer[tables.reshape(-1)]  # (B*MB, Bs, KV, Dh)
+    return g.reshape(b, mb * g.shape[1], g.shape[2], g.shape[3])
+
+
+def paged_prefill(
+    params: Dict,
+    tokens: jax.Array,
+    config: AnyConfig,
+    cache: PagedKVCache,
+    prompt_lens: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, PagedKVCache, jax.Array]:
+    """Admit every row and run the prompt, writing K/V into blocks.
+    Returns (last-real-position logits (B, vocab), cache, ok). Mirrors
+    decode.prefill's math exactly (same helpers); only the cache writes
+    differ. Ragged rows allocate by the PADDED length — pad-slot K/V is
+    masked by length and overwritten as the row decodes, exactly like the
+    dense cache's pad slots."""
+    c = config
+    if isinstance(c, MoEConfig) and prompt_lens is not None:
+        raise ValueError(
+            "ragged prompts are dense-only (see decode.prefill)"
+        )
+    attn = _select_attn(c, None)
+    b, s_p = tokens.shape
+    if s_p > cache.capacity_per_row:
+        raise ValueError(
+            f"prompt length {s_p} exceeds the per-row table capacity "
+            f"{cache.capacity_per_row}"
+        )
+    cache, ok = admit(
+        cache, jnp.ones((b,), jnp.int32),
+        jnp.full((b,), s_p, jnp.int32),
+    )
+    positions = jnp.broadcast_to(jnp.arange(s_p, dtype=jnp.int32), (b, s_p))
+    x = embedding_lookup(params["embed"], tokens, c.dtype)
+    k_pool, v_pool = cache.k_pool, cache.v_pool
+    zero = jnp.zeros((b,), jnp.int32)
+    for li, layer in enumerate(params["layers"]):
+        q, k, v = _project_qkv(layer, x, positions, c)
+        # Writes gated on ok: a failed admission left the tables
+        # unchanged, and scattering through them would land in blocks
+        # owned by OTHER live rows — admit's all-or-nothing discipline
+        # must hold one level up too.
+        k_pool = k_pool.at[li].set(jnp.where(
+            ok, _paged_write(k_pool[li], cache.block_tables, k, zero),
+            k_pool[li]))
+        v_pool = v_pool.at[li].set(jnp.where(
+            ok, _paged_write(v_pool[li], cache.block_tables, v, zero),
+            v_pool[li]))
+        o = attn(q, k, v, causal=True).astype(c.dtype)
+        x = x + jnp.einsum("bshk,hkd->bsd", o, resolve(layer["wo"], c.dtype))
+        h = _rmsnorm(x, layer["ln2"])
+        x = x + _ffn_delta(h, layer, li, c)
+    x = _rmsnorm(x, params["ln_f"])
+    if prompt_lens is None:
+        x_last = x[:, -1]
+        length = jnp.full((b,), s_p, jnp.int32)
+    else:
+        x_last = jnp.take_along_axis(
+            x, (prompt_lens - 1)[:, None, None], axis=1
+        )[:, 0]
+        length = prompt_lens.astype(jnp.int32)
+    logits = jnp.einsum("bd,vd->bv", x_last,
+                        resolve(params["embed"], c.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, cache._replace(
+        k_pool=k_pool, v_pool=v_pool,
+        length=jnp.where(ok, length, cache.length),
+    ), ok
+
+
+def paged_decode_step(
+    params: Dict,
+    cache: PagedKVCache,
+    token: jax.Array,
+    config: AnyConfig,
+    attn_impl: str = "gather",
+) -> Tuple[jax.Array, PagedKVCache]:
+    """One token (B,) in -> (next-token logits (B, vocab), cache, ok) —
+    the paged mirror of decode.decode_step. ``ok`` False means the pool
+    could not supply a block some row needed: the cache is returned
+    UNCHANGED (no write, no length advance — all-or-nothing, like admit)
+    and the logits are meaningless; release rows or grow the pool, then
+    retry. ``attn_impl='pallas'`` reads the cache through the
+    block-walking kernel (ops/paged_attention.py); ``'gather'`` is the
+    reference path."""
+    c = config
+    b = token.shape[0]
+    cache, ok = _extend_for_write(cache, 1)
+    pos = cache.length
+    positions = pos[:, None]
+    x = embedding_lookup(params["embed"], token[:, None], c.dtype)
+    k_pool, v_pool = cache.k_pool, cache.v_pool
+    for li, layer in enumerate(params["layers"]):
+        q, k, v = _project_qkv(layer, x, positions, c)
+        # Writes gated on ok (pool exhausted at a block boundary): with
+        # unchanged tables, blk_slot = length//Bs points at a slot this
+        # row does NOT own, whose stale id may be another live row's
+        # block — the write would silently corrupt that row. On ok=False
+        # the step is a no-op on the cache and the caller must release
+        # rows (or grow the pool) and retry.
+        kp = jnp.where(
+            ok, _paged_write(k_pool[li], cache.block_tables, k, pos),
+            k_pool[li])
+        vp = jnp.where(
+            ok, _paged_write(v_pool[li], cache.block_tables, v, pos),
+            v_pool[li])
+        k_pool = k_pool.at[li].set(kp)
+        v_pool = v_pool.at[li].set(vp)
+        if attn_impl == "pallas":
+            from tpu_composer.ops.paged_attention import paged_decode_attention
+
+            o = paged_decode_attention(
+                q[:, 0], kp, vp, cache.block_tables, pos + 1,
+            )[:, None]
+        else:
+            o = _cached_attention(
+                q, _paged_read(kp, cache.block_tables),
+                _paged_read(vp, cache.block_tables),
+                pos + 1, c, q_positions=positions,
+            )
+        x = x + jnp.einsum("bshk,hkd->bsd", o, resolve(layer["wo"], c.dtype))
+        h = _rmsnorm(x, layer["ln2"])
+        x = x + _ffn_delta(h, layer, li, c, drop_free=True)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", x,
+                        resolve(params["embed"], c.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0], cache._replace(
+        k_pool=k_pool, v_pool=v_pool,
+        length=jnp.where(ok, pos + 1, pos),
+    ), ok
+
+
+def paged_generate(
+    params: Dict,
+    prompt: jax.Array,
+    config: AnyConfig,
+    max_new_tokens: int,
+    num_blocks: int,
+    block_size: int = 16,
+    prompt_lens: Optional[jax.Array] = None,
+    attn_impl: str = "gather",
+) -> jax.Array:
+    """Greedy generation over a fresh pool — the parity surface against
+    decode.generate (same tokens, dense vs paged). Serving loops that
+    admit/release rows across calls drive paged_prefill /
+    paged_decode_step / release directly instead."""
+    c = config
+    b, s_p = prompt.shape
+    per_row = -(-(s_p + max_new_tokens) // block_size)  # static ceil
+    worst = b * per_row
+    if worst > num_blocks:
+        raise ValueError(
+            f"pool of {num_blocks} blocks cannot cover the worst case "
+            f"{worst} (= {b} rows x ceil(({s_p}+{max_new_tokens})"
+            f"/{block_size}))"
+        )
+    cache = init_paged_cache(
+        c, b, num_blocks, block_size, blocks_per_row=per_row,
+    )
+    logits, cache, _ok = paged_prefill(
+        params, prompt, c, cache, prompt_lens=prompt_lens
+    )
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        cache, token = carry
+        # ok is statically guaranteed here: the pool was sized for the
+        # worst case above, and this generate owns every block in it.
+        logits, cache, _ok = paged_decode_step(
+            params, cache, token, c, attn_impl=attn_impl
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, nxt), token
+
+    (_, _), tokens = jax.lax.scan(
+        step, (cache, first), None, length=max_new_tokens
+    )
+    return tokens.T

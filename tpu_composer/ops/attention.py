@@ -596,19 +596,27 @@ def flash_attention(
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
+def _default_interpret() -> bool:
+    """Interpret-mode resolution shared by every Pallas kernel in the
+    package (flash here, the paged-decode kernel in paged_attention.py):
+    interpret off-TPU so CPU-mesh tests drive the same code, Mosaic on
+    TPU; ``TPUC_FLASH_INTERPRET`` (0/1) overrides — needed when
+    AOT-compiling for a TPU topology from a CPU-backend process."""
+    env = os.environ.get("TPUC_FLASH_INTERPRET")
+    if env not in (None, "", "0", "1"):
+        raise ValueError(
+            f"TPUC_FLASH_INTERPRET must be '0' or '1', got {env!r}"
+        )
+    if env in ("0", "1"):
+        return env == "1"
+    return jax.default_backend() != "tpu"
+
+
 def _flash_prep(q, k, v, block_q, block_k, interpret):
     """Shared prologue: interpret resolution, block fitting/validation, and
     the (B, S, H, D) -> (B*H, S, D) collapse both public entry points use."""
     if interpret is None:
-        env = os.environ.get("TPUC_FLASH_INTERPRET")
-        if env not in (None, "", "0", "1"):
-            raise ValueError(
-                f"TPUC_FLASH_INTERPRET must be '0' or '1', got {env!r}"
-            )
-        if env in ("0", "1"):
-            interpret = env == "1"
-        else:
-            interpret = jax.default_backend() != "tpu"
+        interpret = _default_interpret()
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
     if h % hk:

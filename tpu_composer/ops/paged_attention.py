@@ -1,0 +1,159 @@
+"""Pallas paged-attention decode kernel — walk the block table in-kernel.
+
+The gather path (models/paged.py `_paged_read`) materializes every row's
+blocks as a contiguous (B, MB*Bs, KV, Dh) tensor before attending: one
+extra HBM round-trip of the whole working cache per layer per token,
+which is exactly the bandwidth decode is bound by. This kernel reads
+each K/V block straight from the pool instead, routed by a
+scalar-prefetched block table (`pltpu.PrefetchScalarGridSpec`): the
+index map picks pool block `tables[b, j]` for grid step j, the online
+softmax accumulates across the row's blocks in VMEM scratch, and the
+gathered intermediate never exists. The same trick GPU paged-attention
+kernels do with pointer chasing, expressed the Mosaic way — index maps
+over a prefetched table.
+
+Decode shape only (one query token per row): q (B, H, Dh) against pool
+(N, Bs, KV, Dh) + tables (B, MB) + lengths (B,) -> (B, H, Dh). One K/V
+block tile carries ALL KV heads (Mosaic wants the last-two block dims
+full or 8/128-aligned, and KV is small), and the kernel unrolls the KV
+axis statically — each query-head group still reads its own KV head's
+slice once, so the GQA bandwidth saving is preserved.
+
+Numerics contract (tests/test_paged_attention.py): bit-level agreement
+with the gather path is not promised (different reduction order), but
+outputs match to dtype-appropriate tolerance and paged generate through
+this kernel produces greedy tokens identical to the dense path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_composer.ops.attention import _default_interpret
+
+
+def _kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+            m_ref, l_ref, acc_ref, *, block_size: int, n_kv: int,
+            scale: float):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    g = q_ref.shape[2]
+    # Scores for every (kv, group) query row against this block, KV axis
+    # statically unrolled: rows kvi*G..(kvi+1)*G of s are kv head kvi.
+    parts = []
+    for kvi in range(n_kv):
+        q_kv = q_ref[0, kvi].astype(jnp.float32)          # (G, Dh)
+        k_kv = k_ref[0, :, kvi].astype(jnp.float32)       # (Bs, Dh)
+        parts.append(jax.lax.dot_general(
+            q_kv, k_kv, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ))
+    s = jnp.concatenate(parts, axis=0) * scale            # (KV*G, Bs)
+    pos = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, dimension=1
+    )
+    s = jnp.where(pos < len_ref[b], s, -jnp.inf)
+
+    rows = n_kv * g
+    m_prev = m_ref[:rows, :1]
+    l_prev = l_ref[:rows, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # A fully-masked block (slot past this row's length — including table
+    # slots beyond n_blocks pointing at stale ids) contributes exp(-inf)=0;
+    # keep m_new finite so the rescale below never sees inf - inf.
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev - m_safe,
+                              -jnp.inf))
+    p = jnp.exp(s - m_safe)                               # masked -> 0
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    outs = []
+    for kvi in range(n_kv):
+        v_kv = v_ref[0, :, kvi].astype(jnp.float32)       # (Bs, Dh)
+        outs.append(jax.lax.dot_general(
+            p[kvi * g:(kvi + 1) * g], v_kv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ))
+    acc_ref[:rows] = acc_ref[:rows] * alpha + jnp.concatenate(outs, axis=0)
+    m_ref[:rows] = jnp.broadcast_to(m_new, (rows, m_ref.shape[1]))
+    l_ref[:rows] = jnp.broadcast_to(l_new, (rows, l_ref.shape[1]))
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:rows, :1], 1e-30)  # all-masked row -> 0
+        o_ref[0] = (acc_ref[:rows] / denom).astype(o_ref.dtype).reshape(
+            n_kv, g, acc_ref.shape[1]
+        )
+
+
+def paged_decode_attention(
+    q: jax.Array,          # (B, H, Dh)
+    k_pool: jax.Array,     # (N, Bs, KV, Dh)
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # (B, MB) int32
+    lengths: jax.Array,       # (B,) int32
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """One decode step of attention over the paged cache -> (B, H, Dh).
+
+    ``interpret`` defaults to True off-TPU (CPU-mesh testability) exactly
+    like ops/attention.py; ``TPUC_FLASH_INTERPRET`` overrides for AOT
+    compiles from CPU-backend processes."""
+    if interpret is None:
+        interpret = _default_interpret()
+    b, h, dh = q.shape
+    n, bs, kv, dh2 = k_pool.shape
+    if dh != dh2:
+        raise ValueError(f"head_dim mismatch: q {dh} vs pool {dh2}")
+    if h % kv:
+        raise ValueError(f"H={h} not a multiple of KV={kv}")
+    g = h // kv
+    mb = block_tables.shape[1]
+    qg = q.reshape(b, kv, g, dh)
+    rows = max(8, kv * g)  # sublane-pad the scratch accumulators
+
+    grid = (b, mb)
+    kernel = functools.partial(
+        _kernel, block_size=bs, n_kv=kv, scale=1.0 / (dh ** 0.5)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, kv, g, dh),
+                             lambda b_, j, tables, lens: (b_, 0, 0, 0)),
+                pl.BlockSpec((1, bs, kv, dh),
+                             lambda b_, j, tables, lens: (
+                                 tables[b_, j], 0, 0, 0)),
+                pl.BlockSpec((1, bs, kv, dh),
+                             lambda b_, j, tables, lens: (
+                                 tables[b_, j], 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, kv, g, dh),
+                lambda b_, j, tables, lens: (b_, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, 128), jnp.float32),  # running max
+                pltpu.VMEM((rows, 128), jnp.float32),  # running denom
+                pltpu.VMEM((rows, dh), jnp.float32),   # output accumulator
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv, g, dh), q.dtype),
+        interpret=interpret,
+    )(block_tables, lengths, qg, k_pool, v_pool)
+    return out.reshape(b, h, dh)
