@@ -145,6 +145,22 @@ class TestPoolAccounting:
                         jax.tree_util.tree_leaves(after)):
             np.testing.assert_array_equal(a, b)
 
+    def test_admit_beyond_row_table_is_all_or_nothing(self):
+        # Pool has plenty of blocks but the row's table holds only 2:
+        # admission must fail cleanly, not set n_blocks > MB while the
+        # table silently caps (later writes would clip onto the row's
+        # last block).
+        cache = init_paged_cache(_cfg(), 2, num_blocks=16, block_size=4,
+                                 blocks_per_row=2)
+        before = jax.tree_util.tree_map(np.asarray, cache)
+        cache2, ok = admit(cache, jnp.array([1, 0]),
+                           jnp.array([12, 0], jnp.int32))  # wants 3 > MB 2
+        assert not bool(ok)
+        for a, b in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(
+                            jax.tree_util.tree_map(np.asarray, cache2))):
+            np.testing.assert_array_equal(a, b)
+
     def test_release_returns_blocks_for_reuse(self):
         cache = self._empty(batch=2, num_blocks=4, bs=4)
         cache, ok = admit(cache, jnp.array([1, 1]),

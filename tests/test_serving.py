@@ -1,0 +1,197 @@
+"""Continuous-batching engine — the gold contract is solo-run equality.
+
+Whatever the batch composition, admission order, slot reuse, or pool
+pressure, every request's tokens must EQUAL what a solo decode.generate
+call on its prompt produces. These tests stage churn deliberately:
+staggered arrivals, lengths that finish mid-flight, more requests than
+slots, and a pool sized to force head-of-line waiting.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_composer.models import ModelConfig
+from tpu_composer.models.decode import generate
+from tpu_composer.models.moe import MoEConfig
+from tpu_composer.models.serving import ContinuousBatchingEngine
+from tpu_composer.models.transformer import init_params
+
+
+def _cfg():
+    return ModelConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                       n_kv_heads=2, d_ff=64, max_seq=128,
+                       dtype=jnp.float32)
+
+
+def _solo(p, c, prompt, n):
+    out = generate(p, jnp.asarray([prompt], jnp.int32), c,
+                   max_new_tokens=n)
+    return np.asarray(out)[0].tolist()
+
+
+@pytest.fixture(scope="module")
+def world():
+    c = _cfg()
+    p = init_params(c, jax.random.key(0))
+    return c, p
+
+
+class TestSoloEquality:
+    def test_interleaved_requests_match_solo_runs(self, world):
+        c, p = world
+        key = jax.random.key(1)
+        prompts = []
+        for i in range(6):
+            key, k = jax.random.split(key)
+            ln = int(jax.random.randint(k, (), 3, 12))
+            key, k = jax.random.split(key)
+            prompts.append(
+                np.asarray(jax.random.randint(
+                    k, (ln,), 0, c.vocab_size)).tolist()
+            )
+        lens = [5, 9, 3, 12, 7, 4]  # finish at different times
+        eng = ContinuousBatchingEngine(p, c, slots=3, num_blocks=32,
+                                       block_size=8)
+        reqs = [eng.submit(pr, n) for pr, n in zip(prompts, lens)]
+        eng.run()
+        for req, pr, n in zip(reqs, prompts, lens):
+            assert req.done
+            assert req.tokens == _solo(p, c, pr, n), (
+                f"request {req.req_id} diverged from its solo run"
+            )
+
+    def test_single_slot_serializes_but_stays_exact(self, world):
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=1, num_blocks=8,
+                                       block_size=8)
+        prompts = [[1, 2, 3], [7, 8], [5, 5, 5, 5]]
+        reqs = [eng.submit(pr, 6) for pr in prompts]
+        eng.run()
+        for req, pr in zip(reqs, prompts):
+            assert req.tokens == _solo(p, c, pr, 6)
+
+    def test_pool_pressure_delays_but_never_corrupts(self, world):
+        c, p = world
+        # Pool fits ~one worst-case request at a time even though two
+        # slots exist: the second must wait for blocks, then still match.
+        eng = ContinuousBatchingEngine(p, c, slots=2, num_blocks=4,
+                                       block_size=8)
+        reqs = [eng.submit([3, 1, 4, 1, 5], 8) for _ in range(3)]
+        eng.run()
+        gold = _solo(p, c, [3, 1, 4, 1, 5], 8)
+        for req in reqs:
+            assert req.tokens == gold
+
+    def test_eos_releases_early(self, world):
+        c, p = world
+        gold = _solo(p, c, [2, 7, 1], 10)
+        # Truncation AT the first eos occurrence, whatever the model
+        # repeats: eos = the first token cuts after exactly one.
+        first_at = gold.index(gold[0])
+        eng = ContinuousBatchingEngine(p, c, slots=2, num_blocks=16,
+                                       block_size=8, eos_id=gold[0])
+        req = eng.submit([2, 7, 1], 10)
+        eng.run()
+        assert req.tokens == gold[:first_at + 1]
+        assert int(eng.cache.free_top) == 16  # early release returned blocks
+        # And an eos the model never emits changes nothing.
+        absent = next(t for t in range(c.vocab_size) if t not in gold)
+        eng2 = ContinuousBatchingEngine(p, c, slots=2, num_blocks=16,
+                                        block_size=8, eos_id=absent)
+        req2 = eng2.submit([2, 7, 1], 10)
+        eng2.run()
+        assert req2.tokens == gold
+
+    def test_pallas_kernel_path_matches(self, world):
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=2, num_blocks=16,
+                                       block_size=8, attn_impl="pallas")
+        reqs = [eng.submit([9, 8, 7], 5), eng.submit([1, 2], 7)]
+        eng.run()
+        assert reqs[0].tokens == _solo(p, c, [9, 8, 7], 5)
+        assert reqs[1].tokens == _solo(p, c, [1, 2], 7)
+
+
+class TestEngineHygiene:
+    def test_pool_drains_back_to_full(self, world):
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=3, num_blocks=24,
+                                       block_size=8)
+        for i in range(7):
+            eng.submit([i + 1, i + 2], 4)
+        eng.run()
+        assert int(eng.cache.free_top) == 24
+        assert sorted(np.asarray(eng.cache.free).tolist()) == list(range(24))
+
+    def test_rejects_impossible_request(self, world):
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=1, num_blocks=2,
+                                       block_size=8)
+        with pytest.raises(ValueError, match="worst-case"):
+            eng.submit(list(range(30)), 20)
+
+    def test_rejects_moe(self, world):
+        c, p = world
+        mc = MoEConfig(vocab_size=64, d_model=32, n_layers=1, n_heads=4,
+                       n_kv_heads=2, d_ff=64, max_seq=64,
+                       dtype=jnp.float32, n_experts=2, top_k=1)
+        with pytest.raises(ValueError, match="dense configs only"):
+            ContinuousBatchingEngine(p, mc, slots=1, num_blocks=4)
+
+    def test_submit_validates_with_scheduler_math(self, world):
+        """A request submit() accepts must be schedulable: validation
+        uses the bucketed prompt length the scheduler reserves with —
+        raw-length validation would accept a request _try_admit can
+        never place, livelocking the FIFO head-of-line."""
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=1, num_blocks=3,
+                                       block_size=8)
+        # 17 tokens bucket to 32; ceil((32+7)/8)=5 > 3 blocks -> reject
+        # at submit, not livelock at run.
+        with pytest.raises(ValueError, match="worst-case"):
+            eng.submit(list(range(1, 18)), 7)
+
+    def test_step_events_include_the_prefill_token(self, world):
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=1, num_blocks=8,
+                                       block_size=8)
+        req = eng.submit([4, 2], 1)  # one token: comes from the prefill
+        events = eng.step()
+        assert events == [(req.req_id, req.tokens[0])]
+        assert req.done
+        # Streaming a longer request: concatenating every step's events
+        # reproduces the full output, first token included.
+        req2 = eng.submit([4, 2], 5)
+        seen = []
+        while not req2.done:
+            seen.extend(t for rid, t in eng.step() if rid == req2.req_id)
+        assert seen == req2.tokens == _solo(p, c, [4, 2], 5)
+
+    def test_blocks_per_row_bounds_the_table(self, world):
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=2, num_blocks=64,
+                                       block_size=8, blocks_per_row=4)
+        assert eng.cache.block_tables.shape == (2, 4)
+        reqs = [eng.submit([1, 2, 3], 6), eng.submit([9], 4)]
+        eng.run()
+        assert reqs[0].tokens == _solo(p, c, [1, 2, 3], 6)
+        assert reqs[1].tokens == _solo(p, c, [9], 4)
+        # A request beyond the per-row table is rejected up front even
+        # though the pool has plenty of blocks.
+        with pytest.raises(ValueError, match="positions per row"):
+            eng.submit(list(range(1, 30)), 10)
+
+    def test_compiles_are_bucketed(self, world):
+        # Same bucket -> same jitted prefill; the engine must not compile
+        # per prompt length.
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=2, num_blocks=32,
+                                       block_size=8)
+        for ln in (3, 5, 7, 8):  # all bucket to 8
+            eng.submit(list(range(1, ln + 1)), 2)
+        eng.run()
+        assert list(eng._prefills.keys()) == [8]

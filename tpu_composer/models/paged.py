@@ -121,7 +121,12 @@ def admit(
     want = slot < want_rows[:, None]  # (B, MB) bool
     flat = want.reshape(-1)
     total = flat.sum()
-    ok = total <= cache.free_top
+    # Per-row table capacity is part of all-or-nothing: without it a
+    # too-long request would be "admitted" with n_blocks > MB while the
+    # table silently capped at MB slots, and later writes past capacity
+    # would clip onto the row's last block (the _extend_for_write guard,
+    # mirrored).
+    ok = (total <= cache.free_top) & jnp.all(want_rows <= mb)
     rank = jnp.cumsum(flat) - 1
     pop_idx = cache.free_top - 1 - rank
     popped = cache.free[jnp.clip(pop_idx, 0, cache.free.shape[0] - 1)]
@@ -164,13 +169,16 @@ def release(cache: PagedKVCache, row_mask: jax.Array) -> PagedKVCache:
 
 
 def _extend_for_write(
-    cache: PagedKVCache, t: int
+    cache: PagedKVCache, t: int, active: Optional[jax.Array] = None
 ) -> Tuple[PagedKVCache, jax.Array]:
     """Claim blocks so every active row can append ``t`` tokens at its
     current length. Returns (cache, ok). Rows past their table capacity
     make ``ok`` False (caller guards statically; tests pin it)."""
     b, mb = cache.block_tables.shape
-    active = cache.n_blocks > 0
+    if active is None:
+        active = cache.n_blocks > 0
+    else:
+        active = active.astype(bool) & (cache.n_blocks > 0)
     need_total = _blocks_needed(cache.length + t, cache.block_size)
     need_total = jnp.where(active, need_total, 0)
     slot = jnp.arange(mb, dtype=jnp.int32)[None, :]
@@ -192,18 +200,24 @@ def _extend_for_write(
     ), ok
 
 
-def _paged_write(pool_layer, tables, new, pos):
+def _paged_write(pool_layer, tables, new, pos, active=None):
     """Scatter ``new`` (B, T, KV, Dh) into the pool at each row's
     positions ``pos..pos+T``. Blocks are row-owned so the (block, offset)
-    pairs are distinct — scatter order is irrelevant."""
+    pairs are distinct — scatter order is irrelevant. Rows where
+    ``active`` is False write NOTHING (their updates scatter to an
+    out-of-range sentinel with mode='drop'): an idle slot's table holds
+    stale ids that may belong to live rows, so masking, not clamping, is
+    the only safe treatment."""
     b, t = new.shape[0], new.shape[1]
-    bs = pool_layer.shape[1]
+    n, bs = pool_layer.shape[0], pool_layer.shape[1]
     abs_pos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
-    blk_slot = abs_pos // bs  # (B, T) slot in the row's table
+    blk_slot = jnp.clip(abs_pos // bs, 0, tables.shape[1] - 1)
     blk = jnp.take_along_axis(tables, blk_slot, axis=1)  # (B, T) pool ids
+    if active is not None:
+        blk = jnp.where(active[:, None].astype(bool), blk, n)
     off = abs_pos % bs
     return pool_layer.at[blk.reshape(-1), off.reshape(-1)].set(
-        new.reshape((-1,) + new.shape[2:])
+        new.reshape((-1,) + new.shape[2:]), mode="drop"
     )
 
 
@@ -225,44 +239,63 @@ def paged_prefill(
     cache: PagedKVCache,
     prompt_lens: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, PagedKVCache, jax.Array]:
-    """Admit every row and run the prompt, writing K/V into blocks.
-    Returns (last-real-position logits (B, vocab), cache, ok). Mirrors
-    decode.prefill's math exactly (same helpers); only the cache writes
-    differ. Ragged rows allocate by the PADDED length — pad-slot K/V is
-    masked by length and overwritten as the row decodes, exactly like the
-    dense cache's pad slots."""
+    """Admit EVERY row and run the prompt, writing K/V into blocks —
+    the whole-batch case of paged_prefill_rows (one transformer loop
+    lives there; this wrapper just names all slots). Returns
+    (last-real-position logits (B, vocab), cache, ok)."""
+    b = tokens.shape[0]
+    return paged_prefill_rows(
+        params, tokens, config, cache,
+        slot_ids=jnp.arange(b, dtype=jnp.int32),
+        prompt_lens=prompt_lens,
+    )
+
+
+def paged_prefill_rows(
+    params: Dict,
+    tokens: jax.Array,      # (R, S) — the sub-batch being admitted
+    config: AnyConfig,
+    cache: PagedKVCache,
+    slot_ids: jax.Array,    # (R,) int32 — distinct, currently-released slots
+    prompt_lens: Optional[jax.Array] = None,  # (R,)
+) -> Tuple[jax.Array, PagedKVCache, jax.Array]:
+    """Admit ``R`` new requests into the named batch slots of a LIVE
+    cache and prefill them, leaving every other slot untouched — the
+    admission primitive of a continuous-batching engine (models/
+    serving.py). Returns (last-position logits (R, vocab), cache, ok);
+    ``ok`` False = pool couldn't cover the admission, cache unchanged.
+
+    ``slot_ids`` must be distinct and previously released (the engine
+    owns slot bookkeeping); ragged rows allocate by the padded length,
+    like paged_prefill."""
     c = config
     if isinstance(c, MoEConfig) and prompt_lens is not None:
         raise ValueError(
             "ragged prompts are dense-only (see decode.prefill)"
         )
     attn = _select_attn(c, None)
-    b, s_p = tokens.shape
+    r, s_p = tokens.shape
+    b = cache.block_tables.shape[0]
     if s_p > cache.capacity_per_row:
         raise ValueError(
             f"prompt length {s_p} exceeds the per-row table capacity "
             f"{cache.capacity_per_row}"
         )
-    cache, ok = admit(
-        cache, jnp.ones((b,), jnp.int32),
-        jnp.full((b,), s_p, jnp.int32),
-    )
-    positions = jnp.broadcast_to(jnp.arange(s_p, dtype=jnp.int32), (b, s_p))
+    mask = jnp.zeros((b,), jnp.int32).at[slot_ids].set(1)
+    want = jnp.zeros((b,), jnp.int32).at[slot_ids].set(s_p)
+    cache, ok = admit(cache, mask, want)
+    tables_r = cache.block_tables[slot_ids]  # (R, MB)
+
+    positions = jnp.broadcast_to(jnp.arange(s_p, dtype=jnp.int32), (r, s_p))
     x = embedding_lookup(params["embed"], tokens, c.dtype)
     k_pool, v_pool = cache.k_pool, cache.v_pool
-    zero = jnp.zeros((b,), jnp.int32)
+    zero = jnp.zeros((r,), jnp.int32)
     for li, layer in enumerate(params["layers"]):
         q, k, v = _project_qkv(layer, x, positions, c)
-        # Writes gated on ok: a failed admission left the tables
-        # unchanged, and scattering through them would land in blocks
-        # owned by OTHER live rows — admit's all-or-nothing discipline
-        # must hold one level up too.
         k_pool = k_pool.at[li].set(jnp.where(
-            ok, _paged_write(k_pool[li], cache.block_tables, k, zero),
-            k_pool[li]))
+            ok, _paged_write(k_pool[li], tables_r, k, zero), k_pool[li]))
         v_pool = v_pool.at[li].set(jnp.where(
-            ok, _paged_write(v_pool[li], cache.block_tables, v, zero),
-            v_pool[li]))
+            ok, _paged_write(v_pool[li], tables_r, v, zero), v_pool[li]))
         o = attn(q, k, v, causal=True).astype(c.dtype)
         x = x + jnp.einsum("bshk,hkd->bsd", o, resolve(layer["wo"], c.dtype))
         h = _rmsnorm(x, layer["ln2"])
@@ -270,18 +303,20 @@ def paged_prefill(
     x = _rmsnorm(x, params["ln_f"])
     if prompt_lens is None:
         x_last = x[:, -1]
-        length = jnp.full((b,), s_p, jnp.int32)
+        lens_r = jnp.full((r,), s_p, jnp.int32)
     else:
         x_last = jnp.take_along_axis(
             x, (prompt_lens - 1)[:, None, None], axis=1
         )[:, 0]
-        length = prompt_lens.astype(jnp.int32)
+        lens_r = prompt_lens.astype(jnp.int32)
     logits = jnp.einsum("bd,vd->bv", x_last,
                         resolve(params["embed"], c.dtype),
                         preferred_element_type=jnp.float32)
+    length = cache.length.at[slot_ids].set(
+        jnp.where(ok, lens_r, cache.length[slot_ids])
+    )
     return logits, cache._replace(
-        k_pool=k_pool, v_pool=v_pool,
-        length=jnp.where(ok, length, cache.length),
+        k_pool=k_pool, v_pool=v_pool, length=length
     ), ok
 
 
@@ -291,18 +326,25 @@ def paged_decode_step(
     token: jax.Array,
     config: AnyConfig,
     attn_impl: str = "gather",
-) -> Tuple[jax.Array, PagedKVCache]:
+    active: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, PagedKVCache, jax.Array]:
     """One token (B,) in -> (next-token logits (B, vocab), cache, ok) —
     the paged mirror of decode.decode_step. ``ok`` False means the pool
     could not supply a block some row needed: the cache is returned
     UNCHANGED (no write, no length advance — all-or-nothing, like admit)
     and the logits are meaningless; release rows or grow the pool, then
-    retry. ``attn_impl='pallas'`` reads the cache through the
+    retry. ``active`` (B,) masks rows: idle batch slots (a continuous-
+    batching engine between requests) compute garbage logits but write
+    nothing and never advance — their stale tables may name other rows'
+    blocks. ``attn_impl='pallas'`` reads the cache through the
     block-walking kernel (ops/paged_attention.py); ``'gather'`` is the
     reference path."""
     c = config
     b = token.shape[0]
-    cache, ok = _extend_for_write(cache, 1)
+    if active is None:
+        active = jnp.ones((b,), bool)
+    active = active.astype(bool) & (cache.n_blocks > 0)
+    cache, ok = _extend_for_write(cache, 1, active)
     pos = cache.length
     positions = pos[:, None]
     x = embedding_lookup(params["embed"], token[:, None], c.dtype)
@@ -316,10 +358,12 @@ def paged_decode_step(
         # the step is a no-op on the cache and the caller must release
         # rows (or grow the pool) and retry.
         kp = jnp.where(
-            ok, _paged_write(k_pool[li], cache.block_tables, k, pos),
+            ok,
+            _paged_write(k_pool[li], cache.block_tables, k, pos, active),
             k_pool[li])
         vp = jnp.where(
-            ok, _paged_write(v_pool[li], cache.block_tables, v, pos),
+            ok,
+            _paged_write(v_pool[li], cache.block_tables, v, pos, active),
             v_pool[li])
         k_pool = k_pool.at[li].set(kp)
         v_pool = v_pool.at[li].set(vp)
@@ -344,7 +388,7 @@ def paged_decode_step(
                         preferred_element_type=jnp.float32)
     return logits[:, 0], cache._replace(
         k_pool=k_pool, v_pool=v_pool,
-        length=jnp.where(ok, pos + 1, pos),
+        length=jnp.where(ok & active, pos + 1, pos),
     ), ok
 
 

@@ -1,0 +1,250 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+Static-shape serving the TPU way: ONE jitted decode step over a fixed
+number of batch slots runs forever; requests stream in and out of slots
+between steps. A finished row releases its blocks to the shared pool and
+its slot admits the next waiting request via a single-row prefill
+(`paged_prefill_rows`) — no recompilation, no padding every row to the
+longest request in flight, no waiting for stragglers to drain a batch
+(the reference operates hardware, not models; this is first-class per
+the build spec, SURVEY §7).
+
+Correctness contract (tests/test_serving.py): every request's output is
+EXACTLY what a solo `decode.generate` call on its prompt would produce —
+batch composition, admission order, and slot reuse can never leak
+between requests.
+
+Two deliberate v1 simplifications, both documented where they bite:
+- Greedy decoding only (sampling composes exactly as in
+  decode.generate — a temperature/top-k/top-p `pick` on the same
+  logits — but per-request RNG streams across churn are bookkeeping, not
+  architecture, so v1 pins the architecture).
+- Host round-trip per step for the generated tokens (B ints): the
+  engine is the orchestration layer and runs CPU-mesh tests; an on-chip
+  deployment would keep the token feed device-resident.
+
+Prompt lengths are padded to power-of-two buckets so the per-admission
+prefill compiles once per bucket, not once per prompt length.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_composer.models.decode import AnyConfig
+from tpu_composer.models.paged import (
+    init_paged_cache,
+    paged_decode_step,
+    paged_prefill_rows,
+    release,
+)
+
+
+@dataclass
+class Request:
+    """One generation request. ``tokens`` fills as the engine runs;
+    ``done`` flips when max_new_tokens are out or eos_id was emitted."""
+
+    prompt: List[int]
+    max_new_tokens: int
+    req_id: int = -1
+    tokens: List[int] = field(default_factory=list)
+    done: bool = False
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class ContinuousBatchingEngine:
+    """Fixed ``slots``-row engine over one shared block pool.
+
+    Admission reserves each request's WORST-CASE blocks
+    (ceil((padded_prompt + max_new)/block_size)) host-side before it is
+    scheduled, so the jit-side pool can never exhaust mid-flight — the
+    paged layer's all-or-nothing ok-flags stay as defense-in-depth, not
+    the control path."""
+
+    def __init__(
+        self,
+        params: Dict,
+        config: AnyConfig,
+        slots: int,
+        num_blocks: int,
+        block_size: int = 16,
+        attn_impl: str = "gather",
+        eos_id: Optional[int] = None,
+        blocks_per_row: Optional[int] = None,
+    ):
+        """``blocks_per_row`` bounds one request's table — and therefore
+        how many table slots every attention read walks. Leave it None
+        only for small pools: the default (whole pool) makes per-token
+        attention cost scale with POOL size, not sequence length; a
+        deployment sizes it at the longest request it will admit
+        (ceil(max_request_tokens / block_size))."""
+        from tpu_composer.models.moe import MoEConfig
+
+        if isinstance(config, MoEConfig):
+            # The admission prefill pads prompts to buckets and relies on
+            # prompt_lens masking; MoE routing shares one capacity group
+            # across the padded row (see decode.prefill), so pads would
+            # affect real tokens. Same restriction, same reason.
+            raise ValueError("the v1 engine serves dense configs only")
+        self.params = params
+        self.config = config
+        self.slots = slots
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.attn_impl = attn_impl
+        self.eos_id = eos_id
+        self.cache = init_paged_cache(
+            config, slots, num_blocks, block_size,
+            blocks_per_row=blocks_per_row,
+        )
+        self._slot_req: List[Optional[Request]] = [None] * slots
+        self._next_token = np.zeros(slots, np.int32)
+        self._reserved = np.zeros(slots, np.int64)  # blocks held per slot
+        self._waiting: Deque[Request] = deque()
+        self._next_id = 0
+        self._decode = jax.jit(
+            partial(paged_decode_step, config=config, attn_impl=attn_impl),
+            static_argnames=(),
+        )
+        self._prefills: Dict[int, Any] = {}
+
+    # -- submission ----------------------------------------------------
+    def submit(self, prompt: List[int], max_new_tokens: int) -> Request:
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # Validate with the SAME math the scheduler reserves with (the
+        # bucketed prompt length) — validating with the raw length would
+        # accept requests the scheduler can never place, and head-of-line
+        # FIFO would then livelock the whole queue.
+        pad = _bucket(len(prompt))
+        worst = _worst_blocks(pad, max_new_tokens, self.block_size)
+        cap = self.cache.capacity_per_row
+        if worst > self.num_blocks or pad + max_new_tokens > cap:
+            raise ValueError(
+                f"request needs {worst} blocks / {pad + max_new_tokens} "
+                f"positions worst-case; the pool has {self.num_blocks} "
+                f"blocks and {cap} positions per row"
+            )
+        req = Request(prompt=list(prompt), max_new_tokens=max_new_tokens,
+                      req_id=self._next_id)
+        self._next_id += 1
+        self._waiting.append(req)
+        return req
+
+    # -- scheduling ----------------------------------------------------
+    def _free_slot(self) -> Optional[int]:
+        for i, r in enumerate(self._slot_req):
+            if r is None:
+                return i
+        return None
+
+    def _try_admit(self) -> List[Tuple[int, int]]:
+        """Admit the head-of-line request if a slot and worst-case blocks
+        are available; returns the (req_id, token) events the admission
+        produced (the prefill emits the request's FIRST token). One
+        admission per call: one prefill compile shape per engine step
+        keeps step latency bounded."""
+        if not self._waiting:
+            return []
+        slot = self._free_slot()
+        if slot is None:
+            return []
+        req = self._waiting[0]
+        pad = _bucket(len(req.prompt))
+        worst = _worst_blocks(pad, req.max_new_tokens, self.block_size)
+        if int(self._reserved.sum()) + worst > self.num_blocks:
+            return []  # head-of-line blocks; FIFO fairness, no starvation
+        self._waiting.popleft()
+        prefill = self._prefills.get(pad)
+        if prefill is None:
+            prefill = jax.jit(
+                partial(paged_prefill_rows, config=self.config)
+            )
+            self._prefills[pad] = prefill
+        tokens = np.zeros((1, pad), np.int32)
+        tokens[0, :len(req.prompt)] = req.prompt
+        logits, cache, ok = prefill(
+            self.params, jnp.asarray(tokens), cache=self.cache,
+            slot_ids=jnp.array([slot], jnp.int32),
+            prompt_lens=jnp.array([len(req.prompt)], jnp.int32),
+        )
+        if not bool(ok):  # host reservation should make this unreachable
+            self._waiting.appendleft(req)
+            return []
+        self.cache = cache
+        self._slot_req[slot] = req
+        self._reserved[slot] = worst
+        first = int(jnp.argmax(logits[0]))
+        self._emit(slot, first)
+        return [(req.req_id, first)]
+
+    def _emit(self, slot: int, token: int) -> None:
+        req = self._slot_req[slot]
+        req.tokens.append(token)
+        self._next_token[slot] = token
+        if (len(req.tokens) >= req.max_new_tokens
+                or (self.eos_id is not None and token == self.eos_id)):
+            req.done = True
+            self.cache = release(
+                self.cache,
+                jnp.zeros((self.slots,), jnp.int32).at[slot].set(1),
+            )
+            self._slot_req[slot] = None
+            self._reserved[slot] = 0
+
+    # -- the loop ------------------------------------------------------
+    def step(self) -> List[Tuple[int, int]]:
+        """One engine iteration: admit (at most one), then one decode
+        step across every active slot. Returns ALL (req_id, token)
+        events produced this step — including a just-admitted request's
+        first token, which comes from its prefill, not the decode."""
+        events = self._try_admit()
+        active = np.array(
+            [r is not None for r in self._slot_req], bool
+        )
+        if not active.any():
+            return events
+        logits, cache, ok = self._decode(
+            self.params, self.cache,
+            jnp.asarray(self._next_token),
+            active=jnp.asarray(active),
+        )
+        assert bool(ok), "pool exhausted despite host-side reservation"
+        self.cache = cache
+        picks = np.asarray(jnp.argmax(logits, axis=-1)).astype(np.int32)
+        for slot in np.nonzero(active)[0]:
+            req = self._slot_req[slot]
+            self._emit(slot, int(picks[slot]))
+            events.append((req.req_id, int(picks[slot])))
+        return events
+
+    def run(self, max_steps: int = 100000) -> None:
+        """Drive until every submitted request is done."""
+        for _ in range(max_steps):
+            if not self._waiting and not any(
+                r is not None for r in self._slot_req
+            ):
+                return
+            self.step()
+        raise RuntimeError(f"not drained after {max_steps} steps")
+
+
+def _worst_blocks(prompt_len: int, max_new: int, block_size: int) -> int:
+    # Pure host math — this runs on every submit and every engine step.
+    return -(-(prompt_len + max_new) // block_size)
