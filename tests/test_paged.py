@@ -107,6 +107,31 @@ class TestParity:
                                        np.asarray(p_logits), rtol=1e-5)
             tok = jnp.argmax(p_logits, axis=-1).astype(jnp.int32)
 
+    def test_int8_pool_matches_dense_int8_cache(self):
+        # The quantized pool must reproduce the DENSE int8 cache's
+        # decode exactly: same quant scheme at the same positions, just
+        # block-pooled.
+        c = _cfg()
+        p = _params(c)
+        prompt = jax.random.randint(jax.random.key(11), (3, 7), 0,
+                                    c.vocab_size)
+        dense = generate(p, prompt, c, max_new_tokens=10, kv_quant=True)
+        paged = paged_generate(p, prompt, c, max_new_tokens=10,
+                               num_blocks=24, block_size=4,
+                               kv_quant=True)
+        np.testing.assert_array_equal(np.asarray(dense), np.asarray(paged))
+
+    def test_int8_pool_halves_the_bytes(self):
+        c = _cfg()
+        bf = init_paged_cache(c, 2, num_blocks=8, block_size=8)
+        q8 = init_paged_cache(c, 2, num_blocks=8, block_size=8, quant=True)
+        assert q8.k_pool.dtype == jnp.int8 and q8.quantized
+        val_ratio = (bf.k_pool.size * bf.k_pool.dtype.itemsize) / (
+            q8.k_pool.size * q8.k_pool.dtype.itemsize)
+        assert val_ratio == 4.0  # fp32 test dtype -> int8
+        # Scales add 1/(2*Dh) relative overhead, nothing more.
+        assert q8.k_scale.shape == q8.k_pool.shape[:-1]
+
     def test_whole_generate_is_jittable(self):
         c = _cfg()
         p = _params(c)

@@ -185,13 +185,41 @@ class TestEngineHygiene:
         with pytest.raises(ValueError, match="positions per row"):
             eng.submit(list(range(1, 30)), 10)
 
+    def test_int8_engine_matches_dense_int8(self, world):
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=2, num_blocks=16,
+                                       block_size=8, kv_quant=True)
+        reqs = [eng.submit([3, 1, 4], 6), eng.submit([2, 7], 5)]
+        eng.run()
+        gold0 = np.asarray(generate(
+            p, jnp.asarray([[3, 1, 4]], jnp.int32), c, max_new_tokens=6,
+            kv_quant=True))[0].tolist()
+        gold1 = np.asarray(generate(
+            p, jnp.asarray([[2, 7]], jnp.int32), c, max_new_tokens=5,
+            kv_quant=True))[0].tolist()
+        assert reqs[0].tokens == gold0
+        assert reqs[1].tokens == gold1
+        with pytest.raises(ValueError, match="gather path"):
+            ContinuousBatchingEngine(p, c, slots=1, num_blocks=4,
+                                     kv_quant=True, attn_impl="pallas")
+
     def test_compiles_are_bucketed(self, world):
-        # Same bucket -> same jitted prefill; the engine must not compile
-        # per prompt length.
+        # Same bucket -> same prefill shape -> one compile in jit's
+        # shape-keyed cache; the engine must not compile per prompt length.
         c, p = world
         eng = ContinuousBatchingEngine(p, c, slots=2, num_blocks=32,
                                        block_size=8)
         for ln in (3, 5, 7, 8):  # all bucket to 8
             eng.submit(list(range(1, ln + 1)), 2)
         eng.run()
-        assert list(eng._prefills.keys()) == [8]
+        assert eng._prefill._cache_size() == 1
+
+    def test_rejects_beyond_max_seq(self, world):
+        # The gold reference (solo decode.generate) raises past
+        # config.max_seq; a request with no defined gold output must be
+        # rejected at submit, not served.
+        c, p = world  # max_seq=128
+        eng = ContinuousBatchingEngine(p, c, slots=1, num_blocks=64,
+                                       block_size=8)
+        with pytest.raises(ValueError, match="max_seq"):
+            eng.submit(list(range(1, 121)), 20)  # pad 128 + 20 > 128

@@ -38,6 +38,7 @@ from tpu_composer.models.decode import (
     _cached_attention,
     _ffn_delta,
     _project_qkv,
+    quantize_kv,
 )
 from tpu_composer.models.moe import MoEConfig
 from tpu_composer.models.quant import embedding_lookup, resolve
@@ -56,6 +57,11 @@ class PagedKVCache(NamedTuple):
     - ``free``: (N,) int32 — stack of free block ids; ``free[:free_top]``
       are free, popped from the top.
     - ``free_top``: () int32.
+    - ``k_scale``/``v_scale``: (L, N, Bs, KV) fp32 — present when the
+      pool stores int8 (``quant=True``): per-(position, head) scales,
+      exactly the dense KVCache's scheme, block-pooled. Composes the two
+      serving memory wins: paging (HBM ~ actual tokens) × int8 (half the
+      bytes per token).
     """
 
     k_pool: jax.Array
@@ -65,6 +71,8 @@ class PagedKVCache(NamedTuple):
     n_blocks: jax.Array
     free: jax.Array
     free_top: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
     @property
     def block_size(self) -> int:
@@ -74,6 +82,10 @@ class PagedKVCache(NamedTuple):
     def capacity_per_row(self) -> int:
         return self.block_tables.shape[1] * self.block_size
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
 
 def init_paged_cache(
     config: AnyConfig,
@@ -81,20 +93,34 @@ def init_paged_cache(
     num_blocks: int,
     block_size: int = 16,
     blocks_per_row: Optional[int] = None,
+    quant: bool = False,
 ) -> PagedKVCache:
     """Empty pool. ``blocks_per_row`` bounds one row's table (default: the
-    whole pool — any single row may grow to every block)."""
+    whole pool — any single row may grow to every block). ``quant=True``
+    stores the pool int8 with per-(position, head) scales (see
+    PagedKVCache)."""
     c = config
     mb = blocks_per_row or num_blocks
     shape = (c.n_layers, num_blocks, block_size, c.kv_heads, c.head_dim)
-    return PagedKVCache(
-        k_pool=jnp.zeros(shape, c.dtype),
-        v_pool=jnp.zeros(shape, c.dtype),
+    common = dict(
         block_tables=jnp.zeros((batch, mb), jnp.int32),
         length=jnp.zeros((batch,), jnp.int32),
         n_blocks=jnp.zeros((batch,), jnp.int32),
         free=jnp.arange(num_blocks, dtype=jnp.int32),
         free_top=jnp.asarray(num_blocks, jnp.int32),
+    )
+    if not quant:
+        return PagedKVCache(
+            k_pool=jnp.zeros(shape, c.dtype),
+            v_pool=jnp.zeros(shape, c.dtype),
+            **common,
+        )
+    return PagedKVCache(
+        k_pool=jnp.zeros(shape, jnp.int8),
+        v_pool=jnp.zeros(shape, jnp.int8),
+        k_scale=jnp.zeros(shape[:-1], jnp.float32),
+        v_scale=jnp.zeros(shape[:-1], jnp.float32),
+        **common,
     )
 
 
@@ -131,13 +157,12 @@ def admit(
     pop_idx = cache.free_top - 1 - rank
     popped = cache.free[jnp.clip(pop_idx, 0, cache.free.shape[0] - 1)]
     tables_flat = jnp.where(flat, popped, cache.block_tables.reshape(-1))
-    new = PagedKVCache(
-        k_pool=cache.k_pool,
-        v_pool=cache.v_pool,
+    new = cache._replace(  # _replace, NOT a fresh NamedTuple: a fresh one
+        # would silently drop the optional scale pools to their None
+        # defaults and corrupt the quantized cache's pytree structure.
         block_tables=tables_flat.reshape(b, mb),
         length=jnp.where(row_mask, 0, cache.length),
         n_blocks=jnp.where(row_mask, want_rows, cache.n_blocks),
-        free=cache.free,
         free_top=cache.free_top - total,
     )
     # All-or-nothing: on overflow nothing changes (jnp.where over the
@@ -222,14 +247,46 @@ def _paged_write(pool_layer, tables, new, pos, active=None):
 
 
 def _paged_read(pool_layer, tables):
-    """Gather a row-contiguous view (B, MB*Bs, KV, Dh) — the reference
-    attention path. Slot j of the table lands at positions [j*Bs,(j+1)*Bs)
-    by construction, so downstream masking-by-length is identical to the
-    dense cache. The Pallas kernel (ops/paged_attention.py) computes the
-    same function without materializing this gather."""
+    """Gather a row-contiguous view (B, MB*Bs, ...) — the reference
+    attention path, for value pools (..., KV, Dh) and scale pools
+    (..., KV) alike. Slot j of the table lands at positions
+    [j*Bs,(j+1)*Bs) by construction, so downstream masking-by-length is
+    identical to the dense cache. The Pallas kernel
+    (ops/paged_attention.py) computes the same function without
+    materializing this gather."""
     b, mb = tables.shape
-    g = pool_layer[tables.reshape(-1)]  # (B*MB, Bs, KV, Dh)
-    return g.reshape(b, mb * g.shape[1], g.shape[2], g.shape[3])
+    g = pool_layer[tables.reshape(-1)]  # (B*MB, Bs, ...)
+    return g.reshape((b, mb * g.shape[1]) + g.shape[2:])
+
+
+def _write_kv_layer(cache: PagedKVCache, li: int, tables, k, v, pos,
+                    ok, active=None):
+    """Write one layer's new K/V (B, T, KV, Dh) into the pools —
+    quantizing on the way when the pool is int8 — and return the updated
+    cache plus THIS layer's written (values, scales) for the read path.
+    The single spelling of the ok/active-gated paired write (prefill and
+    decode both route here, so the quant and gating logic cannot
+    drift)."""
+    def gated(pool, new):
+        return jnp.where(ok, _paged_write(pool[li], tables, new, pos,
+                                          active), pool[li])
+
+    if not cache.quantized:
+        kp, vp = gated(cache.k_pool, k), gated(cache.v_pool, v)
+        cache = cache._replace(k_pool=cache.k_pool.at[li].set(kp),
+                               v_pool=cache.v_pool.at[li].set(vp))
+        return cache, (kp, vp, None, None)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    kp, vp = gated(cache.k_pool, kq), gated(cache.v_pool, vq)
+    ksp, vsp = gated(cache.k_scale, ks), gated(cache.v_scale, vs)
+    cache = cache._replace(
+        k_pool=cache.k_pool.at[li].set(kp),
+        v_pool=cache.v_pool.at[li].set(vp),
+        k_scale=cache.k_scale.at[li].set(ksp),
+        v_scale=cache.v_scale.at[li].set(vsp),
+    )
+    return cache, (kp, vp, ksp, vsp)
 
 
 def paged_prefill(
@@ -288,14 +345,12 @@ def paged_prefill_rows(
 
     positions = jnp.broadcast_to(jnp.arange(s_p, dtype=jnp.int32), (r, s_p))
     x = embedding_lookup(params["embed"], tokens, c.dtype)
-    k_pool, v_pool = cache.k_pool, cache.v_pool
     zero = jnp.zeros((r,), jnp.int32)
     for li, layer in enumerate(params["layers"]):
         q, k, v = _project_qkv(layer, x, positions, c)
-        k_pool = k_pool.at[li].set(jnp.where(
-            ok, _paged_write(k_pool[li], tables_r, k, zero), k_pool[li]))
-        v_pool = v_pool.at[li].set(jnp.where(
-            ok, _paged_write(v_pool[li], tables_r, v, zero), v_pool[li]))
+        cache, _written = _write_kv_layer(
+            cache, li, tables_r, k, v, zero, ok
+        )
         o = attn(q, k, v, causal=True).astype(c.dtype)
         x = x + jnp.einsum("bshk,hkd->bsd", o, resolve(layer["wo"], c.dtype))
         h = _rmsnorm(x, layer["ln2"])
@@ -315,9 +370,7 @@ def paged_prefill_rows(
     length = cache.length.at[slot_ids].set(
         jnp.where(ok, lens_r, cache.length[slot_ids])
     )
-    return logits, cache._replace(
-        k_pool=k_pool, v_pool=v_pool, length=length
-    ), ok
+    return logits, cache._replace(length=length), ok
 
 
 def paged_decode_step(
@@ -345,10 +398,14 @@ def paged_decode_step(
         active = jnp.ones((b,), bool)
     active = active.astype(bool) & (cache.n_blocks > 0)
     cache, ok = _extend_for_write(cache, 1, active)
+    if attn_impl == "pallas" and cache.quantized:
+        raise ValueError(
+            "the Pallas paged kernel reads bf16/fp32 pools; int8 pools "
+            "use the gather path (kernel int8 support is a follow-up)"
+        )
     pos = cache.length
     positions = pos[:, None]
     x = embedding_lookup(params["embed"], token[:, None], c.dtype)
-    k_pool, v_pool = cache.k_pool, cache.v_pool
     for li, layer in enumerate(params["layers"]):
         q, k, v = _project_qkv(layer, x, positions, c)
         # Writes gated on ok (pool exhausted at a block boundary): with
@@ -357,16 +414,9 @@ def paged_decode_step(
         # block — the write would silently corrupt that row. On ok=False
         # the step is a no-op on the cache and the caller must release
         # rows (or grow the pool) and retry.
-        kp = jnp.where(
-            ok,
-            _paged_write(k_pool[li], cache.block_tables, k, pos, active),
-            k_pool[li])
-        vp = jnp.where(
-            ok,
-            _paged_write(v_pool[li], cache.block_tables, v, pos, active),
-            v_pool[li])
-        k_pool = k_pool.at[li].set(kp)
-        v_pool = v_pool.at[li].set(vp)
+        cache, (kp, vp, ksp, vsp) = _write_kv_layer(
+            cache, li, cache.block_tables, k, v, pos, ok, active
+        )
         if attn_impl == "pallas":
             from tpu_composer.ops.paged_attention import paged_decode_attention
 
@@ -378,6 +428,10 @@ def paged_decode_step(
                 q, _paged_read(kp, cache.block_tables),
                 _paged_read(vp, cache.block_tables),
                 pos + 1, c, q_positions=positions,
+                k_scale=(None if ksp is None
+                         else _paged_read(ksp, cache.block_tables)),
+                v_scale=(None if vsp is None
+                         else _paged_read(vsp, cache.block_tables)),
             )
         x = x + jnp.einsum("bshk,hkd->bsd", o, resolve(layer["wo"], c.dtype))
         h = _rmsnorm(x, layer["ln2"])
@@ -387,7 +441,6 @@ def paged_decode_step(
                         resolve(params["embed"], c.dtype),
                         preferred_element_type=jnp.float32)
     return logits[:, 0], cache._replace(
-        k_pool=k_pool, v_pool=v_pool,
         length=jnp.where(ok & active, pos + 1, pos),
     ), ok
 
@@ -401,11 +454,13 @@ def paged_generate(
     block_size: int = 16,
     prompt_lens: Optional[jax.Array] = None,
     attn_impl: str = "gather",
+    kv_quant: bool = False,
 ) -> jax.Array:
     """Greedy generation over a fresh pool — the parity surface against
-    decode.generate (same tokens, dense vs paged). Serving loops that
-    admit/release rows across calls drive paged_prefill /
-    paged_decode_step / release directly instead."""
+    decode.generate (same tokens, dense vs paged, same ``kv_quant``
+    int8-cache semantics). Serving loops that admit/release rows across
+    calls drive paged_prefill / paged_decode_step / release directly
+    instead."""
     c = config
     b, s_p = prompt.shape
     per_row = -(-(s_p + max_new_tokens) // block_size)  # static ceil
@@ -418,6 +473,7 @@ def paged_generate(
         )
     cache = init_paged_cache(
         c, b, num_blocks, block_size, blocks_per_row=per_row,
+        quant=kv_quant,
     )
     logits, cache, _ok = paged_prefill(
         params, prompt, c, cache, prompt_lens=prompt_lens

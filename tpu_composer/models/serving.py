@@ -85,13 +85,20 @@ class ContinuousBatchingEngine:
         attn_impl: str = "gather",
         eos_id: Optional[int] = None,
         blocks_per_row: Optional[int] = None,
+        kv_quant: bool = False,
     ):
         """``blocks_per_row`` bounds one request's table — and therefore
         how many table slots every attention read walks. Leave it None
         only for small pools: the default (whole pool) makes per-token
         attention cost scale with POOL size, not sequence length; a
         deployment sizes it at the longest request it will admit
-        (ceil(max_request_tokens / block_size))."""
+        (ceil(max_request_tokens / block_size)). ``kv_quant`` stores the
+        pool int8 (half the bytes per cached token; gather read path
+        only)."""
+        if kv_quant and attn_impl == "pallas":
+            raise ValueError(
+                "int8 pools use the gather path (see paged_decode_step)"
+            )
         from tpu_composer.models.moe import MoEConfig
 
         if isinstance(config, MoEConfig):
@@ -109,7 +116,7 @@ class ContinuousBatchingEngine:
         self.eos_id = eos_id
         self.cache = init_paged_cache(
             config, slots, num_blocks, block_size,
-            blocks_per_row=blocks_per_row,
+            blocks_per_row=blocks_per_row, quant=kv_quant,
         )
         self._slot_req: List[Optional[Request]] = [None] * slots
         self._next_token = np.zeros(slots, np.int32)
@@ -120,7 +127,12 @@ class ContinuousBatchingEngine:
             partial(paged_decode_step, config=config, attn_impl=attn_impl),
             static_argnames=(),
         )
-        self._prefills: Dict[int, Any] = {}
+        # One jitted prefill: jax.jit's shape-keyed cache already compiles
+        # once per prompt bucket — prompt padding to power-of-two buckets
+        # (in _try_admit) is what bounds the number of shapes.
+        self._prefill = jax.jit(
+            partial(paged_prefill_rows, config=config)
+        )
 
     # -- submission ----------------------------------------------------
     def submit(self, prompt: List[int], max_new_tokens: int) -> Request:
@@ -134,12 +146,17 @@ class ContinuousBatchingEngine:
         # FIFO would then livelock the whole queue.
         pad = _bucket(len(prompt))
         worst = _worst_blocks(pad, max_new_tokens, self.block_size)
-        cap = self.cache.capacity_per_row
+        cap = min(self.cache.capacity_per_row, self.config.max_seq)
+        # max_seq bounds the SOLO reference run (decode.generate raises
+        # past it — RoPE positions beyond the trained context): a request
+        # the reference cannot produce has no defined gold output, so the
+        # engine must reject it too, whatever the pool could hold.
         if worst > self.num_blocks or pad + max_new_tokens > cap:
             raise ValueError(
                 f"request needs {worst} blocks / {pad + max_new_tokens} "
                 f"positions worst-case; the pool has {self.num_blocks} "
-                f"blocks and {cap} positions per row"
+                f"blocks and {cap} positions per row (min of table "
+                f"capacity and config.max_seq)"
             )
         req = Request(prompt=list(prompt), max_new_tokens=max_new_tokens,
                       req_id=self._next_id)
@@ -171,15 +188,9 @@ class ContinuousBatchingEngine:
         if int(self._reserved.sum()) + worst > self.num_blocks:
             return []  # head-of-line blocks; FIFO fairness, no starvation
         self._waiting.popleft()
-        prefill = self._prefills.get(pad)
-        if prefill is None:
-            prefill = jax.jit(
-                partial(paged_prefill_rows, config=self.config)
-            )
-            self._prefills[pad] = prefill
         tokens = np.zeros((1, pad), np.int32)
         tokens[0, :len(req.prompt)] = req.prompt
-        logits, cache, ok = prefill(
+        logits, cache, ok = self._prefill(
             self.params, jnp.asarray(tokens), cache=self.cache,
             slot_ids=jnp.array([slot], jnp.int32),
             prompt_lens=jnp.array([len(req.prompt)], jnp.int32),
@@ -225,7 +236,13 @@ class ContinuousBatchingEngine:
             jnp.asarray(self._next_token),
             active=jnp.asarray(active),
         )
-        assert bool(ok), "pool exhausted despite host-side reservation"
+        if not bool(ok):
+            # Defense-in-depth behind the host-side reservation — a real
+            # exception (not an assert: python -O would strip it and then
+            # argmax meaningless logits into request outputs).
+            raise RuntimeError(
+                "pool exhausted despite host-side reservation"
+            )
         self.cache = cache
         picks = np.asarray(jnp.argmax(logits, axis=-1)).astype(np.int32)
         for slot in np.nonzero(active)[0]:
