@@ -214,6 +214,19 @@ class TestEngineHygiene:
         eng.run()
         assert eng._prefill._cache_size() == 1
 
+    def test_bucket_padding_does_not_shrink_max_seq(self, world):
+        """Regression (review-caught): a 65-token prompt buckets to 128 =
+        max_seq, but RoPE positions advance from the REAL length — the
+        request is valid (65 + 10 <= 128) and must serve, token-equal to
+        its solo run."""
+        c, p = world  # max_seq = 128
+        prompt = list(range(1, 66))  # 65 tokens -> pad 128
+        eng = ContinuousBatchingEngine(p, c, slots=1, num_blocks=64,
+                                       block_size=8)
+        req = eng.submit(prompt, 10)
+        eng.run()
+        assert req.tokens == _solo(p, c, prompt, 10)
+
     def test_rejects_beyond_max_seq(self, world):
         # The gold reference (solo decode.generate) raises past
         # config.max_seq; a request with no defined gold output must be

@@ -146,17 +146,25 @@ class ContinuousBatchingEngine:
         # FIFO would then livelock the whole queue.
         pad = _bucket(len(prompt))
         worst = _worst_blocks(pad, max_new_tokens, self.block_size)
-        cap = min(self.cache.capacity_per_row, self.config.max_seq)
-        # max_seq bounds the SOLO reference run (decode.generate raises
-        # past it — RoPE positions beyond the trained context): a request
-        # the reference cannot produce has no defined gold output, so the
-        # engine must reject it too, whatever the pool could hold.
+        cap = self.cache.capacity_per_row
         if worst > self.num_blocks or pad + max_new_tokens > cap:
             raise ValueError(
                 f"request needs {worst} blocks / {pad + max_new_tokens} "
                 f"positions worst-case; the pool has {self.num_blocks} "
-                f"blocks and {cap} positions per row (min of table "
-                f"capacity and config.max_seq)"
+                f"blocks and {cap} positions per row"
+            )
+        # Two DIFFERENT bounds: block/table capacity is consumed by the
+        # PADDED length (pad slots hold masked K/V), but max_seq bounds
+        # the SOLO reference run (decode.generate raises past it — RoPE
+        # positions beyond the trained context) and decode positions
+        # advance from the REAL prompt length. Conflating them would
+        # reject every prompt just above a bucket boundary.
+        if len(prompt) + max_new_tokens > self.config.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds config.max_seq "
+                f"({self.config.max_seq}) — the solo reference run has "
+                "no defined output past it"
             )
         req = Request(prompt=list(prompt), max_new_tokens=max_new_tokens,
                       req_id=self._next_id)

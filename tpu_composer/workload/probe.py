@@ -466,7 +466,7 @@ def decode_throughput_on_chip(
     # the quant numbers are emitted as a partial stage record FIRST — a
     # watchdog hard-exit mid-spec (which no try/except survives) must not
     # take minutes of already-measured evidence with it.
-    print("STAGE_PARTIAL decode " + __import__("json").dumps(out), flush=True)
+    print("STAGE_PARTIAL decode " + json.dumps(out), flush=True)
     try:
         from tpu_composer.models.speculative import speculative_generate
 
@@ -502,6 +502,38 @@ def decode_throughput_on_chip(
         out["spec_speedup"] = round(best_b / best_s, 2)
     except Exception as e:  # noqa: BLE001 - keep the quant evidence
         out["spec_error"] = f"{type(e).__name__}: {e}"
+
+    # Paged KV cache (block pool + Mosaic block-walking kernel,
+    # models/paged.py / ops/paged_attention.py): same greedy decode
+    # through 128-token blocks, timed against the dense bf16 baseline
+    # above. Emit-partial-first + isolated, like the spec block: paged
+    # numbers are additive evidence and must never cost the earlier ones.
+    print("STAGE_PARTIAL decode " + json.dumps(out), flush=True)
+    try:
+        from tpu_composer.models.paged import paged_generate
+
+        blocks_needed = -(-(prompt_len + new_tokens) // 128) * batch
+        paged = jax.jit(
+            lambda pp, tk: paged_generate(
+                pp, tk, c, max_new_tokens=new_tokens,
+                num_blocks=blocks_needed, block_size=128,
+                attn_impl="pallas",
+            )
+        )
+        paged(params, prompt).block_until_ready()
+        best_p = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            paged(params, prompt).block_until_ready()
+            best_p = min(best_p, time.perf_counter() - t0)
+        out["paged_pallas_tokens_per_s"] = round(
+            batch * new_tokens / best_p, 1
+        )
+        out["paged_vs_dense"] = round(
+            out["paged_pallas_tokens_per_s"] / out["bf16_tokens_per_s"], 2
+        )
+    except Exception as e:  # noqa: BLE001 - keep the earlier evidence
+        out["paged_error"] = f"{type(e).__name__}: {e}"
     return out
 
 
