@@ -235,4 +235,4 @@ class TestEngineHygiene:
         eng = ContinuousBatchingEngine(p, c, slots=1, num_blocks=64,
                                        block_size=8)
         with pytest.raises(ValueError, match="max_seq"):
-            eng.submit(list(range(1, 121)), 20)  # pad 128 + 20 > 128
+            eng.submit(list(range(1, 121)), 20)  # raw 120 + 20 > max_seq 128
