@@ -27,6 +27,10 @@ def main() -> None:
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel axis")
     p.add_argument("--n-kv-heads", type=int, default=0,
                    help="grouped-query kv heads (0 = MHA)")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="sequential microbatches per optimizer update "
+                        "(activation memory lever; must divide the "
+                        "global batch)")
     args = p.parse_args()
 
     # Honor an explicit JAX_PLATFORMS before any backend initializes (the
@@ -78,6 +82,7 @@ def main() -> None:
             else jnp.float32,
         ),
         sp_impl="zigzag",
+        grad_accum_steps=args.grad_accum,
     )
 
     result = fit(

@@ -193,6 +193,48 @@ class TestShardedTrainStep:
         state, metrics2 = step_fn(state, tokens)
         assert metrics2["loss"] < metrics["loss"]  # it learns the batch
 
+    def test_grad_accumulation_matches_full_batch(self):
+        """Mean-reduced loss over equal microbatches == the full-batch
+        mean, so accum=4 must produce the SAME update as accum=1 on the
+        same global batch (float-association tolerance)."""
+        mc = ModelConfig(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+            max_seq=32, dtype=jnp.float32,
+        )
+        mesh = make_mesh({"dp": 2, "sp": 1, "tp": 4})
+        tokens = jax.random.randint(jax.random.key(1), (8, 32), 0, 256)
+        tc1 = TrainConfig(model=mc)
+        tc4 = TrainConfig(model=mc, grad_accum_steps=4)
+        s1 = make_train_state(tc1, jax.random.key(0), mesh)
+        s4 = make_train_state(tc4, jax.random.key(0), mesh)
+        step1, bs = make_train_step(tc1, mesh)
+        step4, _ = make_train_step(tc4, mesh)
+        tokens = jax.device_put(tokens, bs)
+        s1, m1 = step1(s1, tokens)
+        s4, m4 = step4(s4, tokens)
+        np.testing.assert_allclose(float(m1["loss"]), float(m4["loss"]),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(m1["grad_norm"]),
+                                   float(m4["grad_norm"]), rtol=1e-4)
+        flat1 = jax.tree_util.tree_leaves(s1["params"])
+        flat4 = jax.tree_util.tree_leaves(s4["params"])
+        for a, b in zip(flat1, flat4):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-6)
+
+    def test_grad_accum_must_divide_batch(self):
+        mc = ModelConfig(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+                         d_ff=64, max_seq=16, dtype=jnp.float32)
+        mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1},
+                         devices=jax.devices()[:1])
+        tc = TrainConfig(model=mc, grad_accum_steps=3)
+        state = make_train_state(tc, jax.random.key(0), mesh)
+        step_fn, bs = make_train_step(tc, mesh)
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.key(1), (4, 16), 0, 64), bs)
+        with pytest.raises(ValueError, match="not divisible"):
+            step_fn(state, tokens)
+
     def test_ring_and_plain_attention_agree_in_training(self):
         mc = ModelConfig(
             vocab_size=256, d_model=64, n_layers=1, n_heads=4, d_ff=128,

@@ -71,6 +71,13 @@ class TrainConfig:
     # GPipe over the 'pp' mesh axis when > 0 and the mesh has pp > 1
     # (dense model only; microbatches must divide the global batch).
     pipeline_microbatches: int = 0
+    # Gradient accumulation: split the global batch into this many
+    # sequential microbatches per optimizer update (lax.scan), trading
+    # step latency for activation memory — the standard lever when the
+    # target global batch does not fit HBM. 1 = off. Mean-reduced loss
+    # makes the accumulated gradient EXACTLY the full-batch gradient
+    # (equal microbatch sizes), pinned by test_parallel.py.
+    grad_accum_steps: int = 1
 
     @property
     def is_moe(self) -> bool:
@@ -335,8 +342,51 @@ def make_train_step(tc: TrainConfig, mesh: Mesh):
         mod = tc._model_mod()
         loss = functools.partial(mod.loss_fn, config=tc.model, attn_fn=attn_fn)
 
+    accum = tc.grad_accum_steps
+    if accum < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got {accum}")
+
+    def _grads(params, tokens):
+        if accum == 1:
+            return jax.value_and_grad(loss)(params, tokens)
+        if tokens.shape[0] % accum:
+            raise ValueError(
+                f"global batch {tokens.shape[0]} not divisible by "
+                f"grad_accum_steps {accum}"
+            )
+        # (A, B/A, S), each microbatch still sharded over the data axes —
+        # without the constraint XLA may materialize the reshape gathered.
+        mb = jax.lax.with_sharding_constraint(
+            tokens.reshape(accum, tokens.shape[0] // accum,
+                           tokens.shape[1]),
+            NamedSharding(mesh, P(None, batch_axes, None)),
+        )
+
+        def acc(carry, mtok):
+            loss_sum, grad_sum = carry
+            l, g = jax.value_and_grad(loss)(params, mtok)
+            # f32 accumulator regardless of param dtype: bf16 adds round
+            # to an 8-bit mantissa every microbatch and would break the
+            # exact-equivalence contract the docstring promises.
+            return (loss_sum + l, jax.tree_util.tree_map(
+                lambda s, gi: s + gi.astype(jnp.float32), grad_sum, g
+            )), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            acc, (jnp.zeros((), jnp.float32), zeros), mb
+        )
+        # Mean of equal-size microbatch means == the full-batch mean, so
+        # the accumulated gradient is exactly the unaccumulated one.
+        inv = 1.0 / accum
+        return loss_sum * inv, jax.tree_util.tree_map(
+            lambda g, p: (g * inv).astype(p.dtype), grad_sum, params
+        )
+
     def step(state, tokens):
-        loss_val, grads = jax.value_and_grad(loss)(state["params"], tokens)
+        loss_val, grads = _grads(state["params"], tokens)
         updates, new_opt = opt.update(grads, state["opt"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
         grad_norm = optax.global_norm(grads)
