@@ -116,6 +116,62 @@ class TestSoloEquality:
         assert reqs[1].tokens == _solo(p, c, [1, 2], 7)
 
 
+class TestSampling:
+    def _solo_sampled(self, p, c, prompt, n, temperature, top_k, top_p,
+                      seed):
+        out = generate(p, jnp.asarray([prompt], jnp.int32), c,
+                       max_new_tokens=n, temperature=temperature,
+                       top_k=top_k or None,
+                       top_p=top_p if top_p < 1.0 else None,
+                       key=jax.random.key(seed))
+        return np.asarray(out)[0].tolist()
+
+    def test_sampled_request_matches_solo_run(self, world):
+        """The whole point of the per-request key schedule: a sampled
+        request equals generate() with the same controls and key."""
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=2, num_blocks=16,
+                                       block_size=8)
+        cases = [
+            ([5, 9, 2], 8, 0.8, 5, 0.9, 7),
+            ([1, 3], 6, 1.3, 0, 1.0, 11),   # pure temperature
+            ([8, 8, 8, 8], 7, 0.5, 3, 1.0, 3),  # top-k only
+        ]
+        reqs = [eng.submit(pr, n, temperature=t, top_k=k, top_p=pp,
+                           seed=s) for pr, n, t, k, pp, s in cases]
+        eng.run()
+        for req, (pr, n, t, k, pp, s) in zip(reqs, cases):
+            assert req.tokens == self._solo_sampled(p, c, pr, n, t, k,
+                                                    pp, s), (
+                f"sampled request {req.req_id} diverged from its solo run"
+            )
+
+    def test_mixed_greedy_and_sampled_slots(self, world):
+        """Greedy and sampled requests share one jitted step; neither
+        may perturb the other."""
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=3, num_blocks=24,
+                                       block_size=8)
+        g = eng.submit([2, 4, 6], 7)
+        s1 = eng.submit([3, 5], 7, temperature=0.9, top_k=4, seed=13)
+        s2 = eng.submit([9, 1, 1], 5, temperature=1.1, top_p=0.8, seed=5)
+        eng.run()
+        assert g.tokens == _solo(p, c, [2, 4, 6], 7)
+        assert s1.tokens == self._solo_sampled(p, c, [3, 5], 7, 0.9, 4,
+                                               1.0, 13)
+        assert s2.tokens == self._solo_sampled(p, c, [9, 1, 1], 5, 1.1,
+                                               0, 0.8, 5)
+
+    def test_submit_validates_sampling_controls(self, world):
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=1, num_blocks=8,
+                                       block_size=8)
+        with pytest.raises(ValueError, match="top_k"):
+            eng.submit([1], 2, top_k=-1)
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit([1], 2, top_p=0.0)
+
+
 class TestEngineHygiene:
     def test_pool_drains_back_to_full(self, world):
         c, p = world

@@ -386,6 +386,18 @@ def filter_top_p(logits: jax.Array, top_p: float) -> jax.Array:
     return jnp.where(logits >= cut, logits, -jnp.inf)
 
 
+def sampling_key_schedule(
+    key: jax.Array, max_new_tokens: int
+) -> Tuple[jax.Array, jax.Array]:
+    """THE key discipline for sampled decoding, shared by generate() and
+    the serving engine (models/serving.py): generated token 0 uses
+    ``first_key``, token t >= 1 uses ``step_keys[t-1]``. One spelling so
+    the engine's per-request streams cannot silently diverge from the
+    solo run it promises to match token-for-token."""
+    key, first_key = jax.random.split(key)
+    return first_key, jax.random.split(key, max_new_tokens)
+
+
 def generate(
     params: Dict,
     prompt: jax.Array,  # (B, S_prompt) int32
@@ -441,7 +453,7 @@ def generate(
             logits = filter_top_p(logits, top_p)
         return jax.random.categorical(k, logits).astype(jnp.int32)
 
-    key, first_key = jax.random.split(key)  # use-once key discipline
+    first_key, keys = sampling_key_schedule(key, max_new_tokens)
     first = pick(logits, first_key)
 
     def step(carry, k):
@@ -449,7 +461,5 @@ def generate(
         logits, cache = decode_step(params, cache, token, c)
         nxt = pick(logits, k)
         return (cache, nxt), token
-
-    keys = jax.random.split(key, max_new_tokens)
     (_, _), tokens = jax.lax.scan(step, (cache, first), keys)
     return tokens.T  # (B, max_new_tokens)

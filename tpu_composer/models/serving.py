@@ -14,14 +14,16 @@ EXACTLY what a solo `decode.generate` call on its prompt would produce —
 batch composition, admission order, and slot reuse can never leak
 between requests.
 
-Two deliberate v1 simplifications, both documented where they bite:
-- Greedy decoding only (sampling composes exactly as in
-  decode.generate — a temperature/top-k/top-p `pick` on the same
-  logits — but per-request RNG streams across churn are bookkeeping, not
-  architecture, so v1 pins the architecture).
-- Host round-trip per step for the generated tokens (B ints): the
-  engine is the orchestration layer and runs CPU-mesh tests; an on-chip
-  deployment would keep the token feed device-resident.
+Sampling is per-request (temperature / top-k / top-p / seed, composed
+in decode.generate's order) with a per-request key schedule identical
+to the solo run's, so sampled requests hold the same solo-equality
+contract greedy ones do — every slot picks through one vectorized
+jitted `_pick_rows`.
+
+One deliberate v1 simplification, documented where it bites: a host
+round-trip per step for the generated tokens (B ints) — the engine is
+the orchestration layer and runs CPU-mesh tests; an on-chip deployment
+would keep the token feed device-resident.
 
 Prompt lengths are padded to power-of-two buckets so the per-admission
 prefill compiles once per bucket, not once per prompt length.
@@ -38,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_composer.models.decode import AnyConfig
+from tpu_composer.models.decode import AnyConfig, sampling_key_schedule
 from tpu_composer.models.paged import (
     init_paged_cache,
     paged_decode_step,
@@ -50,13 +52,23 @@ from tpu_composer.models.paged import (
 @dataclass
 class Request:
     """One generation request. ``tokens`` fills as the engine runs;
-    ``done`` flips when max_new_tokens are out or eos_id was emitted."""
+    ``done`` flips when max_new_tokens are out or eos_id was emitted.
+
+    Sampling controls compose exactly as in decode.generate (temperature
+    first, then top-k, then top-p nucleus); ``seed`` drives a per-request
+    key schedule IDENTICAL to the one generate(key=jax.random.key(seed))
+    uses, so a sampled request still equals its solo run token-for-token.
+    temperature 0 (the default) is greedy and ignores the rest."""
 
     prompt: List[int]
     max_new_tokens: int
     req_id: int = -1
     tokens: List[int] = field(default_factory=list)
     done: bool = False
+    temperature: float = 0.0
+    top_k: int = 0          # 0 = off
+    top_p: float = 1.0      # 1.0 = off
+    seed: int = 0
 
 
 def _bucket(n: int, floor: int = 8) -> int:
@@ -64,6 +76,47 @@ def _bucket(n: int, floor: int = 8) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _pick_rows(logits, temp, top_k, top_p, keys):
+    """Per-row sampling, bit-compatible with decode.generate's pick():
+    temperature first, then top-k, then top-p nucleus, then categorical —
+    with every control a PER-ROW array so greedy and differently-sampled
+    requests share one jitted step. Rows with temp<=0 take the plain
+    argmax. Equivalences to the scalar filters (pinned by solo-parity
+    tests): the k-th-largest threshold with >= keeps ties exactly like
+    filter_top_k; top_p=1.0 computes a cut of -inf and keeps every row
+    unchanged exactly like skipping filter_top_p; top_k<=0 keeps all."""
+    v = logits.shape[-1]
+    safe_t = jnp.where(temp > 0, temp, 1.0)
+    scaled = logits / safe_t[:, None]
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    k_eff = jnp.clip(jnp.where(top_k <= 0, v, top_k), 1, v)
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+    filt = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    # The sorted view of `filt` without a second O(V log V) sort: kept
+    # entries are exactly the first `kept` of sorted_desc. NOT a rank-k
+    # mask — the >= filter keeps every value TIED with the k-th, so the
+    # count (a reduction) is the tie-exact cut where rank-k would drop
+    # tied entries and silently change the nucleus.
+    kept = jnp.sum(scaled >= kth, axis=-1, keepdims=True)
+    sorted_f = jnp.where(
+        jnp.arange(v)[None, :] < kept, sorted_desc, -jnp.inf
+    )
+    probs = jax.nn.softmax(sorted_f, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < top_p[:, None]
+    cut = jnp.min(jnp.where(keep_sorted, sorted_f, jnp.inf), axis=-1,
+                  keepdims=True)
+    filt = jnp.where(filt >= cut, filt, -jnp.inf)
+    # Per-row keys through vmap: lane b computes exactly the solo run's
+    # categorical(key_b, (1, V)) — vmap's PRNG contract makes the batched
+    # sample equal the per-row call.
+    sampled = jax.vmap(
+        lambda k, l: jax.random.categorical(k, l[None, :])[0]
+    )(keys, filt)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
 
 
 class ContinuousBatchingEngine:
@@ -121,8 +174,18 @@ class ContinuousBatchingEngine:
         self._slot_req: List[Optional[Request]] = [None] * slots
         self._next_token = np.zeros(slots, np.int32)
         self._reserved = np.zeros(slots, np.int64)  # blocks held per slot
+        # Per-slot sampling state. _slot_keys[slot] is the request's full
+        # key schedule, precomputed at admission to match decode.generate
+        # exactly: schedule[0] = the post-prefill first_key, schedule[t]
+        # = the key for generated token t.
+        self._temp = np.zeros(slots, np.float32)
+        self._topk = np.zeros(slots, np.int32)
+        self._topp = np.ones(slots, np.float32)
+        self._slot_keys: List[Optional[jax.Array]] = [None] * slots
+        self._dummy_key = jax.random.key(0)
         self._waiting: Deque[Request] = deque()
         self._next_id = 0
+        self._pick = jax.jit(_pick_rows)
         self._decode = jax.jit(
             partial(paged_decode_step, config=config, attn_impl=attn_impl),
             static_argnames=(),
@@ -135,11 +198,17 @@ class ContinuousBatchingEngine:
         )
 
     # -- submission ----------------------------------------------------
-    def submit(self, prompt: List[int], max_new_tokens: int) -> Request:
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: int = 0) -> Request:
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 = off), got {top_k}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         # Validate with the SAME math the scheduler reserves with (the
         # bucketed prompt length) — validating with the raw length would
         # accept requests the scheduler can never place, and head-of-line
@@ -167,7 +236,8 @@ class ContinuousBatchingEngine:
                 "no defined output past it"
             )
         req = Request(prompt=list(prompt), max_new_tokens=max_new_tokens,
-                      req_id=self._next_id)
+                      req_id=self._next_id, temperature=temperature,
+                      top_k=top_k, top_p=top_p, seed=seed)
         self._next_id += 1
         self._waiting.append(req)
         return req
@@ -209,7 +279,29 @@ class ContinuousBatchingEngine:
         self.cache = cache
         self._slot_req[slot] = req
         self._reserved[slot] = worst
-        first = int(jnp.argmax(logits[0]))
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+        if req.temperature > 0:
+            # The SHARED key discipline (decode.sampling_key_schedule):
+            # schedule[t] drives generated token t — first_key at t=0,
+            # step_keys[t-1] after.
+            first_key, step_keys = sampling_key_schedule(
+                jax.random.key(req.seed), req.max_new_tokens
+            )
+            self._slot_keys[slot] = jnp.concatenate(
+                [first_key[None], step_keys[:-1]]
+            )
+        else:
+            self._slot_keys[slot] = None
+        first = int(self._pick(
+            logits,
+            jnp.asarray(self._temp[slot:slot + 1]),
+            jnp.asarray(self._topk[slot:slot + 1]),
+            jnp.asarray(self._topp[slot:slot + 1]),
+            (self._slot_keys[slot][:1] if self._slot_keys[slot] is not None
+             else self._dummy_key[None]),
+        )[0])
         self._emit(slot, first)
         return [(req.req_id, first)]
 
@@ -226,6 +318,10 @@ class ContinuousBatchingEngine:
             )
             self._slot_req[slot] = None
             self._reserved[slot] = 0
+            self._temp[slot] = 0.0
+            self._topk[slot] = 0
+            self._topp[slot] = 1.0
+            self._slot_keys[slot] = None
 
     # -- the loop ------------------------------------------------------
     def step(self) -> List[Tuple[int, int]]:
@@ -252,7 +348,18 @@ class ContinuousBatchingEngine:
                 "pool exhausted despite host-side reservation"
             )
         self.cache = cache
-        picks = np.asarray(jnp.argmax(logits, axis=-1)).astype(np.int32)
+        # Each sampled slot's key for THIS step: schedule[len(tokens)]
+        # (t tokens emitted so far -> this step produces token t).
+        step_keys = jnp.stack([
+            (self._slot_keys[s][len(self._slot_req[s].tokens)]
+             if active[s] and self._slot_keys[s] is not None
+             else self._dummy_key)
+            for s in range(self.slots)
+        ])
+        picks = np.asarray(self._pick(
+            logits, jnp.asarray(self._temp), jnp.asarray(self._topk),
+            jnp.asarray(self._topp), step_keys,
+        ))
         for slot in np.nonzero(active)[0]:
             req = self._slot_req[slot]
             self._emit(slot, int(picks[slot]))
