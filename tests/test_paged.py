@@ -107,6 +107,72 @@ class TestParity:
                                        np.asarray(p_logits), rtol=1e-5)
             tok = jnp.argmax(p_logits, axis=-1).astype(jnp.int32)
 
+    def test_chunk_matches_dense_chunk(self):
+        """paged_decode_chunk vs decode.decode_chunk: same per-position
+        logits and the same cache semantics, chunked prefill's
+        correctness base."""
+        from tpu_composer.models.decode import decode_chunk
+        from tpu_composer.models.paged import paged_decode_chunk
+
+        c = _cfg()
+        p = _params(c)
+        prompt = jax.random.randint(jax.random.key(12), (2, 5), 0,
+                                    c.vocab_size)
+        d_logits, d_cache = prefill(p, prompt, c)
+        cache = init_paged_cache(c, 2, num_blocks=16, block_size=4)
+        p_logits, cache, ok = paged_prefill(p, prompt, c, cache)
+        assert bool(ok)
+        chunk = jax.random.randint(jax.random.key(13), (2, 4), 0,
+                                   c.vocab_size)
+        dl, d_cache = decode_chunk(p, d_cache, chunk, c)
+        pl, cache, ok = paged_decode_chunk(p, cache, chunk, c)
+        assert bool(ok)
+        np.testing.assert_allclose(np.asarray(dl), np.asarray(pl),
+                                   rtol=1e-5)
+        assert cache.length.tolist() == d_cache.length.tolist()
+        # And the caches agree going forward: one more decode step each.
+        tok = jnp.argmax(pl[:, -1], axis=-1).astype(jnp.int32)
+        dl2, _ = decode_step(p, d_cache, tok, c)
+        pl2, _, _ = paged_decode_step(p, cache, tok, c)
+        np.testing.assert_allclose(np.asarray(dl2), np.asarray(pl2),
+                                   rtol=1e-5)
+
+    def test_chunked_prefill_equals_whole_prefill(self):
+        """Feeding a prompt through fixed-size chunks (after an
+        admit-only block reservation) reproduces whole-prompt prefill:
+        same final logits position, same cache, same downstream tokens."""
+        from tpu_composer.models.paged import paged_decode_chunk
+
+        c = _cfg()
+        p = _params(c)
+        prompt = jax.random.randint(jax.random.key(14), (1, 10), 0,
+                                    c.vocab_size)
+        whole = init_paged_cache(c, 1, num_blocks=16, block_size=4)
+        w_logits, whole, ok = paged_prefill(p, prompt, c, whole)
+        assert bool(ok)
+        chunked = init_paged_cache(c, 1, num_blocks=16, block_size=4)
+        # Pad 10 -> 12 (multiple of C=4); reserve for the padded length.
+        chunked, ok = admit(chunked, jnp.array([1]),
+                            jnp.array([12], jnp.int32))
+        assert bool(ok)
+        padded = jnp.concatenate(
+            [prompt, jnp.zeros((1, 2), jnp.int32)], axis=1)
+        last = None
+        for i in range(3):
+            logits, chunked, ok = paged_decode_chunk(
+                p, chunked, padded[:, i * 4:(i + 1) * 4], c)
+            assert bool(ok)
+            last = logits
+        # Real length is 10: its last token sits at chunk 2, offset 1.
+        np.testing.assert_allclose(np.asarray(w_logits),
+                                   np.asarray(last[:, 1]), rtol=1e-5)
+        chunked = chunked._replace(length=jnp.array([10], jnp.int32))
+        tok = jnp.argmax(last[:, 1], axis=-1).astype(jnp.int32)
+        w1, _, _ = paged_decode_step(p, whole, tok, c)
+        c1, _, _ = paged_decode_step(p, chunked, tok, c)
+        np.testing.assert_allclose(np.asarray(w1), np.asarray(c1),
+                                   rtol=1e-5)
+
     def test_int8_pool_matches_dense_int8_cache(self):
         # The quantized pool must reproduce the DENSE int8 cache's
         # decode exactly: same quant scheme at the same positions, just

@@ -373,39 +373,47 @@ def paged_prefill_rows(
     return logits, cache._replace(length=length), ok
 
 
-def paged_decode_step(
+def paged_decode_chunk(
     params: Dict,
     cache: PagedKVCache,
-    token: jax.Array,
+    tokens: jax.Array,
     config: AnyConfig,
     attn_impl: str = "gather",
     active: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, PagedKVCache, jax.Array]:
-    """One token (B,) in -> (next-token logits (B, vocab), cache, ok) —
-    the paged mirror of decode.decode_step. ``ok`` False means the pool
-    could not supply a block some row needed: the cache is returned
-    UNCHANGED (no write, no length advance — all-or-nothing, like admit)
-    and the logits are meaningless; release rows or grow the pool, then
-    retry. ``active`` (B,) masks rows: idle batch slots (a continuous-
-    batching engine between requests) compute garbage logits but write
-    nothing and never advance — their stale tables may name other rows'
-    blocks. ``attn_impl='pallas'`` reads the cache through the
-    block-walking kernel (ops/paged_attention.py); ``'gather'`` is the
-    reference path."""
+    """T tokens (B, T) in -> (per-position logits (B, T, vocab), cache,
+    ok) — the paged mirror of decode.decode_chunk: token i attends the
+    cache plus chunk tokens 0..i (per-query causal limits). T=1 is
+    single-step decoding (paged_decode_step); T>1 is chunked prefill —
+    a serving engine feeds a long prompt through fixed-size chunks so
+    admission costs one bounded step at a time instead of one
+    full-prompt pause.
+
+    ``ok`` False means the pool could not supply a block some row
+    needed: the cache is returned UNCHANGED (no write, no length
+    advance — all-or-nothing, like admit) and the logits are
+    meaningless; release rows or grow the pool, then retry. ``active``
+    (B,) masks rows: idle batch slots (a continuous-batching engine
+    between requests) compute garbage logits but write nothing and never
+    advance — their stale tables may name other rows' blocks.
+    ``attn_impl='pallas'`` uses the block-walking kernel
+    (ops/paged_attention.py) on the T=1 shape it implements; chunks read
+    through the gather path."""
     c = config
-    b = token.shape[0]
+    b, t = tokens.shape
     if active is None:
         active = jnp.ones((b,), bool)
     active = active.astype(bool) & (cache.n_blocks > 0)
-    cache, ok = _extend_for_write(cache, 1, active)
+    cache, ok = _extend_for_write(cache, t, active)
     if attn_impl == "pallas" and cache.quantized:
         raise ValueError(
             "the Pallas paged kernel reads bf16/fp32 pools; int8 pools "
             "use the gather path (kernel int8 support is a follow-up)"
         )
+    use_kernel = attn_impl == "pallas" and t == 1
     pos = cache.length
-    positions = pos[:, None]
-    x = embedding_lookup(params["embed"], token[:, None], c.dtype)
+    positions = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    x = embedding_lookup(params["embed"], tokens, c.dtype)
     for li, layer in enumerate(params["layers"]):
         q, k, v = _project_qkv(layer, x, positions, c)
         # Writes gated on ok (pool exhausted at a block boundary): with
@@ -417,7 +425,7 @@ def paged_decode_step(
         cache, (kp, vp, ksp, vsp) = _write_kv_layer(
             cache, li, cache.block_tables, k, v, pos, ok, active
         )
-        if attn_impl == "pallas":
+        if use_kernel:
             from tpu_composer.ops.paged_attention import paged_decode_attention
 
             o = paged_decode_attention(
@@ -427,7 +435,7 @@ def paged_decode_step(
             o = _cached_attention(
                 q, _paged_read(kp, cache.block_tables),
                 _paged_read(vp, cache.block_tables),
-                pos + 1, c, q_positions=positions,
+                pos + t, c, q_positions=positions,
                 k_scale=(None if ksp is None
                          else _paged_read(ksp, cache.block_tables)),
                 v_scale=(None if vsp is None
@@ -440,9 +448,27 @@ def paged_decode_step(
     logits = jnp.einsum("bsd,vd->bsv", x,
                         resolve(params["embed"], c.dtype),
                         preferred_element_type=jnp.float32)
-    return logits[:, 0], cache._replace(
-        length=jnp.where(ok & active, pos + 1, pos),
+    return logits, cache._replace(
+        length=jnp.where(ok & active, pos + t, pos),
     ), ok
+
+
+def paged_decode_step(
+    params: Dict,
+    cache: PagedKVCache,
+    token: jax.Array,
+    config: AnyConfig,
+    attn_impl: str = "gather",
+    active: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, PagedKVCache, jax.Array]:
+    """One token (B,) in -> (next-token logits (B, vocab), cache, ok):
+    the T=1 specialization of paged_decode_chunk (see its docstring for
+    the ok/active contract)."""
+    logits, cache, ok = paged_decode_chunk(
+        params, cache, token[:, None], config,
+        attn_impl=attn_impl, active=active,
+    )
+    return logits[:, 0], cache, ok
 
 
 def paged_generate(

@@ -42,7 +42,9 @@ import numpy as np
 
 from tpu_composer.models.decode import AnyConfig, sampling_key_schedule
 from tpu_composer.models.paged import (
+    admit,
     init_paged_cache,
+    paged_decode_chunk,
     paged_decode_step,
     paged_prefill_rows,
     release,
@@ -139,6 +141,7 @@ class ContinuousBatchingEngine:
         eos_id: Optional[int] = None,
         blocks_per_row: Optional[int] = None,
         kv_quant: bool = False,
+        prefill_chunk: Optional[int] = None,
     ):
         """``blocks_per_row`` bounds one request's table — and therefore
         how many table slots every attention read walks. Leave it None
@@ -147,7 +150,12 @@ class ContinuousBatchingEngine:
         deployment sizes it at the longest request it will admit
         (ceil(max_request_tokens / block_size)). ``kv_quant`` stores the
         pool int8 (half the bytes per cached token; gather read path
-        only)."""
+        only). ``prefill_chunk`` switches admission to CHUNKED prefill:
+        the prompt streams through fixed ``prefill_chunk``-token chunks,
+        one per engine step, while every other slot keeps decoding — an
+        admission never pauses the batch longer than one chunk (the
+        admission-latency bound long prompts need). One compile shape
+        total for admission instead of one per bucket."""
         if kv_quant and attn_impl == "pallas":
             raise ValueError(
                 "int8 pools use the gather path (see paged_decode_step)"
@@ -186,6 +194,17 @@ class ContinuousBatchingEngine:
         self._waiting: Deque[Request] = deque()
         self._next_id = 0
         self._pick = jax.jit(_pick_rows)
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        self.prefill_chunk = prefill_chunk
+        # In-flight chunked admission: {slot, req, consumed, padded} —
+        # its slot is excluded from decode until the last chunk lands.
+        self._admitting: Optional[Dict[str, Any]] = None
+        self._chunk = jax.jit(
+            partial(paged_decode_chunk, config=config,
+                    attn_impl=attn_impl)
+        )
         self._decode = jax.jit(
             partial(paged_decode_step, config=config, attn_impl=attn_impl),
             static_argnames=(),
@@ -210,10 +229,10 @@ class ContinuousBatchingEngine:
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         # Validate with the SAME math the scheduler reserves with (the
-        # bucketed prompt length) — validating with the raw length would
+        # padded prompt length) — validating with the raw length would
         # accept requests the scheduler can never place, and head-of-line
         # FIFO would then livelock the whole queue.
-        pad = _bucket(len(prompt))
+        pad = self._pad_len(len(prompt))
         worst = _worst_blocks(pad, max_new_tokens, self.block_size)
         cap = self.cache.capacity_per_row
         if worst > self.num_blocks or pad + max_new_tokens > cap:
@@ -242,6 +261,14 @@ class ContinuousBatchingEngine:
         self._waiting.append(req)
         return req
 
+    def _pad_len(self, prompt_len: int) -> int:
+        """The padded prompt length admission actually allocates for:
+        the next multiple of prefill_chunk in chunked mode, the
+        power-of-two bucket otherwise."""
+        if self.prefill_chunk is not None:
+            return -(-prompt_len // self.prefill_chunk) * self.prefill_chunk
+        return _bucket(prompt_len)
+
     # -- scheduling ----------------------------------------------------
     def _free_slot(self) -> Optional[int]:
         for i, r in enumerate(self._slot_req):
@@ -257,15 +284,37 @@ class ContinuousBatchingEngine:
         keeps step latency bounded."""
         if not self._waiting:
             return []
+        if self._admitting is not None:
+            return []  # a chunked admission is already streaming in
         slot = self._free_slot()
         if slot is None:
             return []
         req = self._waiting[0]
-        pad = _bucket(len(req.prompt))
+        pad = self._pad_len(len(req.prompt))
         worst = _worst_blocks(pad, req.max_new_tokens, self.block_size)
         if int(self._reserved.sum()) + worst > self.num_blocks:
             return []  # head-of-line blocks; FIFO fairness, no starvation
         self._waiting.popleft()
+        if self.prefill_chunk is not None:
+            # Chunked admission: reserve the blocks now (admit-only), then
+            # stream the prompt one chunk per engine step. No token yet —
+            # the last chunk's logits produce it in _advance_admission.
+            cache, ok = admit(
+                self.cache,
+                jnp.zeros((self.slots,), jnp.int32).at[slot].set(1),
+                jnp.zeros((self.slots,), jnp.int32).at[slot].set(pad),
+            )
+            if not bool(ok):  # host reservation should make this unreachable
+                self._waiting.appendleft(req)
+                return []
+            self.cache = cache
+            self._slot_req[slot] = req
+            self._reserved[slot] = worst
+            padded = np.zeros(pad, np.int32)
+            padded[:len(req.prompt)] = req.prompt
+            self._admitting = {"slot": slot, "req": req, "consumed": 0,
+                               "padded": padded}
+            return []
         tokens = np.zeros((1, pad), np.int32)
         tokens[0, :len(req.prompt)] = req.prompt
         logits, cache, ok = self._prefill(
@@ -279,6 +328,12 @@ class ContinuousBatchingEngine:
         self.cache = cache
         self._slot_req[slot] = req
         self._reserved[slot] = worst
+        self._arm_sampling(slot, req)
+        first = self._pick_first(slot, logits)
+        self._emit(slot, first)
+        return [(req.req_id, first)]
+
+    def _arm_sampling(self, slot: int, req: Request) -> None:
         self._temp[slot] = req.temperature
         self._topk[slot] = req.top_k
         self._topp[slot] = req.top_p
@@ -294,14 +349,50 @@ class ContinuousBatchingEngine:
             )
         else:
             self._slot_keys[slot] = None
-        first = int(self._pick(
-            logits,
+
+    def _pick_first(self, slot: int, logits_1v) -> int:
+        return int(self._pick(
+            logits_1v,
             jnp.asarray(self._temp[slot:slot + 1]),
             jnp.asarray(self._topk[slot:slot + 1]),
             jnp.asarray(self._topp[slot:slot + 1]),
             (self._slot_keys[slot][:1] if self._slot_keys[slot] is not None
              else self._dummy_key[None]),
         )[0])
+
+    def _advance_admission(self) -> List[Tuple[int, int]]:
+        """Feed the in-flight chunked admission its next chunk. On the
+        last chunk, truncate the padded length back to the real prompt,
+        arm sampling, and emit the request's first token."""
+        if self._admitting is None:
+            return []
+        st = self._admitting
+        c_sz = self.prefill_chunk
+        slot, req = st["slot"], st["req"]
+        chunk = np.zeros((self.slots, c_sz), np.int32)
+        chunk[slot] = st["padded"][st["consumed"]:st["consumed"] + c_sz]
+        logits, cache, ok = self._chunk(
+            self.params, self.cache, jnp.asarray(chunk),
+            active=jnp.zeros((self.slots,), bool).at[slot].set(True),
+        )
+        if not bool(ok):
+            raise RuntimeError(
+                "pool exhausted during chunked admission despite "
+                "host-side reservation"
+            )
+        self.cache = cache
+        st["consumed"] += c_sz
+        if st["consumed"] < len(st["padded"]):
+            return []
+        real = len(req.prompt)
+        # Pad-slot K/V sits past the real length: masked on every read
+        # and overwritten as the row decodes, like bucketed prefill pads.
+        self.cache = self.cache._replace(
+            length=self.cache.length.at[slot].set(real))
+        self._admitting = None
+        self._arm_sampling(slot, req)
+        first = self._pick_first(
+            slot, logits[slot:slot + 1, (real - 1) % c_sz])
         self._emit(slot, first)
         return [(req.req_id, first)]
 
@@ -330,8 +421,12 @@ class ContinuousBatchingEngine:
         events produced this step — including a just-admitted request's
         first token, which comes from its prefill, not the decode."""
         events = self._try_admit()
+        events += self._advance_admission()
+        admitting_slot = (self._admitting["slot"]
+                          if self._admitting is not None else -1)
         active = np.array(
-            [r is not None for r in self._slot_req], bool
+            [r is not None and s != admitting_slot
+             for s, r in enumerate(self._slot_req)], bool
         )
         if not active.any():
             return events
@@ -348,18 +443,25 @@ class ContinuousBatchingEngine:
                 "pool exhausted despite host-side reservation"
             )
         self.cache = cache
-        # Each sampled slot's key for THIS step: schedule[len(tokens)]
-        # (t tokens emitted so far -> this step produces token t).
-        step_keys = jnp.stack([
-            (self._slot_keys[s][len(self._slot_req[s].tokens)]
-             if active[s] and self._slot_keys[s] is not None
-             else self._dummy_key)
-            for s in range(self.slots)
-        ])
-        picks = np.asarray(self._pick(
-            logits, jnp.asarray(self._temp), jnp.asarray(self._topk),
-            jnp.asarray(self._topp), step_keys,
-        ))
+        if all(k is None for k in self._slot_keys):
+            # All-greedy batch (the common serving default): a single
+            # argmax — the full sampling pipeline (vocab sort, softmax,
+            # cumsum, categorical) would compute per-step work whose
+            # results the temp>0 select discards for every row.
+            picks = np.asarray(jnp.argmax(logits, axis=-1))
+        else:
+            # Each sampled slot's key for THIS step: schedule[len(tokens)]
+            # (t tokens emitted so far -> this step produces token t).
+            step_keys = jnp.stack([
+                (self._slot_keys[s][len(self._slot_req[s].tokens)]
+                 if active[s] and self._slot_keys[s] is not None
+                 else self._dummy_key)
+                for s in range(self.slots)
+            ])
+            picks = np.asarray(self._pick(
+                logits, jnp.asarray(self._temp), jnp.asarray(self._topk),
+                jnp.asarray(self._topp), step_keys,
+            ))
         for slot in np.nonzero(active)[0]:
             req = self._slot_req[slot]
             self._emit(slot, int(picks[slot]))
