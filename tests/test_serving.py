@@ -152,6 +152,27 @@ class TestChunkedAdmission:
         assert first.tokens == _solo(p, c, [3, 1, 4], 12)
         assert long.tokens == _solo(p, c, list(range(1, 25)), 4)
 
+    def test_free_slots_admit_during_long_admission(self, world):
+        """Round-robin admission: a long prompt streaming in must not
+        leave other free slots idle — short requests admit and stream
+        concurrently (one chunk of admission work per step total)."""
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=3, num_blocks=48,
+                                       block_size=8, prefill_chunk=8)
+        long = eng.submit(list(range(1, 49)), 3)   # 6 chunks
+        short1 = eng.submit([4, 2], 3)             # 1 chunk
+        short2 = eng.submit([7, 7, 7], 3)          # 1 chunk
+        # After six steps (round-robin: L,L,S1,L,S2,L) both shorts must
+        # be producing tokens while the long admission still streams.
+        for _ in range(6):
+            eng.step()
+        assert not long.tokens  # still streaming (6 chunks, 1/step)
+        assert short1.tokens and short2.tokens
+        eng.run()
+        assert long.tokens == _solo(p, c, list(range(1, 49)), 3)
+        assert short1.tokens == _solo(p, c, [4, 2], 3)
+        assert short2.tokens == _solo(p, c, [7, 7, 7], 3)
+
     def test_chunked_sampled_and_int8(self, world):
         c, p = world
         eng = ContinuousBatchingEngine(p, c, slots=2, num_blocks=32,

@@ -87,6 +87,48 @@ class TestSpeculativeExactness:
 
 
 class TestDecodeChunk:
+    @pytest.mark.parametrize("kv_quant", [False, True])
+    def test_paged_speculative_matches_dense_and_target(self, kv_quant):
+        """paged_speculative_generate (block-pool caches for target AND
+        draft) reproduces both the dense speculative run and target-only
+        greedy — the same exactness contract, paged."""
+        from tpu_composer.models.speculative import (
+            paged_speculative_generate,
+        )
+
+        c = _cfg()
+        dc = _cfg(n_layers=1, d_ff=96)
+        params = init_params(c, jax.random.key(0))
+        draft = init_params(dc, jax.random.key(7))
+        prompt = jax.random.randint(jax.random.key(2), (1, 5), 0,
+                                    c.vocab_size)
+        ref = generate(params, prompt, c, max_new_tokens=12, max_seq=96,
+                       kv_quant=kv_quant)
+        dense = speculative_generate(
+            params, draft, prompt, c, draft_config=dc,
+            max_new_tokens=12, gamma=3, max_seq=96, kv_quant=kv_quant,
+        )
+        paged = paged_speculative_generate(
+            params, draft, prompt, c, num_blocks=8, block_size=8,
+            draft_config=dc, max_new_tokens=12, gamma=3,
+            kv_quant=kv_quant,
+        )
+        assert paged.tolist() == dense.tolist() == ref.tolist()
+
+    def test_paged_speculative_capacity_check(self):
+        from tpu_composer.models.speculative import (
+            paged_speculative_generate,
+        )
+
+        c = _cfg()
+        params = init_params(c, jax.random.key(0))
+        prompt = jnp.zeros((1, 6), jnp.int32)
+        with pytest.raises(ValueError, match="blocks"):
+            paged_speculative_generate(
+                params, params, prompt, c, num_blocks=2, block_size=8,
+                max_new_tokens=32, gamma=4,
+            )
+
     def test_chunk_equals_stepwise(self):
         """decode_chunk(T) must equal T successive decode_steps — same
         logits, same cache contents (the verify step's correctness)."""

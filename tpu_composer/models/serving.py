@@ -198,9 +198,13 @@ class ContinuousBatchingEngine:
             raise ValueError(f"prefill_chunk must be >= 1, got "
                              f"{prefill_chunk}")
         self.prefill_chunk = prefill_chunk
-        # In-flight chunked admission: {slot, req, consumed, padded} —
-        # its slot is excluded from decode until the last chunk lands.
-        self._admitting: Optional[Dict[str, Any]] = None
+        # In-flight chunked admissions, round-robin: each engine step
+        # advances exactly ONE of them by one chunk (bounded per-step
+        # admission work), but any free slot can START admitting at any
+        # time — a 64-chunk prompt must not leave seven empty slots idle
+        # for 64 steps. Entries: {slot, req, consumed, padded}; their
+        # slots are excluded from decode until the last chunk lands.
+        self._admitting: Deque[Dict[str, Any]] = deque()
         self._chunk = jax.jit(
             partial(paged_decode_chunk, config=config,
                     attn_impl=attn_impl)
@@ -284,8 +288,6 @@ class ContinuousBatchingEngine:
         keeps step latency bounded."""
         if not self._waiting:
             return []
-        if self._admitting is not None:
-            return []  # a chunked admission is already streaming in
         slot = self._free_slot()
         if slot is None:
             return []
@@ -312,8 +314,8 @@ class ContinuousBatchingEngine:
             self._reserved[slot] = worst
             padded = np.zeros(pad, np.int32)
             padded[:len(req.prompt)] = req.prompt
-            self._admitting = {"slot": slot, "req": req, "consumed": 0,
-                               "padded": padded}
+            self._admitting.append({"slot": slot, "req": req,
+                                    "consumed": 0, "padded": padded})
             return []
         tokens = np.zeros((1, pad), np.int32)
         tokens[0, :len(req.prompt)] = req.prompt
@@ -361,12 +363,14 @@ class ContinuousBatchingEngine:
         )[0])
 
     def _advance_admission(self) -> List[Tuple[int, int]]:
-        """Feed the in-flight chunked admission its next chunk. On the
-        last chunk, truncate the padded length back to the real prompt,
-        arm sampling, and emit the request's first token."""
-        if self._admitting is None:
+        """Feed the LONGEST-waITING in-flight chunked admission its next
+        chunk (round-robin: one chunk of admission work per engine step,
+        however many admissions stream). On a request's last chunk,
+        truncate the padded length back to the real prompt, arm sampling,
+        and emit its first token."""
+        if not self._admitting:
             return []
-        st = self._admitting
+        st = self._admitting.popleft()
         c_sz = self.prefill_chunk
         slot, req = st["slot"], st["req"]
         chunk = np.zeros((self.slots, c_sz), np.int32)
@@ -383,13 +387,13 @@ class ContinuousBatchingEngine:
         self.cache = cache
         st["consumed"] += c_sz
         if st["consumed"] < len(st["padded"]):
+            self._admitting.append(st)  # more chunks to stream
             return []
         real = len(req.prompt)
         # Pad-slot K/V sits past the real length: masked on every read
         # and overwritten as the row decodes, like bucketed prefill pads.
         self.cache = self.cache._replace(
             length=self.cache.length.at[slot].set(real))
-        self._admitting = None
         self._arm_sampling(slot, req)
         first = self._pick_first(
             slot, logits[slot:slot + 1, (real - 1) % c_sz])
@@ -422,10 +426,9 @@ class ContinuousBatchingEngine:
         first token, which comes from its prefill, not the decode."""
         events = self._try_admit()
         events += self._advance_admission()
-        admitting_slot = (self._admitting["slot"]
-                          if self._admitting is not None else -1)
+        admitting_slots = {st["slot"] for st in self._admitting}
         active = np.array(
-            [r is not None and s != admitting_slot
+            [r is not None and s not in admitting_slots
              for s, r in enumerate(self._slot_req)], bool
         )
         if not active.any():

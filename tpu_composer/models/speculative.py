@@ -67,6 +67,63 @@ def _draft_roll(params: Dict, cache, pending, config, gamma: int):
     return drafts, cache
 
 
+def _speculative_loop(
+    first: int,
+    max_new_tokens: int,
+    gamma: int,
+    prompt_len: int,
+    draft_roll,
+    verify,
+    t_cache,
+    d_cache,
+    set_length,
+) -> List[int]:
+    """The one host-driven accept loop, generic over the cache type:
+    ``draft_roll(cache, pending, gamma) -> (drafts, cache)``,
+    ``verify(cache, chunk) -> (greedy, cache)``,
+    ``set_length(cache, n) -> cache`` (the
+    rewind — stale K/V beyond the valid prefix is masked and later
+    overwritten, dense and paged caches alike). Dense and paged
+    speculative generation share this loop so the acceptance/bookkeeping
+    logic cannot fork."""
+    out: List[int] = [first]
+    # Invariant: both caches cover the prompt plus out[:covered]; the
+    # still-uncovered suffix of `out` is what the draft consumes next (1
+    # token normally, 2 after a fully-accepted round) and the target's
+    # verify chunk always starts at its own first uncovered token.
+    covered_d = 0
+    covered_t = 0
+    while len(out) < max_new_tokens:
+        pending_d = jnp.asarray([out[covered_d:]], jnp.int32)
+        drafts, d_cache = draft_roll(d_cache, pending_d, gamma)
+
+        chunk = jnp.concatenate(
+            [jnp.asarray([out[covered_t:]], jnp.int32), drafts], axis=1
+        )
+        greedy, t_cache = verify(t_cache, chunk)
+        # greedy[:, i] is the target's choice AFTER chunk[:, :i+1]; drafts
+        # start at chunk position (len(out) - covered_t).
+        off = len(out) - covered_t
+        d_np = np.asarray(drafts[0])
+        g_np = np.asarray(greedy[0])
+        a = 0
+        while a < gamma and d_np[a] == g_np[off - 1 + a]:
+            a += 1
+        accepted = list(d_np[:a]) + [int(g_np[off - 1 + a])]
+        prev_len = len(out)
+        out.extend(int(x) for x in accepted)
+
+        # Cache bookkeeping: the verify chunk wrote off+gamma entries but
+        # only off+a are real; the draft wrote pending+gamma-1 of which
+        # pending+min(a, gamma-1) are real. Lengths rewind to the valid
+        # prefix — stale K/V beyond it is masked and later overwritten.
+        covered_t = prev_len + a
+        t_cache = set_length(t_cache, prompt_len + covered_t)
+        covered_d = prev_len + min(a, gamma - 1)
+        d_cache = set_length(d_cache, prompt_len + covered_d)
+    return out[:max_new_tokens]
+
+
 def speculative_generate(
     params: Dict,
     draft_params: Dict,
@@ -115,44 +172,113 @@ def speculative_generate(
     _, d_cache = prefill(draft_params, prompt, dc, max_seq=max_seq,
                          quant=kv_quant)
 
-    out: List[int] = [int(jnp.argmax(t_logits, axis=-1)[0])]
-    # Invariant: both caches cover the prompt plus out[:covered]; the
-    # still-uncovered suffix of `out` is what the draft consumes next (1
-    # token normally, 2 after a fully-accepted round) and the target's
-    # verify chunk always starts at its own first uncovered token.
-    covered_d = 0
-    covered_t = 0
-    while len(out) < max_new_tokens:
-        pending_d = jnp.asarray([out[covered_d:]], jnp.int32)
-        drafts, d_cache = _draft_roll(draft_params, d_cache, pending_d, dc,
-                                      gamma)
+    out = _speculative_loop(
+        int(jnp.argmax(t_logits, axis=-1)[0]),
+        max_new_tokens, gamma, prompt.shape[1],
+        draft_roll=lambda cache, pending, g: _draft_roll(
+            draft_params, cache, pending, dc, g),
+        verify=lambda cache, chunk: _verify_chunk(
+            params, cache, chunk, config),
+        t_cache=t_cache,
+        d_cache=d_cache,
+        set_length=lambda cache, n: cache._replace(
+            length=jnp.full_like(cache.length, n)),
+    )
+    return jnp.asarray([out], jnp.int32)
 
-        chunk = jnp.concatenate(
-            [jnp.asarray([out[covered_t:]], jnp.int32), drafts], axis=1
-        )
-        greedy, t_cache = _verify_chunk(params, t_cache, chunk, config)
-        # greedy[:, i] is the target's choice AFTER chunk[:, :i+1]; drafts
-        # start at chunk position (len(out) - covered_t).
-        off = len(out) - covered_t
-        d_np = np.asarray(drafts[0])
-        g_np = np.asarray(greedy[0])
-        a = 0
-        while a < gamma and d_np[a] == g_np[off - 1 + a]:
-            a += 1
-        accepted = list(d_np[:a]) + [int(g_np[off - 1 + a])]
-        prev_len = len(out)
-        out.extend(int(x) for x in accepted)
 
-        # Cache bookkeeping: the verify chunk wrote off+gamma entries but
-        # only off+a are real; the draft wrote pending+gamma-1 of which
-        # pending+min(a, gamma-1) are real. Lengths rewind to the valid
-        # prefix — stale K/V beyond it is masked and later overwritten.
-        covered_t = prev_len + a
-        t_cache = t_cache._replace(
-            length=jnp.full_like(t_cache.length, prompt.shape[1] + covered_t)
+def paged_speculative_generate(
+    params: Dict,
+    draft_params: Dict,
+    prompt: jax.Array,  # (1, S_prompt) int32
+    config: AnyConfig,
+    num_blocks: int,
+    block_size: int = 16,
+    draft_config: Optional[AnyConfig] = None,
+    max_new_tokens: int = 32,
+    gamma: int = 4,
+    kv_quant: bool = False,
+) -> jax.Array:
+    """speculative_generate over paged block-pool caches (one per model)
+    — same host loop, same exact-greedy contract, the pool's HBM story.
+    ``num_blocks``/``block_size`` size EACH cache's pool; the verify
+    overshoot (gamma) counts toward capacity like the dense bound."""
+    from tpu_composer.models.paged import (
+        init_paged_cache,
+        paged_decode_chunk,
+        paged_prefill,
+    )
+
+    dc = draft_config or config
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            f"speculative decoding runs per-sequence (batch 1), got batch"
+            f" {prompt.shape[0]}"
         )
-        covered_d = prev_len + min(a, gamma - 1)
-        d_cache = d_cache._replace(
-            length=jnp.full_like(d_cache.length, prompt.shape[1] + covered_d)
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    need = prompt.shape[1] + max_new_tokens + gamma - 1
+    # Same trained-context bound the dense path enforces: past it the
+    # reference run (target-only greedy) is undefined, so "exact" would
+    # mean nothing.
+    cap = min(config.max_seq, dc.max_seq)
+    if need > cap:
+        raise ValueError(
+            f"prompt + max_new_tokens + gamma overshoot ({need}) exceeds"
+            f" the cache capacity ({cap})"
         )
-    return jnp.asarray([out[:max_new_tokens]], jnp.int32)
+    per_row = -(-need // block_size)
+    if per_row > num_blocks:
+        raise ValueError(
+            f"prompt + max_new_tokens + gamma overshoot ({need}) needs "
+            f"{per_row} blocks; the pool has {num_blocks}"
+        )
+
+    def make(cfg, p):
+        cache = init_paged_cache(cfg, 1, num_blocks, block_size,
+                                 blocks_per_row=per_row, quant=kv_quant)
+        logits, cache, ok = paged_prefill(p, prompt, cfg, cache)
+        if not bool(ok):
+            raise RuntimeError("pool could not cover the prompt")
+        return logits, cache
+
+    def chunked(p, cfg):
+        def fn(cache, chunk):
+            logits, cache, ok = paged_decode_chunk(p, cache, chunk, cfg)
+            if not bool(ok):
+                raise RuntimeError(
+                    "pool exhausted mid-speculation despite the "
+                    "capacity precheck"
+                )
+            return logits, cache
+        return fn
+
+    t_chunk = chunked(params, config)
+    d_chunk = chunked(draft_params, dc)
+    t_logits, t_cache = make(config, params)
+    _, d_cache = make(dc, draft_params)
+
+    def draft_roll(cache, pending, g):
+        logits, cache = d_chunk(cache, pending)
+        first = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+        toks = [first]
+        for _ in range(g - 1):
+            lg, cache = d_chunk(cache, toks[-1])
+            toks.append(jnp.argmax(lg[:, -1:], axis=-1).astype(jnp.int32))
+        return jnp.concatenate(toks, axis=1), cache
+
+    def verify(cache, chunk):
+        logits, cache = t_chunk(cache, chunk)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+    out = _speculative_loop(
+        int(jnp.argmax(t_logits, axis=-1)[0]),
+        max_new_tokens, gamma, prompt.shape[1],
+        draft_roll=draft_roll,
+        verify=verify,
+        t_cache=t_cache,
+        d_cache=d_cache,
+        set_length=lambda cache, n: cache._replace(
+            length=jnp.full_like(cache.length, n)),
+    )
+    return jnp.asarray([out], jnp.int32)
