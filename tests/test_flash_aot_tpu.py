@@ -82,6 +82,28 @@ class TestPagedDecodeCompilesForTPU:
         )).lower(*args).compile()
         assert compiled is not None
 
+    def test_paged_decode_kernel_int8(self):
+        """The int8-pool variant (scale blocks riding the table-routed
+        index maps) lowers through Mosaic for v5e too."""
+        import functools
+
+        from tpu_composer.ops.paged_attention import paged_decode_attention
+
+        n, bs, kv, dh, b, h, mb = 64, 128, 2, 128, 8, 8, 16
+        args = (
+            _sds((b, h, dh), jnp.bfloat16),        # q
+            _sds((n, bs, kv, dh), jnp.int8),       # k_pool
+            _sds((n, bs, kv, dh), jnp.int8),       # v_pool
+            _sds((b, mb), jnp.int32),              # block_tables
+            _sds((b,), jnp.int32),                 # lengths
+            _sds((n, bs, kv), jnp.float32),        # k_scale
+            _sds((n, bs, kv), jnp.float32),        # v_scale
+        )
+        compiled = jax.jit(functools.partial(
+            paged_decode_attention, interpret=False
+        )).lower(*args).compile()
+        assert compiled is not None
+
 
 class TestFlashCompilesForTPU:
     def test_grad_bf16_causal_default_blocks(self):

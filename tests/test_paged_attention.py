@@ -113,6 +113,59 @@ class TestKernelParity:
             rtol=2e-2, atol=2e-2)
 
 
+class TestInt8Kernel:
+    def test_matches_gather_reference_int8(self):
+        """int8 pools + scale blocks through the kernel's table-routed
+        index maps vs the gather path's score-side dequant."""
+        from tpu_composer.models.decode import quantize_kv
+        from tpu_composer.models.paged import _paged_read
+
+        dh, bs, n, b, h, kv = 64, 16, 8, 2, 4, 2
+        kf, vf = _rand_pool(jax.random.key(8), n, bs, kv, dh)
+        k_pool, k_scale = quantize_kv(kf)
+        v_pool, v_scale = quantize_kv(vf)
+        q = jax.random.normal(jax.random.key(9), (b, h, dh), jnp.float32)
+        tables = jnp.array([[0, 3, 5], [1, 6, 7]], jnp.int32)
+        lengths = jnp.array([35, 42], jnp.int32)
+        got = paged_decode_attention(
+            q, k_pool, v_pool, tables, lengths,
+            k_scale=k_scale, v_scale=v_scale, interpret=True)
+        c = ModelConfig(d_model=h * dh, n_heads=h, n_kv_heads=kv,
+                        dtype=jnp.float32)
+        want = _cached_attention(
+            q[:, None], _paged_read(k_pool, tables),
+            _paged_read(v_pool, tables), lengths, c,
+            q_positions=(lengths - 1)[:, None],
+            k_scale=_paged_read(k_scale, tables),
+            v_scale=_paged_read(v_scale, tables),
+        )[:, 0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_scale_args_must_pair(self):
+        dh, bs, n, b, h, kv = 32, 8, 4, 1, 2, 1
+        k_pool, v_pool = _rand_pool(jax.random.key(10), n, bs, kv, dh)
+        q = jnp.zeros((b, h, dh), jnp.float32)
+        with pytest.raises(ValueError, match="both"):
+            paged_decode_attention(
+                q, k_pool, v_pool, jnp.zeros((1, 2), jnp.int32),
+                jnp.ones((1,), jnp.int32),
+                k_scale=jnp.zeros((n, bs, kv)), interpret=True)
+
+    def test_int8_paged_generate_pallas_matches_dense_int8(self):
+        c = ModelConfig(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, d_ff=64, max_seq=64,
+                        dtype=jnp.float32)
+        p = init_params(c, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(11), (2, 6), 0,
+                                    c.vocab_size)
+        dense = generate(p, prompt, c, max_new_tokens=8, kv_quant=True)
+        paged = paged_generate(p, prompt, c, max_new_tokens=8,
+                               num_blocks=16, block_size=8,
+                               attn_impl="pallas", kv_quant=True)
+        np.testing.assert_array_equal(np.asarray(dense), np.asarray(paged))
+
+
 class TestEndToEnd:
     def test_paged_generate_pallas_matches_dense_greedy(self):
         c = ModelConfig(vocab_size=64, d_model=64, n_layers=2, n_heads=4,

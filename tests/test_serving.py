@@ -327,9 +327,13 @@ class TestEngineHygiene:
             kv_quant=True))[0].tolist()
         assert reqs[0].tokens == gold0
         assert reqs[1].tokens == gold1
-        with pytest.raises(ValueError, match="gather path"):
-            ContinuousBatchingEngine(p, c, slots=1, num_blocks=4,
-                                     kv_quant=True, attn_impl="pallas")
+        # int8 pool + the Pallas kernel path: same solo equality.
+        eng2 = ContinuousBatchingEngine(p, c, slots=2, num_blocks=16,
+                                        block_size=8, kv_quant=True,
+                                        attn_impl="pallas")
+        req2 = eng2.submit([3, 1, 4], 6)
+        eng2.run()
+        assert req2.tokens == gold0
 
     def test_compiles_are_bucketed(self, world):
         # Same bucket -> same prefill shape -> one compile in jit's

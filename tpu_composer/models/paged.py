@@ -405,11 +405,6 @@ def paged_decode_chunk(
         active = jnp.ones((b,), bool)
     active = active.astype(bool) & (cache.n_blocks > 0)
     cache, ok = _extend_for_write(cache, t, active)
-    if attn_impl == "pallas" and cache.quantized:
-        raise ValueError(
-            "the Pallas paged kernel reads bf16/fp32 pools; int8 pools "
-            "use the gather path (kernel int8 support is a follow-up)"
-        )
     use_kernel = attn_impl == "pallas" and t == 1
     pos = cache.length
     positions = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
@@ -430,6 +425,7 @@ def paged_decode_chunk(
 
             o = paged_decode_attention(
                 q[:, 0], kp, vp, cache.block_tables, pos + 1,
+                k_scale=ksp, v_scale=vsp,
             )[:, None]
         else:
             o = _cached_attention(

@@ -149,17 +149,13 @@ class ContinuousBatchingEngine:
         attention cost scale with POOL size, not sequence length; a
         deployment sizes it at the longest request it will admit
         (ceil(max_request_tokens / block_size)). ``kv_quant`` stores the
-        pool int8 (half the bytes per cached token; gather read path
-        only). ``prefill_chunk`` switches admission to CHUNKED prefill:
-        the prompt streams through fixed ``prefill_chunk``-token chunks,
+        pool int8 (half the bytes per cached token; composes with the
+        Pallas kernel path). ``prefill_chunk`` switches admission to
+        CHUNKED prefill: the prompt streams through fixed ``prefill_chunk``-token chunks,
         one per engine step, while every other slot keeps decoding — an
         admission never pauses the batch longer than one chunk (the
         admission-latency bound long prompts need). One compile shape
         total for admission instead of one per bucket."""
-        if kv_quant and attn_impl == "pallas":
-            raise ValueError(
-                "int8 pools use the gather path (see paged_decode_step)"
-            )
         from tpu_composer.models.moe import MoEConfig
 
         if isinstance(config, MoEConfig):
@@ -363,7 +359,7 @@ class ContinuousBatchingEngine:
         )[0])
 
     def _advance_admission(self) -> List[Tuple[int, int]]:
-        """Feed the LONGEST-waITING in-flight chunked admission its next
+        """Feed the longest-waiting in-flight chunked admission its next
         chunk (round-robin: one chunk of admission work per engine step,
         however many admissions stream). On a request's last chunk,
         truncate the padded length back to the real prompt, arm sampling,

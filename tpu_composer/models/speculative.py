@@ -67,6 +67,21 @@ def _draft_roll(params: Dict, cache, pending, config, gamma: int):
     return drafts, cache
 
 
+def _draft_roll_host(chunk_fn, cache, pending, gamma: int):
+    """The drafting contract, host-driven and generic over the cache:
+    consume ``pending``, emit ``gamma`` greedy drafts; the cache advances
+    past pending + the first gamma-1 drafts (the last draft's K/V is
+    never written — re-feeding the newest accepted token always keeps it
+    one step ahead). The dense path's ``_draft_roll`` is this same
+    contract fused into one jitted lax.scan; change one, change both."""
+    logits, cache = chunk_fn(cache, pending)
+    toks = [jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)]
+    for _ in range(gamma - 1):
+        lg, cache = chunk_fn(cache, toks[-1])
+        toks.append(jnp.argmax(lg[:, -1:], axis=-1).astype(jnp.int32))
+    return jnp.concatenate(toks, axis=1), cache
+
+
 def _speculative_loop(
     first: int,
     max_new_tokens: int,
@@ -243,8 +258,16 @@ def paged_speculative_generate(
         return logits, cache
 
     def chunked(p, cfg):
+        # Jitted per chunk length — the loop only ever presents a few
+        # shapes (pending 1 or 2, verify gamma+1 or gamma+2, drafts 1),
+        # so this matches the dense path's compile-once cost instead of
+        # dispatching the whole transformer op-by-op every round.
+        jfn = jax.jit(
+            lambda cache, chunk: paged_decode_chunk(p, cache, chunk, cfg)
+        )
+
         def fn(cache, chunk):
-            logits, cache, ok = paged_decode_chunk(p, cache, chunk, cfg)
+            logits, cache, ok = jfn(cache, chunk)
             if not bool(ok):
                 raise RuntimeError(
                     "pool exhausted mid-speculation despite the "
@@ -259,13 +282,7 @@ def paged_speculative_generate(
     _, d_cache = make(dc, draft_params)
 
     def draft_roll(cache, pending, g):
-        logits, cache = d_chunk(cache, pending)
-        first = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
-        toks = [first]
-        for _ in range(g - 1):
-            lg, cache = d_chunk(cache, toks[-1])
-            toks.append(jnp.argmax(lg[:, -1:], axis=-1).astype(jnp.int32))
-        return jnp.concatenate(toks, axis=1), cache
+        return _draft_roll_host(d_chunk, cache, pending, g)
 
     def verify(cache, chunk):
         logits, cache = t_chunk(cache, chunk)

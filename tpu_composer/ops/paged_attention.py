@@ -38,9 +38,17 @@ from jax.experimental.pallas import tpu as pltpu
 from tpu_composer.ops.attention import _default_interpret
 
 
+def _kernel_quant(tables_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
+                  vs_ref, o_ref, m_ref, l_ref, acc_ref, **kw):
+    """Positional adapter: Pallas passes refs in in_specs order, so the
+    int8 variant (two extra scale inputs) needs its own arg layout."""
+    _kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+            m_ref, l_ref, acc_ref, ks_ref=ks_ref, vs_ref=vs_ref, **kw)
+
+
 def _kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
             m_ref, l_ref, acc_ref, *, block_size: int, n_kv: int,
-            scale: float):
+            scale: float, ks_ref=None, vs_ref=None):
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -53,15 +61,23 @@ def _kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     g = q_ref.shape[2]
     # Scores for every (kv, group) query row against this block, KV axis
     # statically unrolled: rows kvi*G..(kvi+1)*G of s are kv head kvi.
+    # int8 pools (ks_ref/vs_ref given): the dense gather path's scheme
+    # in-kernel — the k scale is a per-(position, head) multiply on the
+    # SCORES, the v scale folds into the probabilities; the (Bs, Dh)
+    # tensors themselves upconvert in-register off the halved HBM read.
     parts = []
     for kvi in range(n_kv):
         q_kv = q_ref[0, kvi].astype(jnp.float32)          # (G, Dh)
         k_kv = k_ref[0, :, kvi].astype(jnp.float32)       # (Bs, Dh)
-        parts.append(jax.lax.dot_general(
+        s_kv = jax.lax.dot_general(
             q_kv, k_kv, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ))
-    s = jnp.concatenate(parts, axis=0) * scale            # (KV*G, Bs)
+        ) * scale
+        if ks_ref is not None:
+            # After the 1/sqrt(Dh) factor — the dense path's order.
+            s_kv = s_kv * ks_ref[0, :, kvi][None, :]
+        parts.append(s_kv)
+    s = jnp.concatenate(parts, axis=0)                    # (KV*G, Bs)
     pos = j * block_size + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, dimension=1
     )
@@ -83,8 +99,11 @@ def _kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     outs = []
     for kvi in range(n_kv):
         v_kv = v_ref[0, :, kvi].astype(jnp.float32)       # (Bs, Dh)
+        p_kv = p[kvi * g:(kvi + 1) * g]
+        if vs_ref is not None:
+            p_kv = p_kv * vs_ref[0, :, kvi][None, :]
         outs.append(jax.lax.dot_general(
-            p[kvi * g:(kvi + 1) * g], v_kv, (((1,), (0,)), ((), ())),
+            p_kv, v_kv, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ))
     acc_ref[:rows] = acc_ref[:rows] * alpha + jnp.concatenate(outs, axis=0)
@@ -105,13 +124,19 @@ def paged_decode_attention(
     v_pool: jax.Array,
     block_tables: jax.Array,  # (B, MB) int32
     lengths: jax.Array,       # (B,) int32
+    k_scale: Optional[jax.Array] = None,  # (N, Bs, KV) fp32 (int8 pools)
+    v_scale: Optional[jax.Array] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """One decode step of attention over the paged cache -> (B, H, Dh).
+    ``k_scale``/``v_scale`` (both or neither) switch to the int8-pool
+    variant: scale blocks ride the same table-routed index maps.
 
     ``interpret`` defaults to True off-TPU (CPU-mesh testability) exactly
     like ops/attention.py; ``TPUC_FLASH_INTERPRET`` overrides for AOT
     compiles from CPU-backend processes."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
     if interpret is None:
         interpret = _default_interpret()
     b, h, dh = q.shape
@@ -126,24 +151,25 @@ def paged_decode_attention(
     rows = max(8, kv * g)  # sublane-pad the scratch accumulators
 
     grid = (b, mb)
-    kernel = functools.partial(
-        _kernel, block_size=bs, n_kv=kv, scale=1.0 / (dh ** 0.5)
-    )
+    kw = dict(block_size=bs, n_kv=kv, scale=1.0 / (dh ** 0.5))
+    q_spec = pl.BlockSpec((1, kv, g, dh),
+                          lambda b_, j, tables, lens: (b_, 0, 0, 0))
+    pool_spec = pl.BlockSpec((1, bs, kv, dh),
+                             lambda b_, j, tables, lens: (
+                                 tables[b_, j], 0, 0, 0))
+    scale_spec = pl.BlockSpec((1, bs, kv),
+                              lambda b_, j, tables, lens: (
+                                  tables[b_, j], 0, 0))
+    quant = k_scale is not None
     out = pl.pallas_call(
-        kernel,
+        functools.partial(_kernel_quant if quant else _kernel, **kw),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, kv, g, dh),
-                             lambda b_, j, tables, lens: (b_, 0, 0, 0)),
-                pl.BlockSpec((1, bs, kv, dh),
-                             lambda b_, j, tables, lens: (
-                                 tables[b_, j], 0, 0, 0)),
-                pl.BlockSpec((1, bs, kv, dh),
-                             lambda b_, j, tables, lens: (
-                                 tables[b_, j], 0, 0, 0)),
-            ],
+            in_specs=(
+                [q_spec, pool_spec, pool_spec]
+                + ([scale_spec, scale_spec] if quant else [])
+            ),
             out_specs=pl.BlockSpec(
                 (1, kv, g, dh),
                 lambda b_, j, tables, lens: (b_, 0, 0, 0)),
@@ -155,5 +181,6 @@ def paged_decode_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((b, kv, g, dh), q.dtype),
         interpret=interpret,
-    )(block_tables, lengths, qg, k_pool, v_pool)
+    )(block_tables, lengths, qg, k_pool, v_pool,
+      *((k_scale, v_scale) if quant else ()))
     return out.reshape(b, h, dh)
