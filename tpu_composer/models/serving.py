@@ -158,12 +158,25 @@ class ContinuousBatchingEngine:
         total for admission instead of one per bucket."""
         from tpu_composer.models.moe import MoEConfig
 
-        if isinstance(config, MoEConfig):
-            # The admission prefill pads prompts to buckets and relies on
-            # prompt_lens masking; MoE routing shares one capacity group
-            # across the padded row (see decode.prefill), so pads would
-            # affect real tokens. Same restriction, same reason.
-            raise ValueError("the v1 engine serves dense configs only")
+        if isinstance(config, MoEConfig) and prefill_chunk is None:
+            # Bucketed-prefill admission runs the TRAINING forward on the
+            # padded row, where MoE routing shares one capacity group and
+            # pads can push real tokens past expert capacity (see
+            # decode.prefill). CHUNKED admission runs decode_chunk
+            # semantics instead — drop-free capacity, every token routed
+            # independently — so pads cannot displace real tokens.
+            # Equality with the solo generate run is then conditional the
+            # same way decode.py documents for chunked verification: they
+            # agree whenever the solo PREFILL itself dropped no tokens
+            # (generous capacity_factor); under expert saturation the
+            # engine's drop-free routing is the more faithful serving
+            # computation — serving stacks do not replicate training's
+            # capacity-drop artifact.
+            raise ValueError(
+                "MoE serving requires chunked admission: pass "
+                "prefill_chunk (bucketed prefill's padded training-"
+                "forward routing would let pads affect real tokens)"
+            )
         self.params = params
         self.config = config
         self.slots = slots

@@ -263,11 +263,15 @@ def paged_speculative_generate(
         # so this matches the dense path's compile-once cost instead of
         # dispatching the whole transformer op-by-op every round.
         jfn = jax.jit(
-            lambda cache, chunk: paged_decode_chunk(p, cache, chunk, cfg)
+            # params as an ARGUMENT, not a closure: closing over them
+            # would bake every weight into each compiled executable as an
+            # HLO constant, once per model per chunk shape.
+            lambda p_, cache, chunk: paged_decode_chunk(p_, cache, chunk,
+                                                        cfg)
         )
 
         def fn(cache, chunk):
-            logits, cache, ok = jfn(cache, chunk)
+            logits, cache, ok = jfn(p, cache, chunk)
             if not bool(ok):
                 raise RuntimeError(
                     "pool exhausted mid-speculation despite the "
