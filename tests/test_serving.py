@@ -244,6 +244,84 @@ class TestSampling:
             eng.submit([1], 2, top_p=0.0)
 
 
+class TestCancellation:
+    def test_cancel_in_every_lifecycle_stage(self, world):
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=2, num_blocks=16,
+                                       block_size=8, prefill_chunk=8)
+        decoding = eng.submit([1, 2, 3], 10)
+        streaming = eng.submit(list(range(1, 25)), 5)  # 3 chunks
+        waiting = eng.submit([7], 5)  # no slot: both are taken
+        eng.step()  # admits `decoding` (its one chunk)
+        eng.step()  # decoding's first token; `streaming` starts chunk 1
+        eng.step()  # streaming mid-admission (chunk 2 of 3)
+        assert decoding.tokens
+        # Genuinely mid-admission when cancelled — not merely waiting.
+        assert any(st["req"] is streaming for st in eng._admitting)
+        assert eng.cancel(waiting) and waiting.done
+        assert eng.cancel(streaming) and streaming.done
+        assert not streaming.tokens  # never produced anything
+        assert not any(st["req"] is streaming for st in eng._admitting)
+        assert eng.cancel(decoding) and decoding.done
+        assert eng.cancel(decoding) is False  # double-cancel is a no-op
+        eng.run()
+        assert int(eng.cache.free_top) == 16  # every block returned
+
+    def test_cancel_frees_slot_for_next_request(self, world):
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=1, num_blocks=8,
+                                       block_size=8)
+        hog = eng.submit([5, 5], 40)  # holds 6 of the 8 blocks
+        eng.step()
+        eng.cancel(hog)
+        nxt = eng.submit([3, 1, 4], 4)
+        eng.run()
+        assert nxt.tokens == _solo(p, c, [3, 1, 4], 4)
+
+
+class TestChurnStorm:
+    def test_random_churn_conserves_and_stays_exact(self, world):
+        """Serving soak: random submits, cancels and drains across modes
+        (greedy/sampled, short/long prompts) — the pool must conserve
+        blocks and surviving requests must still equal their solo runs."""
+        import random
+
+        c, p = world
+        rng = random.Random(42)
+        eng = ContinuousBatchingEngine(p, c, slots=3, num_blocks=48,
+                                       block_size=8, prefill_chunk=8)
+        live, finished = [], []
+        for i in range(60):
+            r = rng.random()
+            if r < 0.4 and len(live) < 8:
+                ln = rng.randint(1, 20)
+                pr = [rng.randint(0, c.vocab_size - 1)
+                      for _ in range(ln)]
+                if rng.random() < 0.3:
+                    req = eng.submit(pr, rng.randint(1, 6),
+                                     temperature=0.8, top_k=5,
+                                     seed=rng.randint(0, 99))
+                else:
+                    req = eng.submit(pr, rng.randint(1, 6))
+                req._prompt_copy = list(pr)
+                live.append(req)
+            elif r < 0.5 and live:
+                eng.cancel(live.pop(rng.randrange(len(live))))
+            else:
+                eng.step()
+            finished += [q for q in live if q.done]
+            live = [q for q in live if not q.done]
+        eng.run()
+        finished += live
+        assert int(eng.cache.free_top) == 48
+        assert sorted(np.asarray(eng.cache.free).tolist()) == list(range(48))
+        # Spot-check solo equality on the greedy survivors.
+        for req in [q for q in finished if q.temperature == 0
+                    and q.tokens][:5]:
+            assert req.tokens == _solo(p, c, req._prompt_copy,
+                                       req.max_new_tokens)[:len(req.tokens)]
+
+
 class TestEngineHygiene:
     def test_pool_drains_back_to_full(self, world):
         c, p = world

@@ -409,6 +409,20 @@ class ContinuousBatchingEngine:
         self._emit(slot, first)
         return [(req.req_id, first)]
 
+    def _free(self, slot: int) -> None:
+        """Release a slot's blocks and zero its per-slot state — the one
+        teardown used by completion and cancellation alike."""
+        self.cache = release(
+            self.cache,
+            jnp.zeros((self.slots,), jnp.int32).at[slot].set(1),
+        )
+        self._slot_req[slot] = None
+        self._reserved[slot] = 0
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._topp[slot] = 1.0
+        self._slot_keys[slot] = None
+
     def _emit(self, slot: int, token: int) -> None:
         req = self._slot_req[slot]
         req.tokens.append(token)
@@ -416,16 +430,32 @@ class ContinuousBatchingEngine:
         if (len(req.tokens) >= req.max_new_tokens
                 or (self.eos_id is not None and token == self.eos_id)):
             req.done = True
-            self.cache = release(
-                self.cache,
-                jnp.zeros((self.slots,), jnp.int32).at[slot].set(1),
-            )
-            self._slot_req[slot] = None
-            self._reserved[slot] = 0
-            self._temp[slot] = 0.0
-            self._topk[slot] = 0
-            self._topp[slot] = 1.0
-            self._slot_keys[slot] = None
+            self._free(slot)
+
+    def cancel(self, req: Request) -> bool:
+        """Abort a request wherever it is — waiting, mid-chunked-
+        admission, or decoding — returning its blocks to the pool.
+        Returns False when it had already finished (nothing to cancel);
+        ``req.done`` flips either way so callers can treat cancellation
+        as completion."""
+        if req.done:
+            return False
+        req.done = True
+        try:
+            self._waiting.remove(req)
+            return True
+        except ValueError:
+            pass  # not waiting: it occupies a slot
+        for st in list(self._admitting):
+            if st["req"] is req:
+                self._admitting.remove(st)
+                self._free(st["slot"])
+                return True
+        for slot, r in enumerate(self._slot_req):
+            if r is req:
+                self._free(slot)
+                return True
+        return False  # finished between the caller's check and ours
 
     # -- the loop ------------------------------------------------------
     def step(self) -> List[Tuple[int, int]]:
