@@ -244,6 +244,123 @@ class TestSampling:
             eng.submit([1], 2, top_p=0.0)
 
 
+class TestPrefixCaching:
+    def test_shared_prefix_requests_match_solo_runs(self, world):
+        """The system-prompt cache: requests attached to one registered
+        prefix must produce EXACTLY their solo-run tokens — the shared
+        blocks hold the same K/V a solo prefill would compute (absolute
+        RoPE positions; identical prefix => identical K/V)."""
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=3, num_blocks=48,
+                                       block_size=8, prefill_chunk=8)
+        sys_prompt = list(range(1, 17))  # 16 tokens = 2 blocks
+        h = eng.register_prefix(sys_prompt)
+        free_after_reg = int(eng.cache.free_top)
+        tails = [[7, 3], [9], [5, 5, 5, 2]]
+        reqs = [eng.submit(sys_prompt + t, 6, prefix=h) for t in tails]
+        eng.run()
+        for req, t in zip(reqs, tails):
+            assert req.tokens == _solo(p, c, sys_prompt + t, 6), (
+                f"prefix-attached request {req.req_id} diverged"
+            )
+        # Shared blocks stayed in the pool (held by the handle), every
+        # per-request block came back.
+        assert int(eng.cache.free_top) == free_after_reg
+        eng.close_prefix(h)
+        assert int(eng.cache.free_top) == 48  # prefix freed at last drop
+        assert sorted(np.asarray(eng.cache.free).tolist()) == list(range(48))
+
+    def test_prefix_is_cached_once(self, world):
+        """The memory claim: N attached requests hold ONE copy of the
+        prefix blocks — admission pops only per-request suffix blocks."""
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=2, num_blocks=32,
+                                       block_size=8, prefill_chunk=8)
+        h = eng.register_prefix(list(range(1, 25)))  # 24 tokens = 3 blocks
+        free0 = int(eng.cache.free_top)
+        r1 = eng.submit(h.tokens + [4], 12, prefix=h)
+        r2 = eng.submit(h.tokens + [6], 12, prefix=h)
+        eng.step()  # admits r1 (attach + its one chunk)
+        eng.step()  # admits r2; both now mid-flight
+        assert not r1.done and not r2.done
+        # Each attached row claimed only its OWN suffix blocks: pool
+        # usage is free0 minus fresh blocks, not minus 2x prefix.
+        used = free0 - int(eng.cache.free_top)
+        assert used <= 2 * 3  # <= two rows' worth of suffix+decode blocks
+        # The prefix blocks are co-owned: refcount = handle + attached.
+        rc = np.asarray(eng.cache.refcount)[np.asarray(h.block_ids)]
+        assert (rc == 3).all()  # handle + two in-flight rows
+        eng.run()
+        rc = np.asarray(eng.cache.refcount)[np.asarray(h.block_ids)]
+        assert (rc == 1).all()  # rows done: only the handle holds them
+        eng.close_prefix(h)
+        assert int(eng.cache.free_top) == 32
+
+    def test_prefix_with_sampling_and_cancel(self, world):
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=2, num_blocks=32,
+                                       block_size=8, prefill_chunk=8)
+        h = eng.register_prefix(list(range(2, 10)))  # 8 tokens
+        sampled = eng.submit(h.tokens + [3, 1], 5, temperature=0.9,
+                             top_k=4, seed=17, prefix=h)
+        doomed = eng.submit(h.tokens + [9], 8, prefix=h)
+        eng.step(); eng.step()
+        eng.cancel(doomed)
+        eng.run()
+        gold = np.asarray(generate(
+            p, jnp.asarray([h.tokens + [3, 1]], jnp.int32), c,
+            max_new_tokens=5, temperature=0.9, top_k=4,
+            key=jax.random.key(17)))[0].tolist()
+        assert sampled.tokens == gold
+        eng.close_prefix(h)
+        assert int(eng.cache.free_top) == 32
+
+    def test_close_while_request_queued_keeps_blocks_alive(self, world):
+        """The review-caught lifecycle hole: closing a handle while a
+        prefix request still WAITS (holding no pool refcount) must not
+        free the blocks — a decoding row would recycle them and the
+        queued request would attach to foreign K/V. The handle's host
+        refs keep the registry hold until the last request finishes."""
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=1, num_blocks=32,
+                                       block_size=8, prefill_chunk=8)
+        h = eng.register_prefix(list(range(1, 9)))
+        hog = eng.submit([2, 4, 6], 10)       # takes the only slot
+        queued = eng.submit(h.tokens + [5, 5], 6, prefix=h)
+        eng.step()  # hog admitted; queued waits
+        assert not queued.tokens
+        eng.close_prefix(h)
+        # The prefix blocks must still be held (refcount >= 1): the
+        # queued request's host-side reference pins them.
+        rc = np.asarray(eng.cache.refcount)[np.asarray(h.block_ids)]
+        assert (rc >= 1).all()
+        eng.run()
+        assert queued.tokens == _solo(p, c, h.tokens + [5, 5], 6)
+        # Last reference gone -> blocks freed without close being called
+        # again.
+        assert int(eng.cache.free_top) == 32
+
+    def test_prefix_validation(self, world):
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=1, num_blocks=16,
+                                       block_size=8, prefill_chunk=8)
+        with pytest.raises(ValueError, match="multiple of"):
+            eng.register_prefix([1, 2, 3])  # not block-aligned
+        h = eng.register_prefix(list(range(1, 9)))
+        with pytest.raises(ValueError, match="START with"):
+            eng.submit([9, 9, 9, 9, 9, 9, 9, 9, 1], 2, prefix=h)
+        with pytest.raises(ValueError, match="START with"):
+            eng.submit(h.tokens, 2, prefix=h)  # no suffix
+        eng.close_prefix(h)
+        with pytest.raises(ValueError, match="closed"):
+            eng.submit(h.tokens + [1], 2, prefix=h)
+        # Bucketed engines reject prefix attachment outright.
+        eng2 = ContinuousBatchingEngine(p, c, slots=1, num_blocks=16,
+                                        block_size=8)
+        with pytest.raises(ValueError, match="chunked admission"):
+            eng2.submit([1, 2], 2, prefix=h)
+
+
 class TestCancellation:
     def test_cancel_in_every_lifecycle_stage(self, world):
         c, p = world

@@ -57,6 +57,13 @@ class PagedKVCache(NamedTuple):
     - ``free``: (N,) int32 — stack of free block ids; ``free[:free_top]``
       are free, popped from the top.
     - ``free_top``: () int32.
+    - ``refcount``: (N,) int32 — owners per block. Singly-owned blocks
+      (the normal case) carry 1; a shared-prefix block carries one count
+      per attached row plus one for its registry handle. ``release``
+      decrements and frees only blocks that reach zero, so prefix
+      sharing (the system-prompt cache) needs no copy-on-write: decode
+      is append-only and rows only ever WRITE to blocks they own
+      exclusively (positions >= their prefix).
     - ``k_scale``/``v_scale``: (L, N, Bs, KV) fp32 — present when the
       pool stores int8 (``quant=True``): per-(position, head) scales,
       exactly the dense KVCache's scheme, block-pooled. Composes the two
@@ -71,6 +78,7 @@ class PagedKVCache(NamedTuple):
     n_blocks: jax.Array
     free: jax.Array
     free_top: jax.Array
+    refcount: jax.Array = None
     k_scale: Optional[jax.Array] = None
     v_scale: Optional[jax.Array] = None
 
@@ -108,6 +116,7 @@ def init_paged_cache(
         n_blocks=jnp.zeros((batch,), jnp.int32),
         free=jnp.arange(num_blocks, dtype=jnp.int32),
         free_top=jnp.asarray(num_blocks, jnp.int32),
+        refcount=jnp.zeros((num_blocks,), jnp.int32),
     )
     if not quant:
         return PagedKVCache(
@@ -128,6 +137,24 @@ def _blocks_needed(tokens: jax.Array, block_size: int) -> jax.Array:
     return -(-tokens // block_size)  # ceil
 
 
+def _pop_blocks(cache: PagedKVCache, flat_want: jax.Array):
+    """THE free-stack pop: for every True in ``flat_want`` take one block
+    off the top of the stack. Returns (popped ids aligned with
+    flat_want, total popped, updated refcount with the popped blocks at
+    1). Callers gate all-or-nothing on ``total <= cache.free_top`` plus
+    their own capacity checks — one spelling so the pop discipline
+    (top-down order, clip-guarded gather, drop-mode refcount set) cannot
+    drift between admit, extend, and prefix attach."""
+    total = flat_want.sum()
+    rank = jnp.cumsum(flat_want) - 1
+    pop_idx = cache.free_top - 1 - rank
+    popped = cache.free[jnp.clip(pop_idx, 0, cache.free.shape[0] - 1)]
+    refcount = cache.refcount.at[
+        jnp.where(flat_want, popped, cache.refcount.shape[0])
+    ].set(1, mode="drop")
+    return popped, total, refcount
+
+
 def admit(
     cache: PagedKVCache, row_mask: jax.Array, n_tokens: jax.Array
 ) -> Tuple[PagedKVCache, jax.Array]:
@@ -146,16 +173,13 @@ def admit(
     slot = jnp.arange(mb, dtype=jnp.int32)[None, :]
     want = slot < want_rows[:, None]  # (B, MB) bool
     flat = want.reshape(-1)
-    total = flat.sum()
     # Per-row table capacity is part of all-or-nothing: without it a
     # too-long request would be "admitted" with n_blocks > MB while the
     # table silently capped at MB slots, and later writes past capacity
     # would clip onto the row's last block (the _extend_for_write guard,
     # mirrored).
+    popped, total, refcount = _pop_blocks(cache, flat)
     ok = (total <= cache.free_top) & jnp.all(want_rows <= mb)
-    rank = jnp.cumsum(flat) - 1
-    pop_idx = cache.free_top - 1 - rank
-    popped = cache.free[jnp.clip(pop_idx, 0, cache.free.shape[0] - 1)]
     tables_flat = jnp.where(flat, popped, cache.block_tables.reshape(-1))
     new = cache._replace(  # _replace, NOT a fresh NamedTuple: a fresh one
         # would silently drop the optional scale pools to their None
@@ -164,6 +188,7 @@ def admit(
         length=jnp.where(row_mask, 0, cache.length),
         n_blocks=jnp.where(row_mask, want_rows, cache.n_blocks),
         free_top=cache.free_top - total,
+        refcount=refcount,
     )
     # All-or-nothing: on overflow nothing changes (jnp.where over the
     # pytree keeps shapes static under jit).
@@ -172,22 +197,41 @@ def admit(
     ), ok
 
 
+def _free_blocks(cache: PagedKVCache, ids, drop_mask) -> PagedKVCache:
+    """Decrement ``refcount`` for every id where ``drop_mask`` and push
+    the blocks that reach ZERO onto the free stack — each freed block
+    exactly once, even if several owners dropped it in this same call
+    (the per-BLOCK freed mask below is the dedup; pushing per-owner would
+    double-free a shared-prefix block whose last two owners leave
+    together). ``ids``/``drop_mask`` are flat, any length."""
+    n = cache.refcount.shape[0]
+    idx = jnp.where(drop_mask, ids, n)
+    rc = cache.refcount.at[idx].add(-1, mode="drop")
+    touched = jnp.zeros((n,), bool).at[idx].set(True, mode="drop")
+    freed = touched & (rc == 0) & (cache.refcount > 0)
+    rank = jnp.cumsum(freed) - 1
+    block_ids = jnp.arange(n, dtype=jnp.int32)
+    push_idx = jnp.where(freed, cache.free_top + rank, n)
+    return cache._replace(
+        refcount=rc,
+        free=cache.free.at[push_idx].set(block_ids, mode="drop"),
+        free_top=cache.free_top + freed.sum(),
+    )
+
+
 def release(cache: PagedKVCache, row_mask: jax.Array) -> PagedKVCache:
-    """Push the masked rows' blocks back on the free stack and zero the
-    rows. The pool data itself is left as-is — stale blocks are never
-    readable because reads mask by length."""
+    """Drop the masked rows' ownership of their blocks and zero the rows;
+    blocks whose refcount reaches zero return to the free stack (shared-
+    prefix blocks survive until their last owner leaves). The pool data
+    itself is left as-is — stale blocks are never readable because reads
+    mask by length."""
     b, mb = cache.block_tables.shape
     slot = jnp.arange(mb, dtype=jnp.int32)[None, :]
     used = (slot < cache.n_blocks[:, None]) & row_mask[:, None].astype(bool)
-    flat = used.reshape(-1)
-    rank = jnp.cumsum(flat) - 1
-    push_idx = jnp.where(flat, cache.free_top + rank, cache.free.shape[0])
-    free = cache.free.at[push_idx].set(
-        cache.block_tables.reshape(-1), mode="drop"
+    cache = _free_blocks(
+        cache, cache.block_tables.reshape(-1), used.reshape(-1)
     )
     return cache._replace(
-        free=free,
-        free_top=cache.free_top + flat.sum(),
         length=jnp.where(row_mask, 0, cache.length),
         n_blocks=jnp.where(row_mask, 0, cache.n_blocks),
     )
@@ -209,20 +253,95 @@ def _extend_for_write(
     slot = jnp.arange(mb, dtype=jnp.int32)[None, :]
     want = (slot >= cache.n_blocks[:, None]) & (slot < need_total[:, None])
     flat = want.reshape(-1)
-    total = flat.sum()
+    popped, total, refcount = _pop_blocks(cache, flat)
     ok = (total <= cache.free_top) & jnp.all(need_total <= mb)
-    rank = jnp.cumsum(flat) - 1
-    pop_idx = cache.free_top - 1 - rank
-    popped = cache.free[jnp.clip(pop_idx, 0, cache.free.shape[0] - 1)]
     tables_flat = jnp.where(flat, popped, cache.block_tables.reshape(-1))
     new = cache._replace(
         block_tables=tables_flat.reshape(b, mb),
         n_blocks=jnp.maximum(cache.n_blocks, need_total),
         free_top=cache.free_top - total,
+        refcount=refcount,
     )
     return jax.tree_util.tree_map(
         lambda n, o: jnp.where(ok, n, o), new, cache
     ), ok
+
+
+def attach_prefix(
+    cache: PagedKVCache,
+    slot: int,
+    prefix_blocks: jax.Array,  # (K,) int32 pool ids holding the prefix
+    prefix_len: int,
+    extra_tokens: int,
+) -> Tuple[PagedKVCache, jax.Array]:
+    """Admit one row that STARTS with a shared prefix: its table opens
+    with ``prefix_blocks`` (refcount +1 each — the row becomes a
+    co-owner, never a writer: it only appends at positions >=
+    ``prefix_len``, which land in the fresh blocks claimed here for the
+    ``extra_tokens`` that follow). Returns (cache, ok); all-or-nothing
+    like admit. The prefix must be block-aligned (``prefix_len`` a
+    multiple of block_size) so table slot j keeps meaning positions
+    [j*Bs, (j+1)*Bs) — the invariant every read path assumes."""
+    b, mb = cache.block_tables.shape
+    k = prefix_blocks.shape[0]
+    if prefix_len != k * cache.block_size:
+        raise ValueError(
+            f"prefix_len {prefix_len} must equal len(prefix_blocks) x "
+            f"block_size ({k} x {cache.block_size})"
+        )
+    if k > mb:
+        raise ValueError(
+            f"prefix spans {k} blocks but the row table holds {mb}"
+        )
+    need_total = -(-(prefix_len + extra_tokens) // cache.block_size)
+    ok = jnp.asarray(need_total <= mb)
+    # Pop the fresh blocks for the row's own suffix.
+    slots_idx = jnp.arange(mb, dtype=jnp.int32)
+    want = (slots_idx >= k) & (slots_idx < need_total)
+    popped, fresh, rc = _pop_blocks(cache, want)
+    ok = ok & (fresh <= cache.free_top)
+    rc = rc.at[prefix_blocks].add(1)
+    row_table = jnp.where(
+        slots_idx < k,
+        jnp.pad(prefix_blocks, (0, mb - k)),
+        jnp.where(want, popped, cache.block_tables[slot]),
+    )
+    new = cache._replace(
+        block_tables=cache.block_tables.at[slot].set(row_table),
+        length=cache.length.at[slot].set(prefix_len),
+        n_blocks=cache.n_blocks.at[slot].set(need_total),
+        free_top=cache.free_top - fresh,
+        refcount=rc,
+    )
+    return jax.tree_util.tree_map(
+        lambda a, o: jnp.where(ok, a, o), new, cache
+    ), ok
+
+
+def detach_row_keep_blocks(
+    cache: PagedKVCache, slot: int
+) -> Tuple[PagedKVCache, jax.Array, jax.Array]:
+    """Zero a row WITHOUT dropping its block ownership — the registry
+    half of prefix caching: the caller (a prefix registry) keeps the
+    returned (block_ids (MB,), n_blocks) as its handle, holding the
+    refcounts until it drops the prefix via drop_blocks. The row's slot
+    is immediately reusable."""
+    ids = cache.block_tables[slot]
+    n = cache.n_blocks[slot]
+    return cache._replace(
+        length=cache.length.at[slot].set(0),
+        n_blocks=cache.n_blocks.at[slot].set(0),
+    ), ids, n
+
+
+def drop_blocks(
+    cache: PagedKVCache, block_ids: jax.Array, count
+) -> PagedKVCache:
+    """Drop one ownership count from ``block_ids[:count]`` (a prefix
+    handle closing); blocks reaching refcount zero return to the free
+    stack."""
+    idx = jnp.arange(block_ids.shape[0])
+    return _free_blocks(cache, block_ids, idx < count)
 
 
 def _paged_write(pool_layer, tables, new, pos, active=None):

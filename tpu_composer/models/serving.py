@@ -43,6 +43,9 @@ import numpy as np
 from tpu_composer.models.decode import AnyConfig, sampling_key_schedule
 from tpu_composer.models.paged import (
     admit,
+    attach_prefix,
+    detach_row_keep_blocks,
+    drop_blocks,
     init_paged_cache,
     paged_decode_chunk,
     paged_decode_step,
@@ -71,6 +74,33 @@ class Request:
     top_k: int = 0          # 0 = off
     top_p: float = 1.0      # 1.0 = off
     seed: int = 0
+    prefix: Optional["PrefixHandle"] = None
+
+
+@dataclass
+class PrefixHandle:
+    """A shared prompt prefix (system prompt) cached ONCE in the pool:
+    every attached request's table opens with these blocks (refcounted —
+    the K/V bytes exist once however many requests share them), and the
+    per-request prefill work starts after the prefix. Obtained from
+    ContinuousBatchingEngine.register_prefix; close_prefix stops new
+    submits and drops the registry's reference — the blocks free only
+    when the LAST reference (registry, waiting, or in-flight request)
+    lets go, so a queued request can never attach to recycled blocks.
+
+    ``refs`` counts those references host-side (registry hold + every
+    not-yet-finished submitted request); the pool-level refcount tracks
+    only ATTACHED rows + one for the registry's whole lifetime."""
+
+    tokens: List[int]
+    block_ids: jax.Array
+    n_blocks: int
+    closed: bool = False
+    refs: int = 1  # the registry's own hold
+
+    @property
+    def n_tokens(self) -> int:
+        return len(self.tokens)
 
 
 def _bucket(n: int, floor: int = 8) -> int:
@@ -202,6 +232,7 @@ class ContinuousBatchingEngine:
         self._dummy_key = jax.random.key(0)
         self._waiting: Deque[Request] = deque()
         self._next_id = 0
+        self._prefix_reserved = 0  # blocks held by open prefix handles
         self._pick = jax.jit(_pick_rows)
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got "
@@ -232,7 +263,8 @@ class ContinuousBatchingEngine:
     # -- submission ----------------------------------------------------
     def submit(self, prompt: List[int], max_new_tokens: int,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 1.0, seed: int = 0) -> Request:
+               top_p: float = 1.0, seed: int = 0,
+               prefix: Optional[PrefixHandle] = None) -> Request:
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -241,12 +273,28 @@ class ContinuousBatchingEngine:
             raise ValueError(f"top_k must be >= 0 (0 = off), got {top_k}")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if prefix is not None:
+            if self.prefill_chunk is None:
+                raise ValueError(
+                    "prefix-attached requests need chunked admission "
+                    "(pass prefill_chunk): the remainder streams in "
+                    "after the shared blocks"
+                )
+            if prefix.closed:
+                raise ValueError("prefix handle is closed")
+            p_n = prefix.n_tokens
+            if prompt[:p_n] != prefix.tokens or len(prompt) <= p_n:
+                raise ValueError(
+                    "prompt must START with the prefix tokens and "
+                    "extend past them (the first-token logits come from "
+                    "the request's own suffix)"
+                )
         # Validate with the SAME math the scheduler reserves with (the
         # padded prompt length) — validating with the raw length would
         # accept requests the scheduler can never place, and head-of-line
         # FIFO would then livelock the whole queue.
-        pad = self._pad_len(len(prompt))
-        worst = _worst_blocks(pad, max_new_tokens, self.block_size)
+        pad = self._pad_len_req(prompt, prefix)
+        worst = self._worst_fresh_blocks(pad, max_new_tokens, prefix)
         cap = self.cache.capacity_per_row
         if worst > self.num_blocks or pad + max_new_tokens > cap:
             raise ValueError(
@@ -269,8 +317,10 @@ class ContinuousBatchingEngine:
             )
         req = Request(prompt=list(prompt), max_new_tokens=max_new_tokens,
                       req_id=self._next_id, temperature=temperature,
-                      top_k=top_k, top_p=top_p, seed=seed)
+                      top_k=top_k, top_p=top_p, seed=seed, prefix=prefix)
         self._next_id += 1
+        if prefix is not None:
+            prefix.refs += 1  # held until this request finishes/cancels
         self._waiting.append(req)
         return req
 
@@ -281,6 +331,123 @@ class ContinuousBatchingEngine:
         if self.prefill_chunk is not None:
             return -(-prompt_len // self.prefill_chunk) * self.prefill_chunk
         return _bucket(prompt_len)
+
+    def _pad_len_req(self, prompt: List[int],
+                     prefix: Optional[PrefixHandle]) -> int:
+        """Padded TOTAL length for a request: prefix (already cached,
+        block-aligned) + its remainder padded to chunk multiples."""
+        if prefix is None:
+            return self._pad_len(len(prompt))
+        return prefix.n_tokens + self._pad_len(
+            len(prompt) - prefix.n_tokens)
+
+    def _worst_fresh_blocks(self, pad_total: int, max_new: int,
+                            prefix: Optional[PrefixHandle]) -> int:
+        """Blocks the request itself will claim — the shared prefix
+        blocks are already paid for by the registry."""
+        worst = _worst_blocks(pad_total, max_new, self.block_size)
+        return worst - (prefix.n_blocks if prefix is not None else 0)
+
+    # -- shared prompt prefixes ---------------------------------------
+    def register_prefix(self, tokens: List[int]) -> PrefixHandle:
+        """Prefill ``tokens`` once into pool blocks and return a handle
+        requests can attach to (`submit(..., prefix=h)`): the prefix K/V
+        exists ONCE however many requests share it — the system-prompt
+        cache. Length must be a nonzero multiple of block_size (table
+        slots must keep their position meaning); MoE configs additionally
+        need a multiple of prefill_chunk (chunk pads would be routed).
+        Staging borrows a free slot for the prefill; the blocks then
+        detach into the handle and the slot frees immediately."""
+        p_n = len(tokens)
+        if p_n == 0 or p_n % self.block_size:
+            raise ValueError(
+                f"prefix length must be a nonzero multiple of "
+                f"block_size ({self.block_size}), got {p_n}"
+            )
+        k = p_n // self.block_size
+        slot = self._free_slot()
+        if slot is None:
+            raise RuntimeError("no free slot to stage the prefix prefill")
+        from tpu_composer.models.moe import MoEConfig
+
+        # Honor the host-side reservation discipline: free_top alone
+        # still shows in-flight rows' not-yet-claimed decode-growth
+        # blocks as free, and stealing them would make the engine's
+        # "unreachable" pool-exhausted error reachable.
+        staged = -(-(p_n if isinstance(self.config, MoEConfig)
+                     else self._pad_len(p_n)) // self.block_size)
+        if (int(self._reserved.sum()) + self._prefix_reserved + staged
+                > self.num_blocks):
+            raise RuntimeError(
+                "pool cannot hold the prefix alongside the blocks "
+                "reserved for in-flight requests"
+            )
+
+        if isinstance(self.config, MoEConfig):
+            c_sz = self.prefill_chunk
+            if p_n % c_sz:
+                raise ValueError(
+                    f"MoE prefixes must be a multiple of prefill_chunk "
+                    f"({c_sz}): chunk pads would be routed"
+                )
+            onehot = jnp.zeros((self.slots,), jnp.int32).at[slot].set(1)
+            cache, ok = admit(
+                self.cache, onehot, onehot * p_n)
+            if not bool(ok):
+                raise RuntimeError("pool cannot hold the prefix")
+            self.cache = cache
+            arr = np.asarray(tokens, np.int32)
+            for i in range(p_n // c_sz):
+                chunk = np.zeros((self.slots, c_sz), np.int32)
+                chunk[slot] = arr[i * c_sz:(i + 1) * c_sz]
+                _, cache, ok = self._chunk(
+                    self.params, self.cache, jnp.asarray(chunk),
+                    active=jnp.zeros((self.slots,), bool).at[slot].set(
+                        True),
+                )
+                if not bool(ok):
+                    raise RuntimeError("pool cannot hold the prefix")
+                self.cache = cache
+        else:
+            pad = self._pad_len(p_n)
+            buf = np.zeros((1, pad), np.int32)
+            buf[0, :p_n] = tokens
+            _, cache, ok = self._prefill(
+                self.params, jnp.asarray(buf), cache=self.cache,
+                slot_ids=jnp.array([slot], jnp.int32),
+                prompt_lens=jnp.array([p_n], jnp.int32),
+            )
+            if not bool(ok):
+                raise RuntimeError("pool cannot hold the prefix")
+            self.cache = cache
+        self.cache, ids, n_total = detach_row_keep_blocks(self.cache, slot)
+        n_total = int(n_total)
+        if n_total > k:  # bucket-pad blocks past the prefix: free them
+            self.cache = drop_blocks(self.cache, ids[k:], n_total - k)
+        self._prefix_reserved += k
+        return PrefixHandle(tokens=list(tokens),
+                            block_ids=jnp.asarray(ids[:k]), n_blocks=k)
+
+    def _release_handle_ref(self, handle: PrefixHandle) -> None:
+        handle.refs -= 1
+        if handle.refs == 0:
+            # Last reference anywhere (registry AND every submitted
+            # request): only now may the pool's registry-held refcount
+            # drop and the reservation shrink — freeing at close time
+            # would let a decoding row recycle blocks a QUEUED request
+            # still expects to attach to.
+            self.cache = drop_blocks(self.cache, handle.block_ids,
+                                     handle.n_blocks)
+            self._prefix_reserved -= handle.n_blocks
+
+    def close_prefix(self, handle: PrefixHandle) -> None:
+        """Stop new submits against the handle and drop the registry's
+        reference; blocks free once the last submitted request finishes
+        (or immediately when none reference it)."""
+        if handle.closed:
+            return
+        handle.closed = True
+        self._release_handle_ref(handle)
 
     # -- scheduling ----------------------------------------------------
     def _free_slot(self) -> Optional[int]:
@@ -301,30 +468,45 @@ class ContinuousBatchingEngine:
         if slot is None:
             return []
         req = self._waiting[0]
-        pad = self._pad_len(len(req.prompt))
-        worst = _worst_blocks(pad, req.max_new_tokens, self.block_size)
-        if int(self._reserved.sum()) + worst > self.num_blocks:
+        pad = self._pad_len_req(req.prompt, req.prefix)
+        worst = self._worst_fresh_blocks(pad, req.max_new_tokens,
+                                         req.prefix)
+        if (int(self._reserved.sum()) + self._prefix_reserved + worst
+                > self.num_blocks):
             return []  # head-of-line blocks; FIFO fairness, no starvation
         self._waiting.popleft()
         if self.prefill_chunk is not None:
             # Chunked admission: reserve the blocks now (admit-only), then
             # stream the prompt one chunk per engine step. No token yet —
             # the last chunk's logits produce it in _advance_admission.
-            cache, ok = admit(
-                self.cache,
-                jnp.zeros((self.slots,), jnp.int32).at[slot].set(1),
-                jnp.zeros((self.slots,), jnp.int32).at[slot].set(pad),
-            )
+            # A prefix-attached row opens with the shared blocks
+            # (co-owned, refcount +1) and streams only its REMAINDER —
+            # the prefix K/V is already in the pool.
+            if req.prefix is not None:
+                p_n = req.prefix.n_tokens
+                cache, ok = attach_prefix(
+                    self.cache, slot, req.prefix.block_ids, p_n,
+                    extra_tokens=pad - p_n,
+                )
+                tail = req.prompt[p_n:]
+            else:
+                cache, ok = admit(
+                    self.cache,
+                    jnp.zeros((self.slots,), jnp.int32).at[slot].set(1),
+                    jnp.zeros((self.slots,), jnp.int32).at[slot].set(pad),
+                )
+                tail = req.prompt
             if not bool(ok):  # host reservation should make this unreachable
                 self._waiting.appendleft(req)
                 return []
             self.cache = cache
             self._slot_req[slot] = req
             self._reserved[slot] = worst
-            padded = np.zeros(pad, np.int32)
-            padded[:len(req.prompt)] = req.prompt
+            padded = np.zeros(self._pad_len(len(tail)), np.int32)
+            padded[:len(tail)] = tail
             self._admitting.append({"slot": slot, "req": req,
-                                    "consumed": 0, "padded": padded})
+                                    "consumed": 0, "padded": padded,
+                                    "tail": len(tail)})
             return []
         tokens = np.zeros((1, pad), np.int32)
         tokens[0, :len(req.prompt)] = req.prompt
@@ -404,14 +586,20 @@ class ContinuousBatchingEngine:
         self.cache = self.cache._replace(
             length=self.cache.length.at[slot].set(real))
         self._arm_sampling(slot, req)
+        # The streamed content is the request's TAIL (everything after a
+        # shared prefix; the whole prompt without one): its last real
+        # token's logits sit at tail-relative offset (tail-1) % chunk.
         first = self._pick_first(
-            slot, logits[slot:slot + 1, (real - 1) % c_sz])
+            slot, logits[slot:slot + 1, (st["tail"] - 1) % c_sz])
         self._emit(slot, first)
         return [(req.req_id, first)]
 
     def _free(self, slot: int) -> None:
         """Release a slot's blocks and zero its per-slot state — the one
-        teardown used by completion and cancellation alike."""
+        teardown used by completion and cancellation alike. A prefix-
+        attached row also drops its handle reference (release() already
+        decremented the pool refcounts, shared blocks included)."""
+        req = self._slot_req[slot]
         self.cache = release(
             self.cache,
             jnp.zeros((self.slots,), jnp.int32).at[slot].set(1),
@@ -422,6 +610,8 @@ class ContinuousBatchingEngine:
         self._topk[slot] = 0
         self._topp[slot] = 1.0
         self._slot_keys[slot] = None
+        if req is not None and req.prefix is not None:
+            self._release_handle_ref(req.prefix)
 
     def _emit(self, slot: int, token: int) -> None:
         req = self._slot_req[slot]
@@ -443,6 +633,8 @@ class ContinuousBatchingEngine:
         req.done = True
         try:
             self._waiting.remove(req)
+            if req.prefix is not None:
+                self._release_handle_ref(req.prefix)
             return True
         except ValueError:
             pass  # not waiting: it occupies a slot
