@@ -53,6 +53,15 @@ def pytest_configure(config):
         "markers",
         "tpu: requires real TPU hardware (run with TPUC_TESTS_ON_TPU=1)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running suite, excluded from tier-1 (`-m 'not slow'`)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection soak driven by fabric/chaos.py (always also"
+        " marked slow; run with `-m chaos`)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,148 @@
+"""Chaos soak: the full operator under sustained injected fabric flakes.
+
+ISSUE-1 acceptance: 100 attach/detach cycles at a 10% injected transient
+failure rate must complete with zero stuck resources and zero duplicate
+fabric attachments. The chaos decorator (fabric/chaos.py) injects failures
+between the controllers and the pool — exactly where wire flakes live — and
+the breaker + jittered-backoff + budget machinery has to absorb them.
+
+Marked slow+chaos: excluded from tier-1 (`-m 'not slow'`); run explicitly
+with `pytest -m chaos`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.controllers.request_controller import (
+    ComposabilityRequestReconciler,
+    RequestTiming,
+)
+from tpu_composer.controllers.resource_controller import (
+    ComposableResourceReconciler,
+    ResourceTiming,
+)
+from tpu_composer.controllers.syncer import UpstreamSyncer
+from tpu_composer.fabric.breaker import BreakerConfig, BreakerFabricProvider
+from tpu_composer.fabric.chaos import ChaosFabricProvider
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.store import Store
+
+LANES = 4
+CYCLES_PER_LANE = 25  # 4 x 25 = 100 attach/detach cycles
+FAILURE_RATE = 0.10
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_100_cycles_at_10pct_transient_failure_rate():
+    store = Store()
+    for i in range(8):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 8
+        store.create(n)
+    pool = InMemoryPool(chips={"tpu-v4": 64})
+    chaos = ChaosFabricProvider(pool, failure_rate=FAILURE_RATE, seed=1337)
+    # Production-shaped wrapping, tuned so random 10% noise keeps flowing:
+    # a breaker trip or quarantine needs a consecutive-failure streak that
+    # is vanishingly unlikely at p=0.1 — if one happens anyway, reallocation
+    # must still drain the cycle rather than wedge it.
+    fabric = BreakerFabricProvider(
+        chaos, endpoint="chaos-pool",
+        config=BreakerConfig(failure_threshold=8, reset_timeout=0.5),
+    )
+    agent = FakeNodeAgent(pool=pool)
+    mgr = Manager(store, health_addr="127.0.0.1:0")
+    mgr.add_controller(ComposabilityRequestReconciler(
+        store, fabric,
+        timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.02,
+                             running_poll=5.0)))
+    mgr.add_controller(ComposableResourceReconciler(
+        store, fabric, agent,
+        timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.02,
+                              detach_poll=0.05, detach_fast=0.02,
+                              busy_poll=0.05, attach_budget=12)))
+    mgr.add_runnable(UpstreamSyncer(store, fabric, period=0.1, grace=0.5))
+    mgr.start(workers_per_controller=2)
+
+    fails: list = []
+
+    def check_no_duplicate_attachments() -> None:
+        ids = [d.device_id for d in pool.get_resources()]
+        if len(ids) != len(set(ids)):
+            dupes = sorted(d for d in ids if ids.count(d) > 1)
+            fails.append(f"duplicate fabric attachments: {dupes[:8]}")
+
+    def cycle(i: int) -> None:
+        name = f"chaos-{i}"
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name=name),
+            spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                type="tpu", model="tpu-v4", size=4)),
+        ))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            r = store.try_get(ComposabilityRequest, name)
+            if r is not None and r.status.state == "Running":
+                break
+            time.sleep(0.01)
+        else:
+            fails.append(f"{name}: never Running (stuck attach)")
+            return
+        check_no_duplicate_attachments()
+        store.delete(ComposabilityRequest, name)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if store.try_get(ComposabilityRequest, name) is None:
+                return
+            time.sleep(0.01)
+        fails.append(f"{name}: teardown never completed (stuck detach)")
+
+    try:
+        lanes = []
+        for lane in range(LANES):
+            def run(lane=lane):
+                for j in range(CYCLES_PER_LANE):
+                    i = lane * CYCLES_PER_LANE + j
+                    try:
+                        cycle(i)
+                    except Exception as e:  # noqa: BLE001 - a dead lane must FAIL
+                        fails.append(f"chaos-{i}: lane crashed: {e!r}")
+                        return
+
+            t = threading.Thread(target=run)
+            t.start()
+            lanes.append(t)
+        for t in lanes:
+            t.join()
+        # Settle: syncer reclaim + any in-flight detaches.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if (pool.free_chips("tpu-v4") == 64
+                    and not store.list(ComposableResource)):
+                break
+            time.sleep(0.05)
+    finally:
+        mgr.stop()
+
+    assert not fails, fails[:10]
+    assert chaos.injected > 0, "chaos never fired — the soak proved nothing"
+    # Zero stuck resources, zero leaked/duplicate attachments.
+    assert pool.free_chips("tpu-v4") == 64
+    assert pool.get_resources() == []
+    leftovers = [k for k in store.keys()
+                 if k[0] in ("ComposabilityRequest", "ComposableResource")]
+    assert leftovers == [], leftovers[:10]

@@ -493,12 +493,17 @@ class TestWireFaultMatrix:
         w = remote_world
         make_tpu_cr(w)
         to_online(w)
-        # Break whatever GET the dialect's check_resource uses.
+        # Break whatever GET the dialect's check_resource uses. A single
+        # 503 would be absorbed by the transport's idempotent-GET retry
+        # (fabric/httpx.py, docs/RESILIENCE.md) — inject enough consecutive
+        # failures to exhaust the retry budget so the error SURFACES.
         for method, prefix in {("GET", "/v1/attachments"),
                                ("GET", "/redfish/v1/Systems")}:
-            w.server.fail_next(method, prefix, 503)
+            for _ in range(4):
+                w.server.fail_next(method, prefix, 503)
         with pytest.raises(FabricError):
             w.rec.reconcile("r0")
+        w.server._forced_failures.clear()  # heal before the recovery pass
         cr = get(w)
         assert cr.status.state == RESOURCE_STATE_ONLINE
         assert cr.status.error != ""

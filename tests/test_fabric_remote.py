@@ -308,14 +308,28 @@ class TestAdapterFactory:
     def test_rest_backends(self, server, monkeypatch):
         monkeypatch.setenv("FABRIC_ENDPOINT", server.url)
         monkeypatch.delenv("FABRIC_AUTH_URL", raising=False)
+        # Remote providers come back behind the per-endpoint breaker
+        # (fabric/breaker.py); unwrap to assert the backend selection.
+        from tpu_composer.fabric.breaker import BreakerFabricProvider
+
+        def unwrap(p):
+            assert isinstance(p, BreakerFabricProvider)
+            return p._inner
+
         cm = new_fabric_provider("REST_CM")
-        assert isinstance(cm, RestPoolClient) and not cm.synchronous
+        assert isinstance(unwrap(cm), RestPoolClient) and not cm.synchronous
         fm = new_fabric_provider("REST_FM")
-        assert isinstance(fm, RestPoolClient) and fm.synchronous
-        assert isinstance(new_fabric_provider("LAYOUT"), LayoutApplyClient)
-        assert isinstance(new_fabric_provider("REDFISH"), RedfishClient)
+        assert isinstance(unwrap(fm), RestPoolClient) and fm.synchronous
+        assert isinstance(unwrap(new_fabric_provider("LAYOUT")), LayoutApplyClient)
+        assert isinstance(unwrap(new_fabric_provider("REDFISH")), RedfishClient)
         # And they actually work end-to-end through the factory.
         assert cm.add_resource(make_resource(name="factory-0")).device_ids
+
+    def test_breaker_opt_out(self, server, monkeypatch):
+        monkeypatch.setenv("FABRIC_ENDPOINT", server.url)
+        monkeypatch.delenv("FABRIC_AUTH_URL", raising=False)
+        monkeypatch.setenv("TPU_COMPOSER_BREAKER", "0")
+        assert isinstance(new_fabric_provider("REST_CM"), RestPoolClient)
 
     def test_missing_endpoint_rejected(self, monkeypatch):
         monkeypatch.delenv("FABRIC_ENDPOINT", raising=False)

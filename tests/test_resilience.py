@@ -1,0 +1,1005 @@
+"""Fabric resilience layer: error taxonomy, circuit breaker, attach budgets,
+quarantine + automatic reallocation (docs/RESILIENCE.md).
+
+The tier-1 acceptance spine lives here: persistent injected attach failures
+on one host trip that host's breaker, exhaust the resource's attach budget,
+quarantine the node, and the owning ComposabilityRequest STILL reaches
+Running by reallocating onto healthy capacity — with the breaker/quarantine
+metrics visible in Registry.expose_text(). The long soaks are in
+test_chaos_soak.py (marked slow/chaos); everything here runs in tier-1
+under JAX_PLATFORMS=cpu.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.agent.publisher import (
+    DevicePublisher,
+    node_quarantine_name,
+    node_quarantined,
+)
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    ComposableResourceSpec,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.dra import DeviceTaintRule
+from tpu_composer.api.types import (
+    REQUEST_STATE_RUNNING,
+    RESOURCE_STATE_ONLINE,
+)
+from tpu_composer.controllers.request_controller import (
+    AllocationError,
+    ComposabilityRequestReconciler,
+)
+from tpu_composer.controllers.resource_controller import (
+    ComposableResourceReconciler,
+    ResourceTiming,
+)
+from tpu_composer.controllers.syncer import UpstreamSyncer
+from tpu_composer.fabric.breaker import (
+    BreakerConfig,
+    BreakerFabricProvider,
+    BreakerOpenError,
+    CircuitBreaker,
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+)
+from tpu_composer.fabric.chaos import ChaosFabricProvider
+from tpu_composer.fabric.httpx import (
+    HttpStatusError,
+    JsonHttpClient,
+    TransientHttpStatusError,
+    fabric_timeout,
+)
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.fabric.provider import (
+    FabricError,
+    TransientFabricError,
+    WaitingDeviceAttaching,
+    classify_fabric_error,
+)
+from tpu_composer.runtime.metrics import (
+    fabric_breaker_trips_total,
+    global_registry,
+    resources_quarantined_total,
+)
+from tpu_composer.runtime.queue import RateLimitingQueue
+from tpu_composer.runtime.store import Store
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class MidpointRng:
+    """random() == 0.5 — makes the breaker's ±20% reset jitter exact."""
+
+    def random(self) -> float:
+        return 0.5
+
+    def uniform(self, a: float, b: float) -> float:
+        return (a + b) / 2
+
+
+# ---------------------------------------------------------------------------
+# Error taxonomy (fabric/provider.py + fabric/httpx.py)
+# ---------------------------------------------------------------------------
+
+class TestTaxonomy:
+    def test_transient_is_fabric_error(self):
+        assert issubclass(TransientFabricError, FabricError)
+        assert issubclass(BreakerOpenError, TransientFabricError)
+        assert issubclass(TransientHttpStatusError, HttpStatusError)
+        assert issubclass(TransientHttpStatusError, TransientFabricError)
+
+    def test_classify_preserves_transience(self):
+        t = classify_fabric_error(TransientFabricError("x"), "attach r0: x")
+        assert isinstance(t, TransientFabricError)
+        p = classify_fabric_error(FabricError("x"), "attach r0: x")
+        assert isinstance(p, FabricError) and not isinstance(p, TransientFabricError)
+
+    def test_connection_refused_is_typed_transient(self):
+        # Nothing listens on this port: urllib's URLError must surface as a
+        # typed TransientFabricError, never a raw urllib exception.
+        client = JsonHttpClient("http://127.0.0.1:9", get_retries=0, timeout=0.5)
+        with pytest.raises(TransientFabricError):
+            client.request("PUT", "/v1/x", {})
+
+    def test_5xx_transient_4xx_terminal(self):
+        from tests.fake_fabric import FakeFabricServer
+
+        srv = FakeFabricServer()
+        try:
+            client = JsonHttpClient(srv.url, get_retries=0)
+            srv.fail_next("GET", "/v1/attachments", 503)
+            with pytest.raises(TransientFabricError):
+                client.request("GET", "/v1/attachments")
+            srv.fail_next("GET", "/v1/attachments", 400)
+            with pytest.raises(HttpStatusError) as ei:
+                client.request("GET", "/v1/attachments")
+            assert not isinstance(ei.value, TransientFabricError)
+        finally:
+            srv.close()
+
+    def test_idempotent_get_retried_with_jitter(self):
+        from tests.fake_fabric import FakeFabricServer
+
+        srv = FakeFabricServer()
+        sleeps = []
+        try:
+            client = JsonHttpClient(
+                srv.url, get_retries=2, _sleep=sleeps.append,
+                _rng=random.Random(3),
+            )
+            srv.fail_next("GET", "/v1/attachments", 502)
+            status, payload = client.request("GET", "/v1/attachments")
+            assert status == 200 and payload == {"attachments": []}
+            assert len(sleeps) == 1 and sleeps[0] > 0
+        finally:
+            srv.close()
+
+    def test_mutating_verbs_never_retried(self):
+        from tests.fake_fabric import FakeFabricServer
+
+        srv = FakeFabricServer()
+        try:
+            client = JsonHttpClient(srv.url, get_retries=2, _sleep=lambda s: None)
+            srv.fail_next("PUT", "/v1/slices", 502)
+            with pytest.raises(TransientFabricError):
+                client.request("PUT", "/v1/slices/s1",
+                               {"model": "tpu-v4", "topology": "2x2x1",
+                                "nodes": ["w0"]})
+            # The single 502 was consumed by the one (unretried) attempt.
+            assert sum(1 for r in srv.request_log if r.startswith("PUT")) == 1
+        finally:
+            srv.close()
+
+    def test_malformed_response_is_typed_transient(self):
+        """A dying proxy/LB answering with a garbage status line raises
+        http.client.BadStatusLine — it must surface as a typed transient
+        (endpoint-reachability) fault, not leak raw or read as 'the
+        endpoint answered' to the breaker."""
+        import socket as socketlib
+        import threading
+
+        srv = socketlib.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def garbage_server():
+            conn, _ = srv.accept()
+            conn.recv(4096)
+            conn.sendall(b"this is not http\r\n\r\n")
+            conn.close()
+
+        t = threading.Thread(target=garbage_server, daemon=True)
+        t.start()
+        try:
+            client = JsonHttpClient(
+                f"http://127.0.0.1:{port}", get_retries=0, timeout=5)
+            with pytest.raises(TransientFabricError):
+                client.request("PUT", "/v1/x", {})
+        finally:
+            t.join(timeout=5)
+            srv.close()
+
+    def test_timeout_env_override(self, monkeypatch):
+        monkeypatch.setenv("TPU_COMPOSER_FABRIC_TIMEOUT", "7.5")
+        assert fabric_timeout(60.0) == 7.5
+        monkeypatch.setenv("TPU_COMPOSER_FABRIC_TIMEOUT", "bogus")
+        assert fabric_timeout(60.0) == 60.0
+        monkeypatch.delenv("TPU_COMPOSER_FABRIC_TIMEOUT")
+        assert fabric_timeout(60.0) == 60.0
+
+    def test_timeout_env_reaches_all_backends(self, monkeypatch):
+        from tpu_composer.fabric.layout import LayoutApplyClient
+        from tpu_composer.fabric.redfish import RedfishClient
+        from tpu_composer.fabric.rest import RestPoolClient
+
+        monkeypatch.setenv("TPU_COMPOSER_FABRIC_TIMEOUT", "3.25")
+        monkeypatch.delenv("FABRIC_AUTH_URL", raising=False)
+        for client in (
+            RestPoolClient("http://x", token_cache=None),
+            LayoutApplyClient("http://x", token_cache=None),
+            RedfishClient("http://x", token_cache=None),
+        ):
+            assert client._http.timeout == 3.25
+        # An explicit constructor timeout still wins over the env.
+        assert RedfishClient("http://x", token_cache=None,
+                             timeout=9.0)._http.timeout == 9.0
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker state machine
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def make(self, threshold=2, reset=10.0):
+        clock = FakeClock()
+        br = CircuitBreaker(
+            "ep", "w0",
+            BreakerConfig(failure_threshold=threshold, reset_timeout=reset),
+            clock=clock, rng=MidpointRng(),
+        )
+        return br, clock
+
+    def fail_once(self, br):
+        br.acquire()
+        br.failure()
+
+    def test_trips_after_consecutive_failures(self):
+        br, _ = self.make(threshold=3)
+        for _ in range(2):
+            self.fail_once(br)
+        assert br.state == STATE_CLOSED
+        self.fail_once(br)
+        assert br.state == STATE_OPEN
+        with pytest.raises(BreakerOpenError):
+            br.acquire()
+
+    def test_success_resets_streak(self):
+        br, _ = self.make(threshold=2)
+        self.fail_once(br)
+        br.acquire()
+        br.success()
+        self.fail_once(br)
+        assert br.state == STATE_CLOSED  # streak broken, never reached 2
+
+    def test_half_open_probe_success_closes(self):
+        br, clock = self.make(threshold=1, reset=10.0)
+        self.fail_once(br)
+        assert br.state == STATE_OPEN
+        clock.t = 9.9  # MidpointRng -> open_until is exactly t+10
+        with pytest.raises(BreakerOpenError):
+            br.acquire()
+        clock.t = 10.1
+        br.acquire()
+        assert br.state == STATE_HALF_OPEN
+        # Only one probe admitted while its outcome is pending.
+        with pytest.raises(BreakerOpenError):
+            br.acquire()
+        br.success()
+        assert br.state == STATE_CLOSED
+
+    def test_half_open_probe_failure_reopens(self):
+        br, clock = self.make(threshold=1, reset=10.0)
+        self.fail_once(br)
+        trips_before = fabric_breaker_trips_total.value(endpoint="ep", scope="w0")
+        clock.t = 10.1
+        br.acquire()
+        br.failure()
+        assert br.state == STATE_OPEN
+        assert fabric_breaker_trips_total.value(
+            endpoint="ep", scope="w0"
+        ) == trips_before + 1
+        # A fresh reset window applies from the re-trip.
+        clock.t = 15.0
+        with pytest.raises(BreakerOpenError):
+            br.acquire()
+
+    def test_cancel_releases_probe_slot(self):
+        br, clock = self.make(threshold=1, reset=10.0)
+        self.fail_once(br)
+        clock.t = 10.1
+        br.acquire()
+        br.cancel()  # the call never ran (sibling breaker rejected it)
+        br.acquire()  # slot free again — no starvation
+        br.success()
+        assert br.state == STATE_CLOSED
+
+
+class TestBreakerFabricProvider:
+    def make_world(self, **cfg):
+        pool = InMemoryPool(chips={"gpu-a100": 8})
+        chaos = ChaosFabricProvider(pool)
+        config = BreakerConfig(**{"failure_threshold": 2, "reset_timeout": 30.0,
+                                  **cfg})
+        fabric = BreakerFabricProvider(
+            chaos, endpoint="mock-pool", config=config,
+            clock=FakeClock(), rng=MidpointRng(),
+        )
+        return pool, chaos, fabric
+
+    @staticmethod
+    def gpu(name, node):
+        return ComposableResource(
+            metadata=ObjectMeta(name=name),
+            spec=ComposableResourceSpec(type="gpu", model="gpu-a100",
+                                        target_node=node),
+        )
+
+    def test_flaky_node_trips_only_its_own_breaker(self):
+        pool, chaos, fabric = self.make_world()
+        chaos.fail_node("w0")
+        for _ in range(2):
+            with pytest.raises(TransientFabricError):
+                fabric.add_resource(self.gpu("r0", "w0"))
+        assert fabric.breaker("w0").state == STATE_OPEN
+        assert fabric.breaker().state == STATE_CLOSED
+        # w0 now fails FAST without touching the fabric...
+        calls_before = chaos.calls
+        with pytest.raises(BreakerOpenError):
+            fabric.add_resource(self.gpu("r0", "w0"))
+        assert chaos.calls == calls_before
+        # ...while healthy nodes and endpoint-scoped verbs flow normally.
+        assert fabric.add_resource(self.gpu("r1", "w1")).device_ids
+        assert fabric.get_resources()
+
+    def test_blackout_trips_endpoint_breaker(self):
+        pool, chaos, fabric = self.make_world(
+            failure_threshold=2, endpoint_failure_threshold=3)
+        chaos.blackout()
+        for _ in range(3):
+            with pytest.raises(TransientFabricError):
+                fabric.get_resources()
+        assert fabric.breaker().state == STATE_OPEN
+        calls_before = chaos.calls
+        with pytest.raises(BreakerOpenError):
+            fabric.get_resources()
+        with pytest.raises(BreakerOpenError):
+            fabric.add_resource(self.gpu("r0", "w9"))  # endpoint gate
+        assert chaos.calls == calls_before
+
+    def test_wait_sentinels_and_terminal_errors_do_not_trip(self):
+        pool = InMemoryPool(chips={"gpu-a100": 1}, async_steps=3)
+        fabric = BreakerFabricProvider(
+            pool, endpoint="mock-pool",
+            config=BreakerConfig(failure_threshold=1),
+        )
+        with pytest.raises(WaitingDeviceAttaching):
+            fabric.add_resource(self.gpu("r0", "w0"))
+        assert fabric.breaker("w0").state == STATE_CLOSED
+        with pytest.raises(FabricError):  # terminal: unknown model
+            fabric.add_resource(ComposableResource(
+                metadata=ObjectMeta(name="r1"),
+                spec=ComposableResourceSpec(type="gpu", model="nope",
+                                            target_node="w0"),
+            ))
+        assert fabric.breaker("w0").state == STATE_CLOSED
+
+    def test_forget_node_drops_breaker_and_metrics(self):
+        from tpu_composer.runtime.metrics import fabric_breaker_state
+
+        pool, chaos, fabric = self.make_world()
+        chaos.fail_node("w0")
+        for _ in range(2):
+            with pytest.raises(TransientFabricError):
+                fabric.add_resource(self.gpu("r0", "w0"))
+        assert "w0" in fabric._node_breakers
+        key = (("endpoint", "mock-pool"), ("scope", "w0"))
+        assert key in fabric_breaker_state._values
+        fabric.forget_node("w0")
+        assert "w0" not in fabric._node_breakers
+        assert key not in fabric_breaker_state._values  # series retired
+        # A recreated same-name node starts with a fresh closed breaker.
+        assert fabric.breaker("w0").state == STATE_CLOSED
+
+    def test_recovery_closes_after_reset_timeout(self):
+        pool, chaos, fabric = self.make_world(reset_timeout=10.0)
+        clock = fabric._clock
+        chaos.fail_node("w0")
+        for _ in range(2):
+            with pytest.raises(TransientFabricError):
+                fabric.add_resource(self.gpu("r0", "w0"))
+        chaos.heal_node("w0")
+        with pytest.raises(BreakerOpenError):
+            fabric.add_resource(self.gpu("r0", "w0"))
+        clock.t = 10.1  # half-open probe passes through and closes
+        assert fabric.add_resource(self.gpu("r0", "w0")).device_ids
+        assert fabric.breaker("w0").state == STATE_CLOSED
+
+
+# ---------------------------------------------------------------------------
+# Chaos provider
+# ---------------------------------------------------------------------------
+
+class TestChaosProvider:
+    def test_scripted_node_failures_then_heal(self):
+        pool = InMemoryPool(chips={"gpu-a100": 4})
+        chaos = ChaosFabricProvider(pool)
+        res = TestBreakerFabricProvider.gpu("r0", "w0")
+        chaos.fail_node("w0", times=2)
+        for _ in range(2):
+            with pytest.raises(TransientFabricError):
+                chaos.add_resource(res)
+        assert chaos.add_resource(res).device_ids  # scripted count exhausted
+        assert chaos.injected == 2
+
+    def test_probabilistic_rate_is_seeded(self):
+        pool = InMemoryPool(chips={"gpu-a100": 4})
+        chaos = ChaosFabricProvider(pool, failure_rate=0.5, seed=42)
+        outcomes = []
+        for _ in range(50):
+            try:
+                chaos.get_resources()
+                outcomes.append(True)
+            except TransientFabricError:
+                outcomes.append(False)
+        assert 10 < sum(outcomes) < 40  # ~50% either way
+        chaos2 = ChaosFabricProvider(InMemoryPool(), failure_rate=0.5, seed=42)
+        outcomes2 = []
+        for _ in range(50):
+            try:
+                chaos2.get_resources()
+                outcomes2.append(True)
+            except TransientFabricError:
+                outcomes2.append(False)
+        assert outcomes == outcomes2  # reproducible by seed
+
+    def test_blackout_and_latency(self):
+        sleeps = []
+        pool = InMemoryPool(chips={"gpu-a100": 4})
+        chaos = ChaosFabricProvider(pool, latency=0.25, sleep=sleeps.append)
+        chaos.blackout()
+        with pytest.raises(TransientFabricError):
+            chaos.get_resources()
+        chaos.heal()
+        assert chaos.get_resources() == []
+        assert sleeps == [0.25, 0.25]
+
+
+# ---------------------------------------------------------------------------
+# Attach budget + quarantine (resource controller)
+# ---------------------------------------------------------------------------
+
+def make_world(nodes=3, budget=3, breaker=None, chips=64):
+    store = Store()
+    for i in range(nodes):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 8
+        n.status.milli_cpu = 8000
+        n.status.memory = 64 << 30
+        n.status.allowed_pod_number = 100
+        store.create(n)
+    pool = InMemoryPool(chips={"tpu-v4": chips})
+    chaos = ChaosFabricProvider(pool)
+    fabric = breaker(chaos) if breaker else chaos
+    agent = FakeNodeAgent(pool=pool)
+    req_rec = ComposabilityRequestReconciler(store, fabric)
+    res_rec = ComposableResourceReconciler(
+        store, fabric, agent, timing=ResourceTiming(attach_budget=budget)
+    )
+    return store, pool, chaos, fabric, req_rec, res_rec
+
+
+def make_cr(store, pool, name="r0", node="worker-0"):
+    pool.reserve_slice("s1", "tpu-v4", "2x2x1", [node])
+    return store.create(ComposableResource(
+        metadata=ObjectMeta(name=name),
+        spec=ComposableResourceSpec(
+            type="tpu", model="tpu-v4", target_node=node, chip_count=4,
+            slice_name="s1", worker_id=0, topology="2x2x1",
+        ),
+    ))
+
+
+def pump(store, req_rec, res_rec, name="req-1", steps=60,
+         want_state=REQUEST_STATE_RUNNING):
+    """Reconcile both controllers, absorbing the expected fabric errors the
+    way the manager's worker loop does (backoff requeue)."""
+    for _ in range(steps):
+        try:
+            req_rec.reconcile(name)
+        except FabricError:
+            pass
+        for c in store.list(ComposableResource):
+            try:
+                res_rec.reconcile(c.metadata.name)
+            except FabricError:
+                pass
+        req = store.get(ComposabilityRequest, name)
+        if req.status.state == want_state:
+            return req
+    raise AssertionError(
+        f"{name} never reached {want_state}:"
+        f" {store.get(ComposabilityRequest, name).status.to_dict()}"
+    )
+
+
+class TestAttachBudget:
+    def test_attempts_count_and_reset_on_success(self):
+        store, pool, chaos, fabric, _, res_rec = make_world(budget=5)
+        make_cr(store, pool)
+        res_rec.reconcile("r0")  # "" -> Attaching
+        chaos.fail_node("worker-0", times=2)
+        for want in (1, 2):
+            with pytest.raises(TransientFabricError):
+                res_rec.reconcile("r0")
+            assert res_rec._attach_streaks["r0"] == want
+            cr = store.get(ComposableResource, "r0")
+            # Persisted only when the error message changes (identical
+            # repeat failures must NOT write status — a per-failure write
+            # would self-trigger an immediate requeue and defeat backoff).
+            assert cr.status.attach_attempts == 1
+            assert cr.status.error
+        res_rec.reconcile("r0")  # healed
+        cr = store.get(ComposableResource, "r0")
+        assert cr.status.state == RESOURCE_STATE_ONLINE
+        assert cr.status.attach_attempts == 0
+        assert "r0" not in res_rec._attach_streaks
+        assert not cr.status.quarantined
+
+    def test_wait_sentinel_resets_attempt_streak(self):
+        """A WaitingDeviceAttaching answer is evidence the fabric is serving
+        this node: wire flakes sprinkled across a long async attach must not
+        sum to a quarantine (the budget counts CONSECUTIVE failures)."""
+        store, pool, chaos, fabric, _, res_rec = make_world(budget=3)
+        pool._async_steps = 3  # CM-flavor: several waiting polls per attach
+        make_cr(store, pool)
+        res_rec.reconcile("r0")  # "" -> Attaching
+        chaos.fail_node("worker-0", times=2)
+        for _ in range(2):
+            with pytest.raises(TransientFabricError):
+                res_rec.reconcile("r0")
+        assert res_rec._attach_streaks["r0"] == 2
+        res_rec.reconcile("r0")  # healed -> waiting sentinel
+        assert "r0" not in res_rec._attach_streaks
+        assert store.get(ComposableResource, "r0").status.attach_attempts == 0
+        # Two more flakes mid-wait still stay under the budget: no quarantine.
+        chaos.fail_node("worker-0", times=2)
+        for _ in range(2):
+            with pytest.raises(TransientFabricError):
+                res_rec.reconcile("r0")
+        for _ in range(4):
+            res_rec.reconcile("r0")
+        cr = store.get(ComposableResource, "r0")
+        assert cr.status.state == RESOURCE_STATE_ONLINE
+        assert not cr.status.quarantined
+
+    def test_endpoint_outage_does_not_burn_node_budgets(self):
+        """A dark fabric manager must NOT quarantine the fleet: endpoint-
+        scoped breaker rejections carry no evidence against any node, so
+        they bypass the attach budget entirely."""
+        clock = FakeClock()
+        store, pool, chaos, fabric, _, res_rec = make_world(
+            budget=3,
+            breaker=lambda inner: BreakerFabricProvider(
+                inner, endpoint="mock-pool",
+                config=BreakerConfig(failure_threshold=50,
+                                     endpoint_failure_threshold=1,
+                                     reset_timeout=60.0),
+                clock=clock, rng=MidpointRng(),
+            ),
+        )
+        make_cr(store, pool)
+        res_rec.reconcile("r0")
+        chaos.blackout()
+        with pytest.raises(TransientFabricError):
+            res_rec.reconcile("r0")  # real failure: trips endpoint breaker
+        assert fabric.breaker().state == STATE_OPEN
+        for _ in range(10):  # fail-fast rejections, NOT budget burn
+            with pytest.raises(BreakerOpenError):
+                res_rec.reconcile("r0")
+        cr = store.get(ComposableResource, "r0")
+        assert not cr.status.quarantined
+        assert cr.status.attach_attempts == 1  # only the real failure counted
+        assert not node_quarantined(store, "worker-0")
+        # Fabric heals, breaker resets: the attach completes normally.
+        chaos.heal()
+        clock.t = 61.0
+        res_rec.reconcile("r0")
+        assert store.get(ComposableResource, "r0").status.state == RESOURCE_STATE_ONLINE
+
+    def test_terminal_errors_do_not_burn_budget(self):
+        store, pool, chaos, fabric, _, res_rec = make_world(budget=2)
+        make_cr(store, pool)
+        res_rec.reconcile("r0")
+        pool.inject_add_failure("r0", times=3)  # terminal FabricError
+        for _ in range(3):
+            with pytest.raises(FabricError):
+                res_rec.reconcile("r0")
+        cr = store.get(ComposableResource, "r0")
+        assert cr.status.attach_attempts == 0
+        assert not cr.status.quarantined
+
+    def test_budget_exhaustion_quarantines(self):
+        store, pool, chaos, fabric, _, res_rec = make_world(budget=3)
+        make_cr(store, pool)
+        res_rec.reconcile("r0")
+        chaos.fail_node("worker-0")  # persistent
+        before = resources_quarantined_total.value(node="worker-0")
+        for _ in range(2):
+            with pytest.raises(TransientFabricError):
+                res_rec.reconcile("r0")
+        # Third failure hits the budget: no raise, durable quarantine.
+        res_rec.reconcile("r0")
+        cr = store.get(ComposableResource, "r0")
+        assert cr.status.quarantined
+        assert "quarantined" in cr.status.error
+        assert node_quarantined(store, "worker-0")
+        rule = store.get(DeviceTaintRule, node_quarantine_name("worker-0"))
+        assert rule.spec.node_name == "worker-0"
+        assert resources_quarantined_total.value(node="worker-0") == before + 1
+        # Quarantined resources are inert — no more fabric calls.
+        calls = chaos.calls
+        res_rec.reconcile("r0")
+        assert chaos.calls == calls
+
+    def test_quarantined_resource_still_deletable(self):
+        store, pool, chaos, fabric, _, res_rec = make_world(budget=1)
+        make_cr(store, pool)
+        res_rec.reconcile("r0")
+        chaos.fail_node("worker-0")
+        res_rec.reconcile("r0")  # budget=1 -> immediate quarantine
+        assert store.get(ComposableResource, "r0").status.quarantined
+        store.delete(ComposableResource, "r0")
+        for _ in range(4):
+            if store.try_get(ComposableResource, "r0") is None:
+                break
+            res_rec.reconcile("r0")
+        assert store.try_get(ComposableResource, "r0") is None
+
+    def test_last_healthy_node_never_quarantined(self):
+        """An endpoint-wide 5xx storm arrives node-attributed and marches
+        through the fleet; the final healthy host must keep retrying
+        (reference behavior) rather than quarantine 100% of capacity."""
+        store, pool, chaos, fabric, _, res_rec = make_world(nodes=2, budget=2)
+        DevicePublisher(store).quarantine_node("worker-1", "already down")
+        make_cr(store, pool)  # worker-0: the last healthy host
+        res_rec.reconcile("r0")
+        chaos.fail_node("worker-0")
+        for _ in range(5):  # well past the budget
+            with pytest.raises(TransientFabricError):
+                res_rec.reconcile("r0")
+        cr = store.get(ComposableResource, "r0")
+        assert not cr.status.quarantined
+        assert not node_quarantined(store, "worker-0")
+        assert "quarantine withheld" in cr.status.error
+        # Capacity frees up (worker-1 repaired) -> the next exhausted
+        # failure may quarantine after all.
+        DevicePublisher(store).clear_node_quarantine("worker-1")
+        res_rec.reconcile("r0")
+        assert store.get(ComposableResource, "r0").status.quarantined
+
+    def test_cordoned_peer_is_not_a_reallocation_target(self):
+        """Quarantine eligibility uses the allocator's own gates: a peer
+        that exists but is cordoned/NotReady cannot absorb replacement
+        capacity, so quarantine must be withheld."""
+        store, pool, chaos, fabric, _, res_rec = make_world(nodes=2, budget=2)
+        peer = store.get(Node, "worker-1")
+        peer.spec.unschedulable = True  # cordoned
+        store.update(peer)
+        make_cr(store, pool)
+        res_rec.reconcile("r0")
+        chaos.fail_node("worker-0")
+        for _ in range(4):
+            with pytest.raises(TransientFabricError):
+                res_rec.reconcile("r0")
+        assert not store.get(ComposableResource, "r0").status.quarantined
+        assert not node_quarantined(store, "worker-0")
+
+    def test_pinned_owner_never_quarantined_off_its_node(self):
+        """A request pinned via target_node can never route elsewhere —
+        quarantining its node would delete the pinned children and loop in
+        AllocationError forever. It must keep retrying instead."""
+        from tpu_composer.api.types import LABEL_MANAGED_BY
+
+        store, pool, chaos, fabric, _, res_rec = make_world(nodes=3, budget=2)
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="req-pin"),
+            spec=ComposabilityRequestSpec(
+                resource=ResourceDetails(type="tpu", model="tpu-v4", size=4,
+                                         target_node="worker-0")),
+        ))
+        cr = make_cr(store, pool)
+        cr.metadata.labels[LABEL_MANAGED_BY] = "req-pin"
+        store.update(cr)
+        res_rec.reconcile("r0")
+        chaos.fail_node("worker-0")
+        for _ in range(4):  # well past the budget; healthy peers exist
+            with pytest.raises(TransientFabricError):
+                res_rec.reconcile("r0")
+        cr = store.get(ComposableResource, "r0")
+        assert not cr.status.quarantined
+        assert not node_quarantined(store, "worker-0")
+        assert "quarantine withheld" in cr.status.error
+
+    def test_disabled_budget_never_quarantines(self):
+        store, pool, chaos, fabric, _, res_rec = make_world(budget=0)
+        make_cr(store, pool)
+        res_rec.reconcile("r0")
+        chaos.fail_node("worker-0")
+        for _ in range(10):
+            with pytest.raises(TransientFabricError):
+                res_rec.reconcile("r0")
+        cr = store.get(ComposableResource, "r0")
+        assert not cr.status.quarantined
+        assert res_rec._attach_streaks["r0"] == 10
+
+
+class TestQuarantineAllocation:
+    def test_allocator_skips_quarantined_nodes(self):
+        store, pool, chaos, fabric, req_rec, res_rec = make_world()
+        publisher = DevicePublisher(store)
+        publisher.quarantine_node("worker-0", "test")
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="req-1"),
+            spec=ComposabilityRequestSpec(
+                resource=ResourceDetails(type="tpu", model="tpu-v4", size=4)),
+        ))
+        req = pump(store, req_rec, res_rec)
+        nodes = {rs.node_name for rs in req.status.resources.values()}
+        assert "worker-0" not in nodes
+
+    def test_pinned_request_on_quarantined_node_errors(self):
+        store, pool, chaos, fabric, req_rec, res_rec = make_world()
+        DevicePublisher(store).quarantine_node("worker-0", "test")
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="req-1"),
+            spec=ComposabilityRequestSpec(
+                resource=ResourceDetails(type="tpu", model="tpu-v4", size=4,
+                                         target_node="worker-0")),
+        ))
+        with pytest.raises(AllocationError, match="quarantined"):
+            req_rec.reconcile("req-1")
+
+    def test_node_deletion_clears_quarantine_and_breaker(self):
+        """A recreated same-name node (autoscaled fleets reuse names) must
+        not inherit a dead node's quarantine or breaker state."""
+        from tpu_composer.runtime.store import WatchEvent
+
+        clock = FakeClock()
+        store, pool, chaos, fabric, req_rec, res_rec = make_world(
+            budget=1,
+            breaker=lambda inner: BreakerFabricProvider(
+                inner, endpoint="mock-pool",
+                config=BreakerConfig(failure_threshold=1, reset_timeout=300.0),
+                clock=clock, rng=MidpointRng(),
+            ),
+        )
+        make_cr(store, pool)
+        res_rec.reconcile("r0")
+        chaos.fail_node("worker-0")
+        res_rec.reconcile("r0")  # budget=1 -> quarantine + tripped breaker
+        assert node_quarantined(store, "worker-0")
+        assert fabric.breaker("worker-0").state == STATE_OPEN
+
+        node = store.get(Node, "worker-0")
+        store.delete(Node, "worker-0")
+        res_rec._map_node_event(WatchEvent(type="DELETED", obj=node))
+        assert not node_quarantined(store, "worker-0")
+        assert "worker-0" not in fabric._node_breakers
+        # The reborn node starts fresh: closed breaker, allocatable.
+        chaos.heal_node("worker-0")
+        assert fabric.breaker("worker-0").state == STATE_CLOSED
+
+    def test_clear_quarantine_restores_node(self):
+        store, pool, chaos, fabric, req_rec, res_rec = make_world(nodes=1)
+        pub = DevicePublisher(store)
+        pub.quarantine_node("worker-0", "test")
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="req-1"),
+            spec=ComposabilityRequestSpec(
+                resource=ResourceDetails(type="tpu", model="tpu-v4", size=4)),
+        ))
+        with pytest.raises(AllocationError):
+            req_rec.reconcile("req-1")
+        pub.clear_node_quarantine("worker-0")
+        assert not pub.node_quarantined("worker-0")
+        pump(store, req_rec, res_rec)
+
+
+# ---------------------------------------------------------------------------
+# The acceptance spine: breaker trip -> quarantine -> Ready via reallocation
+# ---------------------------------------------------------------------------
+
+class TestQuarantineReallocationE2E:
+    def test_persistent_attach_failures_reroute_to_healthy_node(self):
+        clock = FakeClock()
+        store, pool, chaos, fabric, req_rec, res_rec = make_world(
+            nodes=3, budget=4,
+            breaker=lambda inner: BreakerFabricProvider(
+                inner, endpoint="mock-pool",
+                config=BreakerConfig(failure_threshold=2, reset_timeout=300.0),
+                clock=clock, rng=MidpointRng(),
+            ),
+        )
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="req-1"),
+            spec=ComposabilityRequestSpec(
+                resource=ResourceDetails(type="tpu", model="tpu-v4", size=4)),
+        ))
+        # Allocation is deterministic (tightest-fit, then name): the slice
+        # lands on worker-0. Make its attach path persistently fail.
+        chaos.fail_node("worker-0")
+        trips_before = fabric_breaker_trips_total.value(
+            endpoint="mock-pool", scope="worker-0")
+        quarantined_before = resources_quarantined_total.value(node="worker-0")
+
+        req = pump(store, req_rec, res_rec)
+
+        # The request reached Ready on healthy capacity...
+        assert req.status.state == REQUEST_STATE_RUNNING
+        nodes = {rs.node_name for rs in req.status.resources.values()}
+        assert nodes and "worker-0" not in nodes
+        (placed,) = nodes
+        assert len(pool.attached_to(placed)) == 4
+        assert pool.attached_to("worker-0") == []
+        # ...the flaky node's breaker tripped (2 real failures, then fail-fast
+        # rejections burned the rest of the attach budget instantly)...
+        assert fabric.breaker("worker-0").state == STATE_OPEN
+        assert fabric.breaker().state == STATE_CLOSED
+        assert fabric_breaker_trips_total.value(
+            endpoint="mock-pool", scope="worker-0") == trips_before + 1
+        # ...the device was quarantined, durably...
+        assert node_quarantined(store, "worker-0")
+        assert resources_quarantined_total.value(
+            node="worker-0") == quarantined_before + 1
+        # ...and every resilience metric is exposed for scrapes.
+        text = global_registry.expose_text()
+        for metric in ("fabric_breaker_state", "fabric_breaker_trips_total",
+                       "resources_quarantined_total"):
+            assert metric in text, metric
+
+    def test_operator_restart_resumes_quarantine_state(self):
+        """A controller restart must not grant the flaky node a fresh
+        budget: the streak resumes from the last persisted floor in
+        status.attach_attempts (written whenever the surfaced error
+        changes), not from zero."""
+        store, pool, chaos, fabric, req_rec, res_rec = make_world(budget=3)
+        make_cr(store, pool)
+        res_rec.reconcile("r0")
+        chaos.fail_node("worker-0")
+        for _ in range(2):
+            with pytest.raises(TransientFabricError):
+                res_rec.reconcile("r0")
+        assert store.get(ComposableResource, "r0").status.attach_attempts >= 1
+        # Restart: fresh reconciler over the same store resumes at >= 1.
+        res_rec2 = ComposableResourceReconciler(
+            store, fabric, FakeNodeAgent(pool=pool),
+            timing=ResourceTiming(attach_budget=3),
+        )
+        for _ in range(3):
+            if store.get(ComposableResource, "r0").status.quarantined:
+                break
+            try:
+                res_rec2.reconcile("r0")
+            except TransientFabricError:
+                pass
+        assert store.get(ComposableResource, "r0").status.quarantined
+
+
+# ---------------------------------------------------------------------------
+# Syncer anti-drift under a full fabric outage (satellite)
+# ---------------------------------------------------------------------------
+
+class TestSyncerOutage:
+    def make(self):
+        store = Store()
+        for i in range(2):
+            n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+            n.status.tpu_slots = 8
+            store.create(n)
+        pool = InMemoryPool()
+        chaos = ChaosFabricProvider(pool)
+        return store, pool, chaos
+
+    def test_outage_skips_sweep_without_wiping_state(self):
+        store, pool, chaos = self.make()
+        syncer = UpstreamSyncer(store, chaos, grace=100.0)
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        syncer.sync_once(now=0.0)
+        assert leaked in syncer.tracked_missing
+
+        chaos.blackout()
+        with pytest.raises(TransientFabricError):
+            syncer.sync_once(now=50.0)
+        # The failed sweep neither created detach-CRs nor dropped tracking.
+        assert store.list(ComposableResource) == []
+        assert leaked in syncer.tracked_missing
+
+        chaos.heal()
+        assert syncer.sync_once(now=150.0) == 1  # reconverged post-outage
+        (cr,) = store.list(ComposableResource)
+        assert cr.spec.force_detach
+
+    def test_breaker_open_fails_sweep_fast_then_reconverges(self):
+        store, pool, chaos = self.make()
+        clock = FakeClock()
+        fabric = BreakerFabricProvider(
+            chaos, endpoint="mock-pool",
+            config=BreakerConfig(failure_threshold=1, reset_timeout=30.0,
+                                 endpoint_failure_threshold=1),
+            clock=clock, rng=MidpointRng(),
+        )
+        syncer = UpstreamSyncer(store, fabric, grace=100.0)
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        syncer.sync_once(now=0.0)
+
+        chaos.blackout()
+        with pytest.raises(TransientFabricError):
+            syncer.sync_once(now=10.0)  # trips the endpoint breaker
+        calls_before = chaos.calls
+        with pytest.raises(BreakerOpenError):
+            syncer.sync_once(now=20.0)  # fail-fast: fabric never touched
+        assert chaos.calls == calls_before
+        assert leaked in syncer.tracked_missing
+
+        chaos.heal()
+        clock.t = 31.0  # past reset: half-open probe goes through
+        assert syncer.sync_once(now=150.0) == 1
+        assert fabric.breaker().state == STATE_CLOSED
+
+    def test_runnable_loop_survives_outage(self):
+        """The manager-runnable entrypoint logs and keeps ticking (no
+        unhandled exception kills the sweep thread)."""
+        import threading
+
+        store, pool, chaos = self.make()
+        syncer = UpstreamSyncer(store, chaos, period=0.01, grace=0.02)
+        chaos.blackout()
+        stop = threading.Event()
+        t = threading.Thread(target=syncer, args=(stop,))
+        t.start()
+        try:
+            import time as _time
+
+            _time.sleep(0.08)  # several failing sweeps
+            pool.leak_attachment("worker-1", "tpu-v4")
+            chaos.heal()
+            deadline = _time.monotonic() + 5
+            while _time.monotonic() < deadline:
+                if store.list(ComposableResource):
+                    break
+                _time.sleep(0.01)
+            assert store.list(ComposableResource)  # reconverged after heal
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Queue backoff jitter (satellite)
+# ---------------------------------------------------------------------------
+
+class TestQueueJitter:
+    def test_backoff_is_jittered_and_bounded(self):
+        rng = random.Random(7)
+        q = RateLimitingQueue(base_delay=0.1, max_delay=5.0, jitter=rng)
+        delays = []
+        orig = q.add_after
+        q.add_after = lambda key, delay: delays.append(delay)  # type: ignore
+        for _ in range(40):
+            q.add_rate_limited("k")
+        q.add_after = orig  # type: ignore
+        assert all(0.1 <= d <= 5.0 for d in delays)
+        assert max(delays) > 0.5  # it actually grows
+        assert len(set(round(d, 6) for d in delays)) > 20  # not deterministic
+
+    def test_two_keys_decorrelate(self):
+        q = RateLimitingQueue(base_delay=0.1, max_delay=5.0,
+                              jitter=random.Random(11))
+        a, b = [], []
+        orig = q.add_after
+        q.add_after = (  # type: ignore
+            lambda key, delay: (a if key == "a" else b).append(delay)
+        )
+        for _ in range(6):
+            q.add_rate_limited("a")
+            q.add_rate_limited("b")
+        q.add_after = orig  # type: ignore
+        assert a != b  # lockstep herd broken
+
+    def test_forget_resets_jitter_state(self):
+        q = RateLimitingQueue(base_delay=0.1, max_delay=5.0,
+                              jitter=random.Random(3))
+        for _ in range(8):
+            q.add_rate_limited("k")
+        q.forget("k")
+        assert q.retries("k") == 0
+        assert q._last_delay.get("k") is None

@@ -37,6 +37,33 @@ def slice_object_name(node: str) -> str:
     return f"{node}-tpu.composer.dev"
 
 
+def node_quarantine_name(node: str) -> str:
+    """Deterministic DeviceTaintRule name for a whole-node quarantine
+    (device_uuid empty, node_name set — the 'whole node' arm the
+    DeviceTaintRuleSpec docstring reserves)."""
+    return "quarantine-node-" + node.replace("/", "-").lower()
+
+
+def node_quarantined(store, node: str) -> bool:
+    """Point check for ONE node's quarantine marker. Allocation-path code
+    deliberately does NOT use this — it calls quarantined_nodes() once per
+    pass to avoid per-candidate wire GETs; this is for single-node probes
+    (publisher API, operators, tests)."""
+    return store.try_get(DeviceTaintRule, node_quarantine_name(node)) is not None
+
+
+def quarantined_nodes(store) -> set:
+    """Every host under a whole-node quarantine marker, in one list call.
+    This is THE definition of the marker shape (node_name set, device_uuid
+    empty) — the request allocator and the resource controller's
+    quarantine gate both consume this so the encoding can't drift."""
+    return {
+        r.spec.node_name
+        for r in store.list(DeviceTaintRule)
+        if r.spec.node_name and not r.spec.device_uuid
+    }
+
+
 class DevicePublisher:
     def __init__(self, store, retries: int = 5) -> None:
         self.store = store
@@ -154,6 +181,38 @@ class DevicePublisher:
 
     def tainted(self, device_uuid: str) -> bool:
         return self.store.try_get(DeviceTaintRule, taint_rule_name(device_uuid)) is not None
+
+    # ------------------------------------------------------------------
+    # node quarantine (fabric resilience layer, docs/RESILIENCE.md)
+    # ------------------------------------------------------------------
+    def quarantine_node(self, node: str, reason: str) -> None:
+        """Durable node-level quarantine marker. Unlike the per-device
+        detach taints, this survives the failing ComposableResource's
+        deletion — it is what keeps the allocator from re-placing
+        replacement capacity onto the host whose attach path just burned an
+        entire budget. Cleared by an operator (or test) once the fabric
+        path is repaired."""
+        name = node_quarantine_name(node)
+        if self.store.try_get(DeviceTaintRule, name) is not None:
+            return
+        try:
+            self.store.create(
+                DeviceTaintRule(
+                    metadata=ObjectMeta(name=name),
+                    spec=DeviceTaintRuleSpec(node_name=node, reason=reason),
+                )
+            )
+        except AlreadyExistsError:
+            pass
+
+    def clear_node_quarantine(self, node: str) -> None:
+        try:
+            self.store.delete(DeviceTaintRule, node_quarantine_name(node))
+        except NotFoundError:
+            pass
+
+    def node_quarantined(self, node: str) -> bool:
+        return node_quarantined(self.store, node)
 
     def claimable(self, node: str) -> List[SliceDevice]:
         """What a scheduler could still place on: published and untainted.

@@ -98,6 +98,7 @@ _RESOURCE_STATUS = _obj(
         "cdi_device_id": _str(),
         "worker_id": _int(),
         "error": _str(),
+        "quarantined": _bool("Attach budget exhausted on this member"),
     }
 )
 
@@ -160,6 +161,12 @@ COMPOSABLE_RESOURCE_SCHEMA = _obj(
                 "device_ids": _array(_str()),
                 "cdi_device_id": _str(),
                 "chip_indices": _array(_int()),
+                "attach_attempts": _int(
+                    "Consecutive transient attach failures (resilience budget)"
+                ),
+                "quarantined": _bool(
+                    "Attach budget exhausted; owner must reallocate"
+                ),
             }
         ),
     }

@@ -212,6 +212,7 @@ class ResourceStatus:
     cdi_device_id: str = ""
     worker_id: int = -1
     error: str = ""
+    quarantined: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"state": self.state}
@@ -225,6 +226,8 @@ class ResourceStatus:
             d["worker_id"] = self.worker_id
         if self.error:
             d["error"] = self.error
+        if self.quarantined:
+            d["quarantined"] = True
         return d
 
     @classmethod
@@ -236,6 +239,7 @@ class ResourceStatus:
             cdi_device_id=d.get("cdi_device_id", ""),
             worker_id=int(d.get("worker_id", -1)),
             error=d.get("error", ""),
+            quarantined=bool(d.get("quarantined", False)),
         )
 
 
@@ -410,6 +414,12 @@ class ComposableResourceStatus:
     # Persisted so co-located groups on one host keep disjoint nodes across
     # controller restarts (no reference analog — one GPU per CR there).
     chip_indices: List[int] = field(default_factory=list)
+    # Resilience bookkeeping (docs/RESILIENCE.md): consecutive transient
+    # attach failures; when the budget is exhausted the resource is marked
+    # quarantined and the owning request reallocates around its node.
+    # Persisted in status so the budget survives controller restarts.
+    attach_attempts: int = 0
+    quarantined: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"state": self.state}
@@ -421,6 +431,10 @@ class ComposableResourceStatus:
             d["cdi_device_id"] = self.cdi_device_id
         if self.chip_indices:
             d["chip_indices"] = list(self.chip_indices)
+        if self.attach_attempts:
+            d["attach_attempts"] = self.attach_attempts
+        if self.quarantined:
+            d["quarantined"] = True
         return d
 
     @classmethod
@@ -431,6 +445,8 @@ class ComposableResourceStatus:
             device_ids=list(d.get("device_ids", [])),
             cdi_device_id=d.get("cdi_device_id", ""),
             chip_indices=[int(i) for i in d.get("chip_indices", [])],
+            attach_attempts=int(d.get("attach_attempts", 0)),
+            quarantined=bool(d.get("quarantined", False)),
         )
 
 

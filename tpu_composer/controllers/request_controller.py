@@ -38,6 +38,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from tpu_composer.agent.publisher import quarantined_nodes
 from tpu_composer.api.meta import now_iso, parse_iso
 from tpu_composer.api.types import (
     ANNOTATION_DELETE_DEVICE,
@@ -255,6 +256,7 @@ class ComposabilityRequestReconciler(Controller):
                 cdi_device_id=child.status.cdi_device_id,
                 worker_id=child.spec.worker_id if child.spec.type == "tpu" else -1,
                 error=child.status.error,
+                quarantined=child.status.quarantined,
             )
             if rs is None or rs.to_dict() != new.to_dict():
                 req.status.resources[name] = new
@@ -272,6 +274,14 @@ class ComposabilityRequestReconciler(Controller):
 
     def _slice_name(self, req: ComposabilityRequest) -> str:
         return f"{req.name}-slice"
+
+    def _quarantined_nodes(self) -> set:
+        """Hosts under a node-level quarantine marker (attach budget
+        exhausted there — see publisher.quarantine_node). ONE list per
+        allocation pass, not a per-candidate get: allocation holds
+        _alloc_lock, and on the wire store per-node GETs would serialize
+        the fleet behind O(N) RTTs (same reasoning as _used_slots_map)."""
+        return quarantined_nodes(self.store)
 
     def _set_error(self, name: str, msg: str) -> None:
         req = self.store.try_get(ComposabilityRequest, name)
@@ -327,17 +337,21 @@ class ComposabilityRequestReconciler(Controller):
             )
 
         # Children that can't belong to ANY shape of this slice go first:
-        # wrong model/flags, or their node is gone. Topology and member
-        # count are judged separately below — a resize keeps survivors
-        # (reference contrast: device reuse on drift,
-        # composabilityrequest_controller.go:254-305; our live-resize
-        # extends it to connected slices).
+        # wrong model/flags, their node is gone, or they/their node are
+        # quarantined (attach budget exhausted — replacement capacity must
+        # land elsewhere). Topology and member count are judged separately
+        # below — a resize keeps survivors (reference contrast: device
+        # reuse on drift, composabilityrequest_controller.go:254-305; our
+        # live-resize extends it to connected slices).
+        quarantined_nodes = self._quarantined_nodes()
         healthy = [
             c for c in children
             if not c.being_deleted
             and c.spec.model == res.model
             and c.spec.slice_name == slice_name
             and c.spec.force_detach == res.force_detach
+            and not c.status.quarantined
+            and c.spec.target_node not in quarantined_nodes
             and self.store.try_get(Node, c.spec.target_node) is not None
         ]
         stale = [c for c in children if c not in healthy]
@@ -390,6 +404,7 @@ class ComposabilityRequestReconciler(Controller):
             extra = self._pick_extra_nodes(
                 req, shape, exclude=set(cur_hosts),
                 count=shape.num_hosts - len(healthy),
+                quarantined=quarantined_nodes,
             )
             nodes = cur_hosts + extra
             try:
@@ -402,7 +417,7 @@ class ComposabilityRequestReconciler(Controller):
             self._retopologize(healthy, shape.topology)
         else:
             self.fabric.release_slice(slice_name)
-            nodes = self._pick_nodes(req, shape)
+            nodes = self._pick_nodes(req, shape, quarantined_nodes)
             try:
                 self.fabric.reserve_slice(slice_name, res.model, shape.topology, nodes)
             except FabricError:
@@ -432,8 +447,13 @@ class ComposabilityRequestReconciler(Controller):
         self._write_status(req)
         return Result(requeue_after=0.0)
 
-    def _pick_nodes(self, req: ComposabilityRequest, shape: SliceShape) -> List[str]:
+    def _pick_nodes(
+        self, req: ComposabilityRequest, shape: SliceShape,
+        quarantined: set,
+    ) -> List[str]:
         """Choose shape.num_hosts nodes with free TPU ports + capacity.
+        `quarantined` is the allocation pass's one DeviceTaintRule scan
+        (_quarantined_nodes), threaded through so no picker re-lists.
 
         Policies (:361-467 analog): explicit target_node (single-host only),
         samenode (single-host auto-pick), differentnode/topology (spread).
@@ -448,6 +468,11 @@ class ComposabilityRequestReconciler(Controller):
             node = self.store.try_get(Node, res.target_node)
             if node is None:
                 raise AllocationError(f"target node {res.target_node} does not exist")
+            if res.target_node in quarantined:
+                raise AllocationError(
+                    f"target node {res.target_node} is quarantined"
+                    " (fabric attach budget exhausted)"
+                )
             if not self._node_fits(req, node, shape.chips_per_host, self._used_slots_map(req.name)):
                 raise AllocationError(
                     f"target node {res.target_node} lacks capacity for"
@@ -461,7 +486,8 @@ class ComposabilityRequestReconciler(Controller):
         # (see _pick_extra_nodes); differentnode is identical for slices
         # since workers always land on distinct hosts.
         return self._pick_extra_nodes(
-            req, shape, exclude=set(), count=shape.num_hosts
+            req, shape, exclude=set(), count=shape.num_hosts,
+            quarantined=quarantined,
         )
 
     def _retopologize(self, children: List[ComposableResource], topology: str) -> None:
@@ -484,17 +510,19 @@ class ComposabilityRequestReconciler(Controller):
 
     def _pick_extra_nodes(
         self, req: ComposabilityRequest, shape: SliceShape,
-        exclude: set, count: int,
+        exclude: set, count: int, quarantined: set,
     ) -> List[str]:
         """Slice placement: `count` hosts with capacity for one worker's
         chip group each. Fresh allocations pass exclude=∅ and the full host
         count; the grow path excludes surviving members' hosts and asks for
         only the delta — one filter/sort, so placement policy can't diverge
-        between the two."""
+        between the two. `quarantined` comes from the caller's single
+        _quarantined_nodes scan."""
         used = self._used_slots_map(req.name)
         candidates = [
             n for n in self.store.list(Node)
             if n.metadata.name not in exclude
+            and n.metadata.name not in quarantined
             and n.status.ready and not n.spec.unschedulable
             and self._node_fits(req, n, shape.chips_per_host, used)
         ]
@@ -573,12 +601,15 @@ class ComposabilityRequestReconciler(Controller):
         res = req.spec.resource
         keep: List[ComposableResource] = []
         discard: List[ComposableResource] = []
+        quarantined_nodes = self._quarantined_nodes()
         for c in children:
             if (
                 not c.being_deleted
                 and c.spec.model == res.model
                 and c.spec.force_detach == res.force_detach
                 and (not res.target_node or c.spec.target_node == res.target_node)
+                and not c.status.quarantined
+                and c.spec.target_node not in quarantined_nodes
                 and self.store.try_get(Node, c.spec.target_node) is not None
             ):
                 keep.append(c)
@@ -597,7 +628,8 @@ class ComposabilityRequestReconciler(Controller):
         assignments = [c.spec.target_node for c in keep]
         missing = res.size - len(keep)
         if missing > 0:
-            assignments.extend(self._pick_scalar_nodes(req, missing, assignments))
+            assignments.extend(self._pick_scalar_nodes(
+                req, missing, assignments, quarantined_nodes))
 
         req.status.resources = {
             c.name: req.status.resources.get(c.name, ResourceStatus(node_name=c.spec.target_node))
@@ -612,13 +644,20 @@ class ComposabilityRequestReconciler(Controller):
         self._write_status(req)
         return Result(requeue_after=0.0)
 
-    def _pick_scalar_nodes(self, req, count: int, existing: List[str]) -> List[str]:
+    def _pick_scalar_nodes(
+        self, req, count: int, existing: List[str], quarantined_nodes: set,
+    ) -> List[str]:
         res = req.spec.resource
         used = self._used_slots_map(req.name)
         if res.target_node:
             node = self.store.try_get(Node, res.target_node)
             if node is None:
                 raise AllocationError(f"target node {res.target_node} does not exist")
+            if res.target_node in quarantined_nodes:
+                raise AllocationError(
+                    f"target node {res.target_node} is quarantined"
+                    " (fabric attach budget exhausted)"
+                )
             # Capacity must cover everything this request puts there.
             already = sum(1 for e in existing if e == res.target_node)
             if not self._node_fits(req, node, already + count, used):
@@ -628,7 +667,9 @@ class ComposabilityRequestReconciler(Controller):
             return [res.target_node] * count
         nodes = [
             n for n in self.store.list(Node)
-            if n.status.ready and not n.spec.unschedulable and self._node_fits(req, n, 1, used)
+            if n.status.ready and not n.spec.unschedulable
+            and n.metadata.name not in quarantined_nodes
+            and self._node_fits(req, n, 1, used)
         ]
         if not nodes:
             raise AllocationError("no schedulable node with free device ports")
@@ -706,6 +747,21 @@ class ComposabilityRequestReconciler(Controller):
             return Result(requeue_after=0.0)
 
         children = {c.name: c for c in self._children(req)}
+        # A quarantined member will never come Online — go straight back to
+        # allocation, which discards it and places a replacement on healthy
+        # capacity (automatic reallocation, docs/RESILIENCE.md). Without
+        # this the request would sit in Updating polling forever.
+        quarantined = [c for c in children.values() if c.status.quarantined]
+        if quarantined:
+            self.recorder.event(
+                req, WARNING, "MemberQuarantined",
+                f"{len(quarantined)} member(s) quarantined"
+                f" ({', '.join(sorted(c.spec.target_node for c in quarantined))});"
+                " reallocating on healthy capacity",
+            )
+            req.status.state = REQUEST_STATE_NODE_ALLOCATING
+            self._write_status(req)
+            return Result(requeue_after=0.0)
         # Delete children that lost their placeholder row (:509-521).
         redundant = [c for name, c in children.items() if name not in req.status.resources]
         if redundant:

@@ -29,9 +29,12 @@ from typing import List, Optional
 
 from tpu_composer.agent.cdi import generate_cdi_spec
 from tpu_composer.agent.nodeagent import AgentError, DeviceBusyError, NodeAgent
+from tpu_composer.agent.publisher import quarantined_nodes
 from tpu_composer.api.types import (
+    ComposabilityRequest,
     ComposableResource,
     FINALIZER,
+    LABEL_MANAGED_BY,
     LABEL_READY_TO_DETACH,
     Node,
     RESOURCE_STATE_ATTACHING,
@@ -40,15 +43,23 @@ from tpu_composer.api.types import (
     RESOURCE_STATE_EMPTY,
     RESOURCE_STATE_ONLINE,
 )
+from tpu_composer.fabric.breaker import BreakerOpenError
 from tpu_composer.fabric.provider import (
     FabricError,
     FabricProvider,
+    TransientFabricError,
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
+    classify_fabric_error,
 )
 from tpu_composer.runtime.controller import Controller, Result
 from tpu_composer.runtime.events import WARNING, EventRecorder
-from tpu_composer.runtime.metrics import composed_chips, fabric_requests_total, reconcile_total
+from tpu_composer.runtime.metrics import (
+    composed_chips,
+    fabric_requests_total,
+    reconcile_total,
+    resources_quarantined_total,
+)
 from tpu_composer.runtime.store import (
     ConflictError,
     NotFoundError,
@@ -69,6 +80,11 @@ class ResourceTiming:
     detach_poll: float = 1.0  # fabric detach re-poll (30s)
     detach_fast: float = 0.3  # still-visible fast requeue (3s, :400)
     busy_poll: float = 2.0  # device-in-use re-check
+    # Attach-attempt budget (fabric resilience layer): consecutive TRANSIENT
+    # attach failures tolerated before the resource is quarantined and the
+    # owning request reallocates around its node. <= 0 disables (reference
+    # behavior: retry the same host forever, requeueOnErr :436-446).
+    attach_budget: int = 5
 
 
 class ComposableResourceReconciler(Controller):
@@ -100,6 +116,16 @@ class ComposableResourceReconciler(Controller):
         # Serializes host-local chip-index assignment across worker threads
         # (two groups landing on one node must get disjoint /dev/accel sets).
         self._index_lock = threading.Lock()
+        # In-memory attach-failure streaks (resource name -> count), seeded
+        # from status.attach_attempts on first observation. Authoritative
+        # during a streak: persisting every increment would make each failed
+        # reconcile's status write self-trigger an immediate requeue through
+        # the primary watch, bypassing the queue's backoff entirely (a
+        # breaker-open resource then spins thousands of reconciles/minute).
+        # Status is written only when the surfaced error message changes or
+        # at quarantine — so a restart resumes the streak from the last
+        # persisted floor, not necessarily the exact count.
+        self._attach_streaks: dict = {}
         # Node deletions GC dependent resources (reference watches nodes via
         # the request controller; we react directly, :137-183).
         self.watch("Node", mapper=self._map_node_event)
@@ -107,16 +133,28 @@ class ComposableResourceReconciler(Controller):
     def _map_node_event(self, ev: WatchEvent):
         if ev.type != "DELETED":
             return []
+        node = ev.obj.metadata.name
+        # Retire the node's circuit breaker + metric series (no-op for
+        # providers without per-node breakers, e.g. the bare mock pool),
+        # AND its durable quarantine marker: the host left the fleet, and a
+        # recreated same-name node is presumptively repaired hardware — it
+        # must start allocatable, not inherit the dead node's quarantine
+        # forever.
+        forget = getattr(self.fabric, "forget_node", None)
+        if callable(forget):
+            forget(node)
+        self.publisher.clear_node_quarantine(node)
         return [
             r.metadata.name
             for r in self.store.list(ComposableResource)
-            if r.spec.target_node == ev.obj.metadata.name
+            if r.spec.target_node == node
         ]
 
     # ------------------------------------------------------------------
     def reconcile(self, name: str) -> Result:
         res = self.store.try_get(ComposableResource, name)
         if res is None:
+            self._attach_streaks.pop(name, None)
             return Result()
         try:
             result = self._reconcile_inner(res)
@@ -210,6 +248,12 @@ class ComposableResourceReconciler(Controller):
             self.store.update_status(res)
             return Result(requeue_after=self.timing.detach_fast)
 
+        if res.status.quarantined:
+            # Terminal until the owner reallocates (which deletes this CR)
+            # or the spec changes; retrying here would keep hammering the
+            # very attach path that exhausted the budget.
+            return Result()
+
         self.agent.ensure_driver(res.spec.target_node)
 
         try:
@@ -217,7 +261,21 @@ class ComposableResourceReconciler(Controller):
             fabric_requests_total.inc(op="add", outcome="ok")
         except WaitingDeviceAttaching:
             fabric_requests_total.inc(op="add", outcome="waiting")
+            # The fabric answered for THIS node — break the failure streak
+            # (matching the breaker's view of sentinels), else wire flakes
+            # sprinkled across a long async attach would sum to a bogus
+            # quarantine of a host whose attach is progressing.
+            self._attach_streaks.pop(res.name, None)
+            if res.status.attach_attempts:
+                res.status.attach_attempts = 0
+                try:
+                    self.store.update_status(res)
+                except (ConflictError, NotFoundError):
+                    pass  # bookkeeping only
             return Result(requeue_after=self.timing.attach_poll)
+        except TransientFabricError as e:
+            fabric_requests_total.inc(op="add", outcome="transient")
+            return self._attach_failed(res, e)
 
         changed = (
             res.status.device_ids != attach.device_ids
@@ -226,6 +284,10 @@ class ComposableResourceReconciler(Controller):
         if changed:
             res.status.device_ids = list(attach.device_ids)
             res.status.cdi_device_id = attach.cdi_device_id
+        self._attach_streaks.pop(res.name, None)
+        if res.status.attach_attempts:
+            res.status.attach_attempts = 0  # streak broken by success
+            changed = True
         # Chip indices are assigned under the same lock that persists them:
         # one status write is both the fabric-attachment durability point
         # AND the index claim, and a concurrently-attaching co-located group
@@ -275,6 +337,103 @@ class ComposableResourceReconciler(Controller):
         self.recorder.event(res, "Normal", "Attached",
                             f"{len(res.status.device_ids)} chip(s) online on {res.spec.target_node}")
         return Result()
+
+    def _attach_failed(self, res: ComposableResource, err: TransientFabricError) -> Result:
+        """Count one transient attach failure against the budget; quarantine
+        on exhaustion, otherwise surface the error and let the queue's
+        jittered backoff retry (raising keeps requeueOnErr semantics).
+
+        Endpoint-scoped breaker rejections are NOT counted: when the whole
+        fabric manager is dark, every node's attach fails instantly, and
+        counting those would durably quarantine the entire fleet during a
+        brief outage — strictly worse than retry-forever. Only evidence
+        against THIS node (real transport failures reaching it, or its own
+        node breaker) burns its budget."""
+        if isinstance(err, BreakerOpenError) and not err.scope:
+            raise err
+        name = res.name
+        attempts = self._attach_streaks.get(name, res.status.attach_attempts) + 1
+        self._attach_streaks[name] = attempts
+        budget = self.timing.attach_budget
+        msg = str(err)
+        if budget > 0 and attempts >= budget:
+            if self._quarantine_allowed(res):
+                res.status.attach_attempts = attempts
+                return self._quarantine(res, msg)
+            # Nowhere to route replacement capacity: quarantining the last
+            # healthy host would strand the owner in AllocationError —
+            # strictly worse than the reference's retry-forever. This is
+            # also the stop that keeps an endpoint-wide 5xx storm (which
+            # arrives node-attributed as allocation marches through the
+            # fleet) from quarantining 100% of capacity. Keep retrying;
+            # re-check each failure in case capacity frees up later.
+            # Static suffix — embedding the live count would change the
+            # message (and thus write status) every failure, re-creating
+            # the self-wake hot loop the streak cache exists to prevent.
+            msg += (
+                " (attach budget exhausted;"
+                " quarantine withheld: no other healthy capacity)"
+            )
+        if res.status.error != msg:
+            # Piggyback streak persistence on the writes that happen anyway;
+            # identical repeat failures write nothing (see _attach_streaks).
+            res.status.attach_attempts = attempts
+            res.status.error = msg
+            try:
+                self.store.update_status(res)
+            except (ConflictError, NotFoundError):
+                pass  # bookkeeping only — the retry recounts
+        # Raise under the SAME surfaced message so the generic requeueOnErr
+        # _set_error pass is a no-op instead of clobbering the suffix.
+        raise classify_fabric_error(err, msg) from err
+
+    def _quarantine_allowed(self, res: ComposableResource) -> bool:
+        """True only when the owner can actually reallocate: quarantining
+        without a reallocation target strands it in AllocationError — the
+        exact outcome this gate exists to prevent. Two checks:
+
+        - an owner PINNED (spec.resource.target_node) to this node can
+          never route elsewhere, whatever other capacity exists;
+        - some OTHER node must be eligible by the allocator's own gates
+          (ready, schedulable, not quarantined) — mere existence of a
+          cordoned/NotReady node is not a reallocation target.
+        """
+        node = res.spec.target_node
+        owner = res.metadata.labels.get(LABEL_MANAGED_BY, "")
+        if owner:
+            req = self.store.try_get(ComposabilityRequest, owner)
+            if req is not None and req.spec.resource.target_node == node:
+                return False
+        quarantined = quarantined_nodes(self.store)
+        return any(
+            n.metadata.name != node
+            and n.metadata.name not in quarantined
+            and n.status.ready and not n.spec.unschedulable
+            for n in self.store.list(Node)
+        )
+
+    def _quarantine(self, res: ComposableResource, reason: str) -> Result:
+        """Attach budget exhausted: durably mark the node + resource
+        quarantined so the owning request reallocates onto healthy capacity
+        (the DRA-taint arm made real — see publisher.quarantine_node)."""
+        node = res.spec.target_node
+        self._attach_streaks.pop(res.name, None)
+        msg = (
+            f"quarantined: {res.status.attach_attempts} consecutive transient"
+            f" attach failures on {node}: {reason}"
+        )
+        self.publisher.quarantine_node(node, msg)
+        if res.status.device_ids:
+            # A partially-attached group (async flow) also taints its known
+            # devices so no scheduler claims them while quarantined.
+            self.publisher.create_taints(node, res.status.device_ids, "quarantine")
+        res.status.quarantined = True
+        res.status.error = msg
+        self.store.update_status(res)
+        resources_quarantined_total.inc(node=node)
+        self.recorder.event(res, WARNING, "Quarantined", msg)
+        self.log.warning("%s: %s", res.name, msg)
+        return Result()  # inert until the owner or operator reacts
 
     def _assign_chip_indices(self, res: ComposableResource) -> bool:
         """Assign host-local /dev/accel indices disjoint from every other

@@ -12,18 +12,32 @@ from tpu_composer.fabric.provider import (
     FabricDevice,
     FabricError,
     FabricProvider,
+    TransientFabricError,
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
 )
+from tpu_composer.fabric.breaker import (
+    BreakerConfig,
+    BreakerFabricProvider,
+    BreakerOpenError,
+    CircuitBreaker,
+)
+from tpu_composer.fabric.chaos import ChaosFabricProvider
 from tpu_composer.fabric.inmem import InMemoryPool
 from tpu_composer.fabric.adapter import new_fabric_provider
 
 __all__ = [
     "AttachResult",
+    "BreakerConfig",
+    "BreakerFabricProvider",
+    "BreakerOpenError",
+    "ChaosFabricProvider",
+    "CircuitBreaker",
     "DeviceHealth",
     "FabricDevice",
     "FabricError",
     "FabricProvider",
+    "TransientFabricError",
     "WaitingDeviceAttaching",
     "WaitingDeviceDetaching",
     "InMemoryPool",

@@ -83,22 +83,46 @@ def new_fabric_provider(provider_type: Optional[str] = None) -> FabricProvider:
             if kind in ("REST_CM", "REST_FM"):
                 from tpu_composer.fabric.rest import RestPoolClient
 
-                return RestPoolClient(
+                client: FabricProvider = RestPoolClient(
                     endpoint=endpoint,
                     tenant_id=os.environ.get("FABRIC_TENANT_ID", ""),
                     cluster_id=os.environ.get("FABRIC_CLUSTER_ID", ""),
                     synchronous=(kind == "REST_FM"),
                 )
-            if kind == "LAYOUT":
+            elif kind == "LAYOUT":
                 from tpu_composer.fabric.layout import LayoutApplyClient
 
-                return LayoutApplyClient(endpoint=endpoint)
-            from tpu_composer.fabric.redfish import RedfishClient
+                client = LayoutApplyClient(endpoint=endpoint)
+            else:
+                from tpu_composer.fabric.redfish import RedfishClient
 
-            return RedfishClient(endpoint=endpoint)
+                client = RedfishClient(endpoint=endpoint)
         except ModuleNotFoundError as e:
             raise AdapterError(f"{kind} backend not available: {e}") from e
+        return _wrap_breaker(client, endpoint)
     raise AdapterError(f"unknown CDI_PROVIDER_TYPE {kind!r}")
+
+
+def _wrap_breaker(client: FabricProvider, endpoint: str) -> FabricProvider:
+    """Every remote provider ships behind a per-endpoint circuit breaker
+    (docs/RESILIENCE.md). TPU_COMPOSER_BREAKER=0 opts out; threshold/reset
+    are env-tunable for known-flaky fabrics."""
+    if os.environ.get("TPU_COMPOSER_BREAKER", "1") == "0":
+        return client
+    from tpu_composer.fabric.breaker import BreakerConfig, BreakerFabricProvider
+
+    config = BreakerConfig()
+    try:
+        config.failure_threshold = int(
+            os.environ.get("TPU_COMPOSER_BREAKER_THRESHOLD",
+                           config.failure_threshold)
+        )
+        config.reset_timeout = float(
+            os.environ.get("TPU_COMPOSER_BREAKER_RESET_S", config.reset_timeout)
+        )
+    except ValueError as e:
+        raise AdapterError(f"bad breaker env override: {e}") from e
+    return BreakerFabricProvider(client, endpoint=endpoint, config=config)
 
 
 def reset_shared_mock() -> None:

@@ -1,0 +1,171 @@
+"""Chaos fabric provider — fault injection for any FabricProvider.
+
+The fault-injection surface for the resilience layer's tests: where
+``InMemoryPool.inject_add_failure`` scripts failures *inside* the mock pool,
+this decorator injects them *between* the controllers and ANY provider
+(mock, breaker-wrapped, or a real remote client in a staging soak), which is
+where real fabric flakes live — on the wire, before the pool ever sees the
+call. Reference contrast: the reference's fault injection is ~50 scenario
+URLs baked into an httptest persona server
+(composableresource_controller_test.go:737-998); this is the explicit-knob
+equivalent with probabilistic, scripted, and blackout modes.
+
+Knobs (all thread-safe, all injectable mid-run):
+
+- ``failure_rate`` + seeded rng: each verb call fails with probability p
+  (soak tests: "10% transient failure rate");
+- ``fail_node(node, times)``: the next ``times`` node-scoped calls
+  (add/remove/check) targeting ``node`` fail; ``times=-1`` = until healed
+  (the "one persistently flaky chip" scenario driving quarantine);
+- ``fail_op(op, times)``: scripted failures for one verb by name;
+- ``blackout()`` / ``heal()``: every call fails (dead fabric manager) until
+  healed — what trips the endpoint-level breaker;
+- ``latency`` (seconds, or (lo, hi) range): injected delay per call.
+
+All injected failures raise ``TransientFabricError`` — chaos models
+reachability faults; terminal semantics (pool exhausted, bad model) still
+come from the real provider underneath.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+from tpu_composer.api.types import ComposableResource
+from tpu_composer.fabric.provider import (
+    AttachResult,
+    DeviceHealth,
+    FabricDevice,
+    FabricProvider,
+    TransientFabricError,
+)
+
+
+class ChaosFabricProvider(FabricProvider):
+    def __init__(
+        self,
+        inner: FabricProvider,
+        failure_rate: float = 0.0,
+        latency: Union[float, Tuple[float, float]] = 0.0,
+        seed: int = 0,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        self._inner = inner
+        self.failure_rate = failure_rate
+        self.latency = latency
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._blackout = False
+        self._node_failures: Dict[str, int] = {}  # node -> remaining (-1 = forever)
+        self._op_failures: Dict[str, int] = {}  # verb name -> remaining
+        self.calls = 0
+        self.injected = 0  # failures actually raised
+
+    # ------------------------------------------------------------------
+    # injection control
+    # ------------------------------------------------------------------
+    def blackout(self) -> None:
+        """Dead-endpoint mode: every call fails until heal()."""
+        with self._lock:
+            self._blackout = True
+
+    def heal(self) -> None:
+        """Clear the blackout AND all scripted failures."""
+        with self._lock:
+            self._blackout = False
+            self._node_failures.clear()
+            self._op_failures.clear()
+
+    def fail_node(self, node: str, times: int = -1) -> None:
+        """Fail node-scoped calls targeting `node`; -1 = until healed."""
+        with self._lock:
+            self._node_failures[node] = times
+
+    def heal_node(self, node: str) -> None:
+        with self._lock:
+            self._node_failures.pop(node, None)
+
+    def fail_op(self, op: str, times: int = 1) -> None:
+        """Fail the next `times` calls of one verb (e.g. 'get_resources')."""
+        with self._lock:
+            self._op_failures[op] = times
+
+    # ------------------------------------------------------------------
+    def _chaos(self, op: str, node: str = "") -> None:
+        if self.latency:
+            lo, hi = (
+                self.latency if isinstance(self.latency, tuple)
+                else (self.latency, self.latency)
+            )
+            with self._lock:
+                delay = self._rng.uniform(lo, hi)
+            if delay > 0:
+                self._sleep(delay)
+        with self._lock:
+            self.calls += 1
+            if self._blackout:
+                self.injected += 1
+                raise TransientFabricError(f"chaos: endpoint blackout ({op})")
+            if node and self._node_failures.get(node, 0) != 0:
+                if self._node_failures[node] > 0:
+                    self._node_failures[node] -= 1
+                self.injected += 1
+                raise TransientFabricError(
+                    f"chaos: injected {op} failure on {node}"
+                )
+            if self._op_failures.get(op, 0) != 0:
+                if self._op_failures[op] > 0:
+                    self._op_failures[op] -= 1
+                self.injected += 1
+                raise TransientFabricError(f"chaos: injected {op} failure")
+            if self.failure_rate > 0 and self._rng.random() < self.failure_rate:
+                self.injected += 1
+                raise TransientFabricError(
+                    f"chaos: random {op} failure"
+                    + (f" on {node}" if node else "")
+                )
+
+    def __getattr__(self, name: str):
+        # Pool instrumentation (free_chips, attachment_record, inject_*...)
+        # passes through so tests can assert on the wrapped provider.
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
+    # -- provider interface ---------------------------------------------
+    def add_resource(self, resource: ComposableResource) -> AttachResult:
+        self._chaos("add_resource", resource.spec.target_node)
+        return self._inner.add_resource(resource)
+
+    def remove_resource(self, resource: ComposableResource) -> None:
+        self._chaos("remove_resource", resource.spec.target_node)
+        return self._inner.remove_resource(resource)
+
+    def check_resource(self, resource: ComposableResource) -> DeviceHealth:
+        self._chaos("check_resource", resource.spec.target_node)
+        return self._inner.check_resource(resource)
+
+    def get_resources(self) -> List[FabricDevice]:
+        self._chaos("get_resources")
+        return self._inner.get_resources()
+
+    def reserve_slice(
+        self, slice_name: str, model: str, topology: str, nodes: List[str]
+    ) -> None:
+        self._chaos("reserve_slice")
+        return self._inner.reserve_slice(slice_name, model, topology, nodes)
+
+    def release_slice(self, slice_name: str) -> None:
+        self._chaos("release_slice")
+        return self._inner.release_slice(slice_name)
+
+    def resize_slice(
+        self, slice_name: str, model: str, topology: str, nodes: List[str]
+    ) -> None:
+        self._chaos("resize_slice")
+        return self._inner.resize_slice(slice_name, model, topology, nodes)

@@ -10,27 +10,72 @@ Semantics:
   token and retries exactly once (the reference refetches on expiry only —
   retrying on 401 also heals server-side token revocation);
 - responses are parsed as JSON when non-empty; HTTP errors carry the
-  server's ``{"error": ...}`` message when present.
+  server's ``{"error": ...}`` message when present;
+- the error taxonomy is applied HERE, once, for every backend: transport
+  failures (connection reset, refused, DNS, socket timeout) and 5xx raise
+  ``TransientFabricError``; 4xx raise terminal ``HttpStatusError`` — raw
+  urllib exceptions never leak into reconcile loops;
+- idempotent GETs absorb a bounded number of transient failures with
+  decorrelated-jitter backoff before surfacing one (mutating verbs are
+  NEVER retried here — the controllers' level-triggered requeue owns that,
+  and a blind re-PUT could double-submit a non-idempotent pool op).
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import os
+import random
+import socket
+import time
 import urllib.error
 import urllib.request
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
-from tpu_composer.fabric.provider import FabricError
+from tpu_composer.fabric.provider import FabricError, TransientFabricError
 from tpu_composer.fabric.token import TokenCache
+from tpu_composer.runtime.metrics import fabric_retries_total
+
+#: Env override for every remote backend's HTTP timeout (seconds). The
+#: reference hardcodes per-client values (CM 60s, FM 180s, NEC 60s); one
+#: knob beats three constructor plumbing paths when a fabric manager is
+#: known-slow or a test wants sub-second failure detection.
+TIMEOUT_ENV = "TPU_COMPOSER_FABRIC_TIMEOUT"
+
+
+def fabric_timeout(default: float) -> float:
+    """Resolve the HTTP timeout: $TPU_COMPOSER_FABRIC_TIMEOUT wins over the
+    backend's reference-derived default; malformed values fall back."""
+    raw = os.environ.get(TIMEOUT_ENV, "")
+    if raw:
+        try:
+            val = float(raw)
+            if val > 0:
+                return val
+        except ValueError:
+            pass
+    return default
 
 
 class HttpStatusError(FabricError):
-    """Non-2xx response from the fabric endpoint."""
+    """Non-2xx response from the fabric endpoint (terminal: 4xx)."""
 
     def __init__(self, code: int, message: str, body: Optional[Dict[str, Any]] = None):
         super().__init__(message)
         self.code = code
         self.body = body or {}
+
+
+class TransientHttpStatusError(HttpStatusError, TransientFabricError):
+    """5xx — the endpoint is alive but failed server-side; retryable."""
+
+
+def http_status_error(
+    code: int, message: str, body: Optional[Dict[str, Any]] = None
+) -> HttpStatusError:
+    cls = TransientHttpStatusError if code >= 500 else HttpStatusError
+    return cls(code, message, body)
 
 
 class JsonHttpClient:
@@ -39,10 +84,20 @@ class JsonHttpClient:
         base_url: str,
         token_cache: Optional[TokenCache] = None,
         timeout: float = 60.0,
+        get_retries: int = 2,
+        retry_base: float = 0.05,
+        retry_cap: float = 2.0,
+        _sleep: Callable[[float], None] = time.sleep,
+        _rng: Optional[random.Random] = None,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.token_cache = token_cache
         self.timeout = timeout
+        self.get_retries = max(0, get_retries)
+        self.retry_base = retry_base
+        self.retry_cap = retry_cap
+        self._sleep = _sleep
+        self._rng = _rng or random.Random()
 
     def request(
         self,
@@ -51,8 +106,28 @@ class JsonHttpClient:
         body: Optional[Dict[str, Any]] = None,
     ) -> Tuple[int, Dict[str, Any]]:
         """Returns (status_code, parsed_json_or_{}). Raises HttpStatusError on
-        4xx/5xx (other than the single retried 401) and FabricError on
-        transport failure."""
+        4xx (other than the single retried 401) and TransientFabricError on
+        transport failure / 5xx."""
+        retries = self.get_retries if method.upper() == "GET" else 0
+        delay = self.retry_base
+        attempt = 0
+        while True:
+            try:
+                return self._request_auth(method, path, body)
+            except TransientFabricError:
+                if attempt >= retries:
+                    raise
+                attempt += 1
+                fabric_retries_total.inc(endpoint=self.base_url)
+                # Decorrelated jitter: next ∈ U(base, 3·prev), capped.
+                delay = min(
+                    self.retry_cap, self._rng.uniform(self.retry_base, delay * 3)
+                )
+                self._sleep(delay)
+
+    def _request_auth(
+        self, method: str, path: str, body: Optional[Dict[str, Any]]
+    ) -> Tuple[int, Dict[str, Any]]:
         try:
             return self._do(method, path, body)
         except HttpStatusError as e:
@@ -77,11 +152,29 @@ class JsonHttpClient:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return resp.status, _parse(resp.read())
         except urllib.error.HTTPError as e:
-            payload = _parse(e.read())
+            try:
+                payload = _parse(e.read())
+            except OSError:
+                # Reading the error body failed (reset/timeout mid-read).
+                # The status line already arrived — classify on it rather
+                # than leak a raw socket error from inside this handler,
+                # where the sibling except clauses can't catch it.
+                payload = {}
             message = payload.get("error") or f"{method} {url}: HTTP {e.code}"
-            raise HttpStatusError(e.code, message, payload) from e
-        except (urllib.error.URLError, OSError) as e:
-            raise FabricError(f"{method} {url}: {e}") from e
+            raise http_status_error(e.code, message, payload) from e
+        except (
+            urllib.error.URLError,
+            http.client.HTTPException,
+            socket.timeout,
+            OSError,
+        ) as e:
+            # URLError wraps refused/reset/DNS; socket.timeout covers a read
+            # timing out mid-response; HTTPException covers malformed server
+            # responses (BadStatusLine from a dying proxy/LB). All are
+            # endpoint-reachability faults: typed transient, never a raw
+            # urllib/http exception — and the breaker must count them as
+            # failures, not read them as "the endpoint answered".
+            raise TransientFabricError(f"{method} {url}: {e}") from e
 
 
 def _parse(raw: bytes) -> Dict[str, Any]:

@@ -29,7 +29,7 @@ import time
 from typing import List, Optional
 
 from tpu_composer.api.types import ComposableResource
-from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient
+from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient, fabric_timeout
 from tpu_composer.fabric.poolapi import PoolApiMixin
 from tpu_composer.fabric.provider import (
     AttachResult,
@@ -37,6 +37,7 @@ from tpu_composer.fabric.provider import (
     FabricProvider,
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
+    classify_fabric_error,
 )
 from tpu_composer.fabric.token import TokenCache
 
@@ -55,10 +56,12 @@ class LayoutApplyClient(PoolApiMixin, FabricProvider):
         token_cache: Optional[TokenCache] = None,
         poll_interval: float = POLL_INTERVAL_S,
         poll_attempts: int = POLL_ATTEMPTS,
-        timeout: float = 60.0,
+        timeout: Optional[float] = None,
     ) -> None:
         if token_cache is None:
             token_cache = TokenCache.from_env()
+        if timeout is None:
+            timeout = fabric_timeout(60.0)
         self._http = JsonHttpClient(
             endpoint.rstrip("/") + "/v1", token_cache=token_cache, timeout=timeout
         )
@@ -113,7 +116,7 @@ class LayoutApplyClient(PoolApiMixin, FabricProvider):
         except HttpStatusError as e:
             if e.code == 404:
                 return None
-            raise FabricError(f"get attachment {name}: {e}") from e
+            raise classify_fabric_error(e, f"get attachment {name}: {e}") from e
         ids = list(payload.get("device_ids", []))
         if not ids:
             return None
@@ -126,7 +129,9 @@ class LayoutApplyClient(PoolApiMixin, FabricProvider):
             if e.code == 409 and e.body.get("code") == CODE_APPLY_IN_PROGRESS:
                 # Another apply holds the fabric; requeue (nec 409/E40010).
                 raise sentinel(f"{body['resource']}: fabric busy, apply in progress") from e
-            raise FabricError(f"layout-apply {body['resource']}: {e}") from e
+            raise classify_fabric_error(
+                e, f"layout-apply {body['resource']}: {e}"
+            ) from e
         apply_id = payload.get("apply_id", "")
         if not apply_id:
             raise FabricError(f"layout-apply {body['resource']}: no apply_id returned")
@@ -141,7 +146,9 @@ class LayoutApplyClient(PoolApiMixin, FabricProvider):
             try:
                 _, payload = self._http.request("GET", f"/layout-apply/{apply_id}")
             except HttpStatusError as e:
-                raise FabricError(f"{name}: apply {apply_id} status: {e}") from e
+                raise classify_fabric_error(
+                    e, f"{name}: apply {apply_id} status: {e}"
+                ) from e
             status = payload.get("status", "")
             if status == "COMPLETED":
                 return

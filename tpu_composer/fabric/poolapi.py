@@ -17,6 +17,7 @@ from tpu_composer.fabric.provider import (
     FabricDevice,
     FabricError,
     UnsupportedResize,
+    classify_fabric_error,
 )
 
 
@@ -83,10 +84,10 @@ class PoolApiMixin:
                             " the slice already exists — no live-resize"
                             " support"
                         ) from None
-                    raise FabricError(
-                        f"resize_slice {slice_name}: fallback reserve: {re}"
+                    raise classify_fabric_error(
+                        re, f"resize_slice {slice_name}: fallback reserve: {re}"
                     ) from re
-            raise FabricError(f"resize_slice {slice_name}: {e}") from e
+            raise classify_fabric_error(e, f"resize_slice {slice_name}: {e}") from e
         if not 200 <= status < 300:
             raise FabricError(f"resize_slice {slice_name}: HTTP {status}")
 
@@ -97,7 +98,7 @@ class PoolApiMixin:
         except HttpStatusError as e:
             if e.code == 404:
                 return DeviceHealth("Critical", "not attached")
-            raise FabricError(f"check {name}: {e}") from e
+            raise classify_fabric_error(e, f"check {name}: {e}") from e
         return DeviceHealth(
             state=payload.get("state", "Critical"), detail=payload.get("detail", "")
         )
@@ -106,7 +107,7 @@ class PoolApiMixin:
         try:
             _, payload = self._http.request("GET", "/attachments")
         except HttpStatusError as e:
-            raise FabricError(f"get_resources: {e}") from e
+            raise classify_fabric_error(e, f"get_resources: {e}") from e
         return [
             FabricDevice(
                 device_id=item.get("device_id", ""),

@@ -31,7 +31,30 @@ from tpu_composer.api.types import ComposableResource
 
 
 class FabricError(Exception):
-    """Terminal fabric failure — surfaces into status.error."""
+    """Terminal fabric failure — surfaces into status.error.
+
+    Error taxonomy (the resilience layer's contract): raw ``FabricError``
+    means the fabric answered and said NO — retrying the same call cannot
+    succeed without operator/spec intervention (4xx, unknown model, pool
+    exhausted). ``TransientFabricError`` means the fabric may well say yes
+    next time (connection reset, timeout, 5xx, open breaker). Controllers
+    budget and quarantine on transient failures; the circuit breaker counts
+    only them toward tripping.
+    """
+
+
+class TransientFabricError(FabricError):
+    """Retryable fabric failure — the endpoint was unreachable, timed out,
+    or failed server-side (5xx). Safe to retry with backoff; consecutive
+    occurrences count against breaker thresholds and attach budgets."""
+
+
+def classify_fabric_error(cause: Exception, message: str) -> FabricError:
+    """Re-wrap a fabric exception under a new message WITHOUT losing its
+    transient/terminal classification (providers add call context like
+    'attach r0: ...' — the class must survive that wrap)."""
+    cls = TransientFabricError if isinstance(cause, TransientFabricError) else FabricError
+    return cls(message)
 
 
 class WaitingDeviceAttaching(FabricError):

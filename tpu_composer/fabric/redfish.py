@@ -19,7 +19,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from tpu_composer.api.types import ComposableResource
-from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient
+from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient, fabric_timeout
 from tpu_composer.fabric.provider import (
     AttachResult,
     DeviceHealth,
@@ -28,6 +28,7 @@ from tpu_composer.fabric.provider import (
     FabricProvider,
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
+    classify_fabric_error,
 )
 from tpu_composer.fabric.token import TokenCache
 
@@ -37,10 +38,12 @@ class RedfishClient(FabricProvider):
         self,
         endpoint: str,
         token_cache: Optional[TokenCache] = None,
-        timeout: float = 60.0,
+        timeout: Optional[float] = None,
     ) -> None:
         if token_cache is None:
             token_cache = TokenCache.from_env()
+        if timeout is None:
+            timeout = fabric_timeout(60.0)
         self._http = JsonHttpClient(
             endpoint.rstrip("/") + "/redfish/v1", token_cache=token_cache, timeout=timeout
         )
@@ -65,7 +68,7 @@ class RedfishClient(FabricProvider):
         try:
             status, payload = self._http.request("PATCH", f"/Systems/{node}", body)
         except HttpStatusError as e:
-            raise FabricError(f"attach {name}: {e}") from e
+            raise classify_fabric_error(e, f"attach {name}: {e}") from e
         if status == 202:
             raise WaitingDeviceAttaching(f"{name}: composition task accepted")
         # Only blocks labeled with OUR resource name count — aggregating
@@ -97,7 +100,7 @@ class RedfishClient(FabricProvider):
         except HttpStatusError as e:
             if e.code == 404:
                 return  # system or block gone: idempotent
-            raise FabricError(f"detach {name}: {e}") from e
+            raise classify_fabric_error(e, f"detach {name}: {e}") from e
         if status == 202:
             raise WaitingDeviceDetaching(f"{name}: decomposition task accepted")
 
@@ -121,7 +124,7 @@ class RedfishClient(FabricProvider):
         try:
             _, payload = self._http.request("GET", "/Systems")
         except HttpStatusError as e:
-            raise FabricError(f"get_resources: {e}") from e
+            raise classify_fabric_error(e, f"get_resources: {e}") from e
         out: List[FabricDevice] = []
         for member in payload.get("Members", []):
             node = member.get("Id") or member.get("@odata.id", "").rsplit("/", 1)[-1]
@@ -171,7 +174,7 @@ class RedfishClient(FabricProvider):
         except HttpStatusError as e:
             if e.code == 404:
                 return []
-            raise FabricError(f"get system {node}: {e}") from e
+            raise classify_fabric_error(e, f"get system {node}: {e}") from e
         return list(payload.get("Accelerators", []))
 
     def _find_blocks(self, node: str, resource_name: str) -> List[dict]:

@@ -33,7 +33,7 @@ import os
 from typing import Dict, List, Optional
 
 from tpu_composer.api.types import ComposableResource
-from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient
+from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient, fabric_timeout
 from tpu_composer.fabric.poolapi import PoolApiMixin
 from tpu_composer.fabric.provider import (
     AttachResult,
@@ -41,6 +41,7 @@ from tpu_composer.fabric.provider import (
     FabricProvider,
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
+    classify_fabric_error,
 )
 from tpu_composer.fabric.token import TokenCache
 
@@ -60,7 +61,7 @@ class RestPoolClient(PoolApiMixin, FabricProvider):
         timeout: Optional[float] = None,
     ) -> None:
         if timeout is None:
-            timeout = FM_TIMEOUT_S if synchronous else CM_TIMEOUT_S
+            timeout = fabric_timeout(FM_TIMEOUT_S if synchronous else CM_TIMEOUT_S)
         if token_cache is None:
             token_cache = TokenCache.from_env()
         self.synchronous = synchronous
@@ -94,7 +95,7 @@ class RestPoolClient(PoolApiMixin, FabricProvider):
                 "PUT", f"/attachments/{name}" + self._wait_qs(), body
             )
         except HttpStatusError as e:
-            raise FabricError(f"attach {name}: {e}") from e
+            raise classify_fabric_error(e, f"attach {name}: {e}") from e
         if status == 202:
             raise WaitingDeviceAttaching(
                 f"{name}: attach in progress ({payload.get('state', 'attaching')})"
@@ -123,7 +124,7 @@ class RestPoolClient(PoolApiMixin, FabricProvider):
         except HttpStatusError as e:
             if e.code == 404:
                 return  # unknown attachment: idempotent no-op
-            raise FabricError(f"detach {name}: {e}") from e
+            raise classify_fabric_error(e, f"detach {name}: {e}") from e
         if status == 202:
             raise WaitingDeviceDetaching(
                 f"{name}: detach in progress ({payload.get('state', 'detaching')})"

@@ -42,6 +42,13 @@ class Counter:
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def remove(self, **labels: str) -> None:
+        """Drop one label-set's series (e.g. a deleted node's breaker
+        gauges) so churning fleets don't grow /metrics unboundedly."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values.pop(key, None)
+
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -188,6 +195,28 @@ fabric_requests_total = global_registry.counter(
 )
 composed_chips = global_registry.gauge(
     "tpuc_composed_chips", "Currently attached chips by node"
+)
+
+#: Fabric resilience layer (error taxonomy + breaker + quarantine).
+fabric_retries_total = global_registry.counter(
+    "tpuc_fabric_retries_total",
+    "Transport-level retries of idempotent fabric GETs after transient errors",
+)
+fabric_breaker_state = global_registry.gauge(
+    "tpuc_fabric_breaker_state",
+    "Circuit breaker state per endpoint/scope (0=closed, 1=open, 2=half-open)",
+)
+fabric_breaker_trips_total = global_registry.counter(
+    "tpuc_fabric_breaker_trips_total",
+    "Breaker transitions into open, by endpoint/scope",
+)
+fabric_breaker_rejections_total = global_registry.counter(
+    "tpuc_fabric_breaker_rejections_total",
+    "Fabric calls rejected immediately because a breaker was open",
+)
+resources_quarantined_total = global_registry.counter(
+    "tpuc_resources_quarantined_total",
+    "ComposableResources quarantined after exhausting their attach budget",
 )
 
 

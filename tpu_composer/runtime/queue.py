@@ -8,13 +8,18 @@ controller-runtime reconciler in /root/reference/internal/controller. Contract:
   ``done`` is called (the "dirty" set);
 - ``add_after(key, delay)`` schedules a delayed requeue (the reference's
   ``RequeueAfter: 30s`` results);
-- ``add_rate_limited(key)`` applies per-key exponential backoff (failures);
+- ``add_rate_limited(key)`` applies per-key exponential backoff with
+  decorrelated jitter (failures) — deterministic 2^n backoff made every key
+  that failed during a fabric blackout requeue in the same instant when it
+  healed (thundering herd into the just-recovered endpoint); jitter spreads
+  the recovery wave while keeping the same expected growth;
 - ``forget(key)`` resets the backoff (successful reconcile).
 """
 
 from __future__ import annotations
 
 import heapq
+import random
 import threading
 import time
 from typing import Dict, Hashable, List, Optional, Set, Tuple
@@ -25,9 +30,13 @@ class RateLimitingQueue:
         self,
         base_delay: float = 0.005,
         max_delay: float = 16.0,
+        jitter: Optional[random.Random] = None,
     ) -> None:
         self._base_delay = base_delay
         self._max_delay = max_delay
+        self._rng = jitter or random.Random()
+        # key -> last jittered delay (decorrelated jitter state)
+        self._last_delay: Dict[Hashable, float] = {}
         self._cond = threading.Condition()
         self._queue: List[Hashable] = []
         self._queued: Set[Hashable] = set()
@@ -65,13 +74,22 @@ class RateLimitingQueue:
 
     def add_rate_limited(self, key: Hashable) -> None:
         with self._cond:
-            n = self._failures.get(key, 0)
-            self._failures[key] = n + 1
-        self.add_after(key, min(self._base_delay * (2 ** n), self._max_delay))
+            self._failures[key] = self._failures.get(key, 0) + 1
+            # Decorrelated jitter (the AWS formula): next ∈ U(base, 3·prev),
+            # capped. Expected growth ≈ 1.5x/attempt — same shape as the old
+            # 2^n curve, but two keys failing in lockstep drift apart
+            # instead of hammering the store/fabric on synchronized beats.
+            prev = self._last_delay.get(key, self._base_delay)
+            delay = min(
+                self._max_delay, self._rng.uniform(self._base_delay, prev * 3)
+            )
+            self._last_delay[key] = delay
+        self.add_after(key, delay)
 
     def forget(self, key: Hashable) -> None:
         with self._cond:
             self._failures.pop(key, None)
+            self._last_delay.pop(key, None)
 
     def retries(self, key: Hashable) -> int:
         with self._cond:
