@@ -15,8 +15,10 @@ test:
 ## test-par: the suite across N workers (multi-core boxes / CI; the AOT
 ## files share one worker via xdist_group — libtpu aborts on concurrent
 ## topology init). Single-core boxes should use plain `make test`.
+## MARKS narrows by pytest marker expression (CI runs MARKS="not sim" and
+## gives the scheduler trace replays their own step).
 test-par:
-	$(PYTHON) -m pytest tests/ -q -n $(or $(WORKERS),4) --dist loadgroup
+	$(PYTHON) -m pytest tests/ -q -n $(or $(WORKERS),4) --dist loadgroup $(if $(MARKS),-m "$(MARKS)")
 
 ## test-fast: stop at first failure
 test-fast:

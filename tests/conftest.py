@@ -62,6 +62,12 @@ def pytest_configure(config):
         "chaos: fault-injection soak driven by fabric/chaos.py (always also"
         " marked slow; run with `-m chaos`)",
     )
+    config.addinivalue_line(
+        "markers",
+        "sim: long cluster-simulation trace replay against the scheduler"
+        " (always also marked slow so tier-1's `-m 'not slow'` excludes it;"
+        " run with `-m sim`)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

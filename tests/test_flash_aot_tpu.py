@@ -82,6 +82,52 @@ class TestPagedDecodeCompilesForTPU:
         )).lower(*args).compile()
         assert compiled is not None
 
+    def test_paged_decode_kernel_bench_shape(self):
+        """The BENCH decode model's exact shape (ADVICE r5): d1024 H16 kv4
+        -> head_dim=64, 4 KV heads, group size 4. The gates above only
+        compile head_dim=128 / kv=2, so a Mosaic rejection at the bench
+        shape (e.g. a sub-128-lane relayout on the 64-wide head dim) would
+        otherwise first surface as `paged_error` on real hardware."""
+        import functools
+
+        from tpu_composer.ops.paged_attention import paged_decode_attention
+
+        n, bs, kv, dh, b, h, mb = 64, 128, 4, 64, 8, 16, 16
+        args = (
+            _sds((b, h, dh), jnp.bfloat16),        # q
+            _sds((n, bs, kv, dh), jnp.bfloat16),   # k_pool
+            _sds((n, bs, kv, dh), jnp.bfloat16),   # v_pool
+            _sds((b, mb), jnp.int32),              # block_tables
+            _sds((b,), jnp.int32),                 # lengths
+        )
+        compiled = jax.jit(functools.partial(
+            paged_decode_attention, interpret=False
+        )).lower(*args).compile()
+        assert compiled is not None
+
+    def test_paged_decode_kernel_bench_shape_int8(self):
+        """Same bench shape through the int8-pool variant — the serving
+        bench's int8_w_int8_kv path (quant_speedup headline) compiles a
+        different kernel body (scale blocks on the table-routed maps)."""
+        import functools
+
+        from tpu_composer.ops.paged_attention import paged_decode_attention
+
+        n, bs, kv, dh, b, h, mb = 64, 128, 4, 64, 8, 16, 16
+        args = (
+            _sds((b, h, dh), jnp.bfloat16),        # q
+            _sds((n, bs, kv, dh), jnp.int8),       # k_pool
+            _sds((n, bs, kv, dh), jnp.int8),       # v_pool
+            _sds((b, mb), jnp.int32),              # block_tables
+            _sds((b,), jnp.int32),                 # lengths
+            _sds((n, bs, kv), jnp.float32),        # k_scale
+            _sds((n, bs, kv), jnp.float32),        # v_scale
+        )
+        compiled = jax.jit(functools.partial(
+            paged_decode_attention, interpret=False
+        )).lower(*args).compile()
+        assert compiled is not None
+
     def test_paged_decode_kernel_int8(self):
         """The int8-pool variant (scale blocks riding the table-routed
         index maps) lowers through Mosaic for v5e too."""

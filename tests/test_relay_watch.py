@@ -54,6 +54,7 @@ def _paths(tmp_path):
         log_path=str(tmp_path / "watch.jsonl"),
         archive_path=str(tmp_path / "probe.json"),
         pid_path=str(tmp_path / "watch.pid"),
+        marker_path=str(tmp_path / "capture_in_progress.json"),
     )
 
 
@@ -212,9 +213,7 @@ def test_capture_marker_guards_concurrent_handshakes(tmp_path, monkeypatch):
         lambda **kw: [{"endpoint": "127.0.0.1:8082", "reachable": False}],
     )
     p = _paths(tmp_path)
-    marker = str(tmp_path / "probe.json").replace(
-        "probe.json", "capture_in_progress.json"
-    )
+    marker = p["marker_path"]
     seen_during = []
 
     def _probe(**kw):
@@ -287,6 +286,62 @@ def test_hold_capture_marker_acquire_semantics(tmp_path):
         assert held is True
         assert json.load(open(marker))["pid"] == os.getpid()
     assert not os.path.exists(marker)
+
+
+def test_try_acquire_marker_three_states(tmp_path, monkeypatch):
+    """acquired / held-by-other / unguarded are distinct outcomes, and
+    only an ACQUIRED marker is unlinked on exit — an unguarded client
+    (filesystem refused the claim) must never delete a live peer's
+    marker."""
+    marker = str(tmp_path / "capture_in_progress.json")
+    assert rw._try_acquire_marker(marker) == rw.MARKER_ACQUIRED
+    os.unlink(marker)
+    # Foreign live marker → held.
+    with open(marker, "w") as f:
+        json.dump({"pid": 1, "start": rw._proc_start_time(1)}, f)
+    assert rw._try_acquire_marker(marker) == rw.MARKER_HELD
+    # Filesystem refusing the claim (EACCES and friends) → unguarded, and
+    # the hold context proceeds WITHOUT unlinking the peer's marker on
+    # exit — the transient-OSError path used to delete it.
+    real_open = os.open
+
+    def _refuse(path, flags, *a, **kw):
+        if path == marker and flags & os.O_EXCL:
+            raise PermissionError(13, "injected EACCES", path)
+        return real_open(path, flags, *a, **kw)
+
+    monkeypatch.setattr(os, "open", _refuse)
+    assert rw._try_acquire_marker(marker) == rw.MARKER_UNGUARDED
+    with rw.hold_capture_marker(marker) as held:
+        assert held is True  # unguarded still proceeds (capture > lockout)
+    assert os.path.exists(marker)  # the peer's marker survived
+    monkeypatch.undo()
+    assert json.load(open(marker))["pid"] == 1
+
+
+def test_watch_relay_serializes_on_canonical_marker(tmp_path, monkeypatch):
+    """A watcher pointed at a NON-default archive path must still defer to
+    a client holding the (explicitly passed) marker — exclusion is keyed
+    on marker_path, never derived from archive_path."""
+    marker = str(tmp_path / "shared_marker.json")
+    with open(marker, "w") as f:
+        json.dump({"pid": 1, "start": rw._proc_start_time(1)}, f)
+    monkeypatch.setattr(
+        probe, "probe_pool_endpoints",
+        lambda **kw: [{"endpoint": "127.0.0.1:8082", "reachable": True}],
+    )
+
+    def _boom(**kw):
+        raise AssertionError("dialed while the canonical marker was held")
+
+    monkeypatch.setattr(probe, "staged_accelerator_probe", _boom)
+    p = _paths(tmp_path)
+    p["archive_path"] = str(tmp_path / "elsewhere" / "archive.json")
+    p["marker_path"] = marker
+    rc = rw.watch_relay(poll_s=0.01, max_hours=0.0001, **p)
+    assert rc == 1
+    events = [json.loads(l) for l in open(p["log_path"])]
+    assert any(e.get("event") == "capture_deferred" for e in events)
 
 
 def test_stale_capture_marker_reads_idle(tmp_path):

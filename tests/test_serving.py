@@ -360,6 +360,18 @@ class TestPrefixCaching:
         with pytest.raises(ValueError, match="chunked admission"):
             eng2.submit([1, 2], 2, prefix=h)
 
+    def test_register_prefix_rejected_on_bucketed_engine(self, world):
+        """Registration must fail where attachment would: a bucketed
+        engine (no prefill_chunk) can never submit against a prefix, so a
+        registered one would hold pool blocks forever."""
+        c, p = world
+        eng = ContinuousBatchingEngine(p, c, slots=1, num_blocks=16,
+                                       block_size=8)
+        free_before = int(eng.cache.free_top)
+        with pytest.raises(ValueError, match="chunked admission"):
+            eng.register_prefix(list(range(1, 9)))
+        assert int(eng.cache.free_top) == free_before  # nothing leaked
+
 
 class TestCancellation:
     def test_cancel_in_every_lifecycle_stage(self, world):

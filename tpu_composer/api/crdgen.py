@@ -17,6 +17,9 @@ from typing import Dict, List
 from tpu_composer.api.types import (
     ALLOCATION_POLICIES,
     DEVICE_TYPES,
+    PREEMPTION_POLICIES,
+    PRIORITY_MAX,
+    PRIORITY_MIN,
 )
 
 from tpu_composer import GROUP, VERSION  # single source of truth for the API group
@@ -33,12 +36,14 @@ def _str(desc: str = "", enum: List[str] = None, min_length: int = 0) -> Dict:
     return s
 
 
-def _int(desc: str = "", minimum: int = None) -> Dict:
+def _int(desc: str = "", minimum: int = None, maximum: int = None) -> Dict:
     s: Dict = {"type": "integer"}
     if desc:
         s["description"] = desc
     if minimum is not None:
         s["minimum"] = minimum
+    if maximum is not None:
+        s["maximum"] = maximum
     return s
 
 
@@ -119,7 +124,23 @@ COMPOSABILITY_REQUEST_SCHEMA = _obj(
         "apiVersion": _str(),
         "kind": _str(),
         "metadata": {"type": "object"},
-        "spec": _obj({"resource": _RESOURCE_DETAILS}, required=["resource"]),
+        "spec": _obj(
+            {
+                "resource": _RESOURCE_DETAILS,
+                "priority": _int(
+                    "Scheduling priority: higher places first and may preempt"
+                    " strictly-lower-priority requests (scheduler/).",
+                    minimum=PRIORITY_MIN,
+                    maximum=PRIORITY_MAX,
+                ),
+                "preemptionPolicy": _str(
+                    "PreemptLowerPriority (default) or Never: Never neither"
+                    " preempts nor may be preempted/defrag-migrated.",
+                    enum=list(PREEMPTION_POLICIES),
+                ),
+            },
+            required=["resource"],
+        ),
         "status": _obj(
             {
                 "state": _str(),

@@ -57,6 +57,19 @@ DEVICE_TYPES = ("tpu", "gpu", "cxlmemory")
 # place a connected slice across however many hosts its shape requires.
 ALLOCATION_POLICIES = ("samenode", "differentnode", "topology")
 
+# Preemption policies — modeled after PriorityClass.preemptionPolicy, but a
+# single knob with victim-side meaning too: the default lets a request both
+# preempt strictly-lower-priority requests and be preempted by strictly-higher
+# ones; "Never" opts the request out of preemption in BOTH directions (it
+# neither evicts others nor may be chosen as a victim or defrag migrant).
+PREEMPT_LOWER_PRIORITY = "PreemptLowerPriority"
+PREEMPT_NEVER = "Never"
+PREEMPTION_POLICIES = (PREEMPT_LOWER_PRIORITY, PREEMPT_NEVER)
+
+# Priority bounds (k8s user-priority range).
+PRIORITY_MIN = -1_000_000_000
+PRIORITY_MAX = 1_000_000_000
+
 FINALIZER = "tpu.composer.dev/finalizer"  # analog of com.ie.ibm.hpsys/finalizer
 
 # Annotations (reference: cohdi.io/* at composabilityrequest_controller.go:46-47)
@@ -186,16 +199,40 @@ class ResourceDetails:
 @dataclass
 class ComposabilityRequestSpec:
     resource: ResourceDetails = field(default_factory=ResourceDetails)
+    # Cluster-scheduler arbitration (scheduler/): higher priority places
+    # first and may preempt strictly-lower-priority requests when capacity
+    # is fragmented away. 0 is the batch default.
+    priority: int = 0
+    preemption_policy: str = PREEMPT_LOWER_PRIORITY
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"resource": self.resource.to_dict()}
+        d: Dict[str, Any] = {"resource": self.resource.to_dict()}
+        if self.priority:
+            d["priority"] = self.priority
+        if self.preemption_policy != PREEMPT_LOWER_PRIORITY:
+            d["preemptionPolicy"] = self.preemption_policy
+        return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ComposabilityRequestSpec":
-        return cls(resource=ResourceDetails.from_dict(d.get("resource", {})))
+        return cls(
+            resource=ResourceDetails.from_dict(d.get("resource", {})),
+            priority=int(d.get("priority", 0)),
+            preemption_policy=d.get("preemptionPolicy", PREEMPT_LOWER_PRIORITY),
+        )
 
     def validate(self) -> None:
         self.resource.validate()
+        if not PRIORITY_MIN <= self.priority <= PRIORITY_MAX:
+            raise ValidationError(
+                f"priority must be within [{PRIORITY_MIN}, {PRIORITY_MAX}],"
+                f" got {self.priority}"
+            )
+        if self.preemption_policy not in PREEMPTION_POLICIES:
+            raise ValidationError(
+                f"preemptionPolicy must be one of {PREEMPTION_POLICIES},"
+                f" got {self.preemption_policy!r}"
+            )
 
 
 @dataclass

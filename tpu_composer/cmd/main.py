@@ -42,6 +42,20 @@ from tpu_composer.runtime.manager import Manager
 from tpu_composer.runtime.store import Store
 
 
+def _env_seconds(name: str, default: float) -> float:
+    """Env knob holding a number of seconds; a malformed value must die as
+    a clean startup error, not an argparse-construction traceback."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise SystemExit(
+            f"bad {name}={raw!r}: expected seconds as a plain number"
+        )
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpu-composer",
@@ -129,6 +143,20 @@ def build_parser() -> argparse.ArgumentParser:
         type=float,
         default=600.0,
         help="grace before orphaned fabric devices are force-detached (reference: 600)",
+    )
+    p.add_argument(
+        "--defrag-interval",
+        type=float,
+        default=_env_seconds("TPUC_DEFRAG_INTERVAL", 0.0),
+        help="seconds between defragmentation planner passes (0 disables;"
+             " env TPUC_DEFRAG_INTERVAL)",
+    )
+    p.add_argument(
+        "--defrag-execute",
+        action="store_true",
+        default=os.environ.get("TPUC_DEFRAG_EXECUTE", "") == "1",
+        help="execute defrag plans (migrate workers via re-solve) instead"
+             " of dry-run logging them (env TPUC_DEFRAG_EXECUTE=1)",
     )
     p.add_argument(
         "--webhook-bind-address",
@@ -277,11 +305,20 @@ def build_manager(args: argparse.Namespace) -> Manager:
         metrics_keyfile=args.metrics_key or None,
         metrics_token_file=args.metrics_token_file or None,
     )
+    from tpu_composer.scheduler import ClusterScheduler, DefragLoop
+
+    scheduler = ClusterScheduler(store)
     mgr.add_controller(ComposabilityRequestReconciler(store, fabric,
-                                                      recorder=mgr.recorder))
+                                                      recorder=mgr.recorder,
+                                                      scheduler=scheduler))
     res_rec = ComposableResourceReconciler(store, fabric, agent,
                                            recorder=mgr.recorder)
     mgr.add_controller(res_rec)
+    if args.defrag_interval > 0:
+        mgr.add_runnable(DefragLoop(store, scheduler.defrag,
+                                    period=args.defrag_interval,
+                                    execute=args.defrag_execute,
+                                    recorder=mgr.recorder))
     mgr.add_runnable(UpstreamSyncer(store, fabric, period=args.sync_period,
                                     grace=args.sync_grace,
                                     recorder=mgr.recorder))

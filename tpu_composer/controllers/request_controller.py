@@ -28,15 +28,22 @@ TPU-first deltas (SURVEY.md §5/§7):
 
 gpu/cxlmemory requests keep the reference's independent-device semantics
 (BASELINE.json config[0] compatibility).
+
+Placement is DELEGATED: the node-picking logic that used to live inline here
+(_pick_nodes / _pick_extra_nodes / _used_slots_map) moved to
+``tpu_composer/scheduler/`` — this controller asks the ClusterScheduler
+where a slice goes (priority arbitration, gang admission, preemption) and
+executes the decision: writing placeholders, reserving the fabric, and —
+when the scheduler names victims — driving their eviction through the same
+child-delete / re-solve paths every other disruption uses.
 """
 
 from __future__ import annotations
 
-import threading
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional
 
 from tpu_composer.agent.publisher import quarantined_nodes
 from tpu_composer.api.meta import now_iso, parse_iso
@@ -66,7 +73,11 @@ from tpu_composer.fabric.provider import (
 )
 from tpu_composer.runtime.controller import Controller, Result
 from tpu_composer.runtime.events import WARNING, EventRecorder
-from tpu_composer.runtime.metrics import attach_to_ready_seconds, reconcile_total
+from tpu_composer.runtime.metrics import (
+    attach_to_ready_seconds,
+    reconcile_total,
+    scheduler_preemptions_total,
+)
 from tpu_composer.runtime.store import (
     ConflictError,
     NotFoundError,
@@ -75,7 +86,8 @@ from tpu_composer.runtime.store import (
     WatchEvent,
     delete_tolerant,
 )
-from tpu_composer.topology.slices import SliceShape, TopologyError, is_tpu_model, solve_slice
+from tpu_composer.scheduler import AllocationError, ClusterScheduler
+from tpu_composer.topology.slices import TopologyError, solve_slice
 
 
 @dataclass
@@ -88,10 +100,6 @@ class RequestTiming:
     # reference's fixed requeue (:585) is its primary detection quantum.
     running_poll: float = 30.0
     cleaning_poll: float = 0.3  # children-still-terminating re-check (30s, :611)
-
-
-class AllocationError(FabricError):
-    """No valid placement exists right now — surfaced in status.error."""
 
 
 def generate_resource_name(device_type: str) -> str:
@@ -109,16 +117,22 @@ class ComposabilityRequestReconciler(Controller):
         fabric: FabricProvider,
         timing: Optional[RequestTiming] = None,
         recorder: Optional[EventRecorder] = None,
+        scheduler: Optional[ClusterScheduler] = None,
     ) -> None:
         super().__init__(store)
         self.fabric = fabric
         self.timing = timing or RequestTiming()
         self.recorder = recorder or EventRecorder()
+        # The cluster-wide placement authority (scheduler/). Shared with the
+        # DefragLoop when cmd/main wires one; tests may inject their own.
+        self.scheduler = scheduler or ClusterScheduler(store)
         # Placement decisions must be serialized: two concurrent allocations
         # would otherwise both pick the same least-loaded node before either
         # writes its placeholders (the reference gets this implicitly from
-        # controller-runtime's default MaxConcurrentReconciles=1).
-        self._alloc_lock = threading.Lock()
+        # controller-runtime's default MaxConcurrentReconciles=1). The lock
+        # is the SCHEDULER's so the defrag executor contends on the same
+        # one — its verify+delete must not interleave with a placement.
+        self._alloc_lock = self.scheduler.alloc_lock
         # Request names whose folded child statuses haven't been written yet
         # (each reconcile is single-threaded per name; the set is only ever
         # touched for the name being reconciled).
@@ -249,6 +263,13 @@ class ComposabilityRequestReconciler(Controller):
         changed = False
         for name, child in children.items():
             rs = req.status.resources.get(name)
+            if child.being_deleted and rs is None:
+                # A draining child whose row is already gone (preemption
+                # clears rows on eviction): resurrecting it would plant a
+                # phantom placeholder claim — in NodeAllocating the
+                # removal branch below never drops rows, so the claim
+                # would outlive the child and block other placements.
+                continue
             new = ResourceStatus(
                 state=child.status.state,
                 node_name=child.spec.target_node,
@@ -401,7 +422,7 @@ class ComposabilityRequestReconciler(Controller):
             # delta on fresh hosts appended after the stable prefix. A
             # provider without live resize forces the dissolve-and-rebuild
             # path instead (release+reserve under running pods is unsafe).
-            extra = self._pick_extra_nodes(
+            extra = self.scheduler.place_extra(
                 req, shape, exclude=set(cur_hosts),
                 count=shape.num_hosts - len(healthy),
                 quarantined=quarantined_nodes,
@@ -417,10 +438,26 @@ class ComposabilityRequestReconciler(Controller):
             self._retopologize(healthy, shape.topology)
         else:
             self.fabric.release_slice(slice_name)
-            nodes = self._pick_nodes(req, shape, quarantined_nodes)
+            placement = self.scheduler.place(req, shape, quarantined_nodes)
+            if placement.victims:
+                self._preempt(req, placement.victims)
+                raise AllocationError(
+                    f"preempting {len(placement.victims)} lower-priority"
+                    f" request(s) ({', '.join(placement.victims)});"
+                    " waiting for their capacity to drain"
+                )
+            nodes = placement.nodes
             try:
                 self.fabric.reserve_slice(slice_name, res.model, shape.topology, nodes)
             except FabricError:
+                # place() dequeued this request on success; a failed
+                # reservation (transient fabric fault, open breaker) means
+                # it is still unplaced — put the backfill-gate protection
+                # back before the backoff retry, or a lower-priority
+                # request could take the very hosts just picked.
+                self.scheduler.requeue(
+                    req, shape.num_hosts, shape.chips_per_host
+                )
                 raise
         # Placeholders + authoritative coordinates (:471-484, plus slice
         # block for webhook injection). Kept children retain their status
@@ -447,48 +484,69 @@ class ComposabilityRequestReconciler(Controller):
         self._write_status(req)
         return Result(requeue_after=0.0)
 
-    def _pick_nodes(
-        self, req: ComposabilityRequest, shape: SliceShape,
-        quarantined: set,
-    ) -> List[str]:
-        """Choose shape.num_hosts nodes with free TPU ports + capacity.
-        `quarantined` is the allocation pass's one DeviceTaintRule scan
-        (_quarantined_nodes), threaded through so no picker re-lists.
-
-        Policies (:361-467 analog): explicit target_node (single-host only),
-        samenode (single-host auto-pick), differentnode/topology (spread).
-        """
-        res = req.spec.resource
-        if res.target_node:
-            if shape.num_hosts > 1:
-                raise AllocationError(
-                    f"topology {shape.topology} spans {shape.num_hosts} hosts;"
-                    " target_node only supports single-host slices"
+    def _preempt(self, req: ComposabilityRequest, victims: List[str]) -> None:
+        """Evict the scheduler's victim set through the normal controller
+        paths: delete each victim's children (the resource controller
+        drains/detaches them) and push the victim back to NodeAllocating so
+        an Updating victim cannot recreate children from its placeholder
+        rows and steal the capacity back. The victim's own re-solve then
+        releases its fabric reservation, fails placement (the backfill gate
+        protects the pending preemptor), and re-queues until capacity
+        returns."""
+        for v_name in victims:
+            v = self.store.try_get(ComposabilityRequest, v_name)
+            if v is None or v.being_deleted:
+                continue
+            self.recorder.event(
+                v, WARNING, "Preempted",
+                f"preempted by {req.name} (priority {req.spec.priority} >"
+                f" {v.spec.priority}); re-queued until capacity returns",
+            )
+            self.recorder.event(
+                req, "Normal", "Preempting",
+                f"evicting lower-priority request {v_name} to free capacity",
+            )
+            self._delete_children(v, [c for c in self._children(v)
+                                      if not c.being_deleted])
+            scheduler_preemptions_total.inc()
+            # Every pre-terminal state, including a victim ALREADY in
+            # NodeAllocating (mid-re-solve after a Degraded event): its
+            # placeholder rows are capacity claims (used_slots_map counts
+            # them), and a preempted request keeping rows for the very
+            # hosts it was evicted from would read as still pinning them —
+            # the preemptor would name it a victim again every pass. The
+            # write RETRIES on conflict: the child deletions above race the
+            # victim's own reconcile, and losing the write while the
+            # victim sits in Updating would let _handle_updating recreate
+            # the just-deleted children from its placeholder rows — the
+            # eviction would converge to resurrection, not re-queueing.
+            for _ in range(4):
+                if v is None or v.being_deleted or v.status.state in (
+                    REQUEST_STATE_CLEANING, REQUEST_STATE_DELETING,
+                ):
+                    break
+                v.status.state = REQUEST_STATE_NODE_ALLOCATING
+                v.status.error = (
+                    f"preempted by higher-priority request {req.name}"
                 )
-            node = self.store.try_get(Node, res.target_node)
-            if node is None:
-                raise AllocationError(f"target node {res.target_node} does not exist")
-            if res.target_node in quarantined:
-                raise AllocationError(
-                    f"target node {res.target_node} is quarantined"
-                    " (fabric attach budget exhausted)"
+                v.status.resources = {}
+                try:
+                    self.store.update_status(v)
+                    break
+                except NotFoundError:
+                    break
+                except ConflictError:
+                    v = self.store.try_get(ComposabilityRequest, v_name)
+            else:
+                # Never silent: an Updating victim whose push kept losing
+                # will recreate its children from placeholder rows, and
+                # the next preemption pass re-names it — this log is the
+                # only trace of that loop's cause.
+                self.log.warning(
+                    "preemption of %s by %s: status push kept conflicting;"
+                    " victim may recreate children until the next pass",
+                    v_name, req.name,
                 )
-            if not self._node_fits(req, node, shape.chips_per_host, self._used_slots_map(req.name)):
-                raise AllocationError(
-                    f"target node {res.target_node} lacks capacity for"
-                    f" {shape.chips_per_host} chips"
-                )
-            return [res.target_node]
-
-        # For tpu, allocation_policy does not constrain host count — the
-        # topology dictates it (a 2x2x2 slice needs exactly 2 hosts). The
-        # policy is honored as a placement preference: tightest-fit packing
-        # (see _pick_extra_nodes); differentnode is identical for slices
-        # since workers always land on distinct hosts.
-        return self._pick_extra_nodes(
-            req, shape, exclude=set(), count=shape.num_hosts,
-            quarantined=quarantined,
-        )
 
     def _retopologize(self, children: List[ComposableResource], topology: str) -> None:
         """Rewrite spec.topology on surviving members after a live resize.
@@ -507,94 +565,6 @@ class ComposabilityRequestReconciler(Controller):
                     # that keeps failing is visible; anything else raises.
                     self.log.info("retopologize %s -> %s deferred: %s",
                                   c.name, topology, e)
-
-    def _pick_extra_nodes(
-        self, req: ComposabilityRequest, shape: SliceShape,
-        exclude: set, count: int, quarantined: set,
-    ) -> List[str]:
-        """Slice placement: `count` hosts with capacity for one worker's
-        chip group each. Fresh allocations pass exclude=∅ and the full host
-        count; the grow path excludes surviving members' hosts and asks for
-        only the delta — one filter/sort, so placement policy can't diverge
-        between the two. `quarantined` comes from the caller's single
-        _quarantined_nodes scan."""
-        used = self._used_slots_map(req.name)
-        candidates = [
-            n for n in self.store.list(Node)
-            if n.metadata.name not in exclude
-            and n.metadata.name not in quarantined
-            and n.status.ready and not n.spec.unschedulable
-            and self._node_fits(req, n, shape.chips_per_host, used)
-        ]
-        if len(candidates) < count:
-            raise AllocationError(
-                f"need {count} {'more ' if exclude else ''}hosts with"
-                f" {shape.chips_per_host} free TPU ports for"
-                f" {shape.topology}, only {len(candidates)} available"
-            )
-        # Tightest-fit first (fewest ports left free after placement):
-        # sub-host chip groups pack onto already-fragmented hosts, keeping
-        # whole hosts intact for the topology shapes that need all their
-        # ports. The 256-node mixed-size storm exposed the opposite
-        # (least-loaded-first) policy deadlocking whole-host slices behind
-        # scattered singles — fragmentation the reference never sees
-        # because its devices are independent, while TPU workers are
-        # all-or-nothing port groups.
-        candidates.sort(
-            key=lambda n: (
-                n.status.tpu_slots - used.get(n.name, 0), n.name
-            )
-        )
-        return [n.metadata.name for n in candidates[:count]]
-
-    def _used_slots_map(self, exclude_request: str = "") -> Dict[str, int]:
-        """node -> chips already claimed there: instantiated children PLUS
-        other requests' placeholder rows whose child doesn't exist yet —
-        without the placeholder term, concurrent allocations all pick the
-        same least-loaded node before any child materializes (the occupancy
-        check vs other requests, composabilityrequest_controller.go:386-443).
-        Built in one pass over the store; allocation holds _alloc_lock, so
-        per-candidate rescans would serialize the whole fleet behind O(N*R)
-        work."""
-        used: Dict[str, int] = {}
-        existing = {c.name: c for c in self.store.list(ComposableResource)}
-        for c in existing.values():
-            if (
-                not c.being_deleted
-                and c.metadata.labels.get(LABEL_MANAGED_BY) != exclude_request
-            ):
-                n = c.spec.chip_count if c.spec.type == "tpu" else 1
-                used[c.spec.target_node] = used.get(c.spec.target_node, 0) + n
-        for other in self.store.list(ComposabilityRequest):
-            if other.name == exclude_request or other.being_deleted:
-                continue
-            per_member = (
-                other.status.slice.chips_per_host
-                if other.spec.resource.type == "tpu" and other.status.slice.chips_per_host
-                else 1
-            )
-            for name, rs in other.status.resources.items():
-                if name not in existing and rs.node_name:
-                    used[rs.node_name] = used.get(rs.node_name, 0) + per_member
-        return used
-
-    def _node_fits(
-        self, req: ComposabilityRequest, node: Node, chips: int,
-        used: Dict[str, int],
-    ) -> bool:
-        if node.status.tpu_slots - used.get(node.metadata.name, 0) < chips:
-            return False
-        other = req.spec.resource.other_spec
-        if other is not None:
-            # CheckNodeCapacitySufficient analog (utils/nodes.go:78-117).
-            if (
-                node.status.milli_cpu < other.milli_cpu
-                or node.status.memory < other.memory
-                or node.status.ephemeral_storage < other.ephemeral_storage
-                or node.status.allowed_pod_number < other.allowed_pod_number
-            ):
-                return False
-        return True
 
     # -- scalar (gpu/cxlmemory) allocation ------------------------------
     def _allocate_scalar(self, req: ComposabilityRequest, children) -> Result:
@@ -647,55 +617,13 @@ class ComposabilityRequestReconciler(Controller):
     def _pick_scalar_nodes(
         self, req, count: int, existing: List[str], quarantined_nodes: set,
     ) -> List[str]:
-        res = req.spec.resource
-        used = self._used_slots_map(req.name)
-        if res.target_node:
-            node = self.store.try_get(Node, res.target_node)
-            if node is None:
-                raise AllocationError(f"target node {res.target_node} does not exist")
-            if res.target_node in quarantined_nodes:
-                raise AllocationError(
-                    f"target node {res.target_node} is quarantined"
-                    " (fabric attach budget exhausted)"
-                )
-            # Capacity must cover everything this request puts there.
-            already = sum(1 for e in existing if e == res.target_node)
-            if not self._node_fits(req, node, already + count, used):
-                raise AllocationError(
-                    f"target node {res.target_node} lacks {already + count} free device ports"
-                )
-            return [res.target_node] * count
-        nodes = [
-            n for n in self.store.list(Node)
-            if n.status.ready and not n.spec.unschedulable
-            and n.metadata.name not in quarantined_nodes
-            and self._node_fits(req, n, 1, used)
-        ]
-        if not nodes:
-            raise AllocationError("no schedulable node with free device ports")
-        if res.allocation_policy == "samenode":
-            if existing:
-                anchor_name = existing[0]
-            else:
-                anchor_name = min(
-                    nodes, key=lambda n: (used.get(n.name, 0), n.name)
-                ).metadata.name
-            anchor = self.store.try_get(Node, anchor_name)
-            already = sum(1 for e in existing if e == anchor_name)
-            if anchor is None or not self._node_fits(req, anchor, already + count, used):
-                raise AllocationError(
-                    f"samenode anchor {anchor_name} lacks {already + count} free device ports"
-                )
-            return [anchor_name] * count
-        # differentnode: spread over distinct nodes not already used (:444-467)
-        taken = set(existing)
-        fresh = [n.metadata.name for n in nodes if n.metadata.name not in taken]
-        if len(fresh) < count:
-            raise AllocationError(
-                f"differentnode policy needs {count} unused nodes, found {len(fresh)}"
-            )
-        fresh.sort(key=lambda nm: (used.get(nm, 0), nm))
-        return fresh[:count]
+        # Same engine and admission gate as slice placement, so scalar
+        # devices and TPU workers share one capacity map, cannot
+        # double-book a host, and cannot backfill-steal ports a pending
+        # higher-priority slice is queued for.
+        return self.scheduler.place_scalar(
+            req, count, existing, quarantined_nodes
+        )
 
     def _deletion_order(self, children: List[ComposableResource]) -> List[ComposableResource]:
         """5-bucket deletion priority, oldest-used first within a bucket
@@ -886,6 +814,7 @@ class ComposabilityRequestReconciler(Controller):
         return Result(requeue_after=0.0)
 
     def _handle_cleaning(self, req: ComposabilityRequest) -> Result:
+        self.scheduler.forget(req.name)  # a dying request stops queueing
         children = self._children(req)
         if children:
             self._delete_children(req, children)

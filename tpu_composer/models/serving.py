@@ -358,6 +358,17 @@ class ContinuousBatchingEngine:
         need a multiple of prefill_chunk (chunk pads would be routed).
         Staging borrows a free slot for the prefill; the blocks then
         detach into the handle and the slot frees immediately."""
+        if self.prefill_chunk is None:
+            # Mirror submit()'s requirement up front: a bucketed engine can
+            # never attach a request to a prefix (submit rejects
+            # prefix-attached requests without chunked admission), so a
+            # prefix registered here would hold pool blocks forever with
+            # no way to use or reclaim them short of close_prefix.
+            raise ValueError(
+                "register_prefix requires chunked admission (pass"
+                " prefill_chunk): bucketed engines cannot attach requests"
+                " to a prefix, so its blocks would leak"
+            )
         p_n = len(tokens)
         if p_n == 0 or p_n % self.block_size:
             raise ValueError(

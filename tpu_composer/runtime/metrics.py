@@ -219,6 +219,34 @@ resources_quarantined_total = global_registry.counter(
     "ComposableResources quarantined after exhausting their attach budget",
 )
 
+#: Cluster scheduler (scheduler/: priority queue, preemption, defrag).
+scheduler_queue_depth = global_registry.gauge(
+    "tpuc_scheduler_queue_depth",
+    "ComposabilityRequests waiting for placement (pending queue size)",
+)
+scheduler_preemptions_total = global_registry.counter(
+    "tpuc_scheduler_preemptions_total",
+    "Victim requests evicted so a higher-priority request could place",
+)
+scheduler_held_back_total = global_registry.counter(
+    "tpuc_scheduler_held_back_total",
+    "Placements deferred by the backfill gate to protect a pending"
+    " higher-priority request",
+)
+scheduler_fragmentation_score = global_registry.gauge(
+    "tpuc_scheduler_fragmentation_score",
+    "Share of free TPU capacity stranded on partially-used hosts"
+    " (0 = all free capacity sits on whole hosts)",
+)
+scheduler_time_to_placement_seconds = global_registry.histogram(
+    "tpuc_scheduler_time_to_placement_seconds",
+    "Wait from first failed placement attempt to successful placement",
+)
+scheduler_defrag_migrations_total = global_registry.counter(
+    "tpuc_scheduler_defrag_migrations_total",
+    "Worker migrations started by the defragmentation planner",
+)
+
 
 def timed() -> float:
     return time.monotonic()

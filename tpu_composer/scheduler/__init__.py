@@ -1,0 +1,36 @@
+"""Cluster scheduler: priority, gang admission, preemption, defrag.
+
+The placement subsystem the request controller delegates to instead of
+picking nodes inline — see scheduler/core.py for the facade and
+docs/ARCHITECTURE.md (Scheduler section) for the data flow.
+"""
+
+from tpu_composer.scheduler.core import ClusterScheduler, Placement
+from tpu_composer.scheduler.defrag import (
+    DefragLoop,
+    DefragPlan,
+    DefragPlanner,
+    Migration,
+)
+from tpu_composer.scheduler.placement import (
+    AllocationError,
+    PlacementEngine,
+    host_index,
+)
+from tpu_composer.scheduler.preemption import Preemptor
+from tpu_composer.scheduler.queue import PendingEntry, SchedulerQueue
+
+__all__ = [
+    "AllocationError",
+    "ClusterScheduler",
+    "DefragLoop",
+    "DefragPlan",
+    "DefragPlanner",
+    "Migration",
+    "PendingEntry",
+    "Placement",
+    "PlacementEngine",
+    "Preemptor",
+    "SchedulerQueue",
+    "host_index",
+]

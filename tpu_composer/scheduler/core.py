@@ -1,0 +1,277 @@
+"""ClusterScheduler — the placement authority the request controller asks.
+
+One facade over the four scheduler pieces:
+
+- :class:`~tpu_composer.scheduler.placement.PlacementEngine` scores and
+  picks host sets (fragmentation-aware bin-packing + ICI contiguity);
+- :class:`~tpu_composer.scheduler.queue.SchedulerQueue` remembers who is
+  waiting, at what priority, for what gang demand;
+- :class:`~tpu_composer.scheduler.preemption.Preemptor` computes minimal
+  victim sets when a high-priority demand cannot fit;
+- :class:`~tpu_composer.scheduler.defrag.DefragPlanner` (driven separately
+  by the DefragLoop runnable) proposes migrations that reassemble
+  contiguous capacity.
+
+``place()`` is the one entry point for fresh slice placements and returns a
+:class:`Placement` that either names the hosts (success), names the victims
+the caller must evict first (preemption), or raises
+:class:`~tpu_composer.scheduler.placement.AllocationError` (queue and
+retry). The caller is expected to serialize calls (the request controller's
+allocation lock) — the queue itself is thread-safe, but two concurrent
+placements would double-book capacity exactly as the inline allocator
+would have.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Set
+
+from tpu_composer.api.types import ComposabilityRequest
+from tpu_composer.runtime.metrics import (
+    scheduler_fragmentation_score,
+    scheduler_held_back_total,
+    scheduler_queue_depth,
+    scheduler_time_to_placement_seconds,
+)
+from tpu_composer.scheduler.defrag import DefragPlanner
+from tpu_composer.scheduler.placement import AllocationError, PlacementEngine
+from tpu_composer.scheduler.preemption import Preemptor
+from tpu_composer.scheduler.queue import PendingEntry, SchedulerQueue
+from tpu_composer.topology.slices import SliceShape
+
+
+@dataclass
+class Placement:
+    """Outcome of a placement decision: hosts to use, or victims to evict
+    first (mutually exclusive — victims non-empty means no hosts yet)."""
+
+    nodes: List[str] = field(default_factory=list)
+    victims: List[str] = field(default_factory=list)
+
+
+class ClusterScheduler:
+    def __init__(self, store) -> None:
+        self.store = store
+        self.engine = PlacementEngine(store)
+        self.queue = SchedulerQueue()
+        self.preemptor = Preemptor(store, self.engine)
+        # THE allocation lock: the request controller serializes its
+        # placement passes on it, and the defrag executor takes it around
+        # each verify+delete — without the shared lock, defrag's capacity
+        # re-verification could be invalidated by a concurrent placement
+        # between its check and its delete, evicting a Running worker
+        # with nowhere to re-land.
+        self.alloc_lock = threading.Lock()
+        self.defrag = DefragPlanner(
+            store, self.engine, queue=self.queue, lock=self.alloc_lock
+        )
+
+    # ------------------------------------------------------------------
+    def place(
+        self,
+        req: ComposabilityRequest,
+        shape: SliceShape,
+        quarantined: Set[str],
+    ) -> Placement:
+        """Arbitrated placement for a fresh slice allocation."""
+        # One store pass, two views: `occupied` (every live claim — what
+        # the gate and the fragmentation gauge must see) and `used` (minus
+        # this request's own children — what its own picking must see).
+        occupied, used = self.engine.capacity_maps(req.name)
+        self.queue.prune(self.store)
+        try:
+            nodes = self.engine.pick_hosts(req, shape, quarantined, used=used)
+        except AllocationError:
+            self.queue.note_pending(req, shape.num_hosts, shape.chips_per_host)
+            self._update_gauges(quarantined, occupied)
+            victims = self.preemptor.compute_victims(
+                req, shape, quarantined, used
+            )
+            if victims:
+                return Placement(victims=victims)
+            raise
+        self._admit(
+            req, {n: shape.chips_per_host for n in nodes}, occupied,
+            quarantined, pending_demand=(shape.num_hosts, shape.chips_per_host),
+        )
+        return Placement(nodes=nodes)
+
+    def place_scalar(
+        self,
+        req: ComposabilityRequest,
+        count: int,
+        existing,
+        quarantined: Set[str],
+    ) -> List[str]:
+        """Arbitrated scalar (gpu/cxlmemory) placement: scalar devices
+        consume the same per-host ports as slice workers, so they go
+        through the same pending queue and backfill gate — a priority-0
+        gpu request must not grab the last free port a feasible
+        higher-priority slice is queued for. No preemption, though:
+        evicting a gang for an independent device is never worth the
+        disruption, and scalar requests themselves recover by waiting."""
+        occupied, used = self.engine.capacity_maps(req.name)
+        self.queue.prune(self.store)
+        # Demand bookkeeping for the gate's feasibility probes: pinned /
+        # samenode requests need ONE host with room for the DELTA
+        # (anchored — growth can't move elsewhere); spread policies need
+        # `count` hosts with one port each. The demand must be the delta,
+        # not delta+held: probes run against the full `occupied` map,
+        # which already counts the devices the request holds — adding
+        # them again would double-count and make the gate call a
+        # satisfiable anchored request 'unsatisfiable', dropping its
+        # protection exactly when it needs it.
+        res = req.spec.resource
+        existing = list(existing)
+        exclude: tuple = ()
+        if res.target_node:
+            anchor = res.target_node
+            demand = (1, count)
+        elif res.allocation_policy == "samenode":
+            # One host must take the whole delta; a not-yet-anchored
+            # request can still land anywhere (anchor "").
+            anchor = existing[0] if existing else ""
+            demand = (1, count)
+        else:
+            anchor = ""
+            demand = (count, 1)
+            if res.allocation_policy == "differentnode":
+                # Growth can only land on UNUSED nodes; a probe counting
+                # the request's own hosts would overreport feasibility.
+                exclude = tuple(sorted(set(existing)))
+        try:
+            nodes = self.engine.pick_scalar_nodes(
+                req, count, existing, quarantined, used=used
+            )
+        except AllocationError:
+            self.queue.note_pending(req, *demand, anchor=anchor,
+                                    exclude_nodes=exclude)
+            self._update_gauges(quarantined, occupied)
+            raise
+        add: dict = {}
+        for n in nodes:
+            add[n] = add.get(n, 0) + 1
+        self._admit(req, add, occupied, quarantined, pending_demand=demand,
+                    anchor=anchor, exclude_nodes=exclude)
+        return nodes
+
+    def _admit(
+        self,
+        req: ComposabilityRequest,
+        add,
+        occupied,
+        quarantined: Set[str],
+        pending_demand,
+        anchor: str = "",
+        exclude_nodes: tuple = (),
+    ) -> None:
+        """Run the backfill gate over a tentative placement (`add`: node ->
+        ports it would consume) against the FULL occupancy map — including
+        the placer's own holdings, or a grow onto a contended host reads
+        as free and slips the gate. On pass, dequeue + record wait
+        metrics; on hold raise AllocationError naming the protected
+        entry."""
+        held = self._gate(req, add, occupied, quarantined)
+        if held is not None:
+            self.queue.note_pending(req, *pending_demand, anchor=anchor,
+                                    exclude_nodes=exclude_nodes)
+            scheduler_held_back_total.inc()
+            self._update_gauges(quarantined, occupied)
+            raise AllocationError(
+                f"held back: pending request {held.name} (priority"
+                f" {held.priority} > {req.spec.priority}) needs this"
+                " capacity"
+            )
+        wait = self.queue.note_placed(req.name)
+        if wait is not None:
+            scheduler_time_to_placement_seconds.observe(
+                wait, type=req.spec.resource.type
+            )
+        self._update_gauges(quarantined, occupied)
+
+    def place_extra(
+        self,
+        req: ComposabilityRequest,
+        shape: SliceShape,
+        exclude: Set[str],
+        count: int,
+        quarantined: Set[str],
+    ) -> List[str]:
+        """Grow-path placement for the delta workers of a live slice. Not
+        gated: the slice already holds its capacity and a live resize must
+        not deadlock behind the queue — arbitration happened at admission."""
+        return self.engine.pick_slice_hosts(
+            req, shape, exclude=exclude, count=count, quarantined=quarantined
+        )
+
+    def forget(self, name: str) -> None:
+        """Drop a request from the pending queue (deletion path)."""
+        self.queue.forget(name)
+        scheduler_queue_depth.set(float(self.queue.depth()))
+
+    def requeue(self, req: ComposabilityRequest, num_hosts: int,
+                chips_per_host: int) -> None:
+        """Re-register a request whose placement was granted but whose
+        execution (fabric reservation) failed — the gate protection must
+        come back before the backoff retry, and the depth gauge with it.
+        (The time-to-placement sample observed at grant time stands; the
+        residual wait is re-measured from here.)"""
+        self.queue.note_pending(req, num_hosts, chips_per_host)
+        scheduler_queue_depth.set(float(self.queue.depth()))
+
+    # ------------------------------------------------------------------
+    def _gate(
+        self,
+        req: ComposabilityRequest,
+        add,
+        occupied,
+        quarantined: Set[str],
+    ) -> Optional[PendingEntry]:
+        """Conservative backfill: block this placement only if it would
+        turn a currently-placeable higher-priority pending request into an
+        unplaceable one. Probes run against the FULL occupancy map plus
+        the tentative placement. Returns the entry being protected, or
+        None."""
+        entries = self.queue.entries_above(req.spec.priority)
+        if not entries:
+            return None
+        after = dict(occupied)
+        for n, chips in add.items():
+            after[n] = after.get(n, 0) + chips
+        # One node snapshot for all probes (2 per entry) this gate runs.
+        nodes = self.engine.schedulable_nodes(quarantined)
+        for entry in entries:
+            if entry.name == req.name:
+                continue
+            other = self.store.try_get(ComposabilityRequest, entry.name)
+            if other is None or other.being_deleted:
+                continue
+            feasible_now = self.engine.demand_feasible(
+                other, entry.num_hosts, entry.chips_per_host, quarantined,
+                occupied, anchor=entry.anchor, nodes=nodes,
+                exclude_nodes=entry.exclude_nodes,
+            )
+            if not feasible_now:
+                # Unsatisfiable either way (e.g. its only hosts are
+                # quarantined) — holding everyone behind it would be
+                # priority inversion for nothing.
+                continue
+            if not self.engine.demand_feasible(
+                other, entry.num_hosts, entry.chips_per_host, quarantined,
+                after, anchor=entry.anchor, nodes=nodes,
+                exclude_nodes=entry.exclude_nodes,
+            ):
+                return entry
+        return None
+
+    def _update_gauges(self, quarantined: Set[str], occupied) -> None:
+        # The gauge must reflect the REAL cluster: `occupied` is the full
+        # occupancy map from the pass's single store scan (the
+        # request-excluded picking view would read a resizing request's
+        # attached chips as free and make the score flap).
+        scheduler_queue_depth.set(float(self.queue.depth()))
+        scheduler_fragmentation_score.set(
+            self.engine.fragmentation(quarantined, occupied)
+        )

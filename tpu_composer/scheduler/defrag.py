@@ -1,0 +1,391 @@
+"""Defragmentation planner — reassemble contiguous TPU capacity.
+
+Long-running fleets fragment: single-host slices land, die, and re-land
+until every host holds a couple of chips and no 2-host gang can compose
+even though the totals say it should. The planner proposes **worker
+migrations** that vacate nearly-empty hosts by repacking their sub-host
+chip groups onto already-fragmented peers — the same tightest-fit objective
+the placement engine scores, run in reverse over live placements.
+
+Safety properties:
+
+- ``plan()`` is a pure dry run: it reads the store, simulates, and returns
+  a :class:`DefragPlan`; nothing moves until ``execute()`` is called with
+  that plan (and the operator can run plan-only forever via
+  ``TPUC_DEFRAG_EXECUTE=0``).
+- only members of **single-host**, **Running** slices whose owner allows
+  disruption (``preemptionPolicy != Never``) migrate — moving one worker of
+  a multi-host gang would invalidate its ICI topology mid-flight;
+- execution goes through the existing resize machinery: the migrated
+  member's ComposableResource is deleted, its owner re-enters
+  NodeAllocating, and the placement engine's tightest-fit scoring lands the
+  re-solve on the packed target (the plan records the predicted target and
+  ``execute`` re-verifies it still fits before touching anything);
+- a plan is idempotent: once executed and settled, the next ``plan()``
+  finds no migration that improves the fragmentation score and returns
+  empty.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from tpu_composer.agent.publisher import quarantined_nodes
+from tpu_composer.api.types import (
+    ComposabilityRequest,
+    ComposableResource,
+    LABEL_MANAGED_BY,
+    Node,
+    PREEMPT_NEVER,
+    REQUEST_STATE_RUNNING,
+)
+from tpu_composer.runtime.events import EventRecorder
+from tpu_composer.runtime.metrics import (
+    scheduler_defrag_migrations_total,
+    scheduler_fragmentation_score,
+)
+from tpu_composer.runtime.store import NotFoundError, StoreError
+
+
+@dataclass(frozen=True)
+class Migration:
+    request: str
+    resource: str
+    from_node: str
+    to_node: str
+    chips: int
+
+
+@dataclass
+class DefragPlan:
+    migrations: List[Migration] = field(default_factory=list)
+    frag_before: float = 0.0
+    frag_after: float = 0.0
+
+    @property
+    def empty(self) -> bool:
+        return not self.migrations
+
+
+class DefragPlanner:
+    def __init__(self, store, engine, queue=None, lock=None) -> None:
+        self.store = store
+        self.engine = engine
+        # The scheduler's pending queue, when wired (ClusterScheduler
+        # does): execute() refuses migrations whose owner's re-placement
+        # the backfill gate would hold back — without this, a "capacity
+        # shuffle" can silently turn into an unaccounted preemption.
+        self.queue = queue
+        # The scheduler's allocation lock, when wired: each migration's
+        # verify+delete runs under it so a concurrent placement can't
+        # fill the verified target between the check and the delete.
+        self.lock = lock
+        self.log = logging.getLogger("DefragPlanner")
+
+    # ------------------------------------------------------------------
+    def plan(self, quarantined: Optional[Set[str]] = None) -> DefragPlan:
+        """Dry-run: the migrations that would vacate hosts and lower the
+        fragmentation score, or an empty plan when none would."""
+        if quarantined is None:
+            quarantined = quarantined_nodes(self.store)
+        used = self.engine.used_slots_map()
+        frag_before = self.engine.fragmentation(quarantined, used)
+
+        nodes: Dict[str, Node] = {
+            n.metadata.name: n
+            for n in self.store.list(Node)
+            if n.status.ready
+            and not n.spec.unschedulable
+            and n.metadata.name not in quarantined
+        }
+        movable, anchored = self._occupants(nodes)
+
+        # Vacate candidates: hosts with movable occupants and nothing
+        # anchoring them, emptiest first (fewest chips to relocate per
+        # host freed). Whether a host's entire occupancy is still movable
+        # is re-checked against sim_used inside the loop: an earlier
+        # migration may have packed chips ONTO a later candidate, and
+        # "vacating" only its original occupants would be pure churn.
+        sources = sorted(
+            (
+                name
+                for name, node in nodes.items()
+                if movable.get(name) and name not in anchored
+            ),
+            key=lambda name: (used.get(name, 0), name),
+        )
+
+        sim_used = dict(used)
+        migrations: List[Migration] = []
+        vacated: Set[str] = set()
+        for src in sources:
+            if sim_used.get(src, 0) != sum(
+                m.chips for m in movable.get(src, [])
+            ):
+                continue  # received migrated chips (or was empty) — skip
+            trial: List[Migration] = []
+            trial_used = dict(sim_used)
+            ok = True
+            # Largest groups first: best-fit-decreasing packs tighter.
+            for mig in sorted(
+                movable.get(src, []), key=lambda m: (-m.chips, m.resource)
+            ):
+                target = self._best_target(
+                    mig.chips, src, nodes, trial_used, vacated
+                )
+                if target is None:
+                    ok = False
+                    break
+                trial.append(
+                    Migration(
+                        request=mig.request,
+                        resource=mig.resource,
+                        from_node=src,
+                        to_node=target,
+                        chips=mig.chips,
+                    )
+                )
+                trial_used[target] = trial_used.get(target, 0) + mig.chips
+                trial_used[src] = trial_used.get(src, 0) - mig.chips
+            if ok and trial:
+                migrations.extend(trial)
+                sim_used = trial_used
+                vacated.add(src)
+
+        frag_after = self.engine.fragmentation(quarantined, sim_used)
+        if frag_after >= frag_before:
+            return DefragPlan([], frag_before, frag_before)
+        return DefragPlan(migrations, frag_before, frag_after)
+
+    def _best_target(
+        self,
+        chips: int,
+        src: str,
+        nodes: Dict[str, Node],
+        sim_used: Dict[str, int],
+        vacated: Set[str],
+    ) -> Optional[str]:
+        """Tightest-fit target that is already partially used — migrating
+        onto an empty host would only move the fragmentation around."""
+        best = None
+        for name, node in nodes.items():
+            if name == src or name in vacated:
+                continue
+            u = sim_used.get(name, 0)
+            free = node.status.tpu_slots - u
+            if u <= 0 or free < chips:
+                continue
+            key = (free - chips, name)
+            if best is None or key < best[0]:
+                best = (key, name)
+        return best[1] if best else None
+
+    def _occupants(self, nodes: Dict[str, Node]):
+        """Split live TPU chip groups into movable (single-host Running
+        slice, disruption allowed, sub-host group) vs anchoring (everything
+        else pins its host in place)."""
+        requests = {r.name: r for r in self.store.list(ComposabilityRequest)}
+        movable: Dict[str, List[Migration]] = {}
+        anchored: Set[str] = set()
+        for c in self.store.list(ComposableResource):
+            if c.being_deleted:
+                continue
+            node = c.spec.target_node
+            if node not in nodes:
+                continue
+            owner = requests.get(c.metadata.labels.get(LABEL_MANAGED_BY, ""))
+            if (
+                c.spec.type == "tpu"
+                and owner is not None
+                and not owner.being_deleted
+                and owner.spec.preemption_policy != PREEMPT_NEVER
+                and owner.spec.resource.target_node == ""
+                and owner.status.state == REQUEST_STATE_RUNNING
+                and owner.status.slice.num_hosts == 1
+                and c.spec.chip_count < nodes[node].status.tpu_slots
+            ):
+                movable.setdefault(node, []).append(
+                    Migration(
+                        request=owner.name,
+                        resource=c.name,
+                        from_node=node,
+                        to_node="",
+                        chips=c.spec.chip_count,
+                    )
+                )
+            else:
+                anchored.add(node)
+        return movable, anchored
+
+    # ------------------------------------------------------------------
+    def execute(
+        self, plan: DefragPlan, recorder: Optional[EventRecorder] = None
+    ) -> int:
+        """Drive a dry-run plan through the existing resize machinery:
+        delete each migrated member so its owner re-solves onto the packed
+        target. Re-verifies every migration against fresh state — a stale
+        entry (child gone, target filled up meanwhile) is skipped, not
+        forced — and runs each verify+delete under the scheduler's
+        allocation lock (when wired) so a concurrent placement cannot fill
+        the verified target between the check and the delete. Returns the
+        number of migrations actually started."""
+        started = 0
+        quarantined = quarantined_nodes(self.store)
+        for m in plan.migrations:
+            with self.lock if self.lock is not None else contextlib.nullcontext():
+                if self._execute_one(m, quarantined, recorder):
+                    started += 1
+        return started
+
+    def _execute_one(
+        self,
+        m: Migration,
+        quarantined,
+        recorder: Optional[EventRecorder],
+    ) -> bool:
+        """One migration's verify+delete (caller holds the allocation
+        lock when one is wired). False = skipped or failed."""
+        child = self.store.try_get(ComposableResource, m.resource)
+        if (
+            child is None
+            or child.being_deleted
+            or child.spec.target_node != m.from_node
+            or child.metadata.labels.get(LABEL_MANAGED_BY) != m.request
+        ):
+            return False  # world moved on since the plan was cut
+        target = self.store.try_get(Node, m.to_node)
+        used = self.engine.used_slots_map()
+        if (
+            target is None
+            or not target.status.ready
+            or target.spec.unschedulable
+            or m.to_node in quarantined
+            or target.status.tpu_slots - used.get(m.to_node, 0) < m.chips
+        ):
+            # Includes a target quarantined since the plan was cut: the
+            # owner's re-solve would exclude it, so deleting the worker
+            # could strand a Running slice with nowhere to re-land.
+            return False
+        if self._owner_would_be_held_back(m, used, quarantined):
+            self.log.info(
+                "defrag skip %s (%s -> %s): owner %s would be gate-"
+                "blocked from re-placing behind a pending higher-"
+                "priority demand", m.resource, m.from_node, m.to_node,
+                m.request,
+            )
+            return False
+        try:
+            self.store.delete(ComposableResource, m.resource)
+        except NotFoundError:
+            return False
+        except StoreError as e:
+            self.log.warning(
+                "defrag migration of %s (%s -> %s) failed: %s",
+                m.resource, m.from_node, m.to_node, e,
+            )
+            return False
+        scheduler_defrag_migrations_total.inc()
+        if recorder is not None:
+            req = self.store.try_get(ComposabilityRequest, m.request)
+            if req is not None:
+                recorder.event(
+                    req, "Normal", "DefragMigration",
+                    f"migrating worker {m.resource} "
+                    f"{m.from_node} -> {m.to_node} to defragment capacity",
+                )
+        return True
+
+    def _owner_would_be_held_back(
+        self, m: Migration, used, quarantined
+    ) -> bool:
+        """Simulate the migration landing (from -= chips, to += chips) and
+        run the same conservative-backfill probes the owner's re-solve
+        will face: if a currently-feasible higher-priority pending demand
+        becomes infeasible, the owner would be held back — the migration
+        would evict a Running worker with nowhere to go."""
+        if self.queue is None:
+            return False
+        owner = self.store.try_get(ComposabilityRequest, m.request)
+        if owner is None or owner.being_deleted:
+            return True  # nothing to re-place; skip the no-op delete
+        entries = self.queue.entries_above(owner.spec.priority)
+        if not entries:
+            return False
+        after = dict(used)
+        after[m.from_node] = after.get(m.from_node, 0) - m.chips
+        after[m.to_node] = after.get(m.to_node, 0) + m.chips
+        nodes = self.engine.schedulable_nodes(quarantined)
+        for entry in entries:
+            other = self.store.try_get(ComposabilityRequest, entry.name)
+            if other is None or other.being_deleted:
+                continue
+            if self.engine.demand_feasible(
+                other, entry.num_hosts, entry.chips_per_host, quarantined,
+                used, anchor=entry.anchor, nodes=nodes,
+                exclude_nodes=entry.exclude_nodes,
+            ) and not self.engine.demand_feasible(
+                other, entry.num_hosts, entry.chips_per_host, quarantined,
+                after, anchor=entry.anchor, nodes=nodes,
+                exclude_nodes=entry.exclude_nodes,
+            ):
+                return True
+        return False
+
+
+class DefragLoop:
+    """Manager runnable: periodically plan (always) and execute (opt-in).
+
+    Plan-only mode still updates the fragmentation gauge and logs the
+    migrations it *would* run — the operator preview the ISSUE asks for."""
+
+    def __init__(
+        self,
+        store,
+        planner: DefragPlanner,
+        period: float = 300.0,
+        execute: bool = False,
+        recorder: Optional[EventRecorder] = None,
+    ) -> None:
+        self.store = store
+        self.planner = planner
+        self.period = period
+        self.execute = execute
+        self.recorder = recorder
+        self.log = logging.getLogger("DefragLoop")
+
+    def __call__(self, stop_event: threading.Event) -> None:
+        while not stop_event.wait(self.period):
+            try:
+                self.run_once()
+            except StoreError as e:  # pragma: no cover - wire-store only
+                self.log.warning("defrag pass failed: %s", e)
+
+    def run_once(self) -> DefragPlan:
+        plan = self.planner.plan()
+        # Gauge reflects the CURRENT cluster, not the plan's prediction —
+        # execution is asynchronous (owners re-solve on their own clock).
+        scheduler_fragmentation_score.set(plan.frag_before)
+        if plan.empty:
+            return plan
+        summary = ", ".join(
+            f"{m.resource}:{m.from_node}->{m.to_node}" for m in plan.migrations
+        )
+        if self.execute:
+            n = self.planner.execute(plan, recorder=self.recorder)
+            self.log.info(
+                "defrag executed %d/%d migration(s) (frag %.3f -> %.3f): %s",
+                n, len(plan.migrations), plan.frag_before, plan.frag_after,
+                summary,
+            )
+        else:
+            self.log.info(
+                "defrag dry-run: %d migration(s) would cut fragmentation"
+                " %.3f -> %.3f: %s",
+                len(plan.migrations), plan.frag_before, plan.frag_after,
+                summary,
+            )
+        return plan

@@ -1,0 +1,219 @@
+"""Victim-set computation: who must go so a higher-priority slice can fit.
+
+When the placement engine reports no valid host set for a request, the
+preemptor searches for a **minimal** set of strictly-lower-priority
+requests whose eviction would make the placement feasible. Minimality is
+cardinality-first (fewest workloads disturbed), then least total victim
+priority, then least capacity evicted — so a single 4-chip victim beats two
+2-chip ones, and among equals the cheaper/younger victims go first.
+
+Respected constraints:
+
+- only strictly-lower-priority requests are candidates, and only when the
+  preemptor's own ``preemptionPolicy`` is ``PreemptLowerPriority``;
+- a victim with ``preemptionPolicy: Never`` is untouchable;
+- capacity freed on quarantined / cordoned / gone nodes counts for nothing
+  (the placement engine will not use it), so requests living there are
+  never chosen — evicting them would disturb a workload without helping
+  the preemptor (the quarantine-aware half of the priority-inversion
+  guard).
+
+The preemptor only *computes* the set. Execution — deleting the victims'
+children so their own state machines re-queue them — stays in the request
+controller, through the same delete/re-solve paths every other disruption
+uses.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpu_composer.api.types import (
+    ComposabilityRequest,
+    ComposableResource,
+    LABEL_MANAGED_BY,
+    Node,
+    PREEMPT_LOWER_PRIORITY,
+    PREEMPT_NEVER,
+)
+from tpu_composer.topology.slices import SliceShape
+
+#: Exhaustive minimal-set search bound: above this many candidate victims
+#: (or when no set ≤ _EXHAUSTIVE_MAX_SIZE works) fall back to greedy+prune,
+#: which yields an irreducible (if not always minimum-cardinality) set.
+_EXHAUSTIVE_MAX_CANDIDATES = 12
+_EXHAUSTIVE_MAX_SIZE = 6
+
+
+@dataclass
+class _Candidate:
+    name: str
+    priority: int
+    freed: Dict[str, int]  # node -> chips usable capacity eviction frees
+    total_chips: int
+    creation: str
+
+
+class Preemptor:
+    def __init__(self, store, engine) -> None:
+        self.store = store
+        self.engine = engine
+
+    # ------------------------------------------------------------------
+    def compute_victims(
+        self,
+        req: ComposabilityRequest,
+        shape: SliceShape,
+        quarantined: Set[str],
+        used: Dict[str, int],
+    ) -> List[str]:
+        """Minimal victim set making `req`'s shape placeable, or [] when
+        preemption is disallowed or cannot help."""
+        if req.spec.preemption_policy != PREEMPT_LOWER_PRIORITY:
+            return []
+        candidates = self._candidates(req, quarantined)
+        if not candidates:
+            return []
+
+        # ONE node snapshot for every feasibility probe: the exhaustive
+        # search runs up to ~2.5k subset probes, and each demand_feasible
+        # would otherwise re-list the whole Node collection — on a wire
+        # store that is thousands of scans per failed placement, held
+        # under the allocation lock. node_fits is pure given the node and
+        # a used map, so the snapshot is exact.
+        usable_nodes = self.engine.schedulable_nodes(quarantined)
+        target = req.spec.resource.target_node
+        target_node = next(
+            (n for n in usable_nodes if n.metadata.name == target), None
+        )
+
+        def feasible(combo: Tuple[_Candidate, ...]) -> bool:
+            sim = dict(used)
+            for c in combo:
+                for node, chips in c.freed.items():
+                    sim[node] = max(0, sim.get(node, 0) - chips)
+            if target:
+                return (
+                    target_node is not None
+                    and shape.num_hosts == 1
+                    and self.engine.node_fits(
+                        req, target_node, shape.chips_per_host, sim
+                    )
+                )
+            fitting = sum(
+                1
+                for n in usable_nodes
+                if self.engine.node_fits(req, n, shape.chips_per_host, sim)
+            )
+            return fitting >= shape.num_hosts
+
+        # Deterministic candidate order: cheapest victims first.
+        candidates.sort(
+            key=lambda c: (c.priority, c.total_chips, c.creation, c.name)
+        )
+
+        if not feasible(tuple(candidates)):
+            return []  # even evicting everyone eligible wouldn't fit
+
+        if len(candidates) <= _EXHAUSTIVE_MAX_CANDIDATES:
+            for size in range(1, min(len(candidates), _EXHAUSTIVE_MAX_SIZE) + 1):
+                best: Optional[Tuple[tuple, Tuple[_Candidate, ...]]] = None
+                for combo in itertools.combinations(candidates, size):
+                    if not feasible(combo):
+                        continue
+                    key = (
+                        sum(c.priority for c in combo),
+                        sum(c.total_chips for c in combo),
+                        tuple(c.name for c in combo),
+                    )
+                    if best is None or key < best[0]:
+                        best = (key, combo)
+                if best is not None:
+                    return [c.name for c in best[1]]
+
+        return self._greedy_prune(candidates, feasible)
+
+    # ------------------------------------------------------------------
+    def _greedy_prune(self, candidates, feasible) -> List[str]:
+        """Add cheapest-first until feasible, then drop every member whose
+        removal keeps feasibility — an irreducible set in O(n) probes."""
+        chosen: List[_Candidate] = []
+        for c in candidates:
+            chosen.append(c)
+            if feasible(tuple(chosen)):
+                break
+        else:
+            return []
+        # Prune most-expensive-first so the survivors skew cheap.
+        for c in sorted(
+            list(chosen),
+            key=lambda c: (-c.priority, -c.total_chips, c.name),
+        ):
+            trial = [x for x in chosen if x is not c]
+            if trial and feasible(tuple(trial)):
+                chosen = trial
+        return [c.name for c in chosen]
+
+    # ------------------------------------------------------------------
+    def _candidates(
+        self, req: ComposabilityRequest, quarantined: Set[str]
+    ) -> List[_Candidate]:
+        usable: Set[str] = set()
+        for n in self.store.list(Node):
+            if (
+                n.status.ready
+                and not n.spec.unschedulable
+                and n.metadata.name not in quarantined
+            ):
+                usable.add(n.metadata.name)
+        children_by_owner: Dict[str, List[ComposableResource]] = {}
+        existing_names: Set[str] = set()
+        for c in self.store.list(ComposableResource):
+            existing_names.add(c.name)
+            if c.being_deleted:
+                continue
+            owner = c.metadata.labels.get(LABEL_MANAGED_BY, "")
+            if owner:
+                children_by_owner.setdefault(owner, []).append(c)
+
+        out: List[_Candidate] = []
+        for other in self.store.list(ComposabilityRequest):
+            if other.name == req.name or other.being_deleted:
+                continue
+            if other.spec.priority >= req.spec.priority:
+                continue
+            if other.spec.preemption_policy == PREEMPT_NEVER:
+                continue
+            freed: Dict[str, int] = {}
+            for c in children_by_owner.get(other.name, []):
+                if c.spec.target_node in usable:
+                    chips = c.spec.chip_count if c.spec.type == "tpu" else 1
+                    freed[c.spec.target_node] = (
+                        freed.get(c.spec.target_node, 0) + chips
+                    )
+            # Placeholder rows hold capacity exactly like children do in
+            # used_slots_map — an Updating victim's claim must be evictable
+            # too, or a half-created gang could never be preempted.
+            per_member = (
+                other.status.slice.chips_per_host
+                if other.spec.resource.type == "tpu"
+                and other.status.slice.chips_per_host
+                else 1
+            )
+            for name, rs in other.status.resources.items():
+                if name not in existing_names and rs.node_name in usable:
+                    freed[rs.node_name] = freed.get(rs.node_name, 0) + per_member
+            if not freed:
+                continue  # nothing this victim frees is usable — skip it
+            out.append(
+                _Candidate(
+                    name=other.name,
+                    priority=other.spec.priority,
+                    freed=freed,
+                    total_chips=sum(freed.values()),
+                    creation=other.metadata.creation_timestamp or "",
+                )
+            )
+        return out

@@ -170,6 +170,16 @@ def _another_watcher_alive(pid_path: str) -> Optional[int]:
 
 CAPTURE_MARKER_PATH = os.path.join(ARTIFACT_DIR, "capture_in_progress.json")
 
+#: Three-state result of a marker claim. The distinction between ACQUIRED
+#: and UNGUARDED matters on the release path: only a marker THIS process
+#: created may be unlinked on exit — a transient OSError used to collapse
+#: into the same True as a real claim, and the exit path would then delete
+#: a live peer's marker, un-serializing the very handshakes the marker
+#: exists to serialize.
+MARKER_ACQUIRED = "acquired"
+MARKER_HELD = "held-by-other"
+MARKER_UNGUARDED = "unguarded"
+
 
 def _clear_capture(path: str) -> None:
     try:
@@ -178,45 +188,51 @@ def _clear_capture(path: str) -> None:
         pass
 
 
-def _try_acquire_marker(path: str) -> bool:
+def _try_acquire_marker(path: str) -> str:
     """Atomically create the capture marker (O_CREAT|O_EXCL — the check and
     the claim are one syscall, so two clients cannot both win the race a
     plain check-then-write leaves open). A marker that already exists but
     is stale (dead/recycled pid, or this pid's own crash leftover) is
-    reaped and the claim retried once. On a filesystem that refuses the
-    marker entirely, proceed unguarded — a broken marker dir must not cost
-    a round's only capture window."""
+    reaped and the claim retried once.
+
+    Returns one of three states: MARKER_ACQUIRED (this process owns the
+    marker and must unlink it when done), MARKER_HELD (another live client
+    owns it — do not dial), MARKER_UNGUARDED (the filesystem refused the
+    marker entirely; proceed without serialization — a broken marker dir
+    must not cost a round's only capture window — but NEVER unlink, since
+    any marker on disk belongs to someone else)."""
     os.makedirs(os.path.dirname(path), exist_ok=True)
     for _ in range(2):
         try:
             fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
         except FileExistsError:
             if capture_in_progress(path):
-                return False
+                return MARKER_HELD
             _clear_capture(path)  # stale: reap, then retry the claim
             continue
         except OSError:
-            return True
+            return MARKER_UNGUARDED
         with os.fdopen(fd, "w") as f:
             json.dump({"pid": os.getpid(),
                        "start": _proc_start_time(os.getpid()),
                        "t": _now()}, f)
-        return True
-    return False
+        return MARKER_ACQUIRED
+    return MARKER_HELD
 
 
 @contextlib.contextmanager
 def hold_capture_marker(path: str = CAPTURE_MARKER_PATH):
-    """Serialize PJRT clients: yields True while this process holds the
-    capture marker (released on exit), False when another live client
-    holds it — the caller must then NOT dial the relay (overlapping
-    handshakes have wedged it, r05). The one shared acquisition protocol
-    for the watcher and bench.py."""
-    acquired = _try_acquire_marker(path)
+    """Serialize PJRT clients: yields True while this process may dial the
+    relay (marker acquired, or the filesystem cannot host a marker at
+    all), False when another live client holds it — the caller must then
+    NOT dial (overlapping handshakes have wedged the relay, r05). The one
+    shared acquisition protocol for the watcher and bench.py. On exit the
+    marker is unlinked ONLY when this process actually created it."""
+    state = _try_acquire_marker(path)
     try:
-        yield acquired
+        yield state != MARKER_HELD
     finally:
-        if acquired:
+        if state == MARKER_ACQUIRED:
             _clear_capture(path)
 
 
@@ -260,6 +276,7 @@ def watch_relay(
     log_path: str = LOG_PATH,
     archive_path: str = ARCHIVE_PATH,
     pid_path: str = PID_PATH,
+    marker_path: str = CAPTURE_MARKER_PATH,
     once: bool = False,
 ) -> int:
     """Poll until the relay answers, then capture; exit 0 after a full
@@ -293,9 +310,11 @@ def watch_relay(
     # window the size of r05's observed ~6 min one, bounded enough not to
     # hammer a wedged relay with kill-mid-handshake churn.
     negative_fallback_cooldown_s = 180.0
-    capture_marker_path = os.path.join(
-        os.path.dirname(archive_path), "capture_in_progress.json"
-    )
+    # Mutual exclusion is keyed on marker_path — the module-level
+    # CAPTURE_MARKER_PATH by default, NOT a path derived from
+    # archive_path: a watcher pointed at a non-default archive must still
+    # exclude a concurrently-running bench probe, which always serializes
+    # on the canonical marker.
     polls = 0
     _log({"event": "start", "pid": os.getpid(), "poll_s": poll_s,
           "max_hours": max_hours}, log_path)
@@ -332,7 +351,7 @@ def watch_relay(
                 rec["loopback_attempt"] = True
             _log(rec, log_path)
             if (up or loopback_attempt) and capture_possible and cooled:
-                with hold_capture_marker(capture_marker_path) as held:
+                with hold_capture_marker(marker_path) as held:
                     if not held:
                         # Another client (an end-of-round bench probe)
                         # already holds the relay; dialing now would be the
