@@ -28,6 +28,12 @@ test-fast:
 bench:
 	$(PYTHON) bench.py
 
+## perf-smoke: fast CI gate — cache-on vs cache-off store round trips per
+## attach through the cluster path; asserts RTT-count (not wall-time)
+## reduction so read-path caching regressions fail deterministically
+perf-smoke:
+	$(PYTHON) -c "import bench; bench.perf_smoke()"
+
 ## watch-relay: poll the TPU tunnel relay; auto-capture the full on-chip
 ## probe to bench_artifacts/ the moment it answers (run at round start)
 watch-relay:

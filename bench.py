@@ -30,13 +30,21 @@ import time
 REFERENCE_P50_MS = 30_000.0  # one reference requeue quantum (BASELINE.md)
 
 
-def bench_attach_to_ready(cycles: int = 40, size: int = 8, store_latency_s: float = 0.0):
+def bench_attach_to_ready(cycles: int = 40, size: int = 8,
+                          store_latency_s: float = 0.0, cached: bool = True):
     """Full request lifecycle through the live threaded operator.
 
     ``store_latency_s`` > 0 injects an apiserver-like round trip into every
     store op (VERDICT r1 #7): the reference pays a networked kube-apiserver
     on each of its ~dozens of client calls per attach, so the honest
-    comparison charges our control loop the same toll."""
+    comparison charges our control loop the same toll.
+
+    ``cached`` hands the controllers the watch-fed CachedClient (the
+    cmd/main default) instead of the raw store; either way the returned
+    dict carries ``rtts_per_attach`` — store round trips per attach cycle,
+    counted by tpuc_store_requests_total. The bench's own readiness polls
+    go through a separate read-only cached observer so harness reads never
+    pollute the control loop's RTT count (or pay the injected latency)."""
     from tpu_composer.api import (
         ComposabilityRequest,
         ComposabilityRequestSpec,
@@ -53,7 +61,9 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8, store_latency_s: floa
         ResourceTiming,
     )
     from tpu_composer.fabric.inmem import InMemoryPool
+    from tpu_composer.runtime.cache import CachedClient, maybe_cached
     from tpu_composer.runtime.manager import Manager
+    from tpu_composer.runtime.metrics import store_requests_total
     from tpu_composer.runtime.store import Store
 
     store = Store(latency_s=store_latency_s)
@@ -61,18 +71,22 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8, store_latency_s: floa
         n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
         n.status.tpu_slots = 4
         store.create(n)
+    client = maybe_cached(store, cached)
+    observer = CachedClient(store)  # harness-only reads; never counted
     pool = InMemoryPool()
     agent = FakeNodeAgent(pool=pool)
-    mgr = Manager(store=store)
+    mgr = Manager(store=client)
     mgr.add_controller(ComposabilityRequestReconciler(
-        store, pool, timing=RequestTiming(updating_poll=0.01, cleaning_poll=0.01)))
+        client, pool, timing=RequestTiming(updating_poll=0.01, cleaning_poll=0.01)))
     mgr.add_controller(ComposableResourceReconciler(
-        store, pool, agent,
+        client, pool, agent,
         timing=ResourceTiming(attach_poll=0.01, visibility_poll=0.01,
                               detach_poll=0.01, detach_fast=0.01, busy_poll=0.01)))
     mgr.start(workers_per_controller=2)
+    observer.list(ComposabilityRequest)  # warm the observer's informer
 
     latencies_ms = []
+    rtts_before = store_requests_total.total()
     try:
         for i in range(cycles):
             name = f"bench-{i}"
@@ -84,7 +98,8 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8, store_latency_s: floa
             ))
             deadline = time.monotonic() + 30
             while time.monotonic() < deadline:
-                if store.get(ComposabilityRequest, name).status.state == "Running":
+                req = observer.try_get(ComposabilityRequest, name)
+                if req is not None and req.status.state == "Running":
                     break
                 time.sleep(0.001)
             else:
@@ -94,11 +109,13 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8, store_latency_s: floa
             store.delete(ComposabilityRequest, name)
             deadline = time.monotonic() + 30
             while time.monotonic() < deadline:
-                if store.try_get(ComposabilityRequest, name) is None:
+                if observer.try_get(ComposabilityRequest, name) is None:
                     break
                 time.sleep(0.001)
     finally:
+        rtts = store_requests_total.total() - rtts_before
         mgr.stop()
+        observer.stop_informers()
 
     latencies_ms.sort()
     return {
@@ -106,6 +123,7 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8, store_latency_s: floa
         "p90": latencies_ms[int(0.9 * (len(latencies_ms) - 1))],
         "max": latencies_ms[-1],
         "cycles": len(latencies_ms),
+        "rtts_per_attach": round(rtts / max(1, len(latencies_ms)), 2),
     }
 
 
@@ -202,13 +220,19 @@ APISERVER_RTT_S = 0.010  # injected per-request latency: typical in-cluster apis
 
 
 def bench_attach_cluster(cycles: int = 20, size: int = 8,
-                         rtt_s: float = APISERVER_RTT_S):
+                         rtt_s: float = APISERVER_RTT_S, cached: bool = True):
     """Attach-to-Ready through the REAL cluster path: the manager speaking
     KubeStore to the wire-semantics fake apiserver, every HTTP request
     charged an apiserver RTT. This is the honest latency model (VERDICT r1
     #7 evolved): reads are served from the watch-backed reflector cache
     (controller-runtime parity), so only genuine wire ops pay the toll —
-    exactly what a real cluster charges the reference's client-go calls."""
+    exactly what a real cluster charges the reference's client-go calls.
+
+    ``cached=False`` disables the reflector read cache (the
+    TPUC_CACHED_READS=0 escape hatch): every controller get/list becomes a
+    wire op. The returned ``rtts_per_attach`` (tpuc_store_requests_total
+    delta / cycles) is what the cache-on/off comparison in CI asserts on —
+    round-trip COUNTS, not wall time, so the check is deterministic."""
     import os
     import sys
 
@@ -236,7 +260,8 @@ def bench_attach_cluster(cycles: int = 20, size: int = 8,
             "/api/v1/nodes",
             core_node_doc(f"worker-{i}", chips=4, chip_resource=CHIP_RESOURCE),
         )
-    store = KubeStore(config=KubeConfig(host=srv.url), watch_reconnect_s=0.05)
+    store = KubeStore(config=KubeConfig(host=srv.url), watch_reconnect_s=0.05,
+                      cache_reads=cached)
     pool = InMemoryPool()
     mgr = Manager(store=store)
     mgr.add_controller(ComposabilityRequestReconciler(
@@ -251,7 +276,10 @@ def bench_attach_cluster(cycles: int = 20, size: int = 8,
     time.sleep(0.5)
     srv.latency_s = rtt_s
 
+    from tpu_composer.runtime.metrics import store_requests_total
+
     latencies_ms = []
+    rtts_before = store_requests_total.total()
     try:
         for i in range(cycles):
             name = f"bench-{i}"
@@ -283,6 +311,7 @@ def bench_attach_cluster(cycles: int = 20, size: int = 8,
                 # LATER cycle fail allocation with a misleading message.
                 raise RuntimeError(f"{name} teardown never completed")
     finally:
+        rtts = store_requests_total.total() - rtts_before
         mgr.stop()
         store.close()
         srv.stop()
@@ -293,6 +322,7 @@ def bench_attach_cluster(cycles: int = 20, size: int = 8,
         "p90": latencies_ms[int(0.9 * (len(latencies_ms) - 1))],
         "max": latencies_ms[-1],
         "cycles": len(latencies_ms),
+        "rtts_per_attach": round(rtts / max(1, len(latencies_ms)), 2),
     }
 
 
@@ -357,6 +387,29 @@ def summarize_accelerator(accel: dict) -> dict:
     return out
 
 
+def perf_smoke(cycles: int = 3):
+    """CI gate for the read-path cache: cache-on vs cache-off through the
+    full cluster path, asserting on store ROUND-TRIP COUNTS (rtt_s=0, so
+    wall-time noise on shared runners can't flake it). A regression that
+    sends reconcile reads back to the wire at least doubles the count and
+    fails deterministically. Run via ``make perf-smoke``."""
+    on = bench_attach_cluster(cycles=cycles, rtt_s=0.0, cached=True)
+    off = bench_attach_cluster(cycles=cycles, rtt_s=0.0, cached=False)
+    out = {
+        "metric": "perf_smoke_store_rtts_per_attach",
+        "cache_on": on["rtts_per_attach"],
+        "cache_off": off["rtts_per_attach"],
+        "reduction": round(off["rtts_per_attach"] / max(on["rtts_per_attach"], 0.01), 1),
+    }
+    print(json.dumps(out))
+    assert on["rtts_per_attach"] * 2 <= off["rtts_per_attach"], (
+        f"read-path cache regression: cache-on paid {on['rtts_per_attach']}"
+        f" store RTTs/attach vs {off['rtts_per_attach']} with the cache off"
+        " (expected at least a 2x reduction)"
+    )
+    return out
+
+
 def main():
     import os
 
@@ -364,6 +417,12 @@ def main():
     # Honest comparison mode: the full cluster path (KubeStore + fake
     # apiserver) with a 10 ms RTT charged on every wire request.
     attach_inj = bench_attach_cluster(cycles=20, rtt_s=APISERVER_RTT_S)
+    # Cache-off control: same wire path, every controller read a wire op
+    # (TPUC_CACHED_READS=0). The rtts_per_attach gap between this and the
+    # run above is the informer cache's contribution, isolated from
+    # everything else in the PR.
+    attach_off = bench_attach_cluster(cycles=5, rtt_s=APISERVER_RTT_S,
+                                      cached=False)
     # Scale point: a 32-chip / 8-host slice through the same wire path —
     # children are created in one concurrent wave and attach across the
     # worker pool, so the slice's attach cost grows sub-linearly with
@@ -375,11 +434,16 @@ def main():
         "attach_p90_ms": round(attach_inj["p90"], 3),
         "attach_max_ms": round(attach_inj["max"], 3),
         "cycles": attach_inj["cycles"],
+        "store_rtts_per_attach": attach_inj["rtts_per_attach"],
+        "cache_off_p50_ms": round(attach_off["p50"], 3),
+        "cache_off_store_rtts_per_attach": attach_off["rtts_per_attach"],
         "attach_32chip_p50_ms": round(attach_32["p50"], 3),
         "attach_32chip_p90_ms": round(attach_32["p90"], 3),
+        "attach_32chip_store_rtts": attach_32["rtts_per_attach"],
         "injected_store_latency_ms": APISERVER_RTT_S * 1e3,
         "raw_inproc_p50_ms": round(attach_raw["p50"], 3),
         "raw_inproc_p90_ms": round(attach_raw["p90"], 3),
+        "raw_inproc_store_rtts": attach_raw["rtts_per_attach"],
         "baseline_p50_ms": REFERENCE_P50_MS,
         "accelerator": summarize_accelerator(accel),
         "full_record": "bench_artifacts/bench_full.json",

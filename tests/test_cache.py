@@ -1,0 +1,652 @@
+"""Informer read cache (runtime/cache.py) consistency suite.
+
+What must hold for cached reads to be safe on the reconcile hot path:
+
+- watch-event ordering: an event a subscriber (controller) receives has
+  ALREADY been applied to the cache it will read during the reconcile;
+- read-your-writes: a write's response is folded into the cache before the
+  write returns, and a delete is visible to the very next cached read;
+- stale cached rv → write ConflictError → rate-limited requeue → converge
+  (the exact path the controllers already rely on, unchanged);
+- indexer correctness under concurrent create/delete churn;
+- full e2e equivalence: the operator converges identically with cached
+  reads on and off (``TPUC_CACHED_READS=0`` escape hatch), including under
+  injected fabric chaos;
+- satellites: Store's per-kind list index, the watch-queue depth gauge,
+  and the dispatch loop surviving mapper bugs.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import pytest
+
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.types import (
+    LABEL_MANAGED_BY,
+    REQUEST_STATE_RUNNING,
+)
+from tpu_composer.controllers import (
+    ComposabilityRequestReconciler,
+    ComposableResourceReconciler,
+    RequestTiming,
+    ResourceTiming,
+)
+from tpu_composer.fabric.chaos import ChaosFabricProvider
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime import store as store_mod
+from tpu_composer.runtime.cache import (
+    CachedClient,
+    maybe_cached,
+    status_write_needed,
+)
+from tpu_composer.runtime.controller import Controller, Result
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.metrics import (
+    status_writes_coalesced_total,
+    store_requests_total,
+    store_watch_queue_depth,
+)
+from tpu_composer.runtime.store import ConflictError, NotFoundError, Store
+
+
+def wait_for(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_node(store, name, slots=4):
+    n = Node(metadata=ObjectMeta(name=name))
+    n.status.tpu_slots = slots
+    return store.create(n)
+
+
+def make_child(client, name, owner="", node="worker-0"):
+    r = ComposableResource(metadata=ObjectMeta(name=name))
+    if owner:
+        r.metadata.labels[LABEL_MANAGED_BY] = owner
+    r.spec.type = "tpu"
+    r.spec.model = "tpu-v4"
+    r.spec.target_node = node
+    return client.create(r)
+
+
+@pytest.fixture()
+def client(store):
+    c = CachedClient(store)
+    yield c
+    c.stop_informers()
+
+
+class TestCachedReads:
+    def test_reads_served_from_cache_zero_store_rtts(self, store, client):
+        make_node(store, "worker-0")
+        client.get(Node, "worker-0")  # starts + syncs the informer
+        before = store_requests_total.total()
+        for _ in range(25):
+            assert client.get(Node, "worker-0").status.tpu_slots == 4
+            assert len(client.list(Node)) == 1
+            assert client.try_get(Node, "missing") is None
+        assert store_requests_total.total() == before
+
+    def test_read_your_writes_within_one_thread(self, store, client):
+        make_child(client, "c1", owner="req1")
+        got = client.get(ComposableResource, "c1")
+        got.status.state = "Attaching"
+        out = client.update_status(got)
+        # The very next cached read must see the write (response folding).
+        assert client.get(ComposableResource, "c1").status.state == "Attaching"
+        assert (
+            client.get(ComposableResource, "c1").metadata.resource_version
+            == out.metadata.resource_version
+        )
+
+    def test_watch_events_ordered_after_cache_apply(self, store, client):
+        """Every event a subscriber receives must already be readable from
+        the cache — the invariant that makes event-triggered reconciles
+        safe on cached reads (a violation wedges objects: the reconcile
+        reads pre-event state and the event is consumed with no retry)."""
+        q = client.watch("ComposableResource")
+        try:
+            for i in range(30):
+                make_child(client, f"obj-{i}")
+            seen = 0
+            deadline = time.monotonic() + 10
+            while seen < 30 and time.monotonic() < deadline:
+                try:
+                    evt = q.get(timeout=1.0)
+                except Exception:
+                    break
+                # At delivery time the cache must already hold state at
+                # least as new as the event.
+                cached = client.try_get(ComposableResource, evt.obj.metadata.name)
+                assert cached is not None
+                assert (
+                    cached.metadata.resource_version
+                    >= evt.obj.metadata.resource_version
+                )
+                seen += 1
+            assert seen == 30
+        finally:
+            client.stop_watch(q)
+
+    def test_direct_store_writes_converge_into_cache(self, store, client):
+        """Writes that bypass the client (another replica, kubectl) reach
+        the cache via the watch within delivery latency."""
+        client.list(ComposableResource)  # start informer
+        make_child(store, "ext-1")  # direct store write — no folding
+        assert wait_for(
+            lambda: client.try_get(ComposableResource, "ext-1") is not None
+        )
+        store.delete(ComposableResource, "ext-1")
+        assert wait_for(
+            lambda: client.try_get(ComposableResource, "ext-1") is None
+        )
+
+    def test_delete_visible_to_next_cached_read(self, store, client):
+        """delete_tolerant's post-delete re-read comes from cache: the
+        client drains the informer to a barrier, so the deletion (or the
+        terminating MODIFIED) is visible with zero extra RTT."""
+        from tpu_composer.runtime.store import delete_tolerant
+
+        c = make_child(client, "d1")
+        c.metadata.finalizers = ["tpu.composer.dev/lifecycle"]
+        c = client.update(c)
+        surviving = delete_tolerant(client, ComposableResource, "d1")
+        assert surviving is not None and surviving.being_deleted
+        surviving.metadata.finalizers = []
+        client.update(surviving)  # purges
+        assert client.try_get(ComposableResource, "d1") is None
+        # and a finalizer-less object purges outright
+        make_child(client, "d2")
+        assert delete_tolerant(client, ComposableResource, "d2") is None
+
+    def test_failed_informer_start_leaves_no_debris(self, store, client):
+        """A kind the scheme doesn't know: watch() falls back to the raw
+        store, no dead informer is registered, and no store watcher queue
+        is leaked for events to pile into."""
+        watchers_before = len(store._watchers)
+        q = client.watch("NoSuchKind")
+        assert client.cache.peek("NoSuchKind") is None
+        # Exactly the fallback subscription — not an informer's too.
+        assert len(store._watchers) == watchers_before + 1
+        client.stop_watch(q)
+        assert len(store._watchers) == watchers_before
+
+    def test_tombstone_refresh_survives_pruning(self, store, client):
+        """A re-deleted same-name object's tombstone must be the LAST
+        pruned, not the first: pruning is LRU-by-refresh, so a fold racing
+        the newest deletion cannot resurrect the purged object just
+        because the name was also deleted long ago."""
+        inf = client.cache.informer("ComposableResource")
+        with inf._lock:
+            for i in range(4096):
+                inf._tombstones[f"old-{i}"] = i
+            inf._tombstones["hot"] = 1  # ancient insertion position
+        inf._remove("hot", 99999)  # re-deletion refreshes position
+        with inf._lock:
+            inf._tombstones["overflow"] = 100000  # no prune yet (4098 > 4096
+            # only prunes inside _remove) — trigger one more _remove
+        inf._remove("trigger", 100001)
+        with inf._lock:
+            assert inf._tombstones.get("hot") == 99999  # survived the prune
+            assert "old-0" not in inf._tombstones  # cold ones went instead
+
+    def test_uncached_kinds_pass_through(self, store, client):
+        from tpu_composer.api.lease import Lease
+
+        lease = Lease(metadata=ObjectMeta(name="leader"))
+        client.create(lease)
+        before = store_requests_total.total()
+        client.get(Lease, "leader")
+        assert store_requests_total.total() == before + 1  # wire read
+
+
+class TestConflictPath:
+    def test_stale_cached_rv_conflicts_then_converges(self, store, client):
+        """Stale cache copy → write ConflictError → re-read → retry wins:
+        the exact sequence every controller's rate-limited requeue path
+        performs, proven end-to-end against the client."""
+        make_child(client, "c1")
+        stale = client.get(ComposableResource, "c1")
+        # Another writer bumps the rv behind the cache's back.
+        fresh = store.get(ComposableResource, "c1")
+        fresh.status.state = "Attaching"
+        store.update_status(fresh)
+        stale.status.state = "Online"
+        with pytest.raises(ConflictError):
+            client.update_status(stale)
+        # Requeue analog: wait for the watch to refresh the cache, re-read,
+        # rewrite — converges.
+        assert wait_for(
+            lambda: client.get(ComposableResource, "c1").status.state
+            == "Attaching"
+        )
+        retry = client.get(ComposableResource, "c1")
+        retry.status.state = "Online"
+        client.update_status(retry)
+        assert store.get(ComposableResource, "c1").status.state == "Online"
+
+    def test_conflict_error_requeues_and_reconcile_converges(self, store, client):
+        """A controller whose first reconcile writes from a stale copy
+        converges via the ConflictError → add_rate_limited path."""
+
+        class Touch(Controller):
+            primary_kind = "ComposableResource"
+
+            def __init__(self, store_):
+                super().__init__(store_)
+                self.attempts = 0
+                self.done = threading.Event()
+
+            def reconcile(self, name):
+                self.attempts += 1
+                obj = self.store.try_get(ComposableResource, name)
+                if obj is None:
+                    return Result()
+                if obj.status.state != "Online":
+                    if self.attempts == 1:
+                        # Simulate racing writer: bump rv server-side so
+                        # this reconcile's write conflicts.
+                        racer = store_mod.Store.get(store, ComposableResource, name)
+                        store.update_status(racer)
+                    obj.status.state = "Online"
+                    self.store.update_status(obj)  # conflicts on attempt 1
+                    self.done.set()
+                return Result()
+
+        ctrl = Touch(client)
+        ctrl.start(workers=1)
+        try:
+            make_child(client, "r1")
+            assert ctrl.done.wait(10)
+            assert wait_for(
+                lambda: store.get(ComposableResource, "r1").status.state
+                == "Online"
+            )
+            assert ctrl.attempts >= 2  # first attempt conflicted, requeued
+        finally:
+            ctrl.stop()
+
+
+class TestStatusCoalescing:
+    def test_identical_status_write_skipped(self, store, client):
+        make_child(client, "c1")
+        cur = client.get(ComposableResource, "c1")
+        rtts = store_requests_total.total()
+        skipped = status_writes_coalesced_total.total()
+        out = client.update_status(cur)  # nothing changed
+        assert store_requests_total.total() == rtts
+        assert status_writes_coalesced_total.total() == skipped + 1
+        assert out.metadata.resource_version == cur.metadata.resource_version
+
+    def test_changed_status_still_writes(self, store, client):
+        make_child(client, "c1")
+        cur = client.get(ComposableResource, "c1")
+        cur.status.state = "Attaching"
+        out = client.update_status(cur)
+        assert out.metadata.resource_version > cur.metadata.resource_version
+        assert store.get(ComposableResource, "c1").status.state == "Attaching"
+
+    def test_stale_rv_never_coalesced(self, store, client):
+        """A stale caller must reach the store (and conflict) even when its
+        status matches the cached head — coalescing only short-circuits
+        writes from CURRENT state, so the conflict-requeue contract that
+        re-reads fresh state survives."""
+        make_child(client, "c1")
+        stale = client.get(ComposableResource, "c1")
+        fresh = client.get(ComposableResource, "c1")
+        fresh.status.state = "Attaching"
+        client.update_status(fresh)
+        stale.status.state = "Attaching"  # same as head now, but stale rv
+        with pytest.raises(ConflictError):
+            client.update_status(stale)
+
+    def test_dirty_check_helper(self, store, client):
+        obj = make_child(client, "c1")
+        same = obj.deepcopy()
+        assert not status_write_needed(obj, same)
+        same.status.state = "Online"
+        assert status_write_needed(obj, same)
+        stale = obj.deepcopy()
+        stale.metadata.resource_version -= 1
+        assert status_write_needed(obj, stale)
+        assert status_write_needed(None, obj)
+
+
+class TestIndexer:
+    def test_managed_by_selector_uses_index(self, store, client):
+        for i in range(10):
+            make_child(client, f"a-{i}", owner="req-a")
+            make_child(client, f"b-{i}", owner="req-b")
+        make_child(client, "orphan")
+        got = client.list(
+            ComposableResource, label_selector={LABEL_MANAGED_BY: "req-a"}
+        )
+        assert sorted(o.name for o in got) == [f"a-{i}" for i in range(10)]
+        assert (
+            client.list(
+                ComposableResource, label_selector={LABEL_MANAGED_BY: "nope"}
+            )
+            == []
+        )
+
+    def test_index_follows_label_rewrites_and_deletes(self, store, client):
+        c = make_child(client, "c1", owner="req-a")
+        c.metadata.labels[LABEL_MANAGED_BY] = "req-b"
+        client.update(c)
+        assert [
+            o.name
+            for o in client.list(
+                ComposableResource, label_selector={LABEL_MANAGED_BY: "req-b"}
+            )
+        ] == ["c1"]
+        assert (
+            client.list(
+                ComposableResource, label_selector={LABEL_MANAGED_BY: "req-a"}
+            )
+            == []
+        )
+        client.delete(ComposableResource, "c1")
+        assert (
+            client.list(
+                ComposableResource, label_selector={LABEL_MANAGED_BY: "req-b"}
+            )
+            == []
+        )
+
+    def test_indexer_under_concurrent_create_delete(self, store, client):
+        """Churn threads create/delete labeled children while a reader
+        spins on the indexed selector: every returned object must carry
+        the selector's label (no index leaks), and the final index state
+        must match the store exactly."""
+        client.list(ComposableResource)  # start informer
+        stop = threading.Event()
+        errors = []
+
+        def churn(owner, n):
+            try:
+                for i in range(n):
+                    make_child(client, f"{owner}-{i}", owner=owner)
+                for i in range(0, n, 2):
+                    client.delete(ComposableResource, f"{owner}-{i}")
+            except Exception as e:  # pragma: no cover - surfaced via errors
+                errors.append(e)
+
+        def read():
+            while not stop.is_set():
+                for owner in ("req-x", "req-y"):
+                    for o in client.list(
+                        ComposableResource,
+                        label_selector={LABEL_MANAGED_BY: owner},
+                    ):
+                        if o.metadata.labels.get(LABEL_MANAGED_BY) != owner:
+                            errors.append(
+                                AssertionError(f"index leak: {o.name}")
+                            )
+
+        threads = [
+            threading.Thread(target=churn, args=("req-x", 30)),
+            threading.Thread(target=churn, args=("req-y", 30)),
+        ]
+        reader = threading.Thread(target=read)
+        reader.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        stop.set()
+        reader.join(10)
+        assert not errors, errors[:3]
+        # Quiesce, then the cached view must equal the store's view.
+        assert wait_for(
+            lambda: {
+                o.name for o in client.list(ComposableResource)
+            } == {o.name for o in store.list(ComposableResource)}
+        )
+        for owner in ("req-x", "req-y"):
+            assert {
+                o.name
+                for o in client.list(
+                    ComposableResource, label_selector={LABEL_MANAGED_BY: owner}
+                )
+            } == {
+                o.name
+                for o in store.list(
+                    ComposableResource, label_selector={LABEL_MANAGED_BY: owner}
+                )
+            }
+
+
+class TestStoreKindIndex:
+    """Satellite: Store.list touches only the requested kind's objects."""
+
+    def test_list_correct_across_kinds_and_mutations(self, store):
+        make_node(store, "n1")
+        make_child(store, "c1", owner="r1")
+        make_child(store, "c2")
+        assert [o.name for o in store.list(Node)] == ["n1"]
+        assert [o.name for o in store.list(ComposableResource)] == ["c1", "c2"]
+        assert [
+            o.name
+            for o in store.list(
+                ComposableResource, label_selector={LABEL_MANAGED_BY: "r1"}
+            )
+        ] == ["c1"]
+        store.delete(ComposableResource, "c1")
+        assert [o.name for o in store.list(ComposableResource)] == ["c2"]
+        assert [o.name for o in store.list(Node)] == ["n1"]
+        assert set(store.keys()) == {("Node", "n1"), ("ComposableResource", "c2")}
+        assert len(store) == 2
+
+    def test_persistence_reload_keeps_kind_index(self, tmp_path):
+        s1 = Store(persist_dir=str(tmp_path / "state"))
+        make_node(s1, "n1")
+        make_child(s1, "c1")
+        s2 = Store(persist_dir=str(tmp_path / "state"))
+        assert [o.name for o in s2.list(Node)] == ["n1"]
+        assert [o.name for o in s2.list(ComposableResource)] == ["c1"]
+        assert len(s2) == 2
+
+
+class TestWatchQueueDepth:
+    """Satellite: undrained watcher queues are visible, not silent."""
+
+    def test_depth_gauge_and_warning(self, store, monkeypatch, caplog):
+        monkeypatch.setattr(store_mod, "WATCH_QUEUE_WARN_DEPTH", 10)
+        q = store.watch("ComposableResource")
+        label = store._watchers[-1].label
+        with caplog.at_level(logging.WARNING, logger="store"):
+            for i in range(15):
+                make_child(store, f"c-{i}")
+        assert store_watch_queue_depth.value(watcher=label) == 15.0
+        assert any(
+            "falling behind" in rec.message for rec in caplog.records
+        )
+        # One warning per crossing, not one per event.
+        assert (
+            sum("falling behind" in rec.message for rec in caplog.records)
+            == 1
+        )
+        store.stop_watch(q)
+        # Series removed so churning watchers don't grow /metrics forever.
+        assert store_watch_queue_depth.value(watcher=label) == 0.0
+
+
+class TestDispatchLoop:
+    """Satellite: q.get absorbs only queue.Empty; mapper bugs surface."""
+
+    def test_mapper_exception_logged_not_silent(self, store, caplog):
+        class Broken(Controller):
+            primary_kind = ""
+
+            def __init__(self, store_):
+                super().__init__(store_)
+                self.seen = threading.Event()
+                self.watch("ComposableResource", mapper=self._boom)
+
+            def _boom(self, ev):
+                if ev.obj.metadata.name == "bad":
+                    raise RuntimeError("mapper bug")
+                return [ev.obj.metadata.name]
+
+            def reconcile(self, name):
+                self.seen.set()
+                return Result()
+
+        ctrl = Broken(store)
+        ctrl.start(workers=1)
+        try:
+            with caplog.at_level(logging.ERROR, logger="Broken"):
+                make_child(store, "bad")
+                assert wait_for(
+                    lambda: any(
+                        "mapper/predicate failed" in r.message
+                        for r in caplog.records
+                    )
+                )
+                # The dispatch thread survived the bug: later events still flow.
+                make_child(store, "good")
+                assert ctrl.seen.wait(5)
+        finally:
+            ctrl.stop()
+
+
+# ----------------------------------------------------------------------
+# e2e: full operator on cached reads (and the cache-off escape hatch)
+# ----------------------------------------------------------------------
+def _operator(store_or_client, pool=None, fabric=None):
+    pool = pool or InMemoryPool()
+    fabric = fabric or pool
+    agent = FakeNodeAgent(pool=pool)
+    mgr = Manager(store=store_or_client)
+    mgr.add_controller(ComposabilityRequestReconciler(
+        store_or_client, fabric,
+        timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.05)))
+    mgr.add_controller(ComposableResourceReconciler(
+        store_or_client, fabric, agent,
+        timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
+                              detach_poll=0.05, detach_fast=0.05,
+                              busy_poll=0.05)))
+    mgr.start(workers_per_controller=2)
+    return mgr, pool
+
+
+def submit(store, name, size=8):
+    store.create(ComposabilityRequest(
+        metadata=ObjectMeta(name=name),
+        spec=ComposabilityRequestSpec(
+            resource=ResourceDetails(type="tpu", model="tpu-v4", size=size)),
+    ))
+
+
+@pytest.mark.parametrize("cached", [True, False], ids=["cache-on", "cache-off"])
+class TestE2EEquivalence:
+    def test_lifecycle_converges(self, store, cached):
+        """Attach → Running → delete → purged, cache on AND off: the
+        TPUC_CACHED_READS=0 escape hatch is a pure latency trade, never a
+        semantic one."""
+        for i in range(4):
+            make_node(store, f"worker-{i}")
+        client = maybe_cached(store, cached)
+        assert isinstance(client, CachedClient) == cached
+        mgr, pool = _operator(client)
+        free0 = pool.free_chips("tpu-v4")
+        try:
+            for cycle in range(2):
+                submit(store, f"job-{cycle}")
+                assert wait_for(
+                    lambda c=cycle: store.get(
+                        ComposabilityRequest, f"job-{c}"
+                    ).status.state == REQUEST_STATE_RUNNING
+                ), store.get(ComposabilityRequest, f"job-{cycle}").status.to_dict()
+                store.delete(ComposabilityRequest, f"job-{cycle}")
+                assert wait_for(
+                    lambda c=cycle: store.try_get(
+                        ComposabilityRequest, f"job-{c}"
+                    ) is None
+                )
+            assert wait_for(lambda: not store.list(ComposableResource))
+            assert pool.free_chips("tpu-v4") == free0  # everything released
+        finally:
+            mgr.stop()
+
+    def test_resync_after_controller_stop_start(self, store, cached):
+        """An object created while the controllers are DOWN is reconciled
+        after restart: the initial reconcile wave lists from the cache,
+        which must resync regardless of what it missed."""
+        for i in range(4):
+            make_node(store, f"worker-{i}")
+        client = maybe_cached(store, cached)
+        mgr, pool = _operator(client)
+        try:
+            submit(store, "job-0")
+            assert wait_for(
+                lambda: store.get(ComposabilityRequest, "job-0").status.state
+                == REQUEST_STATE_RUNNING
+            )
+        finally:
+            mgr.stop()
+        # Controllers (and, via the manager, the informers) are down.
+        submit(store, "job-1")
+        client2 = maybe_cached(store, cached)
+        mgr2, _ = _operator(client2, pool=pool)
+        try:
+            assert wait_for(
+                lambda: store.get(ComposabilityRequest, "job-1").status.state
+                == REQUEST_STATE_RUNNING
+            ), store.get(ComposabilityRequest, "job-1").status.to_dict()
+            # job-0 resumed untouched (still Running, still 2 children).
+            assert (
+                store.get(ComposabilityRequest, "job-0").status.state
+                == REQUEST_STATE_RUNNING
+            )
+        finally:
+            mgr2.stop()
+
+
+class TestChaosWithCache:
+    def test_chaos_attach_converges_on_cached_reads(self, store):
+        """Tier-1 chaos smoke with the cache ON: probabilistic transient
+        fabric failures exercise the error → status-write → backoff-requeue
+        paths on top of cached reads; the request still reaches Running and
+        tears down cleanly."""
+        for i in range(4):
+            make_node(store, f"worker-{i}")
+        client = CachedClient(store)
+        pool = InMemoryPool()
+        chaos = ChaosFabricProvider(pool, failure_rate=0.15, seed=7)
+        mgr, _ = _operator(client, pool=pool, fabric=chaos)
+        free0 = pool.free_chips("tpu-v4")
+        try:
+            submit(store, "chaos-job")
+            assert wait_for(
+                lambda: store.get(ComposabilityRequest, "chaos-job").status.state
+                == REQUEST_STATE_RUNNING,
+                timeout=30.0,
+            ), store.get(ComposabilityRequest, "chaos-job").status.to_dict()
+            assert chaos.injected > 0  # the run actually saw failures
+            store.delete(ComposabilityRequest, "chaos-job")
+            assert wait_for(
+                lambda: store.try_get(ComposabilityRequest, "chaos-job") is None,
+                timeout=30.0,
+            )
+            assert wait_for(lambda: pool.free_chips("tpu-v4") == free0,
+                            timeout=30.0)
+        finally:
+            mgr.stop()

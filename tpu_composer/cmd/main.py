@@ -16,6 +16,8 @@ SURVEY.md §5 "Config / flag system"):
   NODE_AGENT          FAKE | LOCAL (default FAKE under MOCK, LOCAL otherwise)
   ENABLE_WEBHOOKS     "false" disables in-process admission (cmd/main.go:196)
   TPUC_STATE_DIR      object-store persistence directory
+  TPUC_CACHED_READS   "0" disables the watch-fed informer read cache
+                      (--no-cached-reads equivalent; default on)
 
 Run: ``python -m tpu_composer [flags]`` or ``python -m tpu_composer.cmd.main``.
 """
@@ -122,6 +124,16 @@ def build_parser() -> argparse.ArgumentParser:
              "auto — in-cluster when a service account token is mounted AND "
              "no --state-dir/TPUC_STATE_DIR configures standalone mode; "
              "--no-in-cluster forces the standalone store inside a pod",
+    )
+    p.add_argument(
+        "--cached-reads",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_CACHED_READS", "1") != "0",
+        help="serve controller get/list from a watch-fed informer cache;"
+             " only writes pay an apiserver round trip (controller-runtime"
+             " parity). --no-cached-reads or TPUC_CACHED_READS=0 reads the"
+             " store directly on every call (escape hatch; semantics are"
+             " identical, latency is not)",
     )
     p.add_argument(
         "--workers",
@@ -255,20 +267,30 @@ def build_store(args: argparse.Namespace):
             else KubeConfig.load(kubeconfig or None)
         )
         log.info("store: kube-apiserver at %s", cfg.host)
-        return KubeStore(config=cfg)
+        # KubeStore's reflector cache is the wire-path twin of the
+        # standalone CachedClient — one flag governs both.
+        return KubeStore(
+            config=cfg, cache_reads=getattr(args, "cached_reads", True)
+        )
     log.info("store: standalone (state_dir=%s)", args.state_dir or "<memory>")
     return Store(persist_dir=args.state_dir or None)
 
 
 def build_manager(args: argparse.Namespace) -> Manager:
     store = build_store(args)
+    # Informer read cache (runtime/cache.py): controllers, scheduler,
+    # syncer and admission all read through `client`; only writes reach
+    # `store`. KubeStore passes through unchanged (it caches internally).
+    from tpu_composer.runtime.cache import maybe_cached
+
+    client = maybe_cached(store, getattr(args, "cached_reads", True))
     from tpu_composer.fabric.adapter import TracedFabricProvider
 
     # Every fabric verb becomes a trace span (runtime/tracing.py); the
     # wrapper delegates everything else, so pick_node_agent's
     # InMemoryPool-identity check keeps seeing the shared mock directly.
     fabric = TracedFabricProvider(new_fabric_provider())
-    agent = pick_node_agent(store)
+    agent = pick_node_agent(client)
 
     addr = args.health_probe_bind_address or None
     if addr and addr.startswith(":"):
@@ -282,6 +304,9 @@ def build_manager(args: argparse.Namespace) -> Manager:
             # cmd/main.go:142-155); the file lock only fences one host.
             from tpu_composer.runtime.leases import LeaseElector
 
+            # The raw store, not the client: leader election needs
+            # linearizable Lease reads (both cache layers exclude Leases,
+            # but the intent belongs in the wiring too).
             elector = LeaseElector(store)
     maddr = args.metrics_bind_address or None
     if maddr and maddr.startswith(":"):
@@ -295,7 +320,7 @@ def build_manager(args: argparse.Namespace) -> Manager:
             " bearer tokens must not transit plain HTTP"
         )
     mgr = Manager(
-        store=store,
+        store=client,
         leader_elect=args.leader_elect,
         leader_lock_path=args.leader_lock_path,
         health_addr=addr,
@@ -307,19 +332,19 @@ def build_manager(args: argparse.Namespace) -> Manager:
     )
     from tpu_composer.scheduler import ClusterScheduler, DefragLoop
 
-    scheduler = ClusterScheduler(store)
-    mgr.add_controller(ComposabilityRequestReconciler(store, fabric,
+    scheduler = ClusterScheduler(client)
+    mgr.add_controller(ComposabilityRequestReconciler(client, fabric,
                                                       recorder=mgr.recorder,
                                                       scheduler=scheduler))
-    res_rec = ComposableResourceReconciler(store, fabric, agent,
+    res_rec = ComposableResourceReconciler(client, fabric, agent,
                                            recorder=mgr.recorder)
     mgr.add_controller(res_rec)
     if args.defrag_interval > 0:
-        mgr.add_runnable(DefragLoop(store, scheduler.defrag,
+        mgr.add_runnable(DefragLoop(client, scheduler.defrag,
                                     period=args.defrag_interval,
                                     execute=args.defrag_execute,
                                     recorder=mgr.recorder))
-    mgr.add_runnable(UpstreamSyncer(store, fabric, period=args.sync_period,
+    mgr.add_runnable(UpstreamSyncer(client, fabric, period=args.sync_period,
                                     grace=args.sync_grace,
                                     recorder=mgr.recorder))
     # Event-driven visibility: /dev change events nudge the resource
@@ -339,7 +364,7 @@ def build_manager(args: argparse.Namespace) -> Manager:
         if isinstance(agent, RemoteNodeAgent):
             mgr.add_runnable(MultiNodeWatcher(agent, res_rec))
     if os.environ.get("ENABLE_WEBHOOKS", "").lower() != "false":
-        register_validating_webhooks(store)
+        register_validating_webhooks(client)
         if args.webhook_bind_address:
             # The AdmissionReview wire server (reference :9443 webhook
             # server, cmd/main.go:101-103): validating + pod-mutating
@@ -364,7 +389,7 @@ def build_manager(args: argparse.Namespace) -> Manager:
                         if stop_event.wait(2.0):
                             return
                 webhook = AdmissionServer(
-                    store,
+                    client,
                     bind=args.webhook_bind_address,
                     certfile=certfile,
                     keyfile=(args.webhook_key or None) if certfile else None,

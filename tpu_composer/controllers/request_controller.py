@@ -36,6 +36,16 @@ where a slice goes (priority arbitration, gang admission, preemption) and
 executes the decision: writing placeholders, reserving the fabric, and —
 when the scheduler names victims — driving their eviction through the same
 child-delete / re-solve paths every other disruption uses.
+
+Reads vs writes: ``self.store`` is normally a
+:class:`~tpu_composer.runtime.cache.CachedClient` (cmd/main's
+``--cached-reads``, on by default) — every ``get``/``try_get``/``list``
+(including ``_children``'s managed-by selector, which the cache serves from
+a label index) costs zero apiserver round trips, and only the writes here
+hit the wire. A stale cached read surfaces as ``ConflictError`` on the
+write and rides the existing rate-limited-requeue path. The escape hatch
+(``TPUC_CACHED_READS=0``) hands this controller the raw store with
+identical semantics.
 """
 
 from __future__ import annotations
@@ -333,7 +343,10 @@ class ComposabilityRequestReconciler(Controller):
     def _handle_node_allocating(self, req: ComposabilityRequest) -> Result:
         with self._alloc_lock:
             # Re-read inside the lock so this decision sees every placeholder
-            # written by allocations that just finished.
+            # written by allocations that just finished. Safe under cached
+            # reads too: the CachedClient folds write RESPONSES into the
+            # cache before update_status returns, so anything persisted
+            # under this lock by the previous holder is visible here.
             req = self.store.get(ComposabilityRequest, req.name)
             res = req.spec.resource
             children = self._children(req)

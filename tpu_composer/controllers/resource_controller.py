@@ -19,6 +19,14 @@ TPU-first deltas:
 - polling quanta are sub-second and configurable (ResourceTiming) instead of
   the fixed 30s/3s requeues (:236,:298,:400) — the single biggest
   attach-to-Ready latency lever identified in BASELINE.md.
+
+Reads vs writes: ``self.store`` is normally a
+:class:`~tpu_composer.runtime.cache.CachedClient` (cmd/main's
+``--cached-reads``) — the node-existence probes, `_assign_chip_indices`'
+all-resources scan and `_quarantine_allowed`'s node sweep are cache reads
+(zero RTT); only status/spec writes pay an apiserver round trip, and
+identical status re-writes are coalesced away at the client. Stale cached
+reads surface as ``ConflictError`` → rate-limited requeue, unchanged.
 """
 
 from __future__ import annotations

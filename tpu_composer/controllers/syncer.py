@@ -9,7 +9,10 @@ leaked device id (:140-165) — its reconciler adopts the id and runs the
 normal detach path, returning the chip to the pool.
 
 Ours keeps the design but with configurable cadence/grace (the bench runs
-sub-second) and structured events.
+sub-second) and structured events. The store handle is normally the
+CachedClient (cmd/main ``--cached-reads``): the per-tick
+ComposableResource scan is an informer-cache read, so shrinking the sync
+period for fast leak reclaim no longer multiplies apiserver list load.
 """
 
 from __future__ import annotations

@@ -20,6 +20,7 @@ like the reference's suites (SURVEY.md §4 "Tests invoke Reconcile directly").
 from __future__ import annotations
 
 import logging
+import queue as _queue
 import threading
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
@@ -126,12 +127,25 @@ class Controller:
     ) -> None:
         while not self._stop.is_set():
             try:
+                # Only the expected timeout is absorbed: a bare `except
+                # Exception` here used to swallow real mapper/store bugs
+                # into a silent 0.2 s spin loop.
                 event: WatchEvent = q.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            if event is None:
+                continue  # wake-up sentinel some feeders use on shutdown
+            try:
+                if predicate is not None and not predicate(event):
+                    continue
+                keys = mapper(event) if mapper else [event.obj.metadata.name]
             except Exception:
+                # A mapper/predicate bug must not kill the dispatch thread
+                # (events would silently stop flowing) — log loudly, drop
+                # the one event, keep dispatching.
+                self.log.exception("dispatch: mapper/predicate failed for %s",
+                                   getattr(event, "type", event))
                 continue
-            if predicate is not None and not predicate(event):
-                continue
-            keys = mapper(event) if mapper else [event.obj.metadata.name]
             for key in keys:
                 self.queue.add(key)
 

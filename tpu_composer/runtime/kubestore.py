@@ -55,6 +55,11 @@ from tpu_composer import GROUP, VERSION
 from tpu_composer.api.meta import ApiObject, ObjectMeta
 from tpu_composer.api.scheme import Scheme, default_scheme
 from tpu_composer.api.types import Node, NodeStatus
+from tpu_composer.runtime.metrics import (
+    cached_reads_total,
+    status_writes_coalesced_total,
+    store_requests_total,
+)
 from tpu_composer.runtime.store import (
     ADDED,
     DELETED,
@@ -496,6 +501,7 @@ class KubeStore:
         self._run_admission("CREATE", obj, None)
         if hasattr(obj, "validate"):
             obj.validate()
+        store_requests_total.inc(verb="create", kind=obj.KIND)
         out = self._request("POST", route.path_prefix, self._encode(obj))
         decoded = self._decode(obj.KIND, out)
         self._note_write(decoded)
@@ -504,11 +510,13 @@ class KubeStore:
     def get(self, cls: Type[T], name: str) -> T:
         refl = self._cached(cls.KIND)
         if refl is not None:
+            cached_reads_total.inc(verb="get", kind=cls.KIND)
             obj = refl.get(name)
             if obj is None:
                 raise NotFoundError(f"GET {cls.KIND}/{name}: 404 NotFound (cache)")
             return obj  # type: ignore[return-value]
         route = self._route(cls.KIND)
+        store_requests_total.inc(verb="get", kind=cls.KIND)
         out = self._request("GET", f"{route.path_prefix}/{name}")
         return self._decode(cls.KIND, out)  # type: ignore[return-value]
 
@@ -525,6 +533,7 @@ class KubeStore:
     ) -> List[T]:
         refl = self._cached(cls.KIND)
         if refl is not None:
+            cached_reads_total.inc(verb="list", kind=cls.KIND)
             decoded = refl.list()
             if label_selector:
                 decoded = [
@@ -537,6 +546,7 @@ class KubeStore:
                 ]
             return sorted(decoded, key=lambda o: o.metadata.name)  # type: ignore[return-value]
         route = self._route(cls.KIND)
+        store_requests_total.inc(verb="list", kind=cls.KIND)
         path = route.path_prefix
         if label_selector:
             sel = ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
@@ -573,6 +583,7 @@ class KubeStore:
             self._run_admission("UPDATE", obj, old)
         if hasattr(obj, "validate"):
             obj.validate()
+        store_requests_total.inc(verb="update", kind=obj.KIND)
         out = self._request(
             "PUT", f"{route.path_prefix}/{obj.metadata.name}", self._encode(obj)
         )
@@ -585,6 +596,19 @@ class KubeStore:
         if route.read_only:
             raise StoreError(f"{obj.KIND} is read-only through KubeStore")
         obj = obj.deepcopy()
+        # Status-write coalescing (shared dirty-check with the standalone
+        # CachedClient): a status identical to the cached head at the same
+        # resourceVersion would be a pure rv-bump PUT — skip the wire op.
+        if route.cacheable and self._cache_reads:
+            from tpu_composer.runtime.cache import status_write_needed
+
+            with self._lock:
+                refl = self._reflectors.get(obj.KIND)
+            if refl is not None and refl.wait_synced(0):
+                if not status_write_needed(refl.get(obj.metadata.name), obj):
+                    status_writes_coalesced_total.inc(kind=obj.KIND)
+                    return obj.deepcopy()
+        store_requests_total.inc(verb="update_status", kind=obj.KIND)
         out = self._request(
             "PUT",
             f"{route.path_prefix}/{obj.metadata.name}/status",
@@ -603,6 +627,7 @@ class KubeStore:
             if stored is None:
                 raise NotFoundError(f"{cls.KIND}/{name} not found")
             self._run_admission("DELETE", stored.deepcopy(), stored)
+        store_requests_total.inc(verb="delete", kind=cls.KIND)
         out = self._request("DELETE", f"{route.path_prefix}/{name}")
         # Keep the cache coherent with what the DELETE actually did: the
         # server returns the object when deletion is pending on finalizers
@@ -730,6 +755,7 @@ class _WatchThread(threading.Thread):
         state so consumers (node-GC mappers, the read cache) still observe
         the deletion."""
         route = self._store._route(self._kind)
+        store_requests_total.inc(verb="list", kind=self._kind)
         out = self._store._request("GET", route.path_prefix)
         listed: Dict[str, ApiObject] = {}
         for item in out.get("items", []):

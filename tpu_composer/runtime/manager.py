@@ -129,6 +129,9 @@ class Manager:
         # `is not None`, not `or`: an EMPTY store is falsy (Store.__len__),
         # and silently swapping in a fresh one would orphan the caller's
         # admission hooks and persistence settings.
+        # The handle may be a runtime.cache.CachedClient (the watch-fed
+        # informer facade cmd/main wires under --cached-reads): the manager
+        # owns its lifecycle and stops the informer threads on shutdown.
         self.store = store if store is not None else Store()
         self.recorder = EventRecorder()
         self.log = logging.getLogger("manager")
@@ -286,6 +289,12 @@ class Manager:
         for t in self._threads:
             t.join(timeout=5)
         self._threads.clear()
+        # Informer shutdown AFTER the controllers: their stop() paths may
+        # still read through the cache, and the store watches the informers
+        # hold must unsubscribe before the process exits.
+        stop_informers = getattr(self.store, "stop_informers", None)
+        if callable(stop_informers):
+            stop_informers()
         if self._elector is not None:
             self._elector.release()
         self._started = False

@@ -42,6 +42,12 @@ class Counter:
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def total(self) -> float:
+        """Sum over every label set (bench convenience: the RTT counter's
+        delta across a run divided by cycles = store_rtts_per_attach)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def remove(self, **labels: str) -> None:
         """Drop one label-set's series (e.g. a deleted node's breaker
         gauges) so churning fleets don't grow /metrics unboundedly."""
@@ -217,6 +223,28 @@ fabric_breaker_rejections_total = global_registry.counter(
 resources_quarantined_total = global_registry.counter(
     "tpuc_resources_quarantined_total",
     "ComposableResources quarantined after exhausting their attach budget",
+)
+
+#: Informer read cache (runtime/cache.py + kubestore reflector): the
+#: read-path instrumentation that makes store_rtts_per_attach measurable.
+store_requests_total = global_registry.counter(
+    "tpuc_store_requests_total",
+    "Store/apiserver round trips by verb and kind (wire ops only — reads"
+    " served from the informer cache are counted in tpuc_cached_reads_total)",
+)
+cached_reads_total = global_registry.counter(
+    "tpuc_cached_reads_total",
+    "get/list reads served from the watch-fed informer cache (zero RTT)",
+)
+status_writes_coalesced_total = global_registry.counter(
+    "tpuc_status_writes_coalesced_total",
+    "update_status calls skipped because the status dict was unchanged at"
+    " the current resourceVersion",
+)
+store_watch_queue_depth = global_registry.gauge(
+    "tpuc_store_watch_queue_depth",
+    "Undrained events per store watcher queue (a growing depth means a"
+    " slow consumer — the unbounded queue would otherwise hide it)",
 )
 
 #: Cluster scheduler (scheduler/: priority queue, preemption, defrag).

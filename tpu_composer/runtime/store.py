@@ -28,16 +28,28 @@ from __future__ import annotations
 
 import copy
 import json
+import logging
 import os
 import queue
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Tuple, Type, TypeVar
 
 from tpu_composer.api.meta import ApiObject, new_uid, now_iso
 from tpu_composer.api.scheme import Scheme, default_scheme
+from tpu_composer.runtime.metrics import (
+    store_requests_total,
+    store_watch_queue_depth,
+)
 
 T = TypeVar("T", bound=ApiObject)
+
+#: Watcher queues are unbounded; past this depth the consumer is falling
+#: behind and we say so (gauge + one warning per crossing) instead of
+#: silently buffering events forever.
+WATCH_QUEUE_WARN_DEPTH = 1024
+
+_log = logging.getLogger("store")
 
 
 class StoreError(Exception):
@@ -84,6 +96,17 @@ class WatchEvent:
     obj: ApiObject
 
 
+@dataclass
+class _Watcher:
+    """One subscription: its kind filter, queue, stable metric identity,
+    and whether the depth warning already fired for the current backlog."""
+
+    kind: Optional[str]
+    q: "queue.Queue[WatchEvent]"
+    label: str = ""
+    warned: bool = field(default=False, compare=False)
+
+
 # An admission hook runs inside create/update with (op, new_obj, old_obj) and
 # may mutate new_obj or raise to reject. op ∈ {"CREATE", "UPDATE", "DELETE"}.
 # Reference analog: the validating webhook registered at cmd/main.go:196-201.
@@ -106,10 +129,13 @@ class Store:
         self._scheme = scheme or default_scheme()
         self._latency_s = latency_s
         self._lock = threading.RLock()
-        # (kind, name) -> object. All objects are cluster-scoped, like the
-        # reference's CRDs (+kubebuilder:resource:scope=Cluster).
-        self._objects: Dict[Tuple[str, str], ApiObject] = {}
-        self._watchers: List[Tuple[Optional[str], "queue.Queue[WatchEvent]"]] = []
+        # kind -> name -> object (all cluster-scoped, like the reference's
+        # CRDs, +kubebuilder:resource:scope=Cluster). The per-kind secondary
+        # index keeps ``list`` from scanning and sorting every kind's keys
+        # on each call — list runs on every reconcile, caching on or off.
+        self._by_kind: Dict[str, Dict[str, ApiObject]] = {}
+        self._watchers: List[_Watcher] = []
+        self._watch_seq = 0
         self._admission: List[Tuple[str, AdmissionHook]] = []  # (kind or "*", hook)
         self._rv_counter = 0
         self._persist_dir = persist_dir
@@ -158,7 +184,7 @@ class Store:
                     continue
                 with open(os.path.join(kdir, fn)) as f:
                     obj = self._scheme.decode(json.load(f))
-                self._objects[(obj.KIND, obj.metadata.name)] = obj
+                self._by_kind.setdefault(obj.KIND, {})[obj.metadata.name] = obj
                 max_rv = max(max_rv, obj.metadata.resource_version)
         self._rv_counter = max_rv
 
@@ -180,18 +206,39 @@ class Store:
         """
         q: "queue.Queue[WatchEvent]" = queue.Queue()
         with self._lock:
-            self._watchers.append((kind, q))
+            self._watch_seq += 1
+            self._watchers.append(
+                _Watcher(kind, q, label=f"{kind or '*'}-{self._watch_seq}")
+            )
         return q
 
     def stop_watch(self, q: "queue.Queue[WatchEvent]") -> None:
         with self._lock:
-            self._watchers = [(k, w) for (k, w) in self._watchers if w is not q]
+            kept = []
+            for w in self._watchers:
+                if w.q is q:
+                    store_watch_queue_depth.remove(watcher=w.label)
+                else:
+                    kept.append(w)
+            self._watchers = kept
 
     def _notify(self, event_type: str, obj: ApiObject) -> None:
         snap = obj.deepcopy()
-        for kind, q in self._watchers:
-            if kind is None or kind == obj.KIND:
-                q.put(WatchEvent(event_type, snap))
+        for w in self._watchers:
+            if w.kind is None or w.kind == obj.KIND:
+                w.q.put(WatchEvent(event_type, snap))
+                depth = w.q.qsize()
+                store_watch_queue_depth.set(float(depth), watcher=w.label)
+                if depth > WATCH_QUEUE_WARN_DEPTH:
+                    if not w.warned:
+                        w.warned = True
+                        _log.warning(
+                            "watcher %s queue depth %d exceeds %d —"
+                            " consumer is falling behind",
+                            w.label, depth, WATCH_QUEUE_WARN_DEPTH,
+                        )
+                elif depth <= WATCH_QUEUE_WARN_DEPTH // 2:
+                    w.warned = False
 
     def _run_admission(self, op: str, new: ApiObject, old: Optional[ApiObject]) -> None:
         for kind, hook in list(self._admission):
@@ -212,13 +259,14 @@ class Store:
             time.sleep(self._latency_s)
 
     def create(self, obj: T) -> T:
+        store_requests_total.inc(verb="create", kind=obj.KIND)
         self._rtt()
         obj = obj.deepcopy()
         if not obj.metadata.name:
             raise StoreError("metadata.name is required")
         with self._lock:
-            key = (obj.KIND, obj.metadata.name)
-            if key in self._objects:
+            kind_objs = self._by_kind.setdefault(obj.KIND, {})
+            if obj.metadata.name in kind_objs:
                 raise AlreadyExistsError(f"{obj.KIND}/{obj.metadata.name} already exists")
             # Admission (mutating) runs before schema validation, matching the
             # K8s admission chain the reference's webhook participates in.
@@ -230,16 +278,17 @@ class Store:
             obj.metadata.generation = 1
             obj.metadata.creation_timestamp = obj.metadata.creation_timestamp or now_iso()
             obj.metadata.deletion_timestamp = None
-            self._objects[key] = obj
+            kind_objs[obj.metadata.name] = obj
             self._persist(obj)
             self._notify(ADDED, obj)
             return obj.deepcopy()
 
     def get(self, cls: Type[T], name: str) -> T:
+        store_requests_total.inc(verb="get", kind=cls.KIND)
         self._rtt()
         with self._lock:
             try:
-                obj = self._objects[(cls.KIND, name)]
+                obj = self._by_kind.get(cls.KIND, {})[name]
             except KeyError:
                 raise NotFoundError(f"{cls.KIND}/{name} not found") from None
             return obj.deepcopy()  # type: ignore[return-value]
@@ -255,12 +304,14 @@ class Store:
         cls: Type[T],
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[T]:
+        store_requests_total.inc(verb="list", kind=cls.KIND)
         self._rtt()
         with self._lock:
+            # Per-kind index: only this kind's objects are touched — list
+            # runs on every reconcile, so the old all-kinds scan+sort cost
+            # O(total objects log total) per call even with caching off.
             out: List[T] = []
-            for (kind, _), obj in sorted(self._objects.items()):
-                if kind != cls.KIND:
-                    continue
+            for _, obj in sorted(self._by_kind.get(cls.KIND, {}).items()):
                 if label_selector and any(
                     obj.metadata.labels.get(k) != v for k, v in label_selector.items()
                 ):
@@ -281,11 +332,12 @@ class Store:
         If the object is terminating and this update removes the last
         finalizer, the object is purged (DELETED event) — K8s semantics.
         """
+        store_requests_total.inc(verb="update", kind=obj.KIND)
         self._rtt()
         obj = obj.deepcopy()
         with self._lock:
-            key = (obj.KIND, obj.metadata.name)
-            stored = self._objects.get(key)
+            kind_objs = self._by_kind.get(obj.KIND, {})
+            stored = kind_objs.get(obj.metadata.name)
             if stored is None:
                 raise NotFoundError(f"{obj.KIND}/{obj.metadata.name} not found")
             self._check_conflict(stored, obj)
@@ -303,30 +355,31 @@ class Store:
             obj.metadata.resource_version = self._next_rv()
 
             if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
-                del self._objects[key]
+                del kind_objs[obj.metadata.name]
                 self._unpersist(obj.KIND, obj.metadata.name)
                 self._notify(DELETED, obj)
                 return obj.deepcopy()
 
-            self._objects[key] = obj
+            kind_objs[obj.metadata.name] = obj
             self._persist(obj)
             self._notify(MODIFIED, obj)
             return obj.deepcopy()
 
     def update_status(self, obj: T) -> T:
         """Persist only ``status`` (status subresource semantics)."""
+        store_requests_total.inc(verb="update_status", kind=obj.KIND)
         self._rtt()
         obj = obj.deepcopy()
         with self._lock:
-            key = (obj.KIND, obj.metadata.name)
-            stored = self._objects.get(key)
+            kind_objs = self._by_kind.get(obj.KIND, {})
+            stored = kind_objs.get(obj.metadata.name)
             if stored is None:
                 raise NotFoundError(f"{obj.KIND}/{obj.metadata.name} not found")
             self._check_conflict(stored, obj)
             updated = stored.deepcopy()
             updated.status = obj.status  # type: ignore[attr-defined]
             updated.metadata.resource_version = self._next_rv()
-            self._objects[key] = updated
+            kind_objs[obj.metadata.name] = updated
             self._persist(updated)
             self._notify(MODIFIED, updated)
             return updated.deepcopy()  # type: ignore[return-value]
@@ -338,10 +391,11 @@ class Store:
         controllers run their teardown states (the reference's Cleaning /
         Detaching paths). Without: purges immediately.
         """
+        store_requests_total.inc(verb="delete", kind=cls.KIND)
         self._rtt()
         with self._lock:
-            key = (cls.KIND, name)
-            stored = self._objects.get(key)
+            kind_objs = self._by_kind.get(cls.KIND, {})
+            stored = kind_objs.get(name)
             if stored is None:
                 raise NotFoundError(f"{cls.KIND}/{name} not found")
             # Hooks get copies: a mutating hook must not corrupt canonical
@@ -352,11 +406,11 @@ class Store:
                     updated = stored.deepcopy()
                     updated.metadata.deletion_timestamp = now_iso()
                     updated.metadata.resource_version = self._next_rv()
-                    self._objects[key] = updated
+                    kind_objs[name] = updated
                     self._persist(updated)
                     self._notify(MODIFIED, updated)
                 return
-            del self._objects[key]
+            del kind_objs[name]
             self._unpersist(cls.KIND, name)
             self._notify(DELETED, stored)
 
@@ -365,8 +419,12 @@ class Store:
     # ------------------------------------------------------------------
     def keys(self) -> Iterable[Tuple[str, str]]:
         with self._lock:
-            return list(self._objects.keys())
+            return [
+                (kind, name)
+                for kind, objs in self._by_kind.items()
+                for name in objs
+            ]
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._objects)
+            return sum(len(objs) for objs in self._by_kind.values())

@@ -63,6 +63,14 @@ class PlacementEngine:
     cluster, so the caller (the request controller under its allocation
     lock, or the defrag planner) always sees placeholders written by the
     allocation that just finished.
+
+    The handle is normally the CachedClient (cmd/main ``--cached-reads``),
+    which is what makes "re-read everything per decision" affordable at
+    fleet scale: capacity_maps' two full scans and every feasibility
+    probe's node list are informer-cache snapshots (zero RTT), and the
+    write-response folding in the client preserves the
+    placeholders-visible-under-the-lock invariant the docstring above
+    relies on.
     """
 
     def __init__(self, store) -> None:
