@@ -317,6 +317,178 @@ class TestStatusCoalescing:
         with pytest.raises(ConflictError):
             client.update_status(stale)
 
+    def test_lagging_cache_never_coalesces_a_conflict(self, store, client):
+        """A cached head LAGGING the store (newer event still queued) must
+        not turn a would-be ConflictError into a silently 'successful'
+        coalesce: the client drains the informer to a barrier and
+        re-checks before skipping, so the stale write reaches the store
+        and conflicts exactly like cache-off mode."""
+        make_child(client, "c1")
+        stale = client.get(ComposableResource, "c1")
+        inf = client.cache.peek("ComposableResource")
+        # Slow event application so the cache provably lags when the
+        # coalescing check first looks at the head.
+        orig_apply = inf._apply
+
+        def slow_apply(obj):
+            time.sleep(0.05)
+            orig_apply(obj)
+
+        inf._apply = slow_apply
+        # Another writer bumps the rv behind the cache's back; its
+        # MODIFIED event sits in the informer queue for >=50ms.
+        fresh = store.get(ComposableResource, "c1")
+        fresh.status.state = "Attaching"
+        store.update_status(fresh)
+        # Stale rv + status identical to the (lagging) cached head: the
+        # naive check would coalesce; the raw store conflicts.
+        with pytest.raises(ConflictError):
+            client.update_status(stale)
+
+
+class TestLazyStartConcurrency:
+    """Regression: InformerCache must never hold its lock across
+    _KindInformer.start(). Admission hooks registered on the CachedClient
+    (cmd/main) run inside Store.create/update holding Store._lock and read
+    back through the cache; a lazy informer start that held the cache lock
+    while calling store.watch()/store.list() acquired the two locks in
+    opposite orders — one racing create wedged every store op (ABBA)."""
+
+    def test_admission_hook_read_races_lazy_informer_start(self):
+        for _ in range(30):
+            store = Store(latency_s=0.002)  # widen the start window
+            client = CachedClient(store)
+
+            def hook(op, new, old):
+                # Webhook shape: reads back through the cached client
+                # while the store holds its lock around this hook.
+                client.list(ComposabilityRequest)
+
+            client.register_admission("*", hook)
+            barrier = threading.Barrier(2)
+
+            def creator():
+                barrier.wait()
+                make_node(client, "worker-0")
+
+            def reader():
+                barrier.wait()
+                client.list(ComposabilityRequest)
+
+            threads = [
+                threading.Thread(target=creator, daemon=True),
+                threading.Thread(target=reader, daemon=True),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            assert not any(t.is_alive() for t in threads), (
+                "lazy informer start deadlocked against an admission-hook"
+                " read (ABBA on Store._lock / InformerCache._lock)"
+            )
+            client.stop_informers()
+
+    def test_reads_never_block_on_inflight_start(self, store, client, monkeypatch):
+        """While another thread is mid-start for a kind, cached reads fall
+        back to the raw store instead of waiting — waiting inside an
+        admission hook (Store._lock held) on a starter that needs
+        Store._lock would re-create the deadlock as a wait cycle."""
+        from tpu_composer.runtime import cache as cache_mod
+
+        orig_start = cache_mod._KindInformer.start
+        entered = threading.Event()
+        gate = threading.Event()
+
+        def slow_start(self):
+            entered.set()
+            assert gate.wait(10)
+            orig_start(self)
+
+        monkeypatch.setattr(cache_mod._KindInformer, "start", slow_start)
+        make_node(store, "worker-0")
+        starter = threading.Thread(
+            target=lambda: client.cache.informer("Node"), daemon=True
+        )
+        starter.start()
+        assert entered.wait(5)
+        # The cache lock is free while start() runs...
+        assert client.cache._lock.acquire(timeout=2)
+        client.cache._lock.release()
+        # ...and a concurrent read completes promptly from the raw store.
+        before = store_requests_total.total()
+        got = []
+        reader = threading.Thread(
+            target=lambda: got.append(client.get(Node, "worker-0")), daemon=True
+        )
+        reader.start()
+        reader.join(timeout=5)
+        assert not reader.is_alive(), "read blocked on an in-flight start"
+        assert got and got[0].metadata.name == "worker-0"
+        assert store_requests_total.total() == before + 1  # raw-store read
+        gate.set()
+        starter.join(timeout=10)
+        assert not starter.is_alive()
+        assert client.cache.peek("Node") is not None  # published after start
+
+    def test_waiters_observe_published_informer(self, store, client, monkeypatch):
+        """watch()-path callers (wait=True) block on the per-kind start
+        event and pick up the published informer, not a duplicate."""
+        from tpu_composer.runtime import cache as cache_mod
+
+        orig_start = cache_mod._KindInformer.start
+        entered = threading.Event()
+        gate = threading.Event()
+
+        def slow_start(self):
+            entered.set()
+            assert gate.wait(10)
+            orig_start(self)
+
+        monkeypatch.setattr(cache_mod._KindInformer, "start", slow_start)
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(client.cache.informer("Node")),
+                daemon=True,
+            )
+            for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        assert entered.wait(5)
+        gate.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not any(t.is_alive() for t in threads)
+        assert len(results) == 3
+        assert all(r is results[0] and r is not None for r in results)
+
+
+class TestWatchRoutes:
+    def test_routes_hold_queue_strongly(self, store, client):
+        """The route table must keep the queue alive: keyed by id() alone,
+        an abandoned queue's id could be reused by a later raw-store queue
+        whose stop_watch would pop the stale route and never reach
+        store.stop_watch, leaking an unbounded store watcher."""
+        q = client.watch("Node")
+        entry = client._watch_routes[id(q)]
+        assert entry[0] is q  # strong ref — id reuse impossible while routed
+        client.stop_watch(q)
+        assert id(q) not in client._watch_routes
+
+    def test_stale_route_alias_still_stops_store_watch(self, store, client):
+        """Even if a stale route entry aliased a raw-store queue's id, the
+        identity check routes stop_watch to the store, not the informer."""
+        inf = client.cache.informer("Node")
+        raw_q = client.watch("Lease")  # uncached kind -> raw store watch
+        watchers_before = len(store._watchers)
+        # Simulate the aliased leftover: same id key, DIFFERENT queue obj.
+        client._watch_routes[id(raw_q)] = (object(), inf)
+        client.stop_watch(raw_q)
+        assert len(store._watchers) == watchers_before - 1  # store watch gone
+        del client._watch_routes[id(raw_q)]  # drop the simulated debris
+
     def test_dirty_check_helper(self, store, client):
         obj = make_child(client, "c1")
         same = obj.deepcopy()

@@ -778,6 +778,34 @@ class TestQuarantineAllocation:
         chaos.heal_node("worker-0")
         assert fabric.breaker("worker-0").state == STATE_CLOSED
 
+    def test_mapper_cleanup_failure_still_gcs_and_clear_retries(self):
+        """The node-DELETED mapper runs ONCE and the dispatch loop drops
+        mapper exceptions: a wire fault during its quarantine clear must
+        neither swallow the GC requeue keys nor strand the marker — the
+        reconcile path (retried under backoff) re-runs the clear."""
+        from tpu_composer.runtime.store import StoreError, WatchEvent
+
+        store, pool, chaos, fabric, req_rec, res_rec = make_world(budget=1)
+        make_cr(store, pool)
+        res_rec.reconcile("r0")
+        chaos.fail_node("worker-0")
+        res_rec.reconcile("r0")  # budget=1 -> quarantine
+        assert node_quarantined(store, "worker-0")
+
+        node = store.get(Node, "worker-0")
+        store.delete(Node, "worker-0")
+        orig_clear = res_rec.publisher.clear_node_quarantine
+        res_rec.publisher.clear_node_quarantine = lambda n: (_ for _ in ()).throw(
+            StoreError("apiserver unavailable")
+        )
+        keys = res_rec._map_node_event(WatchEvent(type="DELETED", obj=node))
+        assert "r0" in keys  # GC requeues survive the failed cleanup
+        assert node_quarantined(store, "worker-0")  # stranded... for now
+        # The requeued reconcile GCs the resource AND retries the clear.
+        res_rec.publisher.clear_node_quarantine = orig_clear
+        res_rec.reconcile("r0")
+        assert not node_quarantined(store, "worker-0")
+
     def test_clear_quarantine_restores_node(self):
         store, pool, chaos, fabric, req_rec, res_rec = make_world(nodes=1)
         pub = DevicePublisher(store)
@@ -888,6 +916,34 @@ class TestSyncerOutage:
         pool = InMemoryPool()
         chaos = ChaosFabricProvider(pool)
         return store, pool, chaos
+
+    def test_stale_quarantine_marker_swept_when_node_gone(self):
+        """Backstop for the node-DELETED mapper's one-shot cleanup: a
+        quarantine marker whose node left the fleet — with NO dependent
+        CRs left to retry the clear through — is cleared by the periodic
+        sweep; live nodes keep their markers, per-device taints survive."""
+        store, pool, chaos = self.make()
+        pub = DevicePublisher(store)
+        pub.quarantine_node("worker-0", "flaky fabric")  # node exists
+        pub.quarantine_node("departed", "flaky fabric")  # node never/not in fleet
+        pub.create_taints("worker-1", ["tpu-dev-1"], "bad chip")
+        syncer = UpstreamSyncer(store, chaos, grace=100.0)
+        syncer.sync_once(now=0.0)
+        assert node_quarantined(store, "worker-0")  # live node: kept
+        assert not node_quarantined(store, "departed")  # swept
+        assert pub.tainted("tpu-dev-1")  # device taint untouched
+
+    def test_sweep_runs_even_during_fabric_outage(self):
+        """The sweep needs only the store: it must run BEFORE the fabric
+        call so a dead fabric endpoint (get_resources raising every tick)
+        can't suspend the stale-marker backstop for the whole outage."""
+        store, pool, chaos = self.make()
+        DevicePublisher(store).quarantine_node("departed", "stranded")
+        chaos.blackout()
+        syncer = UpstreamSyncer(store, chaos, grace=100.0)
+        with pytest.raises(TransientFabricError):
+            syncer.sync_once(now=0.0)
+        assert not node_quarantined(store, "departed")  # swept anyway
 
     def test_outage_skips_sweep_without_wiping_state(self):
         store, pool, chaos = self.make()

@@ -44,6 +44,27 @@ def node_quarantine_name(node: str) -> str:
     return "quarantine-node-" + node.replace("/", "-").lower()
 
 
+def is_node_quarantine_marker(rule) -> bool:
+    """THE whole-node quarantine marker shape test (node_name set,
+    device_uuid empty): the allocator gate, the syncer's stale-marker
+    sweep and quarantined_nodes() all consume this one predicate so the
+    encoding can't drift between them."""
+    return bool(rule.spec.node_name) and not rule.spec.device_uuid
+
+
+def retire_node(fabric, publisher, node: str) -> None:
+    """Host-left-the-fleet retirement: forget its circuit breaker (no-op
+    for providers without per-node breakers) and delete its durable
+    quarantine marker, so a recreated same-name node starts allocatable.
+    Shared by the resource controller's node-DELETED mapper, its
+    _gc_node_gone retry and the syncer's stale-marker sweep — one ritual,
+    no drift (same reason is_node_quarantine_marker exists)."""
+    forget = getattr(fabric, "forget_node", None)
+    if callable(forget):
+        forget(node)
+    publisher.clear_node_quarantine(node)
+
+
 def node_quarantined(store, node: str) -> bool:
     """Point check for ONE node's quarantine marker. Allocation-path code
     deliberately does NOT use this — it calls quarantined_nodes() once per
@@ -53,14 +74,14 @@ def node_quarantined(store, node: str) -> bool:
 
 
 def quarantined_nodes(store) -> set:
-    """Every host under a whole-node quarantine marker, in one list call.
-    This is THE definition of the marker shape (node_name set, device_uuid
-    empty) — the request allocator and the resource controller's
-    quarantine gate both consume this so the encoding can't drift."""
+    """Every host under a whole-node quarantine marker, in one list call
+    (shape test: is_node_quarantine_marker) — the request allocator and
+    the resource controller's quarantine gate both consume this so the
+    encoding can't drift."""
     return {
         r.spec.node_name
         for r in store.list(DeviceTaintRule)
-        if r.spec.node_name and not r.spec.device_uuid
+        if is_node_quarantine_marker(r)
     }
 
 

@@ -37,7 +37,7 @@ from typing import List, Optional
 
 from tpu_composer.agent.cdi import generate_cdi_spec
 from tpu_composer.agent.nodeagent import AgentError, DeviceBusyError, NodeAgent
-from tpu_composer.agent.publisher import quarantined_nodes
+from tpu_composer.agent.publisher import quarantined_nodes, retire_node
 from tpu_composer.api.types import (
     ComposabilityRequest,
     ComposableResource,
@@ -72,6 +72,7 @@ from tpu_composer.runtime.store import (
     ConflictError,
     NotFoundError,
     Store,
+    StoreError,
     WatchEvent,
     delete_tolerant,
 )
@@ -148,15 +149,37 @@ class ComposableResourceReconciler(Controller):
         # recreated same-name node is presumptively repaired hardware — it
         # must start allocatable, not inherit the dead node's quarantine
         # forever.
-        forget = getattr(self.fabric, "forget_node", None)
-        if callable(forget):
-            forget(node)
-        self.publisher.clear_node_quarantine(node)
-        return [
-            r.metadata.name
-            for r in self.store.list(ComposableResource)
-            if r.spec.target_node == node
-        ]
+        # Guarded: this mapper runs ONCE per DELETED event and the dispatch
+        # loop logs-and-drops mapper exceptions — a transient store/wire
+        # fault in the cleanup must not also drop the GC requeue keys
+        # below. A failed clear retries: _gc_node_gone re-runs it on every
+        # dependent resource's reconcile (queue backoff), and the syncer's
+        # stale-quarantine sweep is the level-triggered backstop when no
+        # dependents remain — either way the marker cannot permanently
+        # exclude a recreated same-name node.
+        try:
+            retire_node(self.fabric, self.publisher, node)
+        except Exception:
+            self.log.exception(
+                "node %s breaker/quarantine cleanup failed; the reconcile"
+                " path and the syncer sweep retry the clear", node
+            )
+        try:
+            return [
+                r.metadata.name
+                for r in self.store.list(ComposableResource)
+                if r.spec.target_node == node
+            ]
+        except StoreError as e:
+            # Same wire fault, worse spot: without the list there is
+            # nothing to requeue. Dependent resources self-heal on their
+            # own poll requeues / next watch events; losing the fast-path
+            # kick must not also kill the dispatch thread's event.
+            self.log.error(
+                "node %s: listing dependents for GC failed (%s); relying"
+                " on per-resource poll requeues", node, e,
+            )
+            return []
 
     # ------------------------------------------------------------------
     def reconcile(self, name: str) -> Result:
@@ -209,6 +232,11 @@ class ComposableResourceReconciler(Controller):
             # remove needs no live host), else the orphan is never reclaimed
             # and the syncer recreates the CR every grace period.
             return False
+        # Idempotent retry of the node-DELETED mapper's one-shot cleanup: if
+        # that retirement failed (wire fault), this reconcile — retried
+        # under backoff — re-runs it so a recreated same-name node starts
+        # allocatable.
+        retire_node(self.fabric, self.publisher, res.spec.target_node)
         self.agent.delete_device_taint(res.spec.target_node, res.status.device_ids)
         self.publisher.delete_taints(res.status.device_ids)
         self.publisher.retract_group(

@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from tpu_composer.api.dra import DeviceTaintRule
 from tpu_composer.api.meta import ObjectMeta
 from tpu_composer.api.types import (
     ComposableResource,
@@ -30,7 +31,11 @@ from tpu_composer.api.types import (
 )
 from tpu_composer.fabric.provider import FabricError, FabricProvider
 from tpu_composer.runtime.events import WARNING, EventRecorder
-from tpu_composer.runtime.store import AlreadyExistsError, Store
+from tpu_composer.runtime.store import (
+    AlreadyExistsError,
+    Store,
+    StoreError,
+)
 
 import logging
 
@@ -58,12 +63,20 @@ class UpstreamSyncer:
         while not stop_event.wait(self.period):
             try:
                 self.sync_once()
-            except FabricError as e:
+            except (FabricError, StoreError) as e:
+                # StoreError too: the manager runs this in a bare thread —
+                # one transient apiserver 5xx mid-pass must not kill
+                # orphan reclamation AND the quarantine backstop until
+                # process restart. Next tick retries.
                 self.log.warning("sync failed: %s", e)
 
     def sync_once(self, now: Optional[float] = None) -> int:
         """One diff pass; returns the number of detach-CRs created."""
         now = time.monotonic() if now is None else now
+        # Store-only; runs BEFORE the fabric call so a fabric outage
+        # (get_resources raising every tick) cannot also suspend the
+        # stale-marker backstop for its whole duration.
+        self._sweep_stale_quarantines()
         upstream = self.fabric.get_resources()
 
         local_ids = {
@@ -91,6 +104,51 @@ class UpstreamSyncer:
             if dev_id not in upstream_ids:
                 del self._missing[dev_id]
         return created
+
+    def _sweep_stale_quarantines(self) -> int:
+        """Clear whole-node quarantine markers whose node left the fleet.
+
+        Level-triggered backstop for the resource controller's node-DELETED
+        mapper: that cleanup runs ONCE per deletion event, and a wire fault
+        there — or a node deleted after reallocation already removed its
+        dependent CRs, leaving no reconcile to retry through — would
+        otherwise strand the marker and exclude a recreated same-name node
+        from allocation forever. Per-rule faults are logged and skipped so
+        one bad delete doesn't abort the sync pass; the next tick retries.
+        """
+        from tpu_composer.agent.publisher import (
+            DevicePublisher,
+            is_node_quarantine_marker,
+            retire_node,
+        )
+
+        cleared = 0
+        try:
+            rules = self.store.list(DeviceTaintRule)
+        except StoreError as e:
+            self.log.warning("quarantine sweep skipped: %s", e)
+            return 0
+        for rule in rules:
+            if not is_node_quarantine_marker(rule):
+                continue  # per-device taint, not a whole-node marker
+            node = rule.spec.node_name
+            try:
+                if self.store.try_get(Node, node) is not None:
+                    continue
+                # clear_node_quarantine swallows NotFound: a concurrent
+                # clear means done either way.
+                retire_node(self.fabric, DevicePublisher(self.store), node)
+            except StoreError as e:
+                self.log.warning(
+                    "stale quarantine marker %s (node %s gone) not cleared:"
+                    " %s — retrying next tick", rule.metadata.name, node, e,
+                )
+                continue
+            self.log.warning(
+                "cleared stale quarantine marker for departed node %s", node
+            )
+            cleared += 1
+        return cleared
 
     def _create_detach_cr(self, dev) -> bool:
         name = f"detach-{dev.device_id}".lower().replace("/", "-")

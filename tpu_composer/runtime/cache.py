@@ -27,8 +27,11 @@ O(writes), not O(reads+writes), per reconcile:
 - status-write coalescing — :func:`status_write_needed` skips
   ``update_status`` when the caller read current state (rv matches) and the
   status dict is byte-identical: a pure rv bump the watch would broadcast
-  to every controller for nothing. Shared with ``KubeStore.update_status``
-  so the wire path coalesces identically.
+  to every controller for nothing. The CachedClient drains the informer to
+  a barrier before skipping, so a lagging cache (newer event still queued)
+  falls through to the store and surfaces the same ConflictError cache-off
+  mode would. Shared with ``KubeStore.update_status`` so the wire path
+  coalesces identically.
 
 Escape hatch: ``--cached-reads``/``TPUC_CACHED_READS=0`` (cmd/main) runs
 every read on the store directly — semantics must be identical, and
@@ -40,7 +43,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-from typing import Dict, List, Optional, Set, Type, TypeVar
+from typing import Dict, List, Optional, Set, Tuple, Type, TypeVar
 
 from tpu_composer.api.meta import ApiObject
 from tpu_composer.api.types import LABEL_MANAGED_BY
@@ -313,27 +316,76 @@ class _KindInformer:
 
 class InformerCache:
     """Per-kind informers over one in-proc Store, started lazily on first
-    read of each kind (the same lazy-reflector shape KubeStore uses)."""
+    read of each kind (the same lazy-reflector shape KubeStore uses).
+
+    Lock discipline: ``_lock`` is NEVER held across ``_KindInformer.start()``.
+    start() calls ``store.watch()``/``store.list()``, which take
+    ``Store._lock`` — and admission hooks (registered on the CachedClient
+    in cmd/main) run INSIDE ``Store.create``/``update`` holding
+    ``Store._lock`` and read back through this cache, taking ``_lock``.
+    Holding ``_lock`` across start() therefore acquires the two locks in
+    opposite orders on the two paths and a create racing any kind's lazy
+    first read deadlocks every store operation (ABBA). Instead a lazy
+    start runs a per-kind publish-after-start protocol: mark the kind as
+    starting (under ``_lock``), release, run start(), then re-acquire to
+    publish; concurrent callers either wait on the kind's start event
+    (``wait=True``) or fall back to the raw store for this one read
+    (``wait=False`` — required on any path that may already hold
+    ``Store._lock``, where waiting on a starter that needs that same lock
+    would re-create the deadlock as a wait cycle)."""
 
     def __init__(self, store: Store, index_keys=DEFAULT_INDEX_KEYS) -> None:
         self._store = store
         self._index_keys = tuple(index_keys)
         self._lock = threading.Lock()
         self._informers: Dict[str, _KindInformer] = {}
+        # kind -> Event set when that kind's in-flight start() resolves
+        # (published or failed).
+        self._starting: Dict[str, threading.Event] = {}
         self._closed = False
 
-    def informer(self, kind: str) -> Optional[_KindInformer]:
-        with self._lock:
-            if self._closed:
+    def informer(self, kind: str, wait: bool = True) -> Optional[_KindInformer]:
+        """Running informer for ``kind``, starting one if needed.
+
+        ``wait=False`` never blocks: if another thread is mid-start for
+        this kind, returns None and the caller serves this read from the
+        raw store (identical semantics, one extra RTT, no wait cycle).
+        """
+        while True:
+            with self._lock:
+                if self._closed:
+                    return None
+                inf = self._informers.get(kind)
+                if inf is not None:
+                    return inf
+                ev = self._starting.get(kind)
+                if ev is None:
+                    ev = threading.Event()
+                    self._starting[kind] = ev
+                    break  # this thread starts it — with _lock RELEASED
+            if not wait:
                 return None
-            inf = self._informers.get(kind)
-            if inf is None:
-                inf = _KindInformer(self._store, kind, self._index_keys)
-                # start() before registering: a failed start (unregistered
-                # kind, store error mid-list) must not leave a dead
-                # informer published for later reads/watches to trust.
-                inf.start()
-                self._informers[kind] = inf
+            ev.wait()
+            # Starter published, failed, or lost to close — re-check.
+
+        inf = _KindInformer(self._store, kind, self._index_keys)
+        published = False
+        try:
+            # Publish only after a successful start: a failed start
+            # (unregistered kind, store error mid-list) must not leave a
+            # dead informer for later reads/watches to trust.
+            inf.start()
+            with self._lock:
+                if not self._closed:
+                    self._informers[kind] = inf
+                    published = True
+        finally:
+            with self._lock:
+                self._starting.pop(kind, None)
+            ev.set()
+        if not published:  # lost the race with stop()
+            inf.stop()
+            return None
         return inf
 
     def peek(self, kind: str) -> Optional[_KindInformer]:
@@ -369,9 +421,15 @@ class CachedClient:
         self.cache = InformerCache(store, index_keys)
         self._uncached = frozenset(uncached_kinds)
         self._lock = threading.Lock()
-        # queue id -> informer, for informer-routed watches (stop_watch
-        # must know where to unsubscribe).
-        self._watch_routes: Dict[int, _KindInformer] = {}
+        # queue id -> (queue, informer), for informer-routed watches
+        # (stop_watch must know where to unsubscribe). The entry holds the
+        # queue itself: keying by id() alone would let an abandoned
+        # queue's id be reused by a later (raw-store) queue, whose
+        # stop_watch would then pop the stale route and never reach
+        # store.stop_watch — a strong reference makes aliasing impossible.
+        self._watch_routes: Dict[
+            int, Tuple["queue.Queue[WatchEvent]", _KindInformer]
+        ] = {}
 
     # -- delegated plumbing -------------------------------------------
     @property
@@ -401,13 +459,17 @@ class CachedClient:
                 q: "queue.Queue[WatchEvent]" = queue.Queue()
                 inf.subscribe(q)
                 with self._lock:
-                    self._watch_routes[id(q)] = inf
+                    self._watch_routes[id(q)] = (q, inf)
                 return q
         return self.store.watch(kind)
 
     def stop_watch(self, q) -> None:
+        inf = None
         with self._lock:
-            inf = self._watch_routes.pop(id(q), None)
+            entry = self._watch_routes.get(id(q))
+            if entry is not None and entry[0] is q:
+                del self._watch_routes[id(q)]
+                inf = entry[1]
         if inf is not None:
             inf.unsubscribe(q)
         else:
@@ -424,9 +486,15 @@ class CachedClient:
 
     # -- cached reads --------------------------------------------------
     def _informer(self, kind: str) -> Optional[_KindInformer]:
+        """wait=False: reads may run inside admission hooks that already
+        hold ``Store._lock`` (cmd/main registers the validating webhook on
+        this client) — blocking there on another thread's informer start,
+        whose initial list needs ``Store._lock``, would deadlock. A read
+        racing an in-flight start is served from the raw store instead
+        (None), which is semantically identical."""
         if kind in self._uncached:
             return None
-        return self.cache.informer(kind)
+        return self.cache.informer(kind, wait=False)
 
     def get(self, cls: Type[T], name: str) -> T:
         inf = self._informer(cls.KIND)
@@ -480,8 +548,26 @@ class CachedClient:
             # unchanged status on poll requeues; each skipped write saves a
             # wire RTT AND the MODIFIED broadcast that would wake every
             # watcher for nothing.
-            status_writes_coalesced_total.inc(kind=obj.KIND)
-            return obj.deepcopy()
+            #
+            # But the cached head can LAG the store (the newer object's
+            # event still queued): the raw store would answer this write
+            # with ConflictError — forcing the re-read/requeue the
+            # controllers rely on — so coalescing here would turn a
+            # conflict into a reported success on a stale object. Drain
+            # the informer to a barrier (in-proc queue sync, zero store
+            # RTTs) and re-check against the drained head; any write that
+            # completed before this call has its event applied by then, so
+            # the stale case falls through to the store and conflicts
+            # exactly like cache-off. (A write racing this call — landing
+            # after the barrier — can still coalesce at the old head; raw
+            # semantics could serialize our no-op first with the same
+            # outcome minus the rv bump, so level triggering converges
+            # identically and the racer sees one conflict fewer.)
+            if inf.barrier() and not status_write_needed(
+                inf.get(obj.metadata.name), obj
+            ):
+                status_writes_coalesced_total.inc(kind=obj.KIND)
+                return obj.deepcopy()
         out = self.store.update_status(obj)
         self._fold(out)
         return out

@@ -599,6 +599,13 @@ class KubeStore:
         # Status-write coalescing (shared dirty-check with the standalone
         # CachedClient): a status identical to the cached head at the same
         # resourceVersion would be a pure rv-bump PUT — skip the wire op.
+        # Known window: a reflector lagging the apiserver can coalesce a
+        # write the apiserver would 409 (stale rv, identical status) —
+        # reported success on an object a concurrent writer superseded.
+        # There is no cheap wire barrier to close it; level triggering
+        # still converges via the pending MODIFIED event, and the skipped
+        # write was a no-op at the head the caller read. The standalone
+        # CachedClient CAN close it (in-proc queue barrier) and does.
         if route.cacheable and self._cache_reads:
             from tpu_composer.runtime.cache import status_write_needed
 
