@@ -28,9 +28,11 @@ test-fast:
 bench:
 	$(PYTHON) bench.py
 
-## perf-smoke: fast CI gate — cache-on vs cache-off store round trips per
-## attach through the cluster path; asserts RTT-count (not wall-time)
-## reduction so read-path caching regressions fail deterministically
+## perf-smoke: fast CI gate, two count-based (never wall-time) assertions —
+## cache-on vs cache-off store round trips per attach through the cluster
+## path (read path), and a batched vs unbatched 8-child same-node fabric
+## wave that must issue strictly fewer attach/detach provider calls
+## (write path / FabricDispatcher group-verb coalescing)
 perf-smoke:
 	$(PYTHON) -c "import bench; bench.perf_smoke()"
 

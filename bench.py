@@ -23,15 +23,86 @@ flagship model's train-step throughput on the real accelerator).
 
 from __future__ import annotations
 
+import collections
 import json
 import statistics
 import time
 
 REFERENCE_P50_MS = 30_000.0  # one reference requeue quantum (BASELINE.md)
 
+# FabricDispatcher knobs scaled to bench timing (prod defaults are 20 ms /
+# 250 ms — these runs set every poll quantum to ~10 ms, so the window and
+# completion poll shrink with them). The attach waves here place one child
+# per node, so the window buys no coalescing and is kept near zero; the
+# same-node wave in bench_fabric_wave sets its own generous window.
+BENCH_BATCH_WINDOW_S = 0.002
+BENCH_FABRIC_POLL_S = 0.01
+
+
+def _counting_pool(**kwargs):
+    """InMemoryPool that counts PROVIDER calls per verb — the ground truth
+    behind ``fabric_calls_per_attach``, independent of which layer
+    (dispatcher group verb, split retry, or direct reconcile call) issued
+    the RPC. One group call counts once: that is the amortization being
+    measured."""
+    from tpu_composer.fabric.inmem import InMemoryPool
+
+    class CountingPool(InMemoryPool):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.fabric_calls = collections.Counter()
+
+        def add_resource(self, r):
+            self.fabric_calls["add"] += 1
+            return super().add_resource(r)
+
+        def add_resources(self, rs):
+            self.fabric_calls["add_batch"] += 1
+            return super().add_resources(rs)
+
+        def remove_resource(self, r):
+            self.fabric_calls["remove"] += 1
+            return super().remove_resource(r)
+
+        def remove_resources(self, rs):
+            self.fabric_calls["remove_batch"] += 1
+            return super().remove_resources(rs)
+
+        def check_resource(self, r):
+            self.fabric_calls["check"] += 1
+            return super().check_resource(r)
+
+        def get_resources(self):
+            self.fabric_calls["get"] += 1
+            return super().get_resources()
+
+        def reserve_slice(self, *a, **kw):
+            self.fabric_calls["reserve"] += 1
+            return super().reserve_slice(*a, **kw)
+
+        def release_slice(self, *a, **kw):
+            self.fabric_calls["release"] += 1
+            return super().release_slice(*a, **kw)
+
+    return CountingPool(**kwargs)
+
+
+def _bench_dispatcher(pool, enabled: bool):
+    """Dispatcher wired the way cmd/main wires it, at bench time scale;
+    None when the TPUC_FABRIC_BATCH=0 path is being measured."""
+    if not enabled:
+        return None
+    from tpu_composer.fabric.dispatcher import FabricDispatcher
+
+    return FabricDispatcher(
+        pool, batch_window=BENCH_BATCH_WINDOW_S,
+        poll_interval=BENCH_FABRIC_POLL_S, concurrency=8,
+    )
+
 
 def bench_attach_to_ready(cycles: int = 40, size: int = 8,
-                          store_latency_s: float = 0.0, cached: bool = True):
+                          store_latency_s: float = 0.0, cached: bool = True,
+                          fabric_batch: bool = True):
     """Full request lifecycle through the live threaded operator.
 
     ``store_latency_s`` > 0 injects an apiserver-like round trip into every
@@ -42,9 +113,13 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8,
     ``cached`` hands the controllers the watch-fed CachedClient (the
     cmd/main default) instead of the raw store; either way the returned
     dict carries ``rtts_per_attach`` — store round trips per attach cycle,
-    counted by tpuc_store_requests_total. The bench's own readiness polls
-    go through a separate read-only cached observer so harness reads never
-    pollute the control loop's RTT count (or pay the injected latency)."""
+    counted by tpuc_store_requests_total — and ``fabric_calls_per_attach``
+    — provider calls per cycle, counted at the pool itself.
+    ``fabric_batch=False`` is the TPUC_FABRIC_BATCH=0 control: direct
+    blocking fabric calls inside reconcile workers. The bench's own
+    readiness polls go through a separate read-only cached observer so
+    harness reads never pollute the control loop's RTT count (or pay the
+    injected latency)."""
     from tpu_composer.api import (
         ComposabilityRequest,
         ComposabilityRequestSpec,
@@ -60,7 +135,6 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8,
         RequestTiming,
         ResourceTiming,
     )
-    from tpu_composer.fabric.inmem import InMemoryPool
     from tpu_composer.runtime.cache import CachedClient, maybe_cached
     from tpu_composer.runtime.manager import Manager
     from tpu_composer.runtime.metrics import store_requests_total
@@ -73,13 +147,14 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8,
         store.create(n)
     client = maybe_cached(store, cached)
     observer = CachedClient(store)  # harness-only reads; never counted
-    pool = InMemoryPool()
+    pool = _counting_pool()
     agent = FakeNodeAgent(pool=pool)
+    dispatcher = _bench_dispatcher(pool, fabric_batch)
     mgr = Manager(store=client)
     mgr.add_controller(ComposabilityRequestReconciler(
         client, pool, timing=RequestTiming(updating_poll=0.01, cleaning_poll=0.01)))
     mgr.add_controller(ComposableResourceReconciler(
-        client, pool, agent,
+        client, pool, agent, dispatcher=dispatcher,
         timing=ResourceTiming(attach_poll=0.01, visibility_poll=0.01,
                               detach_poll=0.01, detach_fast=0.01, busy_poll=0.01)))
     mgr.start(workers_per_controller=2)
@@ -115,6 +190,8 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8,
     finally:
         rtts = store_requests_total.total() - rtts_before
         mgr.stop()
+        if dispatcher is not None:
+            dispatcher.stop()
         observer.stop_informers()
 
     latencies_ms.sort()
@@ -124,6 +201,10 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8,
         "max": latencies_ms[-1],
         "cycles": len(latencies_ms),
         "rtts_per_attach": round(rtts / max(1, len(latencies_ms)), 2),
+        "fabric_calls_per_attach": round(
+            sum(pool.fabric_calls.values()) / max(1, len(latencies_ms)), 2
+        ),
+        "fabric_calls": dict(pool.fabric_calls),
     }
 
 
@@ -220,7 +301,8 @@ APISERVER_RTT_S = 0.010  # injected per-request latency: typical in-cluster apis
 
 
 def bench_attach_cluster(cycles: int = 20, size: int = 8,
-                         rtt_s: float = APISERVER_RTT_S, cached: bool = True):
+                         rtt_s: float = APISERVER_RTT_S, cached: bool = True,
+                         fabric_batch: bool = True):
     """Attach-to-Ready through the REAL cluster path: the manager speaking
     KubeStore to the wire-semantics fake apiserver, every HTTP request
     charged an apiserver RTT. This is the honest latency model (VERDICT r1
@@ -232,7 +314,9 @@ def bench_attach_cluster(cycles: int = 20, size: int = 8,
     TPUC_CACHED_READS=0 escape hatch): every controller get/list becomes a
     wire op. The returned ``rtts_per_attach`` (tpuc_store_requests_total
     delta / cycles) is what the cache-on/off comparison in CI asserts on —
-    round-trip COUNTS, not wall time, so the check is deterministic."""
+    round-trip COUNTS, not wall time, so the check is deterministic.
+    ``fabric_batch`` mirrors TPUC_FABRIC_BATCH the same way; the returned
+    ``fabric_calls_per_attach`` counts provider calls at the pool."""
     import os
     import sys
 
@@ -247,7 +331,6 @@ def bench_attach_cluster(cycles: int = 20, size: int = 8,
         RequestTiming,
         ResourceTiming,
     )
-    from tpu_composer.fabric.inmem import InMemoryPool
     from tpu_composer import GROUP, VERSION
     from tpu_composer.runtime.kubestore import CHIP_RESOURCE, KubeConfig, KubeStore
     from tpu_composer.runtime.manager import Manager
@@ -262,12 +345,13 @@ def bench_attach_cluster(cycles: int = 20, size: int = 8,
         )
     store = KubeStore(config=KubeConfig(host=srv.url), watch_reconnect_s=0.05,
                       cache_reads=cached)
-    pool = InMemoryPool()
+    pool = _counting_pool()
+    dispatcher = _bench_dispatcher(pool, fabric_batch)
     mgr = Manager(store=store)
     mgr.add_controller(ComposabilityRequestReconciler(
         store, pool, timing=RequestTiming(updating_poll=0.01, cleaning_poll=0.01)))
     mgr.add_controller(ComposableResourceReconciler(
-        store, pool, FakeNodeAgent(pool=pool),
+        store, pool, FakeNodeAgent(pool=pool), dispatcher=dispatcher,
         timing=ResourceTiming(attach_poll=0.01, visibility_poll=0.01,
                               detach_poll=0.01, detach_fast=0.01,
                               busy_poll=0.01)))
@@ -313,6 +397,8 @@ def bench_attach_cluster(cycles: int = 20, size: int = 8,
     finally:
         rtts = store_requests_total.total() - rtts_before
         mgr.stop()
+        if dispatcher is not None:
+            dispatcher.stop()
         store.close()
         srv.stop()
 
@@ -323,6 +409,10 @@ def bench_attach_cluster(cycles: int = 20, size: int = 8,
         "max": latencies_ms[-1],
         "cycles": len(latencies_ms),
         "rtts_per_attach": round(rtts / max(1, len(latencies_ms)), 2),
+        "fabric_calls_per_attach": round(
+            sum(pool.fabric_calls.values()) / max(1, len(latencies_ms)), 2
+        ),
+        "fabric_calls": dict(pool.fabric_calls),
     }
 
 
@@ -387,25 +477,126 @@ def summarize_accelerator(accel: dict) -> dict:
     return out
 
 
+def bench_fabric_wave(children: int = 8, fabric_batch: bool = True):
+    """Deterministic per-node batching measurement: ``children`` loose
+    single-device CRs targeting ONE node attach (and detach) as a wave
+    through the live resource controller. No injected latency anywhere —
+    the returned numbers are provider-call COUNTS, so the perf-smoke
+    assertion on them cannot flake on wall time. With batching on, the
+    whole wave coalesces into group calls; off, every child pays its own
+    provider RPC."""
+    from tpu_composer.api import (
+        ComposableResource,
+        ComposableResourceSpec,
+        Node,
+        ObjectMeta,
+    )
+    from tpu_composer.agent.fake import FakeNodeAgent
+    from tpu_composer.controllers import (
+        ComposableResourceReconciler,
+        ResourceTiming,
+    )
+    from tpu_composer.runtime.manager import Manager
+    from tpu_composer.runtime.store import Store
+
+    store = Store()
+    n = Node(metadata=ObjectMeta(name="wave-node"))
+    n.status.tpu_slots = children
+    store.create(n)
+    pool = _counting_pool(chips={"gpu-a100": children})
+    agent = FakeNodeAgent(pool=pool)
+    dispatcher = None
+    if fabric_batch:
+        from tpu_composer.fabric.dispatcher import FabricDispatcher
+
+        # A generous window: the whole in-proc submission wave lands well
+        # inside it, making the coalescing deterministic.
+        dispatcher = FabricDispatcher(pool, batch_window=0.1,
+                                      poll_interval=0.01, concurrency=8)
+    mgr = Manager(store=store)
+    mgr.add_controller(ComposableResourceReconciler(
+        store, pool, agent, dispatcher=dispatcher,
+        timing=ResourceTiming(attach_poll=0.01, visibility_poll=0.01,
+                              detach_poll=0.01, detach_fast=0.01,
+                              busy_poll=0.01)))
+    mgr.start(workers_per_controller=8)
+    names = [f"wave-{i}" for i in range(children)]
+    try:
+        for name in names:
+            store.create(ComposableResource(
+                metadata=ObjectMeta(name=name),
+                spec=ComposableResourceSpec(type="gpu", model="gpu-a100",
+                                            target_node="wave-node"),
+            ))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(
+                (r := store.try_get(ComposableResource, n2)) is not None
+                and r.status.state == "Online"
+                for n2 in names
+            ):
+                break
+            time.sleep(0.002)
+        else:
+            raise RuntimeError("fabric wave never fully attached")
+        for name in names:
+            store.delete(ComposableResource, name)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(store.try_get(ComposableResource, n2) is None for n2 in names):
+                break
+            time.sleep(0.002)
+        else:
+            raise RuntimeError("fabric wave never fully detached")
+    finally:
+        mgr.stop()
+        if dispatcher is not None:
+            dispatcher.stop()
+    calls = pool.fabric_calls
+    return {
+        "children": children,
+        "provider_mutations": (
+            calls["add"] + calls["add_batch"]
+            + calls["remove"] + calls["remove_batch"]
+        ),
+        "fabric_calls": dict(calls),
+    }
+
+
 def perf_smoke(cycles: int = 3):
-    """CI gate for the read-path cache: cache-on vs cache-off through the
-    full cluster path, asserting on store ROUND-TRIP COUNTS (rtt_s=0, so
-    wall-time noise on shared runners can't flake it). A regression that
-    sends reconcile reads back to the wire at least doubles the count and
-    fails deterministically. Run via ``make perf-smoke``."""
+    """CI gate, two deterministic COUNT assertions (never wall time):
+
+    1. read-path cache — cache-on vs cache-off through the full cluster
+       path must show at least a 2x store round-trip reduction (rtt_s=0);
+    2. fabric write path — an 8-child same-node wave with batching on must
+       issue STRICTLY fewer attach/detach provider calls than with
+       batching off (the per-node group-verb coalescing, in-proc so the
+       count is exact).
+
+    Run via ``make perf-smoke``."""
     on = bench_attach_cluster(cycles=cycles, rtt_s=0.0, cached=True)
     off = bench_attach_cluster(cycles=cycles, rtt_s=0.0, cached=False)
+    wave_on = bench_fabric_wave(children=8, fabric_batch=True)
+    wave_off = bench_fabric_wave(children=8, fabric_batch=False)
     out = {
         "metric": "perf_smoke_store_rtts_per_attach",
         "cache_on": on["rtts_per_attach"],
         "cache_off": off["rtts_per_attach"],
         "reduction": round(off["rtts_per_attach"] / max(on["rtts_per_attach"], 0.01), 1),
+        "fabric_wave_mutations_batched": wave_on["provider_mutations"],
+        "fabric_wave_mutations_unbatched": wave_off["provider_mutations"],
     }
     print(json.dumps(out))
     assert on["rtts_per_attach"] * 2 <= off["rtts_per_attach"], (
         f"read-path cache regression: cache-on paid {on['rtts_per_attach']}"
         f" store RTTs/attach vs {off['rtts_per_attach']} with the cache off"
         " (expected at least a 2x reduction)"
+    )
+    assert wave_on["provider_mutations"] < wave_off["provider_mutations"], (
+        "fabric batching regression: an 8-child same-node wave issued"
+        f" {wave_on['provider_mutations']} attach/detach provider calls with"
+        f" batching on vs {wave_off['provider_mutations']} with it off"
+        " (expected strictly fewer: the wave should coalesce into group calls)"
     )
     return out
 
@@ -429,17 +620,28 @@ def main():
     # hosts (the reference pays its 30 s requeue per STATE, regardless).
     attach_32 = bench_attach_cluster(cycles=10, size=32,
                                      rtt_s=APISERVER_RTT_S)
+    # Fabric-pipeline control: the same 32-chip wave with the dispatcher
+    # off (TPUC_FABRIC_BATCH=0) — the fabric_calls_per_attach gap is the
+    # dispatcher's amortization (shared listings + dedup), isolated.
+    attach_32_off = bench_attach_cluster(cycles=5, size=32,
+                                         rtt_s=APISERVER_RTT_S,
+                                         fabric_batch=False)
     accel = bench_accelerator()
     extra = {
         "attach_p90_ms": round(attach_inj["p90"], 3),
         "attach_max_ms": round(attach_inj["max"], 3),
         "cycles": attach_inj["cycles"],
         "store_rtts_per_attach": attach_inj["rtts_per_attach"],
+        "fabric_calls_per_attach": attach_inj["fabric_calls_per_attach"],
         "cache_off_p50_ms": round(attach_off["p50"], 3),
         "cache_off_store_rtts_per_attach": attach_off["rtts_per_attach"],
         "attach_32chip_p50_ms": round(attach_32["p50"], 3),
         "attach_32chip_p90_ms": round(attach_32["p90"], 3),
         "attach_32chip_store_rtts": attach_32["rtts_per_attach"],
+        "attach_32chip_fabric_calls": attach_32["fabric_calls_per_attach"],
+        "attach_32chip_fabric_calls_unbatched":
+            attach_32_off["fabric_calls_per_attach"],
+        "attach_32chip_unbatched_p50_ms": round(attach_32_off["p50"], 3),
         "injected_store_latency_ms": APISERVER_RTT_S * 1e3,
         "raw_inproc_p50_ms": round(attach_raw["p50"], 3),
         "raw_inproc_p90_ms": round(attach_raw["p90"], 3),

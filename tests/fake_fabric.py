@@ -35,6 +35,7 @@ from tpu_composer.api.types import (
 from tpu_composer.fabric.inmem import InMemoryPool
 from tpu_composer.fabric.provider import (
     FabricError,
+    TransientFabricError,
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
 )
@@ -244,6 +245,8 @@ class _FabricHandler(BaseHTTPRequestHandler):
                     return self._send(404, {"error": f"no slice {name}"})
                 pool.release_slice(name)
                 return self._send(204)
+        if parts == ["attachments:batch"] and method == "POST":
+            return self._attachment_batch(wait)
         if parts == ["attachments"] and method == "GET":
             items = [
                 {
@@ -269,6 +272,49 @@ class _FabricHandler(BaseHTTPRequestHandler):
         if parts[:1] == ["layout-apply"] and len(parts) == 2 and method == "GET":
             return self._layout_status(parts[1])
         self._send(404, {"error": f"no pool route for {method} /{'/'.join(parts)}"})
+
+    def _attachment_batch(self, wait: bool) -> None:
+        """Group attach/detach (rest.py add_resources/remove_resources):
+        one request carries a whole per-node wave, the response reports
+        PER-MEMBER outcomes so one bad device degrades one member."""
+        pool = self.fabric.pool
+        body = self._body()
+        op = body.get("op", "")
+        if op not in ("add", "remove"):
+            return self._send(400, {"error": f"bad batch op {op!r}"})
+        results: List[dict] = []
+        for item in body.get("items", []):
+            name = item.get("name", "")
+            try:
+                if op == "add":
+                    resource = _resource_from_body(name, item)
+                    result = _maybe_wait(
+                        lambda: pool.add_resource(resource),
+                        wait, WaitingDeviceAttaching,
+                    )
+                    results.append({
+                        "name": name,
+                        "device_ids": result.device_ids,
+                        "cdi_device_id": result.cdi_device_id,
+                    })
+                else:
+                    resource = _dummy_resource(
+                        name, device_ids=list(item.get("device_ids", []))
+                    )
+                    _maybe_wait(
+                        lambda: pool.remove_resource(resource),
+                        wait, WaitingDeviceDetaching,
+                    )
+                    results.append({"name": name, "removed": True})
+            except WaitingDeviceAttaching:
+                results.append({"name": name, "state": "attaching"})
+            except WaitingDeviceDetaching:
+                results.append({"name": name, "state": "detaching"})
+            except TransientFabricError as e:
+                results.append({"name": name, "error": str(e), "transient": True})
+            except FabricError as e:
+                results.append({"name": name, "error": str(e), "transient": False})
+        self._send(200, {"results": results})
 
     def _attachment_crud(self, method: str, name: str, wait: bool) -> None:
         pool = self.fabric.pool

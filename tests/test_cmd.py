@@ -68,6 +68,44 @@ class TestBuildManager:
         finally:
             mgr.stop()
 
+    def test_fabric_batch_default_and_escape_hatch(self, monkeypatch, tmp_path):
+        """Default wiring routes the resource controller through a
+        FabricDispatcher with the flag-configured knobs; TPUC_FABRIC_BATCH=0
+        (or --no-fabric-batch) restores direct fabric calls."""
+        monkeypatch.setenv("CDI_PROVIDER_TYPE", "MOCK")
+        monkeypatch.delenv("NODE_AGENT", raising=False)
+        from tpu_composer.controllers import ComposableResourceReconciler
+        from tpu_composer.fabric.adapter import reset_shared_mock
+
+        reset_shared_mock()
+        args = build_parser().parse_args([
+            "--state-dir", str(tmp_path / "s1"),
+            "--fabric-batch-window", "0.007",
+            "--fabric-concurrency", "3",
+        ])
+        assert args.fabric_batch is True
+        mgr = build_manager(args)
+        try:
+            rec = next(c for c in mgr._controllers
+                       if isinstance(c, ComposableResourceReconciler))
+            assert rec.dispatcher is not None
+            assert rec.dispatcher.batch_window == 0.007
+            assert rec.dispatcher.concurrency == 3
+        finally:
+            mgr.stop()
+
+        monkeypatch.setenv("TPUC_FABRIC_BATCH", "0")
+        reset_shared_mock()
+        args = build_parser().parse_args(["--state-dir", str(tmp_path / "s2")])
+        assert args.fabric_batch is False
+        mgr = build_manager(args)
+        try:
+            rec = next(c for c in mgr._controllers
+                       if isinstance(c, ComposableResourceReconciler))
+            assert rec.dispatcher is None
+        finally:
+            mgr.stop()
+
     def test_webhooks_enabled_by_default(self, monkeypatch, tmp_path):
         monkeypatch.setenv("CDI_PROVIDER_TYPE", "MOCK")
         monkeypatch.delenv("ENABLE_WEBHOOKS", raising=False)

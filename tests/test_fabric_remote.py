@@ -16,6 +16,7 @@ from tpu_composer.fabric.inmem import InMemoryPool
 from tpu_composer.fabric.layout import LayoutApplyClient
 from tpu_composer.fabric.provider import (
     FabricError,
+    TransientFabricError,
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
 )
@@ -85,6 +86,54 @@ class TestRestClient:
             assert result.device_ids  # no sentinel surfaced
         finally:
             server.close()
+
+    def test_group_verbs_one_wire_call_per_member_outcomes(self, server):
+        """add_resources/remove_resources: one POST carries the whole wave
+        and a bad member degrades only itself (dispatcher group-verb
+        contract)."""
+        client = RestPoolClient(server.url, token_cache=None)
+        server.pool.inject_add_failure("b1", times=1)
+        rs = [make_resource(name=f"b{i}", count=1) for i in range(3)]
+        before = len(server.request_log)
+        outcomes = client.add_resources(rs)
+        assert len(server.request_log) == before + 1  # ONE wire call
+        assert server.request_log[-1].endswith("/v1/attachments:batch")
+        assert outcomes[0].device_ids and outcomes[2].device_ids
+        assert isinstance(outcomes[1], FabricError)
+        assert not isinstance(outcomes[1], WaitingDeviceAttaching)
+        # detach wave: same shape, None = removed
+        for r, out in zip(rs, outcomes):
+            if not isinstance(out, Exception):
+                r.status.device_ids = out.device_ids
+        removed = client.remove_resources([rs[0], rs[2]])
+        assert removed == [None, None]
+        assert server.pool.attached_to("worker-0") == []
+
+    def test_group_verbs_async_members_surface_wait_outcomes(self):
+        server = FakeFabricServer(pool=InMemoryPool(async_steps=2))
+        try:
+            client = RestPoolClient(server.url, token_cache=None)
+            outcomes = client.add_resources([make_resource(name="a0", count=1)])
+            assert isinstance(outcomes[0], WaitingDeviceAttaching)
+            client.add_resources([make_resource(name="a0", count=1)])  # poll
+            final = client.add_resources([make_resource(name="a0", count=1)])
+            assert final[0].device_ids  # per-member progress on each poll
+        finally:
+            server.close()
+
+    def test_missing_batch_route_is_unsupported_batch(self, server):
+        from tpu_composer.fabric.provider import UnsupportedBatch
+
+        client = RestPoolClient(server.url, token_cache=None)
+        server.fail_next("POST", "/v1/attachments:batch", code=404)
+        with pytest.raises(UnsupportedBatch):
+            client.add_resources([make_resource(name="x0", count=1)])
+
+    def test_batch_5xx_fails_whole_call_as_transient(self, server):
+        client = RestPoolClient(server.url, token_cache=None)
+        server.fail_next("POST", "/v1/attachments:batch", code=503)
+        with pytest.raises(TransientFabricError):
+            client.add_resources([make_resource(name="x0", count=1)])
 
     def test_pool_exhausted_is_terminal_error(self, server):
         client = RestPoolClient(server.url, token_cache=None)

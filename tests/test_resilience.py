@@ -1028,11 +1028,15 @@ class TestQueueJitter:
         rng = random.Random(7)
         q = RateLimitingQueue(base_delay=0.1, max_delay=5.0, jitter=rng)
         delays = []
-        orig = q.add_after
-        q.add_after = lambda key, delay: delays.append(delay)  # type: ignore
+        orig = q._push_delayed
+        # capture the scheduling seam (backoff entries no longer route
+        # through add_after — they carry a generation tag for forget())
+        q._push_delayed = (  # type: ignore
+            lambda key, delay, gen: delays.append(delay)
+        )
         for _ in range(40):
             q.add_rate_limited("k")
-        q.add_after = orig  # type: ignore
+        q._push_delayed = orig  # type: ignore
         assert all(0.1 <= d <= 5.0 for d in delays)
         assert max(delays) > 0.5  # it actually grows
         assert len(set(round(d, 6) for d in delays)) > 20  # not deterministic
@@ -1041,14 +1045,14 @@ class TestQueueJitter:
         q = RateLimitingQueue(base_delay=0.1, max_delay=5.0,
                               jitter=random.Random(11))
         a, b = [], []
-        orig = q.add_after
-        q.add_after = (  # type: ignore
-            lambda key, delay: (a if key == "a" else b).append(delay)
+        orig = q._push_delayed
+        q._push_delayed = (  # type: ignore
+            lambda key, delay, gen: (a if key == "a" else b).append(delay)
         )
         for _ in range(6):
             q.add_rate_limited("a")
             q.add_rate_limited("b")
-        q.add_after = orig  # type: ignore
+        q._push_delayed = orig  # type: ignore
         assert a != b  # lockstep herd broken
 
     def test_forget_resets_jitter_state(self):

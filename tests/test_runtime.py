@@ -56,6 +56,38 @@ class TestQueue:
         q.forget("a")
         assert q.retries("a") == 0
 
+    def test_forget_invalidates_pending_backoff_entries(self):
+        """A key that succeeded (forget) must not be re-woken by a stale
+        pre-success failure requeue still sitting in the delay heap."""
+        q = RateLimitingQueue(base_delay=0.08, max_delay=1.0)
+        q.add_rate_limited("a")  # backoff entry pending
+        q.forget("a")  # success before the entry fires
+        assert q.get(timeout=0.3) is None  # stale entry evaporated
+
+    def test_forget_then_new_failure_requeues_normally(self):
+        q = RateLimitingQueue(base_delay=0.03, max_delay=0.1)
+        q.add_rate_limited("a")
+        q.forget("a")
+        q.add_rate_limited("a")  # NEW failure after the forget
+        assert q.get(timeout=1.0) == "a"  # only the fresh entry fires
+        q.done("a")
+        assert q.get(timeout=0.2) is None
+
+    def test_forget_never_touches_plain_add_after(self):
+        """add_after entries are liveness (periodic polls), not backoff —
+        a successful reconcile's forget must leave them armed."""
+        q = RateLimitingQueue()
+        q.add_after("a", 0.1)
+        q.forget("a")  # the worker loop forgets on every success
+        assert q.get(timeout=1.0) == "a"
+
+    def test_deep_queue_drains_fifo(self):
+        q = RateLimitingQueue()
+        for i in range(500):
+            q.add(i)
+        drained = [q.get(timeout=0.1) for _ in range(500)]
+        assert drained == list(range(500))
+
     def test_shutdown_unblocks(self):
         q = RateLimitingQueue()
         out = []

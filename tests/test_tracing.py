@@ -122,6 +122,21 @@ class TestWiring:
             e["args"]["provider"] == "InMemoryPool" for e in tracing.snapshot()
         )
 
+    def test_fabric_wrapper_caches_traced_verbs(self):
+        """Verb wrappers are built once per instance — repeat access is a
+        plain __dict__ hit (no closure rebuild on the attach hot path) and
+        still records spans; non-verb instrumentation stays a live read."""
+        pool = TracedFabricProvider(InMemoryPool())
+        first = pool.get_resources
+        assert pool.get_resources is first  # cached, not rebuilt
+        assert "get_resources" in pool.__dict__
+        first()
+        first()
+        names = [e["name"] for e in tracing.snapshot()]
+        assert names.count("fabric.get_resources") == 2
+        assert "free_chips" not in pool.__dict__  # passthrough not cached
+        assert pool.free_chips("tpu-v4") == pool._inner.free_chips("tpu-v4")
+
     def test_reconcile_spans_nest_fabric_calls_and_serve_over_http(self):
         store = Store()
         n = Node(metadata=ObjectMeta(name="worker-0"))

@@ -18,6 +18,9 @@ SURVEY.md §5 "Config / flag system"):
   TPUC_STATE_DIR      object-store persistence directory
   TPUC_CACHED_READS   "0" disables the watch-fed informer read cache
                       (--no-cached-reads equivalent; default on)
+  TPUC_FABRIC_BATCH   "0" disables the FabricDispatcher (--no-fabric-batch
+                      equivalent): attach/detach run as today's direct
+                      blocking calls inside reconcile workers
 
 Run: ``python -m tpu_composer [flags]`` or ``python -m tpu_composer.cmd.main``.
 """
@@ -134,6 +137,33 @@ def build_parser() -> argparse.ArgumentParser:
              " parity). --no-cached-reads or TPUC_CACHED_READS=0 reads the"
              " store directly on every call (escape hatch; semantics are"
              " identical, latency is not)",
+    )
+    p.add_argument(
+        "--fabric-batch",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_FABRIC_BATCH", "1") != "0",
+        help="route attach/detach through the FabricDispatcher: same-node"
+             " submissions coalesce into one group provider call, fabric"
+             " waits are polled off-worker with one shared per-node pass,"
+             " and completions re-enqueue the CR immediately."
+             " --no-fabric-batch or TPUC_FABRIC_BATCH=0 restores direct"
+             " blocking fabric calls inside reconcile workers",
+    )
+    p.add_argument(
+        "--fabric-batch-window",
+        type=float,
+        default=_env_seconds("TPUC_FABRIC_BATCH_WINDOW", 0.02),
+        help="seconds a fabric submission waits for same-node companions"
+             " before dispatch (the batching/latency trade; env"
+             " TPUC_FABRIC_BATCH_WINDOW)",
+    )
+    p.add_argument(
+        "--fabric-concurrency",
+        type=int,
+        default=int(os.environ.get("TPUC_FABRIC_CONCURRENCY", "8")),
+        help="dispatcher worker threads — concurrent fabric calls across"
+             " nodes (per-node calls are always serialized FIFO; env"
+             " TPUC_FABRIC_CONCURRENCY)",
     )
     p.add_argument(
         "--workers",
@@ -336,8 +366,22 @@ def build_manager(args: argparse.Namespace) -> Manager:
     mgr.add_controller(ComposabilityRequestReconciler(client, fabric,
                                                       recorder=mgr.recorder,
                                                       scheduler=scheduler))
+    dispatcher = None
+    if getattr(args, "fabric_batch", True):
+        from tpu_composer.fabric.dispatcher import FabricDispatcher
+
+        # The dispatcher sits ABOVE the traced/breaker stack: every
+        # provider call it issues (group or split) is traced and
+        # breaker-guarded like a direct call would be.
+        dispatcher = FabricDispatcher(
+            fabric,
+            batch_window=args.fabric_batch_window,
+            concurrency=args.fabric_concurrency,
+        )
+        mgr.add_runnable(dispatcher.run)
     res_rec = ComposableResourceReconciler(client, fabric, agent,
-                                           recorder=mgr.recorder)
+                                           recorder=mgr.recorder,
+                                           dispatcher=dispatcher)
     mgr.add_controller(res_rec)
     if args.defrag_interval > 0:
         mgr.add_runnable(DefragLoop(client, scheduler.defrag,

@@ -18,7 +18,12 @@ TPU-first deltas:
 - the group's chips are one fabric call, not per-device loops;
 - polling quanta are sub-second and configurable (ResourceTiming) instead of
   the fixed 30s/3s requeues (:236,:298,:400) — the single biggest
-  attach-to-Ready latency lever identified in BASELINE.md.
+  attach-to-Ready latency lever identified in BASELINE.md;
+- with a FabricDispatcher wired (cmd/main's ``--fabric-batch`` default),
+  attach/detach SUBMIT and return instead of blocking the worker: same-node
+  submissions coalesce into group provider calls, and completion re-enqueues
+  this CR immediately — the poll quanta above become a safety net rather
+  than the requeue clock (docs/ARCHITECTURE.md "Fabric write path").
 
 Reads vs writes: ``self.store`` is normally a
 :class:`~tpu_composer.runtime.cache.CachedClient` (cmd/main's
@@ -53,6 +58,7 @@ from tpu_composer.api.types import (
 )
 from tpu_composer.fabric.breaker import BreakerOpenError
 from tpu_composer.fabric.provider import (
+    DispatchedAttaching,
     FabricError,
     FabricProvider,
     TransientFabricError,
@@ -108,10 +114,18 @@ class ComposableResourceReconciler(Controller):
         timing: Optional[ResourceTiming] = None,
         recorder: Optional[EventRecorder] = None,
         publisher=None,  # DevicePublisher; default built on the store
+        dispatcher=None,  # fabric.dispatcher.FabricDispatcher; None = direct
     ) -> None:
         super().__init__(store)
         self.fabric = fabric
         self.agent = agent
+        # Fabric I/O pipeline: with a dispatcher, attach/detach SUBMIT and
+        # return — the worker thread never blocks on the fabric, same-node
+        # submissions coalesce into group calls, and completion re-enqueues
+        # this CR's key immediately (the poll timers below stay as the
+        # safety net). Without one (TPUC_FABRIC_BATCH=0, and every
+        # pre-dispatcher test), fabric verbs run inline as before.
+        self.dispatcher = dispatcher
         self.timing = timing or ResourceTiming()
         self.recorder = recorder or EventRecorder()
         if publisher is None:
@@ -123,8 +137,19 @@ class ComposableResourceReconciler(Controller):
         # :894-975). The controller acts as the DRA driver's control side.
         self.publisher = publisher
         # Serializes host-local chip-index assignment across worker threads
-        # (two groups landing on one node must get disjoint /dev/accel sets).
+        # (two groups landing on one node must get disjoint /dev/accel
+        # sets). The lock guards only the in-memory ASSIGNMENT; the status
+        # write that persists it happens outside, with _index_claims
+        # covering the gap — holding a 10 ms apiserver write under this
+        # lock serialized the whole attach wave's durability points.
         self._index_lock = threading.Lock()
+        # node -> resource name -> indices assigned but not yet persisted.
+        # Consulted by _assign_chip_indices so a concurrently-attaching
+        # co-located group can never compute an overlapping set while the
+        # claimant's status write is in flight. Entries live only for the
+        # duration of that write (dropped on success AND failure — a failed
+        # write's retry recomputes from fresh store state).
+        self._index_claims: dict = {}
         # In-memory attach-failure streaks (resource name -> count), seeded
         # from status.attach_attempts on first observation. Authoritative
         # during a streak: persisting every increment would make each failed
@@ -186,6 +211,13 @@ class ComposableResourceReconciler(Controller):
         res = self.store.try_get(ComposableResource, name)
         if res is None:
             self._attach_streaks.pop(name, None)
+            if self.dispatcher is not None:
+                # Drop queued submissions and parked outcomes for a purged
+                # CR. An op already at the fabric is left to complete: the
+                # verbs are idempotent and the syncer's anti-drift sweep
+                # reclaims any attachment that materializes without a CR.
+                self.dispatcher.cancel("add", name)
+                self.dispatcher.cancel("remove", name)
             return Result()
         try:
             result = self._reconcile_inner(res)
@@ -277,9 +309,19 @@ class ComposableResourceReconciler(Controller):
     def _handle_attaching(self, res: ComposableResource) -> Result:
         if res.being_deleted:
             # Nothing durable attached yet vs attached-but-not-online —
-            # same split as :214-218.
+            # same split as :214-218. With a dispatcher, an attach already
+            # issued to the fabric cannot be cancelled: route through
+            # Detaching anyway — per-node FIFO queues the (idempotent)
+            # detach BEHIND the materializing attach, so whichever chips
+            # land are released rather than leaked.
+            uncancellable_add = (
+                self.dispatcher is not None
+                and not self.dispatcher.cancel("add", res.metadata.name)
+            )
             res.status.state = (
-                RESOURCE_STATE_DETACHING if res.status.device_ids else RESOURCE_STATE_DELETING
+                RESOURCE_STATE_DETACHING
+                if res.status.device_ids or uncancellable_add
+                else RESOURCE_STATE_DELETING
             )
             self.store.update_status(res)
             return Result(requeue_after=self.timing.detach_fast)
@@ -293,8 +335,17 @@ class ComposableResourceReconciler(Controller):
         self.agent.ensure_driver(res.spec.target_node)
 
         try:
-            attach = self.fabric.add_resource(res)
+            attach = self._fabric_add(res)
             fabric_requests_total.inc(op="add", outcome="ok")
+        except DispatchedAttaching:
+            # Synthetic dispatcher acknowledgment: the submission is queued
+            # or executing but the FABRIC has not answered for this node —
+            # the failure streak must survive (only the real wait sentinel
+            # below is evidence of fabric-side progress). Completion fires
+            # the latch and re-enqueues this key immediately; attach_poll
+            # is the safety-net fallback.
+            fabric_requests_total.inc(op="add", outcome="dispatched")
+            return Result(requeue_after=self.timing.attach_poll)
         except WaitingDeviceAttaching:
             fabric_requests_total.inc(op="add", outcome="waiting")
             # The fabric answered for THIS node — break the failure streak
@@ -324,15 +375,33 @@ class ComposableResourceReconciler(Controller):
         if res.status.attach_attempts:
             res.status.attach_attempts = 0  # streak broken by success
             changed = True
-        # Chip indices are assigned under the same lock that persists them:
-        # one status write is both the fabric-attachment durability point
-        # AND the index claim, and a concurrently-attaching co-located group
-        # cannot observe the gap between assignment and persistence.
+        # Chip indices: assignment is serialized under _index_lock, but the
+        # persisting status write runs OUTSIDE it — the in-memory claim
+        # keeps co-located assigners disjoint during the write, so an
+        # 8-host wave's durability points land in parallel instead of
+        # queueing behind one lock (safe in-process: exactly one controller
+        # instance is active under leader election).
         if is_tpu_model(res.spec.model):
+            claimed = False
             with self._index_lock:
-                changed = self._assign_chip_indices(res) or changed
+                assigned = self._assign_chip_indices(res)
+                if assigned:
+                    self._index_claims.setdefault(
+                        res.spec.target_node, {}
+                    )[res.metadata.name] = list(res.status.chip_indices)
+                    claimed = True
+                changed = assigned or changed
+            try:
                 if changed:
                     res = self.store.update_status(res)
+            finally:
+                if claimed:
+                    with self._index_lock:
+                        node_claims = self._index_claims.get(res.spec.target_node)
+                        if node_claims is not None:
+                            node_claims.pop(res.metadata.name, None)
+                            if not node_claims:
+                                self._index_claims.pop(res.spec.target_node, None)
         elif changed:
             res = self.store.update_status(res)
 
@@ -473,11 +542,10 @@ class ComposableResourceReconciler(Controller):
 
     def _assign_chip_indices(self, res: ComposableResource) -> bool:
         """Assign host-local /dev/accel indices disjoint from every other
-        group on the same node. Caller MUST hold _index_lock across this
-        call AND the status write that persists it — otherwise a
-        concurrently-attaching co-located group could compute the same
-        indices from the not-yet-written store state. Returns whether
-        anything changed.
+        group on the same node. Caller MUST hold _index_lock; the set of
+        taken indices is the union of persisted store state and the
+        in-flight _index_claims of writes still on the wire. Returns
+        whether anything changed.
 
         Without this, co-located groups would all publish accel0..N-1 and
         hand containers the same physical chips (and deadlock each other's
@@ -493,6 +561,11 @@ class ComposableResourceReconciler(Controller):
             and other.spec.target_node == res.spec.target_node
             for i in other.status.chip_indices
         }
+        for claimant, indices in self._index_claims.get(
+            res.spec.target_node, {}
+        ).items():
+            if claimant != res.metadata.name:
+                used.update(indices)
         indices: List[int] = []
         candidate = 0
         while len(indices) < need:
@@ -531,9 +604,43 @@ class ComposableResourceReconciler(Controller):
         )
         return slice_env(standalone, res.spec.worker_id, res.spec.model)
 
+    def _fabric_add(self, res: ComposableResource):
+        """Attach via the dispatcher (submit-and-return + completion latch)
+        or inline when batching is disabled."""
+        if self.dispatcher is None:
+            return self.fabric.add_resource(res)
+        name = res.metadata.name
+        if res.status.device_ids and self.dispatcher.op_state("add", name) is None:
+            # Visibility-poll re-entry: the durable attach result already
+            # sits in status and nothing is in flight — serving it skips a
+            # fresh batch window + idempotent provider re-read per poll
+            # cycle. A fabric-side loss of the attachment in this window
+            # surfaces the same way the direct path's between-re-adds gap
+            # does: via Online health polling / the anti-drift syncer.
+            from tpu_composer.fabric.provider import AttachResult
+
+            return AttachResult(
+                list(res.status.device_ids), res.status.cdi_device_id
+            )
+        return self.dispatcher.add_resource(
+            res, on_ready=lambda: self.queue.add(name)
+        )
+
+    def _fabric_remove(self, res: ComposableResource) -> None:
+        if self.dispatcher is None:
+            return self.fabric.remove_resource(res)
+        name = res.metadata.name
+        return self.dispatcher.remove_resource(
+            res, on_ready=lambda: self.queue.add(name)
+        )
+
     def fabric_attached(self, node: str):
+        # Dispatcher-served listings are single-flighted and snapshot-cached
+        # (staleness bounded by its batch window) — an attach wave's
+        # per-node gauge refreshes share one provider call.
+        provider = self.dispatcher if self.dispatcher is not None else self.fabric
         try:
-            return [d for d in self.fabric.get_resources() if d.node == node]
+            return [d for d in provider.get_resources() if d.node == node]
         except FabricError:
             return []
 
@@ -566,8 +673,17 @@ class ComposableResourceReconciler(Controller):
         # A gone node has no device stack to drain — skip the host-side steps
         # and run only the fabric detach (the syncer's orphan-reclaim case).
         node_exists = self.store.try_get(Node, node) is not None
+        # Dispatcher fast path: once a remove is submitted (or its outcome
+        # is parked awaiting consumption), the host-side prep below already
+        # ran in the submitting pass — re-entries driven by the completion
+        # latch / detach_poll must not re-pay the load check, taint writes
+        # and drain every cycle.
+        remove_submitted = (
+            self.dispatcher is not None
+            and self.dispatcher.op_state("remove", res.metadata.name) is not None
+        )
         # 1. Load check unless force (:340-353).
-        if not res.spec.force_detach and node_exists:
+        if not res.spec.force_detach and node_exists and not remove_submitted:
             if not self.agent.check_no_loads(node, res.status.device_ids, group=self._cdi_name(res)):
                 msg = f"chips in use on {node}; waiting for workloads to finish"
                 if res.status.error != msg:
@@ -576,7 +692,7 @@ class ComposableResourceReconciler(Controller):
                     self.recorder.event(res, WARNING, "DeviceBusy", msg)
                 return Result(requeue_after=self.timing.busy_poll)
 
-        if node_exists:
+        if node_exists and not remove_submitted:
             # 2. Quarantine scheduling (:355-363 via DeviceTaintRule): both
             # the node-local marker the agent's drain honors and the
             # cluster-level rule a scheduler sees.
@@ -590,9 +706,12 @@ class ComposableResourceReconciler(Controller):
             except DeviceBusyError:
                 return Result(requeue_after=self.timing.busy_poll)
 
-        # 4. Fabric detach with wait sentinel (:372-378).
+        # 4. Fabric detach with wait sentinel (:372-378). DispatchedDetaching
+        # (the dispatcher's submit-and-return acknowledgment) subclasses the
+        # wait sentinel: same requeue, but completion re-enqueues this key
+        # immediately so detach_poll is only the fallback.
         try:
-            self.fabric.remove_resource(res)
+            self._fabric_remove(res)
             fabric_requests_total.inc(op="remove", outcome="ok")
         except WaitingDeviceDetaching:
             fabric_requests_total.inc(op="remove", outcome="waiting")

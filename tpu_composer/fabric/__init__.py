@@ -23,11 +23,13 @@ from tpu_composer.fabric.breaker import (
     CircuitBreaker,
 )
 from tpu_composer.fabric.chaos import ChaosFabricProvider
+from tpu_composer.fabric.dispatcher import FabricDispatcher
 from tpu_composer.fabric.inmem import InMemoryPool
 from tpu_composer.fabric.adapter import new_fabric_provider
 
 __all__ = [
     "AttachResult",
+    "FabricDispatcher",
     "BreakerConfig",
     "BreakerFabricProvider",
     "BreakerOpenError",

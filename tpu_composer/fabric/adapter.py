@@ -29,6 +29,7 @@ class AdapterError(ValueError):
 
 _TRACED_METHODS = (
     "add_resource", "remove_resource", "check_resource", "get_resources",
+    "add_resources", "remove_resources",
     "reserve_slice", "release_slice", "resize_slice",
 )
 
@@ -38,7 +39,12 @@ class TracedFabricProvider:
     slow attach shows WHICH fabric call ate the time (the reference has no
     tracing at all — SURVEY.md §5). Wraps by delegation, so it composes
     with any provider including ones defining only the base-class
-    resize_slice default."""
+    resize_slice default.
+
+    Wrapped verbs are built once and cached in the instance __dict__:
+    ``__getattr__`` only fires on a miss, so after the first access each
+    fabric verb is a plain attribute read — the hot attach path no longer
+    pays a delegation lookup plus a closure allocation per call."""
 
     def __init__(self, inner: FabricProvider) -> None:
         self._inner = inner
@@ -55,6 +61,9 @@ class TracedFabricProvider:
                                   provider=provider):
                     return attr(*args, **kwargs)
 
+            # Only verb wrappers are cached — other attributes (test-pool
+            # counters, injection knobs) stay live reads on the inner.
+            self.__dict__[name] = traced
             return traced
         return attr
 

@@ -305,6 +305,22 @@ class BreakerFabricProvider(FabricProvider):
             resource.spec.target_node, self._inner.remove_resource, resource
         )
 
+    # Group verbs: one batch is one wire call against one node, guarded by
+    # that node's breaker + the endpoint breaker. Per-member outcomes
+    # travel INSIDE a successful response (never raised), so only a
+    # whole-call reachability fault counts as a breaker failure — the
+    # dispatcher's split retries then run through the single verbs with
+    # normal per-node accounting. UnsupportedBatch is a capability probe,
+    # not an outcome: it must not consume a half-open probe slot's verdict
+    # (_call already treats non-transient raises as endpoint-alive).
+    def add_resources(self, resources: List[ComposableResource]) -> List[object]:
+        node = resources[0].spec.target_node if resources else ""
+        return self._call(node, self._inner.add_resources, resources)
+
+    def remove_resources(self, resources: List[ComposableResource]) -> List[object]:
+        node = resources[0].spec.target_node if resources else ""
+        return self._call(node, self._inner.remove_resources, resources)
+
     def check_resource(self, resource: ComposableResource) -> DeviceHealth:
         return self._call(
             resource.spec.target_node, self._inner.check_resource, resource

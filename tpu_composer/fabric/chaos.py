@@ -146,6 +146,20 @@ class ChaosFabricProvider(FabricProvider):
         self._chaos("remove_resource", resource.spec.target_node)
         return self._inner.remove_resource(resource)
 
+    # Group verbs fail as a WHOLE call (one wire RPC = one reachability
+    # fault), which is exactly what drives the dispatcher's failure
+    # splitting: the member-by-member retries then hit the single-verb
+    # injection above, so per-resource accounting is what gets exercised.
+    def add_resources(self, resources: List[ComposableResource]) -> List[object]:
+        node = resources[0].spec.target_node if resources else ""
+        self._chaos("add_resources", node)
+        return self._inner.add_resources(resources)
+
+    def remove_resources(self, resources: List[ComposableResource]) -> List[object]:
+        node = resources[0].spec.target_node if resources else ""
+        self._chaos("remove_resources", node)
+        return self._inner.remove_resources(resources)
+
     def check_resource(self, resource: ComposableResource) -> DeviceHealth:
         self._chaos("check_resource", resource.spec.target_node)
         return self._inner.check_resource(resource)

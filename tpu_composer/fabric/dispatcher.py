@@ -1,0 +1,517 @@
+"""FabricDispatcher — the async fabric I/O pipeline between reconcile
+workers and a FabricProvider.
+
+Why this layer exists (ISSUE 4 / BASELINE.md's "30 s quantization" lever,
+carried to its conclusion): with store round trips off the read path, the
+attach wave is bound by the fabric side — every ComposableResource paid its
+own blocking ``add_resource`` inside a reconcile worker, and in-progress
+attaches were re-polled on a fixed ``attach_poll`` timer. Composable-fabric
+scaling work (arXiv:2404.06467) and RPC-amortization work (Dagger,
+arXiv:2106.01482) both show the same failure shape: per-device control-plane
+calls must be batched and pipelined or the fabric manager's per-call
+overhead dominates as group size grows. The dispatcher provides:
+
+- **per-node batching** — attach/detach submissions targeting the same node
+  within a coalescing window (``batch_window``) collapse into one provider
+  call through the optional ``add_resources``/``remove_resources`` group
+  verbs (InMemoryPool, REST CM); providers without them get a transparent
+  per-item fallback. Ordering is strict per-node FIFO: an attach can never
+  reorder past a detach for the same node, and an op for a resource that
+  still has an earlier in-flight op holds its lane until that op completes.
+  Concurrency *across* nodes is bounded by ``concurrency`` worker threads.
+- **failure splitting** — a group call that raises is retried
+  member-by-member through the single verbs, so one bad device cannot
+  poison its group and breaker / attach-budget / quarantine accounting
+  stays per-resource (PR 1 semantics unchanged).
+- **completion-driven requeue** — a submission immediately raises the
+  ``DispatchedAttaching``/``DispatchedDetaching`` sentinel (the reconciler
+  requeues on its normal poll timer as a safety net) and registers an
+  ``on_ready`` latch; the dispatcher fires it the moment the op completes
+  — or first reports fabric-side progress — so the CR's key re-enters its
+  controller queue immediately instead of burning a fixed ``attach_poll``
+  quantum. Fabric-async ops (wait sentinels from the provider) are
+  re-polled by the dispatcher itself with one shared per-node poll pass.
+- **shared snapshot reads** — concurrent/near-in-time ``get_resources``
+  calls are single-flighted and served from a snapshot no older than
+  ``snapshot_ttl`` (default: the batch window), amortizing the listing the
+  controllers refresh per-node gauges from. Consumers (composed-chips
+  gauge, the 60 s anti-drift syncer) tolerate far more staleness than the
+  window; callers needing a linearizable listing should hold the raw
+  provider.
+
+The dispatcher is NOT itself a FabricProvider: ``add_resource``/
+``remove_resource`` take an ``on_ready`` latch and raise dispatch sentinels,
+which only the resource controller understands. Pass-through verbs
+(``check_resource``, slice transactions) pass the raw provider through
+unchanged so existing callers keep their synchronous semantics.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from tpu_composer.api.types import ComposableResource
+from tpu_composer.fabric.provider import (
+    AttachResult,
+    DispatchedAttaching,
+    DispatchedDetaching,
+    FabricDevice,
+    FabricError,
+    FabricProvider,
+    UnsupportedBatch,
+    WaitingDeviceAttaching,
+    WaitingDeviceDetaching,
+)
+from tpu_composer.runtime.metrics import (
+    fabric_batch_size,
+    fabric_calls_total,
+    fabric_completion_latency,
+    fabric_inflight,
+    fabric_reads_coalesced_total,
+)
+
+VERB_ADD = "add"
+VERB_REMOVE = "remove"
+
+_GROUP_VERBS = {VERB_ADD: "add_resources", VERB_REMOVE: "remove_resources"}
+_SINGLE_VERBS = {VERB_ADD: "add_resource", VERB_REMOVE: "remove_resource"}
+_WAIT_SENTINELS = {VERB_ADD: WaitingDeviceAttaching, VERB_REMOVE: WaitingDeviceDetaching}
+_DISPATCH_SENTINELS = {VERB_ADD: DispatchedAttaching, VERB_REMOVE: DispatchedDetaching}
+
+# op states
+_QUEUED = "queued"  # in its lane's FIFO, not yet issued to the provider
+_INFLIGHT = "inflight"  # a worker is executing a provider call for it
+_PENDING = "pending"  # provider answered a wait sentinel; dispatcher re-polls
+_DONE = "done"  # outcome parked for the next reconcile to consume
+
+
+class _Op:
+    __slots__ = (
+        "verb", "resource", "node", "name", "on_ready", "state",
+        "result", "error", "submitted", "next_poll", "wait_msg",
+    )
+
+    def __init__(self, verb: str, resource: ComposableResource, now: float) -> None:
+        self.verb = verb
+        self.resource = resource
+        self.node = resource.spec.target_node
+        self.name = resource.metadata.name
+        self.on_ready: List[Callable[[], None]] = []
+        self.state = _QUEUED
+        self.result: Optional[AttachResult] = None
+        self.error: Optional[Exception] = None
+        self.submitted = now
+        self.next_poll = 0.0
+        self.wait_msg = ""
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.verb, self.name)
+
+
+class _Lane:
+    """Per-node submission lane: FIFO of queued ops + fabric-pending ops."""
+
+    __slots__ = ("fifo", "pending", "busy")
+
+    def __init__(self) -> None:
+        self.fifo: Deque[_Op] = collections.deque()
+        self.pending: Dict[str, _Op] = {}  # name -> op awaiting fabric completion
+        self.busy = False
+
+    def idle(self) -> bool:
+        return not self.fifo and not self.pending and not self.busy
+
+
+class FabricDispatcher:
+    def __init__(
+        self,
+        provider: FabricProvider,
+        batch_window: float = 0.02,
+        concurrency: int = 8,
+        poll_interval: float = 0.25,
+        max_batch: int = 16,
+        snapshot_ttl: float = 0.05,
+        done_ttl: float = 300.0,
+    ) -> None:
+        self.provider = provider
+        self.batch_window = max(0.0, batch_window)
+        self.concurrency = max(1, concurrency)
+        self.poll_interval = max(0.001, poll_interval)
+        self.max_batch = max(1, max_batch)
+        # Listing staleness bound. Independent of the batch window: an
+        # attach wave's per-node gauge refreshes arrive spread over the
+        # whole wave, not within one coalescing window, and the consumers
+        # (composed-chips gauge, 60 s anti-drift syncer) tolerate far more
+        # than 50 ms.
+        self.snapshot_ttl = snapshot_ttl
+        self.done_ttl = done_ttl
+        self.log = logging.getLogger("FabricDispatcher")
+        self._cond = threading.Condition()
+        self._lanes: Dict[str, _Lane] = {}
+        self._ops: Dict[Tuple[str, str], _Op] = {}  # live (queued/inflight/pending)
+        self._done: Dict[Tuple[str, str], Tuple[_Op, float]] = {}
+        self._threads: List[threading.Thread] = []
+        self._started = False
+        self._shutdown = False
+        # Capability probe result: None = unknown, False = provider raised
+        # UnsupportedBatch once (skip group attempts from then on).
+        self._group_verbs_ok: Optional[bool] = None
+        # get_resources single-flight + snapshot micro-cache.
+        self._snap: Optional[List[FabricDevice]] = None
+        self._snap_time = -1e9
+        self._snap_err: Optional[Exception] = None
+        self._snap_inflight = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        with self._cond:
+            if self._started or self._shutdown:
+                return
+            self._started = True
+            for i in range(self.concurrency):
+                t = threading.Thread(
+                    target=self._worker_loop, name=f"fabric-dispatch-{i}",
+                    daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+
+    def stop(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+        with self._cond:
+            # Abandoned ops are safe: every verb is idempotent and the
+            # controllers' poll-timer fallback re-submits after restart.
+            self._lanes.clear()
+            self._ops.clear()
+            self._done.clear()
+            fabric_inflight.set(0)
+
+    def run(self, stop_event: threading.Event) -> None:
+        """Manager runnable: start workers, park until shutdown."""
+        self.start()
+        stop_event.wait()
+        self.stop()
+
+    # ------------------------------------------------------------------
+    # submission facade (the resource controller's fabric write path)
+    # ------------------------------------------------------------------
+    def add_resource(
+        self, resource: ComposableResource,
+        on_ready: Optional[Callable[[], None]] = None,
+    ) -> AttachResult:
+        return self._call(VERB_ADD, resource, on_ready)
+
+    def remove_resource(
+        self, resource: ComposableResource,
+        on_ready: Optional[Callable[[], None]] = None,
+    ) -> None:
+        return self._call(VERB_REMOVE, resource, on_ready)
+
+    def _call(self, verb: str, resource: ComposableResource, on_ready):
+        name = resource.metadata.name
+        key = (verb, name)
+        with self._cond:
+            done = self._done.pop(key, None)
+            if done is not None:
+                op = done[0]
+                if op.error is not None:
+                    raise op.error
+                return op.result
+            op = self._ops.get(key)
+            if op is None:
+                if self._shutdown:
+                    raise _DISPATCH_SENTINELS[verb](
+                        f"{name}: dispatcher stopped; resubmit after restart"
+                    )
+                self.start()  # lazy start: facade usable without wiring order
+                op = _Op(verb, resource, time.monotonic())
+                # A parked outcome of the OPPOSITE verb is stale the moment
+                # the state machine moves on (attach result nobody consumed
+                # before deletion began, and vice versa).
+                self._done.pop((_other(verb), name), None)
+                self._ops[key] = op
+                lane = self._lanes.setdefault(op.node, _Lane())
+                lane.fifo.append(op)
+                self._cond.notify_all()
+            else:
+                # Refresh the resource snapshot (spec/status may have moved)
+                # only while still queued — an in-flight call must keep the
+                # exact object it was issued with.
+                if op.state == _QUEUED:
+                    op.resource = resource
+            if on_ready is not None:
+                op.on_ready = [on_ready]
+            if op.state == _PENDING:
+                # The FABRIC answered "in progress" — surface the real wait
+                # sentinel so streak/budget accounting sees fabric-side
+                # progress exactly as the direct-call path would.
+                raise _WAIT_SENTINELS[verb](op.wait_msg or f"{name}: {verb} in progress")
+        raise _DISPATCH_SENTINELS[verb](f"{name}: {verb} dispatched")
+
+    def cancel(self, verb: str, name: str) -> bool:
+        """Drop a submission that has not reached the provider yet.
+
+        Returns True when nothing took effect at the fabric for
+        (verb, name) — the op was still queued (now removed), failed, or
+        never existed. False means the provider call already started, the
+        fabric holds it pending, OR a completed attach result is parked:
+        in every False case the caller must run the op's normal completion
+        path (e.g. detach after an uncancellable attach — a parked
+        SUCCESSFUL AttachResult means the chips ARE attached, and
+        discarding it would leak them until the syncer's orphan sweep)."""
+        key = (verb, name)
+        with self._cond:
+            done = self._done.get(key)
+            if done is not None:
+                if verb == VERB_ADD and done[0].error is None:
+                    return False  # attach materialized — must detach
+                del self._done[key]
+                return True
+            op = self._ops.get(key)
+            if op is None:
+                return True
+            if op.state != _QUEUED:
+                return False
+            del self._ops[key]
+            lane = self._lanes.get(op.node)
+            if lane is not None:
+                try:
+                    lane.fifo.remove(op)
+                except ValueError:
+                    pass
+            return True
+
+    # ------------------------------------------------------------------
+    # shared snapshot reads
+    # ------------------------------------------------------------------
+    def get_resources(self) -> List[FabricDevice]:
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                if now - self._snap_time <= self.snapshot_ttl:
+                    if self._snap_err is not None:
+                        raise self._snap_err
+                    fabric_reads_coalesced_total.inc()
+                    return list(self._snap or [])
+                if not self._snap_inflight:
+                    self._snap_inflight = True
+                    break
+                self._cond.wait(timeout=1.0)
+        snap: Optional[List[FabricDevice]] = None
+        err: Optional[Exception] = None
+        try:
+            snap = self.provider.get_resources()
+        except Exception as e:  # parked for every coalesced waiter
+            err = e
+        fabric_calls_total.inc(verb="get_resources", batched="false")
+        with self._cond:
+            self._snap, self._snap_err = snap, err
+            self._snap_time = time.monotonic()
+            self._snap_inflight = False
+            self._cond.notify_all()
+        if err is not None:
+            raise err
+        return list(snap or [])
+
+    # pass-through verbs: synchronous callers keep the raw provider contract
+    def check_resource(self, resource: ComposableResource):
+        return self.provider.check_resource(resource)
+
+    def __getattr__(self, name: str):
+        return getattr(self.provider, name)
+
+    # ------------------------------------------------------------------
+    # worker side
+    # ------------------------------------------------------------------
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cond:
+                task = None
+                while task is None:
+                    if self._shutdown:
+                        return
+                    now = time.monotonic()
+                    self._sweep_done(now)
+                    task, wake = self._next_task(now)
+                    if task is None:
+                        self._cond.wait(timeout=wake)
+            lane, verb, ops = task
+            try:
+                self._execute(verb, ops)
+            finally:
+                callbacks = []
+                with self._cond:
+                    lane.busy = False
+                    for op in ops:
+                        callbacks.extend(op.on_ready)
+                        op.on_ready = []
+                    # Prune empty lanes so churning fleets don't grow the
+                    # lane map forever (O(1): a batch shares one node).
+                    node = ops[0].node
+                    if self._lanes.get(node) is lane and lane.idle():
+                        del self._lanes[node]
+                    self._cond.notify_all()
+                for cb in callbacks:
+                    try:
+                        cb()
+                    except Exception:
+                        self.log.exception("on_ready latch failed")
+
+    def _next_task(self, now: float):
+        """Pick one lane turn: a window-expired FIFO batch, or a due shared
+        poll of fabric-pending ops. Returns (task, wait_hint_seconds)."""
+        wake: Optional[float] = None
+        for lane in self._lanes.values():
+            if lane.busy:
+                continue
+            # Due fabric-side polls first: they represent the oldest work.
+            due = [op for op in lane.pending.values() if op.next_poll <= now]
+            if due:
+                verb = due[0].verb
+                ops = [op for op in due if op.verb == verb][: self.max_batch]
+                for op in ops:
+                    op.state = _INFLIGHT
+                    del lane.pending[op.name]
+                lane.busy = True
+                return (lane, verb, ops), None
+            if lane.fifo:
+                head = lane.fifo[0]
+                ready_at = head.submitted + self.batch_window
+                if ready_at <= now:
+                    ops = self._take_batch(lane)
+                    if ops:
+                        lane.busy = True
+                        return (lane, ops[0].verb, ops), None
+                    # head blocked behind an engaged sibling — re-check when
+                    # that op completes (cond is notified then).
+                else:
+                    wake = ready_at - now if wake is None else min(wake, ready_at - now)
+            for op in lane.pending.values():
+                hint = op.next_poll - now
+                wake = hint if wake is None else min(wake, hint)
+        return None, (max(0.001, wake) if wake is not None else None)
+
+    def _take_batch(self, lane: _Lane) -> List[_Op]:
+        """Longest same-verb FIFO prefix, capped at max_batch, stopping at
+        any op whose resource still has an earlier op engaged with the
+        fabric (per-resource serialization: a detach must never be issued
+        while its attach is still materializing, and vice versa)."""
+        ops: List[_Op] = []
+        verb = lane.fifo[0].verb
+        engaged = set(lane.pending)
+        while lane.fifo and len(ops) < self.max_batch:
+            op = lane.fifo[0]
+            if op.verb != verb or op.name in engaged:
+                break
+            lane.fifo.popleft()
+            op.state = _INFLIGHT
+            ops.append(op)
+        return ops
+
+    # -- execution (no dispatcher lock held) ----------------------------
+    def _execute(self, verb: str, ops: List[_Op]) -> None:
+        fabric_inflight.inc(len(ops))
+        try:
+            if len(ops) > 1 and self._group_verbs_ok is not False:
+                group = getattr(self.provider, _GROUP_VERBS[verb])
+                try:
+                    outcomes = group([op.resource for op in ops])
+                except UnsupportedBatch:
+                    self._group_verbs_ok = False
+                else:
+                    if self._group_verbs_ok is None:
+                        self._group_verbs_ok = True
+                    fabric_calls_total.inc(verb=verb, batched="true")
+                    fabric_batch_size.observe(len(ops), verb=verb)
+                    if isinstance(outcomes, list) and len(outcomes) == len(ops):
+                        for op, out in zip(ops, outcomes):
+                            self._settle(op, out)
+                        return
+                    # Malformed provider response: treat as whole-call
+                    # failure below (split retry), never drop outcomes.
+                    self.log.error(
+                        "%s returned %d outcomes for %d members; splitting",
+                        _GROUP_VERBS[verb], len(outcomes) if isinstance(outcomes, list) else -1,
+                        len(ops),
+                    )
+            self._execute_singles(verb, ops)
+        except Exception:
+            # Whole group call raised (transport fault, dead endpoint,
+            # chaos): failure splitting — retry member-by-member so one bad
+            # member can't poison the group and accounting stays
+            # per-resource.
+            fabric_calls_total.inc(verb=verb, batched="true")
+            fabric_batch_size.observe(len(ops), verb=verb)
+            self._execute_singles(verb, ops)
+        finally:
+            fabric_inflight.inc(-len(ops))
+
+    def _execute_singles(self, verb: str, ops: List[_Op]) -> None:
+        single = getattr(self.provider, _SINGLE_VERBS[verb])
+        for op in ops:
+            try:
+                out = single(op.resource)
+            except Exception as e:
+                out = e
+            fabric_calls_total.inc(verb=verb, batched="false")
+            self._settle(op, out)
+
+    def _settle(self, op: _Op, outcome) -> None:
+        """Record one member's outcome: result, fabric wait, or error."""
+        now = time.monotonic()
+        with self._cond:
+            lane = self._lanes.setdefault(op.node, _Lane())
+            if isinstance(outcome, _WAIT_SENTINELS[op.verb]):
+                op.state = _PENDING
+                op.wait_msg = str(outcome)
+                op.next_poll = now + self.poll_interval
+                lane.pending[op.name] = op
+                # Fall through to fire on_ready (collected by the worker):
+                # the reconciler gets one immediate pass that observes the
+                # REAL wait sentinel, resetting streaks exactly as the
+                # direct-call path would on fabric-side progress.
+                return
+            op.state = _DONE
+            if isinstance(outcome, Exception):
+                op.error = outcome
+            else:
+                op.result = outcome if op.verb == VERB_ADD else None
+            self._ops.pop(op.key, None)
+            self._done[op.key] = (op, now)
+            fabric_completion_latency.observe(
+                now - op.submitted, verb=op.verb,
+                outcome="error" if op.error is not None else "ok",
+            )
+
+    def _sweep_done(self, now: float) -> None:
+        """Unconsumed outcomes (CR deleted before its requeue ran) rot away
+        after done_ttl so the parking table can't grow unboundedly."""
+        if not self._done:
+            return
+        stale = [k for k, (_, t) in self._done.items() if now - t > self.done_ttl]
+        for k in stale:
+            del self._done[k]
+
+    # -- introspection (tests / debugging) ------------------------------
+    def op_state(self, verb: str, name: str) -> Optional[str]:
+        with self._cond:
+            if (verb, name) in self._done:
+                return _DONE
+            op = self._ops.get((verb, name))
+            return op.state if op is not None else None
+
+
+def _other(verb: str) -> str:
+    return VERB_REMOVE if verb == VERB_ADD else VERB_ADD

@@ -189,34 +189,52 @@ class InMemoryPool(FabricProvider):
     # provider interface
     # ------------------------------------------------------------------
     def add_resource(self, resource: ComposableResource) -> AttachResult:
+        with self._lock:
+            return self._add_one_locked(resource)
+
+    def add_resources(self, resources: List[ComposableResource]) -> List[object]:
+        """Group attach: every member processed inside ONE lock acquisition
+        (one fabric 'RPC'), per-member outcomes reported in place so one
+        bad device cannot poison its group (provider.py group-verb
+        contract). Async pools make per-member progress on every group
+        poll, exactly as per-member re-polls would."""
+        out: List[object] = []
+        with self._lock:
+            for r in resources:
+                try:
+                    out.append(self._add_one_locked(r))
+                except FabricError as e:
+                    out.append(e)
+        return out
+
+    def _add_one_locked(self, resource: ComposableResource) -> AttachResult:
         name = resource.metadata.name
         spec = resource.spec
-        with self._lock:
-            existing = self._attachments.get(name)
-            if existing is not None:
-                # Idempotent completion re-read (CM ADD_COMPLETE re-scan).
-                return AttachResult(list(existing.device_ids), existing.cdi_device_id)
+        existing = self._attachments.get(name)
+        if existing is not None:
+            # Idempotent completion re-read (CM ADD_COMPLETE re-scan).
+            return AttachResult(list(existing.device_ids), existing.cdi_device_id)
 
-            if self._add_failures.get(name, 0) > 0:
-                self._add_failures[name] -= 1
-                raise FabricError(f"injected attach failure for {name}")
+        if self._add_failures.get(name, 0) > 0:
+            self._add_failures[name] -= 1
+            raise FabricError(f"injected attach failure for {name}")
 
-            pending = self._pending_attach.get(name)
-            if pending is None and self._async_steps > 0:
-                self._pending_attach[name] = self._async_steps
-                raise WaitingDeviceAttaching(f"{name}: attach accepted, in progress")
-            if pending is not None and pending > 0:
-                self._pending_attach[name] = pending - 1
-                if self._pending_attach[name] > 0:
-                    raise WaitingDeviceAttaching(f"{name}: attach in progress")
+        pending = self._pending_attach.get(name)
+        if pending is None and self._async_steps > 0:
+            self._pending_attach[name] = self._async_steps
+            raise WaitingDeviceAttaching(f"{name}: attach accepted, in progress")
+        if pending is not None and pending > 0:
+            self._pending_attach[name] = pending - 1
+            if self._pending_attach[name] > 0:
+                raise WaitingDeviceAttaching(f"{name}: attach in progress")
 
-            if spec.type == "tpu" and spec.slice_name:
-                att = self._attach_slice_member(resource)
-            else:
-                att = self._attach_loose(resource)
-            self._attachments[name] = att
-            self._pending_attach.pop(name, None)
-            return AttachResult(list(att.device_ids), att.cdi_device_id)
+        if spec.type == "tpu" and spec.slice_name:
+            att = self._attach_slice_member(resource)
+        else:
+            att = self._attach_loose(resource)
+        self._attachments[name] = att
+        self._pending_attach.pop(name, None)
+        return AttachResult(list(att.device_ids), att.cdi_device_id)
 
     def _attach_slice_member(self, resource: ComposableResource) -> _Attachment:
         spec = resource.spec
@@ -266,32 +284,47 @@ class InMemoryPool(FabricProvider):
         )
 
     def remove_resource(self, resource: ComposableResource) -> None:
-        name = resource.metadata.name
         with self._lock:
-            if self._remove_failures.get(name, 0) > 0:
-                self._remove_failures[name] -= 1
-                raise FabricError(f"injected detach failure for {name}")
-            att = self._attachments.get(name)
-            if att is None:
-                self._drop_leaked(resource)
-                return  # idempotent
-            pending = self._pending_detach.get(name)
-            if pending is None and self._async_steps > 0:
-                self._pending_detach[name] = self._async_steps
-                raise WaitingDeviceDetaching(f"{name}: detach accepted, in progress")
-            if pending is not None and pending > 0:
-                self._pending_detach[name] = pending - 1
-                if self._pending_detach[name] > 0:
-                    raise WaitingDeviceDetaching(f"{name}: detach in progress")
-            del self._attachments[name]
-            self._pending_detach.pop(name, None)
-            if att.slice_name and att.slice_name in self._slices:
-                # Chips return to the reservation (released with the slice).
-                pass
-            else:
-                self._free.setdefault(att.model, []).extend(att.device_ids)
-            for d in att.device_ids:
-                self._health.pop(d, None)
+            self._remove_one_locked(resource)
+
+    def remove_resources(self, resources: List[ComposableResource]) -> List[object]:
+        """Group detach twin of :meth:`add_resources` (None = detached)."""
+        out: List[object] = []
+        with self._lock:
+            for r in resources:
+                try:
+                    self._remove_one_locked(r)
+                    out.append(None)
+                except FabricError as e:
+                    out.append(e)
+        return out
+
+    def _remove_one_locked(self, resource: ComposableResource) -> None:
+        name = resource.metadata.name
+        if self._remove_failures.get(name, 0) > 0:
+            self._remove_failures[name] -= 1
+            raise FabricError(f"injected detach failure for {name}")
+        att = self._attachments.get(name)
+        if att is None:
+            self._drop_leaked(resource)
+            return  # idempotent
+        pending = self._pending_detach.get(name)
+        if pending is None and self._async_steps > 0:
+            self._pending_detach[name] = self._async_steps
+            raise WaitingDeviceDetaching(f"{name}: detach accepted, in progress")
+        if pending is not None and pending > 0:
+            self._pending_detach[name] = pending - 1
+            if self._pending_detach[name] > 0:
+                raise WaitingDeviceDetaching(f"{name}: detach in progress")
+        del self._attachments[name]
+        self._pending_detach.pop(name, None)
+        if att.slice_name and att.slice_name in self._slices:
+            # Chips return to the reservation (released with the slice).
+            pass
+        else:
+            self._free.setdefault(att.model, []).extend(att.device_ids)
+        for d in att.device_ids:
+            self._health.pop(d, None)
 
     def _drop_leaked(self, resource: ComposableResource) -> None:
         """A detach-CR created by the syncer targets an orphaned attachment by

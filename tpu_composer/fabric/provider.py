@@ -69,6 +69,24 @@ class WaitingDeviceDetaching(FabricError):
     """Detach accepted but still in progress; requeue (client.go:43-44)."""
 
 
+class UnsupportedBatch(FabricError):
+    """The provider has no group attach/detach verb. The FabricDispatcher
+    catches this once and falls back to transparent per-item calls — it is
+    a capability probe, never an operational failure."""
+
+
+class DispatchedAttaching(WaitingDeviceAttaching):
+    """Attach queued in the FabricDispatcher; the FABRIC has not answered
+    yet. Subclassed so the reconciler can tell 'the dispatcher holds your
+    submission' apart from a real fabric wait sentinel: only the latter is
+    evidence the endpoint answered for this node (and may reset
+    attach-failure streaks); a synthetic queue acknowledgment is not."""
+
+
+class DispatchedDetaching(WaitingDeviceDetaching):
+    """Detach queued in the FabricDispatcher; see DispatchedAttaching."""
+
+
 # Health states — replaces the reference's res_op_status first-digit scheme
 # (0/1/2 = OK/Warning/Critical, fti/cm/client.go:293-309).
 HEALTH_OK = "OK"
@@ -135,6 +153,39 @@ class FabricProvider(abc.ABC):
     def get_resources(self) -> List[FabricDevice]:
         """Every attachment the fabric currently knows about (drives the
         anti-drift syncer, upstreamsyncer_controller.go:85-97)."""
+
+    # -- group verbs (fabric I/O pipeline; optional) --------------------
+    def add_resources(
+        self, resources: List[ComposableResource]
+    ) -> List[object]:
+        """Attach several chip groups bound for the SAME node in one
+        provider call (the FabricDispatcher's per-node batch verb).
+
+        Returns a list aligned with ``resources`` whose elements are each
+        either an :class:`AttachResult` or a ``FabricError`` *instance*
+        (including wait sentinels) describing that member's outcome — a
+        partial failure must not raise, so one bad device cannot poison
+        its group. Raising from this method means the WHOLE call failed
+        (transport fault, dead endpoint): the dispatcher then splits the
+        batch and retries member-by-member through ``add_resource``, so
+        per-resource breaker/budget accounting is preserved.
+
+        The default raises :class:`UnsupportedBatch`; providers without a
+        native group verb get a transparent per-item fallback."""
+        raise UnsupportedBatch(
+            f"{type(self).__name__} has no group attach verb"
+        )
+
+    def remove_resources(
+        self, resources: List[ComposableResource]
+    ) -> List[object]:
+        """Group detach twin of :meth:`add_resources`: per-member outcomes
+        are ``None`` (detached / idempotent no-op) or a ``FabricError``
+        instance; raising fails the whole call and triggers member-by-member
+        split retry."""
+        raise UnsupportedBatch(
+            f"{type(self).__name__} has no group detach verb"
+        )
 
     # -- slice transactions (TPU addition; default no-ops for gpu compat) --
     def reserve_slice(self, slice_name: str, model: str, topology: str, nodes: List[str]) -> None:

@@ -39,6 +39,8 @@ from tpu_composer.fabric.provider import (
     AttachResult,
     FabricError,
     FabricProvider,
+    TransientFabricError,
+    UnsupportedBatch,
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
     classify_fabric_error,
@@ -129,6 +131,91 @@ class RestPoolClient(PoolApiMixin, FabricProvider):
             raise WaitingDeviceDetaching(
                 f"{name}: detach in progress ({payload.get('state', 'detaching')})"
             )
+
+    # -- group verbs (fabric I/O pipeline) --------------------------------
+    # One POST carries a whole per-node wave:
+    #
+    #     POST /v1/attachments:batch   {op: add|remove, items: [...]}
+    #
+    # and the 200 response reports PER-MEMBER outcomes ({device_ids,...} |
+    # {state: attaching|detaching} | {error, transient}), so one bad device
+    # degrades one member, not the wave. A pool service without the route
+    # (404/405/501) surfaces as UnsupportedBatch and the dispatcher falls
+    # back to per-item calls; a transport fault fails the whole call and
+    # the dispatcher split-retries member-by-member.
+    def add_resources(self, resources: List[ComposableResource]) -> List[object]:
+        return self._batch("add", resources)
+
+    def remove_resources(self, resources: List[ComposableResource]) -> List[object]:
+        return self._batch("remove", resources)
+
+    def _batch(self, op: str, resources: List[ComposableResource]) -> List[object]:
+        items: List[Dict[str, object]] = []
+        for r in resources:
+            if op == "add":
+                spec = r.spec
+                item: Dict[str, object] = {
+                    "name": r.metadata.name,
+                    "type": spec.type,
+                    "node": spec.target_node,
+                    "model": spec.model,
+                    "chip_count": spec.chip_count,
+                }
+                if spec.slice_name:
+                    item["slice"] = spec.slice_name
+                    item["worker_id"] = spec.worker_id
+                    item["topology"] = spec.topology
+            else:
+                item = {
+                    "name": r.metadata.name,
+                    "device_ids": list(r.status.device_ids),
+                }
+            items.append(item)
+        try:
+            _, payload = self._http.request(
+                "POST", "/attachments:batch" + self._wait_qs(),
+                {"op": op, "items": items},
+            )
+        except HttpStatusError as e:
+            if e.code in (404, 405, 501):
+                raise UnsupportedBatch(
+                    f"pool service has no batch endpoint ({e.code})"
+                ) from None
+            raise classify_fabric_error(e, f"batch {op}: {e}") from e
+        results = {
+            rec.get("name"): rec
+            for rec in payload.get("results", [])
+            if isinstance(rec, dict)
+        }
+        return [
+            self._batch_outcome(op, r.metadata.name, results.get(r.metadata.name))
+            for r in resources
+        ]
+
+    @staticmethod
+    def _batch_outcome(op: str, name: str, rec: Optional[Dict]) -> object:
+        if rec is None:
+            # A member the service silently dropped is retryable — the
+            # dispatcher's next pass re-submits it individually.
+            return TransientFabricError(
+                f"batch {op} {name}: pool service returned no result"
+            )
+        if rec.get("error"):
+            cls = TransientFabricError if rec.get("transient") else FabricError
+            return cls(f"{op} {name}: {rec['error']}")
+        state = rec.get("state", "")
+        if state == "attaching":
+            return WaitingDeviceAttaching(f"{name}: attach in progress")
+        if state == "detaching":
+            return WaitingDeviceDetaching(f"{name}: detach in progress")
+        if op == "remove":
+            return None
+        device_ids = list(rec.get("device_ids", []))
+        if not device_ids:
+            return FabricError(f"attach {name}: fabric returned no device ids")
+        return AttachResult(
+            device_ids=device_ids, cdi_device_id=rec.get("cdi_device_id", "")
+        )
 
     def _wait_qs(self) -> str:
         return "?wait=true" if self.synchronous else ""

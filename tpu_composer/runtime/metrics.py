@@ -247,6 +247,34 @@ store_watch_queue_depth = global_registry.gauge(
     " slow consumer — the unbounded queue would otherwise hide it)",
 )
 
+#: Fabric I/O pipeline (fabric/dispatcher.py): per-node batched group
+#: attach, async dispatch, completion-driven requeue.
+fabric_calls_total = global_registry.counter(
+    "tpuc_fabric_calls_total",
+    "Provider calls issued by the fabric write path, by verb and whether"
+    " the call was a batched group verb (batched=true) or a single-item"
+    " call (batched=false; includes split retries of failed batches)",
+)
+fabric_batch_size = global_registry.histogram(
+    "tpuc_fabric_batch_size",
+    "Members per group fabric call attempted by the dispatcher",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0),
+)
+fabric_inflight = global_registry.gauge(
+    "tpuc_fabric_inflight",
+    "Fabric ops currently executing against the provider (all nodes)",
+)
+fabric_completion_latency = global_registry.histogram(
+    "tpuc_fabric_completion_latency_seconds",
+    "Latency from dispatcher submission to op completion (batch window +"
+    " provider time + any fabric-async wait), by verb and outcome",
+)
+fabric_reads_coalesced_total = global_registry.counter(
+    "tpuc_fabric_reads_coalesced_total",
+    "get_resources listings served from the dispatcher's shared snapshot"
+    " (no provider call; staleness bounded by the batch window)",
+)
+
 #: Cluster scheduler (scheduler/: priority queue, preemption, defrag).
 scheduler_queue_depth = global_registry.gauge(
     "tpuc_scheduler_queue_depth",

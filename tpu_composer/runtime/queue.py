@@ -13,16 +13,25 @@ controller-runtime reconciler in /root/reference/internal/controller. Contract:
   that failed during a fabric blackout requeue in the same instant when it
   healed (thundering herd into the just-recovered endpoint); jitter spreads
   the recovery wave while keeping the same expected growth;
-- ``forget(key)`` resets the backoff (successful reconcile).
+- ``forget(key)`` resets the backoff (successful reconcile) AND lazily
+  invalidates the key's pending backoff entries: a key that succeeded must
+  not be woken again by a stale pre-success failure requeue. Plain
+  ``add_after`` entries (periodic polls) are never invalidated — they are
+  liveness, not backoff.
+
+The ready queue is a ``collections.deque``: under deep queues (an attach
+wave fanning hundreds of keys out) the old ``list.pop(0)`` made every get
+O(n) — O(n^2) to drain the wave.
 """
 
 from __future__ import annotations
 
+import collections
 import heapq
 import random
 import threading
 import time
-from typing import Dict, Hashable, List, Optional, Set, Tuple
+from typing import Deque, Dict, Hashable, List, Optional, Tuple
 
 
 class RateLimitingQueue:
@@ -38,13 +47,19 @@ class RateLimitingQueue:
         # key -> last jittered delay (decorrelated jitter state)
         self._last_delay: Dict[Hashable, float] = {}
         self._cond = threading.Condition()
-        self._queue: List[Hashable] = []
-        self._queued: Set[Hashable] = set()
-        self._processing: Set[Hashable] = set()
-        self._dirty: Set[Hashable] = set()
+        self._queue: Deque[Hashable] = collections.deque()
+        self._queued: set = set()
+        self._processing: set = set()
+        self._dirty: set = set()
         self._failures: Dict[Hashable, int] = {}
-        # min-heap of (ready_time, seq, key)
-        self._delayed: List[Tuple[float, int, Hashable]] = []
+        # min-heap of (ready_time, seq, key, backoff_gen); backoff_gen is
+        # None for plain add_after entries and the key's backoff generation
+        # at push time for add_rate_limited entries — forget() bumps the
+        # generation so stale backoff entries evaporate at promotion
+        # instead of spuriously re-waking a key that already succeeded.
+        self._delayed: List[Tuple[float, int, Hashable, Optional[int]]] = []
+        self._backoff_gen: Dict[Hashable, int] = {}
+        self._backoff_pending: Dict[Hashable, int] = {}  # outstanding entries
         self._seq = 0
         self._shutdown = False
 
@@ -68,12 +83,12 @@ class RateLimitingQueue:
         with self._cond:
             if self._shutdown:
                 return
-            self._seq += 1
-            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, key))
-            self._cond.notify()
+            self._push_delayed(key, delay, None)
 
     def add_rate_limited(self, key: Hashable) -> None:
         with self._cond:
+            if self._shutdown:
+                return
             self._failures[key] = self._failures.get(key, 0) + 1
             # Decorrelated jitter (the AWS formula): next ∈ U(base, 3·prev),
             # capped. Expected growth ≈ 1.5x/attempt — same shape as the old
@@ -84,12 +99,30 @@ class RateLimitingQueue:
                 self._max_delay, self._rng.uniform(self._base_delay, prev * 3)
             )
             self._last_delay[key] = delay
-        self.add_after(key, delay)
+            self._backoff_pending[key] = self._backoff_pending.get(key, 0) + 1
+            self._push_delayed(key, delay, self._backoff_gen.get(key, 0))
+
+    def _push_delayed(
+        self, key: Hashable, delay: float, gen: Optional[int]
+    ) -> None:
+        # caller holds the lock
+        self._seq += 1
+        heapq.heappush(
+            self._delayed, (time.monotonic() + delay, self._seq, key, gen)
+        )
+        self._cond.notify()
 
     def forget(self, key: Hashable) -> None:
         with self._cond:
             self._failures.pop(key, None)
             self._last_delay.pop(key, None)
+            if self._backoff_pending.get(key):
+                # Outstanding backoff entries become stale: bump the
+                # generation so _promote_ready drops them on arrival. The
+                # per-key state is pruned when the last stale entry drains
+                # (bounded by the backoff cap), so churning keys don't
+                # accrete bookkeeping.
+                self._backoff_gen[key] = self._backoff_gen.get(key, 0) + 1
 
     def retries(self, key: Hashable) -> int:
         with self._cond:
@@ -99,7 +132,19 @@ class RateLimitingQueue:
     def _promote_ready(self, now: float) -> None:
         # caller holds the lock
         while self._delayed and self._delayed[0][0] <= now:
-            _, _, key = heapq.heappop(self._delayed)
+            _, _, key, gen = heapq.heappop(self._delayed)
+            if gen is not None:
+                current = self._backoff_gen.get(key, 0)
+                left = self._backoff_pending.get(key, 1) - 1
+                if left > 0:
+                    self._backoff_pending[key] = left
+                else:
+                    # Last outstanding entry drained — prune the per-key
+                    # bookkeeping (next backoff starts back at gen 0).
+                    self._backoff_pending.pop(key, None)
+                    self._backoff_gen.pop(key, None)
+                if gen != current:
+                    continue  # forgotten since scheduling — stale backoff
             if key in self._processing:
                 self._dirty.add(key)
             elif key not in self._queued:
@@ -114,7 +159,7 @@ class RateLimitingQueue:
                 now = time.monotonic()
                 self._promote_ready(now)
                 if self._queue:
-                    key = self._queue.pop(0)
+                    key = self._queue.popleft()
                     self._queued.discard(key)
                     self._processing.add(key)
                     return key
