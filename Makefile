@@ -4,7 +4,7 @@
 PYTHON ?= python
 IMG ?= tpu-composer:latest
 
-.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean build-installer bundle
+.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean build-installer bundle crash-soak
 
 all: native test
 
@@ -35,6 +35,18 @@ bench:
 ## (write path / FabricDispatcher group-verb coalescing)
 perf-smoke:
 	$(PYTHON) -c "import bench; bench.perf_smoke()"
+
+## crash-soak: kill–restart crash-consistency soak (tests/test_crash_restart.py,
+## markers slow+crash): hard-stop the operator at 32 randomized points inside
+## attach/detach waves (cache on/off x batched/unbatched fabric), restart it
+## against the same store + fabric, and assert adoption-driven convergence —
+## zero leaked attachments, zero double-attaches (nonce-checked), budget and
+## quarantine accounting identical to an uninterrupted run. Deterministic
+## seed by default (what CI runs); CRASH_SEED=random soaks a fresh seed
+## locally — the chosen seed is printed, so any failure reproduces with
+## CRASH_SEED=<n> make crash-soak.
+crash-soak:
+	$(PYTHON) -m pytest tests/test_crash_restart.py -q -m crash -p no:randomly
 
 ## watch-relay: poll the TPU tunnel relay; auto-capture the full on-chip
 ## probe to bench_artifacts/ the moment it answers (run at round start)

@@ -68,6 +68,13 @@ def pytest_configure(config):
         " (always also marked slow so tier-1's `-m 'not slow'` excludes it;"
         " run with `-m sim`)",
     )
+    config.addinivalue_line(
+        "markers",
+        "crash: kill–restart soak driving hard stops at randomized points"
+        " inside attach/detach waves (always also marked slow; run with"
+        " `make crash-soak` or `pytest -m crash`; CRASH_SEED=random for"
+        " local randomized soaks)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -254,6 +254,8 @@ class _FabricHandler(BaseHTTPRequestHandler):
                     "node": d.node,
                     "model": d.model,
                     "slice": d.slice_name,
+                    "type": d.type,
+                    "resource": d.resource_name,
                     "health": {"state": d.health.state, "detail": d.health.detail},
                 }
                 for d in pool.get_resources()

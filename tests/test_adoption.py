@@ -1,0 +1,301 @@
+"""Cold-start adoption of durable fabric intents (controllers/adoption.py).
+
+A crash between "intent persisted" and "outcome persisted" leaves a
+``status.pending_op`` record whose truth only the fabric knows. These tests
+pin the classification table: completed-but-unrecorded work is adopted into
+status, never-issued work is cleared for clean re-submission, fabric-async
+work is handed to the dispatcher's re-poll pass — and attach-budget /
+quarantine accounting is never rewritten by any of it.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_composer.api import ComposableResource, Node, ObjectMeta
+from tpu_composer.api.meta import now_iso
+from tpu_composer.api.types import (
+    PendingOp,
+    RESOURCE_STATE_ATTACHING,
+    RESOURCE_STATE_DETACHING,
+)
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.controllers.adoption import adopt_pending_ops
+from tpu_composer.controllers.resource_controller import (
+    ComposableResourceReconciler,
+    ResourceTiming,
+)
+from tpu_composer.fabric.dispatcher import FabricDispatcher
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.fabric.provider import FabricError
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.store import Store, StoreError
+
+
+def make_cr(store, name, node="worker-0", state=RESOURCE_STATE_ATTACHING,
+            verb="add", model="tpu-v4", chip_count=1):
+    """A CR mid-op at crash time: intent persisted, outcome not."""
+    res = ComposableResource(metadata=ObjectMeta(name=name))
+    res.spec.type = "tpu"
+    res.spec.model = model
+    res.spec.target_node = node
+    res.spec.chip_count = chip_count
+    res.status.state = state
+    store.create(res)
+    got = store.get(ComposableResource, name)
+    got.status.state = state
+    if verb:
+        got.status.pending_op = PendingOp(
+            verb=verb, nonce=f"nonce-{name}", node=node, started_at=now_iso()
+        )
+    return store.update_status(got)
+
+
+@pytest.fixture()
+def world(store):
+    store.create(Node(metadata=ObjectMeta(name="worker-0")))
+    return store, InMemoryPool()
+
+
+class TestAddIntents:
+    def test_completed_but_unrecorded_attach_is_adopted(self, world):
+        """The fabric holds the attachment; the crash ate the status write.
+        Adoption folds the device ids + cdi id in and retires the intent —
+        without issuing a second materializing attach."""
+        store, pool = world
+        res = make_cr(store, "r0")
+        result = pool.add_resource(res)  # pre-crash attach that landed
+        free_before = pool.free_chips("tpu-v4")
+
+        report = adopt_pending_ops(store, pool)
+        assert report.adopted == ["r0"]
+        got = store.get(ComposableResource, "r0")
+        assert got.status.device_ids == result.device_ids
+        assert got.status.cdi_device_id == result.cdi_device_id
+        assert got.status.pending_op is None
+        assert pool.free_chips("tpu-v4") == free_before  # no double attach
+
+    def test_never_issued_attach_cleared_when_fabric_rejects(self, world):
+        """Nothing at the fabric and the probe fails: clear the intent so
+        the normal reconcile re-submits under its own budget accounting."""
+        store, pool = world
+        make_cr(store, "r0")
+        pool.inject_add_failure("r0", times=99)
+
+        report = adopt_pending_ops(store, pool)
+        assert report.reissued == ["r0"]
+        got = store.get(ComposableResource, "r0")
+        assert got.status.pending_op is None
+        assert got.status.device_ids == []
+        # Budget accounting untouched — probes never count as attempts.
+        assert got.status.attach_attempts == 0
+
+    def test_never_issued_attach_probe_completes_synchronously(self, world):
+        """A sync provider answering the probe with the result IS the
+        terminal state reconcile wanted — adopt it."""
+        store, pool = world
+        make_cr(store, "r0")
+        report = adopt_pending_ops(store, pool)
+        assert report.adopted == ["r0"]
+        got = store.get(ComposableResource, "r0")
+        assert len(got.status.device_ids) == 1
+        assert got.status.pending_op is None
+
+    def test_async_attach_handed_to_dispatcher_repoll(self, world):
+        """Fabric answered 'in progress': the dispatcher's shared per-node
+        re-poll pass drives it to completion, not a cold requeue."""
+        store, _ = world
+        pool = InMemoryPool(async_steps=2)
+        res = make_cr(store, "r0")
+        with pytest.raises(Exception):
+            pool.add_resource(res)  # pre-crash submission, fabric-async now
+        dispatcher = FabricDispatcher(pool, batch_window=0.0,
+                                      poll_interval=0.01)
+        try:
+            report = adopt_pending_ops(store, pool, dispatcher)
+            assert report.repolled == ["r0"]
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if dispatcher.op_state("add", "r0") == "done":
+                    break
+                time.sleep(0.01)
+            assert dispatcher.op_state("add", "r0") == "done"
+            # The next reconcile's submission consumes the parked outcome.
+            out = dispatcher.add_resource(store.get(ComposableResource, "r0"))
+            assert len(out.device_ids) == 1
+        finally:
+            dispatcher.stop()
+
+    def test_async_attach_without_dispatcher_is_deferred(self, world):
+        store, _ = world
+        pool = InMemoryPool(async_steps=3)
+        res = make_cr(store, "r0")
+        with pytest.raises(Exception):
+            pool.add_resource(res)
+        report = adopt_pending_ops(store, pool, dispatcher=None)
+        assert report.deferred == ["r0"]
+        # Intent kept: the poll-timer reconcile path owns the completion.
+        assert store.get(ComposableResource, "r0").status.pending_op is not None
+
+    def test_quarantined_intent_cleared_without_fabric_probe(self, world):
+        """Quarantine is terminal for the attach path: adoption must never
+        re-probe (let alone re-issue) an attach the budget machinery
+        retired — and must not rewrite the accounting."""
+        store, pool = world
+        res = make_cr(store, "r0")
+        res.status.quarantined = True
+        res.status.attach_attempts = 5
+        store.update_status(res)
+        free_before = pool.free_chips("tpu-v4")
+
+        report = adopt_pending_ops(store, pool)
+        assert report.cleared == ["r0"]
+        got = store.get(ComposableResource, "r0")
+        assert got.status.pending_op is None
+        assert got.status.quarantined is True
+        assert got.status.attach_attempts == 5  # bit-for-bit preserved
+        assert pool.free_chips("tpu-v4") == free_before  # never probed
+
+    def test_deleted_owner_with_nothing_materialized_cleared(self, world):
+        store, pool = world
+        res = make_cr(store, "r0")
+        res.add_finalizer("tpu.composer.dev/finalizer")
+        store.update(res)
+        store.delete(ComposableResource, "r0")  # terminating, finalizer-held
+        report = adopt_pending_ops(store, pool)
+        assert report.cleared == ["r0"]
+        assert store.get(ComposableResource, "r0").status.pending_op is None
+
+
+class TestRemoveIntents:
+    def test_effective_detach_cleared_for_reconcile_tail(self, world):
+        """Nothing left at the fabric: the detach completed but the crash
+        ate the Deleting transition — retire the intent, the Detaching
+        reconcile re-runs its idempotent tail."""
+        store, pool = world
+        make_cr(store, "r0", state=RESOURCE_STATE_DETACHING, verb="remove")
+        report = adopt_pending_ops(store, pool)
+        assert report.cleared == ["r0"]
+        assert store.get(ComposableResource, "r0").status.pending_op is None
+
+    def test_ineffective_detach_repolled_and_ids_adopted(self, world):
+        """Fabric still holds chips: fold every fabric-known id into status
+        (a crash can predate the id adoption) and re-drive through the
+        dispatcher."""
+        store, pool = world
+        res = make_cr(store, "r0", state=RESOURCE_STATE_DETACHING,
+                      verb="remove")
+        attach = pool.add_resource(res)
+        # Crash predated the id write: status knows nothing.
+        res = store.get(ComposableResource, "r0")
+        assert res.status.device_ids == []
+        dispatcher = FabricDispatcher(pool, batch_window=0.0,
+                                      poll_interval=0.01)
+        try:
+            report = adopt_pending_ops(store, pool, dispatcher)
+            assert report.repolled == ["r0"]
+            got = store.get(ComposableResource, "r0")
+            assert got.status.device_ids == sorted(attach.device_ids)
+            assert got.status.pending_op is not None  # kept until effective
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if dispatcher.op_state("remove", "r0") == "done":
+                    break
+                time.sleep(0.01)
+            assert pool.attachment_record("r0") is None  # detach went through
+        finally:
+            dispatcher.stop()
+
+
+class TestDegradedStores:
+    def test_dark_fabric_defers_everything(self, world):
+        store, pool = world
+        make_cr(store, "r0")
+        make_cr(store, "r1", verb="remove", state=RESOURCE_STATE_DETACHING)
+
+        class DarkFabric:
+            def get_resources(self):
+                raise FabricError("fabric manager unreachable")
+
+        report = adopt_pending_ops(store, DarkFabric())
+        assert sorted(report.deferred) == ["r0", "r1"]
+        # Intents all kept: the reconcile path (breaker + backoff) retries.
+        assert store.get(ComposableResource, "r0").status.pending_op is not None
+        assert store.get(ComposableResource, "r1").status.pending_op is not None
+
+    def test_store_list_failure_is_nonfatal(self, world):
+        _, pool = world
+
+        class DeadStore:
+            def list(self, cls):
+                raise StoreError("apiserver down")
+
+        report = adopt_pending_ops(DeadStore(), pool)
+        assert report.errors and report.adopted == []
+
+    def test_no_pending_intents_never_lists_fabric(self, world):
+        """The common cold start (clean shutdown) must not pay a fabric
+        listing at all."""
+        store, _ = world
+        make_cr(store, "r0", verb="")  # settled resource, no intent
+
+        class ExplodingFabric:
+            def get_resources(self):
+                raise AssertionError("listed fabric with no pending intents")
+
+        report = adopt_pending_ops(store, ExplodingFabric())
+        assert report.total == 0
+
+
+class TestManagerWiring:
+    def test_hook_runs_after_acquire_before_controllers(self, store):
+        """The adoption slot: leadership held, no controller worker running
+        yet — by the first reconcile, surviving intents are resolved."""
+        store.create(Node(metadata=ObjectMeta(name="worker-0")))
+        pool = InMemoryPool()
+        agent = FakeNodeAgent(pool=pool)
+        rec = ComposableResourceReconciler(
+            store, pool, agent,
+            timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
+                                  detach_poll=0.05, detach_fast=0.05))
+        mgr = Manager(store=store)
+        mgr.add_controller(rec)
+        seen = {}
+
+        def hook():
+            seen["controller_threads"] = list(rec._threads)
+            seen["report"] = adopt_pending_ops(store, pool)
+
+        mgr.add_startup_hook(hook)
+        # Crash scenario baked into the store: attach landed, write lost.
+        res = make_cr(store, "r0")
+        result = pool.add_resource(res)
+        mgr.start(workers_per_controller=1)
+        try:
+            assert seen["controller_threads"] == []  # pre-controller-start
+            assert seen["report"].adopted == ["r0"]
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                got = store.get(ComposableResource, "r0")
+                if got.status.state == "Online":
+                    break
+                time.sleep(0.02)
+            got = store.get(ComposableResource, "r0")
+            assert got.status.state == "Online"
+            assert got.status.device_ids == result.device_ids
+            assert got.status.pending_op is None
+        finally:
+            mgr.stop()
+
+    def test_hook_failure_is_nonfatal(self, store):
+        mgr = Manager(store=store)
+        mgr.add_startup_hook(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        started = threading.Event()
+        mgr.add_startup_hook(started.set)
+        mgr.start(workers_per_controller=1)
+        try:
+            assert started.is_set(), "later hooks must still run"
+            assert mgr.ready()
+        finally:
+            mgr.stop()

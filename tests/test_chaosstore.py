@@ -1,0 +1,316 @@
+"""ChaosStore — store-layer fault injection (runtime/chaosstore.py).
+
+The apiserver twin of ChaosFabricProvider: transient 5xx, optimistic-
+concurrency conflicts, injected latency, lossy watch streams. Unit tests pin
+each injection mode and the plumbing passthrough; the convergence tests
+prove the control plane absorbs store faults the way it already absorbs
+fabric faults (the crash-consistency machinery's other half).
+"""
+
+import queue as _queue
+import time
+
+import pytest
+
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.types import REQUEST_STATE_RUNNING
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.controllers import (
+    ComposabilityRequestReconciler,
+    ComposableResourceReconciler,
+    RequestTiming,
+    ResourceTiming,
+)
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.cache import CachedClient, maybe_cached
+from tpu_composer.runtime.chaosstore import ChaosStore
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.metrics import store_chaos_injected_total
+from tpu_composer.runtime.store import (
+    ConflictError,
+    Store,
+    StoreError,
+    WatchEvent,
+)
+
+
+def _node(name="worker-0", slots=4):
+    n = Node(metadata=ObjectMeta(name=name))
+    n.status.tpu_slots = slots
+    return n
+
+
+class TestInjectionModes:
+    def test_failure_rate_one_fails_everything(self, store):
+        chaos = ChaosStore(store, failure_rate=1.0, seed=1)
+        with pytest.raises(StoreError):
+            chaos.create(_node())
+        with pytest.raises(StoreError):
+            chaos.list(Node)
+        assert chaos.injected == 2
+        assert len(store) == 0  # injected BEFORE the inner call: never commits
+
+    def test_conflict_rate_spares_reads_and_creates(self, store):
+        chaos = ChaosStore(store, conflict_rate=1.0, seed=1)
+        chaos.create(_node())  # creates are never conflict-injected
+        got = chaos.get(Node, "worker-0")  # reads neither
+        with pytest.raises(ConflictError):
+            chaos.update(got)
+        with pytest.raises(ConflictError):
+            chaos.update_status(got)
+        with pytest.raises(ConflictError):
+            chaos.delete(Node, "worker-0")
+        assert store.get(Node, "worker-0") is not None  # nothing committed
+
+    def test_fail_verb_scripted_count(self, store):
+        chaos = ChaosStore(store)
+        chaos.fail_verb("create", times=2)
+        for _ in range(2):
+            with pytest.raises(StoreError):
+                chaos.create(_node())
+        chaos.create(_node())  # third attempt commits
+        assert store.get(Node, "worker-0") is not None
+
+    def test_blackout_and_heal(self, store):
+        chaos = ChaosStore(store)
+        chaos.blackout()
+        with pytest.raises(StoreError):
+            chaos.list(Node)
+        chaos.heal()
+        assert chaos.list(Node) == []
+
+    def test_latency_injected_per_call(self, store):
+        delays = []
+        chaos = ChaosStore(store, latency=(0.01, 0.02), seed=3,
+                           sleep=delays.append)
+        chaos.create(_node())
+        chaos.get(Node, "worker-0")
+        assert len(delays) == 2
+        assert all(0.01 <= d <= 0.02 for d in delays)
+
+    def test_injections_counted_by_mode(self, store):
+        t0 = store_chaos_injected_total.value(verb="update", mode="conflict")
+        chaos = ChaosStore(store, conflict_rate=1.0, seed=1)
+        chaos.create(_node())
+        with pytest.raises(ConflictError):
+            chaos.update(chaos.get(Node, "worker-0"))
+        assert store_chaos_injected_total.value(
+            verb="update", mode="conflict") == t0 + 1
+
+
+class TestWatchDrops:
+    def test_events_dropped_but_control_items_pass(self, store):
+        chaos = ChaosStore(store, watch_drop_rate=1.0, seed=1)
+        q = chaos.watch("Node")
+        store.create(_node())
+        q._q.put(None)  # wake-up sentinel behind the event
+        assert q.get(timeout=1) is None  # event swallowed, sentinel through
+
+    def test_zero_rate_returns_raw_queue(self, store):
+        chaos = ChaosStore(store)
+        q = chaos.watch("Node")
+        store.create(_node())
+        ev = q.get(timeout=1)
+        assert isinstance(ev, WatchEvent) and ev.type == "ADDED"
+        chaos.stop_watch(q)
+
+    def test_partial_drop_rate_with_seed(self, store):
+        chaos = ChaosStore(store, watch_drop_rate=0.5, seed=7)
+        q = chaos.watch("Node")
+        for i in range(40):
+            store.create(_node(f"w-{i}"))
+        got = 0
+        while True:
+            try:
+                item = q.get(block=False)
+            except _queue.Empty:
+                break
+            if isinstance(item, WatchEvent):
+                got += 1
+        assert 0 < got < 40  # lossy, not dead and not lossless
+
+    def test_stop_watch_unsubscribes_inner_queue(self, store):
+        chaos = ChaosStore(store, watch_drop_rate=0.5, seed=1)
+        q = chaos.watch("Node")
+        chaos.stop_watch(q)
+        store.create(_node())
+        assert q._q.qsize() == 0  # inner queue no longer fed
+
+
+class TestPlumbing:
+    def test_passthrough_surface(self, store):
+        chaos = ChaosStore(store)
+        chaos.create(_node())
+        assert chaos.try_get(Node, "worker-0") is not None
+        assert chaos.try_get(Node, "nope") is None
+        assert len(chaos) == 1
+        assert chaos.scheme is store.scheme
+        assert list(chaos.keys()) == list(store.keys())
+
+    def test_maybe_cached_wraps_chaos_over_inproc_store(self, store):
+        chaos = ChaosStore(store, failure_rate=0.0)
+        client = maybe_cached(chaos, True)
+        assert isinstance(client, CachedClient)
+        assert maybe_cached(chaos, False) is chaos
+
+    def test_cmd_wiring_builds_chaos_store(self, tmp_path):
+        from tpu_composer.cmd.main import build_parser, build_store
+
+        args = build_parser().parse_args([
+            "--chaos-store-failure-rate", "0.25",
+            "--chaos-store-seed", "42",
+            "--state-dir", str(tmp_path / "s"),
+        ])
+        chained = build_store(args)
+        assert isinstance(chained, ChaosStore)
+        assert chained.failure_rate == 0.25
+        assert isinstance(chained._inner, Store)
+        # All knobs off -> bare store, no wrapper in the hot path.
+        args = build_parser().parse_args(["--state-dir", str(tmp_path / "s2")])
+        assert isinstance(build_store(args), Store)
+
+
+class TestConvergenceUnderStoreChaos:
+    """The acceptance shape: the operator converges through injected store
+    faults — conflicts requeue, transients retry under backoff — exactly as
+    it does through fabric faults."""
+
+    def _operator(self, chaos):
+        pool = InMemoryPool()
+        agent = FakeNodeAgent(pool=pool)
+        mgr = Manager(store=chaos)
+        mgr.add_controller(ComposabilityRequestReconciler(
+            chaos, pool,
+            timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.05)))
+        mgr.add_controller(ComposableResourceReconciler(
+            chaos, pool, agent,
+            timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
+                                  detach_poll=0.05, detach_fast=0.05,
+                                  busy_poll=0.05)))
+        return mgr, pool
+
+    def _wait(self, predicate, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.02)
+        return False
+
+    def _run_cycle(self, chaos):
+        mgr, pool = self._operator(chaos)
+        mgr.start(workers_per_controller=2)
+        try:
+            created = False
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not created:
+                try:
+                    chaos.create(ComposabilityRequest(
+                        metadata=ObjectMeta(name="job"),
+                        spec=ComposabilityRequestSpec(
+                            resource=ResourceDetails(
+                                type="tpu", model="tpu-v4", size=4)),
+                    ))
+                    created = True
+                except StoreError:
+                    time.sleep(0.05)
+            assert created
+            assert self._wait(
+                lambda: chaos._inner.get(
+                    ComposabilityRequest, "job"
+                ).status.state == REQUEST_STATE_RUNNING
+            ), chaos._inner.get(ComposabilityRequest, "job").status.to_dict()
+            deleted = False
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not deleted:
+                try:
+                    chaos.delete(ComposabilityRequest, "job")
+                    deleted = True
+                except StoreError:
+                    time.sleep(0.05)
+            assert deleted
+            assert self._wait(
+                lambda: chaos._inner.try_get(ComposabilityRequest, "job")
+                is None and not chaos._inner.list(ComposableResource)
+            )
+            assert self._wait(lambda: pool.free_chips("tpu-v4") == 64)
+            assert pool.get_resources() == []
+        finally:
+            mgr.stop()
+
+    def test_converges_through_transients_and_conflicts(self, store):
+        store.create(_node("worker-0"))
+        store.create(_node("worker-1"))
+        chaos = ChaosStore(store, failure_rate=0.03, conflict_rate=0.08,
+                           seed=1234)
+        self._run_cycle(chaos)
+
+    @pytest.mark.slow
+    @pytest.mark.chaos
+    def test_soak_heavy_store_chaos(self, store):
+        """Heavier rates, several attach/detach cycles, cache stacked on
+        top (reads from the informer, writes through the injector — the
+        asymmetry a real deployment has)."""
+        store.create(_node("worker-0"))
+        store.create(_node("worker-1"))
+        chaos = ChaosStore(store, failure_rate=0.10, conflict_rate=0.15,
+                           seed=99)
+        client = maybe_cached(chaos, True)
+        pool = InMemoryPool()
+        agent = FakeNodeAgent(pool=pool)
+        mgr = Manager(store=client)
+        mgr.add_controller(ComposabilityRequestReconciler(
+            client, pool,
+            timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.05)))
+        mgr.add_controller(ComposableResourceReconciler(
+            client, pool, agent,
+            timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
+                                  detach_poll=0.05, detach_fast=0.05,
+                                  busy_poll=0.05)))
+        mgr.start(workers_per_controller=2)
+        try:
+            for cycle in range(5):
+                name = f"job-{cycle}"
+                created = False
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline and not created:
+                    try:
+                        client.create(ComposabilityRequest(
+                            metadata=ObjectMeta(name=name),
+                            spec=ComposabilityRequestSpec(
+                                resource=ResourceDetails(
+                                    type="tpu", model="tpu-v4", size=4)),
+                        ))
+                        created = True
+                    except StoreError:
+                        time.sleep(0.05)
+                assert created
+                assert self._wait(
+                    lambda: store.get(
+                        ComposabilityRequest, name
+                    ).status.state == REQUEST_STATE_RUNNING, timeout=60,
+                ), store.get(ComposabilityRequest, name).status.to_dict()
+                deleted = False
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline and not deleted:
+                    try:
+                        client.delete(ComposabilityRequest, name)
+                        deleted = True
+                    except StoreError:
+                        time.sleep(0.05)
+                assert deleted
+                assert self._wait(
+                    lambda: store.try_get(ComposabilityRequest, name) is None
+                    and not store.list(ComposableResource), timeout=60)
+            assert self._wait(lambda: pool.free_chips("tpu-v4") == 64)
+            assert pool.get_resources() == []
+            assert chaos.injected > 0, "soak never actually injected faults"
+        finally:
+            mgr.stop()

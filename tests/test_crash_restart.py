@@ -1,0 +1,552 @@
+"""Kill–restart crash consistency: the soak harness (ISSUE 5 tentpole).
+
+The hard correctness case for a composable-hardware operator is a process
+crash mid-mutation: the fabric keeps chips attached while every in-memory
+trace of the work (dispatcher lanes, parked outcomes, reconcile workers) is
+gone. These tests hard-stop the operator — no drain — at RANDOMIZED points
+inside attach and detach waves, restart it against the same store + fabric,
+and assert the durable-intent + cold-start-adoption machinery converges
+with:
+
+- zero leaked fabric attachments (chip conservation at the pool),
+- zero double-attaches (every materialization nonce-checked against the
+  durable intent that caused it),
+- attach-budget / quarantine accounting identical to an uninterrupted run.
+
+The crash model: a ``CrashFuse`` store wrapper counts the OPERATOR's
+mutating store calls and, at a randomized fuse point, fails that write and
+every call after it — the process is dead; some writes landed, later ones
+did not. The fabric may still complete an op issued before death (exactly
+the in-flight-RPC window a real crash leaves). Driver traffic (the test's
+own submissions/deletes) goes straight to the raw store, like any other
+apiserver client.
+
+Run: ``make crash-soak`` (fixed seed) or ``CRASH_SEED=random make
+crash-soak`` for a randomized local soak (the chosen seed is printed so any
+failure reproduces).
+"""
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.dra import DeviceTaintRule
+from tpu_composer.api.types import REQUEST_STATE_RUNNING
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.agent.publisher import is_node_quarantine_marker
+from tpu_composer.controllers import (
+    ComposabilityRequestReconciler,
+    ComposableResourceReconciler,
+    RequestTiming,
+    ResourceTiming,
+    UpstreamSyncer,
+)
+from tpu_composer.controllers.adoption import adopt_pending_ops
+from tpu_composer.controllers.syncer import is_orphan_tracker
+from tpu_composer.fabric.dispatcher import FabricDispatcher
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.cache import CachedClient
+from tpu_composer.runtime.leases import LeaseElector
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.metrics import resources_quarantined_total
+from tpu_composer.runtime.store import Store, StoreError
+
+
+def wait_for(predicate, timeout=20.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ----------------------------------------------------------------------
+# crash harness
+# ----------------------------------------------------------------------
+class RecordingPool(InMemoryPool):
+    """InMemoryPool that logs every attachment materialization with the
+    durable-intent nonce that caused it, and every release. The soak's
+    zero-double-attach assertion reads this log."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.events = []  # ("attach", name, nonce) | ("release", name)
+
+    def _add_one_locked(self, resource):
+        name = resource.metadata.name
+        before = name in self._attachments
+        result = super()._add_one_locked(resource)
+        if not before and name in self._attachments:
+            po = resource.status.pending_op
+            self.events.append(("attach", name, po.nonce if po else ""))
+        return result
+
+    def _remove_one_locked(self, resource):
+        name = resource.metadata.name
+        before = name in self._attachments
+        super()._remove_one_locked(resource)
+        if before and name not in self._attachments:
+            self.events.append(("release", name))
+
+
+def assert_no_double_attach(events):
+    """Each resource's materializations must strictly alternate with
+    releases, and no durable-intent nonce may materialize chips twice —
+    one fabric mutation traces to exactly one intent."""
+    open_attach = {}
+    seen_nonces = set()
+    for ev in events:
+        if ev[0] == "attach":
+            _, name, nonce = ev
+            assert name not in open_attach, (
+                f"double attach for {name} (no release between): {events}"
+            )
+            open_attach[name] = nonce
+            key = (name, nonce)
+            assert key not in seen_nonces, (
+                f"intent nonce {nonce!r} materialized twice for {name}: {events}"
+            )
+            seen_nonces.add(key)
+        else:
+            open_attach.pop(ev[1], None)
+
+
+class CrashFuse:
+    """Store facade modeling a process crash at a precise point: after
+    ``fuse`` mutating calls, the failing write and EVERY subsequent call
+    raise — nothing more lands. ``fuse=None`` never blows (control runs);
+    ``die()`` blows it immediately (kill at quiescence)."""
+
+    _MUTATING = frozenset({"create", "update", "update_status", "delete"})
+
+    def __init__(self, inner, fuse=None):
+        self._inner = inner
+        self._fuse = fuse
+        self._lock = threading.Lock()
+        self.mutations = 0
+        self.dead = threading.Event()
+
+    def die(self):
+        self.dead.set()
+
+    def _gate(self, verb):
+        with self._lock:
+            if self.dead.is_set():
+                raise StoreError("crash: process dead")
+            if verb in self._MUTATING:
+                self.mutations += 1
+                if self._fuse is not None and self.mutations > self._fuse:
+                    self.dead.set()
+                    raise StoreError("crash: process died mid-write")
+
+    def create(self, obj):
+        self._gate("create")
+        return self._inner.create(obj)
+
+    def get(self, cls, name):
+        self._gate("get")
+        return self._inner.get(cls, name)
+
+    def try_get(self, cls, name):
+        self._gate("get")
+        return self._inner.try_get(cls, name)
+
+    def list(self, cls, label_selector=None):
+        self._gate("list")
+        return self._inner.list(cls, label_selector)
+
+    def update(self, obj):
+        self._gate("update")
+        return self._inner.update(obj)
+
+    def update_status(self, obj):
+        self._gate("update_status")
+        return self._inner.update_status(obj)
+
+    def delete(self, cls, name):
+        self._gate("delete")
+        return self._inner.delete(cls, name)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner"], name)
+
+
+class Incarnation:
+    """One operator process lifetime against a shared store + fabric."""
+
+    def __init__(self, raw_store, pool, *, cached, batched, fuse=None):
+        self.fuse = CrashFuse(raw_store, fuse)
+        self.client = CachedClient(self.fuse) if cached else self.fuse
+        self.dispatcher = (
+            FabricDispatcher(pool, batch_window=0.01, concurrency=4,
+                             poll_interval=0.02)
+            if batched else None
+        )
+        agent = FakeNodeAgent(pool=pool)
+        self.mgr = Manager(store=self.client, dispatcher=self.dispatcher,
+                           drain_timeout=0.0)  # crash harness: never drain
+        self.mgr.add_startup_hook(
+            lambda: adopt_pending_ops(self.client, pool, self.dispatcher))
+        self.mgr.add_controller(ComposabilityRequestReconciler(
+            self.client, pool,
+            timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.05)))
+        self.mgr.add_controller(ComposableResourceReconciler(
+            self.client, pool, agent,
+            timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
+                                  detach_poll=0.05, detach_fast=0.05,
+                                  busy_poll=0.05),
+            dispatcher=self.dispatcher))
+        # Anti-drift backstop, grace wide enough that the ms-wide "attach
+        # landed, status write in flight" window (and the crash-to-restart
+        # gap) never false-positives as a leak.
+        self.syncer = UpstreamSyncer(self.client, pool, period=0.1, grace=5.0)
+        self.mgr.add_runnable(self.syncer)
+        if self.dispatcher is not None:
+            self.mgr.add_runnable(self.dispatcher.run)
+        self.mgr.start(workers_per_controller=2)
+
+    def kill(self):
+        """SIGKILL analog: writes stop landing, the dispatcher abandons
+        lanes and parked outcomes, nothing is drained or flushed."""
+        self.fuse.die()
+        if self.dispatcher is not None:
+            self.dispatcher.kill()
+        self.mgr.stop()
+
+
+# ----------------------------------------------------------------------
+# the soak
+# ----------------------------------------------------------------------
+def _fresh_world():
+    store = Store()
+    for i in range(4):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 4
+        store.create(n)
+    return store
+
+
+def _submit_wave(store):
+    store.create(ComposabilityRequest(
+        metadata=ObjectMeta(name="wave-a"),
+        spec=ComposabilityRequestSpec(resource=ResourceDetails(
+            type="tpu", model="tpu-v4", size=8)),
+    ))
+    store.create(ComposabilityRequest(
+        metadata=ObjectMeta(name="wave-b"),
+        spec=ComposabilityRequestSpec(resource=ResourceDetails(
+            type="tpu", model="tpu-v4", size=4)),
+    ))
+
+
+def _all_running(store):
+    try:
+        return all(
+            store.get(ComposabilityRequest, n).status.state
+            == REQUEST_STATE_RUNNING
+            and sum(len(r.device_ids)
+                    for r in store.get(ComposabilityRequest, n)
+                    .status.resources.values()) == size
+            for n, size in (("wave-a", 8), ("wave-b", 4))
+        )
+    except Exception:
+        return False
+
+
+def _delete_wave(store):
+    for name in ("wave-a", "wave-b"):
+        try:
+            store.delete(ComposabilityRequest, name)
+        except Exception:
+            pass
+
+
+def _all_gone(store):
+    return (
+        store.try_get(ComposabilityRequest, "wave-a") is None
+        and store.try_get(ComposabilityRequest, "wave-b") is None
+        and not store.list(ComposableResource)
+    )
+
+
+def _assert_converged_running(store, pool):
+    """Post-restart attach convergence: Running, intents retired, chips
+    conserved, accounting identical to an uninterrupted run (zeros — no
+    fabric fault was ever injected)."""
+    for res in store.list(ComposableResource):
+        assert res.status.pending_op is None, res.status.to_dict()
+        assert res.status.attach_attempts == 0, res.status.to_dict()
+        assert not res.status.quarantined, res.status.to_dict()
+    attached = len(pool.get_resources())
+    assert attached == 12, f"expected 12 attached chips, fabric has {attached}"
+    assert pool.free_chips("tpu-v4") == 64 - 12  # conservation: no leak/double
+    assert not [r for r in store.list(DeviceTaintRule)
+                if is_node_quarantine_marker(r)]
+
+
+def _assert_converged_empty(store, pool):
+    assert pool.get_resources() == [], "leaked fabric attachments"
+    assert pool.free_chips("tpu-v4") == 64
+    assert not [r for r in store.list(DeviceTaintRule)
+                if is_node_quarantine_marker(r)]
+
+
+def _crash_seed():
+    raw = os.environ.get("CRASH_SEED", "")
+    if raw == "random":
+        seed = random.SystemRandom().randrange(1 << 30)
+    elif raw:
+        seed = int(raw)
+    else:
+        seed = 20260803  # fixed CI seed; CRASH_SEED overrides
+    print(f"\ncrash-soak seed: {seed}")
+    return seed
+
+
+CONFIGS = [
+    # (cached reads, batched fabric, fabric async steps)
+    pytest.param(False, False, 0, id="direct-sync"),
+    pytest.param(True, False, 0, id="cached-sync"),
+    pytest.param(False, True, 1, id="batched-async"),
+    pytest.param(True, True, 1, id="cached-batched-async"),
+]
+
+CYCLES_PER_CONFIG = 4  # 2 crash points per cycle x 4 configs = 32 total
+
+
+@pytest.mark.slow
+@pytest.mark.crash
+class TestKillRestartSoak:
+    @pytest.mark.parametrize("cached,batched,async_steps", CONFIGS)
+    def test_randomized_crash_points_converge(self, cached, batched,
+                                              async_steps):
+        rng = random.Random(_crash_seed() ^ hash((cached, batched)))
+        quarantined_before = resources_quarantined_total.total()
+
+        # Control run: uninterrupted attach + detach wave. Yields the
+        # operator write counts that bound the fuse distribution AND the
+        # accounting baseline the crash runs must match bit-for-bit.
+        store = _fresh_world()
+        pool = RecordingPool(async_steps=async_steps)
+        inc = Incarnation(store, pool, cached=cached, batched=batched)
+        try:
+            _submit_wave(store)
+            assert wait_for(lambda: _all_running(store)), "control attach"
+            w_attach = inc.fuse.mutations
+            _assert_converged_running(store, pool)
+            _delete_wave(store)
+            assert wait_for(lambda: _all_gone(store)), "control detach"
+            w_detach = inc.fuse.mutations - w_attach
+            _assert_converged_empty(store, pool)
+            assert_no_double_attach(pool.events)
+        finally:
+            inc.kill()
+        assert w_attach > 5 and w_detach > 5  # fuse range is meaningful
+
+        for cycle in range(CYCLES_PER_CONFIG):
+            f_attach = rng.randint(1, w_attach)
+            f_detach = rng.randint(1, w_detach)
+            store = _fresh_world()
+            pool = RecordingPool(async_steps=async_steps)
+
+            # -- attach wave, crash at write #f_attach -------------------
+            inc = Incarnation(store, pool, cached=cached, batched=batched,
+                              fuse=f_attach)
+            _submit_wave(store)
+            wait_for(lambda: inc.fuse.dead.is_set() or _all_running(store),
+                     timeout=15)
+            inc.kill()
+
+            # -- restart: adoption + reconcile must finish the wave ------
+            inc = Incarnation(store, pool, cached=cached, batched=batched)
+            try:
+                assert wait_for(lambda: _all_running(store), timeout=30), (
+                    f"[{cycle}] attach crash at write {f_attach} never "
+                    f"converged: " + repr([
+                        r.status.to_dict()
+                        for r in store.list(ComposableResource)]))
+                _assert_converged_running(store, pool)
+                assert_no_double_attach(pool.events)
+            finally:
+                inc.kill()
+
+            # -- detach wave, crash at write #f_detach -------------------
+            inc = Incarnation(store, pool, cached=cached, batched=batched,
+                              fuse=f_detach)
+            _delete_wave(store)
+            wait_for(lambda: inc.fuse.dead.is_set() or _all_gone(store),
+                     timeout=15)
+            inc.kill()
+
+            # -- restart: teardown must finish with zero leaks -----------
+            inc = Incarnation(store, pool, cached=cached, batched=batched)
+            try:
+                _delete_wave(store)  # re-issue: the crash may predate them
+                assert wait_for(lambda: _all_gone(store), timeout=30), (
+                    f"[{cycle}] detach crash at write {f_detach} never "
+                    f"converged: " + repr([
+                        r.status.to_dict()
+                        for r in store.list(ComposableResource)]))
+                assert wait_for(
+                    lambda: pool.get_resources() == [], timeout=15
+                ), "leaked fabric attachments after detach-crash restart"
+                _assert_converged_empty(store, pool)
+                assert_no_double_attach(pool.events)
+                # Orphan trackers for transient windows must drain too.
+                assert wait_for(lambda: not [
+                    r for r in store.list(DeviceTaintRule)
+                    if is_orphan_tracker(r)], timeout=10)
+            finally:
+                inc.kill()
+
+        # Budget/quarantine parity with the uninterrupted run: identical
+        # (zero) across every crash cycle of this config.
+        assert resources_quarantined_total.total() == quarantined_before
+
+
+# ----------------------------------------------------------------------
+# graceful drain (the acceptance's other half)
+# ----------------------------------------------------------------------
+class TestGracefulDrain:
+    def test_shutdown_drains_inflight_then_releases_lease(self, store):
+        """stop() with in-flight fabric ops completes them (and their
+        status writes) within --drain-timeout, and releases the leader
+        lease only AFTER the drain."""
+        for i in range(2):
+            n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+            n.status.tpu_slots = 4
+            store.create(n)
+        pool = RecordingPool(async_steps=3)
+        agent = FakeNodeAgent(pool=pool)
+        dispatcher = FabricDispatcher(pool, batch_window=0.01,
+                                      poll_interval=0.02)
+        elector = LeaseElector(store, identity="drainer",
+                               lease_duration_s=5.0, renew_period_s=0.5)
+        order = []
+        real_drain = dispatcher.drain
+        real_release = elector.release
+        dispatcher.drain = lambda t: (order.append("drain"),
+                                      real_drain(t))[1]
+        elector.release = lambda: (order.append("release"),
+                                   real_release())[1]
+        mgr = Manager(store=store, leader_elector=elector,
+                      dispatcher=dispatcher, drain_timeout=8.0)
+        mgr.add_controller(ComposabilityRequestReconciler(
+            store, pool,
+            timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.05)))
+        mgr.add_controller(ComposableResourceReconciler(
+            store, pool, agent,
+            timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
+                                  detach_poll=0.05, detach_fast=0.05),
+            dispatcher=dispatcher))
+        mgr.add_runnable(dispatcher.run)
+        mgr.start(workers_per_controller=2)
+        stopped = False
+        try:
+            store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name="job"),
+                spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                    type="tpu", model="tpu-v4", size=8)),
+            ))
+            # Catch the wave mid-flight: at least one fabric op live.
+            assert wait_for(
+                lambda: any(
+                    dispatcher.op_state("add", r.metadata.name) is not None
+                    for r in store.list(ComposableResource)),
+                timeout=10,
+            ), "wave never reached the dispatcher"
+            mgr.stop()
+            stopped = True
+            assert order and order[0] == "drain"
+            assert "release" in order and order.index("release") > 0
+            # Drained clean: every submitted op settled AND its outcome was
+            # consumed by a reconcile that persisted the result.
+            assert dispatcher._ops == {} and dispatcher._done == {}
+            for res in store.list(ComposableResource):
+                assert res.status.pending_op is None, res.status.to_dict()
+                assert res.status.device_ids, res.status.to_dict()
+        finally:
+            if not stopped:
+                mgr.stop()
+
+    def test_deposed_leader_skips_drain_before_watchdog_notices(self, store):
+        """Fencing reads LIVE leadership, not the lagging watchdog flag: a
+        lease that expired moments before stop() must skip the drain even
+        when lost_leadership has not been set yet."""
+        dispatcher = FabricDispatcher(InMemoryPool(), batch_window=0.01)
+        elector = LeaseElector(store, identity="deposed",
+                               lease_duration_s=5.0, renew_period_s=1.0)
+        drained = []
+        dispatcher.drain = lambda t: (drained.append(t), True)[1]
+        mgr = Manager(store=store, leader_elector=elector,
+                      dispatcher=dispatcher, drain_timeout=8.0)
+        mgr.add_runnable(dispatcher.run)
+        mgr.start()
+        try:
+            assert elector.is_leader
+            # Depose without the manager noticing (watchdog polls at 1 Hz;
+            # stop() races it after a partition).
+            elector._leading = False
+            assert not mgr.lost_leadership  # the flag lags — that's the bug
+            mgr.stop()
+            assert drained == [], (
+                "deposed leader drained (drove the fabric) after losing"
+                " the lease"
+            )
+        finally:
+            mgr.stop()
+            dispatcher.kill()
+
+    def test_drain_timeout_reports_and_leaves_durable_intent(self):
+        """A fabric that never answers can't block shutdown past the
+        deadline; the durable intent is the successor's to adopt."""
+        gate = threading.Event()
+
+        class StuckPool(InMemoryPool):
+            def add_resource(self, resource):
+                gate.wait(10)
+                return super().add_resource(resource)
+
+        pool = StuckPool()
+        dispatcher = FabricDispatcher(pool, batch_window=0.0)
+        res = ComposableResource(metadata=ObjectMeta(name="r0"))
+        res.spec.type, res.spec.model = "tpu", "tpu-v4"
+        res.spec.target_node, res.spec.chip_count = "worker-0", 1
+        from tpu_composer.fabric.provider import DispatchedAttaching
+
+        with pytest.raises(DispatchedAttaching):
+            dispatcher.add_resource(res)
+        t0 = time.monotonic()
+        assert dispatcher.drain(0.3) is False
+        assert time.monotonic() - t0 < 5.0
+        gate.set()
+        dispatcher.kill()
+
+    def test_draining_dispatcher_rejects_new_submissions(self):
+        """The drain window admits no NEW fabric mutations: late
+        submissions get the dispatch sentinel and re-drive after restart."""
+        pool = InMemoryPool()
+        dispatcher = FabricDispatcher(pool, batch_window=0.0)
+        dispatcher.start()
+        assert dispatcher.drain(0.2) is True  # empty: drains instantly
+        res = ComposableResource(metadata=ObjectMeta(name="late"))
+        res.spec.type, res.spec.model = "tpu", "tpu-v4"
+        res.spec.target_node, res.spec.chip_count = "worker-0", 1
+        from tpu_composer.fabric.provider import DispatchedAttaching
+
+        with pytest.raises(DispatchedAttaching, match="draining"):
+            dispatcher.add_resource(res)
+        assert pool.get_resources() == []  # nothing reached the fabric
+        dispatcher.stop()

@@ -662,3 +662,50 @@ class TestChaosSoakBatched:
             assert pool.free_chips("gpu-a100") == 8  # no leaks across the soak
         finally:
             disp.stop()
+
+
+class TestStopFlush:
+    """In-process stop/start (manager restart without process exit) must not
+    silently strand a completed attach result: stop() flushes unfired
+    on_ready latches so the controller gets its immediate requeue; kill()
+    (the SIGKILL analog the crash harness uses) abandons everything."""
+
+    def test_stop_fires_latch_of_parked_outcome(self, pool):
+        disp = new_dispatcher(pool)
+        woke = threading.Event()
+        with pytest.raises(DispatchedAttaching):
+            disp.add_resource(cr("r0"), on_ready=lambda: woke.set())
+        assert drain(disp, "add", "r0") == "done"
+        woke.clear()  # completion fired it once; nobody consumed the result
+        disp.stop()
+        assert woke.is_set(), "parked outcome's latch lost on stop()"
+
+    def test_stop_fires_latch_of_queued_op(self):
+        # Window long enough that the op is still queued at stop time.
+        pool = RecordingPool(chips={"gpu-a100": 4})
+        disp = FabricDispatcher(pool, batch_window=30.0)
+        disp.start()
+        woke = threading.Event()
+        with pytest.raises(DispatchedAttaching):
+            disp.add_resource(cr("r0"), on_ready=lambda: woke.set())
+        assert disp.op_state("add", "r0") == "queued"
+        disp.stop()
+        assert woke.is_set(), "queued submission's latch lost on stop()"
+        assert pool.log == []  # never reached the provider
+
+    def test_kill_abandons_latches(self, pool):
+        disp = new_dispatcher(pool)
+        woke = threading.Event()
+        with pytest.raises(DispatchedAttaching):
+            disp.add_resource(cr("r0"), on_ready=lambda: woke.set())
+        assert drain(disp, "add", "r0") == "done"
+        woke.clear()
+        disp.kill()
+        assert not woke.is_set(), "kill() must model SIGKILL: no flush"
+        assert disp.op_state("add", "r0") is None
+
+    def test_post_stop_submission_raises_dispatch_sentinel(self, pool):
+        disp = new_dispatcher(pool)
+        disp.stop()
+        with pytest.raises(DispatchedAttaching, match="stopped"):
+            disp.add_resource(cr("r0"))

@@ -264,6 +264,139 @@ class TestDeposedManagerStopsDriving:
             mgr.stop()
 
 
+class TestLeaderFailoverUnderLoad:
+    """Satellite (ISSUE 5): hard-kill the leader mid-attach-wave. The
+    standby must steal the expired lease, run the cold-start adoption pass
+    over the dead leader's durable ``pending_op`` intents, and finish the
+    wave — zero leaks, zero double-attaches, budget accounting untouched.
+
+    The kill is a real crash analog (the soak harness's model): the dead
+    replica's store writes stop landing mid-stream and its dispatcher
+    abandons lanes without flushing; the lease is never released, so
+    failover happens only through expiry."""
+
+    def _replica(self, raw_store, pool, ident, reports):
+        from tests.test_crash_restart import CrashFuse
+        from tpu_composer.controllers.adoption import adopt_pending_ops
+        from tpu_composer.fabric.dispatcher import FabricDispatcher
+
+        fuse = CrashFuse(raw_store)
+        dispatcher = FabricDispatcher(pool, batch_window=0.01,
+                                      concurrency=4, poll_interval=0.05)
+        mgr = Manager(
+            store=fuse,
+            leader_elector=LeaseElector(
+                fuse, identity=ident,
+                lease_duration_s=1.0, renew_period_s=0.2,
+            ),
+            dispatcher=dispatcher,
+            drain_timeout=0.0,  # crash path: adoption, not drain
+        )
+        mgr.add_startup_hook(
+            lambda: reports.append(
+                (ident, adopt_pending_ops(fuse, pool, dispatcher))
+            )
+        )
+        mgr.add_controller(ComposabilityRequestReconciler(
+            fuse, pool, timing=RequestTiming(updating_poll=0.05,
+                                             cleaning_poll=0.05)))
+        mgr.add_controller(ComposableResourceReconciler(
+            fuse, pool, FakeNodeAgent(pool=pool),
+            timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
+                                  detach_poll=0.05, detach_fast=0.05,
+                                  busy_poll=0.05),
+            dispatcher=dispatcher))
+        mgr.add_runnable(dispatcher.run)
+        return mgr, fuse, dispatcher
+
+    def test_standby_adopts_pending_intents_mid_wave(self, store):
+        from tests.test_crash_restart import (
+            RecordingPool,
+            assert_no_double_attach,
+        )
+        from tpu_composer.api import ComposableResource
+
+        for i in range(2):
+            n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+            n.status.tpu_slots = 4
+            store.create(n)
+        # async_steps=3: each attach needs three fabric re-polls after
+        # submission, guaranteeing a wide mid-flight window to kill in.
+        pool = RecordingPool(async_steps=3)
+        reports = []
+        m1, fuse1, disp1 = self._replica(store, pool, "leader", reports)
+        m2, fuse2, disp2 = self._replica(store, pool, "standby", reports)
+        m1.start(workers_per_controller=2)
+        t2 = threading.Thread(target=m2.start,
+                              kwargs={"workers_per_controller": 2},
+                              daemon=True)
+        t2.start()
+        try:
+            assert wait_for(lambda: m1._elector.is_leader, timeout=5)
+            assert not m2._elector.is_leader
+
+            store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name="wave"),
+                spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                    type="tpu", model="tpu-v4", size=8)),
+            ))
+            # Durable intent on the wire — the wave is mid-flight.
+            assert wait_for(
+                lambda: any(r.status.pending_op is not None
+                            for r in store.list(ComposableResource)),
+                timeout=10,
+            ), "no pending_op intent ever persisted"
+
+            # SIGKILL analog on the leader: writes stop landing, the
+            # dispatcher abandons everything, the lease is NOT released.
+            fuse1.die()
+            disp1.kill()
+
+            assert wait_for(lambda: m2._elector.is_leader, timeout=10), (
+                "standby never stole the expired lease"
+            )
+            assert wait_for(
+                lambda: any(i == "standby" for i, _ in reports), timeout=5
+            ), "standby never ran the adoption pass"
+            standby_reports = [r for ident, r in reports
+                               if ident == "standby"]
+            assert standby_reports[0].total >= 1, (
+                "standby's adoption pass saw no pending intents — the kill"
+                " missed the wave"
+            )
+
+            def converged():
+                req = store.try_get(ComposabilityRequest, "wave")
+                return (
+                    req is not None
+                    and req.status.state == "Running"
+                    and sum(len(r.device_ids)
+                            for r in req.status.resources.values()) == 8
+                )
+            assert wait_for(converged, timeout=30), (
+                "standby never converged the adopted wave: " + repr([
+                    r.status.to_dict()
+                    for r in store.list(ComposableResource)])
+            )
+            for res in store.list(ComposableResource):
+                assert res.status.pending_op is None, res.status.to_dict()
+                assert res.status.attach_attempts == 0, res.status.to_dict()
+                assert not res.status.quarantined, res.status.to_dict()
+            assert len(pool.get_resources()) == 8
+            assert pool.free_chips("tpu-v4") == 64 - 8  # no leak, no double
+            assert_no_double_attach(pool.events)
+        finally:
+            fuse1.die()
+            disp1.kill()
+            try:
+                m1.stop()
+            except Exception:
+                pass  # dead store: release can't land, like a real crash
+            m2.stop()
+            disp2.kill()
+            t2.join(timeout=5)
+
+
 class TestLeaseOnKubeStore:
     """The cluster path: Lease CAS through the apiserver wire protocol."""
 

@@ -145,3 +145,208 @@ class TestOrphanOnDeadNode:
         assert pool.get_resources() == []
         # converged: no more detach-CRs get created
         assert syncer.sync_once(now=400.0) == 0
+
+
+class TestExplicitDeviceType:
+    """Satellite (ISSUE 5): the detach-CR's device type comes from the
+    fabric's explicit ``FabricDevice.type``, not a model-name prefix sniff
+    — the sniff survives only as the fallback for providers that predate
+    the field."""
+
+    def test_explicit_type_wins_over_model_name(self, world):
+        store, _, _ = world
+        # A TPU whose marketing name doesn't start with "tpu": the sniff
+        # would misclassify it as gpu; the explicit type must not.
+        pool = InMemoryPool(chips={"trillium": 4})
+        syncer = UpstreamSyncer(store, pool, grace=10.0)
+        leaked = pool.leak_attachment("worker-1", "trillium", type="tpu")
+        syncer.sync_once(now=0.0)
+        assert syncer.sync_once(now=100.0) == 1
+        (cr,) = store.list(ComposableResource)
+        assert cr.metadata.labels[LABEL_READY_TO_DETACH] == leaked
+        assert cr.spec.type == "tpu"
+        assert cr.spec.model == "trillium"
+
+    def test_model_sniff_is_only_the_fallback(self, world):
+        store, pool, syncer = world
+        from tpu_composer.fabric.provider import FabricDevice
+
+        dev = FabricDevice(device_id="x", node="worker-1", model="tpu-v4")
+        assert dev.type == ""  # legacy provider: field absent
+        assert syncer._create_detach_cr(dev)
+        (cr,) = store.list(ComposableResource)
+        assert cr.spec.type == "tpu"  # sniffed, as before
+
+
+class TestDurableOrphanGrace:
+    """Satellite (ISSUE 5): the orphan first-seen timestamp is persisted,
+    so a controller restart RESUMES the 10-min grace clock instead of
+    resetting it — a crash-loop can no longer defer leak reclamation
+    forever."""
+
+    def test_first_seen_persisted_as_tracker(self, world):
+        store, pool, syncer = world
+        from tpu_composer.api.dra import DeviceTaintRule
+        from tpu_composer.api.types import ANNOTATION_ORPHAN_FIRST_SEEN
+        from tpu_composer.controllers.syncer import (
+            is_orphan_tracker,
+            orphan_tracker_name,
+        )
+
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        syncer.sync_once(now=0.0)
+        rule = store.get(DeviceTaintRule, orphan_tracker_name(leaked))
+        assert is_orphan_tracker(rule)
+        assert rule.spec.device_uuid == leaked
+        assert rule.metadata.annotations[ANNOTATION_ORPHAN_FIRST_SEEN]
+        # Scheduling-inert: never a whole-node quarantine marker.
+        from tpu_composer.agent.publisher import (
+            is_node_quarantine_marker,
+            quarantined_nodes,
+        )
+
+        assert not is_node_quarantine_marker(rule)
+        assert quarantined_nodes(store) == set()
+
+    def test_restart_resumes_grace_clock(self, world):
+        """A device already aged past grace at restart is reclaimed on the
+        NEW syncer's first pass — no fresh 10-minute wait."""
+        store, pool, syncer = world
+        import time as _time
+
+        from tpu_composer.api.dra import DeviceTaintRule
+        from tpu_composer.api.types import ANNOTATION_ORPHAN_FIRST_SEEN
+        from tpu_composer.controllers.syncer import orphan_tracker_name
+
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        syncer.sync_once(now=0.0)  # persists first-seen
+        # Age the durable record past the grace window (grace=100 in the
+        # fixture), as if the crash-loop had been churning for 150 s.
+        rule = store.get(DeviceTaintRule, orphan_tracker_name(leaked))
+        stamp = (
+            __import__("datetime").datetime.fromtimestamp(
+                _time.time() - 150.0, __import__("datetime").timezone.utc
+            ).isoformat().replace("+00:00", "Z")
+        )
+        rule.metadata.annotations[ANNOTATION_ORPHAN_FIRST_SEEN] = stamp
+        store.update(rule)
+
+        fresh = UpstreamSyncer(store, pool, grace=100.0)  # the restart
+        assert fresh.sync_once(now=1000.0) == 1, (
+            "restart reset the grace clock instead of resuming it"
+        )
+        (cr,) = store.list(ComposableResource)
+        assert cr.metadata.labels[LABEL_READY_TO_DETACH] == leaked
+        # Tracker retired with the reclamation.
+        assert store.try_get(
+            DeviceTaintRule, orphan_tracker_name(leaked)) is None
+
+    def test_restart_without_aging_still_waits_out_grace(self, world):
+        store, pool, syncer = world
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        syncer.sync_once(now=0.0)
+        fresh = UpstreamSyncer(store, pool, grace=100.0)
+        assert fresh.sync_once(now=0.0) == 0  # age ~0: grace still runs
+        assert leaked in fresh.tracked_missing
+
+    def test_reappeared_owner_drops_tracker(self, world):
+        store, pool, syncer = world
+        from tpu_composer.api.dra import DeviceTaintRule
+        from tpu_composer.controllers.syncer import orphan_tracker_name
+
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        syncer.sync_once(now=0.0)
+        assert store.try_get(
+            DeviceTaintRule, orphan_tracker_name(leaked)) is not None
+        cr = ComposableResource(metadata=ObjectMeta(name="late-owner"))
+        cr.spec.type, cr.spec.model, cr.spec.target_node = (
+            "tpu", "tpu-v4", "worker-1")
+        store.create(cr)
+        got = store.get(ComposableResource, "late-owner")
+        got.status.device_ids = [leaked]
+        store.update_status(got)
+        syncer.sync_once(now=50.0)
+        assert store.try_get(
+            DeviceTaintRule, orphan_tracker_name(leaked)) is None
+
+    def test_unreadable_stamp_restarts_clock_but_keeps_tracking(self, world):
+        store, pool, syncer = world
+        from tpu_composer.api.dra import DeviceTaintRule
+        from tpu_composer.api.types import ANNOTATION_ORPHAN_FIRST_SEEN
+        from tpu_composer.controllers.syncer import orphan_tracker_name
+
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        syncer.sync_once(now=0.0)
+        rule = store.get(DeviceTaintRule, orphan_tracker_name(leaked))
+        rule.metadata.annotations[ANNOTATION_ORPHAN_FIRST_SEEN] = "not-a-time"
+        store.update(rule)
+        fresh = UpstreamSyncer(store, pool, grace=100.0)
+        assert fresh.sync_once(now=0.0) == 0
+        assert leaked in fresh.tracked_missing  # tracked, clock restarted
+        assert fresh.sync_once(now=150.0) == 1  # and still reclaims
+
+    def test_failed_tracker_load_is_retried_next_tick(self, world):
+        """A transient list failure on the first tick must not permanently
+        disable clock resumption: the next tick retries the load and the
+        durable age still wins over the reset in-memory clock."""
+        store, pool, syncer = world
+        import time as _time
+
+        from tpu_composer.api.dra import DeviceTaintRule
+        from tpu_composer.api.types import ANNOTATION_ORPHAN_FIRST_SEEN
+        from tpu_composer.controllers.syncer import orphan_tracker_name
+        from tpu_composer.runtime.chaosstore import ChaosStore
+
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        syncer.sync_once(now=0.0)  # persists the first-seen record
+        rule = store.get(DeviceTaintRule, orphan_tracker_name(leaked))
+        stamp = (
+            __import__("datetime").datetime.fromtimestamp(
+                _time.time() - 150.0, __import__("datetime").timezone.utc
+            ).isoformat().replace("+00:00", "Z")
+        )
+        rule.metadata.annotations[ANNOTATION_ORPHAN_FIRST_SEEN] = stamp
+        store.update(rule)
+
+        chaos = ChaosStore(store)
+        chaos.fail_verb("list", 1)  # the restart's tracker load fails
+        fresh = UpstreamSyncer(chaos, pool, grace=100.0)
+        assert fresh.sync_once(now=1000.0) == 0  # load failed; clock reset
+        # Next tick: the load retry lands and the 150 s durable age
+        # (> grace 100) reclaims immediately — no fresh grace wait.
+        assert fresh.sync_once(now=1001.0) == 1, (
+            "one transient list failure permanently disabled clock resume"
+        )
+
+    def test_failed_tracker_persist_is_retried_backdated(self, world):
+        """A transient create failure when a device is first seen missing
+        must be retried on later ticks, back-dated to the in-memory
+        first-seen time — not silently skipped forever."""
+        store, pool, _ = world
+        import time as _time
+
+        from tpu_composer.api.dra import DeviceTaintRule
+        from tpu_composer.api.meta import parse_iso
+        from tpu_composer.api.types import ANNOTATION_ORPHAN_FIRST_SEEN
+        from tpu_composer.controllers.syncer import orphan_tracker_name
+        from tpu_composer.runtime.chaosstore import ChaosStore
+
+        chaos = ChaosStore(store)
+        syncer = UpstreamSyncer(chaos, pool, grace=100.0)
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        chaos.fail_verb("create", 1)
+        syncer.sync_once(now=0.0)  # first sighting; persist fails
+        assert store.try_get(
+            DeviceTaintRule, orphan_tracker_name(leaked)) is None
+        syncer.sync_once(now=40.0)  # retry lands, back-dated 40 s
+        rule = store.get(DeviceTaintRule, orphan_tracker_name(leaked))
+        age = _time.time() - parse_iso(
+            rule.metadata.annotations[ANNOTATION_ORPHAN_FIRST_SEEN]
+        ).timestamp()
+        assert 35.0 <= age <= 60.0, (
+            f"stamp not back-dated to first-seen (age {age:.1f}s, want ~40)"
+        )
+        # No further re-stamping once persisted.
+        syncer.sync_once(now=50.0)
+        rule2 = store.get(DeviceTaintRule, orphan_tracker_name(leaked))
+        assert rule2.metadata.annotations == rule.metadata.annotations

@@ -104,7 +104,23 @@ _RESOURCE_STATUS = _obj(
         "worker_id": _int(),
         "error": _str(),
         "quarantined": _bool("Attach budget exhausted on this member"),
+        "pending_verb": _str(
+            "Verb of the member's in-flight fabric op (add/remove; empty"
+            " when settled)"
+        ),
     }
+)
+
+_PENDING_OP = _obj(
+    {
+        "verb": _str(enum=["add", "remove"]),
+        "nonce": _str("Unique per issued intent; survives crash/retry"),
+        "node": _str(),
+        "started_at": _str(),
+    },
+    desc="Durable fabric-mutation intent written before the attach/detach"
+    " is issued and cleared when its outcome is recorded; the cold-start"
+    " adoption pass reconstructs in-flight work from this after a crash.",
 )
 
 _SLICE_STATUS = _obj(
@@ -188,6 +204,7 @@ COMPOSABLE_RESOURCE_SCHEMA = _obj(
                 "quarantined": _bool(
                     "Attach budget exhausted; owner must reallocate"
                 ),
+                "pending_op": _PENDING_OP,
             }
         ),
     }

@@ -75,6 +75,11 @@ FINALIZER = "tpu.composer.dev/finalizer"  # analog of com.ie.ibm.hpsys/finalizer
 # Annotations (reference: cohdi.io/* at composabilityrequest_controller.go:46-47)
 ANNOTATION_LAST_USED_TIME = "tpu.composer.dev/last-used-time"
 ANNOTATION_DELETE_DEVICE = "tpu.composer.dev/delete-device"
+# Wall-clock ISO timestamp on the syncer's orphan tracking objects: the
+# first time the fabric reported a device with no local owner. Persisted so
+# a controller restart cannot reset the orphan grace window (crash-loops
+# would otherwise defer leak reclamation indefinitely).
+ANNOTATION_ORPHAN_FIRST_SEEN = "tpu.composer.dev/orphan-first-seen"
 LABEL_MANAGED_BY = "app.kubernetes.io/managed-by"
 LABEL_READY_TO_DETACH = "tpu.composer.dev/ready-to-detach-device-id"
 
@@ -236,6 +241,47 @@ class ComposabilityRequestSpec:
 
 
 @dataclass
+class PendingOp:
+    """Durable record of a fabric mutation in flight for one resource.
+
+    Written into ComposableResource.status BEFORE the attach/detach reaches
+    the fabric, cleared when its outcome is recorded — so the *intent*
+    survives a controller crash even when the in-memory dispatcher lanes and
+    parked outcomes do not. The cold-start adoption pass
+    (controllers/adoption.py) diffs these records against
+    ``fabric.get_resources()`` to classify every in-flight op after a
+    restart. No reference analog: the reference loses all in-flight intent
+    on restart and leans entirely on its 30 s requeues + 10 min orphan
+    grace to re-converge.
+    """
+
+    verb: str = ""  # "add" | "remove"
+    #: Unique per issued intent; an op re-driven after a crash keeps its
+    #: nonce, so a fabric mutation can be traced to exactly one intent
+    #: (the kill–restart harness asserts zero double-attach on this).
+    nonce: str = ""
+    node: str = ""
+    started_at: str = ""  # wall-clock ISO (monotonic clocks die with the process)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "verb": self.verb,
+            "nonce": self.nonce,
+            "node": self.node,
+            "started_at": self.started_at,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PendingOp":
+        return cls(
+            verb=d.get("verb", ""),
+            nonce=d.get("nonce", ""),
+            node=d.get("node", ""),
+            started_at=d.get("started_at", ""),
+        )
+
+
+@dataclass
 class ResourceStatus:
     """Per-child summary folded into the request status.
 
@@ -250,6 +296,11 @@ class ResourceStatus:
     worker_id: int = -1
     error: str = ""
     quarantined: bool = False
+    # Verb of the child's in-flight fabric op ("add"/"remove", "" when
+    # settled) — surfaced so an operator watching the request can see which
+    # members still have fabric mutations outstanding (and a drain/restart
+    # can be judged from the parent object alone).
+    pending_verb: str = ""
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"state": self.state}
@@ -265,6 +316,8 @@ class ResourceStatus:
             d["error"] = self.error
         if self.quarantined:
             d["quarantined"] = True
+        if self.pending_verb:
+            d["pending_verb"] = self.pending_verb
         return d
 
     @classmethod
@@ -277,6 +330,7 @@ class ResourceStatus:
             worker_id=int(d.get("worker_id", -1)),
             error=d.get("error", ""),
             quarantined=bool(d.get("quarantined", False)),
+            pending_verb=d.get("pending_verb", ""),
         )
 
 
@@ -457,6 +511,10 @@ class ComposableResourceStatus:
     # Persisted in status so the budget survives controller restarts.
     attach_attempts: int = 0
     quarantined: bool = False
+    # Durable fabric-mutation intent (crash consistency): set before the
+    # attach/detach is issued, cleared when its outcome lands in status.
+    # The cold-start adoption pass reconstructs in-flight work from this.
+    pending_op: Optional[PendingOp] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"state": self.state}
@@ -472,10 +530,13 @@ class ComposableResourceStatus:
             d["attach_attempts"] = self.attach_attempts
         if self.quarantined:
             d["quarantined"] = True
+        if self.pending_op is not None:
+            d["pending_op"] = self.pending_op.to_dict()
         return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ComposableResourceStatus":
+        pending = d.get("pending_op")
         return cls(
             state=d.get("state", ""),
             error=d.get("error", ""),
@@ -484,6 +545,7 @@ class ComposableResourceStatus:
             chip_indices=[int(i) for i in d.get("chip_indices", [])],
             attach_attempts=int(d.get("attach_attempts", 0)),
             quarantined=bool(d.get("quarantined", False)),
+            pending_op=PendingOp.from_dict(pending) if pending else None,
         )
 
 

@@ -21,6 +21,11 @@ SURVEY.md §5 "Config / flag system"):
   TPUC_FABRIC_BATCH   "0" disables the FabricDispatcher (--no-fabric-batch
                       equivalent): attach/detach run as today's direct
                       blocking calls inside reconcile workers
+  TPUC_DRAIN_TIMEOUT  seconds a graceful shutdown drains in-flight fabric
+                      ops before releasing the lease (--drain-timeout)
+  TPUC_CHAOS_STORE_*  store-layer fault injection (FAILURE_RATE,
+                      CONFLICT_RATE, LATENCY, WATCH_DROP_RATE, SEED) —
+                      the apiserver twin of the fabric chaos knobs
 
 Run: ``python -m tpu_composer [flags]`` or ``python -m tpu_composer.cmd.main``.
 """
@@ -47,18 +52,30 @@ from tpu_composer.runtime.manager import Manager
 from tpu_composer.runtime.store import Store
 
 
-def _env_seconds(name: str, default: float) -> float:
-    """Env knob holding a number of seconds; a malformed value must die as
-    a clean startup error, not an argparse-construction traceback."""
+def _env_float(name: str, default: float) -> float:
+    """Env knob holding a number; a malformed value must die as a clean
+    startup error, not an argparse-construction traceback."""
     raw = os.environ.get(name, "")
     if not raw:
         return default
     try:
         return float(raw)
     except ValueError:
-        raise SystemExit(
-            f"bad {name}={raw!r}: expected seconds as a plain number"
-        )
+        raise SystemExit(f"bad {name}={raw!r}: expected a plain number")
+
+
+def _env_seconds(name: str, default: float) -> float:
+    return _env_float(name, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise SystemExit(f"bad {name}={raw!r}: expected an integer")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -164,6 +181,50 @@ def build_parser() -> argparse.ArgumentParser:
         help="dispatcher worker threads — concurrent fabric calls across"
              " nodes (per-node calls are always serialized FIFO; env"
              " TPUC_FABRIC_CONCURRENCY)",
+    )
+    p.add_argument(
+        "--drain-timeout",
+        type=float,
+        default=_env_seconds("TPUC_DRAIN_TIMEOUT", 8.0),
+        help="seconds a graceful shutdown (SIGTERM / leader handoff) waits"
+             " for in-flight fabric ops to settle and their outcomes to be"
+             " consumed before releasing the leader lease; <= 0 disables —"
+             " in-flight intent then recovers via the cold-start adoption"
+             " pass on the next start (env TPUC_DRAIN_TIMEOUT)",
+    )
+    # Store-layer chaos (runtime/chaosstore.py): the apiserver twin of the
+    # fabric chaos knobs; all default off. See docs/OPERATIONS.md for the
+    # watch-drop/cached-reads pairing caveat.
+    p.add_argument(
+        "--chaos-store-failure-rate", type=float,
+        default=_env_float("TPUC_CHAOS_STORE_FAILURE_RATE", 0.0),
+        help="probability each store call fails with a transient error"
+             " (fault-injection soaks only; env TPUC_CHAOS_STORE_FAILURE_RATE)",
+    )
+    p.add_argument(
+        "--chaos-store-conflict-rate", type=float,
+        default=_env_float("TPUC_CHAOS_STORE_CONFLICT_RATE", 0.0),
+        help="probability each mutating store call fails with a resource-"
+             "version conflict (env TPUC_CHAOS_STORE_CONFLICT_RATE)",
+    )
+    p.add_argument(
+        "--chaos-store-latency", type=float,
+        default=_env_seconds("TPUC_CHAOS_STORE_LATENCY", 0.0),
+        help="seconds of injected latency per store call"
+             " (env TPUC_CHAOS_STORE_LATENCY)",
+    )
+    p.add_argument(
+        "--chaos-store-watch-drop-rate", type=float,
+        default=_env_float("TPUC_CHAOS_STORE_WATCH_DROP_RATE", 0.0),
+        help="probability each watch event is dropped; pair with"
+             " --no-cached-reads (the informer has no periodic resync;"
+             " env TPUC_CHAOS_STORE_WATCH_DROP_RATE)",
+    )
+    p.add_argument(
+        "--chaos-store-seed", type=int,
+        default=_env_int("TPUC_CHAOS_STORE_SEED", 0),
+        help="RNG seed for the store chaos injector"
+             " (env TPUC_CHAOS_STORE_SEED)",
     )
     p.add_argument(
         "--workers",
@@ -299,11 +360,44 @@ def build_store(args: argparse.Namespace):
         log.info("store: kube-apiserver at %s", cfg.host)
         # KubeStore's reflector cache is the wire-path twin of the
         # standalone CachedClient — one flag governs both.
-        return KubeStore(
+        store = KubeStore(
             config=cfg, cache_reads=getattr(args, "cached_reads", True)
         )
-    log.info("store: standalone (state_dir=%s)", args.state_dir or "<memory>")
-    return Store(persist_dir=args.state_dir or None)
+    else:
+        log.info("store: standalone (state_dir=%s)",
+                 args.state_dir or "<memory>")
+        store = Store(persist_dir=args.state_dir or None)
+    return _maybe_chaos_store(args, store, log)
+
+
+def _maybe_chaos_store(args: argparse.Namespace, store, log):
+    """Wrap the store in the chaos injector when any knob is on — same
+    layer for the in-proc store and KubeStore (the faults land where wire
+    faults would: between every client and the canonical state)."""
+    rates = (
+        getattr(args, "chaos_store_failure_rate", 0.0),
+        getattr(args, "chaos_store_conflict_rate", 0.0),
+        getattr(args, "chaos_store_latency", 0.0),
+        getattr(args, "chaos_store_watch_drop_rate", 0.0),
+    )
+    if not any(r > 0 for r in rates):
+        return store
+    from tpu_composer.runtime.chaosstore import ChaosStore
+
+    log.warning(
+        "store chaos ON (failure=%.3f conflict=%.3f latency=%.3fs"
+        " watch_drop=%.3f seed=%d) — fault-injection mode",
+        rates[0], rates[1], rates[2], rates[3],
+        getattr(args, "chaos_store_seed", 0),
+    )
+    return ChaosStore(
+        store,
+        failure_rate=rates[0],
+        conflict_rate=rates[1],
+        latency=rates[2],
+        watch_drop_rate=rates[3],
+        seed=getattr(args, "chaos_store_seed", 0),
+    )
 
 
 def build_manager(args: argparse.Namespace) -> Manager:
@@ -327,9 +421,11 @@ def build_manager(args: argparse.Namespace) -> Manager:
         addr = "0.0.0.0" + addr
     elector = None
     if args.leader_elect:
+        from tpu_composer.runtime.chaosstore import ChaosStore
         from tpu_composer.runtime.store import Store as _InProcStore
 
-        if not isinstance(store, _InProcStore):
+        raw_store = store._inner if isinstance(store, ChaosStore) else store
+        if not isinstance(raw_store, _InProcStore):
             # Cluster mode: Lease-based election across replicas (reference
             # cmd/main.go:142-155); the file lock only fences one host.
             from tpu_composer.runtime.leases import LeaseElector
@@ -349,23 +445,6 @@ def build_manager(args: argparse.Namespace) -> Manager:
             "--metrics-token-file requires --metrics-cert/--metrics-key:"
             " bearer tokens must not transit plain HTTP"
         )
-    mgr = Manager(
-        store=client,
-        leader_elect=args.leader_elect,
-        leader_lock_path=args.leader_lock_path,
-        health_addr=addr,
-        leader_elector=elector,
-        metrics_addr=maddr,
-        metrics_certfile=args.metrics_cert or None,
-        metrics_keyfile=args.metrics_key or None,
-        metrics_token_file=args.metrics_token_file or None,
-    )
-    from tpu_composer.scheduler import ClusterScheduler, DefragLoop
-
-    scheduler = ClusterScheduler(client)
-    mgr.add_controller(ComposabilityRequestReconciler(client, fabric,
-                                                      recorder=mgr.recorder,
-                                                      scheduler=scheduler))
     dispatcher = None
     if getattr(args, "fabric_batch", True):
         from tpu_composer.fabric.dispatcher import FabricDispatcher
@@ -378,7 +457,37 @@ def build_manager(args: argparse.Namespace) -> Manager:
             batch_window=args.fabric_batch_window,
             concurrency=args.fabric_concurrency,
         )
+    mgr = Manager(
+        store=client,
+        leader_elect=args.leader_elect,
+        leader_lock_path=args.leader_lock_path,
+        health_addr=addr,
+        leader_elector=elector,
+        metrics_addr=maddr,
+        metrics_certfile=args.metrics_cert or None,
+        metrics_keyfile=args.metrics_key or None,
+        metrics_token_file=args.metrics_token_file or None,
+        dispatcher=dispatcher,
+        drain_timeout=getattr(args, "drain_timeout", 8.0),
+    )
+    if dispatcher is not None:
         mgr.add_runnable(dispatcher.run)
+    # Cold-start adoption (controllers/adoption.py): post-leader-acquire,
+    # pre-controller-start, every durable pending_op intent is classified
+    # against the live fabric — completed attaches are adopted into
+    # status, never-issued ops cleared for re-submission, fabric-async
+    # ops handed to the dispatcher's re-poll pass.
+    from tpu_composer.controllers.adoption import adopt_pending_ops
+
+    mgr.add_startup_hook(
+        lambda: adopt_pending_ops(client, fabric, dispatcher)
+    )
+    from tpu_composer.scheduler import ClusterScheduler, DefragLoop
+
+    scheduler = ClusterScheduler(client)
+    mgr.add_controller(ComposabilityRequestReconciler(client, fabric,
+                                                      recorder=mgr.recorder,
+                                                      scheduler=scheduler))
     res_rec = ComposableResourceReconciler(client, fabric, agent,
                                            recorder=mgr.recorder,
                                            dispatcher=dispatcher)

@@ -288,6 +288,14 @@ class ComposabilityRequestReconciler(Controller):
                 worker_id=child.spec.worker_id if child.spec.type == "tpu" else -1,
                 error=child.status.error,
                 quarantined=child.status.quarantined,
+                # Surface in-flight fabric intent on the parent: `kubectl
+                # get composabilityrequest -o yaml` answers "is any member
+                # still mutating the fabric?" without walking children —
+                # the question every drain/restart decision starts from.
+                pending_verb=(
+                    child.status.pending_op.verb
+                    if child.status.pending_op is not None else ""
+                ),
             )
             if rs is None or rs.to_dict() != new.to_dict():
                 req.status.resources[name] = new

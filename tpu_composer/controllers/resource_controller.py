@@ -37,12 +37,14 @@ reads surface as ``ConflictError`` → rate-limited requeue, unchanged.
 from __future__ import annotations
 
 import threading
+import uuid
 from dataclasses import dataclass
 from typing import List, Optional
 
 from tpu_composer.agent.cdi import generate_cdi_spec
 from tpu_composer.agent.nodeagent import AgentError, DeviceBusyError, NodeAgent
 from tpu_composer.agent.publisher import quarantined_nodes, retire_node
+from tpu_composer.api.meta import now_iso
 from tpu_composer.api.types import (
     ComposabilityRequest,
     ComposableResource,
@@ -50,6 +52,7 @@ from tpu_composer.api.types import (
     LABEL_MANAGED_BY,
     LABEL_READY_TO_DETACH,
     Node,
+    PendingOp,
     RESOURCE_STATE_ATTACHING,
     RESOURCE_STATE_DELETING,
     RESOURCE_STATE_DETACHING,
@@ -281,6 +284,10 @@ class ComposableResourceReconciler(Controller):
             if res is None:
                 return True  # finalizer-less object purged outright — done
         res.status.state = RESOURCE_STATE_DELETING
+        # Any fabric intent is moot: the node is gone and the fabric side
+        # is the syncer's to reclaim — a stale record would only make the
+        # next cold start probe a dead host.
+        res.status.pending_op = None
         try:
             self.store.update_status(res)
         except NotFoundError:
@@ -303,6 +310,12 @@ class ComposableResourceReconciler(Controller):
             # call — async providers (CM flavor) sit in it for whole
             # requeue cycles and operators watch it.
             res.status.state = RESOURCE_STATE_ATTACHING
+            # Durable attach intent rides the SAME write (crash
+            # consistency at zero extra RTT): this transition is strictly
+            # ordered before any fabric call, so a crash anywhere past
+            # this point leaves a record the cold-start adoption pass can
+            # classify against fabric.get_resources().
+            res.status.pending_op = self._new_intent("add", res)
         self.store.update_status(res)
         return Result(requeue_after=0.0 if not res.being_deleted else self.timing.detach_fast)
 
@@ -323,6 +336,14 @@ class ComposableResourceReconciler(Controller):
                 if res.status.device_ids or uncancellable_add
                 else RESOURCE_STATE_DELETING
             )
+            # Replace the attach intent: either a remove intent for the
+            # teardown about to run, or nothing (cancelled before the
+            # fabric saw it).
+            res.status.pending_op = (
+                self._new_intent("remove", res)
+                if res.status.state == RESOURCE_STATE_DETACHING
+                else None
+            )
             self.store.update_status(res)
             return Result(requeue_after=self.timing.detach_fast)
 
@@ -333,6 +354,12 @@ class ComposableResourceReconciler(Controller):
             return Result()
 
         self.agent.ensure_driver(res.spec.target_node)
+
+        if not res.status.device_ids:
+            # Fallback durability point (normally a no-op: "" -> Attaching
+            # already wrote the intent). Guards objects created directly in
+            # Attaching state and pre-intent objects from older versions.
+            res = self._ensure_intent(res, "add")
 
         try:
             attach = self._fabric_add(res)
@@ -371,6 +398,13 @@ class ComposableResourceReconciler(Controller):
         if changed:
             res.status.device_ids = list(attach.device_ids)
             res.status.cdi_device_id = attach.cdi_device_id
+        if res.status.pending_op is not None:
+            # Intent fulfilled: the attach outcome lands in status in the
+            # same write that retires the record (the crash window between
+            # fabric completion and this write is exactly what the
+            # adoption pass re-derives from the fabric listing).
+            res.status.pending_op = None
+            changed = True
         self._attach_streaks.pop(res.name, None)
         if res.status.attach_attempts:
             res.status.attach_attempts = 0  # streak broken by success
@@ -534,6 +568,10 @@ class ComposableResourceReconciler(Controller):
             self.publisher.create_taints(node, res.status.device_ids, "quarantine")
         res.status.quarantined = True
         res.status.error = msg
+        # Quarantine is terminal for the attach path: retire the intent so
+        # a restart's adoption pass never re-probes (let alone re-issues)
+        # an attach the budget machinery just gave up on.
+        res.status.pending_op = None
         self.store.update_status(res)
         resources_quarantined_total.inc(node=node)
         self.recorder.event(res, WARNING, "Quarantined", msg)
@@ -604,6 +642,31 @@ class ComposableResourceReconciler(Controller):
         )
         return slice_env(standalone, res.spec.worker_id, res.spec.model)
 
+    def _new_intent(self, verb: str, res: ComposableResource) -> PendingOp:
+        """Fresh durable intent record. The nonce identifies this logical
+        op across crash/retry cycles: re-driving an interrupted op keeps
+        the persisted nonce, so one fabric mutation traces to exactly one
+        intent (the kill–restart harness's double-attach check)."""
+        return PendingOp(
+            verb=verb,
+            nonce=uuid.uuid4().hex[:12],
+            node=res.spec.target_node,
+            started_at=now_iso(),
+        )
+
+    def _ensure_intent(
+        self, res: ComposableResource, verb: str
+    ) -> ComposableResource:
+        """Make sure a durable ``pending_op`` record for ``verb`` exists
+        BEFORE the fabric sees the op. No-op (no write) when the record is
+        already present — the state-transition writes normally carry it,
+        so this costs a round trip only on unusual entry paths."""
+        po = res.status.pending_op
+        if po is not None and po.verb == verb:
+            return res
+        res.status.pending_op = self._new_intent(verb, res)
+        return self.store.update_status(res)
+
     def _fabric_add(self, res: ComposableResource):
         """Attach via the dispatcher (submit-and-return + completion latch)
         or inline when batching is disabled."""
@@ -652,6 +715,9 @@ class ComposableResourceReconciler(Controller):
                 if res is None:
                     return Result()  # already purged — nothing left to detach
             res.status.state = RESOURCE_STATE_DETACHING
+            # Durable detach intent rides the transition write, ordered
+            # before any fabric remove.
+            res.status.pending_op = self._new_intent("remove", res)
             try:
                 self.store.update_status(res)
             except NotFoundError:
@@ -706,6 +772,11 @@ class ComposableResourceReconciler(Controller):
             except DeviceBusyError:
                 return Result(requeue_after=self.timing.busy_poll)
 
+        # Fallback durability point (normally a no-op: every transition
+        # into Detaching piggybacks the remove intent on its own write).
+        if not remove_submitted:
+            res = self._ensure_intent(res, "remove")
+
         # 4. Fabric detach with wait sentinel (:372-378). DispatchedDetaching
         # (the dispatcher's submit-and-return acknowledgment) subclasses the
         # wait sentinel: same requeue, but completion re-enqueues this key
@@ -740,6 +811,7 @@ class ComposableResourceReconciler(Controller):
         res.status.cdi_device_id = ""
         res.status.chip_indices = []
         res.status.error = ""
+        res.status.pending_op = None  # detach outcome recorded; intent retired
         res.status.state = RESOURCE_STATE_DELETING
         try:
             self.store.update_status(res)

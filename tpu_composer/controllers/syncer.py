@@ -13,17 +13,28 @@ sub-second) and structured events. The store handle is normally the
 CachedClient (cmd/main ``--cached-reads``): the per-tick
 ComposableResource scan is an informer-cache read, so shrinking the sync
 period for fast leak reclaim no longer multiplies apiserver list load.
+
+Crash consistency: the reference tracks first-seen times in process memory,
+so every controller restart resets the 10-minute grace clock — under a
+crash-loop an orphaned device is never reclaimed. Here each newly-missing
+device also gets a durable tracking object (a ``DeviceTaintRule`` named
+``orphan-first-seen-<id>`` carrying the wall-clock first-seen annotation,
+scheduling-inert: its name never collides with ``taint_rule_name`` and it
+fails the whole-node-marker shape test), and a fresh syncer seeds its clock
+from those records.
 """
 
 from __future__ import annotations
 
+import datetime
 import threading
 import time
 from typing import Dict, Optional
 
-from tpu_composer.api.dra import DeviceTaintRule
-from tpu_composer.api.meta import ObjectMeta
+from tpu_composer.api.dra import DeviceTaintRule, DeviceTaintRuleSpec
+from tpu_composer.api.meta import ObjectMeta, parse_iso
 from tpu_composer.api.types import (
+    ANNOTATION_ORPHAN_FIRST_SEEN,
     ComposableResource,
     ComposableResourceSpec,
     LABEL_READY_TO_DETACH,
@@ -33,11 +44,29 @@ from tpu_composer.fabric.provider import FabricError, FabricProvider
 from tpu_composer.runtime.events import WARNING, EventRecorder
 from tpu_composer.runtime.store import (
     AlreadyExistsError,
+    NotFoundError,
     Store,
     StoreError,
 )
+from tpu_composer.topology.slices import is_tpu_model
 
 import logging
+
+#: Name prefix of the durable orphan-tracking objects. Distinct from both
+#: ``taint_rule_name``'s "quarantine-<uuid>" (per-device detach taints) and
+#: "quarantine-node-<node>" (whole-node markers) so no consumer of either
+#: ever picks a tracker up by mistake.
+ORPHAN_TRACKER_PREFIX = "orphan-first-seen-"
+
+
+def orphan_tracker_name(device_id: str) -> str:
+    return ORPHAN_TRACKER_PREFIX + device_id.replace("/", "-").replace(
+        ":", "-"
+    ).lower()
+
+
+def is_orphan_tracker(rule) -> bool:
+    return rule.metadata.name.startswith(ORPHAN_TRACKER_PREFIX)
 
 
 class UpstreamSyncer:
@@ -55,8 +84,14 @@ class UpstreamSyncer:
         self.grace = grace
         self.recorder = recorder or EventRecorder()
         self.log = logging.getLogger("UpstreamSyncer")
-        # device_id -> first-seen-missing monotonic time (:38, :107-123)
+        # device_id -> first-seen-missing time in the caller's `now`
+        # timebase (:38, :107-123). Seeded from the durable trackers on the
+        # first pass so a restart resumes, not resets, each grace clock.
         self._missing: Dict[str, float] = {}
+        # device_ids whose first-seen record is known to be durable; a
+        # persist that failed leaves its id out so later ticks retry.
+        self._tracked: set = set()
+        self._loaded = False
 
     # The Manager runnable entry point (mgr.Add(RunnableFunc) analog).
     def __call__(self, stop_event: threading.Event) -> None:
@@ -73,6 +108,11 @@ class UpstreamSyncer:
     def sync_once(self, now: Optional[float] = None) -> int:
         """One diff pass; returns the number of detach-CRs created."""
         now = time.monotonic() if now is None else now
+        if not self._loaded:
+            # Only a SUCCESSFUL load retires the flag: a transient list
+            # failure here must not permanently disable clock resumption
+            # (each later tick retries until one load lands).
+            self._loaded = self._load_trackers(now)
         # Store-only; runs BEFORE the fabric call so a fabric outage
         # (get_resources raising every tick) cannot also suspend the
         # stale-marker backstop for its whole duration.
@@ -90,20 +130,109 @@ class UpstreamSyncer:
         for dev in upstream:
             upstream_ids.add(dev.device_id)
             if dev.device_id in local_ids:
-                self._missing.pop(dev.device_id, None)  # reappeared (:99-105)
+                if self._missing.pop(dev.device_id, None) is not None:
+                    self._drop_tracker(dev.device_id)  # reappeared (:99-105)
                 continue
-            first = self._missing.setdefault(dev.device_id, now)
+            first = self._missing.get(dev.device_id)
+            if first is None:
+                first = now
+                self._missing[dev.device_id] = now
+            if dev.device_id not in self._tracked:
+                # First sighting, or an earlier persist failed: (re)try,
+                # back-dating the stamp so the durable clock matches the
+                # in-memory one rather than restarting at persist time.
+                if self._persist_tracker(dev, age=now - first):
+                    self._tracked.add(dev.device_id)
             if now - first < self.grace:
                 continue
             if self._create_detach_cr(dev):
                 created += 1
             self._missing.pop(dev.device_id, None)
+            self._drop_tracker(dev.device_id)
 
         # Vanished upstream -> stop tracking (:130-135).
         for dev_id in list(self._missing):
             if dev_id not in upstream_ids:
                 del self._missing[dev_id]
+                self._drop_tracker(dev_id)
         return created
+
+    # ------------------------------------------------------------------
+    # durable grace clock (crash consistency)
+    # ------------------------------------------------------------------
+    def _load_trackers(self, now: float) -> bool:
+        """Seed ``_missing`` from persisted first-seen records: a device
+        already aged A seconds resumes at ``now - A`` in the caller's
+        timebase, so a crash-loop cannot push reclamation out forever.
+        Returns False on a store failure so the caller retries next tick."""
+        try:
+            rules = self.store.list(DeviceTaintRule)
+        except StoreError as e:
+            self.log.warning("orphan tracker load failed (will retry): %s", e)
+            return False
+        wall_now = time.time()
+        for rule in rules:
+            if not is_orphan_tracker(rule):
+                continue
+            dev_id = rule.spec.device_uuid
+            stamp = rule.metadata.annotations.get(ANNOTATION_ORPHAN_FIRST_SEEN, "")
+            try:
+                age = max(0.0, wall_now - parse_iso(stamp).timestamp())
+            except (ValueError, OverflowError):
+                age = 0.0  # unreadable stamp: restart the clock, keep tracking
+            if dev_id:
+                self._missing[dev_id] = now - age
+                self._tracked.add(dev_id)
+        if self._missing:
+            self.log.info(
+                "resumed %d orphan grace clock(s) from durable trackers",
+                len(self._missing),
+            )
+        return True
+
+    def _persist_tracker(self, dev, age: float = 0.0) -> bool:
+        """Durable first-seen record, back-dated by ``age`` seconds (the
+        in-memory clock's view when an earlier persist failed). Failures
+        are non-fatal — the in-memory clock still runs and the caller
+        retries each tick until one create lands."""
+        stamp = (
+            datetime.datetime.now(datetime.timezone.utc)
+            - datetime.timedelta(seconds=max(0.0, age))
+        ).isoformat(timespec="microseconds").replace("+00:00", "Z")
+        try:
+            self.store.create(DeviceTaintRule(
+                metadata=ObjectMeta(
+                    name=orphan_tracker_name(dev.device_id),
+                    annotations={ANNOTATION_ORPHAN_FIRST_SEEN: stamp},
+                ),
+                spec=DeviceTaintRuleSpec(
+                    device_uuid=dev.device_id,
+                    node_name="",  # never a whole-node marker
+                    effect="",  # scheduling-inert: tracking only
+                    reason="orphan grace tracking",
+                ),
+            ))
+        except AlreadyExistsError:
+            pass  # a previous incarnation already stamped it — keep the older clock
+        except StoreError as e:
+            self.log.warning(
+                "orphan tracker for %s not persisted (will retry): %s",
+                dev.device_id, e,
+            )
+            return False
+        return True
+
+    def _drop_tracker(self, device_id: str) -> None:
+        self._tracked.discard(device_id)
+        try:
+            self.store.delete(DeviceTaintRule, orphan_tracker_name(device_id))
+        except NotFoundError:
+            pass
+        except StoreError as e:
+            self.log.warning(
+                "orphan tracker for %s not deleted: %s — a restart may"
+                " briefly re-track the device", device_id, e,
+            )
 
     def _sweep_stale_quarantines(self) -> int:
         """Clear whole-node quarantine markers whose node left the fleet.
@@ -130,7 +259,7 @@ class UpstreamSyncer:
             return 0
         for rule in rules:
             if not is_node_quarantine_marker(rule):
-                continue  # per-device taint, not a whole-node marker
+                continue  # per-device taint or orphan tracker, not a node marker
             node = rule.spec.node_name
             try:
                 if self.store.try_get(Node, node) is not None:
@@ -152,13 +281,17 @@ class UpstreamSyncer:
 
     def _create_detach_cr(self, dev) -> bool:
         name = f"detach-{dev.device_id}".lower().replace("/", "-")
+        # Explicit device type carried through FabricDevice; the model-name
+        # sniff survives only as the fallback for providers that predate
+        # the field (a "tpu-like" model name was never a type contract).
+        dev_type = dev.type or ("tpu" if is_tpu_model(dev.model) else "gpu")
         cr = ComposableResource(
             metadata=ObjectMeta(
                 name=name,
                 labels={LABEL_READY_TO_DETACH: dev.device_id},
             ),
             spec=ComposableResourceSpec(
-                type="tpu" if dev.model.startswith("tpu") else "gpu",
+                type=dev_type,
                 model=dev.model,
                 target_node=dev.node or "unknown",
                 force_detach=True,

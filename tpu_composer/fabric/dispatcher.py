@@ -158,6 +158,11 @@ class FabricDispatcher:
         self._threads: List[threading.Thread] = []
         self._started = False
         self._shutdown = False
+        # Draining: stop accepting NEW submissions while in-flight and
+        # parked work settles (graceful shutdown / leader handoff). Unlike
+        # _shutdown, workers keep running so queued ops reach the fabric
+        # and completions can still be consumed by live reconciles.
+        self._draining = False
         # Capability probe result: None = unknown, False = provider raised
         # UnsupportedBatch once (skip group attempts from then on).
         self._group_verbs_ok: Optional[bool] = None
@@ -183,20 +188,82 @@ class FabricDispatcher:
                 t.start()
                 self._threads.append(t)
 
-    def stop(self) -> None:
+    def stop(self, flush: bool = True) -> None:
+        """Stop workers and clear dispatcher state.
+
+        ``flush=True`` (the in-process stop/start path) fires every
+        unfired ``on_ready`` latch — queued submissions that never reached
+        the fabric AND parked ``_done`` outcomes nobody consumed — before
+        clearing, so a still-running (or restarting) controller gets an
+        immediate requeue and re-drives via the idempotent verbs instead
+        of silently losing a completed attach result until its poll-timer
+        safety net fires. ``flush=False`` (see :meth:`kill`) abandons
+        everything, modeling a process crash."""
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
         for t in self._threads:
             t.join(timeout=5)
         self._threads.clear()
+        callbacks: List[Callable[[], None]] = []
         with self._cond:
+            if flush:
+                for op in self._ops.values():
+                    callbacks.extend(op.on_ready)
+                    op.on_ready = []
+                for op, _ in self._done.values():
+                    callbacks.extend(op.on_ready)
+                    op.on_ready = []
             # Abandoned ops are safe: every verb is idempotent and the
-            # controllers' poll-timer fallback re-submits after restart.
+            # controllers' poll-timer fallback (plus the cold-start
+            # adoption pass reading the durable intent records) re-submits
+            # after restart.
             self._lanes.clear()
             self._ops.clear()
             self._done.clear()
             fabric_inflight.set(0)
+        for cb in callbacks:
+            try:
+                cb()
+            except Exception:
+                self.log.exception("on_ready latch failed during stop flush")
+
+    def kill(self) -> None:
+        """Hard stop: abandon queued ops and parked outcomes without firing
+        latches — the closest in-process analog of SIGKILL. Used by the
+        kill–restart soak harness; production shutdown uses drain+stop."""
+        self.stop(flush=False)
+
+    def drain(self, timeout: float) -> bool:
+        """Graceful drain: refuse new submissions, let queued/in-flight/
+        fabric-pending ops settle, and wait for parked outcomes to be
+        consumed by their (still running) reconciles — all under
+        ``timeout`` seconds. Returns True when fully drained.
+
+        The caller (Manager shutdown / leader handoff) must keep the
+        controllers running while draining: completions fire ``on_ready``
+        latches that re-enqueue CR keys, and those reconciles are what
+        consume parked outcomes and persist results before the process
+        exits. The lease is released only after this returns."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            while True:
+                if not self._ops and not self._done:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._shutdown:
+                    drained = not self._ops and not self._done
+                    if not drained:
+                        self.log.warning(
+                            "drain timed out with %d live op(s) and %d"
+                            " unconsumed outcome(s); relying on durable"
+                            " intent + adoption after restart",
+                            len(self._ops), len(self._done),
+                        )
+                    return drained
+                self._cond.wait(timeout=min(0.05, remaining))
 
     def run(self, stop_event: threading.Event) -> None:
         """Manager runnable: start workers, park until shutdown."""
@@ -225,6 +292,8 @@ class FabricDispatcher:
         with self._cond:
             done = self._done.pop(key, None)
             if done is not None:
+                # Wake drain(): consuming a parked outcome may empty _done.
+                self._cond.notify_all()
                 op = done[0]
                 if op.error is not None:
                     raise op.error
@@ -234,6 +303,13 @@ class FabricDispatcher:
                 if self._shutdown:
                     raise _DISPATCH_SENTINELS[verb](
                         f"{name}: dispatcher stopped; resubmit after restart"
+                    )
+                if self._draining:
+                    # Graceful drain window: in-flight work settles, but no
+                    # NEW fabric mutations start — the successor (or the
+                    # restarted process) re-submits from durable state.
+                    raise _DISPATCH_SENTINELS[verb](
+                        f"{name}: dispatcher draining; resubmit after restart"
                     )
                 self.start()  # lazy start: facade usable without wiring order
                 op = _Op(verb, resource, time.monotonic())
@@ -355,8 +431,13 @@ class FabricDispatcher:
                 with self._cond:
                     lane.busy = False
                     for op in ops:
+                        # Fire but RETAIN the latch (each reconcile pass
+                        # re-registers, replacing the list, so it stays at
+                        # one entry): a parked outcome keeps its latch so an
+                        # in-process stop() can re-fire it — without this, a
+                        # restart between completion and consumption would
+                        # silently strand the result until a poll timer.
                         callbacks.extend(op.on_ready)
-                        op.on_ready = []
                     # Prune empty lanes so churning fleets don't grow the
                     # lane map forever (O(1): a batch shares one node).
                     node = ops[0].node

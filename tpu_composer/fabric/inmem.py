@@ -47,6 +47,7 @@ class _Attachment:
     device_ids: List[str]
     cdi_device_id: str
     slice_name: str = ""
+    type: str = ""  # explicit device type from the attaching spec
 
 
 @dataclass
@@ -261,6 +262,7 @@ class InMemoryPool(FabricProvider):
             device_ids=list(chips),
             cdi_device_id=f"tpu.composer.dev/slice={spec.slice_name}/worker={spec.worker_id}",
             slice_name=spec.slice_name,
+            type=spec.type,
         )
 
     def _attach_loose(self, resource: ComposableResource) -> _Attachment:
@@ -281,6 +283,7 @@ class InMemoryPool(FabricProvider):
             model=spec.model,
             device_ids=chips,
             cdi_device_id=f"tpu.composer.dev/device={chips[0]}",
+            type=spec.type,
         )
 
     def remove_resource(self, resource: ComposableResource) -> None:
@@ -379,13 +382,15 @@ class InMemoryPool(FabricProvider):
                     model=a.model,
                     slice_name=a.slice_name,
                     health=self._health.get(d, DeviceHealth()),
+                    type=a.type,
+                    resource_name=a.resource_name,
                 )
                 for a in self._attachments.values()
                 for d in a.device_ids
             ]
             out.extend(FabricDevice(
                 device_id=l.device_id, node=l.node, model=l.model,
-                slice_name=l.slice_name, health=l.health,
+                slice_name=l.slice_name, health=l.health, type=l.type,
             ) for l in self._leaked)
             return out
 
@@ -404,14 +409,17 @@ class InMemoryPool(FabricProvider):
         with self._lock:
             self._health[device_id] = health
 
-    def leak_attachment(self, node: str, model: str) -> str:
+    def leak_attachment(self, node: str, model: str, type: str = "") -> str:
         """Create a fabric-side attachment with no local CR (drift source)."""
         with self._lock:
             free = self._free[model]
             if not free:
                 raise FabricError(f"no free {model} chips to leak")
             dev = free.pop(0)
-            self._leaked.append(FabricDevice(device_id=dev, node=node, model=model))
+            self._leaked.append(FabricDevice(
+                device_id=dev, node=node, model=model,
+                type=type or ("tpu" if is_tpu_model(model) else "gpu"),
+            ))
             return dev
 
     def attachment_record(self, resource_name: str) -> Optional[Dict[str, object]]:

@@ -118,6 +118,10 @@ class PoolApiMixin:
                     state=item.get("health", {}).get("state", "OK"),
                     detail=item.get("health", {}).get("detail", ""),
                 ),
+                # Optional fields newer pool services report; "" from older
+                # ones keeps the model-sniffing fallbacks in play.
+                type=item.get("type", ""),
+                resource_name=item.get("resource", ""),
             )
             for item in payload.get("attachments", [])
         ]

@@ -125,6 +125,15 @@ class FabricDevice:
     model: str
     slice_name: str = ""
     health: DeviceHealth = field(default_factory=DeviceHealth)
+    # Explicit fabric device type ("tpu"/"gpu"/"cxlmemory"; "" when the
+    # provider predates the field). The syncer's detach-CR creation uses
+    # this instead of sniffing the model-name prefix.
+    type: str = ""
+    # Name of the ComposableResource whose attach produced this device
+    # ("" for providers that do not track ownership, and for leaked
+    # attachments with no owner). The cold-start adoption pass uses it to
+    # recognize completed-but-unrecorded attaches exactly.
+    resource_name: str = ""
 
 
 class FabricProvider(abc.ABC):

@@ -591,7 +591,14 @@ def maybe_cached(store, enabled: bool):
 
     KubeStore carries its own reflector cache (toggled by its
     ``cache_reads`` constructor arg) and passes through unchanged; so does
-    anything already wrapped."""
-    if enabled and isinstance(store, Store):
+    anything already wrapped. A ChaosStore over the in-proc store caches
+    like the bare store would — the informer then sits ABOVE the fault
+    injector, the same position it has over a flaky real apiserver."""
+    from tpu_composer.runtime.chaosstore import ChaosStore
+
+    inproc = isinstance(store, Store) or (
+        isinstance(store, ChaosStore) and isinstance(store._inner, Store)
+    )
+    if enabled and inproc:
         return CachedClient(store)
     return store

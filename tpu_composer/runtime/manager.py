@@ -125,6 +125,8 @@ class Manager:
         metrics_certfile: Optional[str] = None,
         metrics_keyfile: Optional[str] = None,
         metrics_token_file: Optional[str] = None,
+        dispatcher=None,  # FabricDispatcher to drain at shutdown/handoff
+        drain_timeout: float = 8.0,  # seconds; <= 0 disables graceful drain
     ) -> None:
         # `is not None`, not `or`: an EMPTY store is falsy (Store.__len__),
         # and silently swapping in a fresh one would orphan the caller's
@@ -153,12 +155,26 @@ class Manager:
         self._metrics_keyfile = metrics_keyfile
         self._metrics_token_file = metrics_token_file
         self._metrics_server: Optional[http.server.ThreadingHTTPServer] = None
+        self._dispatcher = dispatcher
+        self._drain_timeout = drain_timeout
+        # Post-leader-acquire / pre-controller-start hooks (cold-start
+        # adoption of durable fabric intents, controllers/adoption.py):
+        # they run only once leadership is held — a standby must not probe
+        # the fabric — and strictly before the first reconcile fires.
+        self._startup_hooks: List[Callable[[], None]] = []
 
     def add_controller(self, controller: Controller) -> None:
         self._controllers.append(controller)
 
     def add_runnable(self, runnable: Runnable) -> None:
         self._runnables.append(runnable)
+
+    def add_startup_hook(self, hook: Callable[[], None]) -> None:
+        """Run ``hook`` after leader acquisition and before any controller
+        starts (the cold-start adoption slot). Hook failures are logged,
+        not fatal: the reconcile-path safety nets (idempotent verbs, poll
+        timers, the syncer) still converge, just slower."""
+        self._startup_hooks.append(hook)
 
     def ready(self) -> bool:
         return self._started
@@ -256,6 +272,18 @@ class Manager:
             t.start()
             self._threads.append(t)
 
+        # Cold-start adoption window: leadership (if any) is held, no
+        # controller worker is running yet — in-flight fabric intents from
+        # the previous incarnation are classified and resolved here so the
+        # first reconcile wave starts from reconstructed state.
+        for hook in self._startup_hooks:
+            try:
+                hook()
+            except Exception:
+                self.log.exception(
+                    "startup hook failed; relying on reconcile-path recovery"
+                )
+
         for c in self._controllers:
             c.start(workers=workers_per_controller)
         for r in self._runnables:
@@ -275,6 +303,39 @@ class Manager:
                 return
 
     def stop(self) -> None:
+        # Graceful drain BEFORE anything is torn down: the controllers
+        # must stay live while lanes flush, because completions re-enqueue
+        # CR keys and those reconciles are what persist outcomes. Skipped
+        # when leadership was LOST (fencing: a deposed leader must stop
+        # driving the fabric immediately — queued ops are abandoned and
+        # the successor's adoption pass re-derives them from durable
+        # intent) and on re-entrant stop calls.
+        if (
+            self._dispatcher is not None
+            and self._drain_timeout > 0
+            and self._started
+            and not self.lost_leadership
+            # Live leadership check, not just the watchdog flag: the
+            # watchdog polls on a period, so a lease that expired
+            # moments ago may not have set lost_leadership yet — and a
+            # deposed leader draining for up to --drain-timeout while
+            # the successor adopts is exactly the double-driving window
+            # fencing must close.
+            and (self._elector is None or self._elector.is_leader)
+            and not self._stop.is_set()
+        ):
+            from tpu_composer.runtime.metrics import dispatcher_drains_total
+
+            drained = self._dispatcher.drain(self._drain_timeout)
+            dispatcher_drains_total.inc(
+                outcome="clean" if drained else "timeout"
+            )
+            if not drained:
+                self.log.warning(
+                    "dispatcher drain exceeded %.1fs; in-flight intents"
+                    " recover via adoption on the next start",
+                    self._drain_timeout,
+                )
         self._stop.set()
         for c in self._controllers:
             c.stop()

@@ -275,6 +275,26 @@ fabric_reads_coalesced_total = global_registry.counter(
     " (no provider call; staleness bounded by the batch window)",
 )
 
+#: Crash consistency (durable intent + cold-start adoption + drain).
+adoption_ops_total = global_registry.counter(
+    "tpuc_adoption_ops_total",
+    "Pending fabric-op intents classified by the cold-start adoption pass,"
+    " by verb and outcome (adopted | reissue | repoll | cleared | deferred"
+    " | error)",
+)
+dispatcher_drains_total = global_registry.counter(
+    "tpuc_dispatcher_drains_total",
+    "Graceful dispatcher drains at shutdown/leader handoff, by outcome"
+    " (clean = every op settled and every outcome consumed within"
+    " --drain-timeout; timeout = durable intent + adoption recover the"
+    " rest after restart)",
+)
+store_chaos_injected_total = global_registry.counter(
+    "tpuc_store_chaos_injected_total",
+    "Store-layer faults injected by the ChaosStore, by verb and mode"
+    " (transient | conflict | watch_drop)",
+)
+
 #: Cluster scheduler (scheduler/: priority queue, preemption, defrag).
 scheduler_queue_depth = global_registry.gauge(
     "tpuc_scheduler_queue_depth",
