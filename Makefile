@@ -4,7 +4,7 @@
 PYTHON ?= python
 IMG ?= tpu-composer:latest
 
-.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean build-installer bundle crash-soak
+.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean build-installer bundle crash-soak chaos-soak
 
 all: native test
 
@@ -28,11 +28,12 @@ test-fast:
 bench:
 	$(PYTHON) bench.py
 
-## perf-smoke: fast CI gate, two count-based (never wall-time) assertions —
-## cache-on vs cache-off store round trips per attach through the cluster
-## path (read path), and a batched vs unbatched 8-child same-node fabric
-## wave that must issue strictly fewer attach/detach provider calls
-## (write path / FabricDispatcher group-verb coalescing)
+## perf-smoke: fast CI gate — two count-based assertions (cache-on vs
+## cache-off store round trips per attach through the cluster path, and a
+## batched vs unbatched 8-child same-node fabric wave that must issue
+## strictly fewer attach/detach provider calls) plus one bounded wall-time
+## guard: causal tracing must add <5% (+50 ms jitter allowance) to the
+## 32-chip wave vs TPUC_TRACE=0, best-of-3
 perf-smoke:
 	$(PYTHON) -c "import bench; bench.perf_smoke()"
 
@@ -47,6 +48,15 @@ perf-smoke:
 ## CRASH_SEED=<n> make crash-soak.
 crash-soak:
 	$(PYTHON) -m pytest tests/test_crash_restart.py -q -m crash -p no:randomly
+
+## chaos-soak: fabric fault-injection soak (tests/test_chaos_soak.py,
+## markers slow+chaos): 100 attach/detach cycles at 10% injected fabric
+## failures, asserting breaker/quarantine/reallocation keep converging.
+## Like crash-soak, set TPUC_FLIGHT_FILE / TPUC_TRACE_FILE to leave the
+## flight-recorder black box + trace ring behind on a failed run (the CI
+## steps upload both as failure artifacts).
+chaos-soak:
+	$(PYTHON) -m pytest tests/test_chaos_soak.py -q -m chaos -p no:randomly
 
 ## watch-relay: poll the TPU tunnel relay; auto-capture the full on-chip
 ## probe to bench_artifacts/ the moment it answers (run at round start)

@@ -550,3 +550,79 @@ class TestGracefulDrain:
             dispatcher.add_resource(res)
         assert pool.get_resources() == []  # nothing reached the fabric
         dispatcher.stop()
+
+
+# ----------------------------------------------------------------------
+# causal-trace continuity across a crash (ISSUE 6 acceptance)
+# ----------------------------------------------------------------------
+@pytest.mark.crash
+class TestTraceContinuity:
+    """Deliberately NOT slow-marked: one deterministic kill–restart case is
+    cheap enough for tier-1, and the crash-soak step (`-m crash`) also
+    picks it up."""
+
+    def test_trace_id_survives_kill_restart_via_nonce(self):
+        """One attach renders as ONE trace across a process crash: the
+        trace id is the durable ``status.pending_op`` nonce, so the dead
+        incarnation's reconcile/dispatch spans and the successor's adoption
+        pass share a trace_id in the (process-global) ring — exactly what a
+        Perfetto export of the combined trace file shows as one connected
+        operation."""
+        from tpu_composer.runtime import tracing
+
+        tracing.reset()
+        # Scan crash points until a kill leaves a durable attach intent
+        # behind (the interesting window: intent write landed, outcome
+        # write did not). The scan is cheap — early fuses die within a few
+        # operator writes.
+        survivor = None
+        for fuse in range(2, 40):
+            store = _fresh_world()
+            pool = RecordingPool(async_steps=1)
+            inc = Incarnation(store, pool, cached=False, batched=True,
+                              fuse=fuse)
+            _submit_wave(store)
+            wait_for(lambda: inc.fuse.dead.is_set() or _all_running(store),
+                     timeout=15)
+            inc.kill()
+            adds = [r for r in store.list(ComposableResource)
+                    if r.status.pending_op is not None
+                    and r.status.pending_op.verb == "add"]
+            if adds:
+                survivor = adds[0]
+                break
+        assert survivor is not None, (
+            "no crash point in the fuse scan left a durable attach intent"
+        )
+        nonce = survivor.status.pending_op.nonce
+
+        # The dying incarnation traced under the nonce: the reconcile that
+        # minted the intent adopted it as its trace id.
+        pre = [e for e in tracing.trace_events(nonce) if e.get("ph") == "X"]
+        assert pre, f"no pre-crash spans recorded under nonce {nonce!r}"
+        assert any(e["name"] == "reconcile" for e in pre)
+
+        # Restart against the same store + fabric; adoption + reconcile
+        # finish the wave.
+        inc = Incarnation(store, pool, cached=False, batched=True)
+        try:
+            assert wait_for(lambda: _all_running(store), timeout=30), (
+                "post-crash restart never converged"
+            )
+        finally:
+            inc.kill()
+
+        events = tracing.trace_events(nonce)
+        spans = [e for e in events if e.get("ph") == "X"]
+        # The successor's adoption span JOINED the pre-crash trace: same
+        # trace_id, read back from the durable nonce — continuity across
+        # the kill.
+        adopt = [e for e in spans if e["name"] == "adopt"]
+        assert adopt, (
+            f"adoption never joined trace {nonce!r}; spans:"
+            f" {[e['name'] for e in spans]}"
+        )
+        assert adopt[0]["args"].get("resource") == survivor.metadata.name
+        assert len(spans) > len(pre), (
+            "no post-restart spans joined the pre-crash trace"
+        )

@@ -1,0 +1,322 @@
+"""Per-CR lifecycle timelines + crash flight recorder (runtime/lifecycle.py):
+the bounded per-object ledger feeding tpuc_phase_duration_seconds, the
+/debug/requests timelines, and the black-box dump written on crash paths
+(atexit, unhandled thread exception, drain-timeout)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpu_composer.api import (
+    ComposableResource,
+    ComposableResourceSpec,
+    ObjectMeta,
+)
+from tpu_composer.runtime import lifecycle, tracing
+from tpu_composer.runtime.events import WARNING, EventRecorder
+from tpu_composer.runtime.lifecycle import FlightRecorder, phase_for
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.metrics import (
+    flight_dumps_total,
+    phase_duration_seconds,
+)
+from tpu_composer.runtime.store import Store
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestPhaseMapping:
+    def test_resource_states(self):
+        assert phase_for("ComposableResource", "") == "Pending"
+        assert phase_for("ComposableResource", "Attaching") == "Attaching"
+        assert phase_for("ComposableResource", "Online") == "Ready"
+        assert phase_for("ComposableResource", "Detaching") == "Detaching"
+        assert phase_for("ComposableResource", "(deleted)") == "Deleted"
+
+    def test_request_states(self):
+        assert phase_for("ComposabilityRequest", "") == "Pending"
+        assert phase_for("ComposabilityRequest", "NodeAllocating") == "Pending"
+        assert phase_for("ComposabilityRequest", "Updating") == "Scheduled"
+        assert phase_for("ComposabilityRequest", "Running") == "Ready"
+        assert phase_for("ComposabilityRequest", "Cleaning") == "Terminating"
+
+    def test_unknown_state_passes_through(self):
+        assert phase_for("ComposableResource", "Weird") == "Weird"
+
+
+class TestFlightRecorder:
+    def test_record_state_dedups_repeats(self):
+        fr = FlightRecorder()
+        fr.record_state("ComposableResource", "r0", "Attaching")
+        fr.record_state("ComposableResource", "r0", "Attaching")  # no-op
+        fr.record_state("ComposableResource", "r0", "Online")
+        tl = fr.timeline("r0")
+        assert len([e for e in tl["entries"] if e["t"] == "phase"]) == 2
+        assert tl["phase"] == "Ready" and tl["phase_age_s"] >= 0
+
+    def test_phase_duration_observed_on_exit(self):
+        fr = FlightRecorder()
+        before = phase_duration_seconds.count(kind="resource",
+                                              phase="Attaching")
+        fr.record_state("ComposableResource", "r1", "Attaching")
+        fr.record_state("ComposableResource", "r1", "Online")
+        after = phase_duration_seconds.count(kind="resource",
+                                             phase="Attaching")
+        assert after == before + 1
+        entry = [e for e in fr.timeline("r1")["entries"]
+                 if e.get("prev_phase") == "Attaching"][0]
+        assert entry["prev_phase_s"] >= 0
+
+    def test_ledger_bounded_per_object_and_lru(self):
+        fr = FlightRecorder(per_object=4, max_objects=2)
+        for i in range(10):
+            fr.record_state("ComposableResource", "hot", f"S{i}")
+        assert len(fr.timeline("hot")["entries"]) == 4
+        fr.record_state("ComposableResource", "b", "Online")
+        fr.record_state("ComposableResource", "c", "Online")  # evicts "hot"
+        assert fr.timeline("hot") is None
+        assert set(fr.names()) == {"b", "c"}
+
+    def test_same_name_across_kinds_tracked_independently(self):
+        """A request and a resource may legally share a name; phase state
+        is keyed per kind so interleaved events neither fabricate phantom
+        transitions nor attribute one kind's duration to the other."""
+        fr = FlightRecorder()
+        req_before = phase_duration_seconds.count(kind="request",
+                                                  phase="Pending")
+        res_before = phase_duration_seconds.count(kind="resource",
+                                                  phase="Attaching")
+        fr.record_state("ComposableResource", "twin", "Attaching")
+        fr.record_state("ComposabilityRequest", "twin", "NodeAllocating")
+        # Repeats interleaved across kinds still dedup per kind.
+        fr.record_state("ComposableResource", "twin", "Attaching")
+        fr.record_state("ComposabilityRequest", "twin", "NodeAllocating")
+        fr.record_state("ComposabilityRequest", "twin", "Running")
+        tl = fr.timeline("twin")
+        phases = [(e["kind"], e["phase"]) for e in tl["entries"]
+                  if e["t"] == "phase"]
+        assert phases == [("ComposableResource", "Attaching"),
+                          ("ComposabilityRequest", "Pending"),
+                          ("ComposabilityRequest", "Ready")]
+        # The request leaving Pending observed ONE request-kind duration
+        # and no resource-kind one (the resource never left Attaching).
+        assert phase_duration_seconds.count(
+            kind="request", phase="Pending") == req_before + 1
+        assert phase_duration_seconds.count(
+            kind="resource", phase="Attaching") == res_before
+        # "current" surfaces the most recent transitioner.
+        assert tl["kind"] == "ComposabilityRequest" and tl["phase"] == "Ready"
+
+    def test_span_sink_keeps_controller_spans_only(self):
+        fr = FlightRecorder()
+        fr.span_sink({"name": "reconcile", "cat": "controller", "dur": 1500.0,
+                      "args": {"object": "r2", "trace_id": "n-1",
+                               "outcome": "ok"}})
+        fr.span_sink({"name": "fabric.add_resource", "cat": "fabric",
+                      "dur": 99.0, "args": {"object": "r2"}})
+        fr.span_sink({"name": "anon", "cat": "controller", "dur": 1.0,
+                      "args": {}})  # no object -> dropped
+        (entry,) = fr.timeline("r2")["entries"]
+        assert entry["t"] == "span" and entry["span"] == "reconcile"
+        assert entry["dur_ms"] == 1.5
+        assert entry["trace_id"] == "n-1" and entry["outcome"] == "ok"
+
+    def test_event_recorder_mirrors_into_ledger(self):
+        res = ComposableResource(metadata=ObjectMeta(name="evt-cr"),
+                                 spec=ComposableResourceSpec(type="gpu"))
+        lifecycle.recorder.reset()
+        EventRecorder().event(res, WARNING, "Quarantined", "budget exhausted")
+        tl = lifecycle.recorder.timeline("evt-cr")
+        (entry,) = tl["entries"]
+        assert entry["t"] == "event" and entry["reason"] == "Quarantined"
+
+    def test_dump_writes_black_box(self, tmp_path):
+        fr = FlightRecorder()
+        fr.record_state("ComposableResource", "d0", "Attaching",
+                        trace_id="n-9")
+        before = flight_dumps_total.value(reason="manual")
+        path = tmp_path / "flight.json"
+        assert fr.dump("manual", str(path)) == str(path)
+        doc = json.loads(path.read_text())
+        assert doc["reason"] == "manual"
+        assert doc["current"]["d0"]["phase"] == "Attaching"
+        assert doc["objects"]["d0"][0]["trace_id"] == "n-9"
+        assert "trace_summary" in doc
+        assert flight_dumps_total.value(reason="manual") == before + 1
+
+    def test_dump_without_destination_is_none(self, monkeypatch):
+        monkeypatch.delenv("TPUC_FLIGHT_FILE", raising=False)
+        assert FlightRecorder().dump("manual") is None
+
+    def test_dump_never_raises_on_bad_path(self):
+        fr = FlightRecorder()
+        assert fr.dump("manual", "/nonexistent-dir/nope/flight.json") is None
+
+
+class TestCrashHooks:
+    def test_dump_crash_writes_both_files(self, tmp_path, monkeypatch):
+        flight = tmp_path / "flight.json"
+        trace = tmp_path / "trace.json"
+        monkeypatch.setenv("TPUC_FLIGHT_FILE", str(flight))
+        monkeypatch.setenv("TPUC_TRACE_FILE", str(trace))
+        lifecycle.recorder.record_state("ComposableResource", "c0",
+                                        "Attaching")
+        with tracing.span("pre-crash"):
+            pass
+        lifecycle.dump_crash("unhandled-exception:Test")
+        assert json.loads(flight.read_text())["reason"].startswith(
+            "unhandled-exception")
+        assert any(e["name"] == "pre-crash"
+                   for e in json.loads(trace.read_text())["traceEvents"])
+
+    def test_unhandled_thread_exception_dumps(self, tmp_path, monkeypatch):
+        """install() wraps threading.excepthook: a dying worker thread
+        leaves the black box behind (the satellite closing the
+        'trace file only on clean stop' gap). The hook function is invoked
+        directly — pytest swaps threading.excepthook for its own catcher
+        around every test, so raising in a real thread would exercise
+        pytest's hook, not ours."""
+        lifecycle.install()  # idempotent; Manager() normally does this
+        flight = tmp_path / "flight.json"
+        monkeypatch.setenv("TPUC_FLIGHT_FILE", str(flight))
+        monkeypatch.setattr(lifecycle, "_prev_thread_hook", lambda a: None)
+        lifecycle.recorder.record_state("ComposableResource", "t0", "Online")
+
+        class HookArgs:
+            exc_type = RuntimeError
+            exc_value = RuntimeError("worker died")
+            exc_traceback = None
+            thread = None
+
+        lifecycle._thread_hook(HookArgs())
+        doc = json.loads(flight.read_text())
+        assert doc["reason"] == "unhandled-exception:RuntimeError"
+
+    def test_sys_excepthook_dumps(self, tmp_path, monkeypatch):
+        lifecycle.install()
+        flight = tmp_path / "flight.json"
+        monkeypatch.setenv("TPUC_FLIGHT_FILE", str(flight))
+        monkeypatch.setattr(lifecycle, "_prev_sys_hook", lambda *a: None)
+        lifecycle._sys_hook(ValueError, ValueError("main died"), None)
+        doc = json.loads(flight.read_text())
+        assert doc["reason"] == "unhandled-exception:ValueError"
+
+    def test_drain_timeout_dumps(self, tmp_path, monkeypatch):
+        """Manager.stop hitting the drain deadline is a crash-shaped exit:
+        the black box must be written before the process moves on."""
+        from tpu_composer.fabric.dispatcher import FabricDispatcher
+        from tpu_composer.fabric.inmem import InMemoryPool
+        from tpu_composer.fabric.provider import DispatchedAttaching
+
+        flight = tmp_path / "flight.json"
+        monkeypatch.setenv("TPUC_FLIGHT_FILE", str(flight))
+        gate = threading.Event()
+
+        class StuckPool(InMemoryPool):
+            def add_resource(self, resource):
+                gate.wait(10)
+                return super().add_resource(resource)
+
+        dispatcher = FabricDispatcher(StuckPool(), batch_window=0.0)
+        mgr = Manager(store=Store(), dispatcher=dispatcher,
+                      drain_timeout=0.3)
+        mgr.add_runnable(dispatcher.run)
+        mgr.start()
+        res = ComposableResource(metadata=ObjectMeta(name="stuck"))
+        res.spec.type, res.spec.model = "tpu", "tpu-v4"
+        res.spec.target_node, res.spec.chip_count = "worker-0", 1
+        with pytest.raises(DispatchedAttaching):
+            dispatcher.add_resource(res)
+        mgr.stop()
+        gate.set()
+        dispatcher.kill()
+        assert flight.exists()
+        assert json.loads(flight.read_text())["reason"] == "drain-timeout"
+
+    def test_atexit_backstop_never_clobbers_a_crash_dump(self, tmp_path,
+                                                         monkeypatch):
+        """A crash dump on disk is the snapshot that explains the death;
+        the atexit sweep at (eventual) process exit must keep it rather
+        than overwrite reason + crash-time ledger with post-crash state.
+        With no prior crash, the backstop itself dumps."""
+        flight = tmp_path / "flight.json"
+        monkeypatch.setenv("TPUC_FLIGHT_FILE", str(flight))
+        monkeypatch.setattr(lifecycle, "_crash_dumped", False)
+        lifecycle._atexit_hook()
+        assert json.loads(flight.read_text())["reason"] == "atexit"
+        lifecycle.dump_crash("unhandled-exception:Boom")
+        assert json.loads(flight.read_text())["reason"].endswith("Boom")
+        lifecycle._atexit_hook()  # must not rewrite
+        assert json.loads(flight.read_text())["reason"].endswith("Boom")
+
+    def test_install_is_idempotent(self):
+        hook_before = threading.excepthook
+        lifecycle.install()
+        lifecycle.install()
+        assert threading.excepthook is hook_before or callable(
+            threading.excepthook)
+
+
+class TestWatchRunnable:
+    def test_manager_feeds_recorder_from_store_watch(self):
+        lifecycle.recorder.reset()
+        store = Store()
+        mgr = Manager(store=store)
+        mgr.start()
+        try:
+            res = ComposableResource(
+                metadata=ObjectMeta(name="watched"),
+                spec=ComposableResourceSpec(type="gpu", model="gpu-a100",
+                                            target_node="n0"),
+            )
+            store.create(res)
+            res = store.get(ComposableResource, "watched")
+            res.status.state = "Attaching"
+            from tpu_composer.api.types import PendingOp
+
+            res.status.pending_op = PendingOp(verb="add", nonce="abc123",
+                                              node="n0", started_at="now")
+            store.update_status(res)
+            res = store.get(ComposableResource, "watched")
+            res.status.state = "Online"
+            res.status.pending_op = None
+            store.update_status(res)
+            assert wait_for(
+                lambda: (tl := lifecycle.recorder.timeline("watched"))
+                is not None and tl.get("phase") == "Ready"
+            ), lifecycle.recorder.timeline("watched")
+            phases = [e for e in lifecycle.recorder.timeline("watched")
+                      ["entries"] if e["t"] == "phase"]
+            assert [p["phase"] for p in phases] == [
+                "Pending", "Attaching", "Ready"]
+            # The durable nonce rode into the ledger -> timeline links to
+            # the trace.
+            assert phases[1]["trace_id"] == "abc123"
+            store.delete(ComposableResource, "watched")
+            assert wait_for(
+                lambda: lifecycle.recorder.timeline("watched")["phase"]
+                == "Deleted"
+            )
+        finally:
+            mgr.stop()
+
+    def test_phase_summary_shape(self):
+        fr = FlightRecorder()
+        fr.record_state("ComposableResource", "s0", "Attaching")
+        fr.record_state("ComposableResource", "s0", "Online")
+        summary = fr.phase_summary()
+        key = "resource/Attaching"
+        assert key in summary
+        assert summary[key]["count"] >= 1
+        assert summary[key]["p90_ms"] >= summary[key]["p50_ms"] >= 0

@@ -265,6 +265,65 @@ class TestMetrics:
         assert h.count(op="attach") == 3
         assert h.percentile(0.5, op="attach") == 0.5
 
+    def test_exposition_round_trip(self):
+        """Parse the scrape text back and verify the format invariants a
+        real Prometheus scraper depends on: escaped label values
+        round-trip, histogram buckets are cumulative and monotonic, the
+        explicit +Inf bucket equals _count, and _sum matches the observed
+        total — per label set."""
+        import re
+
+        def parse(text):
+            # sample name -> {frozenset(label items) -> value}
+            out = {}
+            label_re = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+            for line in text.splitlines():
+                if not line or line.startswith("#"):
+                    continue
+                name_part, value = line.rsplit(" ", 1)
+                if "{" in name_part:
+                    name, raw = name_part.split("{", 1)
+                    labels = {
+                        k: v.replace("\\n", "\n").replace('\\"', '"')
+                            .replace("\\\\", "\\")
+                        for k, v in label_re.findall(raw[:-1])
+                    }
+                else:
+                    name, labels = name_part, {}
+                out.setdefault(name, {})[frozenset(labels.items())] = (
+                    float(value)
+                )
+            return out
+
+        r = Registry()
+        nasty = 'quo"te\\back\nnewline'
+        r.counter("c_total", "help").inc(2, err=nasty)
+        r.gauge("g", "help").set(-1.5, node="n0")
+        h = r.histogram("h_s", "help", buckets=(0.1, 1.0))
+        obs = {"attach": [0.05, 0.05, 0.5, 5.0], "detach": [0.2]}
+        for op, values in obs.items():
+            for v in values:
+                h.observe(v, op=op)
+        parsed = parse(r.expose_text())
+
+        assert parsed["c_total"][frozenset([("err", nasty)])] == 2.0
+        assert parsed["g"][frozenset([("node", "n0")])] == -1.5
+        for op, values in obs.items():
+            key = ("op", op)
+            buckets = {
+                dict(ls)["le"]: v
+                for ls, v in parsed["h_s_bucket"].items() if key in ls
+            }
+            # Explicit +Inf present; cumulative counts monotonic in
+            # bucket order and ending at the total observation count.
+            assert "+Inf" in buckets
+            ordered = [buckets[le] for le in ("0.1", "1.0", "+Inf")]
+            assert ordered == sorted(ordered)
+            count = parsed["h_s_count"][frozenset([key])]
+            assert buckets["+Inf"] == count == len(values)
+            total = parsed["h_s_sum"][frozenset([key])]
+            assert abs(total - sum(values)) < 1e-9
+
 
 class TestSecureMetrics:
     """Dedicated TLS + bearer-token metrics endpoint (VERDICT r2 weak #7;

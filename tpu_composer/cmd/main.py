@@ -26,6 +26,12 @@ SURVEY.md §5 "Config / flag system"):
   TPUC_CHAOS_STORE_*  store-layer fault injection (FAILURE_RATE,
                       CONFLICT_RATE, LATENCY, WATCH_DROP_RATE, SEED) —
                       the apiserver twin of the fabric chaos knobs
+  TPUC_TRACE          "0" disables causal tracing entirely (--no-trace)
+  TPUC_TRACE_EVENTS   trace ring capacity in events (--trace-events)
+  TPUC_TRACE_FILE     write the Chrome trace ring here at stop AND on
+                      crash/drain-timeout (--trace-file)
+  TPUC_FLIGHT_FILE    write the flight-recorder black box here on
+                      crash/drain-timeout (--flight-file)
 
 Run: ``python -m tpu_composer [flags]`` or ``python -m tpu_composer.cmd.main``.
 """
@@ -226,6 +232,41 @@ def build_parser() -> argparse.ArgumentParser:
         help="RNG seed for the store chaos injector"
              " (env TPUC_CHAOS_STORE_SEED)",
     )
+    # Observability (runtime/tracing.py + runtime/lifecycle.py): causal
+    # spans with cross-thread flow arrows, per-CR lifecycle timelines, and
+    # the crash flight recorder. All on by default; the files are opt-in.
+    p.add_argument(
+        "--trace",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_TRACE", "1") != "0",
+        help="record causal control-plane traces (spans + cross-thread flow"
+             " arrows; /debug/traces serves them as Chrome trace-event"
+             " JSON). --no-trace or TPUC_TRACE=0 turns recording into a"
+             " no-op — the perf-smoke gate holds the enabled path within"
+             " 5%% of this on the 32-chip wave",
+    )
+    p.add_argument(
+        "--trace-events",
+        type=int,
+        default=_env_int("TPUC_TRACE_EVENTS", 10_000),
+        help="trace ring capacity in events; oldest events fall off"
+             " (env TPUC_TRACE_EVENTS)",
+    )
+    p.add_argument(
+        "--trace-file",
+        default=os.environ.get("TPUC_TRACE_FILE", ""),
+        help="write the trace ring (Chrome trace-event JSON) here at clean"
+             " stop, on drain-timeout, and from the crash hooks"
+             " (env TPUC_TRACE_FILE; empty disables the file)",
+    )
+    p.add_argument(
+        "--flight-file",
+        default=os.environ.get("TPUC_FLIGHT_FILE", ""),
+        help="write the flight-recorder black box (last-N state"
+             " transitions, span summaries and events per CR) here on"
+             " drain-timeout and from the crash hooks"
+             " (env TPUC_FLIGHT_FILE; empty disables the dump)",
+    )
     p.add_argument(
         "--workers",
         type=int,
@@ -400,7 +441,27 @@ def _maybe_chaos_store(args: argparse.Namespace, store, log):
     )
 
 
+def _configure_tracing(args: argparse.Namespace) -> None:
+    """Apply the observability knobs before any traced code runs. The file
+    destinations land in the env because the crash paths (atexit, thread
+    excepthook, drain-timeout) read $TPUC_TRACE_FILE / $TPUC_FLIGHT_FILE —
+    they must work even when no argparse namespace is reachable."""
+    from tpu_composer.runtime import tracing
+
+    tracing.set_enabled(getattr(args, "trace", True))
+    capacity = getattr(args, "trace_events", 0)
+    if capacity > 0:
+        # Unconditional: the ring is empty this early, so configure()'s
+        # drop-contents side effect is moot.
+        tracing.configure(capacity)
+    if getattr(args, "trace_file", ""):
+        os.environ["TPUC_TRACE_FILE"] = args.trace_file
+    if getattr(args, "flight_file", ""):
+        os.environ["TPUC_FLIGHT_FILE"] = args.flight_file
+
+
 def build_manager(args: argparse.Namespace) -> Manager:
+    _configure_tracing(args)
     store = build_store(args)
     # Informer read cache (runtime/cache.py): controllers, scheduler,
     # syncer and admission all read through `client`; only writes reach

@@ -58,6 +58,7 @@ from tpu_composer.fabric.provider import (
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
 )
+from tpu_composer.runtime import tracing
 from tpu_composer.runtime.metrics import adoption_ops_total
 from tpu_composer.runtime.store import ConflictError, NotFoundError, StoreError
 
@@ -154,10 +155,21 @@ def adopt_pending_ops(store, fabric, dispatcher=None) -> AdoptionReport:
     for res in pending:
         verb = res.status.pending_op.verb
         try:
-            outcome = _adopt_one(
-                store, fabric, dispatcher, res,
-                _devices_for(res, by_owner, unowned),
-            )
+            # The adoption span JOINS the op's pre-crash trace: the durable
+            # nonce is the trace id, so a Perfetto export shows the dead
+            # incarnation's reconcile/dispatch spans and this successor's
+            # adoption span under one trace_id — the cross-crash continuity
+            # the kill–restart soak asserts.
+            with tracing.span(
+                "adopt", cat="adoption", resource=res.metadata.name,
+                verb=verb,
+                ctx=tracing.TraceContext(trace_id=res.status.pending_op.nonce),
+            ) as sp:
+                outcome = _adopt_one(
+                    store, fabric, dispatcher, res,
+                    _devices_for(res, by_owner, unowned),
+                )
+                sp["outcome"] = outcome
         except (ConflictError, NotFoundError):
             # Another writer (a standby that just lost, a racing delete)
             # moved the object — the reconcile path owns it now.

@@ -69,6 +69,7 @@ from tpu_composer.fabric.provider import (
     WaitingDeviceDetaching,
     classify_fabric_error,
 )
+from tpu_composer.runtime import tracing
 from tpu_composer.runtime.controller import Controller, Result
 from tpu_composer.runtime.events import WARNING, EventRecorder
 from tpu_composer.runtime.metrics import (
@@ -222,6 +223,15 @@ class ComposableResourceReconciler(Controller):
                 self.dispatcher.cancel("add", name)
                 self.dispatcher.cancel("remove", name)
             return Result()
+        # Causal tracing: a durable intent's nonce IS the trace id for that
+        # fabric op. Adopting it here back-fills the already-open reconcile
+        # span and makes every child span (fabric calls, dispatcher
+        # submissions, status writes) part of the same trace — including
+        # reconciles of a RESTARTED process, which read the same nonce back
+        # from status (the crash-soak's continuity assertion).
+        po = res.status.pending_op
+        if po is not None and po.nonce:
+            tracing.adopt_trace(tracing.TraceContext(trace_id=po.nonce))
         try:
             result = self._reconcile_inner(res)
             reconcile_total.inc(controller="resource", outcome="ok")
@@ -647,12 +657,17 @@ class ComposableResourceReconciler(Controller):
         op across crash/retry cycles: re-driving an interrupted op keeps
         the persisted nonce, so one fabric mutation traces to exactly one
         intent (the kill–restart harness's double-attach check)."""
-        return PendingOp(
+        po = PendingOp(
             verb=verb,
             nonce=uuid.uuid4().hex[:12],
             node=res.spec.target_node,
             started_at=now_iso(),
         )
+        # The nonce doubles as the trace id: adopt it the moment the intent
+        # exists so the transition write and the fabric submission that
+        # follow in this same reconcile belong to the op's trace.
+        tracing.adopt_trace(tracing.TraceContext(trace_id=po.nonce))
+        return po
 
     def _ensure_intent(
         self, res: ComposableResource, verb: str

@@ -56,9 +56,19 @@ class TracedFabricProvider:
 
             provider = type(self._inner).__name__
 
+            group_verb = name in ("add_resources", "remove_resources")
+
             def traced(*args, **kwargs):
+                extra = {}
+                if group_verb and args:
+                    # Group calls carry their fan-out so the trace shows
+                    # how many members one wire call amortized.
+                    try:
+                        extra["members"] = len(args[0])
+                    except TypeError:
+                        pass
                 with tracing.span(f"fabric.{name}", cat="fabric",
-                                  provider=provider):
+                                  provider=provider, **extra):
                     return attr(*args, **kwargs)
 
             # Only verb wrappers are cached — other attributes (test-pool

@@ -66,6 +66,7 @@ from tpu_composer.fabric.provider import (
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
 )
+from tpu_composer.runtime import tracing
 from tpu_composer.runtime.metrics import (
     fabric_batch_size,
     fabric_calls_total,
@@ -92,7 +93,7 @@ _DONE = "done"  # outcome parked for the next reconcile to consume
 class _Op:
     __slots__ = (
         "verb", "resource", "node", "name", "on_ready", "state",
-        "result", "error", "submitted", "next_poll", "wait_msg",
+        "result", "error", "submitted", "next_poll", "wait_msg", "ctx",
     )
 
     def __init__(self, verb: str, resource: ComposableResource, now: float) -> None:
@@ -107,6 +108,10 @@ class _Op:
         self.submitted = now
         self.next_poll = 0.0
         self.wait_msg = ""
+        # Causal handoff from the submitting reconcile span (trace_id = the
+        # durable pending_op nonce): the execute pass links it into the
+        # dispatch span, and completion spans re-hand it to the requeue.
+        self.ctx: Optional[tracing.TraceContext] = None
 
     @property
     def key(self) -> Tuple[str, str]:
@@ -313,6 +318,11 @@ class FabricDispatcher:
                     )
                 self.start()  # lazy start: facade usable without wiring order
                 op = _Op(verb, resource, time.monotonic())
+                active = tracing.context()
+                if active is not None:
+                    # Flow-start on the submitting thread, bound to the
+                    # reconcile span doing this submission.
+                    op.ctx = active.handoff()
                 # A parked outcome of the OPPOSITE verb is stale the moment
                 # the state machine moves on (attach result nobody consumed
                 # before deletion began, and vice versa).
@@ -327,6 +337,10 @@ class FabricDispatcher:
                 # exact object it was issued with.
                 if op.state == _QUEUED:
                     op.resource = resource
+                if op.ctx is None:
+                    active = tracing.context()
+                    if active is not None:
+                        op.ctx = active.handoff()
             if on_ready is not None:
                 op.on_ready = [on_ready]
             if op.state == _PENDING:
@@ -427,7 +441,7 @@ class FabricDispatcher:
             try:
                 self._execute(verb, ops)
             finally:
-                callbacks = []
+                fired: List[Tuple[_Op, List[Callable[[], None]]]] = []
                 with self._cond:
                     lane.busy = False
                     for op in ops:
@@ -437,18 +451,34 @@ class FabricDispatcher:
                         # in-process stop() can re-fire it — without this, a
                         # restart between completion and consumption would
                         # silently strand the result until a poll timer.
-                        callbacks.extend(op.on_ready)
+                        if op.on_ready:
+                            fired.append((op, list(op.on_ready)))
                     # Prune empty lanes so churning fleets don't grow the
                     # lane map forever (O(1): a batch shares one node).
                     node = ops[0].node
                     if self._lanes.get(node) is lane and lane.idle():
                         del self._lanes[node]
                     self._cond.notify_all()
-                for cb in callbacks:
-                    try:
-                        cb()
-                    except Exception:
-                        self.log.exception("on_ready latch failed")
+                for op, callbacks in fired:
+                    # The completion side of the causal chain: a short span
+                    # in the op's trace wraps the latch, so the queue.add
+                    # the latch performs hands a flow off to the next
+                    # reconcile — Perfetto shows dispatch -> completion ->
+                    # requeued reconcile as connected arrows across threads.
+                    ctx = (
+                        tracing.TraceContext(trace_id=op.ctx.trace_id)
+                        if op.ctx is not None else None
+                    )
+                    with tracing.span(
+                        "dispatch.complete", cat="dispatcher",
+                        resource=op.name, verb=op.verb, state=op.state,
+                        ctx=ctx,
+                    ):
+                        for cb in callbacks:
+                            try:
+                                cb()
+                            except Exception:
+                                self.log.exception("on_ready latch failed")
 
     def _next_task(self, now: float):
         """Pick one lane turn: a window-expired FIFO batch, or a due shared
@@ -503,6 +533,26 @@ class FabricDispatcher:
 
     # -- execution (no dispatcher lock held) ----------------------------
     def _execute(self, verb: str, ops: List[_Op]) -> None:
+        # One parent span per lane turn. A single-member turn JOINS the
+        # member's trace (ctx consumes its flow); a batched turn stays
+        # trace-neutral but links every member's submission flow into
+        # itself — the "parent span with per-member links" shape, so
+        # Perfetto draws N arrows from N reconcile spans into one group
+        # call and back out via each member's completion span.
+        single_ctx = ops[0].ctx if len(ops) == 1 else None
+        with tracing.span(
+            f"dispatch.{verb}", cat="dispatcher", node=ops[0].node,
+            members=len(ops), ctx=single_ctx,
+        ) as sp:
+            if single_ctx is None:
+                for op in ops:
+                    tracing.link(op.ctx)
+                sp["resources"] = ",".join(op.name for op in ops[:16])
+            else:
+                sp["resource"] = ops[0].name
+            self._execute_inner(verb, ops)
+
+    def _execute_inner(self, verb: str, ops: List[_Op]) -> None:
         fabric_inflight.inc(len(ops))
         try:
             if len(ops) > 1 and self._group_verbs_ok is not False:

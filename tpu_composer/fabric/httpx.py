@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from tpu_composer.fabric.provider import FabricError, TransientFabricError
 from tpu_composer.fabric.token import TokenCache
+from tpu_composer.runtime import tracing
 from tpu_composer.runtime.metrics import fabric_retries_total
 
 #: Env override for every remote backend's HTTP timeout (seconds). The
@@ -141,6 +142,13 @@ class JsonHttpClient:
     ) -> Tuple[int, Dict[str, Any]]:
         url = self.base_url + path
         headers = {"Accept": "application/json"}
+        # Causal propagation across the wire: when this call runs inside a
+        # traced operation (the trace id is the durable pending_op nonce),
+        # the fabric manager sees which control-plane op caused the request
+        # — the header is the HTTP analog of the queue/dispatcher handoffs.
+        ctx = tracing.context()
+        if ctx is not None and ctx.trace_id:
+            headers["X-Tpuc-Trace-Id"] = ctx.trace_id
         data = None
         if body is not None:
             data = json.dumps(body).encode()

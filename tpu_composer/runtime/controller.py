@@ -154,10 +154,16 @@ class Controller:
             key = self.queue.get(timeout=0.2)
             if key is None:
                 continue
+            # Cross-thread causality: an add() made inside a traced span (a
+            # dispatcher completion latch, a sibling reconcile) parked a
+            # TraceContext for this key — joining it here draws the Chrome
+            # flow arrow from that span into this reconcile and makes the
+            # trace_id (the pending_op nonce) this thread's active trace.
+            ctx = self.queue.pop_context(key)
             try:
                 with tracing.span(
                     "reconcile", cat="controller",
-                    controller=self.name, object=key,
+                    controller=self.name, object=key, ctx=ctx,
                 ) as sp:
                     result = self.reconcile(key)  # type: ignore[arg-type]
                     sp["outcome"] = (

@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 from typing import Deque, List, Optional
 
 from tpu_composer.api.meta import now_iso
+from tpu_composer.runtime import lifecycle
 
 NORMAL = "Normal"
 WARNING = "Warning"
@@ -38,6 +39,10 @@ class EventRecorder:
         with self._lock:
             self._events.append(ev)
         self.log.debug("%s %s/%s %s: %s", type_, ev.kind, ev.name, reason, message)
+        # Mirror into the per-CR flight ledger: a crash dump should carry
+        # the controller's own narration (Quarantined, Preempted, NodeGone)
+        # next to the phase transitions it explains.
+        lifecycle.recorder.note_event(ev.kind, ev.name, type_, reason, message)
 
     def for_object(self, obj=None, kind: Optional[str] = None, name: Optional[str] = None) -> List[Event]:
         if obj is not None:

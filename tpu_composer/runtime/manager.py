@@ -10,17 +10,25 @@ from __future__ import annotations
 
 import hmac
 import http.server
+import json
 import logging
 import os
 import threading
+import urllib.parse
 from typing import Callable, List, Optional
 
 from tpu_composer.runtime.controller import Controller
 from tpu_composer.runtime.events import EventRecorder
 from tpu_composer.runtime.leader import LeaderElector
-from tpu_composer.runtime import tracing
+from tpu_composer.runtime import lifecycle, tracing
 from tpu_composer.runtime.metrics import global_registry
 from tpu_composer.runtime.store import Store
+
+#: /debug/traces responses are capped: a 10k-event ring serializes to
+#: multiple MB, and an unpaginated scrape of it from a dashboard poller
+#: must not balloon memory or saturate the probe port. Oldest events are
+#: dropped first (the ring's own semantics) and the response says so.
+TRACE_RESPONSE_BYTE_CAP = 2_000_000
 
 # A runnable is the analog of manager.Add(RunnableFunc) used by the
 # UpstreamSyncer (upstreamsyncer_controller.go:52-77): start(stop_event).
@@ -45,13 +53,23 @@ class _PlainTextHandler(http.server.BaseHTTPRequestHandler):
 class _HealthHandler(_PlainTextHandler):
     manager: "Manager"
 
+    def _respond_json(self, code: int, data: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):  # noqa: N802
-        if self.path == "/healthz":
+        parts = urllib.parse.urlsplit(self.path)
+        path = parts.path
+        query = urllib.parse.parse_qs(parts.query)
+        if path == "/healthz":
             self._respond(200, "ok")
-        elif self.path == "/readyz":
+        elif path == "/readyz":
             ready = self.manager.ready()
             self._respond(200 if ready else 503, "ok" if ready else "not ready")
-        elif self.path == "/metrics":
+        elif path == "/metrics":
             # With a dedicated (TLS/authenticated) metrics server
             # CONFIGURED — even one still waiting for its cert — the plain
             # health port must not leak the same data (the reference's
@@ -61,23 +79,60 @@ class _HealthHandler(_PlainTextHandler):
                 self._respond(404, "metrics served on the secure metrics port")
             else:
                 self._respond(200, global_registry.expose_text())
-        elif self.path == "/debug/traces":
+        elif path == "/debug/traces":
             # Chrome trace-event JSON of recent control-plane spans
             # (chrome://tracing / Perfetto). Names and durations only — no
             # secrets — mirroring Go's /debug/pprof convention the
-            # reference never wired up.
-            data = tracing.export_chrome().encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
-        elif self.path == "/debug/traces/summary":
-            import json as _json
-
-            self._respond(200, _json.dumps(tracing.summarize(), indent=1))
+            # reference never wired up. ?cat=<category> and ?limit=<n>
+            # narrow the export; responses are size-capped either way,
+            # dropping OLDEST events first (ring semantics) and reporting
+            # how many were dropped.
+            self._respond_json(200, self._trace_body(query))
+        elif path == "/debug/traces/summary":
+            cat = (query.get("cat") or [None])[0]
+            self._respond(200, json.dumps(tracing.summarize(cat=cat), indent=1))
+        elif path == "/debug/requests":
+            self._respond_json(200, json.dumps(
+                {"requests": lifecycle.recorder.names()}).encode())
+        elif path.startswith("/debug/requests/"):
+            # Per-CR lifecycle timeline: phase transitions with durations,
+            # span summaries and controller events — "where did this
+            # request's time go" as one JSON document.
+            name = urllib.parse.unquote(path[len("/debug/requests/"):])
+            timeline = lifecycle.recorder.timeline(name)
+            if timeline is None:
+                self._respond(404, f"no timeline recorded for {name!r}")
+            else:
+                self._respond_json(200, json.dumps(timeline, indent=1).encode())
         else:
             self._respond(404, "not found")
+
+    @staticmethod
+    def _trace_body(query) -> bytes:
+        cat = (query.get("cat") or [None])[0]
+        limit = None
+        raw_limit = (query.get("limit") or [None])[0]
+        if raw_limit is not None:
+            try:
+                limit = max(0, int(raw_limit))
+            except ValueError:
+                limit = None
+        events = tracing.snapshot(cat=cat, limit=limit)
+        total = len(events)
+
+        def body(evts) -> bytes:
+            doc = {"traceEvents": evts, "displayTimeUnit": "ms"}
+            if len(evts) < total:
+                doc["truncated"] = total - len(evts)
+            return json.dumps(doc).encode()
+
+        data = body(events)
+        while len(data) > TRACE_RESPONSE_BYTE_CAP and events:
+            # Halve from the OLD end until it fits — newest spans are the
+            # ones a live debugging session wants.
+            events = events[len(events) // 2 + 1:]
+            data = body(events)
+        return data
 
 
 class _MetricsHandler(_PlainTextHandler):
@@ -162,6 +217,11 @@ class Manager:
         # they run only once leadership is held — a standby must not probe
         # the fabric — and strictly before the first reconcile fires.
         self._startup_hooks: List[Callable[[], None]] = []
+        # Observability plumbing: span sink + crash hooks (atexit /
+        # unhandled thread exception -> flight-recorder + trace dump) are
+        # registered once per process; the lifecycle watch runnable below
+        # feeds per-CR phase timelines from this manager's store.
+        lifecycle.install()
 
     def add_controller(self, controller: Controller) -> None:
         self._controllers.append(controller)
@@ -284,6 +344,16 @@ class Manager:
                     "startup hook failed; relying on reconcile-path recovery"
                 )
 
+        # Lifecycle timelines: a watch-fed tracker records every CR state
+        # transition (phase durations -> tpuc_phase_duration_seconds, the
+        # /debug/requests timelines, and the flight recorder's ledger).
+        t = threading.Thread(
+            target=lifecycle.watch_runnable(self.store), args=(self._stop,),
+            name="lifecycle-watch", daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+
         for c in self._controllers:
             c.start(workers=workers_per_controller)
         for r in self._runnables:
@@ -336,6 +406,10 @@ class Manager:
                     " recover via adoption on the next start",
                     self._drain_timeout,
                 )
+                # A drain timeout is a crash-shaped exit: leave the black
+                # box behind (flight ledger + trace ring, both env-gated)
+                # so the operator can see WHAT was still in flight.
+                lifecycle.dump_crash("drain-timeout")
         self._stop.set()
         for c in self._controllers:
             c.stop()

@@ -19,10 +19,18 @@ _DEFAULT_BUCKETS = (
 )
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text exposition requires backslash, double-quote and
+    newline escaped inside label values — an error string landing in a
+    label (chaos injections carry exception text) must not corrupt the
+    scrape for every metric after it."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in labels)
     return "{" + inner + "}"
 
 
@@ -54,6 +62,12 @@ class Counter:
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values.pop(key, None)
+
+    def label_sets(self) -> List[Dict[str, str]]:
+        """Every label combination this metric has observed (bench/debug
+        introspection — e.g. enumerating which phases have durations)."""
+        with self._lock:
+            return [dict(key) for key in self._values]
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
@@ -123,6 +137,11 @@ class Histogram:
             return None
         idx = min(len(samples) - 1, max(0, int(round(q * (len(samples) - 1)))))
         return samples[idx]
+
+    def label_sets(self) -> List[Dict[str, str]]:
+        """Every label combination observed (see Counter.label_sets)."""
+        with self._lock:
+            return [dict(key) for key in self._counts]
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
@@ -321,6 +340,22 @@ scheduler_time_to_placement_seconds = global_registry.histogram(
 scheduler_defrag_migrations_total = global_registry.counter(
     "tpuc_scheduler_defrag_migrations_total",
     "Worker migrations started by the defragmentation planner",
+)
+
+#: Causal tracing + lifecycle timelines (runtime/tracing.py +
+#: runtime/lifecycle.py): per-CR phase transitions with durations — the
+#: attach-latency curve decomposed by stage (Pending | Scheduled |
+#: Attaching | Ready | Detaching | Terminating), by object kind.
+phase_duration_seconds = global_registry.histogram(
+    "tpuc_phase_duration_seconds",
+    "Seconds an object spent in the lifecycle phase it just left, by kind"
+    " (request | resource) and phase — fed by the manager's lifecycle"
+    " tracker watching state transitions",
+)
+flight_dumps_total = global_registry.counter(
+    "tpuc_flight_dumps_total",
+    "Flight-recorder dumps written, by reason (drain-timeout |"
+    " unhandled-exception | atexit | manual)",
 )
 
 

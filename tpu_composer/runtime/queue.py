@@ -19,6 +19,14 @@ controller-runtime reconciler in /root/reference/internal/controller. Contract:
   ``add_after`` entries (periodic polls) are never invalidated — they are
   liveness, not backoff.
 
+Causal tracing rides the queue: ``add`` called from inside a traced span
+(a dispatcher completion latch, a reconcile that just submitted a fabric
+op) captures a ``TraceContext`` handoff for the key — emitting the Chrome
+flow-start on the producing thread — and the worker that dequeues the key
+consumes it via ``pop_context``, so the next reconcile span joins the same
+trace with a cross-thread flow arrow. Deduped re-adds keep the NEWEST
+context (latest causality wins).
+
 The ready queue is a ``collections.deque``: under deep queues (an attach
 wave fanning hundreds of keys out) the old ``list.pop(0)`` made every get
 O(n) — O(n^2) to drain the wave.
@@ -32,6 +40,8 @@ import random
 import threading
 import time
 from typing import Deque, Dict, Hashable, List, Optional, Tuple
+
+from tpu_composer.runtime import tracing
 
 
 class RateLimitingQueue:
@@ -60,14 +70,40 @@ class RateLimitingQueue:
         self._delayed: List[Tuple[float, int, Hashable, Optional[int]]] = []
         self._backoff_gen: Dict[Hashable, int] = {}
         self._backoff_pending: Dict[Hashable, int] = {}  # outstanding entries
+        # key -> TraceContext handed off by the most recent add() made from
+        # inside a traced span; claimed at dequeue (get() moves it to
+        # _claimed_ctx under the same lock hold) and consumed by the
+        # worker's pop_context. Bounded by queued+dirty+processing counts.
+        self._trace_ctx: Dict[Hashable, tracing.TraceContext] = {}
+        self._claimed_ctx: Dict[Hashable, tracing.TraceContext] = {}
         self._seq = 0
         self._shutdown = False
 
     # ------------------------------------------------------------------
-    def add(self, key: Hashable) -> None:
+    def add(
+        self, key: Hashable, ctx: Optional[tracing.TraceContext] = None
+    ) -> None:
         with self._cond:
             if self._shutdown:
+                # No handoff either: a flow-start with no consumer would
+                # leave a dangling arrow in the exported trace.
                 return
+            if ctx is None:
+                active = tracing.context()
+                if active is not None:
+                    # Capture the causal edge NOW, on the producing thread
+                    # — the flow-start must bind to the span doing the add.
+                    # (tracing's ring lock nests inside this queue's lock;
+                    # tracing never calls back into the queue.)
+                    ctx = active.handoff()
+            if ctx is not None:
+                old = self._trace_ctx.get(key)
+                if old is not None:
+                    # Newest causality wins; close the superseded
+                    # handoff's arrow into this producing span so no
+                    # flow-start dangles unmatched in the export.
+                    tracing.link(old)
+                self._trace_ctx[key] = ctx
             if key in self._processing:
                 self._dirty.add(key)
                 return
@@ -75,6 +111,15 @@ class RateLimitingQueue:
                 self._queued.add(key)
                 self._queue.append(key)
                 self._cond.notify()
+
+    def pop_context(self, key: Hashable) -> Optional[tracing.TraceContext]:
+        """Consume the propagated trace context for a just-dequeued key.
+        Returns only the context CLAIMED by this key's dequeue (get() moves
+        it out of the parked map under the same lock hold), so a context
+        parked by a concurrent add() after the dequeue is preserved for
+        the requeued reconcile it belongs to."""
+        with self._cond:
+            return self._claimed_ctx.pop(key, None)
 
     def add_after(self, key: Hashable, delay: float) -> None:
         if delay <= 0:
@@ -113,6 +158,13 @@ class RateLimitingQueue:
         self._cond.notify()
 
     def forget(self, key: Hashable) -> None:
+        # NOTE: deliberately leaves _trace_ctx alone. forget() runs on the
+        # success path while the key is still marked processing — its own
+        # context was already consumed by pop_context at dequeue, so any
+        # context present NOW was parked by a concurrent add() (a dispatcher
+        # completion latch firing mid-reconcile, which also set the dirty
+        # bit) and belongs to the upcoming requeued reconcile. Popping it
+        # here would sever the completion -> requeue flow arrow.
         with self._cond:
             self._failures.pop(key, None)
             self._last_delay.pop(key, None)
@@ -162,6 +214,13 @@ class RateLimitingQueue:
                     key = self._queue.popleft()
                     self._queued.discard(key)
                     self._processing.add(key)
+                    # Claim the key's parked context ATOMICALLY with the
+                    # dequeue: an add() landing after this point (e.g. a
+                    # completion latch) parks a context for the NEXT
+                    # reconcile — pop_context must never hand it to the
+                    # one that just started.
+                    if key in self._trace_ctx:
+                        self._claimed_ctx[key] = self._trace_ctx.pop(key)
                     return key
                 if self._shutdown:
                     return None
@@ -187,6 +246,8 @@ class RateLimitingQueue:
     def shutdown(self) -> None:
         with self._cond:
             self._shutdown = True
+            self._trace_ctx.clear()
+            self._claimed_ctx.clear()
             self._cond.notify_all()
 
     def __len__(self) -> int:

@@ -1,22 +1,41 @@
-"""Lightweight tracing for the control plane — spans over reconciles,
-fabric calls and agent actuation, exported as Chrome trace-event JSON.
+"""Causal tracing for the control plane — spans over reconciles, fabric
+calls and agent actuation, connected across threads by explicit
+``TraceContext`` propagation, exported as Chrome trace-event JSON.
 
 The reference has NO tracing at all (SURVEY.md §5: no pprof, no otel — its
 only observability is logs plus default metrics), which makes attach-path
-latency regressions archaeology. This subsystem exceeds that bar with ~150
-lines and zero dependencies:
+latency regressions archaeology. The original subsystem here recorded
+thread-local spans only; the moment an attach crossed a thread boundary
+(queue -> reconcile worker -> dispatcher lane -> completion latch ->
+requeue, or a restart + adoption pass) causality was lost. This version
+makes the causality explicit:
 
 - ``span(name, **attrs)``: context manager recording wall-time begin/end
   with attributes; spans nest via a thread-local stack, so a reconcile's
   fabric call shows up as a child of the reconcile span.
+- ``TraceContext``: a (trace_id, flow) pair handed across thread
+  boundaries. ``ctx.handoff()`` emits a Chrome *flow-start* event bound to
+  the current span; opening a span with ``ctx=...`` (or calling
+  ``link(ctx)`` inside one) emits the matching *flow-finish* — Perfetto
+  draws an arrow from the producing span to the consuming one, across
+  threads. The trace_id for a fabric op IS the durable
+  ``status.pending_op`` nonce, so one attach renders as one connected
+  trace even across a process crash + adoption (the kill–restart soak
+  asserts this).
 - A bounded in-memory ring (default 10k events — old traffic falls off
   rather than growing the heap) shared process-wide.
 - ``export_chrome()``: the whole ring as Chrome trace-event JSON ("cat"
   = component, thread = worker) — load it in chrome://tracing or Perfetto.
 - The manager's health server exposes ``/debug/traces`` (same port as
-  healthz; read-only, no secrets — attribute values are names/counts).
-- ``TPUC_TRACE_FILE``: write the ring to a file at manager stop, for
-  headless runs.
+  healthz; read-only, no secrets — attribute values are names/counts),
+  with ``?cat=`` / ``?limit=`` filtering and a response-size cap.
+- ``TPUC_TRACE_FILE``: write the ring to a file at manager stop — and,
+  via the crash hooks runtime/lifecycle.py installs, at interpreter exit
+  and on unhandled thread exceptions, so a wedged or killed-by-exception
+  process still leaves a trace behind.
+- ``TPUC_TRACE=0`` (or ``set_enabled(False)``): hard-disable recording —
+  ``span`` degrades to a no-op yield; the perf-smoke gate asserts the
+  enabled path stays within 5% of this on the 32-chip wave.
 
 The workload side (JAX) keeps its own richer profiler: ``jax.profiler``
 traces device execution; this module covers the operator half the device
@@ -29,24 +48,146 @@ import json
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
-from typing import Any, Deque, Dict, Iterator, List, Optional
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, Iterator, List, Optional, Tuple
 
 _lock = threading.Lock()
 _events: Deque[Dict[str, Any]] = deque(maxlen=10_000)
 _tls = threading.local()
 _t0 = time.perf_counter()
-# Monotonically-increasing ids so Perfetto can pair nested spans cheaply.
+# Monotonically-increasing ids shared by spans and flows so Perfetto can
+# pair nested spans and flow arrows cheaply.
 _next_id = 0
+_enabled = os.environ.get("TPUC_TRACE", "1") != "0"
+# Span-end sinks (the flight recorder subscribes): called OUTSIDE the ring
+# lock with the finished event dict; exceptions are swallowed — a broken
+# sink must never take down a reconcile.
+_sinks: List[Callable[[Dict[str, Any]], None]] = []
+
+#: Flow events all share one (name, cat) pair — Chrome/Perfetto match
+#: flow-start to flow-finish on (name, cat, id), and the ids are unique.
+_FLOW_NAME = "causal"
+_FLOW_CAT = "flow"
 
 
 def _now_us() -> float:
     return (time.perf_counter() - _t0) * 1e6
 
 
+def _new_id() -> int:
+    global _next_id
+    with _lock:
+        _next_id += 1
+        return _next_id
+
+
+def _tid() -> int:
+    return threading.get_ident() % 1_000_000
+
+
+@dataclass
+class TraceContext:
+    """A causal handle crossing a thread (or process-restart) boundary.
+
+    ``trace_id`` groups every span of one logical operation — for fabric
+    ops it is the durable ``status.pending_op`` nonce, which is what makes
+    the trace survive a crash + adoption. ``flow_id`` is a one-shot Chrome
+    flow-arrow id emitted by :meth:`handoff` on the producing thread and
+    consumed by the first ``span(ctx=...)`` / ``link`` on the consumer.
+    """
+
+    trace_id: str
+    flow_id: Optional[int] = None
+    _flow_consumed: bool = field(default=False, repr=False)
+
+    def handoff(self) -> "TraceContext":
+        """Mint a context to hand to another thread: emits a flow-start
+        bound to the CURRENT thread's enclosing span and returns a fresh
+        context (same trace_id, new one-shot flow id)."""
+        if not _enabled:
+            return TraceContext(self.trace_id)
+        fid = _new_id()
+        evt = {
+            "name": _FLOW_NAME, "cat": _FLOW_CAT, "ph": "s", "id": fid,
+            "ts": _now_us(), "pid": os.getpid(), "tid": _tid(),
+            "args": {"trace_id": self.trace_id},
+        }
+        with _lock:
+            _events.append(evt)
+        return TraceContext(self.trace_id, flow_id=fid)
+
+
+def new_trace(trace_id: Optional[str] = None) -> TraceContext:
+    return TraceContext(trace_id or uuid.uuid4().hex[:12])
+
+
+def context() -> Optional[TraceContext]:
+    """The thread's active TraceContext (None outside any trace)."""
+    return getattr(_tls, "ctx", None)
+
+
+def _consume_flow(ctx: TraceContext, ts: Optional[float] = None) -> None:
+    if ctx.flow_id is None or ctx._flow_consumed:
+        return
+    ctx._flow_consumed = True
+    evt = {
+        "name": _FLOW_NAME, "cat": _FLOW_CAT, "ph": "f", "bp": "e",
+        "id": ctx.flow_id, "ts": ts if ts is not None else _now_us(),
+        "pid": os.getpid(), "tid": _tid(),
+        "args": {"trace_id": ctx.trace_id},
+    }
+    with _lock:
+        _events.append(evt)
+
+
+def link(ctx: Optional[TraceContext]) -> None:
+    """Consume ``ctx``'s pending flow inside the current span — draws the
+    arrow from the producing span into this one WITHOUT making ctx the
+    thread's active context (how a batched group call links each member's
+    submission into the one parent dispatch span)."""
+    if ctx is None or not _enabled:
+        return
+    _consume_flow(ctx)
+
+
+def adopt_trace(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Make ``ctx`` the thread's active context and back-fill its trace_id
+    into every currently-open span (the reconcile span is already open when
+    the resource controller discovers the CR's pending_op nonce). Returns
+    the previous context; the enclosing ``span()`` restores it on exit.
+
+    Outside any open span the context is NOT made active — there would be
+    no restore point, so it would leak onto the thread and stamp every
+    later unrelated span (bit tests calling reconcile() directly, without
+    the controller loop's wrapping span)."""
+    prev = getattr(_tls, "ctx", None)
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        _tls.ctx = ctx
+    if ctx is not None and _enabled:
+        for _, args in stack or ():
+            args["trace_id"] = ctx.trace_id
+        _consume_flow(ctx)
+    return prev
+
+
+def set_enabled(on: bool) -> None:
+    """Hard on/off switch (TPUC_TRACE=0). Disabled: spans yield without
+    recording, handoffs carry trace ids but emit nothing."""
+    global _enabled
+    _enabled = on
+
+
+def enabled() -> bool:
+    return _enabled
+
+
 def configure(capacity: int) -> None:
-    """Resize the ring (drops current contents)."""
+    """Resize the ring (drops current contents). Safe during active spans:
+    in-flight spans append into whichever ring is current at their end."""
     global _events
     with _lock:
         _events = deque(maxlen=capacity)
@@ -57,26 +198,49 @@ def reset() -> None:
         _events.clear()
 
 
-def _depth() -> int:
-    return len(getattr(_tls, "stack", ()))
+def add_span_sink(fn: Callable[[Dict[str, Any]], None]) -> None:
+    if fn not in _sinks:
+        _sinks.append(fn)
+
+
+def remove_span_sink(fn: Callable[[Dict[str, Any]], None]) -> None:
+    if fn in _sinks:
+        _sinks.remove(fn)
 
 
 @contextmanager
-def span(name: str, cat: str = "operator", **attrs: Any) -> Iterator[Dict[str, Any]]:
+def span(
+    name: str, cat: str = "operator", ctx: Optional[TraceContext] = None,
+    **attrs: Any,
+) -> Iterator[Dict[str, Any]]:
     """Record one complete span. Yields the attribute dict so callers can
-    attach results discovered mid-span (e.g. outcome="requeued")."""
-    global _next_id
+    attach results discovered mid-span (e.g. outcome="requeued").
+
+    ``ctx`` joins the span to a propagated trace: its trace_id lands in the
+    span's args, its pending flow (if any) is consumed here — drawing the
+    cross-thread arrow into this span — and it becomes the thread's active
+    context for the span's duration, so child spans (and handoffs made
+    inside) inherit the trace."""
+    if not _enabled:
+        yield dict(attrs)
+        return
     if not hasattr(_tls, "stack"):
         _tls.stack = []
-    with _lock:
-        _next_id += 1
-        sid = _next_id
-    parent = _tls.stack[-1] if _tls.stack else None
-    _tls.stack.append(sid)
+    sid = _new_id()
+    parent = _tls.stack[-1][0] if _tls.stack else None
+    prev_ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        _tls.ctx = ctx
+    active = getattr(_tls, "ctx", None)
     args: Dict[str, Any] = dict(attrs)
     if parent is not None:
         args["parent_span"] = parent
+    if active is not None and active.trace_id:
+        args["trace_id"] = active.trace_id
+    _tls.stack.append((sid, args))
     begin = _now_us()
+    if ctx is not None:
+        _consume_flow(ctx, begin)
     try:
         yield args
     except BaseException as e:
@@ -84,6 +248,7 @@ def span(name: str, cat: str = "operator", **attrs: Any) -> Iterator[Dict[str, A
         raise
     finally:
         _tls.stack.pop()
+        _tls.ctx = prev_ctx
         end = _now_us()
         evt = {
             "name": name,
@@ -92,12 +257,17 @@ def span(name: str, cat: str = "operator", **attrs: Any) -> Iterator[Dict[str, A
             "ts": begin,
             "dur": end - begin,
             "pid": os.getpid(),
-            "tid": threading.get_ident() % 1_000_000,
+            "tid": _tid(),
             "id": sid,
             "args": {k: _safe(v) for k, v in args.items()},
         }
         with _lock:
             _events.append(evt)
+        for sink in list(_sinks):
+            try:
+                sink(evt)
+            except Exception:
+                pass  # a sink bug must never surface into the traced code
 
 
 def _safe(v: Any) -> Any:
@@ -106,20 +276,35 @@ def _safe(v: Any) -> Any:
     return str(v)
 
 
-def snapshot() -> List[Dict[str, Any]]:
+def snapshot(
+    cat: Optional[str] = None, limit: Optional[int] = None
+) -> List[Dict[str, Any]]:
+    """The ring's events, oldest first; ``cat`` filters by category and
+    ``limit`` keeps only the NEWEST n (what /debug/traces paginates on)."""
     with _lock:
-        return list(_events)
+        events = list(_events)
+    if cat:
+        events = [e for e in events if e.get("cat") == cat]
+    if limit is not None and limit >= 0:
+        # NB: events[-0:] would be the FULL list — limit=0 means none.
+        events = events[-limit:] if limit else []
+    return events
 
 
-def export_chrome() -> str:
-    """Chrome trace-event format (the JSON Array flavor) — open in
-    chrome://tracing or https://ui.perfetto.dev."""
-    return json.dumps({"traceEvents": snapshot(), "displayTimeUnit": "ms"})
+def export_chrome(events: Optional[List[Dict[str, Any]]] = None) -> str:
+    """Chrome trace-event format (the JSON Object flavor) — open in
+    chrome://tracing or https://ui.perfetto.dev. Flow events ("ph": s/f)
+    render as arrows connecting spans across threads."""
+    if events is None:
+        events = snapshot()
+    return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
 
 
 def write_file(path: Optional[str] = None) -> Optional[str]:
     """Dump the ring to ``path`` (default $TPUC_TRACE_FILE); returns the
-    path written or None when tracing-to-file is not configured."""
+    path written or None when tracing-to-file is not configured. Called at
+    clean manager stop, on drain-timeout, and by the lifecycle crash hooks
+    (atexit / unhandled thread exception)."""
     path = path or os.environ.get("TPUC_TRACE_FILE")
     if not path:
         return None
@@ -133,6 +318,8 @@ def summarize(cat: Optional[str] = None) -> Dict[str, Dict[str, float]]:
     answers 'where did the attach time go' without leaving the terminal."""
     out: Dict[str, Dict[str, float]] = {}
     for e in snapshot():
+        if e.get("ph") != "X":
+            continue  # flow events carry no duration
         if cat and e["cat"] != cat:
             continue
         s = out.setdefault(e["name"], {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
@@ -141,3 +328,11 @@ def summarize(cat: Optional[str] = None) -> Dict[str, Dict[str, float]]:
         s["total_ms"] += dur_ms
         s["max_ms"] = max(s["max_ms"], dur_ms)
     return out
+
+
+def trace_events(trace_id: str) -> List[Dict[str, Any]]:
+    """Every ring event belonging to one trace (spans + flow arrows)."""
+    return [
+        e for e in snapshot()
+        if e.get("args", {}).get("trace_id") == trace_id
+    ]
