@@ -4,7 +4,7 @@
 PYTHON ?= python
 IMG ?= tpu-composer:latest
 
-.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean build-installer bundle crash-soak chaos-soak
+.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak
 
 all: native test
 
@@ -57,6 +57,17 @@ crash-soak:
 ## steps upload both as failure artifacts).
 chaos-soak:
 	$(PYTHON) -m pytest tests/test_chaos_soak.py -q -m chaos -p no:randomly
+
+## repair-soak: self-healing soak (tests/test_repair_soak.py, markers
+## slow+repair): 100 attach/detach cycles (cache-on, batched) with 10%
+## scripted post-Ready device death at a fixed seed — every request must
+## converge back to full Ready (make-before-break replacement), with zero
+## double-attaches (nonce-checked), the surge budget never exceeded, and
+## the fleet repair breaker freezing repairs in a >50%-degraded brownout
+## instead of mass-detaching. Same black-box contract as the other soaks
+## (TPUC_FLIGHT_FILE / TPUC_TRACE_FILE dumped + uploaded on CI failure).
+repair-soak:
+	$(PYTHON) -m pytest tests/test_repair_soak.py -q -m repair -p no:randomly
 
 ## watch-relay: poll the TPU tunnel relay; auto-capture the full on-chip
 ## probe to bench_artifacts/ the moment it answers (run at round start)

@@ -75,6 +75,12 @@ def pytest_configure(config):
         " `make crash-soak` or `pytest -m crash`; CRASH_SEED=random for"
         " local randomized soaks)",
     )
+    config.addinivalue_line(
+        "markers",
+        "repair: post-Ready failure/repair soak (scripted device death"
+        " under Ready slices; always also marked slow; run with"
+        " `make repair-soak` or `pytest -m repair`)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -269,13 +269,23 @@ class TestPoolFaultMatrix:
         to_online(w)
         chip = get(w).status.device_ids[0]
         w.pool.set_health(chip, DeviceHealth("Critical", "ICI link down"))
-        w.rec.reconcile("r0")
+        # Damped: below the threshold a bad probe writes nothing.
+        for _ in range(w.rec.timing.health_failure_threshold - 1):
+            w.rec.reconcile("r0")
+            cr = get(w)
+            assert cr.status.state == RESOURCE_STATE_ONLINE
+            assert cr.status.error == ""
+        w.rec.reconcile("r0")  # threshold crossed -> durable Degraded
         cr = get(w)
-        assert cr.status.state == RESOURCE_STATE_ONLINE  # degraded, not dead
+        assert cr.status.state == "Degraded"
         assert "Critical" in cr.status.error
+        assert cr.status.failure is not None
         w.pool.set_health(chip, DeviceHealth())
-        w.rec.reconcile("r0")
-        assert get(w).status.error == ""
+        for _ in range(w.rec.timing.health_recovery_threshold):
+            w.rec.reconcile("r0")
+        cr = get(w)
+        assert cr.status.state == RESOURCE_STATE_ONLINE
+        assert cr.status.error == ""
 
     def test_busy_chips_block_detach_until_idle(self, world):
         w = world

@@ -1,0 +1,707 @@
+"""Self-healing data plane: post-Ready failure detection, automatic member
+repair, and repair-storm containment.
+
+Tier-1 acceptance spine for ISSUE 7: a chip dying under a Ready slice is
+detected by damped health probes (or the syncer's device-vanished pass),
+the member transitions to a durable Degraded state with a structured
+failure record, and the request controller drives a make-before-break
+repair — replacement placed on healthy capacity, attached, then the failed
+member force-detached after the drain grace — bounded by the per-request
+surge budget and the fleet-level repair breaker (a brownout freezes repairs
+instead of mass-detaching). The 100-cycle soak is in test_repair_soak.py
+(marked slow/repair); everything here runs in tier-1.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.agent.publisher import node_quarantined
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    ComposableResourceSpec,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.dra import DeviceTaintRule
+from tpu_composer.api.types import (
+    ANNOTATION_REPLACES,
+    REPAIR_DETACH_ONLY,
+    REPAIR_NONE,
+    REQUEST_STATE_RUNNING,
+    RESOURCE_STATE_DEGRADED,
+    RESOURCE_STATE_ONLINE,
+    RESOURCE_STATE_REPAIRING,
+)
+from tpu_composer.controllers.request_controller import (
+    ComposabilityRequestReconciler,
+    RepairConfig,
+    RequestTiming,
+)
+from tpu_composer.controllers.resource_controller import (
+    ComposableResourceReconciler,
+    ResourceTiming,
+)
+from tpu_composer.controllers.syncer import UpstreamSyncer
+from tpu_composer.fabric.chaos import ChaosFabricProvider
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.fabric.provider import (
+    FabricError,
+    UnsupportedRepair,
+)
+from tpu_composer.runtime.metrics import (
+    composed_chips,
+    repair_breaker_open,
+    repairs_total,
+)
+from tpu_composer.runtime.store import Store
+
+MODEL = "tpu-v4"
+
+
+def make_world(nodes=4, chips=64, failure_threshold=2, recovery_threshold=1,
+               node_degrade_threshold=0, repair=None, spec_kw=None,
+               pool_cls=InMemoryPool):
+    """Step-driven harness (no Manager threads): store + chaos-wrapped mock
+    pool + both reconcilers with fast repair timing."""
+    store = Store()
+    for i in range(nodes):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 8
+        store.create(n)
+    pool = pool_cls(chips={MODEL: chips})
+    chaos = ChaosFabricProvider(pool)
+    agent = FakeNodeAgent(pool=pool)
+    req_rec = ComposabilityRequestReconciler(
+        store, chaos,
+        timing=RequestTiming(updating_poll=0.01, cleaning_poll=0.01,
+                             running_poll=5.0, repair_poll=0.01),
+        repair=repair or RepairConfig(),
+    )
+    res_rec = ComposableResourceReconciler(
+        store, chaos, agent,
+        timing=ResourceTiming(
+            health_failure_threshold=failure_threshold,
+            health_recovery_threshold=recovery_threshold,
+            node_degrade_threshold=node_degrade_threshold,
+        ),
+    )
+    return store, pool, chaos, req_rec, res_rec
+
+
+def make_request(store, name="req-1", size=8, **spec_kw):
+    store.create(ComposabilityRequest(
+        metadata=ObjectMeta(name=name),
+        spec=ComposabilityRequestSpec(
+            resource=ResourceDetails(type="tpu", model=MODEL, size=size),
+            **spec_kw,
+        ),
+    ))
+
+
+def converged(store, name="req-1"):
+    req = store.try_get(ComposabilityRequest, name)
+    if req is None:
+        return False
+    live = [c for c in store.list(ComposableResource) if not c.being_deleted]
+    return (
+        req.status.state == REQUEST_STATE_RUNNING
+        and len(live) == req.status.slice.num_hosts
+        and all(c.status.state == RESOURCE_STATE_ONLINE for c in live)
+    )
+
+
+def pump(store, req_rec, res_rec, name="req-1", steps=80, invariant=None,
+         done=None):
+    """One scheduler-free event loop turn per step: request then every
+    resource, absorbing expected fabric errors like the worker loop's
+    backoff does. Stops when ``done()`` (default: the request converged —
+    Running with every member Online at full count)."""
+    finished = done or (lambda: converged(store, name))
+    for _ in range(steps):
+        try:
+            req_rec.reconcile(name)
+        except FabricError:
+            pass
+        for c in store.list(ComposableResource):
+            try:
+                res_rec.reconcile(c.metadata.name)
+            except FabricError:
+                pass
+        if invariant is not None:
+            invariant()
+        if finished():
+            return store.get(ComposabilityRequest, name)
+    return store.get(ComposabilityRequest, name)
+
+
+def to_running(store, req_rec, res_rec, name="req-1"):
+    req = pump(store, req_rec, res_rec, name)
+    assert req.status.state == REQUEST_STATE_RUNNING, req.status.to_dict()
+    return req
+
+
+def members(store):
+    return [c for c in store.list(ComposableResource) if not c.being_deleted]
+
+
+def no_duplicate_attachments(pool):
+    ids = [d.device_id for d in pool.get_resources()]
+    assert len(ids) == len(set(ids)), f"duplicate attachments: {ids}"
+
+
+# ---------------------------------------------------------------------------
+# Replace policy: make-before-break
+# ---------------------------------------------------------------------------
+
+class TestReplaceRepair:
+    def test_dead_chip_member_is_replaced_make_before_break(self):
+        store, pool, chaos, req_rec, res_rec = make_world()
+        make_request(store)
+        to_running(store, req_rec, res_rec)
+        victim = next(c for c in members(store) if c.spec.worker_id == 1)
+        old_name, old_node = victim.name, victim.spec.target_node
+        started = repairs_total.value(outcome="started")
+        replaced = repairs_total.value(outcome="replaced")
+
+        pool.kill_device(victim.status.device_ids[0])
+
+        # Make-before-break invariant: the failed member may only disappear
+        # after its replacement is Online (checked every pump turn).
+        seen = {"repl_online_before_old_gone": False}
+
+        def invariant():
+            no_duplicate_attachments(pool)
+            old = store.try_get(ComposableResource, old_name)
+            repl = next(
+                (c for c in store.list(ComposableResource)
+                 if c.metadata.annotations.get(ANNOTATION_REPLACES) == old_name),
+                None,
+            )
+            if repl is not None and repl.status.state == RESOURCE_STATE_ONLINE:
+                seen["repl_online_before_old_gone"] = True
+            if old is None or old.being_deleted:
+                assert seen["repl_online_before_old_gone"], (
+                    "failed member detached before its replacement was Online"
+                )
+
+        req = pump(
+            store, req_rec, res_rec, invariant=invariant,
+            done=lambda: (
+                store.try_get(ComposableResource, old_name) is None
+                and converged(store)
+            ),
+        )
+        assert req.status.state == REQUEST_STATE_RUNNING
+        live = members(store)
+        assert len(live) == 2
+        assert all(c.status.state == RESOURCE_STATE_ONLINE for c in live)
+        # The replacement took over worker 1 on a fresh node.
+        new_w1 = next(c for c in live if c.spec.worker_id == 1)
+        assert new_w1.name != old_name
+        assert new_w1.spec.target_node != old_node
+        assert new_w1.metadata.annotations.get(ANNOTATION_REPLACES) == old_name
+        # Authoritative coordinates follow the repair.
+        assert req.status.slice.worker_hostnames[1] == new_w1.spec.target_node
+        # The dead chip left circulation; no member holds it.
+        attached_ids = {d.device_id for d in pool.get_resources()}
+        assert not any(d in attached_ids for d in [victim.status.device_ids[0]])
+        assert pool.dead_chips(MODEL) == 1
+        assert repairs_total.value(outcome="started") == started + 1
+        assert repairs_total.value(outcome="replaced") == replaced + 1
+
+    def test_surge_budget_bounds_concurrent_repairs(self):
+        store, pool, chaos, req_rec, res_rec = make_world(nodes=8)
+        make_request(store, size=16, max_concurrent_repairs=1)
+        to_running(store, req_rec, res_rec)
+        victims = [c for c in members(store) if c.spec.worker_id in (0, 2)]
+        victim_names = {v.name for v in victims}
+        for v in victims:
+            pool.kill_device(v.status.device_ids[0])
+
+        max_repairing = {"n": 0}
+
+        def invariant():
+            repairing = [
+                c for c in store.list(ComposableResource)
+                if c.status.state == RESOURCE_STATE_REPAIRING
+            ]
+            max_repairing["n"] = max(max_repairing["n"], len(repairing))
+            assert len(repairing) <= 1, (
+                f"surge budget exceeded: {[c.name for c in repairing]}"
+            )
+            no_duplicate_attachments(pool)
+
+        req = pump(
+            store, req_rec, res_rec, steps=160, invariant=invariant,
+            done=lambda: (
+                not (victim_names
+                     & {c.name for c in store.list(ComposableResource)})
+                and converged(store)
+            ),
+        )
+        assert req.status.state == REQUEST_STATE_RUNNING
+        live = members(store)
+        assert len(live) == 4
+        assert all(c.status.state == RESOURCE_STATE_ONLINE for c in live)
+        assert not (victim_names & {c.name for c in live})
+        assert max_repairing["n"] == 1  # repairs actually serialized
+        assert pool.dead_chips(MODEL) == 2
+
+    def test_repair_waits_when_no_healthy_capacity(self):
+        # 2 nodes, 2-host slice: nowhere to place a replacement — the repair
+        # driver surfaces the failure and retries; the degraded member is
+        # NOT detached (better a degraded member than a smaller slice).
+        store, pool, chaos, req_rec, res_rec = make_world(nodes=2)
+        make_request(store)
+        to_running(store, req_rec, res_rec)
+        victim = members(store)[0]
+        pool.kill_device(victim.status.device_ids[0])
+        failed_before = repairs_total.value(outcome="failed")
+        req = pump(
+            store, req_rec, res_rec, steps=30,
+            done=lambda: repairs_total.value(outcome="failed") > failed_before,
+        )
+        assert repairs_total.value(outcome="failed") > failed_before
+        v = store.get(ComposableResource, victim.name)
+        assert v.status.state == RESOURCE_STATE_DEGRADED
+        assert "repair of" in req.status.error
+
+
+class _NoRepairPool(InMemoryPool):
+    def repair_slice_member(self, slice_name, worker_id, node):
+        raise UnsupportedRepair("this pool cannot swap chips in place")
+
+
+class TestPolicies:
+    def test_unsupported_repair_falls_back_to_resolve(self):
+        store, pool, chaos, req_rec, res_rec = make_world(
+            pool_cls=_NoRepairPool
+        )
+        make_request(store)
+        to_running(store, req_rec, res_rec)
+        victim = members(store)[0]
+        fallback_before = repairs_total.value(outcome="fallback")
+        pool.kill_device(victim.status.device_ids[0])
+        req = pump(
+            store, req_rec, res_rec, steps=160,
+            done=lambda: (
+                victim.name not in {c.name for c in members(store)}
+                and converged(store)
+            ),
+        )
+        assert req.status.state == REQUEST_STATE_RUNNING
+        live = members(store)
+        assert len(live) == 2
+        assert all(c.status.state == RESOURCE_STATE_ONLINE for c in live)
+        assert victim.name not in {c.name for c in live}
+        assert repairs_total.value(outcome="fallback") == fallback_before + 1
+        no_duplicate_attachments(pool)
+
+    def test_detach_only_policy_detaches_and_resolves(self):
+        store, pool, chaos, req_rec, res_rec = make_world()
+        make_request(store, repair_policy=REPAIR_DETACH_ONLY)
+        to_running(store, req_rec, res_rec)
+        victim = members(store)[0]
+        detached_before = repairs_total.value(outcome="detached")
+        pool.kill_device(victim.status.device_ids[0])
+        req = pump(
+            store, req_rec, res_rec, steps=160,
+            done=lambda: (
+                victim.name not in {c.name for c in members(store)}
+                and converged(store)
+            ),
+        )
+        assert req.status.state == REQUEST_STATE_RUNNING
+        live = members(store)
+        assert len(live) == 2
+        assert victim.name not in {c.name for c in live}
+        assert repairs_total.value(outcome="detached") == detached_before + 1
+        no_duplicate_attachments(pool)
+
+    def test_none_policy_leaves_degraded_member_for_operator(self):
+        store, pool, chaos, req_rec, res_rec = make_world()
+        make_request(store, repair_policy=REPAIR_NONE)
+        to_running(store, req_rec, res_rec)
+        victim = members(store)[0]
+        pool.kill_device(victim.status.device_ids[0])
+        pump(
+            store, req_rec, res_rec, steps=20,
+            done=lambda: "repairPolicy=None" in store.get(
+                ComposabilityRequest, "req-1"
+            ).status.error,
+        )
+        v = store.get(ComposableResource, victim.name)
+        assert v.status.state == RESOURCE_STATE_DEGRADED
+        assert v.status.failure is not None
+        # No replacement was placed, nothing was detached.
+        assert len(members(store)) == 2
+        assert not any(
+            c.metadata.annotations.get(ANNOTATION_REPLACES)
+            for c in store.list(ComposableResource)
+        )
+        req = store.get(ComposabilityRequest, "req-1")
+        assert "repairPolicy=None" in req.status.error
+        evs = req_rec.recorder.for_object(kind="ComposabilityRequest",
+                                          name="req-1")
+        assert any(e.reason == "DegradedNoRepair" for e in evs)
+        # In-place recovery clears the stale operator-action-required error.
+        pool.revive_device(victim.status.device_ids[0])
+        req = pump(
+            store, req_rec, res_rec, steps=40,
+            done=lambda: (
+                converged(store)
+                and not store.get(ComposabilityRequest, "req-1").status.error
+            ),
+        )
+        assert req.status.error == ""
+
+    def test_none_policy_does_not_starve_lost_member_recovery(self):
+        """A sibling sitting Degraded under repairPolicy=None must not
+        block the full re-solve when ANOTHER member's child object is lost
+        outright."""
+        store, pool, chaos, req_rec, res_rec = make_world()
+        make_request(store, repair_policy=REPAIR_NONE)
+        to_running(store, req_rec, res_rec)
+        sick, lost = sorted(members(store), key=lambda c: c.spec.worker_id)
+        pool.kill_device(sick.status.device_ids[0])
+        pump(store, req_rec, res_rec, steps=20,
+             done=lambda: store.get(
+                 ComposableResource, sick.name
+             ).status.state == RESOURCE_STATE_DEGRADED)
+        # Lose the other member's child entirely (node-GC analog).
+        store.delete(ComposableResource, lost.name)
+        req = pump(store, req_rec, res_rec, steps=200)
+        assert req.status.state == REQUEST_STATE_RUNNING
+        live = members(store)
+        assert len(live) == 2
+        assert all(c.status.state == RESOURCE_STATE_ONLINE for c in live)
+
+
+# ---------------------------------------------------------------------------
+# Storm containment: the fleet-level repair breaker
+# ---------------------------------------------------------------------------
+
+class TestRepairBreaker:
+    def test_brownout_freezes_repairs_instead_of_mass_detach(self):
+        store, pool, chaos, req_rec, res_rec = make_world(
+            nodes=8, repair=RepairConfig(breaker_fraction=0.5,
+                                         breaker_min_members=2,
+                                         min_degraded_seconds=0.5),
+        )
+        make_request(store, size=16)  # 4 members
+        to_running(store, req_rec, res_rec)
+        before = {c.name for c in members(store)}
+        # Brownout: 3 of 4 members' nodes go dark post-Ready (the fabric
+        # still answers — it just reports Critical everywhere).
+        victims = sorted(members(store), key=lambda c: c.spec.worker_id)[:3]
+        for v in victims:
+            chaos.degrade_node(v.spec.target_node)
+        pump(store, req_rec, res_rec, steps=30,
+             done=lambda: repair_breaker_open.value() == 1.0)
+        pump(store, req_rec, res_rec, steps=5, done=lambda: False)
+        # All three degraded, breaker open, NOTHING detached or replaced.
+        assert repair_breaker_open.value() == 1.0
+        live = members(store)
+        assert {c.name for c in live} == before
+        assert sum(
+            1 for c in live if c.status.state == RESOURCE_STATE_DEGRADED
+        ) == 3
+        evs = req_rec.recorder.for_object(kind="ComposabilityRequest",
+                                          name="req-1")
+        assert any(e.reason == "RepairsFrozen" for e in evs)
+        # Brownout lifts: members RECOVER in place (no repairs ever ran).
+        chaos.heal()
+        req = pump(store, req_rec, res_rec, steps=60)
+        assert req.status.state == REQUEST_STATE_RUNNING
+        assert {c.name for c in members(store)} == before
+        assert all(
+            c.status.state == RESOURCE_STATE_ONLINE for c in members(store)
+        )
+        req_rec.reconcile("req-1")  # one steady pass recomputes the breaker
+        assert repair_breaker_open.value() == 0.0
+
+    def test_single_failure_on_small_fleet_still_repairs(self):
+        # breaker_min_members guards the degenerate fraction: 1 degraded of
+        # 2 attached is 50% but NOT a brownout.
+        store, pool, chaos, req_rec, res_rec = make_world(
+            repair=RepairConfig(breaker_fraction=0.4, breaker_min_members=4),
+        )
+        make_request(store)
+        to_running(store, req_rec, res_rec)
+        victim = members(store)[0]
+        pool.kill_device(victim.status.device_ids[0])
+        req = pump(
+            store, req_rec, res_rec, steps=120,
+            done=lambda: (
+                victim.name not in {c.name for c in members(store)}
+                and converged(store)
+            ),
+        )
+        assert req.status.state == REQUEST_STATE_RUNNING
+        assert victim.name not in {c.name for c in members(store)}
+
+
+# ---------------------------------------------------------------------------
+# Node escalation (PR 1 quarantine path, distinct reason)
+# ---------------------------------------------------------------------------
+
+class TestNodeEscalation:
+    def test_repeated_post_ready_failures_quarantine_the_node(self):
+        store, pool, chaos, req_rec, res_rec = make_world(
+            node_degrade_threshold=2,
+        )
+        # Two independent single-host slices on worker-0.
+        for i, slice_name in enumerate(["s-a", "s-b"]):
+            pool.reserve_slice(slice_name, MODEL, "2x2x1", ["worker-0"])
+            store.create(ComposableResource(
+                metadata=ObjectMeta(name=f"r{i}"),
+                spec=ComposableResourceSpec(
+                    type="tpu", model=MODEL, target_node="worker-0",
+                    chip_count=4, slice_name=slice_name, worker_id=0,
+                    topology="2x2x1",
+                ),
+            ))
+            res_rec.reconcile(f"r{i}")  # "" -> Attaching
+            res_rec.reconcile(f"r{i}")  # Attaching -> Online
+            assert store.get(
+                ComposableResource, f"r{i}"
+            ).status.state == RESOURCE_STATE_ONLINE
+        for i in range(2):
+            cr = store.get(ComposableResource, f"r{i}")
+            pool.kill_device(cr.status.device_ids[0])
+            for _ in range(res_rec.timing.health_failure_threshold):
+                res_rec.reconcile(f"r{i}")
+            assert store.get(
+                ComposableResource, f"r{i}"
+            ).status.state == RESOURCE_STATE_DEGRADED
+        assert node_quarantined(store, "worker-0")
+        marker = next(
+            r for r in store.list(DeviceTaintRule)
+            if r.spec.node_name == "worker-0" and not r.spec.device_uuid
+        )
+        assert "post-ready-failures" in marker.spec.reason
+
+
+# ---------------------------------------------------------------------------
+# Syncer arm: device vanished from the fabric listing
+# ---------------------------------------------------------------------------
+
+class TestSyncerVanishDetection:
+    def _online_member(self, store, pool, chaos, res_rec):
+        pool.reserve_slice("s1", MODEL, "2x2x1", ["worker-0"])
+        store.create(ComposableResource(
+            metadata=ObjectMeta(name="r0"),
+            spec=ComposableResourceSpec(
+                type="tpu", model=MODEL, target_node="worker-0",
+                chip_count=4, slice_name="s1", worker_id=0, topology="2x2x1",
+            ),
+        ))
+        res_rec.reconcile("r0")
+        res_rec.reconcile("r0")
+        cr = store.get(ComposableResource, "r0")
+        assert cr.status.state == RESOURCE_STATE_ONLINE
+        return cr
+
+    def test_vanished_device_degrades_after_damping_window(self):
+        store, pool, chaos, req_rec, res_rec = make_world()
+        cr = self._online_member(store, pool, chaos, res_rec)
+        syncer = UpstreamSyncer(store, chaos, period=0.01, grace=100.0,
+                                vanish_threshold=2)
+        chaos.vanish_device(cr.status.device_ids[0])
+        syncer.sync_once(now=0.0)
+        # Damped: one glitchy listing writes nothing.
+        assert store.get(
+            ComposableResource, "r0"
+        ).status.state == RESOURCE_STATE_ONLINE
+        syncer.sync_once(now=1.0)
+        cr = store.get(ComposableResource, "r0")
+        assert cr.status.state == RESOURCE_STATE_DEGRADED
+        assert cr.status.failure is not None
+        assert cr.status.failure.source == "syncer"
+        assert cr.status.failure.reason == "device-vanished"
+
+    def test_listing_blip_does_not_count_toward_vanish(self):
+        store, pool, chaos, req_rec, res_rec = make_world()
+        cr = self._online_member(store, pool, chaos, res_rec)
+        syncer = UpstreamSyncer(store, chaos, period=0.01, grace=100.0,
+                                vanish_threshold=2)
+        # Fabric unreachable: sync_once raises; unreachable must never
+        # masquerade as vanished.
+        chaos.fail_op("get_resources", times=2)
+        for _ in range(2):
+            with pytest.raises(FabricError):
+                syncer.sync_once(now=0.0)
+        syncer.sync_once(now=1.0)
+        syncer.sync_once(now=2.0)
+        assert store.get(
+            ComposableResource, "r0"
+        ).status.state == RESOURCE_STATE_ONLINE
+
+    def test_vanished_member_recovers_when_devices_reappear(self):
+        """Listing-based recovery mirrors listing-based detection: the
+        member's own handler must NOT probe-recover a device-vanished
+        degrade (health answers OK while the attachment is missing — the
+        livelock); the syncer recovers it when the listing reports the
+        devices again."""
+        store, pool, chaos, req_rec, res_rec = make_world()
+        cr = self._online_member(store, pool, chaos, res_rec)
+        syncer = UpstreamSyncer(store, chaos, period=0.01, grace=100.0,
+                                vanish_threshold=2)
+        dev = cr.status.device_ids[0]
+        chaos.vanish_device(dev)
+        syncer.sync_once(now=0.0)
+        syncer.sync_once(now=1.0)
+        assert store.get(
+            ComposableResource, "r0"
+        ).status.state == RESOURCE_STATE_DEGRADED
+        # Probe-healthy reconciles must NOT flip it back (the probe path
+        # would: pool health is OK — only the listing lies).
+        for _ in range(res_rec.timing.health_recovery_threshold + 1):
+            res_rec.reconcile("r0")
+        assert store.get(
+            ComposableResource, "r0"
+        ).status.state == RESOURCE_STATE_DEGRADED
+        # Devices reappear -> the syncer recovers the member.
+        chaos.unvanish_device(dev)
+        syncer.sync_once(now=2.0)
+        cr = store.get(ComposableResource, "r0")
+        assert cr.status.state == RESOURCE_STATE_ONLINE
+        assert cr.status.failure is None
+
+    def test_vanished_member_is_repaired_despite_healthy_probe(self):
+        """The repair driver's last-look health probe must not veto repair
+        of a device-vanished member — its evidence is the listing, and a
+        healthy probe is exactly the failure mode being detected."""
+        store, pool, chaos, req_rec, res_rec = make_world()
+        make_request(store)
+        to_running(store, req_rec, res_rec)
+        victim = members(store)[0]
+        syncer = UpstreamSyncer(store, chaos, period=0.01, grace=100.0,
+                                vanish_threshold=2)
+        for dev in victim.status.device_ids:
+            chaos.vanish_device(dev)
+        syncer.sync_once(now=0.0)
+        syncer.sync_once(now=1.0)
+        assert store.get(
+            ComposableResource, victim.name
+        ).status.state == RESOURCE_STATE_DEGRADED
+        req = pump(
+            store, req_rec, res_rec, steps=160,
+            done=lambda: (
+                victim.name not in {c.name
+                                    for c in store.list(ComposableResource)}
+                and converged(store)
+            ),
+        )
+        assert req.status.state == REQUEST_STATE_RUNNING
+        assert victim.name not in {c.name for c in members(store)}
+
+    def test_reappearing_device_resets_the_vanish_clock(self):
+        store, pool, chaos, req_rec, res_rec = make_world()
+        cr = self._online_member(store, pool, chaos, res_rec)
+        syncer = UpstreamSyncer(store, chaos, period=0.01, grace=100.0,
+                                vanish_threshold=2)
+        dev = cr.status.device_ids[0]
+        chaos.vanish_device(dev)
+        syncer.sync_once(now=0.0)
+        chaos.unvanish_device(dev)
+        syncer.sync_once(now=1.0)  # reappeared — clock resets
+        chaos.vanish_device(dev)
+        syncer.sync_once(now=2.0)  # missing pass #1 again
+        assert store.get(
+            ComposableResource, "r0"
+        ).status.state == RESOURCE_STATE_ONLINE
+
+
+# ---------------------------------------------------------------------------
+# fabric_attached staleness (satellite: gauge must not zero on a blip)
+# ---------------------------------------------------------------------------
+
+class TestFabricAttachedStaleness:
+    def test_unreachable_fabric_returns_none_not_empty(self):
+        store, pool, chaos, req_rec, res_rec = make_world()
+        chaos.blackout()
+        assert res_rec.fabric_attached("worker-0") is None
+        chaos.heal()
+        assert res_rec.fabric_attached("worker-0") == []
+
+    def test_gauge_keeps_last_value_through_a_blip(self):
+        store, pool, chaos, req_rec, res_rec = make_world()
+        make_request(store, size=4)
+        to_running(store, req_rec, res_rec)
+        node = members(store)[0].spec.target_node
+        assert composed_chips.value(node=node) == 4
+        chaos.blackout()
+        res_rec._refresh_composed_gauge(node)
+        assert composed_chips.value(node=node) == 4  # stale, not zero
+        chaos.heal()
+        res_rec._refresh_composed_gauge(node)
+        assert composed_chips.value(node=node) == 4
+
+
+# ---------------------------------------------------------------------------
+# Satellite: node deleted while a member is Online — the syncer orphan path
+# and the repair/recovery path must compose without double-detach.
+# ---------------------------------------------------------------------------
+
+class TestNodeGoneOrphanCompose:
+    def test_node_deletion_replaces_member_and_reclaims_orphan(self):
+        store, pool, chaos, req_rec, res_rec = make_world(nodes=3)
+        make_request(store)
+        to_running(store, req_rec, res_rec)
+        victim = next(c for c in members(store) if c.spec.worker_id == 1)
+        victim_devices = set(victim.status.device_ids)
+        gone_node = victim.spec.target_node
+        syncer = UpstreamSyncer(store, chaos, period=0.01, grace=0.05,
+                                vanish_threshold=2)
+
+        store.delete(Node, gone_node)
+
+        # Drive controllers + syncer together until the request is whole
+        # again AND the orphaned fabric attachment is reclaimed.
+        import time as _time
+        deadline = _time.monotonic() + 30
+        t = 0.0
+        req = None
+        while _time.monotonic() < deadline:
+            try:
+                req_rec.reconcile("req-1")
+            except FabricError:
+                pass
+            for c in store.list(ComposableResource):
+                try:
+                    res_rec.reconcile(c.metadata.name)
+                except FabricError:
+                    pass
+            t += 0.1
+            syncer.sync_once(now=t)
+            req = store.get(ComposabilityRequest, "req-1")
+            live = members(store)
+            attached_ids = {d.device_id for d in pool.get_resources()}
+            no_duplicate_attachments(pool)
+            if (
+                req.status.state == REQUEST_STATE_RUNNING
+                and len(live) == 2
+                and all(c.status.state == RESOURCE_STATE_ONLINE for c in live)
+                and not (victim_devices & attached_ids)
+            ):
+                break
+        else:
+            raise AssertionError(
+                f"never converged: req={req.status.to_dict()},"
+                f" fabric={[d.device_id for d in pool.get_resources()]}"
+            )
+        # Replacement landed off the dead node; orphaned chips returned to
+        # the pool exactly once (no double-detach: counts reconcile).
+        live = members(store)
+        assert all(c.spec.target_node != gone_node for c in live)
+        attached = sum(len(c.status.device_ids) for c in live)
+        assert pool.free_chips(MODEL) + attached + pool.dead_chips(MODEL) <= 64
+        # Every chip is either free, attached to a live member, or still
+        # carved into the slice reservation — nothing leaked or doubled.
+        assert len({d.device_id for d in pool.get_resources()}) == attached

@@ -1,0 +1,311 @@
+"""Repair soak: the full operator under sustained post-Ready device death.
+
+ISSUE-7 acceptance: with the production-shaped stack (informer cache ON,
+fabric dispatcher ON), 100 attach/detach cycles at a 10% scripted
+post-Ready device-death rate must all converge back to full Ready count —
+every killed chip's member detected (damped health probes), replaced
+make-before-break on healthy capacity, the failed member force-detached —
+with zero double-attaches (nonce-checked via the durable pending_op
+intents), the per-request surge budget never exceeded, and the fleet-level
+repair breaker verifiably freezing repairs in a >50%-degraded brownout
+instead of mass-detaching.
+
+Marked slow+repair: excluded from tier-1 (`-m 'not slow'`); run with
+`make repair-soak` or `pytest -m repair`.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.types import (
+    REQUEST_STATE_RUNNING,
+    RESOURCE_STATE_DEGRADED,
+    RESOURCE_STATE_ONLINE,
+    RESOURCE_STATE_REPAIRING,
+)
+from tpu_composer.controllers.request_controller import (
+    ComposabilityRequestReconciler,
+    RepairConfig,
+    RequestTiming,
+)
+from tpu_composer.controllers.resource_controller import (
+    ComposableResourceReconciler,
+    ResourceTiming,
+)
+from tpu_composer.controllers.syncer import UpstreamSyncer
+from tpu_composer.fabric.chaos import ChaosFabricProvider
+from tpu_composer.fabric.dispatcher import FabricDispatcher
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.cache import CachedClient
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.metrics import repair_breaker_open, repairs_total
+from tpu_composer.runtime.store import Store
+
+from test_crash_restart import RecordingPool, assert_no_double_attach
+
+CYCLES = 100
+DEATH_RATE = 0.10
+SEED = 20260803
+MODEL = "tpu-v4"
+
+
+def build_operator(store, pool, chaos, *, breaker=None):
+    """Production-shaped stack: cache ON, dispatcher ON (the acceptance
+    configuration), repair-tuned sub-second timing."""
+    client = CachedClient(store)
+    dispatcher = FabricDispatcher(chaos, batch_window=0.01, concurrency=4,
+                                  poll_interval=0.02)
+    agent = FakeNodeAgent(pool=pool)
+    mgr = Manager(store=client, dispatcher=dispatcher, drain_timeout=2.0,
+                  health_addr=None)
+    mgr.add_controller(ComposabilityRequestReconciler(
+        client, chaos,
+        timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.02,
+                             running_poll=0.5, repair_poll=0.05),
+        repair=breaker or RepairConfig(),
+    ))
+    mgr.add_controller(ComposableResourceReconciler(
+        client, chaos, agent,
+        timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.02,
+                              detach_poll=0.05, detach_fast=0.02,
+                              busy_poll=0.05, health_poll=0.05,
+                              degraded_poll=0.05,
+                              health_failure_threshold=2,
+                              health_recovery_threshold=1),
+        dispatcher=dispatcher))
+    # Wide grace: a repair's detach window must never false-positive as an
+    # orphan; vanish detection stays at its damped default.
+    mgr.add_runnable(UpstreamSyncer(client, chaos, period=0.1, grace=5.0))
+    mgr.add_runnable(dispatcher.run)
+    mgr.start(workers_per_controller=2)
+    return mgr, client
+
+
+def live_members(store, owner):
+    return [
+        c for c in store.list(ComposableResource)
+        if not c.being_deleted
+        and c.metadata.labels.get("app.kubernetes.io/managed-by") == owner
+    ]
+
+
+def request_converged(store, name):
+    req = store.try_get(ComposabilityRequest, name)
+    if req is None or req.status.state != REQUEST_STATE_RUNNING:
+        return False
+    live = live_members(store, name)
+    return (
+        len(live) == req.status.slice.num_hosts
+        and all(c.status.state == RESOURCE_STATE_ONLINE for c in live)
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.repair
+def test_100_cycles_with_10pct_post_ready_device_death():
+    store = Store()
+    for i in range(6):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 8
+        store.create(n)
+    pool = RecordingPool(chips={MODEL: 64})
+    chaos = ChaosFabricProvider(pool)
+    mgr, client = build_operator(store, pool, chaos)
+    rng = random.Random(SEED)
+
+    fails: list = []
+    kills = 0
+    max_repairing = 0
+
+    def wait(cond, what, deadline_s=60, track_surge=False):
+        nonlocal max_repairing
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if track_surge:
+                repairing = [
+                    c for c in store.list(ComposableResource)
+                    if c.status.state == RESOURCE_STATE_REPAIRING
+                ]
+                max_repairing = max(max_repairing, len(repairing))
+                if len(repairing) > 1:
+                    fails.append(
+                        f"surge budget exceeded: {[c.name for c in repairing]}"
+                    )
+                    return False
+            if cond():
+                return True
+            time.sleep(0.01)
+        fails.append(what)
+        return False
+
+    try:
+        for i in range(CYCLES):
+            name = f"repair-{i}"
+            store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name=name),
+                spec=ComposabilityRequestSpec(
+                    resource=ResourceDetails(type="tpu", model=MODEL, size=8),
+                    max_concurrent_repairs=1,
+                ),
+            ))
+            if not wait(lambda: request_converged(store, name),
+                        f"{name}: never Running"):
+                break
+            if rng.random() < DEATH_RATE:
+                kills += 1
+                victim = rng.choice(live_members(store, name))
+                dead = rng.choice(victim.status.device_ids)
+                pool.kill_device(dead)
+
+                def healed():
+                    if not request_converged(store, name):
+                        return False
+                    attached = {d.device_id for d in pool.get_resources()}
+                    return dead not in attached and not any(
+                        c.being_deleted for c in store.list(ComposableResource)
+                    )
+
+                if not wait(healed, f"{name}: never healed after losing"
+                            f" {dead}", track_surge=True):
+                    break
+            store.delete(ComposabilityRequest, name)
+            if not wait(lambda: store.try_get(ComposabilityRequest, name)
+                        is None, f"{name}: teardown never completed"):
+                break
+        # Settle: in-flight detaches + syncer reclaim.
+        wait(
+            lambda: (
+                not store.list(ComposableResource)
+                and pool.get_resources() == []
+            ),
+            "fleet never drained at end of soak", deadline_s=30,
+        )
+    finally:
+        mgr.stop()
+
+    assert not fails, fails[:10]
+    assert kills >= 5, f"only {kills} scripted deaths — soak proved nothing"
+    # Zero double-attaches, nonce-checked against the durable intents.
+    assert_no_double_attach(pool.events)
+    # Surge budget respected AND repairs actually exercised concurrently.
+    assert max_repairing == 1, max_repairing
+    assert repairs_total.value(outcome="replaced") >= kills * 0.8
+    # Inventory reconciles: every chip is free or retired to the graveyard.
+    assert pool.free_chips(MODEL) + pool.dead_chips(MODEL) == 64
+    assert pool.dead_chips(MODEL) == kills
+    leftovers = [k for k in store.keys()
+                 if k[0] in ("ComposabilityRequest", "ComposableResource")]
+    assert leftovers == [], leftovers[:10]
+
+
+@pytest.mark.slow
+@pytest.mark.repair
+def test_brownout_freezes_repairs_fleet_wide():
+    """>50% of attached members degrade at once: the repair breaker must
+    freeze repairs — zero detaches — and the fleet must recover in place
+    when the brownout lifts."""
+    store = Store()
+    for i in range(4):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 8
+        store.create(n)
+    pool = RecordingPool(chips={MODEL: 64})
+    chaos = ChaosFabricProvider(pool)
+    # Freeze above 1/4 degraded; the drain grace (3 s, below) is wider than
+    # the whole detection window, so even a repair that legitimately slips
+    # in before the fraction crosses the threshold cannot DETACH anything
+    # before the breaker opens — "no detaches while frozen" is exact.
+    mgr, client = build_operator(
+        store, pool, chaos,
+        breaker=RepairConfig(breaker_fraction=0.25, breaker_min_members=2,
+                             min_degraded_seconds=2.0),
+    )
+    try:
+        for i in range(4):
+            store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name=f"req-{i}"),
+                spec=ComposabilityRequestSpec(
+                    resource=ResourceDetails(type="tpu", model=MODEL, size=4),
+                    repair_grace_seconds=3.0,
+                ),
+            ))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(request_converged(store, f"req-{i}") for i in range(4)):
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("fleet never reached Ready")
+        members_before = {
+            c.name for c in store.list(ComposableResource)
+        }
+        # Brownout: every node goes dark at once (fabric still answers —
+        # with bad news everywhere).
+        for n in store.list(Node):
+            chaos.degrade_node(n.metadata.name)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if repair_breaker_open.value() == 1.0:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("repair breaker never opened")
+        # Hold the brownout: no member may be detached — the original
+        # fleet only ever GROWS (a pre-freeze repair may have added a
+        # replacement; it must never remove anyone while frozen).
+        hold_until = time.monotonic() + 2.0
+        while time.monotonic() < hold_until:
+            current = store.list(ComposableResource)
+            assert members_before <= {c.name for c in current}, (
+                "breaker open but original members were detached"
+                " (mass-detach!)"
+            )
+            assert not any(c.being_deleted for c in current)
+            time.sleep(0.05)
+        degraded = [
+            c for c in store.list(ComposableResource)
+            if c.status.state in (RESOURCE_STATE_DEGRADED,
+                                  RESOURCE_STATE_REPAIRING)
+        ]
+        assert len(degraded) >= 3
+        # Brownout lifts: the fleet converges back to full Ready. Members
+        # whose repair never started recover IN PLACE; at most one member
+        # (a pre-freeze repair) may have rotated.
+        chaos.heal()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if (
+                all(request_converged(store, f"req-{i}") for i in range(4))
+                and not any(
+                    c.being_deleted for c in store.list(ComposableResource)
+                )
+            ):
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError(
+                "fleet never recovered after the brownout lifted"
+            )
+        survivors = {c.name for c in store.list(ComposableResource)}
+        # With the dwell (2 s) wider than the whole degrade->recover window
+        # no repair can act at all: every original member recovers in place.
+        assert survivors == members_before, (
+            f"members rotated through a brownout: before={members_before},"
+            f" after={survivors}"
+        )
+        assert_no_double_attach(pool.events)
+    finally:
+        mgr.stop()

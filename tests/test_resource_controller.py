@@ -202,17 +202,64 @@ class TestOnlineState:
         assert r.requeue_after == rec.timing.health_poll
         assert get(store, "r0").status.error == ""
 
-    def test_unhealthy_fabric_surfaces_error_but_stays_online(self, world):
+    def test_unhealthy_probe_is_damped_then_degrades(self, world):
+        """Flap damping (self-healing data plane): a failed probe below the
+        threshold writes NOTHING — no status churn, no event spam; at the
+        threshold the member transitions to a durable Degraded state with a
+        structured failure record."""
         store, pool, agent, rec = self._online(world)
         chip = get(store, "r0").status.device_ids[0]
         pool.set_health(chip, DeviceHealth("Critical", "ICI link down"))
+        rv_before = get(store, "r0").metadata.resource_version
+        threshold = rec.timing.health_failure_threshold
+        for _ in range(threshold - 1):
+            step(rec, "r0")
+            cr = get(store, "r0")
+            # Damped: still Online, no error surfaced, no write at all.
+            assert cr.status.state == RESOURCE_STATE_ONLINE
+            assert cr.status.error == ""
+            assert cr.metadata.resource_version == rv_before
+        step(rec, "r0")  # threshold crossed
+        cr = get(store, "r0")
+        assert cr.status.state == "Degraded"
+        assert "Critical" in cr.status.error
+        assert cr.status.failure is not None
+        assert cr.status.failure.source == "health-probe"
+        assert cr.status.failure.probe_failures == threshold
+        # Recovery (damped the same way): healthy probes return it Online.
+        pool.set_health(chip, DeviceHealth())
+        for _ in range(rec.timing.health_recovery_threshold):
+            step(rec, "r0")
+        cr = get(store, "r0")
+        assert cr.status.state == RESOURCE_STATE_ONLINE
+        assert cr.status.error == ""
+        assert cr.status.failure is None
+
+    def test_transient_flip_never_writes_status_or_events(self, world):
+        """Satellite: a single-probe health flip (bad then good) leaves no
+        trace — the store object is untouched and no Unhealthy/Degraded
+        event is emitted."""
+        store, pool, agent, rec = self._online(world)
+        chip = get(store, "r0").status.device_ids[0]
+        rv_before = get(store, "r0").metadata.resource_version
+        events_before = len(
+            rec.recorder.for_object(kind="ComposableResource", name="r0")
+        )
+        pool.set_health(chip, DeviceHealth("Critical", "transient blip"))
+        step(rec, "r0")  # one bad probe
+        pool.set_health(chip, DeviceHealth())
+        step(rec, "r0")  # flip back — streak resets
+        pool.set_health(chip, DeviceHealth("Critical", "another blip"))
+        step(rec, "r0")
+        pool.set_health(chip, DeviceHealth())
         step(rec, "r0")
         cr = get(store, "r0")
         assert cr.status.state == RESOURCE_STATE_ONLINE
-        assert "Critical" in cr.status.error
-        pool.set_health(chip, DeviceHealth())
-        step(rec, "r0")
-        assert get(store, "r0").status.error == ""
+        assert cr.status.error == ""
+        assert cr.metadata.resource_version == rv_before
+        assert len(
+            rec.recorder.for_object(kind="ComposableResource", name="r0")
+        ) == events_before
 
     def test_delete_moves_to_detaching(self, world):
         store, pool, agent, rec = self._online(world)

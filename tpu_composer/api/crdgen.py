@@ -20,6 +20,7 @@ from tpu_composer.api.types import (
     PREEMPTION_POLICIES,
     PRIORITY_MAX,
     PRIORITY_MIN,
+    REPAIR_POLICIES,
 )
 
 from tpu_composer import GROUP, VERSION  # single source of truth for the API group
@@ -111,6 +112,21 @@ _RESOURCE_STATUS = _obj(
     }
 )
 
+_FAILURE_RECORD = _obj(
+    {
+        "reason": _str("health-probe | device-vanished"),
+        "detail": _str("Last health detail / missing device ids"),
+        "source": _str("Which detector fired: health-probe | syncer"),
+        "observed_at": _str("Wall-clock ISO of the Degraded transition"),
+        "probe_failures": _int(
+            "Consecutive failed observations that crossed the damping"
+            " threshold", minimum=0,
+        ),
+    },
+    desc="Why this member left Online for Degraded (self-healing data"
+    " plane); written with the Degraded transition, cleared on recovery.",
+)
+
 _PENDING_OP = _obj(
     {
         "verb": _str(enum=["add", "remove"]),
@@ -154,6 +170,25 @@ COMPOSABILITY_REQUEST_SCHEMA = _obj(
                     " preempts nor may be preempted/defrag-migrated.",
                     enum=list(PREEMPTION_POLICIES),
                 ),
+                "repairPolicy": _str(
+                    "Post-Ready member failure handling: Replace (default,"
+                    " make-before-break replacement), DetachOnly (detach"
+                    " the failed member, normal recovery re-solves), None"
+                    " (no automatic action).",
+                    enum=list(REPAIR_POLICIES),
+                ),
+                "maxConcurrentRepairs": _int(
+                    "Surge budget: members of this request under active"
+                    " repair at once (default 1).",
+                    minimum=1,
+                ),
+                "repairGraceSeconds": {
+                    "type": "number",
+                    "minimum": 0,
+                    "description": "Seconds a failed member stays attached"
+                    " after its replacement is Online before the"
+                    " force-detach (workload migration window).",
+                },
             },
             required=["resource"],
         ),
@@ -205,6 +240,7 @@ COMPOSABLE_RESOURCE_SCHEMA = _obj(
                     "Attach budget exhausted; owner must reallocate"
                 ),
                 "pending_op": _PENDING_OP,
+                "failure": _FAILURE_RECORD,
             }
         ),
     }

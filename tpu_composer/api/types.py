@@ -47,6 +47,17 @@ RESOURCE_STATE_ATTACHING = "Attaching"
 RESOURCE_STATE_ONLINE = "Online"
 RESOURCE_STATE_DETACHING = "Detaching"
 RESOURCE_STATE_DELETING = "Deleting"
+# Self-healing additions (no reference analog — the reference's Online
+# health poll only records an error string and a member with a dead chip
+# sits Ready forever). Degraded: consecutive failed health probes (or the
+# syncer observing the member's devices vanished from the fabric listing)
+# crossed the damping threshold; the member stays attached, carries a
+# structured status.failure record, and the owning request's repair driver
+# decides what happens next. Repairing: the repair driver committed to
+# replacing this member — a replacement child is attaching; once it is
+# Online (plus the drain grace) this member is force-detached.
+RESOURCE_STATE_DEGRADED = "Degraded"
+RESOURCE_STATE_REPAIRING = "Repairing"
 
 # Device types — reference enum gpu|cxlmemory (composabilityrequest_types.go:41);
 # tpu is our first-class addition.
@@ -70,6 +81,20 @@ PREEMPTION_POLICIES = (PREEMPT_LOWER_PRIORITY, PREEMPT_NEVER)
 PRIORITY_MIN = -1_000_000_000
 PRIORITY_MAX = 1_000_000_000
 
+# Repair policies (spec.repairPolicy) — what the request controller does
+# when a member degrades post-Ready:
+#   Replace    make-before-break: place + attach a replacement member on
+#              healthy capacity first, then force-detach the failed member
+#              after the drain grace (default);
+#   DetachOnly detach the failed member immediately and let the normal
+#              lost-member recovery re-solve (break-before-make);
+#   None       no automatic action — the member sits Degraded with its
+#              failure record for an operator.
+REPAIR_REPLACE = "Replace"
+REPAIR_DETACH_ONLY = "DetachOnly"
+REPAIR_NONE = "None"
+REPAIR_POLICIES = (REPAIR_REPLACE, REPAIR_DETACH_ONLY, REPAIR_NONE)
+
 FINALIZER = "tpu.composer.dev/finalizer"  # analog of com.ie.ibm.hpsys/finalizer
 
 # Annotations (reference: cohdi.io/* at composabilityrequest_controller.go:46-47)
@@ -80,6 +105,15 @@ ANNOTATION_DELETE_DEVICE = "tpu.composer.dev/delete-device"
 # a controller restart cannot reset the orphan grace window (crash-loops
 # would otherwise defer leak reclamation indefinitely).
 ANNOTATION_ORPHAN_FIRST_SEEN = "tpu.composer.dev/orphan-first-seen"
+# Repair linkage (self-healing data plane): a replacement member created by
+# the repair driver names the failed member it replaces; the failed member
+# names its replacement. Durable so a crash mid-repair resumes instead of
+# double-placing (the surge budget and completion logic key on these).
+ANNOTATION_REPLACES = "tpu.composer.dev/replaces"
+ANNOTATION_REPLACED_BY = "tpu.composer.dev/replaced-by"
+# Wall-clock ISO stamp set on the failed member when its replacement came
+# Online: the drain grace window runs from here (crash-safe clock).
+ANNOTATION_REPAIR_DRAIN_START = "tpu.composer.dev/repair-drain-start"
 LABEL_MANAGED_BY = "app.kubernetes.io/managed-by"
 LABEL_READY_TO_DETACH = "tpu.composer.dev/ready-to-detach-device-id"
 
@@ -209,6 +243,17 @@ class ComposabilityRequestSpec:
     # is fragmented away. 0 is the batch default.
     priority: int = 0
     preemption_policy: str = PREEMPT_LOWER_PRIORITY
+    # Self-healing: what the request controller does when a member of this
+    # request degrades post-Ready (see REPAIR_POLICIES).
+    repair_policy: str = REPAIR_REPLACE
+    # Surge budget: at most this many members of this request may be under
+    # active repair (replacement attaching / failed member draining) at
+    # once — a multi-member brownout must not detach half the slice in one
+    # pass.
+    max_concurrent_repairs: int = 1
+    # Seconds the failed member stays attached AFTER its replacement is
+    # Online, so workloads can migrate off it before the force-detach.
+    repair_grace_seconds: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"resource": self.resource.to_dict()}
@@ -216,6 +261,12 @@ class ComposabilityRequestSpec:
             d["priority"] = self.priority
         if self.preemption_policy != PREEMPT_LOWER_PRIORITY:
             d["preemptionPolicy"] = self.preemption_policy
+        if self.repair_policy != REPAIR_REPLACE:
+            d["repairPolicy"] = self.repair_policy
+        if self.max_concurrent_repairs != 1:
+            d["maxConcurrentRepairs"] = self.max_concurrent_repairs
+        if self.repair_grace_seconds:
+            d["repairGraceSeconds"] = self.repair_grace_seconds
         return d
 
     @classmethod
@@ -224,6 +275,9 @@ class ComposabilityRequestSpec:
             resource=ResourceDetails.from_dict(d.get("resource", {})),
             priority=int(d.get("priority", 0)),
             preemption_policy=d.get("preemptionPolicy", PREEMPT_LOWER_PRIORITY),
+            repair_policy=d.get("repairPolicy", REPAIR_REPLACE),
+            max_concurrent_repairs=int(d.get("maxConcurrentRepairs", 1)),
+            repair_grace_seconds=float(d.get("repairGraceSeconds", 0.0)),
         )
 
     def validate(self) -> None:
@@ -238,6 +292,15 @@ class ComposabilityRequestSpec:
                 f"preemptionPolicy must be one of {PREEMPTION_POLICIES},"
                 f" got {self.preemption_policy!r}"
             )
+        if self.repair_policy not in REPAIR_POLICIES:
+            raise ValidationError(
+                f"repairPolicy must be one of {REPAIR_POLICIES},"
+                f" got {self.repair_policy!r}"
+            )
+        if self.max_concurrent_repairs < 1:
+            raise ValidationError("maxConcurrentRepairs must be >= 1")
+        if self.repair_grace_seconds < 0:
+            raise ValidationError("repairGraceSeconds must be >= 0")
 
 
 @dataclass
@@ -278,6 +341,49 @@ class PendingOp:
             nonce=d.get("nonce", ""),
             node=d.get("node", ""),
             started_at=d.get("started_at", ""),
+        )
+
+
+@dataclass
+class FailureRecord:
+    """Structured record of why a member left Online for Degraded.
+
+    Written by the detection paths (damped health probes in the resource
+    controller, the syncer's device-vanished pass) in the same status write
+    as the Degraded transition; cleared by recovery or teardown. Durable so
+    a restarted operator — and the repair driver — see WHAT failed and HOW
+    it was detected, not just an error string.
+    """
+
+    #: Short machine-readable cause: "health-probe" | "device-vanished".
+    reason: str = ""
+    detail: str = ""  # last health detail / missing device ids
+    #: Which detector fired: "health-probe" | "syncer".
+    source: str = ""
+    observed_at: str = ""  # wall-clock ISO of the Degraded transition
+    #: Consecutive failed observations that crossed the damping threshold.
+    probe_failures: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"reason": self.reason}
+        if self.detail:
+            d["detail"] = self.detail
+        if self.source:
+            d["source"] = self.source
+        if self.observed_at:
+            d["observed_at"] = self.observed_at
+        if self.probe_failures:
+            d["probe_failures"] = self.probe_failures
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FailureRecord":
+        return cls(
+            reason=d.get("reason", ""),
+            detail=d.get("detail", ""),
+            source=d.get("source", ""),
+            observed_at=d.get("observed_at", ""),
+            probe_failures=int(d.get("probe_failures", 0)),
         )
 
 
@@ -515,6 +621,9 @@ class ComposableResourceStatus:
     # attach/detach is issued, cleared when its outcome lands in status.
     # The cold-start adoption pass reconstructs in-flight work from this.
     pending_op: Optional[PendingOp] = None
+    # Structured cause of the Degraded transition (self-healing data plane);
+    # set with the Online->Degraded write, cleared on recovery/teardown.
+    failure: Optional[FailureRecord] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"state": self.state}
@@ -532,11 +641,14 @@ class ComposableResourceStatus:
             d["quarantined"] = True
         if self.pending_op is not None:
             d["pending_op"] = self.pending_op.to_dict()
+        if self.failure is not None:
+            d["failure"] = self.failure.to_dict()
         return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ComposableResourceStatus":
         pending = d.get("pending_op")
+        failure = d.get("failure")
         return cls(
             state=d.get("state", ""),
             error=d.get("error", ""),
@@ -546,6 +658,7 @@ class ComposableResourceStatus:
             attach_attempts=int(d.get("attach_attempts", 0)),
             quarantined=bool(d.get("quarantined", False)),
             pending_op=PendingOp.from_dict(pending) if pending else None,
+            failure=FailureRecord.from_dict(failure) if failure else None,
         )
 
 

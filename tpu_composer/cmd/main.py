@@ -32,6 +32,12 @@ SURVEY.md §5 "Config / flag system"):
                       crash/drain-timeout (--trace-file)
   TPUC_FLIGHT_FILE    write the flight-recorder black box here on
                       crash/drain-timeout (--flight-file)
+  TPUC_HEALTH_FAILURE_THRESHOLD   consecutive failed health probes before
+                      an Online member goes Degraded (--health-failure-threshold)
+  TPUC_NODE_DEGRADE_THRESHOLD     per-node Degraded transitions that
+                      escalate to node quarantine (--node-degrade-threshold)
+  TPUC_REPAIR_BREAKER_FRACTION / TPUC_REPAIR_BREAKER_MIN_MEMBERS
+                      fleet-level repair-storm breaker (--repair-breaker-*)
 
 Run: ``python -m tpu_composer [flags]`` or ``python -m tpu_composer.cmd.main``.
 """
@@ -266,6 +272,52 @@ def build_parser() -> argparse.ArgumentParser:
              " transitions, span summaries and events per CR) here on"
              " drain-timeout and from the crash hooks"
              " (env TPUC_FLIGHT_FILE; empty disables the dump)",
+    )
+    # Self-healing data plane (post-Ready failure detection + repair):
+    # per-request policy lives on ComposabilityRequest.spec (repairPolicy /
+    # maxConcurrentRepairs / repairGraceSeconds); these are the fleet-wide
+    # detection and storm-containment knobs.
+    p.add_argument(
+        "--health-failure-threshold",
+        type=int,
+        default=_env_int("TPUC_HEALTH_FAILURE_THRESHOLD", 3),
+        help="consecutive failed fabric health probes before an Online"
+             " member goes Degraded (flap damping: below this nothing is"
+             " written; env TPUC_HEALTH_FAILURE_THRESHOLD)",
+    )
+    p.add_argument(
+        "--node-degrade-threshold",
+        type=int,
+        default=_env_int("TPUC_NODE_DEGRADE_THRESHOLD", 3),
+        help="Degraded transitions on one node within 10 min that escalate"
+             " to a durable node quarantine (reason post-ready-failures);"
+             " <= 0 disables (env TPUC_NODE_DEGRADE_THRESHOLD)",
+    )
+    p.add_argument(
+        "--repair-breaker-fraction",
+        type=float,
+        default=_env_float("TPUC_REPAIR_BREAKER_FRACTION", 0.5),
+        help="freeze ALL repairs while more than this fraction of attached"
+             " members is Degraded/Repairing at once — a brownout is a"
+             " fabric problem; mass-detaching would amplify it"
+             " (env TPUC_REPAIR_BREAKER_FRACTION)",
+    )
+    p.add_argument(
+        "--repair-breaker-min-members",
+        type=int,
+        default=_env_int("TPUC_REPAIR_BREAKER_MIN_MEMBERS", 4),
+        help="repair breaker only arms at this many attached members —"
+             " a tiny fleet's single failure is not a brownout"
+             " (env TPUC_REPAIR_BREAKER_MIN_MEMBERS)",
+    )
+    p.add_argument(
+        "--repair-dwell",
+        type=float,
+        default=_env_seconds("TPUC_REPAIR_DWELL", 0.0),
+        help="seconds a member must stay Degraded before a repair may act"
+             " on it — gives a lifting brownout's tail members their"
+             " chance to recover in place instead of being replaced"
+             " (env TPUC_REPAIR_DWELL)",
     )
     p.add_argument(
         "--workers",
@@ -543,13 +595,26 @@ def build_manager(args: argparse.Namespace) -> Manager:
     mgr.add_startup_hook(
         lambda: adopt_pending_ops(client, fabric, dispatcher)
     )
+    from tpu_composer.controllers.request_controller import RepairConfig
+    from tpu_composer.controllers.resource_controller import ResourceTiming
     from tpu_composer.scheduler import ClusterScheduler, DefragLoop
 
     scheduler = ClusterScheduler(client)
+    repair_cfg = RepairConfig(
+        breaker_fraction=getattr(args, "repair_breaker_fraction", 0.5),
+        breaker_min_members=getattr(args, "repair_breaker_min_members", 4),
+        min_degraded_seconds=getattr(args, "repair_dwell", 0.0),
+    )
+    res_timing = ResourceTiming(
+        health_failure_threshold=getattr(args, "health_failure_threshold", 3),
+        node_degrade_threshold=getattr(args, "node_degrade_threshold", 3),
+    )
     mgr.add_controller(ComposabilityRequestReconciler(client, fabric,
                                                       recorder=mgr.recorder,
-                                                      scheduler=scheduler))
+                                                      scheduler=scheduler,
+                                                      repair=repair_cfg))
     res_rec = ComposableResourceReconciler(client, fabric, agent,
+                                           timing=res_timing,
                                            recorder=mgr.recorder,
                                            dispatcher=dispatcher)
     mgr.add_controller(res_rec)

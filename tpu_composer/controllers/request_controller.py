@@ -60,32 +60,43 @@ from tpu_composer.api.meta import now_iso, parse_iso
 from tpu_composer.api.types import (
     ANNOTATION_DELETE_DEVICE,
     ANNOTATION_LAST_USED_TIME,
+    ANNOTATION_REPAIR_DRAIN_START,
+    ANNOTATION_REPLACED_BY,
+    ANNOTATION_REPLACES,
     ComposabilityRequest,
     ComposableResource,
     ComposableResourceSpec,
     FINALIZER,
     LABEL_MANAGED_BY,
     Node,
+    REPAIR_DETACH_ONLY,
+    REPAIR_NONE,
     REQUEST_STATE_CLEANING,
     REQUEST_STATE_DELETING,
     REQUEST_STATE_EMPTY,
     REQUEST_STATE_NODE_ALLOCATING,
     REQUEST_STATE_RUNNING,
     REQUEST_STATE_UPDATING,
+    RESOURCE_STATE_DEGRADED,
     RESOURCE_STATE_ONLINE,
+    RESOURCE_STATE_REPAIRING,
     ResourceStatus,
     SliceStatus,
 )
 from tpu_composer.fabric.provider import (
     FabricError,
     FabricProvider,
+    UnsupportedRepair,
     UnsupportedResize,
 )
 from tpu_composer.runtime.controller import Controller, Result
 from tpu_composer.runtime.events import WARNING, EventRecorder
 from tpu_composer.runtime.metrics import (
     attach_to_ready_seconds,
+    degraded_members,
     reconcile_total,
+    repair_breaker_open,
+    repairs_total,
     scheduler_preemptions_total,
 )
 from tpu_composer.runtime.store import (
@@ -110,6 +121,33 @@ class RequestTiming:
     # reference's fixed requeue (:585) is its primary detection quantum.
     running_poll: float = 30.0
     cleaning_poll: float = 0.3  # children-still-terminating re-check (30s, :611)
+    # Cadence while a repair is in flight: replacement progress is
+    # event-driven via the child watch; this polls the drain-grace clock
+    # and re-attempts failed placements.
+    repair_poll: float = 0.5
+
+
+@dataclass
+class RepairConfig:
+    """Fleet-level repair-storm containment knobs (self-healing data
+    plane). Per-request policy lives on the spec (repairPolicy /
+    maxConcurrentRepairs / repairGraceSeconds); these bound what ALL
+    requests may do at once."""
+
+    # Freeze all repairs when more than this fraction of attached members
+    # fleet-wide are Degraded/Repairing simultaneously: a brownout is a
+    # fabric problem, and mass-detaching the fleet would amplify it.
+    breaker_fraction: float = 0.5
+    # ...but only when at least this many members are attached — a tiny
+    # fleet's single failure is not a brownout.
+    breaker_min_members: int = 4
+    # Dwell: a member must have been Degraded at least this long (from its
+    # failure record's observed_at) before a repair may act on it. The
+    # tail-of-brownout guard: as a brownout lifts, members recover at
+    # staggered times, and the moment the fraction dips below the breaker
+    # threshold an eager repair would replace a member that was one healthy
+    # probe away from recovering in place. 0 repairs immediately.
+    min_degraded_seconds: float = 0.0
 
 
 def generate_resource_name(device_type: str) -> str:
@@ -128,11 +166,17 @@ class ComposabilityRequestReconciler(Controller):
         timing: Optional[RequestTiming] = None,
         recorder: Optional[EventRecorder] = None,
         scheduler: Optional[ClusterScheduler] = None,
+        repair: Optional[RepairConfig] = None,
     ) -> None:
         super().__init__(store)
         self.fabric = fabric
         self.timing = timing or RequestTiming()
         self.recorder = recorder or EventRecorder()
+        self.repair = repair or RepairConfig()
+        # Repair-breaker edge detection: the freeze/resume transitions are
+        # logged + evented exactly once (the state itself is level-checked
+        # every repair pass).
+        self._repairs_frozen = False
         # The cluster-wide placement authority (scheduler/). Shared with the
         # DefragLoop when cmd/main wires one; tests may inject their own.
         self.scheduler = scheduler or ClusterScheduler(store)
@@ -393,6 +437,12 @@ class ComposabilityRequestReconciler(Controller):
             and c.spec.slice_name == slice_name
             and c.spec.force_detach == res.force_detach
             and not c.status.quarantined
+            # Degraded/Repairing members never re-enter a solved slice: a
+            # re-solve reaching this path replaces them on fresh capacity
+            # (the break-before-make fallback the repair driver leans on).
+            and c.status.state not in (
+                RESOURCE_STATE_DEGRADED, RESOURCE_STATE_REPAIRING,
+            )
             and c.spec.target_node not in quarantined_nodes
             and self.store.try_get(Node, c.spec.target_node) is not None
         ]
@@ -600,6 +650,9 @@ class ComposabilityRequestReconciler(Controller):
                 and c.spec.force_detach == res.force_detach
                 and (not res.target_node or c.spec.target_node == res.target_node)
                 and not c.status.quarantined
+                and c.status.state not in (
+                    RESOURCE_STATE_DEGRADED, RESOURCE_STATE_REPAIRING,
+                )
                 and c.spec.target_node not in quarantined_nodes
                 and self.store.try_get(Node, c.spec.target_node) is not None
             ):
@@ -699,13 +752,22 @@ class ComposabilityRequestReconciler(Controller):
         # A quarantined member will never come Online — go straight back to
         # allocation, which discards it and places a replacement on healthy
         # capacity (automatic reallocation, docs/RESILIENCE.md). Without
-        # this the request would sit in Updating polling forever.
-        quarantined = [c for c in children.values() if c.status.quarantined]
-        if quarantined:
+        # this the request would sit in Updating polling forever. Members
+        # that degraded DURING the attach wave (post-Ready detection firing
+        # while siblings still attach) take the same path: pre-Ready there
+        # is no workload to make-before-break for, so the re-solve simply
+        # replaces them.
+        unusable = [
+            c for c in children.values()
+            if c.status.quarantined or c.status.state in (
+                RESOURCE_STATE_DEGRADED, RESOURCE_STATE_REPAIRING,
+            )
+        ]
+        if unusable:
             self.recorder.event(
                 req, WARNING, "MemberQuarantined",
-                f"{len(quarantined)} member(s) quarantined"
-                f" ({', '.join(sorted(c.spec.target_node for c in quarantined))});"
+                f"{len(unusable)} member(s) quarantined/degraded"
+                f" ({', '.join(sorted(c.spec.target_node for c in unusable))});"
                 " reallocating on healthy capacity",
             )
             req.status.state = REQUEST_STATE_NODE_ALLOCATING
@@ -808,19 +870,435 @@ class ComposabilityRequestReconciler(Controller):
         expected = (
             req.status.slice.num_hosts if res.type == "tpu" and res.size > 0 else res.size
         )
-        if len(live) < expected or any(
-            c.status.state != RESOURCE_STATE_ONLINE for c in live
-        ):
-            # Lost or degraded member -> full re-solve. (Scalar requests must
-            # also go through NodeAllocating, not Updating: the fold step
-            # already dropped the lost child's status row, so Updating would
-            # find nothing to create and flap Running<->Updating forever.)
+        # A member that is fully GONE (child object lost — node deletion
+        # GC, manual delete) is a structural hole the repair driver cannot
+        # fill; the full re-solve below owns it. Checked FIRST so a
+        # sibling sitting Degraded (repairPolicy=None, or a repair
+        # retrying placement) can never starve lost-member recovery.
+        if len(live) < expected:
+            self.recorder.event(req, WARNING, "Degraded",
+                                f"{len(live)}/{expected} members present")
+            req.status.state = REQUEST_STATE_NODE_ALLOCATING
+            self._write_status(req)
+            return Result(requeue_after=0.0)
+        # Self-healing: members that FAILED post-Ready (damped health
+        # probes, or the syncer seeing their devices vanish) are handled by
+        # the repair driver — make-before-break replacement under the surge
+        # budget and the fleet breaker — NOT by the blunt full re-solve
+        # below, which would tear surviving members' coordinates apart.
+        failed = [
+            c for c in live
+            if c.status.state in (RESOURCE_STATE_DEGRADED, RESOURCE_STATE_REPAIRING)
+        ]
+        if failed:
+            return self._drive_repairs(req, live, failed)
+        if self._repairs_frozen:
+            # Every member recovered in place (the brownout lifted before
+            # any repair ran): recompute so the breaker gauge and the
+            # resume edge don't stay latched open.
+            self._repairs_frozen_now(req)
+        if any(c.status.state != RESOURCE_STATE_ONLINE for c in live):
+            # Unknown non-Online state -> full re-solve. (Scalar requests
+            # must also go through NodeAllocating, not Updating: the fold
+            # step already dropped a lost child's status row, so Updating
+            # would find nothing to create and flap Running<->Updating
+            # forever.) A replacement member mid-attach never lands here:
+            # its failed member is still in `failed` until the post-grace
+            # delete, and after that delete the replacement is Online.
             self.recorder.event(req, WARNING, "Degraded",
                                 f"{len(live)}/{expected} members online")
             req.status.state = REQUEST_STATE_NODE_ALLOCATING
             self._write_status(req)
             return Result(requeue_after=0.0)
+        # Fully healthy: retire any stale repair-era error surfaced on the
+        # request (DegradedNoRepair / RepairFailed messages must not
+        # outlive the condition).
+        if req.status.error:
+            req.status.error = ""
+            try:
+                self._write_status(req)
+            except (ConflictError, NotFoundError):
+                pass  # cosmetic — retried on the next pass
         return Result(requeue_after=self.timing.running_poll)
+
+    # ------------------------------------------------------------------
+    # self-healing repair driver (Running-state member failures)
+    # ------------------------------------------------------------------
+    def _repairs_frozen_now(self, req: ComposabilityRequest) -> bool:
+        """Fleet-level repair breaker: when more than breaker_fraction of
+        the attached fleet is Degraded/Repairing at once, the failure is
+        the FABRIC's (brownout/partition), not the members' — freezing
+        repairs keeps the operator from mass-detaching a fleet that will
+        recover when the fabric does. Level-checked every pass; the
+        freeze/resume edges are evented once."""
+        cfg = self.repair
+        attached = [
+            r for r in self.store.list(ComposableResource)
+            if r.status.state in (
+                RESOURCE_STATE_ONLINE, RESOURCE_STATE_DEGRADED,
+                RESOURCE_STATE_REPAIRING,
+            ) and not r.being_deleted
+        ]
+        bad = sum(
+            1 for r in attached
+            if r.status.state in (RESOURCE_STATE_DEGRADED, RESOURCE_STATE_REPAIRING)
+        )
+        degraded_members.set(float(bad))
+        frozen = (
+            len(attached) >= max(1, cfg.breaker_min_members)
+            and bad > cfg.breaker_fraction * len(attached)
+        )
+        repair_breaker_open.set(1.0 if frozen else 0.0)
+        if frozen and not self._repairs_frozen:
+            msg = (
+                f"repairs frozen: {bad}/{len(attached)} attached members"
+                f" degraded (> {cfg.breaker_fraction:.0%}) — treating as a"
+                " fabric-wide brownout, not member failures; no members"
+                " will be detached until the fraction recedes"
+            )
+            self.recorder.event(req, WARNING, "RepairsFrozen", msg)
+            self.log.warning("%s", msg)
+            repairs_total.inc(outcome="frozen")
+        elif not frozen and self._repairs_frozen:
+            self.log.warning(
+                "repairs resumed: degraded fraction receded (%d/%d)",
+                bad, len(attached),
+            )
+            self.recorder.event(
+                req, "Normal", "RepairsResumed",
+                f"degraded fraction receded ({bad}/{len(attached)});"
+                " repairs resume",
+            )
+        self._repairs_frozen = frozen
+        return frozen
+
+    def _drive_repairs(
+        self,
+        req: ComposabilityRequest,
+        live: List[ComposableResource],
+        failed: List[ComposableResource],
+    ) -> Result:
+        policy = req.spec.repair_policy
+        if policy == REPAIR_NONE:
+            msg = (
+                f"{len(failed)} member(s) degraded; repairPolicy=None —"
+                " operator action required"
+            )
+            if req.status.error != msg:
+                req.status.error = msg
+                try:
+                    self._write_status(req)
+                except (ConflictError, NotFoundError):
+                    return Result(requeue_after=self.timing.running_poll)
+                self.recorder.event(req, WARNING, "DegradedNoRepair", msg)
+            return Result(requeue_after=self.timing.running_poll)
+
+        if self._repairs_frozen_now(req):
+            # Frozen: start nothing, detach nothing. Members stay attached
+            # (Degraded members keep probing for recovery); in-flight
+            # replacement ATTACHES may finish — adding capacity is never
+            # the storm — but the grace-expiry detaches wait too.
+            return Result(requeue_after=self.timing.running_poll)
+
+        degraded = sorted(
+            (c for c in failed if c.status.state == RESOURCE_STATE_DEGRADED),
+            key=lambda c: c.name,
+        )
+        repairing = [
+            c for c in failed if c.status.state == RESOURCE_STATE_REPAIRING
+        ]
+        by_replaces = {
+            c.metadata.annotations.get(ANNOTATION_REPLACES): c
+            for c in live if c.metadata.annotations.get(ANNOTATION_REPLACES)
+        }
+
+        # 1. Progress in-flight repairs (make-before-break back half).
+        still_in_flight = 0
+        for c in repairing:
+            repl = by_replaces.get(c.name)
+            if repl is None or repl.status.quarantined:
+                # Replacement died before coming Online (node gone, attach
+                # budget exhausted): revert to Degraded so a FRESH repair
+                # attempt places elsewhere (a quarantined replacement's
+                # node is already excluded by the allocator gates).
+                if repl is not None:
+                    self._delete_children(req, [repl])
+                c.status.state = RESOURCE_STATE_DEGRADED
+                try:
+                    self.store.update_status(c)
+                except (ConflictError, NotFoundError):
+                    pass  # retried next pass
+                # Re-point the authoritative coordinates at the failed
+                # member's node — it is still the one actually attached
+                # for worker w; leaving the dead replacement's node there
+                # would hand the webhook hostnames with nothing behind
+                # them for the whole retry window.
+                w = c.spec.worker_id
+                if (
+                    req.spec.resource.type == "tpu"
+                    and 0 <= w < len(req.status.slice.worker_hostnames)
+                    and req.status.slice.worker_hostnames[w] != c.spec.target_node
+                ):
+                    req.status.slice.worker_hostnames[w] = c.spec.target_node
+                    try:
+                        self._write_status(req)
+                    except (ConflictError, NotFoundError):
+                        pass  # re-asserted next pass
+                repairs_total.inc(outcome="retried")
+                continue
+            # Re-assert the authoritative coordinates every pass: the
+            # _start_replacement write can lose a conflict, and stale
+            # worker_hostnames would hand the webhook the dead node.
+            w = repl.spec.worker_id
+            if (
+                req.spec.resource.type == "tpu"
+                and 0 <= w < len(req.status.slice.worker_hostnames)
+                and req.status.slice.worker_hostnames[w] != repl.spec.target_node
+            ):
+                req.status.slice.worker_hostnames[w] = repl.spec.target_node
+                try:
+                    self._write_status(req)
+                except (ConflictError, NotFoundError):
+                    pass  # retried next pass
+            if repl.status.state != RESOURCE_STATE_ONLINE:
+                still_in_flight += 1
+                continue  # replacement still attaching — event-driven wait
+            # Replacement Online: run the drain grace, then force-detach
+            # the failed member.
+            start_iso = c.metadata.annotations.get(ANNOTATION_REPAIR_DRAIN_START, "")
+            if not start_iso:
+                c.metadata.annotations[ANNOTATION_REPAIR_DRAIN_START] = now_iso()
+                try:
+                    self.store.update(c)
+                except (ConflictError, NotFoundError):
+                    pass  # clock starts on the retry
+                still_in_flight += 1
+                continue
+            try:
+                elapsed = (
+                    parse_iso(now_iso()) - parse_iso(start_iso)
+                ).total_seconds()
+            except ValueError:
+                elapsed = req.spec.repair_grace_seconds  # unreadable: no extra wait
+            if elapsed < req.spec.repair_grace_seconds:
+                still_in_flight += 1
+                continue
+            if not c.spec.force_detach:
+                # The member is failed hardware: load checks against it
+                # would block teardown behind a workload that already
+                # migrated to the replacement.
+                c.spec.force_detach = True
+                try:
+                    c = self.store.update(c)
+                except (ConflictError, NotFoundError):
+                    still_in_flight += 1
+                    continue  # retried next pass
+            self._delete_children(req, [c])
+            repairs_total.inc(outcome="replaced")
+            self.recorder.event(
+                req, "Normal", "RepairComplete",
+                f"member {c.name} ({c.spec.target_node}) replaced by"
+                f" {repl.name} ({repl.spec.target_node}); detaching failed"
+                " member",
+            )
+
+        # 1b. Complete interrupted transitions: a Degraded member that
+        # already HAS a live replacement lost the Repairing mark (crash or
+        # write conflict between store.create(repl) and the member's
+        # status write in _start_replacement). Re-mark it instead of
+        # placing a second replacement — and count it against the surge
+        # budget, which a double-place would silently bypass.
+        fresh = []
+        for c in degraded:
+            if by_replaces.get(c.name) is None:
+                fresh.append(c)
+                continue
+            c.status.state = RESOURCE_STATE_REPAIRING
+            try:
+                self.store.update_status(c)
+            except (ConflictError, NotFoundError):
+                pass  # retried next pass; the replacement already exists
+            still_in_flight += 1
+        degraded = fresh
+
+        # 2. Start new repairs within the surge budget. Members inside the
+        # dwell window (recently degraded — possibly a brownout tail about
+        # to recover in place) are skipped this pass and re-checked on the
+        # repair_poll requeue.
+        dwell = self.repair.min_degraded_seconds
+        if dwell > 0:
+            now = parse_iso(now_iso())
+            ripe = []
+            for c in degraded:
+                fr = c.status.failure
+                try:
+                    age = (now - parse_iso(fr.observed_at)).total_seconds()
+                except (AttributeError, ValueError):
+                    age = dwell  # no/unreadable record: repair immediately
+                if age >= dwell:
+                    ripe.append(c)
+            degraded = ripe
+        # Last-look health probe — applied BEFORE the budget slice (like
+        # the dwell) so a probe-healthy member cannot consume the repair
+        # slot and starve a genuinely dead sibling: never replace a member
+        # whose hardware is answering healthy RIGHT NOW. After a brownout
+        # lifts, members recover at staggered times, and the one still
+        # marked Degraded may be a single damped probe away from
+        # recovering in place; its own recovery streak reclaims it —
+        # repair is for members that are still sick. An unreachable fabric
+        # is not evidence of member failure either. Device-vanished
+        # degrades are exempt: their evidence is the fabric LISTING (probe
+        # health can be OK while the attachment is gone), and their
+        # recovery belongs to the syncer — a healthy probe must not
+        # indefinitely defer their repair.
+        vetted = []
+        for c in degraded:
+            fr = c.status.failure
+            if fr is None or fr.source != "syncer":
+                try:
+                    if self.fabric.check_resource(c).healthy:
+                        continue
+                except FabricError:
+                    continue
+            vetted.append(c)
+        degraded = vetted
+
+        budget = max(1, req.spec.max_concurrent_repairs) - still_in_flight
+        for c in degraded[: max(0, budget)]:
+            if policy == REPAIR_DETACH_ONLY:
+                if not c.spec.force_detach:
+                    c.spec.force_detach = True
+                    try:
+                        c = self.store.update(c)
+                    except (ConflictError, NotFoundError):
+                        continue  # retried next pass
+                self._delete_children(req, [c])
+                repairs_total.inc(outcome="detached")
+                self.recorder.event(
+                    req, WARNING, "RepairDetachOnly",
+                    f"detaching failed member {c.name}"
+                    f" ({c.spec.target_node}); repairPolicy=DetachOnly —"
+                    " normal lost-member recovery replaces it",
+                )
+                continue
+            try:
+                self._start_replacement(req, c)
+            except UnsupportedRepair:
+                # Provider cannot swap a worker's chips in place: fall back
+                # to break-before-make — detach the failed member and let
+                # the full re-solve rebuild (today's recovery path).
+                if not c.spec.force_detach:
+                    c.spec.force_detach = True
+                    try:
+                        c = self.store.update(c)
+                    except (ConflictError, NotFoundError):
+                        continue
+                self._delete_children(req, [c])
+                repairs_total.inc(outcome="fallback")
+                self.recorder.event(
+                    req, WARNING, "RepairFallback",
+                    f"provider has no in-place member repair; detaching"
+                    f" {c.name} and re-solving",
+                )
+            except (AllocationError, FabricError) as e:
+                repairs_total.inc(outcome="failed")
+                msg = f"repair of {c.name} failed (will retry): {e}"
+                if req.status.error != msg:
+                    req.status.error = msg
+                    try:
+                        self._write_status(req)
+                    except (ConflictError, NotFoundError):
+                        pass
+                    self.recorder.event(req, WARNING, "RepairFailed", msg)
+                break  # capacity/fabric problem — no point trying siblings now
+        return Result(requeue_after=self.timing.repair_poll)
+
+    def _start_replacement(
+        self, req: ComposabilityRequest, c: ComposableResource
+    ) -> None:
+        """Make-before-break front half: place a replacement member on
+        healthy capacity, re-carve the slice worker's chips there (tpu),
+        create the replacement child, and mark the failed member Repairing.
+        The replacement's attach then runs the normal Attaching machinery —
+        durable pending_op intent, dispatcher batching, attach budget — so
+        a crash mid-repair is adopted like any other in-flight attach."""
+        res = req.spec.resource
+        quarantined = self._quarantined_nodes()
+        exclude = {
+            ch.spec.target_node
+            for ch in self._children(req) if not ch.being_deleted
+        }
+        if res.type == "tpu" and c.spec.slice_name:
+            shape = solve_slice(res.model, res.size, res.topology)
+            nodes = self.scheduler.place_extra(
+                req, shape, exclude=exclude, count=1, quarantined=quarantined
+            )
+            node = nodes[0]
+            # Fabric step: swap worker w's chip group onto the new node
+            # from healthy inventory (raises UnsupportedRepair -> caller
+            # falls back; FabricError -> retried next pass, nothing
+            # created yet).
+            self.fabric.repair_slice_member(
+                c.spec.slice_name, c.spec.worker_id, node
+            )
+        else:
+            picked = self.scheduler.place_scalar(
+                req, 1, [ch.spec.target_node for ch in self._children(req)
+                         if not ch.being_deleted],
+                quarantined,
+            )
+            node = picked[0]
+
+        repl = ComposableResource()
+        repl.metadata.name = generate_resource_name(res.type)
+        repl.metadata.labels[LABEL_MANAGED_BY] = req.name
+        repl.metadata.annotations[ANNOTATION_REPLACES] = c.name
+        repl.metadata.finalizers = [FINALIZER]
+        repl.spec = ComposableResourceSpec(
+            type=res.type,
+            model=res.model,
+            target_node=node,
+            force_detach=res.force_detach,
+            chip_count=c.spec.chip_count,
+            slice_name=c.spec.slice_name,
+            worker_id=c.spec.worker_id,
+            topology=c.spec.topology,
+        )
+        repl.set_owner(req)
+        self.store.create(repl)
+
+        # Mark the failed member Repairing (annotation first — the update
+        # bumps rv — then the state on the returned object) so the surge
+        # accounting and a restarted operator see the repair in flight.
+        c.metadata.annotations[ANNOTATION_REPLACED_BY] = repl.metadata.name
+        try:
+            c = self.store.update(c)
+            c.status.state = RESOURCE_STATE_REPAIRING
+            self.store.update_status(c)
+        except (ConflictError, NotFoundError):
+            pass  # next pass re-marks; the replacement already exists
+        # Bookkeeping on the parent: the replacement's row (placement
+        # claim) and the authoritative coordinates for worker w.
+        req.status.resources[repl.metadata.name] = ResourceStatus(
+            node_name=node,
+            worker_id=c.spec.worker_id if res.type == "tpu" else -1,
+        )
+        if (
+            res.type == "tpu"
+            and 0 <= c.spec.worker_id < len(req.status.slice.worker_hostnames)
+        ):
+            req.status.slice.worker_hostnames[c.spec.worker_id] = node
+        try:
+            self._write_status(req)
+        except (ConflictError, NotFoundError):
+            pass  # refolded from children on the next pass
+        repairs_total.inc(outcome="started")
+        self.recorder.event(
+            req, "Normal", "RepairStarted",
+            f"replacing failed member {c.name} ({c.spec.target_node}) with"
+            f" {repl.metadata.name} on {node}"
+            f" (worker {c.spec.worker_id})",
+        )
 
     def _shrink_to_zero(self, req: ComposabilityRequest, children) -> Result:
         if children:

@@ -37,9 +37,10 @@ reads surface as ``ConflictError`` → rate-limited requeue, unchanged.
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from tpu_composer.agent.cdi import generate_cdi_spec
 from tpu_composer.agent.nodeagent import AgentError, DeviceBusyError, NodeAgent
@@ -48,16 +49,19 @@ from tpu_composer.api.meta import now_iso
 from tpu_composer.api.types import (
     ComposabilityRequest,
     ComposableResource,
+    FailureRecord,
     FINALIZER,
     LABEL_MANAGED_BY,
     LABEL_READY_TO_DETACH,
     Node,
     PendingOp,
     RESOURCE_STATE_ATTACHING,
+    RESOURCE_STATE_DEGRADED,
     RESOURCE_STATE_DELETING,
     RESOURCE_STATE_DETACHING,
     RESOURCE_STATE_EMPTY,
     RESOURCE_STATE_ONLINE,
+    RESOURCE_STATE_REPAIRING,
 )
 from tpu_composer.fabric.breaker import BreakerOpenError
 from tpu_composer.fabric.provider import (
@@ -75,6 +79,7 @@ from tpu_composer.runtime.events import WARNING, EventRecorder
 from tpu_composer.runtime.metrics import (
     composed_chips,
     fabric_requests_total,
+    member_degradations_total,
     reconcile_total,
     resources_quarantined_total,
 )
@@ -104,6 +109,58 @@ class ResourceTiming:
     # owning request reallocates around its node. <= 0 disables (reference
     # behavior: retry the same host forever, requeueOnErr :436-446).
     attach_budget: int = 5
+    # -- post-Ready failure detection (self-healing data plane) -----------
+    # Consecutive FAILED health probes before an Online member transitions
+    # to Degraded (flap damping: a single bad probe writes nothing). The
+    # reference records every flip and never acts; <= 1 degrades on the
+    # first bad probe.
+    health_failure_threshold: int = 3
+    # Consecutive HEALTHY probes before a Degraded member returns to Online
+    # (the recovery side of the same damping — a brownout lifting must not
+    # bounce members Online on one lucky probe).
+    health_recovery_threshold: int = 2
+    # Poll cadence while Degraded/Repairing (faster than health_poll so
+    # recovery and repair completion are observed promptly).
+    degraded_poll: float = 5.0
+    # Node escalation: this many Degraded transitions on one node within
+    # node_degrade_window seconds quarantine the node via the PR 1
+    # DeviceTaintRule path (distinct reason) so replacements land
+    # elsewhere. <= 0 disables.
+    node_degrade_threshold: int = 3
+    node_degrade_window: float = 600.0
+
+
+def degrade_member(
+    store, publisher, recorder, res: ComposableResource, *,
+    reason: str, detail: str, source: str, probes: int = 0,
+) -> bool:
+    """Shared durable Online -> Degraded transition — the ONE encoding of
+    "this attached member's hardware failed" consumed by both detectors
+    (the controller's damped health probes and the syncer's device-vanished
+    pass): structured failure record + device taints + event + metric, all
+    anchored on the same status write. Returns False when the write lost
+    (the caller's next pass re-detects from the same fabric state)."""
+    res.status.state = RESOURCE_STATE_DEGRADED
+    res.status.error = detail
+    res.status.failure = FailureRecord(
+        reason=reason, detail=detail, source=source,
+        observed_at=now_iso(), probe_failures=probes,
+    )
+    try:
+        store.update_status(res)
+    except (ConflictError, NotFoundError):
+        return False
+    if res.status.device_ids:
+        publisher.create_taints(
+            res.spec.target_node, res.status.device_ids, "degraded"
+        )
+    member_degradations_total.inc(source=source)
+    recorder.event(
+        res, WARNING, "Degraded",
+        f"{reason} on {res.spec.target_node}: {detail}"
+        + (f" ({probes} consecutive failed observations)" if probes else ""),
+    )
+    return True
 
 
 class ComposableResourceReconciler(Controller):
@@ -164,6 +221,20 @@ class ComposableResourceReconciler(Controller):
         # at quarantine — so a restart resumes the streak from the last
         # persisted floor, not necessarily the exact count.
         self._attach_streaks: dict = {}
+        # Health-probe damping (resource name -> consecutive counts). In
+        # memory ONLY — by design a transient flip leaves no trace in the
+        # store (the debounce this subsystem exists for); a restart simply
+        # restarts the window, which can only delay a Degraded transition
+        # by < threshold probes.
+        self._health_streaks: Dict[str, int] = {}
+        self._recovery_streaks: Dict[str, int] = {}
+        # Node escalation clock: node -> monotonic stamps of recent
+        # Degraded transitions there (post-Ready analog of attach streaks),
+        # plus the member names already counted this episode (so the
+        # level-triggered Degraded handler feeds the clock exactly once
+        # per episode, whichever detector wrote the transition).
+        self._node_degrades: Dict[str, List[float]] = {}
+        self._escalation_counted: set = set()
         # Node deletions GC dependent resources (reference watches nodes via
         # the request controller; we react directly, :137-183).
         self.watch("Node", mapper=self._map_node_event)
@@ -215,6 +286,9 @@ class ComposableResourceReconciler(Controller):
         res = self.store.try_get(ComposableResource, name)
         if res is None:
             self._attach_streaks.pop(name, None)
+            self._health_streaks.pop(name, None)
+            self._recovery_streaks.pop(name, None)
+            self._escalation_counted.discard(name)
             if self.dispatcher is not None:
                 # Drop queued submissions and parked outcomes for a purged
                 # CR. An op already at the fabric is left to complete: the
@@ -255,6 +329,10 @@ class ComposableResourceReconciler(Controller):
             return self._handle_attaching(res)
         if state == RESOURCE_STATE_ONLINE:
             return self._handle_online(res)
+        if state == RESOURCE_STATE_DEGRADED:
+            return self._handle_degraded(res)
+        if state == RESOURCE_STATE_REPAIRING:
+            return self._handle_repairing(res)
         if state == RESOURCE_STATE_DETACHING:
             return self._handle_detaching(res)
         if state == RESOURCE_STATE_DELETING:
@@ -480,9 +558,7 @@ class ComposableResourceReconciler(Controller):
         res.status.state = RESOURCE_STATE_ONLINE
         res.status.error = ""
         self.store.update_status(res)
-        composed_chips.set(
-            len(self.fabric_attached(res.spec.target_node)), node=res.spec.target_node
-        )
+        self._refresh_composed_gauge(res.spec.target_node)
         self.recorder.event(res, "Normal", "Attached",
                             f"{len(res.status.device_ids)} chip(s) online on {res.spec.target_node}")
         return Result()
@@ -712,42 +788,234 @@ class ComposableResourceReconciler(Controller):
             res, on_ready=lambda: self.queue.add(name)
         )
 
-    def fabric_attached(self, node: str):
-        # Dispatcher-served listings are single-flighted and snapshot-cached
-        # (staleness bounded by its batch window) — an attach wave's
-        # per-node gauge refreshes share one provider call.
+    def fabric_attached(self, node: str) -> Optional[List]:
+        """Devices the fabric reports attached to ``node`` — or ``None``
+        when the fabric is unreachable. The two outcomes MUST stay
+        distinguishable: swallowing the error into ``[]`` made "fabric
+        blip" identical to "no devices attached", and every caller that
+        refreshed a gauge or reasoned about emptiness silently zeroed out
+        on a wire flake.
+
+        Dispatcher-served listings are single-flighted and snapshot-cached
+        (staleness bounded by its batch window) — an attach wave's
+        per-node gauge refreshes share one provider call."""
         provider = self.dispatcher if self.dispatcher is not None else self.fabric
         try:
             return [d for d in provider.get_resources() if d.node == node]
-        except FabricError:
-            return []
+        except FabricError as e:
+            self.log.debug("fabric listing for %s unavailable: %s", node, e)
+            return None  # stale — callers must not treat as empty
+
+    def _refresh_composed_gauge(self, node: str) -> None:
+        """Level-set tpuc_composed_chips for one node; a fabric blip keeps
+        the last known value instead of zeroing the gauge."""
+        attached = self.fabric_attached(node)
+        if attached is not None:
+            composed_chips.set(len(attached), node=node)
+
+    def _begin_teardown(self, res: ComposableResource) -> Optional[Result]:
+        """Shared deletion/ready-to-detach entry for the attached states
+        (Online/Degraded/Repairing): route to Detaching with a durable
+        remove intent. Returns None when teardown is not requested."""
+        if not (
+            res.being_deleted or res.metadata.labels.get(LABEL_READY_TO_DETACH)
+        ):
+            return None
+        if not res.being_deleted:
+            # Syncer detach-CR: begin teardown immediately (:310-315).
+            res = delete_tolerant(self.store, ComposableResource, res.name)
+            if res is None:
+                return Result()  # already purged — nothing left to detach
+        res.status.state = RESOURCE_STATE_DETACHING
+        # Durable detach intent rides the transition write, ordered
+        # before any fabric remove.
+        res.status.pending_op = self._new_intent("remove", res)
+        try:
+            self.store.update_status(res)
+        except NotFoundError:
+            return Result()  # purged concurrently — teardown already won
+        return Result(requeue_after=self.timing.detach_fast)
 
     def _handle_online(self, res: ComposableResource) -> Result:
-        if res.being_deleted or res.metadata.labels.get(LABEL_READY_TO_DETACH):
-            if not res.being_deleted:
-                # Syncer detach-CR: begin teardown immediately (:310-315).
-                res = delete_tolerant(self.store, ComposableResource, res.name)
-                if res is None:
-                    return Result()  # already purged — nothing left to detach
-            res.status.state = RESOURCE_STATE_DETACHING
-            # Durable detach intent rides the transition write, ordered
-            # before any fabric remove.
-            res.status.pending_op = self._new_intent("remove", res)
-            try:
-                self.store.update_status(res)
-            except NotFoundError:
-                return Result()  # purged concurrently — teardown already won
-            return Result(requeue_after=self.timing.detach_fast)
+        teardown = self._begin_teardown(res)
+        if teardown is not None:
+            return teardown
 
+        name = res.name
         health = self.fabric.check_resource(res)
         fabric_requests_total.inc(op="check", outcome=health.state.lower())
-        err = "" if health.healthy else f"fabric health {health.state}: {health.detail}"
-        if err != res.status.error:
-            res.status.error = err
-            self.store.update_status(res)
-            if err:
-                self.recorder.event(res, WARNING, "Unhealthy", err)
-        return Result(requeue_after=self.timing.health_poll)
+        if health.healthy:
+            self._health_streaks.pop(name, None)
+            if res.status.error:
+                # Clear a stale surfaced error (e.g. from the attach path);
+                # written only when something was actually there.
+                res.status.error = ""
+                try:
+                    self.store.update_status(res)
+                except (ConflictError, NotFoundError):
+                    pass  # bookkeeping only
+            return Result(requeue_after=self.timing.health_poll)
+
+        # Flap damping: a failed probe below the threshold writes NOTHING —
+        # no status update, no event. A flapping probe must not spam the
+        # store and event log (the reference rewrote status on every flip).
+        streak = self._health_streaks.get(name, 0) + 1
+        self._health_streaks[name] = streak
+        threshold = max(1, self.timing.health_failure_threshold)
+        if streak < threshold:
+            return Result(requeue_after=self.timing.health_poll)
+        return self._degrade(
+            res,
+            reason="health-probe",
+            detail=f"fabric health {health.state}: {health.detail}",
+            source="health-probe",
+            probes=streak,
+        )
+
+    def _degrade(
+        self, res: ComposableResource, *, reason: str, detail: str,
+        source: str, probes: int,
+    ) -> Result:
+        """Durable Online -> Degraded transition (shared degrade_member
+        encoding) plus the controller-local bits: streak reset and the
+        node-escalation clock."""
+        name = res.name
+        self._health_streaks.pop(name, None)
+        self._recovery_streaks.pop(name, None)
+        if not degrade_member(
+            self.store, self.publisher, self.recorder, res,
+            reason=reason, detail=detail, source=source, probes=probes,
+        ):
+            # Lost the write — the next reconcile re-detects from the same
+            # fabric state (streak restarts; strictly a delay, never a miss
+            # for a persistent failure).
+            return Result(requeue_after=self.timing.health_poll)
+        self.log.warning("%s: degraded (%s): %s", name, source, detail)
+        self._escalation_counted.add(name)
+        self._note_node_degrade(res)
+        return Result(requeue_after=self.timing.degraded_poll)
+
+    def _note_node_degrade(self, res: ComposableResource) -> None:
+        """Escalation clock: repeated post-Ready failures on one node mean
+        the HOST (fabric port, PCIe path, cooling) is the problem, not the
+        chips — quarantine it via the PR 1 DeviceTaintRule path (distinct
+        reason) so replacement capacity lands elsewhere. Same guard as the
+        attach-budget quarantine: never taint the last healthy node."""
+        threshold = self.timing.node_degrade_threshold
+        if threshold <= 0:
+            return
+        node = res.spec.target_node
+        now = time.monotonic()
+        window = self.timing.node_degrade_window
+        hits = self._node_degrades.setdefault(node, [])
+        hits.append(now)
+        hits[:] = [t for t in hits if now - t <= window]
+        if len(hits) < threshold:
+            return
+        quarantined = quarantined_nodes(self.store)
+        if node in quarantined:
+            return
+        others = any(
+            n.metadata.name != node
+            and n.metadata.name not in quarantined
+            and n.status.ready and not n.spec.unschedulable
+            for n in self.store.list(Node)
+        )
+        if not others:
+            # Quarantining the last healthy host strands every owner in
+            # AllocationError — same stop as the attach-budget path.
+            return
+        msg = (
+            f"post-ready-failures: {len(hits)} member degradations on"
+            f" {node} within {window:.0f}s (last: {res.status.error})"
+        )
+        self.publisher.quarantine_node(node, msg)
+        resources_quarantined_total.inc(node=node)
+        self.recorder.event(res, WARNING, "NodeQuarantined", msg)
+        self.log.warning("node %s: %s", node, msg)
+        hits.clear()
+
+    def _handle_degraded(self, res: ComposableResource) -> Result:
+        teardown = self._begin_teardown(res)
+        if teardown is not None:
+            return teardown
+
+        name = res.name
+        # Degrades written by other detectors (the syncer's device-vanished
+        # pass) reach this handler via the watch without ever passing
+        # _degrade — feed the node-escalation clock here, once per episode
+        # (the in-memory set restarts with the process; re-counting a
+        # still-degraded member once after a restart is conservative).
+        if name not in self._escalation_counted:
+            self._escalation_counted.add(name)
+            self._note_node_degrade(res)
+            # Level re-assert of the "degraded" device taints, once per
+            # episode per process: degrade_member creates them AFTER the
+            # status commit, so a store fault there (or a crash between
+            # the two) would otherwise leave sick chips advertised to
+            # schedulers forever. create_taints is idempotent.
+            if res.status.device_ids:
+                self.publisher.create_taints(
+                    res.spec.target_node, res.status.device_ids, "degraded"
+                )
+
+        # A device-vanished degrade recovers on LISTING evidence, which the
+        # syncer owns: the per-attachment health probe can answer OK while
+        # the attachment is gone from get_resources() — the exact drift
+        # that detector exists for. Probe-based recovery here would flip
+        # the member Online, the syncer would re-degrade it next pass, and
+        # the livelock would churn events forever while the repair driver's
+        # healthy-probe last-look kept skipping it.
+        fr = res.status.failure
+        if fr is not None and fr.source == "syncer":
+            return Result(requeue_after=self.timing.degraded_poll)
+
+        # Recovery probing (damped like detection): a Degraded member whose
+        # fabric health returns — e.g. a brownout lifting while the repair
+        # breaker held repairs frozen — goes back to Online instead of
+        # being detached.
+        health = self.fabric.check_resource(res)
+        fabric_requests_total.inc(op="check", outcome=health.state.lower())
+        if health.healthy:
+            streak = self._recovery_streaks.get(name, 0) + 1
+            if streak >= max(1, self.timing.health_recovery_threshold):
+                self._recovery_streaks.pop(name, None)
+                # Taints first: if this raises (store fault) the member
+                # stays Degraded and the whole recovery retries — ordered
+                # the other way, a fault after the commit would strand
+                # stale "degraded" taints on healthy chips until detach.
+                self.publisher.delete_taints(res.status.device_ids)
+                res.status.state = RESOURCE_STATE_ONLINE
+                res.status.error = ""
+                res.status.failure = None
+                try:
+                    self.store.update_status(res)
+                except (ConflictError, NotFoundError):
+                    return Result(requeue_after=self.timing.degraded_poll)
+                # Only a COMMITTED recovery ends the episode: dropping the
+                # escalation mark before the write could double-count one
+                # real failure into the node clock when the write loses.
+                self._escalation_counted.discard(name)
+                self.recorder.event(
+                    res, "Normal", "Recovered",
+                    f"fabric health recovered after {streak} consecutive"
+                    " healthy probes",
+                )
+                return Result(requeue_after=self.timing.health_poll)
+            self._recovery_streaks[name] = streak
+        else:
+            self._recovery_streaks.pop(name, None)
+        return Result(requeue_after=self.timing.degraded_poll)
+
+    def _handle_repairing(self, res: ComposableResource) -> Result:
+        """A member the repair driver committed to replacing: inert here —
+        the owning request watches the replacement and deletes this member
+        after the drain grace. Deletion (and node-gone GC) still route
+        through the normal teardown."""
+        teardown = self._begin_teardown(res)
+        if teardown is not None:
+            return teardown
+        return Result(requeue_after=self.timing.degraded_poll)
 
     def _handle_detaching(self, res: ComposableResource) -> Result:
         node = res.spec.target_node
@@ -832,7 +1100,7 @@ class ComposableResourceReconciler(Controller):
             self.store.update_status(res)
         except NotFoundError:
             pass  # purged concurrently — the fabric release still happened
-        composed_chips.set(len(self.fabric_attached(node)), node=node)
+        self._refresh_composed_gauge(node)
         self.recorder.event(res, "Normal", "Detached", f"released from {node}")
         return Result(requeue_after=self.timing.detach_fast)
 

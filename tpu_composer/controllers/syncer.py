@@ -39,9 +39,13 @@ from tpu_composer.api.types import (
     ComposableResourceSpec,
     LABEL_READY_TO_DETACH,
     Node,
+    RESOURCE_STATE_DEGRADED,
+    RESOURCE_STATE_ONLINE,
+    RESOURCE_STATE_REPAIRING,
 )
 from tpu_composer.fabric.provider import FabricError, FabricProvider
 from tpu_composer.runtime.events import WARNING, EventRecorder
+from tpu_composer.runtime.metrics import degraded_members
 from tpu_composer.runtime.store import (
     AlreadyExistsError,
     NotFoundError,
@@ -77,13 +81,22 @@ class UpstreamSyncer:
         period: float = 60.0,  # :61
         grace: float = 600.0,  # :38 (10 min)
         recorder: Optional[EventRecorder] = None,
+        vanish_threshold: int = 2,
     ) -> None:
         self.store = store
         self.fabric = fabric
         self.period = period
         self.grace = grace
         self.recorder = recorder or EventRecorder()
+        # Consecutive sync passes an Online member's device must be absent
+        # from get_resources() before the member is marked Degraded
+        # (device-vanished detection). Damping twin of the controller's
+        # health_failure_threshold: one glitchy listing must not degrade a
+        # healthy member.
+        self.vanish_threshold = max(1, vanish_threshold)
         self.log = logging.getLogger("UpstreamSyncer")
+        # resource name -> consecutive passes its devices were missing.
+        self._vanish_counts: Dict[str, int] = {}
         # device_id -> first-seen-missing time in the caller's `now`
         # timebase (:38, :107-123). Seeded from the durable trackers on the
         # first pass so a restart resumes, not resets, each grace clock.
@@ -119,11 +132,8 @@ class UpstreamSyncer:
         self._sweep_stale_quarantines()
         upstream = self.fabric.get_resources()
 
-        local_ids = {
-            d
-            for r in self.store.list(ComposableResource)
-            for d in r.status.device_ids
-        }
+        resources = self.store.list(ComposableResource)
+        local_ids = {d for r in resources for d in r.status.device_ids}
         upstream_ids = set()
         created = 0
 
@@ -155,7 +165,132 @@ class UpstreamSyncer:
             if dev_id not in upstream_ids:
                 del self._missing[dev_id]
                 self._drop_tracker(dev_id)
+
+        # Post-Ready failure detection, syncer arm: an ONLINE member whose
+        # devices left the fabric listing has lost its attachment out from
+        # under the workload — feed the same Degraded path the health
+        # probes use (self-healing data plane). Runs only on a SUCCESSFUL
+        # listing: a fabric outage raises out of get_resources() above and
+        # never reaches here, so "unreachable" can't masquerade as
+        # "vanished".
+        self._detect_vanished(resources, upstream_ids)
         return created
+
+    def _detect_vanished(self, resources, upstream_ids) -> None:
+        from tpu_composer.agent.publisher import DevicePublisher
+        from tpu_composer.controllers.resource_controller import degrade_member
+
+        # Prune clocks of members that no longer exist (deleted
+        # mid-damping): every other pop site keys off the member being
+        # listed, so without this sweep churning fleets grow the dict
+        # unboundedly (the resource controller prunes its streak dicts on
+        # purge the same way).
+        names = {r.name for r in resources}
+        for stale in [k for k in self._vanish_counts if k not in names]:
+            del self._vanish_counts[stale]
+        degraded = 0
+        for r in resources:
+            if (
+                r.status.state == RESOURCE_STATE_DEGRADED
+                and not r.being_deleted
+                and r.status.failure is not None
+                and r.status.failure.source == "syncer"
+                and r.status.device_ids
+                and all(d in upstream_ids for d in r.status.device_ids)
+            ):
+                # Listing-based recovery, the mirror of listing-based
+                # detection: a device-vanished degrade recovers when every
+                # device is reported again. (The member's own handler
+                # deliberately does NOT probe-recover these — health can
+                # answer OK while the attachment is missing.)
+                if self._recover_vanished(r):
+                    continue
+            if r.status.state in (
+                RESOURCE_STATE_DEGRADED, RESOURCE_STATE_REPAIRING,
+            ) and not r.being_deleted:
+                # Same predicate as the request controller's breaker pass
+                # (terminating members excluded) so the two level-setters
+                # of tpuc_degraded can't flap against each other.
+                degraded += 1
+            if (
+                r.status.state != RESOURCE_STATE_ONLINE
+                or r.being_deleted
+                or r.status.pending_op is not None  # mutation racing the listing
+                or not r.status.device_ids
+            ):
+                self._vanish_counts.pop(r.name, None)
+                continue
+            missing = [
+                d for d in r.status.device_ids if d not in upstream_ids
+            ]
+            if not missing:
+                self._vanish_counts.pop(r.name, None)
+                continue
+            n = self._vanish_counts.get(r.name, 0) + 1
+            if n < self.vanish_threshold:
+                self._vanish_counts[r.name] = n  # damped: no write yet
+                continue
+            try:
+                ok = degrade_member(
+                    self.store, DevicePublisher(self.store), self.recorder, r,
+                    reason="device-vanished",
+                    detail=(
+                        f"device(s) {', '.join(missing)} no longer reported"
+                        " by the fabric"
+                    ),
+                    source="syncer",
+                    probes=n,
+                )
+            except StoreError as e:
+                self.log.warning(
+                    "degrading %s (vanished devices) failed: %s — retrying"
+                    " next tick", r.name, e,
+                )
+                self._vanish_counts[r.name] = n  # keep the ripened clock
+                continue
+            if not ok:
+                # Write lost a conflict (degrade_member returns False):
+                # keep the ripened vanish clock so the very next tick
+                # retries, and do NOT report a transition that never
+                # committed.
+                self._vanish_counts[r.name] = n
+                continue
+            self._vanish_counts.pop(r.name, None)
+            degraded += 1
+            self.log.warning(
+                "%s: Online member's device(s) vanished from the fabric"
+                " listing (%s) — marked Degraded", r.name, ", ".join(missing),
+            )
+        # Level-set the fleet gauge every pass (drift-proof, unlike
+        # inc/dec pairs that desync across restarts).
+        degraded_members.set(float(degraded))
+
+    def _recover_vanished(self, r) -> bool:
+        """Return a device-vanished Degraded member to Online (its devices
+        are all reported by the fabric again). Returns False when the
+        write lost — retried next pass."""
+        from tpu_composer.agent.publisher import DevicePublisher
+
+        try:
+            # Taints first: failing here retries the WHOLE recovery next
+            # pass; the other order could strand "degraded" taints on
+            # healthy chips until detach.
+            DevicePublisher(self.store).delete_taints(r.status.device_ids)
+            r.status.state = RESOURCE_STATE_ONLINE
+            r.status.error = ""
+            r.status.failure = None
+            self.store.update_status(r)
+        except StoreError:
+            return False  # conflict/404/outage — retried next pass
+        self.recorder.event(
+            r, "Normal", "Recovered",
+            "vanished device(s) are reported by the fabric again",
+        )
+        self.log.warning(
+            "%s: devices reappeared in the fabric listing — recovered to"
+            " Online", r.name,
+        )
+        return True
 
     # ------------------------------------------------------------------
     # durable grace clock (crash consistency)

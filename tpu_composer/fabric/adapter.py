@@ -30,7 +30,7 @@ class AdapterError(ValueError):
 _TRACED_METHODS = (
     "add_resource", "remove_resource", "check_resource", "get_resources",
     "add_resources", "remove_resources",
-    "reserve_slice", "release_slice", "resize_slice",
+    "reserve_slice", "release_slice", "resize_slice", "repair_slice_member",
 )
 
 

@@ -345,3 +345,11 @@ class BreakerFabricProvider(FabricProvider):
         return self._call(
             "", self._inner.resize_slice, slice_name, model, topology, nodes
         )
+
+    def repair_slice_member(
+        self, slice_name: str, worker_id: int, node: str
+    ) -> None:
+        # Node-scoped: the re-carve lands on the replacement's node.
+        return self._call(
+            node, self._inner.repair_slice_member, slice_name, worker_id, node
+        )

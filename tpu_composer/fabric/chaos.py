@@ -62,6 +62,12 @@ class ChaosFabricProvider(FabricProvider):
         self._blackout = False
         self._node_failures: Dict[str, int] = {}  # node -> remaining (-1 = forever)
         self._op_failures: Dict[str, int] = {}  # verb name -> remaining
+        # Post-Ready failure modes (self-healing data plane): these shape
+        # what health the fabric REPORTS rather than raising errors —
+        # a degraded chip is a successful call carrying bad news.
+        self._degraded_nodes: set = set()  # node blackout after Ready
+        self._flapping: Dict[str, int] = {}  # device_id -> probe counter
+        self._vanished: set = set()  # device ids omitted from get_resources
         self.calls = 0
         self.injected = 0  # failures actually raised
 
@@ -74,11 +80,15 @@ class ChaosFabricProvider(FabricProvider):
             self._blackout = True
 
     def heal(self) -> None:
-        """Clear the blackout AND all scripted failures."""
+        """Clear the blackout, all scripted failures AND the post-Ready
+        health-shaping modes (degraded nodes, flapping, vanished)."""
         with self._lock:
             self._blackout = False
             self._node_failures.clear()
             self._op_failures.clear()
+            self._degraded_nodes.clear()
+            self._flapping.clear()
+            self._vanished.clear()
 
     def fail_node(self, node: str, times: int = -1) -> None:
         """Fail node-scoped calls targeting `node`; -1 = until healed."""
@@ -93,6 +103,41 @@ class ChaosFabricProvider(FabricProvider):
         """Fail the next `times` calls of one verb (e.g. 'get_resources')."""
         with self._lock:
             self._op_failures[op] = times
+
+    # -- post-Ready failure modes (health-shaping, not call failures) ----
+    def degrade_node(self, node: str) -> None:
+        """Node blackout after Ready: every health probe for resources on
+        `node` answers Critical (and get_resources reports its devices
+        Critical) until restore_node. Calls still SUCCEED — a brownout is
+        the fabric answering with bad news, which is what must drive the
+        repair breaker rather than the error-path machinery."""
+        with self._lock:
+            self._degraded_nodes.add(node)
+
+    def restore_node(self, node: str) -> None:
+        with self._lock:
+            self._degraded_nodes.discard(node)
+
+    def flap_device(self, device_id: str) -> None:
+        """Flapping health: probes of a resource holding `device_id`
+        alternate Critical/OK per call — the signal the detection damping
+        must absorb without a single status write."""
+        with self._lock:
+            self._flapping.setdefault(device_id, 0)
+
+    def heal_device(self, device_id: str) -> None:
+        with self._lock:
+            self._flapping.pop(device_id, None)
+
+    def vanish_device(self, device_id: str) -> None:
+        """Listing drift: get_resources omits the device while everything
+        else still works — the syncer's device-vanished detection path."""
+        with self._lock:
+            self._vanished.add(device_id)
+
+    def unvanish_device(self, device_id: str) -> None:
+        with self._lock:
+            self._vanished.discard(device_id)
 
     # ------------------------------------------------------------------
     def _chaos(self, op: str, node: str = "") -> None:
@@ -162,11 +207,41 @@ class ChaosFabricProvider(FabricProvider):
 
     def check_resource(self, resource: ComposableResource) -> DeviceHealth:
         self._chaos("check_resource", resource.spec.target_node)
+        with self._lock:
+            if resource.spec.target_node in self._degraded_nodes:
+                return DeviceHealth(
+                    "Critical",
+                    f"chaos: node {resource.spec.target_node} blackout",
+                )
+            for dev in resource.status.device_ids:
+                if dev in self._flapping:
+                    self._flapping[dev] += 1
+                    if self._flapping[dev] % 2 == 1:
+                        return DeviceHealth(
+                            "Critical", f"chaos: {dev} health flap"
+                        )
         return self._inner.check_resource(resource)
 
     def get_resources(self) -> List[FabricDevice]:
         self._chaos("get_resources")
-        return self._inner.get_resources()
+        out = self._inner.get_resources()
+        with self._lock:
+            degraded, vanished = set(self._degraded_nodes), set(self._vanished)
+        if vanished:
+            out = [d for d in out if d.device_id not in vanished]
+        if degraded:
+            out = [
+                FabricDevice(
+                    device_id=d.device_id, node=d.node, model=d.model,
+                    slice_name=d.slice_name,
+                    health=DeviceHealth(
+                        "Critical", f"chaos: node {d.node} blackout"
+                    ),
+                    type=d.type, resource_name=d.resource_name,
+                ) if d.node in degraded else d
+                for d in out
+            ]
+        return out
 
     def reserve_slice(
         self, slice_name: str, model: str, topology: str, nodes: List[str]
@@ -183,3 +258,9 @@ class ChaosFabricProvider(FabricProvider):
     ) -> None:
         self._chaos("resize_slice")
         return self._inner.resize_slice(slice_name, model, topology, nodes)
+
+    def repair_slice_member(
+        self, slice_name: str, worker_id: int, node: str
+    ) -> None:
+        self._chaos("repair_slice_member", node)
+        return self._inner.repair_slice_member(slice_name, worker_id, node)

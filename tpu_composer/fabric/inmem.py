@@ -82,6 +82,12 @@ class InMemoryPool(FabricProvider):
         self._add_failures: Dict[str, int] = {}  # resource_name -> remaining failures
         self._remove_failures: Dict[str, int] = {}
         self._leaked: List[FabricDevice] = []
+        # Dead-chip tracking (self-healing data plane): a killed chip reports
+        # Critical health forever and is never handed back out — chips that
+        # would return to the free pool land in the graveyard instead (the
+        # real-fabric analog: an RMA queue, not free inventory).
+        self._dead_ids: set = set()
+        self._graveyard: Dict[str, List[str]] = {}  # model -> retired dead chips
 
     # ------------------------------------------------------------------
     # slice transactions
@@ -172,6 +178,40 @@ class InMemoryPool(FabricProvider):
             resv.topology = topology
             resv.nodes = list(nodes)
 
+    def repair_slice_member(
+        self, slice_name: str, worker_id: int, node: str
+    ) -> None:
+        """Swap one worker's chip group for fresh chips on `node` without
+        touching any other worker (provider.py contract). The retired chips
+        stay with the failed member's live attachment until it detaches —
+        _remove_one_locked frees only chips no longer in the reservation,
+        routing dead ones to the graveyard."""
+        with self._lock:
+            resv = self._slices.get(slice_name)
+            if resv is None:
+                raise FabricError(f"slice {slice_name} not reserved")
+            old = resv.groups.get(worker_id)
+            if old is None:
+                raise FabricError(
+                    f"slice {slice_name} has no worker {worker_id}"
+                )
+            need = len(old)
+            free = self._free.get(resv.model, [])
+            if len(free) < need:
+                raise FabricError(
+                    f"slice {slice_name}: pool has {len(free)} free"
+                    f" {resv.model} chips, repair needs {need}"
+                )
+            attached_ids = {
+                d for a in self._attachments.values() for d in a.device_ids
+            }
+            resv.groups[worker_id] = [free.pop(0) for _ in range(need)]
+            if 0 <= worker_id < len(resv.nodes):
+                resv.nodes[worker_id] = node
+            for c in old:
+                if c not in attached_ids:
+                    self._release_chip(resv.model, c)
+
     def release_slice(self, slice_name: str) -> None:
         with self._lock:
             resv = self._slices.pop(slice_name, None)
@@ -184,7 +224,7 @@ class InMemoryPool(FabricProvider):
             for chips in resv.groups.values():
                 for c in chips:
                     if c not in attached_ids:
-                        self._free[resv.model].append(c)
+                        self._release_chip(resv.model, c)
 
     # ------------------------------------------------------------------
     # provider interface
@@ -321,13 +361,20 @@ class InMemoryPool(FabricProvider):
                 raise WaitingDeviceDetaching(f"{name}: detach in progress")
         del self._attachments[name]
         self._pending_detach.pop(name, None)
-        if att.slice_name and att.slice_name in self._slices:
-            # Chips return to the reservation (released with the slice).
-            pass
-        else:
-            self._free.setdefault(att.model, []).extend(att.device_ids)
+        resv = self._slices.get(att.slice_name) if att.slice_name else None
+        still_reserved = (
+            {c for grp in resv.groups.values() for c in grp}
+            if resv is not None else set()
+        )
         for d in att.device_ids:
-            self._health.pop(d, None)
+            if d not in still_reserved:
+                # Not part of the reservation (loose device, or retired by
+                # repair_slice_member) — back to inventory. Chips still in
+                # the reservation return with release_slice.
+                self._release_chip(att.model, d)
+        for d in att.device_ids:
+            if d not in self._dead_ids:
+                self._health.pop(d, None)
 
     def _drop_leaked(self, resource: ComposableResource) -> None:
         """A detach-CR created by the syncer targets an orphaned attachment by
@@ -342,7 +389,7 @@ class InMemoryPool(FabricProvider):
         kept = []
         for dev in self._leaked:
             if dev.device_id in ids:
-                self._free.setdefault(dev.model, []).append(dev.device_id)
+                self._release_chip(dev.model, dev.device_id)
             else:
                 kept.append(dev)
         self._leaked = kept
@@ -353,9 +400,11 @@ class InMemoryPool(FabricProvider):
             att.device_ids = [d for d in att.device_ids if d not in hit]
             if not (att.slice_name and att.slice_name in self._slices):
                 # (chips of a still-reserved slice return via release_slice)
-                self._free.setdefault(att.model, []).extend(sorted(hit))
+                for d in sorted(hit):
+                    self._release_chip(att.model, d)
             for d in hit:
-                self._health.pop(d, None)
+                if d not in self._dead_ids:
+                    self._health.pop(d, None)
             if not att.device_ids:
                 del self._attachments[name]
 
@@ -394,9 +443,52 @@ class InMemoryPool(FabricProvider):
             ) for l in self._leaked)
             return out
 
+    def _release_chip(self, model: str, device_id: str) -> None:
+        """Return one chip to inventory — free pool for healthy chips, the
+        graveyard for killed ones (a dead chip must never be carved into a
+        later reservation and immediately re-degrade it). Caller holds the
+        lock."""
+        if device_id in self._dead_ids:
+            self._graveyard.setdefault(model, []).append(device_id)
+        else:
+            self._free.setdefault(model, []).append(device_id)
+
     # ------------------------------------------------------------------
     # test/bench instrumentation (replaces URL-persona fault injection)
     # ------------------------------------------------------------------
+    def kill_device(self, device_id: str, detail: str = "device dead") -> None:
+        """Scripted post-Ready device death: the chip reports Critical
+        health forever (check_resource / get_resources) and leaves the
+        allocatable pool — free now if loose, via the graveyard when its
+        attachment detaches."""
+        with self._lock:
+            self._dead_ids.add(device_id)
+            self._health[device_id] = DeviceHealth("Critical", detail)
+            for model, lst in self._free.items():
+                if device_id in lst:
+                    lst.remove(device_id)
+                    self._graveyard.setdefault(model, []).append(device_id)
+                    break
+
+    def revive_device(self, device_id: str) -> None:
+        """Undo kill_device (the repaired-hardware case): health clears and
+        a graveyard chip returns to the free pool."""
+        with self._lock:
+            self._dead_ids.discard(device_id)
+            self._health.pop(device_id, None)
+            for model, lst in self._graveyard.items():
+                if device_id in lst:
+                    lst.remove(device_id)
+                    self._free.setdefault(model, []).append(device_id)
+                    break
+
+    def dead_chips(self, model: str) -> int:
+        """Graveyard size for one model (kill_device victims already retired
+        from circulation; soak accounting: free + graveyard + attached +
+        still-reserved == total inventory)."""
+        with self._lock:
+            return len(self._graveyard.get(model, []))
+
     def inject_add_failure(self, resource_name: str, times: int = 1) -> None:
         with self._lock:
             self._add_failures[resource_name] = times

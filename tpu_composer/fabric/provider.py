@@ -65,6 +65,13 @@ class UnsupportedResize(FabricError):
     """The provider cannot reshape a reservation in place; dissolve instead."""
 
 
+class UnsupportedRepair(FabricError):
+    """The provider cannot swap one worker's chip group in place
+    (repair_slice_member). Like UnsupportedBatch/UnsupportedResize this is
+    a capability probe, not a failure: the repair driver catches it and
+    falls back to detach-and-re-solve (break-before-make)."""
+
+
 class WaitingDeviceDetaching(FabricError):
     """Detach accepted but still in progress; requeue (client.go:43-44)."""
 
@@ -222,4 +229,21 @@ class FabricProvider(abc.ABC):
         dissolve-and-rebuild path."""
         raise UnsupportedResize(
             f"{type(self).__name__} has no live slice resize"
+        )
+
+    def repair_slice_member(
+        self, slice_name: str, worker_id: int, node: str
+    ) -> None:
+        """Re-carve ONE worker's chip group onto `node` from healthy free
+        inventory, leaving every other worker's chips untouched (the
+        make-before-break repair's fabric step). The retired chips stay
+        attached to the failed member until it detaches; the provider must
+        release them then (and must not hand known-dead chips back out).
+
+        Raises FabricError when the pool cannot satisfy the re-carve
+        (nothing changed). The default refuses with UnsupportedRepair; the
+        repair driver then falls back to detach-and-re-solve, which never
+        needs this verb."""
+        raise UnsupportedRepair(
+            f"{type(self).__name__} has no in-place member repair"
         )

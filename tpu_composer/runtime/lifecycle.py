@@ -57,6 +57,10 @@ _RESOURCE_PHASES = {
     "": "Pending",
     "Attaching": "Attaching",
     "Online": "Ready",
+    # Self-healing: post-Ready failure (damped health probes / vanished
+    # device) and the make-before-break window while a replacement attaches.
+    "Degraded": "Degraded",
+    "Repairing": "Repairing",
     "Detaching": "Detaching",
     "Deleting": "Terminating",
 }

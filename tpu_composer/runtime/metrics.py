@@ -314,6 +314,33 @@ store_chaos_injected_total = global_registry.counter(
     " (transient | conflict | watch_drop)",
 )
 
+#: Self-healing data plane (post-Ready failure detection + repair driver).
+member_degradations_total = global_registry.counter(
+    "tpuc_member_degradations_total",
+    "Online->Degraded transitions by detection source (health-probe ="
+    " damped consecutive failed probes; syncer = device vanished from the"
+    " fabric listing)",
+)
+degraded_members = global_registry.gauge(
+    "tpuc_degraded",
+    "Attached members currently Degraded or Repairing, fleet-wide"
+    " (level-set by the repair driver and the syncer's anti-drift pass)",
+)
+repairs_total = global_registry.counter(
+    "tpuc_repairs_total",
+    "Repair driver actions by outcome (started = replacement placed +"
+    " attaching; replaced = failed member detached after its replacement"
+    " came Online; detached = DetachOnly policy detach; fallback ="
+    " provider has no in-place repair, detached + re-solved; retried ="
+    " replacement died, repair re-attempted; failed = placement/fabric"
+    " error, retried next pass; frozen = fleet breaker freeze edge)",
+)
+repair_breaker_open = global_registry.gauge(
+    "tpuc_repair_breaker_open",
+    "1 while the fleet-level repair breaker is open (degraded fraction"
+    " above threshold — repairs and repair detaches frozen), else 0",
+)
+
 #: Cluster scheduler (scheduler/: priority queue, preemption, defrag).
 scheduler_queue_depth = global_registry.gauge(
     "tpuc_scheduler_queue_depth",
