@@ -4,7 +4,7 @@
 PYTHON ?= python
 IMG ?= tpu-composer:latest
 
-.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak
+.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak
 
 all: native test
 
@@ -68,6 +68,21 @@ chaos-soak:
 ## (TPUC_FLIGHT_FILE / TPUC_TRACE_FILE dumped + uploaded on CI failure).
 repair-soak:
 	$(PYTHON) -m pytest tests/test_repair_soak.py -q -m repair -p no:randomly
+
+## shard-soak: shard-failover chaos soak (tests/test_shard_failover.py,
+## markers slow+shard): three full operator replicas over one shared store
+## + fabric, each owning a balanced subset of shard leases; one replica is
+## hard-killed (-9 analog: writes stop landing mid-stream, dispatcher
+## abandons lanes, no lease release) mid-32-chip attach wave. Survivors
+## must steal the orphaned shards within ~one lease duration, run the
+## adoption pass SCOPED to the stolen shards' keys, and converge Ready
+## with the nonce-checked zero-double-attach invariant — plus no fabric
+## mutation from the dead replica's identity after its monotonic fencing
+## deadline. A second scenario proves the voluntary rebalance handoff
+## mid-wave. Same black-box contract as the other soaks (TPUC_FLIGHT_FILE /
+## TPUC_TRACE_FILE dumped + uploaded on CI failure).
+shard-soak:
+	$(PYTHON) -m pytest tests/test_shard_failover.py -q -m shard -p no:randomly
 
 ## watch-relay: poll the TPU tunnel relay; auto-capture the full on-chip
 ## probe to bench_artifacts/ the moment it answers (run at round start)

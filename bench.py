@@ -568,6 +568,147 @@ def bench_fabric_wave(children: int = 8, fabric_batch: bool = True):
     }
 
 
+def bench_shard_scaling(replica_counts=(1, 2, 4), requests: int = 16,
+                        size: int = 4, shards: int = 8,
+                        rtt_s: float = 0.01):
+    """Control-plane scaling curve (ROADMAP item 2's ask: publish a curve,
+    not a point): the same burst of requests driven through 1, 2 and 4
+    sharded operator replicas against ONE shared in-proc store with an
+    injected per-wire-op RTT (ChaosStore latency — the apiserver toll each
+    replica's writes pay). Reports placements/sec (burst wall-clock
+    throughput) and attach-to-ready p50/p99 per replica count. Replicas
+    coordinate exactly like production --shards K: shard leases, scoped
+    adoption on acquire, ownership filters end-to-end.
+
+    Caveat for reading the curve: the replicas share one Python process
+    (and GIL), so the parallelism measured is I/O-wait overlap — wire
+    RTTs released while another replica's reconcile runs. At 10 ms RTT
+    the 2-replica point beats 1 on both placements/sec and p99; 4
+    replicas in-proc re-serialize on the GIL. Real multi-process replicas
+    keep scaling — this harness is the down payment (curve shape +
+    correctness under concurrent sharded operation), not the end state."""
+    from tpu_composer.agent.fake import FakeNodeAgent
+    from tpu_composer.api import (
+        ComposabilityRequest,
+        ComposabilityRequestSpec,
+        Node,
+        ObjectMeta,
+        ResourceDetails,
+    )
+    from tpu_composer.api.types import REQUEST_STATE_RUNNING
+    from tpu_composer.controllers import (
+        ComposabilityRequestReconciler,
+        ComposableResourceReconciler,
+        RequestTiming,
+        ResourceTiming,
+    )
+    from tpu_composer.controllers.adoption import adopt_pending_ops
+    from tpu_composer.fabric.dispatcher import FabricDispatcher
+    from tpu_composer.runtime.cache import CachedClient
+    from tpu_composer.runtime.chaosstore import ChaosStore
+    from tpu_composer.runtime.manager import Manager
+    from tpu_composer.runtime.shards import ShardLeaseElector, shard_for
+    from tpu_composer.runtime.store import Store
+
+    results = {}
+    for n_replicas in replica_counts:
+        store = Store()
+        for i in range(max(16, requests * size // 4)):
+            n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+            n.status.tpu_slots = 4
+            store.create(n)
+        pool = _counting_pool()
+        replicas = []
+        for i in range(n_replicas):
+            slow = ChaosStore(store, latency=rtt_s)
+            client = CachedClient(slow)
+            elector = ShardLeaseElector(
+                slow, shards, identity=f"bench-replica-{i}",
+                lease_duration_s=5.0, renew_period_s=0.5,
+                expected_replicas=n_replicas,
+            )
+            own = elector.ownership
+            dispatcher = FabricDispatcher(
+                pool, batch_window=BENCH_BATCH_WINDOW_S,
+                poll_interval=BENCH_FABRIC_POLL_S, concurrency=8,
+                owns=own.owns_key,
+            )
+            mgr = Manager(store=client, leader_elector=elector,
+                          dispatcher=dispatcher, drain_timeout=0.0)
+            elector.on_acquire.append(
+                lambda wins, c=client, d=dispatcher: adopt_pending_ops(
+                    c, pool, d, shards=set(wins), num_shards=shards))
+            elector.on_ready.append(
+                lambda won, m=mgr: m.resync(
+                    lambda k, _s=frozenset(won): shard_for(k, shards) in _s))
+            elector.on_lose.append(
+                lambda s, r, d=dispatcher: d.abandon_unowned())
+            mgr.add_controller(ComposabilityRequestReconciler(
+                client, pool,
+                timing=RequestTiming(updating_poll=0.01, cleaning_poll=0.01),
+                ownership=own))
+            mgr.add_controller(ComposableResourceReconciler(
+                client, pool, FakeNodeAgent(pool=pool),
+                timing=ResourceTiming(attach_poll=0.01, visibility_poll=0.01,
+                                      detach_poll=0.01, detach_fast=0.01,
+                                      busy_poll=0.01),
+                dispatcher=dispatcher, ownership=own))
+            mgr.add_runnable(dispatcher.run)
+            mgr.start(workers_per_controller=4)
+            replicas.append(mgr)
+        try:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                held = sorted(
+                    s for m in replicas for s in m._elector.owned_shards()
+                )
+                if held == list(range(shards)):
+                    break
+                time.sleep(0.02)
+            else:
+                raise RuntimeError(
+                    f"{n_replicas}-replica fleet never balanced: "
+                    + repr([sorted(m._elector.owned_shards())
+                            for m in replicas])
+                )
+            names = [f"churn-{n_replicas}-{i}" for i in range(requests)]
+            t0 = time.perf_counter()
+            for name in names:
+                store.create(ComposabilityRequest(
+                    metadata=ObjectMeta(name=name),
+                    spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                        type="tpu", model="tpu-v4", size=size)),
+                ))
+            done_ms = {}
+            deadline = time.monotonic() + 60
+            while len(done_ms) < len(names) and time.monotonic() < deadline:
+                for name in names:
+                    if name in done_ms:
+                        continue
+                    req = store.try_get(ComposabilityRequest, name)
+                    if (req is not None
+                            and req.status.state == REQUEST_STATE_RUNNING):
+                        done_ms[name] = (time.perf_counter() - t0) * 1e3
+                time.sleep(0.002)
+            if len(done_ms) < len(names):
+                raise RuntimeError(
+                    f"{len(names) - len(done_ms)} request(s) never Running"
+                    f" at {n_replicas} replica(s)"
+                )
+            wall_s = max(done_ms.values()) / 1e3
+            lat = sorted(done_ms.values())
+            results[str(n_replicas)] = {
+                "placements_per_sec": round(len(names) / wall_s, 2),
+                "p50_ms": round(statistics.median(lat), 1),
+                "p99_ms": round(lat[int(0.99 * (len(lat) - 1))], 1),
+                "requests": len(names),
+            }
+        finally:
+            for m in replicas:
+                m.stop()
+    return results
+
+
 def bench_tracing_overhead(children: int = 32, repeats: int = 3):
     """Tracing-cost measurement on the 32-chip same-node wave: best-of-N
     wall time with causal tracing recording every span/flow vs the
@@ -679,6 +820,12 @@ def main():
     attach_32_off = bench_attach_cluster(cycles=5, size=32,
                                          rtt_s=APISERVER_RTT_S,
                                          fabric_batch=False)
+    # Sharded control plane: the same burst at 1/2/4 replicas over one
+    # shared store (injected wire RTT) — the scaling curve, not a point.
+    try:
+        shard_scaling = bench_shard_scaling()
+    except Exception as e:
+        shard_scaling = {"error": str(e)}
     try:
         accel = bench_accelerator()
     except ImportError as e:
@@ -713,6 +860,7 @@ def main():
         "raw_inproc_p90_ms": round(attach_raw["p90"], 3),
         "raw_inproc_store_rtts": attach_raw["rtts_per_attach"],
         "baseline_p50_ms": REFERENCE_P50_MS,
+        "shard_scaling": shard_scaling,
         "phase_durations": phase_durations,
         "accelerator": summarize_accelerator(accel),
         "full_record": "bench_artifacts/bench_full.json",
@@ -754,6 +902,9 @@ def main():
                 # Phase decomposition lives on in bench_full.json.
                 out["extra"].pop("phase_durations", None)
                 line = json.dumps(out)
+                if len(line) > HEADLINE_BUDGET_CHARS:
+                    out["extra"].pop("shard_scaling", None)
+                    line = json.dumps(out)
     print(line)
 
 

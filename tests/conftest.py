@@ -77,6 +77,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "shard: shard-failover chaos soak (kill -9 one of N replicas"
+        " mid-attach-wave; survivors steal the orphaned shard leases and"
+        " converge via scoped adoption; always also marked slow; run with"
+        " `make shard-soak` or `pytest -m shard`)",
+    )
+    config.addinivalue_line(
+        "markers",
         "repair: post-Ready failure/repair soak (scripted device death"
         " under Ready slices; always also marked slow; run with"
         " `make repair-soak` or `pytest -m repair`)",

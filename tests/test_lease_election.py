@@ -23,6 +23,7 @@ from tpu_composer.api import (
     ResourceDetails,
 )
 from tpu_composer.api.lease import Lease
+from tpu_composer.api.meta import now_iso
 from tpu_composer.controllers import (
     ComposabilityRequestReconciler,
     ComposableResourceReconciler,
@@ -156,6 +157,158 @@ class TestLeaseElector:
         )
 
 
+class TestLeaseHardening:
+    """ISSUE 9 satellites: monotonic fencing clock + CAS-guarded release."""
+
+    def test_monotonic_fencing_survives_wall_clock_jump(self, store):
+        """The stand-down deadline must be measured on the monotonic
+        clock: an NTP step (or VM resume) rewinding wall time mid-partition
+        made the old wall-clock arithmetic compute a negative failing_for
+        and kept a partitioned leader alive forever."""
+        import datetime
+
+        partitioned = threading.Event()
+        real_get, real_update = store.get, store.update
+
+        def failing_get(cls, name):
+            if partitioned.is_set() and cls is Lease:
+                from tpu_composer.runtime.store import StoreError
+
+                raise StoreError("injected partition")
+            return real_get(cls, name)
+
+        def failing_update(obj):
+            if partitioned.is_set() and isinstance(obj, Lease):
+                from tpu_composer.runtime.store import StoreError
+
+                raise StoreError("injected partition")
+            return real_update(obj)
+
+        store.get, store.update = failing_get, failing_update
+        a = LeaseElector(store, identity="replica-a",
+                         lease_duration_s=3.0, renew_period_s=0.1,
+                         renew_deadline_s=1.0)
+        assert a.try_acquire()
+        # Wall clock jumps BACKWARD by an hour the moment the partition
+        # starts: every wall-time read now answers from the past.
+        frozen = datetime.datetime.now(
+            datetime.timezone.utc) - datetime.timedelta(hours=1)
+        a._now = lambda: frozen
+        t0 = time.monotonic()
+        partitioned.set()
+        assert wait_for(lambda: not a.is_leader, timeout=5), (
+            "wall-clock jump kept the partitioned leader alive past the"
+            " renew deadline"
+        )
+        assert time.monotonic() - t0 < a.lease_duration_s
+
+    def test_fast_clock_contender_cannot_steal_healthy_lease(self, store):
+        """Steal-side observation gate: a contender whose wall clock runs
+        a full lease duration ahead sees every stamp as 'expired' — it
+        must still refuse to steal while its own monotonic observation
+        shows the (holder, renewTime) pair changing (the leader is alive
+        and renewing)."""
+        import datetime
+
+        a = LeaseElector(store, identity="replica-a",
+                         lease_duration_s=1.0, renew_period_s=0.1)
+        b = LeaseElector(store, identity="replica-b",
+                         lease_duration_s=1.0, renew_period_s=0.1)
+        assert a.try_acquire()
+        # b's wall clock jumps an hour AHEAD: wall-age of a's fresh stamps
+        # now reads ~3600s > lease_duration on every check.
+        b._now = lambda: datetime.datetime.now(
+            datetime.timezone.utc) + datetime.timedelta(hours=1)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 3 * a.lease_duration_s:
+            assert not b.try_acquire(), (
+                "fast-clock contender stole a healthy leader's lease"
+            )
+            time.sleep(0.05)
+        assert a.is_leader
+        # ...and a genuinely dead leader is still stolen: stop renewals and
+        # the observation clock ripens within one lease duration.
+        a._stop_renew.set()
+        assert wait_for(b.try_acquire, timeout=5), (
+            "observation gate also blocked a legitimate steal"
+        )
+        b.release()
+
+    def test_renew_failures_surface_in_metric(self, store):
+        from tpu_composer.runtime.metrics import lease_transitions_total
+
+        acquired0 = lease_transitions_total.value(event="acquired")
+        failed0 = lease_transitions_total.value(event="renewed_fail")
+        a = LeaseElector(store, identity="replica-a",
+                         lease_duration_s=2.0, renew_period_s=0.05,
+                         renew_deadline_s=1.0)
+        assert a.try_acquire()
+        assert lease_transitions_total.value(event="acquired") == acquired0 + 1
+        real_update = store.update
+
+        def failing_update(obj):
+            if isinstance(obj, Lease):
+                from tpu_composer.runtime.store import StoreError
+
+                raise StoreError("injected flake")
+            return real_update(obj)
+
+        store.update = failing_update
+        assert wait_for(
+            lambda: lease_transitions_total.value(event="renewed_fail")
+            > failed0, timeout=5,
+        ), "failed renewals never counted"
+        store.update = real_update
+        a.release()
+
+    def test_release_conflict_never_clears_successor_lease(self, store):
+        """CAS guard: a successor stealing the lease between release()'s
+        read and its write must win — the conflicting write is dropped,
+        never retried against the successor's lease."""
+        a = LeaseElector(store, identity="replica-a",
+                         lease_duration_s=1.0, renew_period_s=10.0)
+        assert a.try_acquire()
+        a._stop_renew.set()  # freeze the renew loop; a still thinks it leads
+        stale = store.get(Lease, a.name)  # rv as of a's leadership
+        # Successor steals AFTER a's (stale) read — holder + rv both move.
+        lease = store.get(Lease, a.name)
+        lease.spec.holder_identity = "replica-b"
+        lease.spec.renew_time = now_iso()
+        store.update(lease)
+        # a's release sees its stale snapshot (the read-then-write race).
+        a.store = type("Stale", (), {
+            "try_get": lambda self_, cls, name: stale,
+            "update": store.update,
+        })()
+        a.release()
+        got = store.get(Lease, a.name)
+        assert got.spec.holder_identity == "replica-b", (
+            "deposed replica's release clobbered the successor's lease"
+        )
+
+    def test_deposed_replica_release_leaves_successor_lease(self, store):
+        a = LeaseElector(store, identity="replica-a",
+                         lease_duration_s=1.0, renew_period_s=0.1)
+        assert a.try_acquire()
+        # Successor force-takes the lease (post-partition heal); a's renew
+        # loop notices and stands down.
+        lease = store.get(Lease, a.name)
+        lease.spec.holder_identity = "replica-b"
+        lease.spec.renew_time = now_iso()
+        store.update(lease)
+        assert wait_for(lambda: not a.is_leader, timeout=3)
+        calls = []
+        real_update = store.update
+        store.update = lambda obj: (calls.append(obj), real_update(obj))[1]
+        a.release()  # deposed: must not touch the lease at all
+        store.update = real_update
+        assert not any(isinstance(o, Lease) for o in calls), (
+            "deposed replica wrote the lease during release"
+        )
+        got = store.get(Lease, a.name)
+        assert got.spec.holder_identity == "replica-b"
+
+
 class TestManagersFailover:
     """Two full managers on one store: only the leader reconciles."""
 
@@ -229,6 +382,9 @@ class TestDeposedManagerStopsDriving:
     controllers (split-brain guard — client-go's analog exits the process)."""
 
     def test_watchdog_stops_manager_on_lost_lease(self, store):
+        from tpu_composer.runtime.metrics import lease_transitions_total
+
+        deposed0 = lease_transitions_total.value(event="deposed")
         n = Node(metadata=ObjectMeta(name="worker-0"))
         n.status.tpu_slots = 4
         store.create(n)
@@ -260,6 +416,18 @@ class TestDeposedManagerStopsDriving:
                 ),
                 timeout=5,
             ), "controllers still running after losing the lease"
+            # Churn metric (ISSUE 9 satellite): the watchdog counts the
+            # deposition EXACTLY once — it fires, stops the manager, and
+            # returns; no second increment however long we watch.
+            assert (
+                lease_transitions_total.value(event="deposed")
+                == deposed0 + 1
+            )
+            time.sleep(1.5)  # longer than a watchdog poll period
+            assert (
+                lease_transitions_total.value(event="deposed")
+                == deposed0 + 1
+            ), "deposed counted more than once for a single deposition"
         finally:
             mgr.stop()
 

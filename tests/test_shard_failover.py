@@ -1,0 +1,362 @@
+"""Shard-failover chaos soak (ISSUE 9 headline test).
+
+Three full operator replicas share one in-proc store and one fabric pool,
+each owning a balanced subset of K shard leases. One replica is hard-killed
+(SIGKILL analog: store writes stop landing mid-stream, its dispatcher
+abandons lanes, no lease is ever released) in the middle of a 32-chip
+attach wave. The soak asserts the whole robustness contract:
+
+- survivors CAS-steal the orphaned shard leases within ~one lease duration
+  (observation-clock expiry + one tick of detection granularity),
+- every shard acquisition runs the PR 5 adoption pass SCOPED to that
+  shard's keys (a shard migration is a cold-start adoption over the moved
+  keys), and the wave converges Ready,
+- the nonce-checked zero-double-attach invariant from test_crash_restart
+  holds across the handoff,
+- no fabric mutation from the dead replica's identity lands after its
+  monotonic fencing deadline (split-brain containment),
+- attach-budget / quarantine accounting is bit-identical to an
+  uninterrupted run (all zeros — no fabric fault was injected).
+
+A second scenario proves the REBALANCE path: a replica joining mid-wave is
+handed shards via shed + scoped adoption with the same invariants.
+
+Run: ``make shard-soak`` (markers slow+shard).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.types import REQUEST_STATE_RUNNING
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.controllers import (
+    ComposabilityRequestReconciler,
+    ComposableResourceReconciler,
+    RequestTiming,
+    ResourceTiming,
+    UpstreamSyncer,
+)
+from tpu_composer.controllers.adoption import adopt_pending_ops
+from tpu_composer.fabric.dispatcher import FabricDispatcher
+from tpu_composer.runtime.cache import CachedClient
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.shards import ShardLeaseElector, shard_for
+from tpu_composer.runtime.store import Store
+
+from tests.test_crash_restart import (
+    CrashFuse,
+    RecordingPool,
+    assert_no_double_attach,
+    wait_for,
+)
+
+LEASE_S = 2.0
+RENEW_S = 0.25
+
+
+class TaggedPool:
+    """Per-replica fabric facade over the shared pool: every MUTATING verb
+    is logged with (replica identity, monotonic timestamp) before it runs,
+    so the soak can assert no mutation from a dead replica's identity
+    lands after its fencing deadline."""
+
+    def __init__(self, pool, identity, mutation_log):
+        self._pool = pool
+        self._identity = identity
+        self._log = mutation_log
+
+    def _tag(self, verb, names):
+        self._log.append((self._identity, time.monotonic(), verb, names))
+
+    def add_resource(self, resource):
+        self._tag("add", [resource.metadata.name])
+        return self._pool.add_resource(resource)
+
+    def remove_resource(self, resource):
+        self._tag("remove", [resource.metadata.name])
+        return self._pool.remove_resource(resource)
+
+    def add_resources(self, resources):
+        self._tag("add", [r.metadata.name for r in resources])
+        return self._pool.add_resources(resources)
+
+    def remove_resources(self, resources):
+        self._tag("remove", [r.metadata.name for r in resources])
+        return self._pool.remove_resources(resources)
+
+    def repair_slice_member(self, *a, **kw):
+        self._tag("repair", [])
+        return self._pool.repair_slice_member(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_pool"], name)
+
+
+class ShardedReplica:
+    """One operator replica: CrashFuse store facade + cached client +
+    dispatcher + shard elector, wired exactly like cmd/main does for
+    --shards K (scoped adoption on acquire, resync on ready, lane fence
+    on lose)."""
+
+    def __init__(self, raw_store, pool, ident, num_shards, mutation_log,
+                 reports, expected_replicas=0):
+        self.ident = ident
+        self.fuse = CrashFuse(raw_store)
+        self.client = CachedClient(self.fuse)
+        self.tagged = TaggedPool(pool, ident, mutation_log)
+        self.elector = ShardLeaseElector(
+            self.fuse, num_shards, identity=ident,
+            lease_duration_s=LEASE_S, renew_period_s=RENEW_S,
+            expected_replicas=expected_replicas,
+        )
+        own = self.elector.ownership
+        self.dispatcher = FabricDispatcher(
+            self.tagged, batch_window=0.01, concurrency=4,
+            poll_interval=0.05, owns=own.owns_key,
+        )
+        self.mgr = Manager(store=self.client, leader_elector=self.elector,
+                           dispatcher=self.dispatcher,
+                           drain_timeout=0.0)  # crash harness: never drain
+        self.elector.on_acquire.append(
+            lambda wins: reports.append((ident, dict(wins),
+                adopt_pending_ops(self.client, self.tagged, self.dispatcher,
+                                  shards=set(wins), num_shards=num_shards))))
+        self.elector.on_ready.append(
+            lambda shards: self.mgr.resync(
+                lambda key, _s=frozenset(shards):
+                shard_for(key, num_shards) in _s))
+        self.elector.on_lose.append(
+            lambda shard, reason: self.dispatcher.abandon_unowned())
+        self.mgr.add_controller(ComposabilityRequestReconciler(
+            self.client, self.tagged,
+            timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.05),
+            ownership=own))
+        self.mgr.add_controller(ComposableResourceReconciler(
+            self.client, self.tagged, FakeNodeAgent(pool=pool),
+            timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
+                                  detach_poll=0.05, detach_fast=0.05,
+                                  busy_poll=0.05),
+            dispatcher=self.dispatcher, ownership=own))
+        self.mgr.add_runnable(UpstreamSyncer(
+            self.client, self.tagged, period=0.1, grace=5.0, ownership=own))
+        self.mgr.add_runnable(self.dispatcher.run)
+
+    def start(self):
+        self.mgr.start(workers_per_controller=2)
+
+    def owned(self):
+        return self.elector.owned_shards()
+
+    def kill(self):
+        """SIGKILL analog: writes stop landing, the dispatcher abandons
+        lanes and parked outcomes, the renew thread dies — no lease is
+        released; failover happens only through observation expiry."""
+        self.fuse.die()
+        self.dispatcher.kill()
+        self.elector._stop.set()
+
+    def stop(self):
+        try:
+            self.mgr.stop()
+        except Exception:
+            pass  # dead store: release can't land, like a real crash
+
+
+def _world(nodes=8, slots=4):
+    store = Store()
+    for i in range(nodes):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = slots
+        store.create(n)
+    return store
+
+
+def _submit_wave(store, name="wave", size=32):
+    store.create(ComposabilityRequest(
+        metadata=ObjectMeta(name=name),
+        spec=ComposabilityRequestSpec(resource=ResourceDetails(
+            type="tpu", model="tpu-v4", size=size)),
+    ))
+
+
+def _running(store, name, size):
+    req = store.try_get(ComposabilityRequest, name)
+    return (
+        req is not None
+        and req.status.state == REQUEST_STATE_RUNNING
+        and sum(len(r.device_ids)
+                for r in req.status.resources.values()) == size
+    )
+
+
+def _assert_clean_accounting(store, pool, attached):
+    for res in store.list(ComposableResource):
+        assert res.status.pending_op is None, res.status.to_dict()
+        assert res.status.attach_attempts == 0, res.status.to_dict()
+        assert not res.status.quarantined, res.status.to_dict()
+    assert len(pool.get_resources()) == attached
+    assert pool.free_chips("tpu-v4") == 64 - attached  # no leak, no double
+    assert_no_double_attach(pool.events)
+
+
+@pytest.mark.slow
+@pytest.mark.shard
+class TestShardFailoverSoak:
+    K = 6
+    REPLICAS = 3
+
+    def test_kill_minus_nine_mid_wave(self):
+        for cycle, kill_delay in enumerate((0.0, 0.15)):
+            self._one_cycle(cycle, kill_delay)
+
+    def _one_cycle(self, cycle, kill_delay):
+        store = _world()
+        pool = RecordingPool(async_steps=2)
+        mutations = []
+        reports = []
+        replicas = [
+            ShardedReplica(store, pool, f"replica-{cycle}-{i}", self.K,
+                           mutations, reports,
+                           expected_replicas=self.REPLICAS)
+            for i in range(self.REPLICAS)
+        ]
+        try:
+            for r in replicas:
+                r.start()
+            # Balanced steady state: every shard owned exactly once.
+            assert wait_for(
+                lambda: sorted(
+                    s for r in replicas for s in r.owned()
+                ) == list(range(self.K)),
+                timeout=3 * LEASE_S,
+            ), f"shards never balanced: {[r.owned() for r in replicas]}"
+
+            _submit_wave(store, size=32)
+            # Mid-wave: durable attach intent on the wire, fabric-async
+            # steps still pending — the widest in-flight window.
+            assert wait_for(
+                lambda: sum(
+                    1 for res in store.list(ComposableResource)
+                    if res.status.pending_op is not None
+                ) >= 2,
+                timeout=15,
+            ), "no pending_op intents ever persisted — kill missed the wave"
+            time.sleep(kill_delay)
+
+            victim = replicas[0]
+            assert victim.owned(), "victim held no shards — nothing to test"
+            orphaned = set(victim.owned())
+            t_kill = time.monotonic()
+            victim.kill()
+            fence_deadline = t_kill + victim.elector.renew_deadline_s
+
+            survivors = replicas[1:]
+
+            def survivors_own_everything():
+                held = [s for r in survivors for s in r.owned()]
+                return sorted(held) == list(range(self.K))
+
+            assert wait_for(survivors_own_everything, timeout=4 * LEASE_S), (
+                "survivors never acquired the orphaned shards:"
+                f" {[r.owned() for r in survivors]}"
+            )
+            takeover_s = time.monotonic() - t_kill
+            # Observation-clock failover: expiry at ~(last observed renew
+            # + lease), detection within a tick — one lease duration plus
+            # tick granularity and CI scheduling slack.
+            assert takeover_s <= LEASE_S + 4 * RENEW_S + 1.0, (
+                f"takeover took {takeover_s:.2f}s (lease {LEASE_S}s)"
+            )
+            # No shard is double-owned across survivors.
+            assert not (survivors[0].owned() & survivors[1].owned())
+            # Scoped adoption ran for the stolen shards.
+            stolen_adoptions = [
+                (ident, shard)
+                for ident, wins, _ in reports
+                for shard, reason in wins.items()
+                if reason == "failover" and shard in orphaned
+            ]
+            assert stolen_adoptions, "no scoped adoption pass on failover"
+
+            assert wait_for(
+                lambda: _running(store, "wave", 32), timeout=60,
+            ), "wave never converged Ready after shard failover: " + repr([
+                (r.metadata.name, r.status.state,
+                 r.status.pending_op is not None)
+                for r in store.list(ComposableResource)])
+            _assert_clean_accounting(store, pool, attached=32)
+
+            # Fencing: nothing from the dead replica's identity may touch
+            # the fabric after its monotonic fencing deadline.
+            late = [
+                m for m in mutations
+                if m[0] == victim.ident and m[1] > fence_deadline
+            ]
+            assert not late, (
+                f"dead replica mutated the fabric after its fencing"
+                f" deadline: {late}"
+            )
+        finally:
+            for r in replicas:
+                r.kill()
+                r.stop()
+
+    def test_rebalance_handoff_mid_wave(self):
+        """A replica joining mid-wave is HANDED shards: the incumbent
+        sheds (fence + lease release), the newcomer adopts scoped — the
+        wave must converge with zero double-attach, exactly like
+        failover but through the voluntary path."""
+        store = _world(nodes=4)
+        pool = RecordingPool(async_steps=2)
+        mutations = []
+        reports = []
+        a = ShardedReplica(store, pool, "incumbent", 4, mutations, reports)
+        try:
+            a.start()
+            assert wait_for(lambda: a.owned() == {0, 1, 2, 3},
+                            timeout=2 * LEASE_S)
+            _submit_wave(store, size=16)
+            assert wait_for(
+                lambda: any(res.status.pending_op is not None
+                            for res in store.list(ComposableResource)),
+                timeout=15,
+            ), "kill missed the wave"
+            b = ShardedReplica(store, pool, "newcomer", 4, mutations, reports)
+            try:
+                b.start()
+                assert wait_for(
+                    lambda: len(b.owned()) >= 1
+                    and len(a.owned()) + len(b.owned()) == 4
+                    and not (a.owned() & b.owned()),
+                    timeout=6 * LEASE_S,
+                ), f"rebalance never handed shards over: a={a.owned()} b={b.owned()}"
+                handed = [
+                    (ident, shard)
+                    for ident, wins, _ in reports
+                    if ident == "newcomer"
+                    for shard, reason in wins.items()
+                    if reason in ("handoff", "failover")
+                ]
+                assert handed, "newcomer never ran a scoped adoption pass"
+                assert wait_for(
+                    lambda: _running(store, "wave", 16), timeout=60,
+                ), "wave never converged Ready after rebalance handoff"
+                _assert_clean_accounting(store, pool, attached=16)
+            finally:
+                b.kill()
+                b.stop()
+        finally:
+            a.kill()
+            a.stop()

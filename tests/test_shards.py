@@ -1,0 +1,556 @@
+"""Shard-lease layer (runtime/shards.py): K shard leases over N replicas.
+
+Tier-1 deterministic coverage — the electors are driven by direct
+``tick()`` calls (no renew threads) wherever timing would otherwise make a
+test flaky. The kill -9 chaos soak lives in tests/test_shard_failover.py
+(markers slow+shard, ``make shard-soak``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+
+import pytest
+
+from tpu_composer.api import ComposableResource, Node, ObjectMeta
+from tpu_composer.api.lease import Lease
+from tpu_composer.api.meta import now_iso
+from tpu_composer.api.types import PendingOp, RESOURCE_STATE_ATTACHING
+from tpu_composer.controllers.adoption import adopt_pending_ops
+from tpu_composer.controllers.resource_controller import (
+    ComposableResourceReconciler,
+    ResourceTiming,
+)
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.fabric.dispatcher import FabricDispatcher
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.runtime.metrics import shard_handoffs_total
+from tpu_composer.runtime.shards import (
+    ShardFencedError,
+    ShardLeaseElector,
+    ShardOwnership,
+    shard_for,
+)
+from tpu_composer.runtime.store import Store, StoreError
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def elector(store, ident, k=4, lease=1.0, renew=0.2, **kw):
+    return ShardLeaseElector(
+        store, num_shards=k, identity=ident,
+        lease_duration_s=lease, renew_period_s=renew, **kw,
+    )
+
+
+class TestShardFor:
+    def test_stable_crc32_mapping(self):
+        # The mapping IS the contract: two replicas (or two incarnations)
+        # disagreeing on a key's shard is a double-attach. Pin it to crc32
+        # so a refactor silently changing the hash fails here.
+        for name in ("wave-a", "wave-a-0", "detach-tpu-0", ""):
+            for k in (1, 2, 4, 7):
+                assert shard_for(name, k) == (
+                    0 if k <= 1 else zlib.crc32(name.encode()) % k
+                )
+
+    def test_every_shard_reachable(self):
+        hit = {shard_for(f"res-{i}", 4) for i in range(64)}
+        assert hit == {0, 1, 2, 3}
+
+    def test_ownership_view(self):
+        own = ShardOwnership(4)
+        assert not own.owns_key("x")
+        own._add(shard_for("x", 4))
+        assert own.owns_key("x")
+        assert own.owns_shard(shard_for("x", 4))
+        own._discard(shard_for("x", 4))
+        assert not own.owns_key("x")
+
+
+class TestShardElector:
+    def test_single_replica_owns_every_shard(self, store):
+        a = elector(store, "replica-a")
+        a.tick()
+        assert a.owned_shards() == {0, 1, 2, 3}
+        for i in range(4):
+            lease = store.get(Lease, a.shard_lease_name(i))
+            assert lease.spec.holder_identity == "replica-a"
+
+    def test_two_replicas_balance_within_spread_one(self, store):
+        a = elector(store, "replica-a")
+        b = elector(store, "replica-b")
+        for _ in range(6):
+            a.tick()
+            b.tick()
+        owned_a, owned_b = a.owned_shards(), b.owned_shards()
+        assert owned_a | owned_b == {0, 1, 2, 3}
+        assert not owned_a & owned_b, "two owners for one shard"
+        assert abs(len(owned_a) - len(owned_b)) <= 1
+
+    def test_returning_replica_is_handed_shards(self, store):
+        # a holds everything; b joins — the rebalancer sheds until the
+        # spread is within 1, and every shed shard is picked up by b.
+        a = elector(store, "replica-a")
+        a.tick()
+        assert len(a.owned_shards()) == 4
+        b = elector(store, "replica-b")
+        for _ in range(8):
+            b.tick()
+            a.tick()
+        assert len(a.owned_shards()) == 2
+        assert len(b.owned_shards()) == 2
+        assert a.owned_shards() | b.owned_shards() == {0, 1, 2, 3}
+
+    def test_dead_replica_shards_stolen_within_lease_duration(self, store):
+        lease_s = 0.6
+        a = elector(store, "replica-a", lease=lease_s, renew=0.1)
+        b = elector(store, "replica-b", lease=lease_s, renew=0.1)
+        a.tick()
+        b.tick()
+        a.tick()
+        b.tick()
+        assert b.owned_shards(), "b never balanced in"
+        orphaned = a.owned_shards()
+        assert orphaned
+        # a dies (no release, renewals just stop). b keeps ticking: its
+        # observation clock must watch a's renew_time sit unchanged for a
+        # full lease duration before stealing.
+        t_dead = time.monotonic()
+        acquired_at = None
+        deadline = time.monotonic() + 5 * lease_s
+        while time.monotonic() < deadline:
+            b.tick()
+            if orphaned <= b.owned_shards():
+                acquired_at = time.monotonic()
+                break
+            time.sleep(0.05)
+        assert acquired_at is not None, "survivor never took the dead shards"
+        took = acquired_at - t_dead
+        assert took >= lease_s * 0.8, (
+            f"stole a live-looking lease after only {took:.2f}s"
+        )
+        assert took <= 2 * lease_s + 0.5, (
+            f"takeover took {took:.2f}s — more than ~one lease duration"
+        )
+        assert b.owned_shards() == {0, 1, 2, 3}
+        assert shard_handoffs_total.value(reason="failover") >= 1
+
+    def test_partitioned_replica_fences_before_successor_steals(self, store):
+        """The shard twin of the single-leader fencing contract: a replica
+        whose renewals fail must drop ownership (monotonic renew-deadline)
+        strictly before its leases become stealable."""
+        partitioned = threading.Event()
+
+        class Partition:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def list(self, cls, label_selector=None):
+                if partitioned.is_set() and cls is Lease:
+                    raise StoreError("injected partition")
+                return self._inner.list(cls, label_selector)
+
+            def update(self, obj):
+                if partitioned.is_set() and isinstance(obj, Lease):
+                    raise StoreError("injected partition")
+                return self._inner.update(obj)
+
+            def create(self, obj):
+                if partitioned.is_set() and isinstance(obj, Lease):
+                    raise StoreError("injected partition")
+                return self._inner.create(obj)
+
+        lost = []
+        a = elector(Partition(store), "replica-a", lease=1.2, renew=0.1,
+                    renew_deadline_s=0.4)
+        a.on_lose.append(lambda s, reason: lost.append((s, reason)))
+        b = elector(store, "replica-b", lease=1.2, renew=0.1)
+        a.tick()
+        assert len(a.owned_shards()) == 4
+        b.tick()  # b observes a's fresh leases
+        t0 = time.monotonic()
+        partitioned.set()
+        # Drive a on its failure cadence until it fences everything.
+        while a.owned_shards() and time.monotonic() - t0 < 3.0:
+            a.tick()
+            time.sleep(0.05)
+        fenced_after = time.monotonic() - t0
+        assert not a.owned_shards(), "partitioned replica never fenced"
+        assert fenced_after < 1.2, (
+            f"fenced {fenced_after:.2f}s after partition — leases were"
+            " already stealable"
+        )
+        assert {reason for _, reason in lost} == {"fenced"}
+        # b must NOT be able to steal yet: a's last renew_time is at most
+        # renew_deadline + slack old, still inside the lease duration.
+        b.tick()
+        assert len(b.owned_shards()) == 0, (
+            "successor stole before the lease expired — no fencing margin"
+        )
+
+        def b_took_everything():
+            b.tick()
+            return b.owned_shards() == {0, 1, 2, 3}
+
+        # ...and once the leases genuinely expire, failover proceeds.
+        assert wait_for(b_took_everything, timeout=6, interval=0.05), (
+            "failover never happened after expiry"
+        )
+
+    def test_release_hands_off_instantly(self, store):
+        a = elector(store, "replica-a")
+        a.tick()
+        a.release()
+        for i in range(4):
+            lease = store.get(Lease, a.shard_lease_name(i))
+            assert lease.spec.holder_identity == ""
+        b = elector(store, "replica-b")
+        b.tick()
+        assert b.owned_shards() == {0, 1, 2, 3}, (
+            "released leases should be acquirable immediately (no expiry wait)"
+        )
+
+    def test_hooks_fire_batched_in_handoff_order(self, store):
+        """A multi-shard win fires ONE on_acquire with every shard won
+        that tick (so a K-shard bootstrap runs one scoped adoption pass,
+        not K), ownership must already be ON when it runs (the adoption
+        pass re-drives ops through this replica's dispatcher, whose
+        owns-gate would silently discard them otherwise), and on_ready
+        (the serving resync) fires strictly after."""
+        events = []
+        a = elector(store, "replica-a", k=2)
+        a.on_acquire.append(lambda wins: events.append((
+            "acquire", dict(wins),
+            {s: a.ownership.owns_shard(s) for s in wins},
+        )))
+        a.on_ready.append(lambda shards: events.append((
+            "ready", set(shards),
+            {s: a.ownership.owns_shard(s) for s in shards},
+        )))
+        a.tick()
+        assert [kind for kind, *_ in events] == ["acquire", "ready"], events
+        kind, wins, owned_at_call = events[0]
+        assert set(wins) == {0, 1}, "bootstrap win not batched into one call"
+        assert set(wins.values()) == {"bootstrap"}
+        assert all(owned_at_call.values()), (
+            "shards not yet owned when on_acquire ran — dispatcher"
+            " owns-gate would drop adoption's submissions"
+        )
+        assert events[1][1] == {0, 1}
+
+    def test_adoption_repoll_passes_dispatcher_gate_on_handoff(self, store):
+        """Regression: the scoped adoption pass fired by a shard win
+        submits in-flight ops to THIS replica's dispatcher — the owns-gate
+        keyed on the same ownership must accept them (ownership flips
+        before on_acquire), or every handoff would silently drop its
+        re-driven work until a poll timer."""
+        from tests.test_crash_restart import RecordingPool
+
+        store.create(Node(metadata=ObjectMeta(name="worker-0")))
+        pool = RecordingPool(async_steps=2)  # forces the repoll path
+        K = 2
+        res = ComposableResource(metadata=ObjectMeta(name="handoff-res"))
+        res.spec.type = "tpu"
+        res.spec.model = "tpu-v4"
+        res.spec.target_node = "worker-0"
+        res.spec.chip_count = 1
+        res.status.state = RESOURCE_STATE_ATTACHING
+        store.create(res)
+        got = store.get(ComposableResource, "handoff-res")
+        got.status.state = RESOURCE_STATE_ATTACHING
+        got.status.pending_op = PendingOp(
+            verb="add", nonce="nonce-h", node="worker-0",
+            started_at=now_iso(),
+        )
+        store.update_status(got)
+        # The previous owner issued the attach; the fabric holds it async.
+        try:
+            pool.add_resource(got)
+        except Exception:
+            pass  # WaitingDeviceAttaching — exactly the repoll case
+        b = elector(store, "replica-b", k=K)
+        disp = FabricDispatcher(pool, batch_window=0.01, poll_interval=0.02,
+                                owns=b.ownership.owns_key)
+        outcomes = []
+        b.on_acquire.append(lambda wins: outcomes.append(
+            adopt_pending_ops(store, pool, disp, shards=set(wins),
+                              num_shards=K)))
+        b.tick()
+        repolled = [n for rep in outcomes for n in rep.repolled]
+        assert "handoff-res" in repolled
+        # The dispatcher must actually be driving it (not silently fenced).
+        assert wait_for(
+            lambda: disp.op_state("add", "handoff-res") in ("pending", "done"),
+            timeout=5,
+        ), "owns-gate discarded the adoption's re-driven op"
+        disp.kill()
+
+    def test_startup_damping_caps_initial_grab(self, store):
+        a = elector(store, "replica-a", k=4, lease=5.0,
+                    expected_replicas=2)
+        a.tick()
+        assert len(a.owned_shards()) == 2, (
+            "expected_replicas=2 should cap the first grab at ceil(4/2)"
+        )
+
+    def test_dead_member_heartbeats_are_garbage_collected(self, store):
+        """Every kill -9'd incarnation leaves a member.<identity> Lease
+        (identity embeds a per-boot uuid) — the tick must retire observed-
+        dead heartbeats or the listing that gates every renewal grows
+        forever with pod churn."""
+        lease_s = 0.4
+        dead = elector(store, "replica-dead", lease=lease_s, renew=0.1)
+        dead.tick()  # creates its member lease + grabs shards
+        survivor = elector(store, "replica-live", lease=lease_s, renew=0.1)
+        survivor.tick()
+        dead_name = dead._member_name
+        assert store.try_get(Lease, dead_name) is not None
+        # dead stops ticking (kill -9). The survivor must GC the heartbeat
+        # after ~2x lease duration of observed death.
+        def gc_done():
+            survivor.tick()
+            return (
+                store.try_get(Lease, dead_name) is None
+                and dead_name not in survivor._obs
+            )
+        assert wait_for(gc_done, timeout=10 * lease_s, interval=0.05), (
+            "dead member heartbeat never garbage-collected"
+        )
+        # ...and the survivor's own heartbeat is untouched.
+        assert store.try_get(Lease, survivor._member_name) is not None
+
+    def test_acquire_returns_even_with_zero_shards(self, store):
+        # K=1 with two replicas: the loser parks as a hot standby — its
+        # Manager must still come up (healthz, controllers idle).
+        a = elector(store, "replica-a", k=1)
+        b = elector(store, "replica-b", k=1)
+        a.tick()
+        assert a.owned_shards() == {0}
+        assert b.acquire(poll_interval=0.05) is True
+        try:
+            assert b.owned_shards() == set()
+            assert b.is_leader  # never deposes — standby stays up
+        finally:
+            b.release()
+            a.release()
+
+
+class TestOwnershipEnforcement:
+    def _world(self, store):
+        n = Node(metadata=ObjectMeta(name="worker-0"))
+        n.status.tpu_slots = 4
+        store.create(n)
+        return InMemoryPool()
+
+    def _mid_attach_cr(self, store, name):
+        res = ComposableResource(metadata=ObjectMeta(name=name))
+        res.spec.type = "tpu"
+        res.spec.model = "tpu-v4"
+        res.spec.target_node = "worker-0"
+        res.spec.chip_count = 1
+        res.status.state = RESOURCE_STATE_ATTACHING
+        store.create(res)
+        got = store.get(ComposableResource, name)
+        got.status.state = RESOURCE_STATE_ATTACHING
+        got.status.pending_op = PendingOp(
+            verb="add", nonce=f"nonce-{name}", node="worker-0",
+            started_at=now_iso(),
+        )
+        return store.update_status(got)
+
+    def test_fabric_write_path_fenced_for_unowned_key(self, store):
+        pool = self._world(store)
+        own = ShardOwnership(4)  # owns nothing
+        rec = ComposableResourceReconciler(
+            store, pool, FakeNodeAgent(pool=pool),
+            timing=ResourceTiming(), ownership=own,
+        )
+        res = self._mid_attach_cr(store, "fenced-res")
+        with pytest.raises(ShardFencedError):
+            rec._fabric_add(res)
+        with pytest.raises(ShardFencedError):
+            rec._fabric_remove(res)
+        assert pool.get_resources() == [], "fenced mutation reached the fabric"
+        # ShardFencedError is a quiet exception: requeue, no traceback spam.
+        assert ShardFencedError in rec.quiet_exceptions
+
+    def test_worker_drops_unowned_keys_without_reconciling(self, store):
+        pool = self._world(store)
+        own = ShardOwnership(4)
+        reconciled = []
+        rec = ComposableResourceReconciler(
+            store, pool, FakeNodeAgent(pool=pool),
+            timing=ResourceTiming(), ownership=own,
+        )
+        real = rec.reconcile
+        rec.reconcile = lambda name: (reconciled.append(name), real(name))[1]
+        self._mid_attach_cr(store, "owned-res")
+        self._mid_attach_cr(store, "ghost-res")
+        own._add(shard_for("owned-res", 4))
+        assert shard_for("ghost-res", 4) != shard_for("owned-res", 4), (
+            "test keys collapsed onto one shard — pick different names"
+        )
+        rec.start(workers=1)
+        try:
+            assert wait_for(lambda: "owned-res" in reconciled, timeout=5)
+            time.sleep(0.3)
+            assert "ghost-res" not in reconciled, (
+                "worker reconciled a key outside the owned shards"
+            )
+        finally:
+            rec.stop()
+
+    def test_dispatcher_abandons_unowned_lanes_on_fence(self, store):
+        pool = self._world(store)
+        owned = {"keep-res"}
+        disp = FabricDispatcher(
+            pool, batch_window=30.0,  # park submissions in the lane FIFO
+            owns=lambda name: name in owned,
+        )
+        keep = self._mid_attach_cr(store, "keep-res")
+        lose = self._mid_attach_cr(store, "lose-res")
+        owned.add("lose-res")
+        from tpu_composer.fabric.provider import DispatchedAttaching
+
+        for res in (keep, lose):
+            with pytest.raises(DispatchedAttaching):
+                disp.add_resource(res)
+        assert disp.op_state("add", "keep-res") == "queued"
+        assert disp.op_state("add", "lose-res") == "queued"
+        # Shard lost: the fence must purge lose-res without firing latches.
+        owned.discard("lose-res")
+        assert disp.abandon_unowned() == 1
+        assert disp.op_state("add", "lose-res") is None
+        assert disp.op_state("add", "keep-res") == "queued"
+        disp.kill()
+        assert pool.get_resources() == []
+
+    def test_dispatcher_refuses_unowned_op_at_execute_time(self, store):
+        pool = self._world(store)
+        owned = {"race-res"}
+        disp = FabricDispatcher(
+            pool, batch_window=0.01, poll_interval=0.02,
+            owns=lambda name: name in owned,
+        )
+        res = self._mid_attach_cr(store, "race-res")
+        # Lose ownership after submission but (deterministically) before
+        # the batch window elapses — the execute-side check must drop it.
+        from tpu_composer.fabric.provider import DispatchedAttaching
+
+        with pytest.raises(DispatchedAttaching):
+            disp.add_resource(res)
+        owned.discard("race-res")
+        assert wait_for(
+            lambda: disp.op_state("add", "race-res") is None, timeout=5
+        ), "fenced op never dropped"
+        time.sleep(0.1)
+        assert pool.get_resources() == [], (
+            "fenced op reached the provider after ownership loss"
+        )
+        disp.kill()
+
+
+class TestScopedAdoption:
+    def test_adoption_scoped_to_shard_keys(self, store):
+        store.create(Node(metadata=ObjectMeta(name="worker-0")))
+        pool = InMemoryPool()
+        K = 4
+        names = [f"mig-{i}" for i in range(8)]
+        by_shard = {}
+        for name in names:
+            res = ComposableResource(metadata=ObjectMeta(name=name))
+            res.spec.type = "tpu"
+            res.spec.model = "tpu-v4"
+            res.spec.target_node = "worker-0"
+            res.spec.chip_count = 1
+            res.status.state = RESOURCE_STATE_ATTACHING
+            store.create(res)
+            got = store.get(ComposableResource, name)
+            got.status.state = RESOURCE_STATE_ATTACHING
+            got.status.pending_op = PendingOp(
+                verb="add", nonce=f"n-{name}", node="worker-0",
+                started_at=now_iso(),
+            )
+            store.update_status(got)
+            by_shard.setdefault(shard_for(name, K), []).append(name)
+        shard = next(s for s, members in by_shard.items() if members)
+        report = adopt_pending_ops(
+            store, pool, None, shards={shard}, num_shards=K
+        )
+        touched = set(
+            report.adopted + report.reissued + report.repolled
+            + report.cleared + report.deferred
+        )
+        assert touched == set(by_shard[shard]), (
+            f"scoped pass touched {touched}, expected {set(by_shard[shard])}"
+        )
+        # Out-of-scope intents must be untouched — they belong to other
+        # shards' owners.
+        for name in names:
+            res = store.get(ComposableResource, name)
+            if name in touched:
+                continue
+            assert res.status.pending_op is not None, (
+                f"{name} outside the scoped shard lost its intent"
+            )
+
+    def test_shard_migration_mid_attach_no_double_attach(self, store):
+        """Satellite: intent written by replica A, shard stolen by B —
+        B's scoped adoption must converge the op with zero double-attach
+        and bit-identical budget/quarantine accounting."""
+        from tests.test_crash_restart import (
+            RecordingPool,
+            assert_no_double_attach,
+        )
+
+        store.create(Node(metadata=ObjectMeta(name="worker-0")))
+        pool = RecordingPool()
+        name = "mid-attach"
+        K = 2
+        res = ComposableResource(metadata=ObjectMeta(name=name))
+        res.spec.type = "tpu"
+        res.spec.model = "tpu-v4"
+        res.spec.target_node = "worker-0"
+        res.spec.chip_count = 2
+        res.status.state = RESOURCE_STATE_ATTACHING
+        store.create(res)
+        got = store.get(ComposableResource, name)
+        got.status.state = RESOURCE_STATE_ATTACHING
+        got.status.pending_op = PendingOp(
+            verb="add", nonce="nonce-mid", node="worker-0",
+            started_at=now_iso(),
+        )
+        got = store.update_status(got)
+        # Replica A issued the attach (it materialized at the fabric) but
+        # crashed/was fenced before recording the outcome.
+        pool.add_resource(got)
+        before_free = pool.free_chips("tpu-v4")
+        # Replica B steals the shard: its on_acquire hook runs the scoped
+        # adoption pass over exactly this key.
+        report = adopt_pending_ops(
+            store, pool, None,
+            shards={shard_for(name, K)}, num_shards=K,
+        )
+        assert name in report.adopted
+        after = store.get(ComposableResource, name)
+        assert after.status.pending_op is None
+        assert len(after.status.device_ids) == 2
+        assert after.status.attach_attempts == 0, "adoption rewrote the budget"
+        assert not after.status.quarantined
+        assert pool.free_chips("tpu-v4") == before_free, (
+            "adoption re-attached chips the fabric already held"
+        )
+        assert_no_double_attach(pool.events)

@@ -32,6 +32,14 @@ SURVEY.md §5 "Config / flag system"):
                       crash/drain-timeout (--trace-file)
   TPUC_FLIGHT_FILE    write the flight-recorder black box here on
                       crash/drain-timeout (--flight-file)
+  TPUC_SHARDS         number of control-plane shard leases (--shards);
+                      1 (default) = today's single-leader behavior,
+                      K>1 = N replicas each own a hash partition of keys
+  TPUC_SHARD_REPLICAS expected replica count (--shard-replicas): damps the
+                      first replica's startup grab during a rolling deploy
+  TPUC_LEASE_DURATION / TPUC_LEASE_RENEW_PERIOD
+                      lease timing for both the single-leader and shard
+                      electors (--lease-duration / --lease-renew-period)
   TPUC_HEALTH_FAILURE_THRESHOLD   consecutive failed health probes before
                       an Online member goes Degraded (--health-failure-threshold)
   TPUC_NODE_DEGRADE_THRESHOLD     per-node Degraded transitions that
@@ -132,6 +140,50 @@ def build_parser() -> argparse.ArgumentParser:
         "--leader-lock-path",
         default=None,
         help="leader lock file (default under TPUC_RUN_DIR)",
+    )
+    # Sharded control plane (runtime/shards.py): K shard leases, N active
+    # replicas each CAS-owning a balanced subset of object keys (crc32
+    # consistent hash). --shards 1 is bit-identical to the single-leader
+    # path: none of the shard machinery is constructed.
+    p.add_argument(
+        "--shards",
+        type=int,
+        default=_env_int("TPUC_SHARDS", 1),
+        help="number of control-plane shard leases (K). 1 (default) keeps"
+             " today's single-active-leader behavior unchanged; K>1 lets N"
+             " replicas each own a hash partition of CR keys, with live"
+             " handoff (scoped adoption) on failover/rebalance and"
+             " monotonic-deadline fencing on lease loss"
+             " (env TPUC_SHARDS)",
+    )
+    p.add_argument(
+        "--shard-replicas",
+        type=int,
+        default=_env_int("TPUC_SHARD_REPLICAS", 0),
+        help="expected operator replica count (N). Purely a startup damper:"
+             " for the first lease duration a booting replica caps its grab"
+             " at ceil(K/N) so a rolling deploy doesn't churn shards through"
+             " replica-1; live membership (renewing replicas) governs the"
+             " balance target afterwards. 0 disables"
+             " (env TPUC_SHARD_REPLICAS)",
+    )
+    p.add_argument(
+        "--lease-duration",
+        type=float,
+        default=_env_seconds("TPUC_LEASE_DURATION", 15.0),
+        help="seconds a leader/shard lease stays valid without renewal —"
+             " the failover budget: a crashed replica's keys migrate to a"
+             " survivor within one lease duration"
+             " (env TPUC_LEASE_DURATION)",
+    )
+    p.add_argument(
+        "--lease-renew-period",
+        type=float,
+        default=_env_seconds("TPUC_LEASE_RENEW_PERIOD", 5.0),
+        help="seconds between lease renewals; the fencing deadline (stop"
+             " acting when renewals keep failing) defaults to 2/3 of"
+             " --lease-duration and is measured on the monotonic clock"
+             " (env TPUC_LEASE_RENEW_PERIOD)",
     )
     p.add_argument(
         "--state-dir",
@@ -533,7 +585,31 @@ def build_manager(args: argparse.Namespace) -> Manager:
     if addr and addr.startswith(":"):
         addr = "0.0.0.0" + addr
     elector = None
-    if args.leader_elect:
+    ownership = None
+    shard_elector = None
+    num_shards = max(1, getattr(args, "shards", 1))
+    if num_shards > 1:
+        # Sharded control plane: K shard leases replace the single global
+        # leader — every replica is active on its owned key partition.
+        # Requires a SHARED store (kube-apiserver, or a shared in-proc
+        # store in tests/bench); --leader-elect is subsumed.
+        from tpu_composer.runtime.shards import ShardLeaseElector
+
+        shard_elector = ShardLeaseElector(
+            store,  # raw store, not the cache: leases need linearizable reads
+            num_shards=num_shards,
+            expected_replicas=max(0, getattr(args, "shard_replicas", 0)),
+            lease_duration_s=getattr(args, "lease_duration", 15.0),
+            renew_period_s=getattr(args, "lease_renew_period", 5.0),
+        )
+        ownership = shard_elector.ownership
+        elector = shard_elector
+        if args.leader_elect:
+            logging.getLogger("setup").info(
+                "--shards %d supersedes --leader-elect (every replica is"
+                " active on its shard subset)", num_shards,
+            )
+    elif args.leader_elect:
         from tpu_composer.runtime.chaosstore import ChaosStore
         from tpu_composer.runtime.store import Store as _InProcStore
 
@@ -546,7 +622,11 @@ def build_manager(args: argparse.Namespace) -> Manager:
             # The raw store, not the client: leader election needs
             # linearizable Lease reads (both cache layers exclude Leases,
             # but the intent belongs in the wiring too).
-            elector = LeaseElector(store)
+            elector = LeaseElector(
+                store,
+                lease_duration_s=getattr(args, "lease_duration", 15.0),
+                renew_period_s=getattr(args, "lease_renew_period", 5.0),
+            )
     maddr = args.metrics_bind_address or None
     if maddr and maddr.startswith(":"):
         maddr = "0.0.0.0" + maddr
@@ -569,6 +649,9 @@ def build_manager(args: argparse.Namespace) -> Manager:
             fabric,
             batch_window=args.fabric_batch_window,
             concurrency=args.fabric_concurrency,
+            # Shard fencing gate: lanes refuse ops for keys this replica
+            # no longer owns (None = unsharded, no gate).
+            owns=ownership.owns_key if ownership is not None else None,
         )
     mgr = Manager(
         store=client,
@@ -592,9 +675,35 @@ def build_manager(args: argparse.Namespace) -> Manager:
     # ops handed to the dispatcher's re-poll pass.
     from tpu_composer.controllers.adoption import adopt_pending_ops
 
-    mgr.add_startup_hook(
-        lambda: adopt_pending_ops(client, fabric, dispatcher)
-    )
+    if shard_elector is not None:
+        # Shard acquisition IS the adoption trigger: every shard this
+        # replica wins — at boot, on failover, on rebalance — runs the
+        # PR 5 cold-start adoption pass scoped to that shard's keys
+        # BEFORE the shard is served (the live-handoff contract), then a
+        # resync wave re-enqueues the moved keys into running controllers.
+        # Losing a shard fences its dispatcher lanes.
+        from tpu_composer.runtime.shards import shard_for
+
+        shard_elector.on_acquire.append(
+            lambda wins: adopt_pending_ops(
+                client, fabric, dispatcher,
+                shards=set(wins), num_shards=num_shards,
+            )
+        )
+        shard_elector.on_ready.append(
+            lambda shards: mgr.resync(
+                lambda key, _s=frozenset(shards):
+                shard_for(key, num_shards) in _s
+            )
+        )
+        if dispatcher is not None:
+            shard_elector.on_lose.append(
+                lambda shard, reason: dispatcher.abandon_unowned()
+            )
+    else:
+        mgr.add_startup_hook(
+            lambda: adopt_pending_ops(client, fabric, dispatcher)
+        )
     from tpu_composer.controllers.request_controller import RepairConfig
     from tpu_composer.controllers.resource_controller import ResourceTiming
     from tpu_composer.scheduler import ClusterScheduler, DefragLoop
@@ -612,20 +721,32 @@ def build_manager(args: argparse.Namespace) -> Manager:
     mgr.add_controller(ComposabilityRequestReconciler(client, fabric,
                                                       recorder=mgr.recorder,
                                                       scheduler=scheduler,
-                                                      repair=repair_cfg))
+                                                      repair=repair_cfg,
+                                                      ownership=ownership))
     res_rec = ComposableResourceReconciler(client, fabric, agent,
                                            timing=res_timing,
                                            recorder=mgr.recorder,
-                                           dispatcher=dispatcher)
+                                           dispatcher=dispatcher,
+                                           ownership=ownership)
     mgr.add_controller(res_rec)
     if args.defrag_interval > 0:
-        mgr.add_runnable(DefragLoop(client, scheduler.defrag,
-                                    period=args.defrag_interval,
-                                    execute=args.defrag_execute,
-                                    recorder=mgr.recorder))
+        mgr.add_runnable(DefragLoop(
+            client, scheduler.defrag,
+            period=args.defrag_interval,
+            execute=args.defrag_execute,
+            recorder=mgr.recorder,
+            # Sharded: defrag plans over the whole cluster — exactly one
+            # replica may run it. Shard 0's owner holds the duty; it fails
+            # over with the lease like any other shard responsibility.
+            gate=(
+                (lambda: ownership.owns_shard(0))
+                if ownership is not None else None
+            ),
+        ))
     mgr.add_runnable(UpstreamSyncer(client, fabric, period=args.sync_period,
                                     grace=args.sync_grace,
-                                    recorder=mgr.recorder))
+                                    recorder=mgr.recorder,
+                                    ownership=ownership))
     # Event-driven visibility: /dev change events nudge the resource
     # controller instead of waiting out a poll quantum (BASELINE.md) —
     # inotify directly for a local agent, HTTP long-poll per node for the

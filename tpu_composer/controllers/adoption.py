@@ -112,13 +112,23 @@ def _devices_for(
     return []
 
 
-def adopt_pending_ops(store, fabric, dispatcher=None) -> AdoptionReport:
+def adopt_pending_ops(
+    store, fabric, dispatcher=None, shards=None, num_shards: int = 1
+) -> AdoptionReport:
     """One cold-start pass over every durable ``pending_op`` record.
 
     Runs post-leader-acquire, pre-controller-start (Manager wiring): by the
     time the first reconcile fires, every surviving intent is either
     resolved into status, cleared for clean re-submission, or already
     re-polling inside the dispatcher.
+
+    With ``shards`` (a set of shard indices) and ``num_shards``, the pass
+    is SCOPED: only intents whose resource key hashes into one of the
+    given shards are classified. This is the shard-acquisition handoff —
+    a shard migration is a cold-start adoption scoped to the moved keys,
+    so failover and rebalancing reuse exactly the machinery the
+    kill–restart soak proves. The default (``shards=None``) scans
+    everything, bit-identical to the single-leader pass.
     """
     report = AdoptionReport()
     try:
@@ -128,6 +138,13 @@ def adopt_pending_ops(store, fabric, dispatcher=None) -> AdoptionReport:
         report.errors.append(f"list: {e}")
         return report
     pending = [r for r in resources if r.status.pending_op is not None]
+    if shards is not None:
+        from tpu_composer.runtime.shards import shard_for
+
+        pending = [
+            r for r in pending
+            if shard_for(r.metadata.name, num_shards) in shards
+        ]
     if not pending:
         return report
 

@@ -90,6 +90,7 @@ from tpu_composer.fabric.provider import (
     UnsupportedResize,
 )
 from tpu_composer.runtime.controller import Controller, Result
+from tpu_composer.runtime.shards import ShardFencedError
 from tpu_composer.runtime.events import WARNING, EventRecorder
 from tpu_composer.runtime.metrics import (
     attach_to_ready_seconds,
@@ -157,7 +158,7 @@ def generate_resource_name(device_type: str) -> str:
 
 class ComposabilityRequestReconciler(Controller):
     primary_kind = "ComposabilityRequest"
-    quiet_exceptions = (FabricError, TopologyError)
+    quiet_exceptions = (FabricError, TopologyError, ShardFencedError)
 
     def __init__(
         self,
@@ -167,8 +168,17 @@ class ComposabilityRequestReconciler(Controller):
         recorder: Optional[EventRecorder] = None,
         scheduler: Optional[ClusterScheduler] = None,
         repair: Optional[RepairConfig] = None,
+        ownership=None,  # runtime.shards.ShardOwnership; None = unsharded
     ) -> None:
-        super().__init__(store)
+        # Sharded mode: this replica reconciles only requests whose key
+        # hashes into an owned shard. Children hash independently — their
+        # attach/detach runs on whichever replica owns each child's shard.
+        # This controller's remaining writes are child create/delete
+        # (CAS-protected, shard-safe) and the SLICE fabric verbs
+        # (reserve/resize/release/repair), which are fenced at call time
+        # via _slice_fabric — a replica fenced mid-reconcile must never
+        # mutate a slice a successor already owns.
+        super().__init__(store, ownership=ownership)
         self.fabric = fabric
         self.timing = timing or RequestTiming()
         self.recorder = recorder or EventRecorder()
@@ -358,6 +368,24 @@ class ComposabilityRequestReconciler(Controller):
     def _slice_name(self, req: ComposabilityRequest) -> str:
         return f"{req.name}-slice"
 
+    def _slice_fabric(self, req: ComposabilityRequest):
+        """Fabric handle for SLICE mutations (reserve/resize/release/
+        repair), fence-checked at call time: the worker-side ownership
+        filter stops new reconciles for unowned request keys, but a shard
+        can be fenced mid-reconcile — this is the last point the
+        split-brain invariant can be enforced before a deposed replica
+        destroys or re-shapes a slice its successor already owns. The
+        quiet ShardFencedError requeues; the successor's reconcile (after
+        scoped adoption) re-derives the slice state idempotently."""
+        if self.ownership is not None and not self.ownership.owns_key(
+            req.metadata.name
+        ):
+            raise ShardFencedError(
+                f"{req.metadata.name}: shard no longer owned by this"
+                " replica; slice mutation fenced"
+            )
+        return self.fabric
+
     def _quarantined_nodes(self) -> set:
         """Hosts under a node-level quarantine marker (attach budget
         exhausted there — see publisher.quarantine_node). ONE list per
@@ -481,7 +509,7 @@ class ComposabilityRequestReconciler(Controller):
                 # topology change like 1x2x2 -> 2x2x1): reprogram ICI links
                 # around the live members.
                 try:
-                    self.fabric.resize_slice(
+                    self._slice_fabric(req).resize_slice(
                         slice_name, res.model, shape.topology, nodes
                     )
                 except UnsupportedResize:
@@ -500,7 +528,7 @@ class ComposabilityRequestReconciler(Controller):
             )
             nodes = cur_hosts + extra
             try:
-                self.fabric.resize_slice(
+                self._slice_fabric(req).resize_slice(
                     slice_name, res.model, shape.topology, nodes
                 )
             except UnsupportedResize:
@@ -508,7 +536,7 @@ class ComposabilityRequestReconciler(Controller):
                 return Result(requeue_after=self.timing.cleaning_poll)
             self._retopologize(healthy, shape.topology)
         else:
-            self.fabric.release_slice(slice_name)
+            self._slice_fabric(req).release_slice(slice_name)
             placement = self.scheduler.place(req, shape, quarantined_nodes)
             if placement.victims:
                 self._preempt(req, placement.victims)
@@ -519,7 +547,7 @@ class ComposabilityRequestReconciler(Controller):
                 )
             nodes = placement.nodes
             try:
-                self.fabric.reserve_slice(slice_name, res.model, shape.topology, nodes)
+                self._slice_fabric(req).reserve_slice(slice_name, res.model, shape.topology, nodes)
             except FabricError:
                 # place() dequeued this request on success; a failed
                 # reservation (transient fabric fault, open breaker) means
@@ -1238,7 +1266,7 @@ class ComposabilityRequestReconciler(Controller):
             # from healthy inventory (raises UnsupportedRepair -> caller
             # falls back; FabricError -> retried next pass, nothing
             # created yet).
-            self.fabric.repair_slice_member(
+            self._slice_fabric(req).repair_slice_member(
                 c.spec.slice_name, c.spec.worker_id, node
             )
         else:
@@ -1304,7 +1332,7 @@ class ComposabilityRequestReconciler(Controller):
         if children:
             self._delete_children(req, children)
             return Result(requeue_after=self.timing.cleaning_poll)
-        self.fabric.release_slice(self._slice_name(req))
+        self._slice_fabric(req).release_slice(self._slice_name(req))
         req.status.resources = {}
         req.status.slice = SliceStatus()
         req.status.scalar_resource = req.spec.resource
@@ -1318,7 +1346,7 @@ class ComposabilityRequestReconciler(Controller):
         if children:
             self._delete_children(req, children)
             return Result(requeue_after=self.timing.cleaning_poll)
-        self.fabric.release_slice(self._slice_name(req))
+        self._slice_fabric(req).release_slice(self._slice_name(req))
         req.status.state = REQUEST_STATE_DELETING
         self._write_status(req)
         return Result(requeue_after=0.0)

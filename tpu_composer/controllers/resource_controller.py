@@ -76,6 +76,7 @@ from tpu_composer.fabric.provider import (
 from tpu_composer.runtime import tracing
 from tpu_composer.runtime.controller import Controller, Result
 from tpu_composer.runtime.events import WARNING, EventRecorder
+from tpu_composer.runtime.shards import ShardFencedError
 from tpu_composer.runtime.metrics import (
     composed_chips,
     fabric_requests_total,
@@ -165,7 +166,7 @@ def degrade_member(
 
 class ComposableResourceReconciler(Controller):
     primary_kind = "ComposableResource"
-    quiet_exceptions = (FabricError, AgentError)
+    quiet_exceptions = (FabricError, AgentError, ShardFencedError)
 
     def __init__(
         self,
@@ -176,8 +177,9 @@ class ComposableResourceReconciler(Controller):
         recorder: Optional[EventRecorder] = None,
         publisher=None,  # DevicePublisher; default built on the store
         dispatcher=None,  # fabric.dispatcher.FabricDispatcher; None = direct
+        ownership=None,  # runtime.shards.ShardOwnership; None = unsharded
     ) -> None:
-        super().__init__(store)
+        super().__init__(store, ownership=ownership)
         self.fabric = fabric
         self.agent = agent
         # Fabric I/O pipeline: with a dispatcher, attach/detach SUBMIT and
@@ -758,9 +760,26 @@ class ComposableResourceReconciler(Controller):
         res.status.pending_op = self._new_intent(verb, res)
         return self.store.update_status(res)
 
+    def _fence_check(self, res: ComposableResource) -> None:
+        """End-to-end shard fencing at the fabric write boundary: the
+        worker-side ownership filter stops NEW reconciles for unowned
+        keys, but ownership can flip mid-reconcile (a shard lease fenced
+        between dequeue and the fabric call) — the mutation itself is the
+        last point the invariant can be enforced. The durable intent
+        already written stays put; the shard's new owner resolves it via
+        scoped adoption."""
+        if self.ownership is not None and not self.ownership.owns_key(
+            res.metadata.name
+        ):
+            raise ShardFencedError(
+                f"{res.metadata.name}: shard no longer owned by this"
+                " replica; mutation fenced"
+            )
+
     def _fabric_add(self, res: ComposableResource):
         """Attach via the dispatcher (submit-and-return + completion latch)
         or inline when batching is disabled."""
+        self._fence_check(res)
         if self.dispatcher is None:
             return self.fabric.add_resource(res)
         name = res.metadata.name
@@ -781,6 +800,7 @@ class ComposableResourceReconciler(Controller):
         )
 
     def _fabric_remove(self, res: ComposableResource) -> None:
+        self._fence_check(res)
         if self.dispatcher is None:
             return self.fabric.remove_resource(res)
         name = res.metadata.name

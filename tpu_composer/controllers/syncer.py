@@ -82,12 +82,21 @@ class UpstreamSyncer:
         grace: float = 600.0,  # :38 (10 min)
         recorder: Optional[EventRecorder] = None,
         vanish_threshold: int = 2,
+        ownership=None,
     ) -> None:
         self.store = store
         self.fabric = fabric
         self.period = period
         self.grace = grace
         self.recorder = recorder or EventRecorder()
+        # Shard ownership (runtime.shards.ShardOwnership): with N replicas
+        # each running a syncer against the same fabric, every mutating
+        # sweep is partitioned by key hash — orphan reclamation by device
+        # id, vanish detection by member name, stale-quarantine clearing
+        # by node name — so exactly one replica acts per object. All three
+        # paths are idempotent, so the partition is about duplicate work
+        # and event spam, not correctness. None = unsharded (act on all).
+        self.ownership = ownership
         # Consecutive sync passes an Online member's device must be absent
         # from get_resources() before the member is marked Degraded
         # (device-vanished detection). Damping twin of the controller's
@@ -105,6 +114,9 @@ class UpstreamSyncer:
         # persist that failed leaves its id out so later ticks retry.
         self._tracked: set = set()
         self._loaded = False
+
+    def _owned(self, key: str) -> bool:
+        return self.ownership is None or self.ownership.owns_key(key)
 
     # The Manager runnable entry point (mgr.Add(RunnableFunc) analog).
     def __call__(self, stop_event: threading.Event) -> None:
@@ -142,6 +154,11 @@ class UpstreamSyncer:
             if dev.device_id in local_ids:
                 if self._missing.pop(dev.device_id, None) is not None:
                     self._drop_tracker(dev.device_id)  # reappeared (:99-105)
+                continue
+            if not self._owned(dev.device_id):
+                # Sharded: another replica's syncer owns this orphan's
+                # grace clock and detach-CR — acting here would duplicate
+                # trackers and events fleet-wide.
                 continue
             first = self._missing.get(dev.device_id)
             if first is None:
@@ -190,6 +207,12 @@ class UpstreamSyncer:
             del self._vanish_counts[stale]
         degraded = 0
         for r in resources:
+            if not self._owned(r.name):
+                # Sharded: the member's owner runs vanish damping and
+                # recovery; the fleet gauge below then counts only owned
+                # members (per-process /metrics sum across replicas).
+                self._vanish_counts.pop(r.name, None)
+                continue
             if (
                 r.status.state == RESOURCE_STATE_DEGRADED
                 and not r.being_deleted
@@ -396,6 +419,8 @@ class UpstreamSyncer:
             if not is_node_quarantine_marker(rule):
                 continue  # per-device taint or orphan tracker, not a node marker
             node = rule.spec.node_name
+            if not self._owned(node):
+                continue  # sharded: the node-key owner clears its markers
             try:
                 if self.store.try_get(Node, node) is not None:
                     continue

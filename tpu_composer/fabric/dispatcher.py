@@ -142,8 +142,15 @@ class FabricDispatcher:
         max_batch: int = 16,
         snapshot_ttl: float = 0.05,
         done_ttl: float = 300.0,
+        owns: Optional[Callable[[str], bool]] = None,
     ) -> None:
         self.provider = provider
+        # Shard fencing gate: owns(resource_name) -> bool, None = every key
+        # is ours (unsharded). Checked immediately before provider calls
+        # are issued and enforced wholesale by abandon_unowned() when a
+        # shard lease is lost — a fenced replica must stop mutating the
+        # shard's keys before a successor can steal the lease.
+        self._owns = owns
         self.batch_window = max(0.0, batch_window)
         self.concurrency = max(1, concurrency)
         self.poll_interval = max(0.001, poll_interval)
@@ -383,6 +390,50 @@ class FabricDispatcher:
                     pass
             return True
 
+    def abandon_unowned(self) -> int:
+        """Shard fence: drop every queued submission, fabric-pending
+        re-poll and parked outcome whose resource key this replica no
+        longer owns. Nothing is fired or parked — the successor re-derives
+        the work from the durable ``pending_op`` intent via its scoped
+        adoption pass (the same contract as :meth:`kill`, scoped to the
+        lost shard's keys). Ops already executing at the provider settle
+        inside the renew-deadline fencing margin. Returns the number of
+        ops dropped."""
+        if self._owns is None:
+            return 0
+        dropped = 0
+        with self._cond:
+            for key in [
+                k for k, op in self._ops.items()
+                if op.state in (_QUEUED, _PENDING) and not self._owns(op.name)
+            ]:
+                op = self._ops.pop(key)
+                lane = self._lanes.get(op.node)
+                if lane is not None:
+                    if op.state == _QUEUED:
+                        try:
+                            lane.fifo.remove(op)
+                        except ValueError:
+                            pass
+                    lane.pending.pop(op.name, None)
+                    if self._lanes.get(op.node) is lane and lane.idle():
+                        del self._lanes[op.node]
+                dropped += 1
+            for key in [
+                k for k, (op, _) in self._done.items()
+                if not self._owns(op.name)
+            ]:
+                del self._done[key]
+                dropped += 1
+            if dropped:
+                self._cond.notify_all()
+        if dropped:
+            self.log.warning(
+                "shard fence: abandoned %d op(s)/outcome(s) for keys this"
+                " replica no longer owns", dropped,
+            )
+        return dropped
+
     # ------------------------------------------------------------------
     # shared snapshot reads
     # ------------------------------------------------------------------
@@ -552,7 +603,28 @@ class FabricDispatcher:
                 sp["resource"] = ops[0].name
             self._execute_inner(verb, ops)
 
+    def _drop_fenced(self, ops: List[_Op]) -> List[_Op]:
+        """Last-line shard fence: an op taken from its lane after the
+        fence raced abandon_unowned() must still never reach the provider
+        under a lost shard's key."""
+        if self._owns is None:
+            return ops
+        fenced = [op for op in ops if not self._owns(op.name)]
+        if not fenced:
+            return ops
+        with self._cond:
+            for op in fenced:
+                self._ops.pop(op.key, None)
+        self.log.warning(
+            "shard fence: refusing %d op(s) for unowned key(s) %s",
+            len(fenced), ",".join(op.name for op in fenced[:8]),
+        )
+        return [op for op in ops if self._owns(op.name)]
+
     def _execute_inner(self, verb: str, ops: List[_Op]) -> None:
+        ops = self._drop_fenced(ops)
+        if not ops:
+            return
         fabric_inflight.inc(len(ops))
         try:
             if len(ops) > 1 and self._group_verbs_ok is not False:
@@ -603,6 +675,13 @@ class FabricDispatcher:
         """Record one member's outcome: result, fabric wait, or error."""
         now = time.monotonic()
         with self._cond:
+            if self._owns is not None and not self._owns(op.name):
+                # Shard lost while the provider call was in flight: do not
+                # park the outcome — the key's new owner re-reads fabric
+                # state via its scoped adoption pass, and a parked result
+                # here would only stall this replica's graceful drains.
+                self._ops.pop(op.key, None)
+                return
             lane = self._lanes.setdefault(op.node, _Lane())
             if isinstance(outcome, _WAIT_SENTINELS[op.verb]):
                 op.state = _PENDING

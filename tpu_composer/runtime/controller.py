@@ -52,10 +52,20 @@ class Controller:
     #: logged without a traceback.
     quiet_exceptions: tuple = ()
 
-    def __init__(self, store: Store, name: Optional[str] = None) -> None:
+    def __init__(
+        self, store: Store, name: Optional[str] = None, ownership=None
+    ) -> None:
         self.store = store
         self.name = name or type(self).__name__
         self.log = logging.getLogger(self.name)
+        # Shard ownership (runtime.shards.ShardOwnership) — None means
+        # unsharded: every key is this replica's to reconcile (the
+        # single-leader default, bit-identical to the pre-shard path).
+        # With an ownership view, keys whose shard this replica does not
+        # hold are dropped at enqueue AND at dequeue (dequeue too because
+        # ownership can flip while a key sits queued); the shard's new
+        # owner re-enqueues them via the manager resync hook.
+        self.ownership = ownership
         self.queue = RateLimitingQueue()
         self._watches: List[Tuple[str, Optional[EventMapper], Optional[EventPredicate]]] = []
         self._watch_queues: List = []
@@ -101,7 +111,8 @@ class Controller:
         if self.primary_kind:
             cls = self.store.scheme.lookup(self.primary_kind)
             for obj in self.store.list(cls):  # type: ignore[type-var]
-                self.queue.add(obj.metadata.name)
+                if self._owned(obj.metadata.name):
+                    self.queue.add(obj.metadata.name)
         for i in range(workers):
             t = threading.Thread(
                 target=self._worker_loop, name=f"{self.name}-worker-{i}", daemon=True
@@ -147,12 +158,24 @@ class Controller:
                                    getattr(event, "type", event))
                 continue
             for key in keys:
-                self.queue.add(key)
+                if self._owned(key):
+                    self.queue.add(key)
+
+    def _owned(self, key) -> bool:
+        return self.ownership is None or self.ownership.owns_key(key)
 
     def _worker_loop(self) -> None:
         while not self._stop.is_set():
             key = self.queue.get(timeout=0.2)
             if key is None:
+                continue
+            if not self._owned(key):
+                # Shard moved (or was never ours) while the key sat
+                # queued: drop it without reconciling — the shard's owner
+                # serves it. pop_context first so the parked trace handoff
+                # can't leak; done() releases the processing mark.
+                self.queue.pop_context(key)
+                self.queue.done(key)
                 continue
             # Cross-thread causality: an add() made inside a traced span (a
             # dispatcher completion latch, a sibling reconcile) parked a

@@ -24,11 +24,13 @@ import logging
 import os
 import socket
 import threading
+import time
 import uuid
 from typing import Optional
 
 from tpu_composer.api.lease import Lease, LeaseSpec
 from tpu_composer.api.meta import ObjectMeta, now_iso, parse_iso
+from tpu_composer.runtime.metrics import lease_transitions_total
 from tpu_composer.runtime.store import (
     AlreadyExistsError,
     ConflictError,
@@ -43,6 +45,46 @@ def default_identity() -> str:
     """hostname_uuid — the same shape client-go uses (id must be unique per
     replica even on one host)."""
     return f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+
+
+class RenewObservation:
+    """What a contender last saw on a lease — (holder, renew_time) — and
+    WHEN it first saw that exact pair, on its own monotonic clock.
+
+    The steal discipline shared by the single-leader elector and the shard
+    elector (client-go's observedRenewTime): a lease is stealable only
+    after the pair has sat unchanged for a full lease duration of LOCAL
+    monotonic time. Comparing the holder's wall-clock stamp against the
+    contender's wall clock alone would let a contender whose clock runs a
+    lease-duration ahead (NTP step, VM resume) depose a healthy leader.
+    """
+
+    __slots__ = ("holder", "renew_time", "first_mono")
+
+    def __init__(self, holder: str, renew_time: str, first_mono: float) -> None:
+        self.holder = holder
+        self.renew_time = renew_time
+        self.first_mono = first_mono
+
+    @classmethod
+    def advance(
+        cls,
+        prev: Optional["RenewObservation"],
+        holder: str,
+        renew_time: str,
+        now_mono: float,
+    ) -> "RenewObservation":
+        """Carry the previous observation forward, resetting the clock
+        whenever the observed (holder, renew_time) pair changes."""
+        if prev is not None and prev.holder == holder and prev.renew_time == renew_time:
+            return prev
+        return cls(holder, renew_time, now_mono)
+
+    def expired(self, lease_duration_s: float, now_mono: float) -> bool:
+        """Free (no holder) or observed-unchanged past the duration."""
+        if not self.holder:
+            return True
+        return now_mono - self.first_mono > max(1.0, float(lease_duration_s))
 
 
 class LeaseElector:
@@ -75,6 +117,8 @@ class LeaseElector:
         self.log = logging.getLogger("LeaseElector")
         self._lock = threading.Lock()
         self._leading = False
+        # Steal-side observation clock (see RenewObservation).
+        self._steal_obs: Optional[RenewObservation] = None
         self._stop_renew = threading.Event()
         self._renew_thread: Optional[threading.Thread] = None
         # Parity with LeaderElector's log line
@@ -95,6 +139,23 @@ class LeaseElector:
             return True
         age = (self._now() - renewed).total_seconds()
         return age > spec.lease_duration_seconds
+
+    def _stealable(self, spec: LeaseSpec) -> bool:
+        """Expired by BOTH clocks: the holder's wall-clock stamp is older
+        than the lease duration AND this process has watched the
+        (holder, renew_time) pair sit unchanged for a full lease duration
+        on its monotonic clock (RenewObservation — the discipline shared
+        with the shard elector). Either alone is spoofable by a clock
+        jump on one side; together a healthy leader is never deposed."""
+        if not spec.holder_identity or not spec.renew_time:
+            return True  # released — free immediately
+        now_mono = time.monotonic()
+        self._steal_obs = RenewObservation.advance(
+            self._steal_obs, spec.holder_identity, spec.renew_time, now_mono
+        )
+        if not self._expired(spec):
+            return False
+        return self._steal_obs.expired(spec.lease_duration_seconds, now_mono)
 
     def try_acquire(self) -> bool:
         """One CAS attempt: create the Lease, renew our own, or steal an
@@ -120,7 +181,7 @@ class LeaseElector:
                 elif existing.spec.holder_identity == self.identity:
                     existing.spec.renew_time = now
                     self.store.update(existing)
-                elif self._expired(existing.spec):
+                elif self._stealable(existing.spec):
                     existing.spec.holder_identity = self.identity
                     existing.spec.acquire_time = now
                     existing.spec.renew_time = now
@@ -134,6 +195,7 @@ class LeaseElector:
                 self.log.warning("lease acquire failed: %s", e)
                 return False
             self._leading = True
+            lease_transitions_total.inc(event="acquired")
             self._start_renewing()
             return True
 
@@ -162,7 +224,13 @@ class LeaseElector:
         self._renew_thread.start()
 
     def _renew_loop(self) -> None:
-        last_success = self._now()
+        # MONOTONIC fencing clock: the "stop acting" deadline must be
+        # immune to wall-clock jumps — an NTP step (or a VM resume)
+        # rewinding time.time() mid-partition would otherwise compute a
+        # tiny/negative failing_for and keep a partitioned leader alive
+        # past the point its lease became stealable. Wall time is used
+        # only for the renew_time STAMP other replicas read.
+        last_success = time.monotonic()
         # After a failed renew, poll fast (1s) so the renew_deadline check
         # fires promptly instead of one renew_period late; the stand-down
         # must land inside (lease_duration - renew_deadline) before the
@@ -182,7 +250,7 @@ class LeaseElector:
                     return
                 lease.spec.renew_time = now_iso()
                 self.store.update(lease)
-                last_success = self._now()
+                last_success = time.monotonic()
                 wait_s = self.renew_period_s
             except (ConflictError, NotFoundError, StoreError) as e:
                 # Fencing: if we cannot renew past the renew deadline (which
@@ -190,7 +258,8 @@ class LeaseElector:
                 # may be about to lead — stop claiming we do while the lease
                 # is still OURS on the wire, so both replicas never drive the
                 # fabric concurrently.
-                failing_for = (self._now() - last_success).total_seconds()
+                failing_for = time.monotonic() - last_success
+                lease_transitions_total.inc(event="renewed_fail")
                 self.log.warning(
                     "lease renew failed (%.0fs): %s", failing_for, e
                 )
@@ -212,13 +281,22 @@ class LeaseElector:
             self._renew_thread.join(timeout=self.renew_period_s + 1)
             self._renew_thread = None
         if not was_leading:
+            # A deposed replica never touches the lease on its way out —
+            # whatever is on the wire belongs to the successor.
             return
+        lease_transitions_total.inc(event="released")
         try:
             lease = self.store.try_get(Lease, self.name)
             if lease is not None and lease.spec.holder_identity == self.identity:
                 lease.spec.holder_identity = ""
                 lease.spec.renew_time = ""
+                # CAS-guarded on identity (the read above) + resourceVersion
+                # (the store's update precondition): if a successor steals
+                # the lease between our read and this write, the write
+                # conflicts and the successor's lease survives untouched.
                 self.store.update(lease)
+        except ConflictError:
+            pass  # successor CAS'd in between read and write — theirs now
         except StoreError:
             pass  # expiry will free it
 

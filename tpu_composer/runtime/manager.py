@@ -239,6 +239,26 @@ class Manager:
     def ready(self) -> bool:
         return self._started
 
+    def resync(self, match: Callable[[str], bool]) -> None:
+        """Re-enqueue every primary object whose key ``match`` accepts into
+        its controller's work queue. The shard-acquisition hook: a shard
+        picked up AFTER startup has no watch events pending for its
+        objects, so the new owner must level-trigger a reconcile wave over
+        the moved keys (the in-process analog of a cache resync)."""
+        for c in self._controllers:
+            if not c.primary_kind:
+                continue
+            try:
+                cls = self.store.scheme.lookup(c.primary_kind)
+                for obj in self.store.list(cls):
+                    if match(obj.metadata.name):
+                        c.queue.add(obj.metadata.name)
+            except Exception:
+                self.log.exception(
+                    "resync of %s failed; poll timers will converge",
+                    c.primary_kind,
+                )
+
     @property
     def health_port(self) -> Optional[int]:
         if self._health_server is None:
@@ -365,7 +385,15 @@ class Manager:
     def _leadership_watchdog(self) -> None:
         while not self._stop.wait(1.0):
             if not self._elector.is_leader:
+                from tpu_composer.runtime.metrics import (
+                    lease_transitions_total,
+                )
+
                 self.log.error("leadership lost — stopping controllers")
+                # Exactly once per deposition: the watchdog fires a single
+                # time and returns (a ShardLeaseElector never trips it —
+                # shard losses fence per-shard, not per-process).
+                lease_transitions_total.inc(event="deposed")
                 self.lost_leadership = True
                 # stop() joins threads including this one; run it from a
                 # helper thread to avoid self-join.

@@ -341,6 +341,31 @@ repair_breaker_open = global_registry.gauge(
     " above threshold — repairs and repair detaches frozen), else 0",
 )
 
+#: Sharded control plane (runtime/shards.py + runtime/leases.py): K shard
+#: leases across N replicas, with live handoff and partition fencing.
+lease_transitions_total = global_registry.counter(
+    "tpuc_lease_transitions_total",
+    "Single-leader lease churn by event (acquired = this replica won the"
+    " lease; renewed_fail = one failed renewal attempt; deposed = the"
+    " manager watchdog observed leadership lost — counted once per"
+    " deposition; released = voluntary release at shutdown)",
+)
+shard_ownership_gauge = global_registry.gauge(
+    "tpuc_shard_ownership",
+    "1 for each shard lease this replica currently holds, 0 otherwise"
+    " (per-process: sum over replicas == shard count when the fleet is"
+    " healthy; a shard stuck at 0 fleet-wide is orphaned)",
+)
+shard_handoffs_total = global_registry.counter(
+    "tpuc_shard_handoffs_total",
+    "Shard ownership changes at this replica, by reason (acquisitions:"
+    " bootstrap = lease created fresh | handoff = picked up a released"
+    " lease | failover = stole an expired lease from a dead replica;"
+    " losses: fenced = renewals failed past the monotonic deadline |"
+    " deposed = another replica holds the lease | rebalance = shed to a"
+    " returning replica | released = voluntary shutdown)",
+)
+
 #: Cluster scheduler (scheduler/: priority queue, preemption, defrag).
 scheduler_queue_depth = global_registry.gauge(
     "tpuc_scheduler_queue_depth",

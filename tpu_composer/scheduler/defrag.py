@@ -32,7 +32,7 @@ import contextlib
 import logging
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set
 
 from tpu_composer.agent.publisher import quarantined_nodes
 from tpu_composer.api.types import (
@@ -349,16 +349,26 @@ class DefragLoop:
         period: float = 300.0,
         execute: bool = False,
         recorder: Optional[EventRecorder] = None,
+        gate: Optional[Callable[[], bool]] = None,
     ) -> None:
         self.store = store
         self.planner = planner
         self.period = period
         self.execute = execute
         self.recorder = recorder
+        # Singleton gate for sharded deployments: defrag plans over the
+        # WHOLE cluster, so N replicas running it concurrently would
+        # compute mutually unaware, conflicting migration sets. cmd/main
+        # gates the pass on owning shard 0 — exactly one replica defrags
+        # at a time, and the duty fails over with the shard lease. None
+        # (unsharded) runs every tick, today's behavior.
+        self.gate = gate
         self.log = logging.getLogger("DefragLoop")
 
     def __call__(self, stop_event: threading.Event) -> None:
         while not stop_event.wait(self.period):
+            if self.gate is not None and not self.gate():
+                continue  # another replica holds the defrag duty
             try:
                 self.run_once()
             except StoreError as e:  # pragma: no cover - wire-store only
