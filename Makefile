@@ -4,7 +4,7 @@
 PYTHON ?= python
 IMG ?= tpu-composer:latest
 
-.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak
+.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak conformance
 
 all: native test
 
@@ -28,14 +28,27 @@ test-fast:
 bench:
 	$(PYTHON) bench.py
 
-## perf-smoke: fast CI gate — two count-based assertions (cache-on vs
+## perf-smoke: fast CI gate — count-based assertions (cache-on vs
 ## cache-off store round trips per attach through the cluster path, and a
 ## batched vs unbatched 8-child same-node fabric wave that must issue
-## strictly fewer attach/detach provider calls) plus one bounded wall-time
-## guard: causal tracing must add <5% (+50 ms jitter allowance) to the
-## 32-chip wave vs TPUC_TRACE=0, best-of-3
+## strictly fewer attach/detach provider calls), one bounded wall-time
+## guard (causal tracing must add <5% (+50 ms jitter allowance) to the
+## 32-chip wave vs TPUC_TRACE=0, best-of-3), plus the event-plane floor
+## check: poll-driven completion p50 >= poll_interval by construction,
+## event-driven strictly under it with zero safety-net fallbacks
 perf-smoke:
 	$(PYTHON) -c "import bench; bench.perf_smoke()"
+
+## conformance: the fabric provider conformance matrix — ONE parameterized
+## contract suite (attach/detach ordering + idempotency, per-member batch
+## outcomes, UnsupportedBatch/UnsupportedRepair/UnsupportedEvents
+## capability probes, health-state mapping, event/poll completion parity)
+## run against every backend: inmem (sync + fabric-async), REST and
+## Redfish over the wire-dialect fake server, plus chaos-wrapped variants
+## proving the fault injector is contract-transparent. A new backend earns
+## its place by adding one factory to tests/test_fabric_conformance.py.
+conformance:
+	$(PYTHON) -m pytest tests/test_fabric_conformance.py tests/test_fabric_events.py -q -p no:randomly
 
 ## crash-soak: kill–restart crash-consistency soak (tests/test_crash_restart.py,
 ## markers slow+crash): hard-stop the operator at 32 randomized points inside

@@ -709,6 +709,119 @@ def bench_shard_scaling(replica_counts=(1, 2, 4), requests: int = 16,
     return results
 
 
+def bench_event_plane(ops: int = 16, poll_interval: float = 0.5,
+                      async_delay: float = 0.02, rtt_s: float = 0.005):
+    """Completion-notification latency: event-driven vs poll-driven.
+
+    ``ops`` fabric-async attaches (the pool completes them server-side
+    ``async_delay`` seconds after acceptance — a real pool manager's shape)
+    run through the FabricDispatcher twice: once with a FabricSession
+    streaming push completions, once pure poll-driven. ``rtt_s`` charges
+    every provider call (including the event long-poll) a wire RTT via the
+    chaos wrapper's latency knob.
+
+    The numbers are LATENCY FLOORS, not wall-clock noise: poll-driven, an
+    accepted op cannot settle before the first safety-net re-poll at
+    ``poll_interval``, so its per-op latency is >= poll_interval by
+    construction; event-driven it settles ~``async_delay`` after
+    acceptance. perf_smoke asserts exactly that floor relationship plus
+    zero poll fallbacks on the event run — counts and floors, never a
+    wall-time race."""
+    import threading
+
+    from tpu_composer.api import ComposableResource  # noqa: F401 (api init)
+    from tpu_composer.api.types import (
+        ComposableResourceSpec,
+        ComposableResourceStatus,
+        ObjectMeta,
+        PendingOp,
+    )
+    from tpu_composer.fabric.chaos import ChaosFabricProvider
+    from tpu_composer.fabric.dispatcher import FabricDispatcher
+    from tpu_composer.fabric.events import FabricSession
+    from tpu_composer.fabric.inmem import InMemoryPool
+    from tpu_composer.runtime.metrics import fabric_poll_fallbacks_total
+
+    def run(events: bool):
+        pool = InMemoryPool(chips={"gpu-a100": ops}, async_delay=async_delay)
+        provider = (
+            ChaosFabricProvider(pool, latency=rtt_s) if rtt_s > 0 else pool
+        )
+        dispatcher = FabricDispatcher(
+            provider, batch_window=0.0, poll_interval=poll_interval,
+            concurrency=8,
+        )
+        session = None
+        if events:
+            session = FabricSession(provider, poll_timeout=1.0,
+                                    retry_base=0.01)
+            dispatcher.attach_session(session)
+            session.start()
+            deadline = time.monotonic() + 5
+            while not session.healthy() and time.monotonic() < deadline:
+                time.sleep(0.002)
+            if not session.healthy():
+                raise RuntimeError("event session never connected")
+        resources = []
+        for i in range(ops):
+            resources.append(ComposableResource(
+                metadata=ObjectMeta(name=f"evb-{i}"),
+                spec=ComposableResourceSpec(
+                    type="gpu", model="gpu-a100", target_node="evb-node",
+                    chip_count=1,
+                ),
+                status=ComposableResourceStatus(
+                    pending_op=PendingOp(verb="add", nonce=f"evb-n{i}")
+                ),
+            ))
+        fallbacks0 = fabric_poll_fallbacks_total.total()
+        submitted = {}
+        try:
+            for r in resources:
+                submitted[r.metadata.name] = time.perf_counter()
+                try:
+                    dispatcher.add_resource(r)
+                except Exception:
+                    pass  # dispatch/wait sentinel — completion comes later
+            done_at = {}
+            deadline = time.monotonic() + 30
+            while len(done_at) < ops and time.monotonic() < deadline:
+                for r in resources:
+                    name = r.metadata.name
+                    if name not in done_at and (
+                        dispatcher.op_state("add", name) == "done"
+                    ):
+                        done_at[name] = time.perf_counter()
+                time.sleep(0.001)
+            if len(done_at) < ops:
+                raise RuntimeError(
+                    f"{ops - len(done_at)} op(s) never settled"
+                )
+            for r in resources:  # consume parked outcomes (sanity)
+                dispatcher.add_resource(r)
+        finally:
+            if session is not None:
+                session.stop()
+            dispatcher.stop()
+        lat = sorted(
+            done_at[n] - submitted[n] for n in done_at
+        )
+        return {
+            "p50_s": round(statistics.median(lat), 4),
+            "max_s": round(lat[-1], 4),
+            "ops": ops,
+            "poll_fallbacks": fabric_poll_fallbacks_total.total() - fallbacks0,
+        }
+
+    return {
+        "poll_interval_s": poll_interval,
+        "async_delay_s": async_delay,
+        "injected_rtt_s": rtt_s,
+        "event_driven": run(events=True),
+        "poll_driven": run(events=False),
+    }
+
+
 def bench_tracing_overhead(children: int = 32, repeats: int = 3):
     """Tracing-cost measurement on the 32-chip same-node wave: best-of-N
     wall time with causal tracing recording every span/flow vs the
@@ -752,7 +865,12 @@ def perf_smoke(cycles: int = 3):
     3. tracing overhead — causal tracing recording every span and flow
        arrow must add <5% to the 32-chip wave's best-of-3 wall time versus
        TPUC_TRACE=0 (plus a 50 ms absolute allowance so a sub-second wave
-       on a noisy runner can't flake the gate on scheduler jitter alone).
+       on a noisy runner can't flake the gate on scheduler jitter alone);
+    4. event plane — completion-notification latency floors: poll-driven,
+       a fabric-async op CANNOT settle before the first safety-net re-poll
+       (p50 >= poll_interval by construction); event-driven it must settle
+       strictly under that floor with ZERO poll fallbacks. Floor + count
+       based — no wall-clock race.
 
     Run via ``make perf-smoke``."""
     on = bench_attach_cluster(cycles=cycles, rtt_s=0.0, cached=True)
@@ -760,6 +878,7 @@ def perf_smoke(cycles: int = 3):
     wave_on = bench_fabric_wave(children=8, fabric_batch=True)
     wave_off = bench_fabric_wave(children=8, fabric_batch=False)
     tracing_cost = bench_tracing_overhead(children=32, repeats=3)
+    event_plane = bench_event_plane(ops=12, poll_interval=0.5)
     out = {
         "metric": "perf_smoke_store_rtts_per_attach",
         "cache_on": on["rtts_per_attach"],
@@ -770,6 +889,9 @@ def perf_smoke(cycles: int = 3):
         "tracing_overhead_pct": tracing_cost["overhead_pct"],
         "tracing_on_best_s": tracing_cost["tracing_on_best_s"],
         "tracing_off_best_s": tracing_cost["tracing_off_best_s"],
+        "event_completion_p50_s": event_plane["event_driven"]["p50_s"],
+        "poll_completion_p50_s": event_plane["poll_driven"]["p50_s"],
+        "event_poll_fallbacks": event_plane["event_driven"]["poll_fallbacks"],
     }
     print(json.dumps(out))
     assert on["rtts_per_attach"] * 2 <= off["rtts_per_attach"], (
@@ -791,6 +913,22 @@ def perf_smoke(cycles: int = 3):
         f" {tracing_cost['tracing_on_best_s']}s with tracing on vs"
         f" {tracing_cost['tracing_off_best_s']}s with TPUC_TRACE=0"
         " (expected <5% overhead — the span/flow hot path must stay cheap)"
+    )
+    floor = event_plane["poll_interval_s"]
+    ev, po = event_plane["event_driven"], event_plane["poll_driven"]
+    assert po["p50_s"] >= floor, (
+        f"poll-driven completion p50 {po['p50_s']}s beat the {floor}s"
+        " re-poll floor — the harness is not measuring the pending path"
+    )
+    assert ev["p50_s"] < floor, (
+        f"event plane regression: event-driven completion p50 {ev['p50_s']}s"
+        f" is still floored by the {floor}s poll_interval — push events are"
+        " not settling dispatcher ops"
+    )
+    assert ev["poll_fallbacks"] == 0, (
+        f"event plane regression: {ev['poll_fallbacks']} op(s) were caught"
+        " by the safety-net poll during a healthy streaming session"
+        " (expected zero — every completion should arrive as a push event)"
     )
     return out
 
@@ -826,6 +964,18 @@ def main():
         shard_scaling = bench_shard_scaling()
     except Exception as e:
         shard_scaling = {"error": str(e)}
+    # Fabric event plane: completion-notification latency, push vs poll,
+    # with a wire RTT charged on every provider call.
+    try:
+        ep = bench_event_plane()
+        event_plane = {
+            "event_p50_ms": round(ep["event_driven"]["p50_s"] * 1e3, 1),
+            "poll_p50_ms": round(ep["poll_driven"]["p50_s"] * 1e3, 1),
+            "poll_interval_ms": ep["poll_interval_s"] * 1e3,
+            "event_fallbacks": ep["event_driven"]["poll_fallbacks"],
+        }
+    except Exception as e:
+        event_plane = {"error": str(e)}
     try:
         accel = bench_accelerator()
     except ImportError as e:
@@ -861,6 +1011,7 @@ def main():
         "raw_inproc_store_rtts": attach_raw["rtts_per_attach"],
         "baseline_p50_ms": REFERENCE_P50_MS,
         "shard_scaling": shard_scaling,
+        "event_plane": event_plane,
         "phase_durations": phase_durations,
         "accelerator": summarize_accelerator(accel),
         "full_record": "bench_artifacts/bench_full.json",

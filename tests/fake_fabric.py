@@ -31,6 +31,7 @@ from tpu_composer.api.types import (
     ComposableResourceSpec,
     ComposableResourceStatus,
     ObjectMeta,
+    PendingOp,
 )
 from tpu_composer.fabric.inmem import InMemoryPool
 from tpu_composer.fabric.provider import (
@@ -39,6 +40,10 @@ from tpu_composer.fabric.provider import (
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
 )
+
+#: Cap on one /v1/events long-poll hold (a handler thread parks on the
+#: pool's event condition for at most this long; the client re-polls).
+EVENTS_LONG_POLL_CAP_S = 10.0
 
 
 def _make_jwt(expires_in: float) -> str:
@@ -157,6 +162,9 @@ class _FabricHandler(BaseHTTPRequestHandler):
     def _route(self, method: str) -> None:
         path, _, query = self.path.partition("?")
         wait = "wait=true" in query
+        self._params = dict(
+            pair.split("=", 1) for pair in query.split("&") if "=" in pair
+        )
         f = self.fabric
         with f._lock:
             f.request_log.append(f"{method} {path}")
@@ -245,6 +253,24 @@ class _FabricHandler(BaseHTTPRequestHandler):
                     return self._send(404, {"error": f"no slice {name}"})
                 pool.release_slice(name)
                 return self._send(204)
+        if parts == ["events"] and method == "GET":
+            # Event-plane subscription (rest.py poll_events): long-poll the
+            # pool's sequence-numbered stream from the resume cursor.
+            try:
+                cursor = int(self._params.get("cursor", "-1"))
+            except ValueError:
+                cursor = -1
+            try:
+                timeout = float(self._params.get("timeout", "5"))
+            except ValueError:
+                timeout = 5.0
+            events, next_cursor = pool.poll_events(
+                cursor, timeout=max(0.0, min(timeout, EVENTS_LONG_POLL_CAP_S))
+            )
+            return self._send(200, {
+                "events": [e.to_wire() for e in events],
+                "cursor": next_cursor,
+            })
         if parts == ["attachments:batch"] and method == "POST":
             return self._attachment_batch(wait)
         if parts == ["attachments"] and method == "GET":
@@ -301,7 +327,8 @@ class _FabricHandler(BaseHTTPRequestHandler):
                     })
                 else:
                     resource = _dummy_resource(
-                        name, device_ids=list(item.get("device_ids", []))
+                        name, device_ids=list(item.get("device_ids", [])),
+                        nonce=str(item.get("nonce", "")),
                     )
                     _maybe_wait(
                         lambda: pool.remove_resource(resource),
@@ -341,7 +368,8 @@ class _FabricHandler(BaseHTTPRequestHandler):
             )
         if method == "DELETE":
             body = self._body()
-            resource = _dummy_resource(name, device_ids=list(body.get("device_ids", [])))
+            resource = _dummy_resource(name, device_ids=list(body.get("device_ids", [])),
+                                       nonce=str(body.get("nonce", "")))
             try:
                 _maybe_wait(
                     lambda: pool.remove_resource(resource), wait, WaitingDeviceDetaching
@@ -444,16 +472,27 @@ class _FabricHandler(BaseHTTPRequestHandler):
         for d in pool.get_resources():
             if d.node != node:
                 continue
-            rec_name = _owner_of(pool, d.device_id) or d.device_id
+            owner = _owner_of(pool, d.device_id)
+            rec_name = owner or d.device_id
             block = by_resource.setdefault(
                 rec_name,
-                {"Resource": rec_name, "Model": d.model, "Slice": d.slice_name,
+                # Leaked devices (no owning attachment) get an UNLABELED
+                # block — a "" Resource must read as "unowned", never as a
+                # resource coincidentally named like a device id.
+                {"Resource": owner or "", "Model": d.model,
+                 "Slice": d.slice_name, "Type": d.type,
                  "DeviceIds": [], "CDIDeviceId": "",
                  "Status": {"Health": "OK", "Detail": ""}},
             )
             block["DeviceIds"].append(d.device_id)
             rank = {"OK": 0, "Warning": 1, "Critical": 2}
-            if rank.get(d.health.state, 0) > rank[block["Status"]["Health"]]:
+            # Unknown states rank Critical on BOTH sides (conformance:
+            # a non-standard health string must never read as healthy —
+            # defaulting to 0 here collapsed it to OK before the client
+            # could rank it).
+            if rank.get(d.health.state, 2) > rank.get(
+                block["Status"]["Health"], 2
+            ):
                 block["Status"] = {"Health": d.health.state, "Detail": d.health.detail}
             rec = pool.attachment_record(rec_name)
             if rec:
@@ -463,6 +502,8 @@ class _FabricHandler(BaseHTTPRequestHandler):
     def _redfish_patch(self, node: str, body: dict) -> None:
         pool = self.fabric.pool
         acc = body.get("Accelerators", {})
+        if "AddMembers" in acc or "RemoveMembers" in acc:
+            return self._redfish_patch_members(node, acc)
         if "Add" in acc:
             add = acc["Add"]
             resource = _resource_from_body(
@@ -500,10 +541,62 @@ class _FabricHandler(BaseHTTPRequestHandler):
             return self._send(200, {"Id": node})
         self._send(400, {"error": "PATCH body needs Accelerators.Add or .Remove"})
 
+    def _redfish_patch_members(self, node: str, acc: dict) -> None:
+        """Member-batch composition (redfish.py add_resources/
+        remove_resources): one PATCH carries a per-node wave; the 200
+        response reports PER-MEMBER outcome records so one bad accelerator
+        degrades one member, never the wave."""
+        pool = self.fabric.pool
+        adding = "AddMembers" in acc
+        results: List[dict] = []
+        for m in acc.get("AddMembers" if adding else "RemoveMembers", []):
+            name = m.get("Resource", "")
+            try:
+                if adding:
+                    resource = _resource_from_body(name, {
+                        "node": node, "model": m.get("Model", ""),
+                        "chip_count": m.get("Count", 1),
+                        "slice": m.get("Slice", ""),
+                        "worker_id": m.get("WorkerId", 0),
+                        "nonce": m.get("Nonce", ""),
+                    })
+                    result = pool.add_resource(resource)
+                    results.append({
+                        "Resource": name,
+                        "DeviceIds": result.device_ids,
+                        "CDIDeviceId": result.cdi_device_id,
+                        "Slice": resource.spec.slice_name,
+                        "Status": {"Health": "OK"},
+                    })
+                else:
+                    pool.remove_resource(_dummy_resource(
+                        name, node=node,
+                        device_ids=list(m.get("DeviceIds", [])),
+                        nonce=str(m.get("Nonce", "")),
+                    ))
+                    results.append({"Resource": name, "Removed": True})
+            except WaitingDeviceAttaching:
+                results.append({"Resource": name, "State": "attaching"})
+            except WaitingDeviceDetaching:
+                results.append({"Resource": name, "State": "detaching"})
+            except TransientFabricError as e:
+                results.append({"Resource": name, "Error": str(e),
+                                "Transient": True})
+            except FabricError as e:
+                results.append({"Resource": name, "Error": str(e),
+                                "Transient": False})
+        self._send(200, {"Id": node, "Results": results})
+
 
 # -- helpers ----------------------------------------------------------------
 
 def _resource_from_body(name: str, body: dict) -> ComposableResource:
+    # The wire nonce (the client's durable intent id) rides into
+    # status.pending_op so the pool's op_completed events carry it back —
+    # the event-plane completion key.
+    status = ComposableResourceStatus()
+    if body.get("nonce"):
+        status.pending_op = PendingOp(verb="add", nonce=str(body["nonce"]))
     return ComposableResource(
         metadata=ObjectMeta(name=name),
         spec=ComposableResourceSpec(
@@ -515,16 +608,21 @@ def _resource_from_body(name: str, body: dict) -> ComposableResource:
             worker_id=int(body.get("worker_id", 0)),
             topology=body.get("topology", ""),
         ),
+        status=status,
     )
 
 
 def _dummy_resource(
-    name: str, node: str = "", device_ids: Optional[List[str]] = None
+    name: str, node: str = "", device_ids: Optional[List[str]] = None,
+    nonce: str = "",
 ) -> ComposableResource:
+    status = ComposableResourceStatus(device_ids=device_ids or [])
+    if nonce:
+        status.pending_op = PendingOp(verb="remove", nonce=nonce)
     return ComposableResource(
         metadata=ObjectMeta(name=name),
         spec=ComposableResourceSpec(model="any", target_node=node or "any"),
-        status=ComposableResourceStatus(device_ids=device_ids or []),
+        status=status,
     )
 
 

@@ -106,6 +106,66 @@ class TestBuildManager:
         finally:
             mgr.stop()
 
+    def test_fabric_events_default_and_escape_hatch(self, monkeypatch, tmp_path):
+        """Default wiring attaches a FabricSession to the dispatcher (and
+        runs it as a manager runnable); TPUC_FABRIC_EVENTS=0 (or
+        --no-fabric-events) constructs none of it, restoring the pure
+        poll-driven completion path. --no-fabric-batch implies no session
+        (the direct-call path has no consumer for push completions)."""
+        monkeypatch.setenv("CDI_PROVIDER_TYPE", "MOCK")
+        monkeypatch.delenv("NODE_AGENT", raising=False)
+        from tpu_composer.controllers import ComposableResourceReconciler
+        from tpu_composer.fabric.adapter import reset_shared_mock
+        from tpu_composer.fabric.events import FabricSession
+
+        reset_shared_mock()
+        args = build_parser().parse_args([
+            "--state-dir", str(tmp_path / "s1"),
+            "--fabric-poll-fallback-mult", "11",
+        ])
+        assert args.fabric_events is True
+        mgr = build_manager(args)
+        try:
+            rec = next(c for c in mgr._controllers
+                       if isinstance(c, ComposableResourceReconciler))
+            assert isinstance(rec.dispatcher._session, FabricSession)
+            assert rec.dispatcher.fallback_multiplier == 11
+            assert any(
+                getattr(r, "__self__", None) is rec.dispatcher._session
+                for r in mgr._runnables
+            ), "session.run never registered with the manager"
+        finally:
+            mgr.stop()
+
+        monkeypatch.setenv("TPUC_FABRIC_EVENTS", "0")
+        reset_shared_mock()
+        args = build_parser().parse_args(["--state-dir", str(tmp_path / "s2")])
+        assert args.fabric_events is False
+        mgr = build_manager(args)
+        try:
+            rec = next(c for c in mgr._controllers
+                       if isinstance(c, ComposableResourceReconciler))
+            assert rec.dispatcher is not None
+            assert rec.dispatcher._session is None
+        finally:
+            mgr.stop()
+
+        monkeypatch.delenv("TPUC_FABRIC_EVENTS", raising=False)
+        monkeypatch.setenv("TPUC_FABRIC_BATCH", "0")
+        reset_shared_mock()
+        args = build_parser().parse_args(["--state-dir", str(tmp_path / "s3")])
+        mgr = build_manager(args)
+        try:
+            rec = next(c for c in mgr._controllers
+                       if isinstance(c, ComposableResourceReconciler))
+            assert rec.dispatcher is None
+            assert not any(
+                isinstance(getattr(r, "__self__", None), FabricSession)
+                for r in mgr._runnables
+            )
+        finally:
+            mgr.stop()
+
     def test_default_shards_is_unsharded_single_leader_path(
         self, monkeypatch, tmp_path
     ):

@@ -1,0 +1,421 @@
+"""Provider conformance suite — the contract every fabric backend must pass.
+
+ROADMAP item 5's ask, extracted while the provider surface was open for the
+event plane: ONE parameterized suite run against every backend — the
+in-proc pool (sync + fabric-async), the REST pool client and the Redfish
+client (both over the fake fabric server speaking their real wire
+dialects), plus chaos-wrapped variants proving the fault-injection
+decorator preserves the contract bit-for-bit when idle.
+
+What the contract covers:
+
+- attach/detach lifecycle and ordering: idempotent completion re-reads,
+  idempotent detach of the unknown, detach-then-reattach, inventory
+  restored;
+- per-member group-verb outcomes: one bad device degrades one member of a
+  batch, outcomes stay aligned with the submitted order;
+- capability probes as probes: ``UnsupportedBatch`` / ``UnsupportedRepair``
+  / ``UnsupportedEvents`` must be raised (not crash, not mis-succeed) by
+  backends lacking the surface, and never by backends that have it;
+- health-state mapping: Redfish-style OK/Warning/Critical (worst-of-group,
+  unknown states never read healthy);
+- async wait sentinels: accepted-then-in-progress semantics;
+- event/poll completion parity: the op_completed stream reports the same
+  device_ids the synchronous path returned, keyed by the durable intent
+  nonce, in sequence order.
+
+A new backend earns its place by adding one factory to ``BACKENDS``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Set
+
+import pytest
+
+from tpu_composer.api.types import (
+    ComposableResource,
+    ComposableResourceSpec,
+    ComposableResourceStatus,
+    ObjectMeta,
+    PendingOp,
+)
+from tpu_composer.fabric.chaos import ChaosFabricProvider
+from tpu_composer.fabric.events import EVENT_OP_COMPLETED
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.fabric.provider import (
+    AttachResult,
+    DeviceHealth,
+    FabricError,
+    UnsupportedBatch,
+    UnsupportedEvents,
+    UnsupportedRepair,
+    WaitingDeviceAttaching,
+    WaitingDeviceDetaching,
+)
+
+from tests.fake_fabric import FakeFabricServer
+
+CHIPS = {"gpu-a100": 8, "tpu-v4": 16}
+
+
+@dataclass
+class Backend:
+    """One backend under conformance test: the provider driven through the
+    FabricProvider interface, the backing pool for ground-truth assertions,
+    and the capability set the contract is parameterized on."""
+
+    provider: object
+    pool: InMemoryPool
+    caps: Set[str] = field(default_factory=set)
+    close: Optional[Callable[[], None]] = None
+
+
+def _mk_inmem() -> Backend:
+    pool = InMemoryPool(chips=dict(CHIPS))
+    return Backend(pool, pool, {"batch", "events", "repair", "owner_listing"})
+
+
+def _mk_inmem_async() -> Backend:
+    pool = InMemoryPool(chips=dict(CHIPS), async_steps=2)
+    return Backend(
+        pool, pool, {"batch", "events", "repair", "owner_listing", "async"}
+    )
+
+
+def _mk_inmem_chaos() -> Backend:
+    # Idle chaos wrapper: the decorator must be contract-transparent.
+    pool = InMemoryPool(chips=dict(CHIPS))
+    return Backend(
+        ChaosFabricProvider(pool), pool,
+        {"batch", "events", "repair", "owner_listing"},
+    )
+
+
+def _mk_rest() -> Backend:
+    from tpu_composer.fabric.rest import RestPoolClient
+
+    srv = FakeFabricServer(pool=InMemoryPool(chips=dict(CHIPS)))
+    client = RestPoolClient(endpoint=srv.url, token_cache=None)
+    return Backend(
+        client, srv.pool, {"batch", "events", "owner_listing"},
+        close=srv.close,
+    )
+
+
+def _mk_rest_chaos() -> Backend:
+    b = _mk_rest()
+    return Backend(
+        ChaosFabricProvider(b.provider), b.pool, set(b.caps), close=b.close
+    )
+
+
+def _mk_redfish() -> Backend:
+    from tpu_composer.fabric.redfish import RedfishClient
+
+    srv = FakeFabricServer(pool=InMemoryPool(chips=dict(CHIPS)))
+    client = RedfishClient(endpoint=srv.url, token_cache=None)
+    return Backend(
+        client, srv.pool, {"batch", "owner_listing"}, close=srv.close
+    )
+
+
+def _mk_redfish_chaos() -> Backend:
+    b = _mk_redfish()
+    return Backend(
+        ChaosFabricProvider(b.provider), b.pool, set(b.caps), close=b.close
+    )
+
+
+BACKENDS = {
+    "inmem": _mk_inmem,
+    "inmem-async": _mk_inmem_async,
+    "inmem-chaos": _mk_inmem_chaos,
+    "rest": _mk_rest,
+    "rest-chaos": _mk_rest_chaos,
+    "redfish": _mk_redfish,
+    "redfish-chaos": _mk_redfish_chaos,
+}
+
+
+@pytest.fixture(params=sorted(BACKENDS))
+def backend(request):
+    b = BACKENDS[request.param]()
+    try:
+        yield b
+    finally:
+        if b.close is not None:
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def make_resource(
+    name: str, node: str = "node-0", model: str = "gpu-a100",
+    nonce: str = "", device_ids=None,
+) -> ComposableResource:
+    status = ComposableResourceStatus(device_ids=list(device_ids or []))
+    if nonce:
+        status.pending_op = PendingOp(verb="add", nonce=nonce)
+    return ComposableResource(
+        metadata=ObjectMeta(name=name),
+        spec=ComposableResourceSpec(
+            type="gpu", model=model, target_node=node, chip_count=1,
+        ),
+        status=status,
+    )
+
+
+def drive(fn, deadline_s: float = 10.0):
+    """Run one fabric op to a terminal outcome, absorbing wait sentinels
+    the way the controllers' level-triggered requeues do."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return fn()
+        except (WaitingDeviceAttaching, WaitingDeviceDetaching):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.005)
+
+
+def drive_batch(batch_fn, resources, deadline_s: float = 10.0):
+    """Drive a group verb until every member reports a terminal outcome,
+    keeping the FIRST terminal outcome per member (the dispatcher's view:
+    a member that failed stays failed for this wave)."""
+    terminal: dict = {}
+    deadline = time.monotonic() + deadline_s
+    while len(terminal) < len(resources):
+        outcomes = batch_fn([r for r in resources
+                             if r.metadata.name not in terminal])
+        pending_names = [r.metadata.name for r in resources
+                         if r.metadata.name not in terminal]
+        for name, out in zip(pending_names, outcomes):
+            if isinstance(out, (WaitingDeviceAttaching, WaitingDeviceDetaching)):
+                continue
+            terminal[name] = out
+        if time.monotonic() > deadline:
+            raise AssertionError(f"batch never settled: missing "
+                                 f"{set(pending_names) - set(terminal)}")
+        time.sleep(0.005)
+    return [terminal[r.metadata.name] for r in resources]
+
+
+# ---------------------------------------------------------------------------
+# the contract
+# ---------------------------------------------------------------------------
+
+class TestLifecycle:
+    def test_attach_detach_roundtrip(self, backend):
+        p, pool = backend.provider, backend.pool
+        free0 = pool.free_chips("gpu-a100")
+        r = make_resource("conf-rt", nonce="n-rt")
+        result = drive(lambda: p.add_resource(r))
+        assert isinstance(result, AttachResult) and result.device_ids
+        assert pool.free_chips("gpu-a100") == free0 - 1
+
+        # Idempotent completion re-read: same ids, no second allocation.
+        again = drive(lambda: p.add_resource(r))
+        assert again.device_ids == result.device_ids
+        assert pool.free_chips("gpu-a100") == free0 - 1
+
+        listed = p.get_resources()
+        mine = [d for d in listed if d.device_id in result.device_ids]
+        assert len(mine) == len(result.device_ids)
+        assert all(d.node == "node-0" for d in mine)
+
+        r.status.device_ids = list(result.device_ids)
+        drive(lambda: p.remove_resource(r))
+        assert pool.free_chips("gpu-a100") == free0
+        assert not [d for d in p.get_resources()
+                    if d.device_id in result.device_ids]
+
+    def test_detach_unknown_is_idempotent_noop(self, backend):
+        p = backend.provider
+        drive(lambda: p.remove_resource(make_resource("conf-ghost")))
+
+    def test_detach_then_reattach(self, backend):
+        """Ordering: attach -> detach -> attach again must yield a live
+        attachment (stale completion state must not leak across ops)."""
+        p, pool = backend.provider, backend.pool
+        r = make_resource("conf-cycle")
+        first = drive(lambda: p.add_resource(r))
+        r.status.device_ids = list(first.device_ids)
+        drive(lambda: p.remove_resource(r))
+        r2 = make_resource("conf-cycle")
+        second = drive(lambda: p.add_resource(r2))
+        assert second.device_ids
+        health = p.check_resource(r2)
+        assert health.healthy
+
+    def test_async_wait_sentinel_progress(self, backend):
+        if "async" not in backend.caps:
+            pytest.skip("backend is synchronous")
+        p = backend.provider
+        r = make_resource("conf-async")
+        with pytest.raises(WaitingDeviceAttaching):
+            p.add_resource(r)
+        result = drive(lambda: p.add_resource(r))
+        assert result.device_ids
+
+
+class TestGroupVerbs:
+    def test_batch_outcomes_stay_aligned_and_isolated(self, backend):
+        """One bad member degrades ONE member: outcomes align with the
+        submitted order, the healthy members attach."""
+        if "batch" not in backend.caps:
+            pytest.skip("backend has no group verbs")
+        p, pool = backend.provider, backend.pool
+        rs = [make_resource(f"conf-b{i}", nonce=f"n-b{i}") for i in range(3)]
+        pool.inject_add_failure("conf-b1", times=1)
+        outcomes = drive_batch(p.add_resources, rs)
+        assert isinstance(outcomes[0], AttachResult)
+        assert isinstance(outcomes[1], FabricError)
+        assert not isinstance(outcomes[1], (WaitingDeviceAttaching,
+                                            WaitingDeviceDetaching))
+        assert isinstance(outcomes[2], AttachResult)
+        ids0 = set(outcomes[0].device_ids)
+        ids2 = set(outcomes[2].device_ids)
+        assert ids0 and ids2 and not (ids0 & ids2)
+
+        # Group detach twin: per-member None for detached AND for the
+        # member that never attached (idempotent no-op).
+        for r, out in zip(rs, outcomes):
+            if isinstance(out, AttachResult):
+                r.status.device_ids = list(out.device_ids)
+        removed = drive_batch(p.remove_resources, rs)
+        assert removed == [None, None, None]
+
+    def test_unsupported_batch_is_a_probe_not_a_crash(self, backend):
+        """A provider lacking group verbs raises UnsupportedBatch from the
+        base class, and the per-item path still works afterward — the
+        dispatcher's fallback contract."""
+        if "batch" in backend.caps:
+            pytest.skip("backend has native group verbs")
+        p = backend.provider
+        rs = [make_resource(f"conf-ub{i}") for i in range(2)]
+        with pytest.raises(UnsupportedBatch):
+            p.add_resources(rs)
+        for r in rs:
+            assert drive(lambda r=r: p.add_resource(r)).device_ids
+
+
+class TestHealth:
+    def test_health_state_mapping(self, backend):
+        p, pool = backend.provider, backend.pool
+        r = make_resource("conf-health")
+        result = drive(lambda: p.add_resource(r))
+        r.status.device_ids = list(result.device_ids)
+        assert p.check_resource(r).healthy
+
+        pool.set_health(result.device_ids[0], DeviceHealth("Warning", "w"))
+        h = p.check_resource(r)
+        assert h.state == "Warning" and not h.healthy
+
+        pool.set_health(result.device_ids[0], DeviceHealth("Critical", "c"))
+        assert p.check_resource(r).state == "Critical"
+
+    def test_unknown_health_state_never_reads_healthy(self, backend):
+        p, pool = backend.provider, backend.pool
+        r = make_resource("conf-funky")
+        result = drive(lambda: p.add_resource(r))
+        r.status.device_ids = list(result.device_ids)
+        pool.set_health(result.device_ids[0], DeviceHealth("Funky", "???"))
+        assert not p.check_resource(r).healthy
+
+    def test_not_attached_is_critical(self, backend):
+        h = backend.provider.check_resource(make_resource("conf-nowhere"))
+        assert h.state == "Critical" and not h.healthy
+
+
+class TestListing:
+    def test_owner_attribution(self, backend):
+        if "owner_listing" not in backend.caps:
+            pytest.skip("backend listing carries no ownership")
+        p = backend.provider
+        r = make_resource("conf-owner")
+        result = drive(lambda: p.add_resource(r))
+        mine = [d for d in p.get_resources()
+                if d.device_id in set(result.device_ids)]
+        assert mine and all(d.resource_name == "conf-owner" for d in mine)
+
+
+class TestRepair:
+    def test_unsupported_repair_is_a_probe(self, backend):
+        """Backends without in-place member repair must refuse with
+        UnsupportedRepair (the repair driver's detach-and-re-solve
+        fallback trigger), never crash or silently succeed."""
+        if "repair" in backend.caps:
+            pytest.skip("backend implements repair_slice_member")
+        with pytest.raises(UnsupportedRepair):
+            backend.provider.repair_slice_member("conf-slice", 0, "node-0")
+
+    def test_repair_recarves_one_worker(self, backend):
+        if "repair" not in backend.caps:
+            pytest.skip("backend has no in-place repair")
+        p, pool = backend.provider, backend.pool
+        p.reserve_slice("conf-rs", "tpu-v4", "2x2x2", ["node-0", "node-1"])
+        before = dict(pool._slices["conf-rs"].groups)
+        p.repair_slice_member("conf-rs", 1, "node-2")
+        after = pool._slices["conf-rs"].groups
+        assert after[0] == before[0], "untouched worker's chips changed"
+        assert after[1] != before[1], "repaired worker kept its chips"
+        p.release_slice("conf-rs")
+
+
+class TestEvents:
+    def test_event_poll_completion_parity(self, backend):
+        """The push stream must report the SAME completion the poll path
+        returned: op_completed events for attach and detach, keyed by the
+        durable intent nonce, carrying the attached device_ids, in
+        strictly increasing sequence order."""
+        if "events" not in backend.caps:
+            pytest.skip("backend has no event stream")
+        p = backend.provider
+        _, cursor = p.poll_events(-1, timeout=0.0)
+
+        r = make_resource("conf-ev", nonce="n-ev-add")
+        result = drive(lambda: p.add_resource(r))
+        r.status.device_ids = list(result.device_ids)
+        r.status.pending_op = PendingOp(verb="remove", nonce="n-ev-rm")
+        drive(lambda: p.remove_resource(r))
+
+        deadline = time.monotonic() + 5
+        seen = []
+        while time.monotonic() < deadline:
+            events, cursor = p.poll_events(cursor, timeout=0.2)
+            seen.extend(events)
+            if [e for e in seen if e.type == EVENT_OP_COMPLETED
+                    and e.verb == "remove" and e.resource == "conf-ev"]:
+                break
+        seqs = [e.seq for e in seen]
+        assert seqs == sorted(seqs), "events out of order"
+        adds = [e for e in seen if e.type == EVENT_OP_COMPLETED
+                and e.verb == "add" and e.resource == "conf-ev"]
+        rms = [e for e in seen if e.type == EVENT_OP_COMPLETED
+               and e.verb == "remove" and e.resource == "conf-ev"]
+        assert len(adds) == 1 and len(rms) == 1
+        assert adds[0].device_ids == result.device_ids
+        assert adds[0].outcome == "ok"
+        assert adds[0].nonce == "n-ev-add"
+        assert rms[0].nonce == "n-ev-rm"
+
+    def test_events_tail_start_skips_backlog(self, backend):
+        if "events" not in backend.caps:
+            pytest.skip("backend has no event stream")
+        p = backend.provider
+        r = make_resource("conf-backlog")
+        drive(lambda: p.add_resource(r))
+        events, cursor = p.poll_events(-1, timeout=0.0)
+        assert events == [], "tail start must not replay history"
+        assert cursor >= 1
+
+    def test_unsupported_events_is_a_probe(self, backend):
+        if "events" in backend.caps:
+            pytest.skip("backend has an event stream")
+        with pytest.raises(UnsupportedEvents):
+            backend.provider.poll_events(-1, timeout=0.0)

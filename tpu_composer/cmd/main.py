@@ -21,6 +21,14 @@ SURVEY.md §5 "Config / flag system"):
   TPUC_FABRIC_BATCH   "0" disables the FabricDispatcher (--no-fabric-batch
                       equivalent): attach/detach run as today's direct
                       blocking calls inside reconcile workers
+  TPUC_FABRIC_EVENTS  "0" disables the fabric event plane
+                      (--no-fabric-events equivalent): no FabricSession is
+                      constructed and op completion is observed purely by
+                      the dispatcher's poll timers, bit-identical to the
+                      pre-event-plane behavior
+  TPUC_FABRIC_POLL_FALLBACK_MULT
+                      poll_interval stretch factor while the event session
+                      is streaming (--fabric-poll-fallback-mult)
   TPUC_DRAIN_TIMEOUT  seconds a graceful shutdown drains in-flight fabric
                       ops before releasing the lease (--drain-timeout)
   TPUC_CHAOS_STORE_*  store-layer fault injection (FAILURE_RATE,
@@ -237,6 +245,30 @@ def build_parser() -> argparse.ArgumentParser:
         help="seconds a fabric submission waits for same-node companions"
              " before dispatch (the batching/latency trade; env"
              " TPUC_FABRIC_BATCH_WINDOW)",
+    )
+    p.add_argument(
+        "--fabric-events",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_FABRIC_EVENTS", "1") != "0",
+        help="hold one persistent event session per fabric endpoint"
+             " (server-push op completions, health transitions, inventory"
+             " deltas; GET /v1/events for REST backends): completions"
+             " settle dispatcher ops the moment the fabric finishes, and"
+             " the re-poll pass stretches to a safety net. Providers"
+             " without an event stream keep polling unchanged."
+             " --no-fabric-events or TPUC_FABRIC_EVENTS=0 restores the"
+             " poll-driven completion path bit-identically",
+    )
+    p.add_argument(
+        "--fabric-poll-fallback-mult",
+        type=float,
+        default=_env_float("TPUC_FABRIC_POLL_FALLBACK_MULT", 20.0),
+        help="while the event session is streaming, fabric-pending ops"
+             " park at poll_interval times this factor (the safety-net"
+             " cadence; anything the net catches counts"
+             " tpuc_fabric_poll_fallbacks_total). Session loss snaps"
+             " parked polls back to the tight poll_interval"
+             " (env TPUC_FABRIC_POLL_FALLBACK_MULT)",
     )
     p.add_argument(
         "--fabric-concurrency",
@@ -652,7 +684,22 @@ def build_manager(args: argparse.Namespace) -> Manager:
             # Shard fencing gate: lanes refuse ops for keys this replica
             # no longer owns (None = unsharded, no gate).
             owns=ownership.owns_key if ownership is not None else None,
+            fallback_multiplier=getattr(args, "fabric_poll_fallback_mult", 20.0),
         )
+    session = None
+    if dispatcher is not None and getattr(args, "fabric_events", True):
+        # Fabric event plane (fabric/events.py): one persistent session
+        # per endpoint, server-push completions settling dispatcher ops.
+        # Only meaningful WITH the dispatcher (the direct-call path blocks
+        # inline and has nothing to push to); a provider without an event
+        # stream answers the capability probe and the session goes
+        # dormant, leaving polling primary.
+        from tpu_composer.fabric.events import FabricSession
+
+        session = FabricSession(
+            fabric, name=os.environ.get("FABRIC_ENDPOINT", "") or "fabric"
+        )
+        dispatcher.attach_session(session)
     mgr = Manager(
         store=client,
         leader_elect=args.leader_elect,
@@ -668,6 +715,8 @@ def build_manager(args: argparse.Namespace) -> Manager:
     )
     if dispatcher is not None:
         mgr.add_runnable(dispatcher.run)
+    if session is not None:
+        mgr.add_runnable(session.run)
     # Cold-start adoption (controllers/adoption.py): post-leader-acquire,
     # pre-controller-start, every durable pending_op intent is classified
     # against the live fabric — completed attaches are adopted into

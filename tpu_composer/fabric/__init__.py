@@ -13,9 +13,11 @@ from tpu_composer.fabric.provider import (
     FabricError,
     FabricProvider,
     TransientFabricError,
+    UnsupportedEvents,
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
 )
+from tpu_composer.fabric.events import FabricEvent, FabricSession
 from tpu_composer.fabric.breaker import (
     BreakerConfig,
     BreakerFabricProvider,
@@ -38,8 +40,11 @@ __all__ = [
     "DeviceHealth",
     "FabricDevice",
     "FabricError",
+    "FabricEvent",
     "FabricProvider",
+    "FabricSession",
     "TransientFabricError",
+    "UnsupportedEvents",
     "WaitingDeviceAttaching",
     "WaitingDeviceDetaching",
     "InMemoryPool",

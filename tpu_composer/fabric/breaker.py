@@ -321,6 +321,17 @@ class BreakerFabricProvider(FabricProvider):
         node = resources[0].spec.target_node if resources else ""
         return self._call(node, self._inner.remove_resources, resources)
 
+    def poll_events(self, cursor: int, timeout: float = 5.0):
+        """Deliberately UN-breakered delegation. Two reasons it must exist
+        explicitly: (1) the base class defines poll_events (raising
+        UnsupportedEvents), so ``__getattr__`` never fires for it — without
+        this override the breaker wrapper would silently disable the event
+        plane for every remote backend it guards; (2) the session has its
+        own reconnect backoff, and a long-poll's routine timeouts/failures
+        must not consume breaker failure streaks or half-open probe slots
+        meant for the mutation path."""
+        return self._inner.poll_events(cursor, timeout)
+
     def check_resource(self, resource: ComposableResource) -> DeviceHealth:
         return self._call(
             resource.spec.target_node, self._inner.check_resource, resource

@@ -20,7 +20,14 @@ Knobs (all thread-safe, all injectable mid-run):
 - ``fail_op(op, times)``: scripted failures for one verb by name;
 - ``blackout()`` / ``heal()``: every call fails (dead fabric manager) until
   healed — what trips the endpoint-level breaker;
-- ``latency`` (seconds, or (lo, hi) range): injected delay per call.
+- ``latency`` (seconds, or (lo, hi) range): injected delay per call;
+- event-plane faults (the fabric event session's failure modes):
+  ``kill_session(times)`` fails the next ``times`` poll_events calls
+  (``-1`` = until healed — the mid-wave session drop), ``drop_events`` /
+  ``duplicate_events`` / ``reorder_events`` mutate the delivered stream.
+  A dropped event is dropped FOREVER (its seq is remembered), modeling a
+  lossy stream rather than a retryable fetch — exactly what the session's
+  gap-detection + resync machinery exists for.
 
 All injected failures raise ``TransientFabricError`` — chaos models
 reachability faults; terminal semantics (pool exhausted, bad model) still
@@ -68,6 +75,14 @@ class ChaosFabricProvider(FabricProvider):
         self._degraded_nodes: set = set()  # node blackout after Ready
         self._flapping: Dict[str, int] = {}  # device_id -> probe counter
         self._vanished: set = set()  # device ids omitted from get_resources
+        # Event-plane chaos (fabric event session failure modes).
+        self._session_kills = 0  # poll_events calls to fail (-1 = forever)
+        self._event_drop_rate = 0.0
+        self._event_drop_next = 0  # scripted: drop the next N events
+        self._event_dup_rate = 0.0
+        self._event_reorder_rate = 0.0
+        self._dropped_seqs: set = set()  # lost for good (lossy stream)
+        self._event_stash: List = []  # held back one batch (cross-batch reorder)
         self.calls = 0
         self.injected = 0  # failures actually raised
 
@@ -80,8 +95,10 @@ class ChaosFabricProvider(FabricProvider):
             self._blackout = True
 
     def heal(self) -> None:
-        """Clear the blackout, all scripted failures AND the post-Ready
-        health-shaping modes (degraded nodes, flapping, vanished)."""
+        """Clear the blackout, all scripted failures, the post-Ready
+        health-shaping modes (degraded nodes, flapping, vanished) AND the
+        event-stream faults (already-dropped seqs stay lost — healing the
+        wire cannot resurrect a lost message)."""
         with self._lock:
             self._blackout = False
             self._node_failures.clear()
@@ -89,6 +106,11 @@ class ChaosFabricProvider(FabricProvider):
             self._degraded_nodes.clear()
             self._flapping.clear()
             self._vanished.clear()
+            self._session_kills = 0
+            self._event_drop_rate = 0.0
+            self._event_drop_next = 0
+            self._event_dup_rate = 0.0
+            self._event_reorder_rate = 0.0
 
     def fail_node(self, node: str, times: int = -1) -> None:
         """Fail node-scoped calls targeting `node`; -1 = until healed."""
@@ -103,6 +125,41 @@ class ChaosFabricProvider(FabricProvider):
         """Fail the next `times` calls of one verb (e.g. 'get_resources')."""
         with self._lock:
             self._op_failures[op] = times
+
+    # -- event-plane faults ---------------------------------------------
+    def kill_session(self, times: int = -1) -> None:
+        """Fail the next `times` poll_events calls (-1 = until healed):
+        the persistent event session drops mid-stream and must reconnect
+        with its resume cursor — or, while dead, the dispatcher must fall
+        back to polling with zero missed completions."""
+        with self._lock:
+            self._session_kills = times
+
+    def restore_session(self) -> None:
+        with self._lock:
+            self._session_kills = 0
+
+    def drop_events(self, rate: float = 0.0, next_n: int = 0) -> None:
+        """Lose events: each delivered event dropped with probability
+        `rate`, plus the next `next_n` events dropped deterministically.
+        A dropped seq never re-delivers — the consumer sees a sequence
+        gap and must resync, not wait."""
+        with self._lock:
+            self._event_drop_rate = rate
+            self._event_drop_next += next_n
+
+    def duplicate_events(self, rate: float) -> None:
+        """Re-deliver events with probability `rate` (at-least-once
+        stream): consumers must dedupe on seq."""
+        with self._lock:
+            self._event_dup_rate = rate
+
+    def reorder_events(self, rate: float) -> None:
+        """Hold events back one batch with probability `rate`, so newer
+        seqs arrive first (cross-batch reorder): consumers must tolerate
+        late duplicates and transient gaps."""
+        with self._lock:
+            self._event_reorder_rate = rate
 
     # -- post-Ready failure modes (health-shaping, not call failures) ----
     def degrade_node(self, node: str) -> None:
@@ -242,6 +299,59 @@ class ChaosFabricProvider(FabricProvider):
                 for d in out
             ]
         return out
+
+    def poll_events(self, cursor: int, timeout: float = 5.0):
+        """Event stream with injected faults. UnsupportedEvents from the
+        inner provider passes through untouched (a capability probe must
+        stay a capability probe); the session-kill knob and the general
+        chaos gate model wire faults; drop/duplicate/reorder mutate the
+        delivered batch while the inner cursor advances normally — which
+        is exactly how a lossy transport looks to the subscriber."""
+        with self._lock:
+            if self._session_kills != 0:
+                if self._session_kills > 0:
+                    self._session_kills -= 1
+                self.injected += 1
+                raise TransientFabricError("chaos: event session killed")
+        self._chaos("poll_events")
+        events, next_cursor = self._inner.poll_events(cursor, timeout)
+        with self._lock:
+            if not (
+                self._event_drop_rate or self._event_drop_next
+                or self._event_dup_rate or self._event_reorder_rate
+                or self._dropped_seqs or self._event_stash
+            ):
+                return events, next_cursor
+            out: List = []
+            stash, self._event_stash = self._event_stash, []
+            for ev in events:
+                if ev.seq in self._dropped_seqs:
+                    continue  # lost for good
+                if self._event_drop_next > 0 or (
+                    self._event_drop_rate > 0
+                    and self._rng.random() < self._event_drop_rate
+                ):
+                    if self._event_drop_next > 0:
+                        self._event_drop_next -= 1
+                    self._dropped_seqs.add(ev.seq)
+                    self.injected += 1
+                    continue
+                if (
+                    self._event_reorder_rate > 0
+                    and self._rng.random() < self._event_reorder_rate
+                ):
+                    self._event_stash.append(ev)
+                    continue
+                out.append(ev)
+                if (
+                    self._event_dup_rate > 0
+                    and self._rng.random() < self._event_dup_rate
+                ):
+                    out.append(ev)
+            # Last batch's stashed events arrive AFTER this batch's newer
+            # seqs — the cross-batch reorder consumers must absorb.
+            out.extend(stash)
+            return out, next_cursor
 
     def reserve_slice(
         self, slice_name: str, model: str, topology: str, nodes: List[str]

@@ -55,6 +55,7 @@ import time
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from tpu_composer.api.types import ComposableResource
+from tpu_composer.fabric.events import EVENT_OP_COMPLETED, FabricEvent
 from tpu_composer.fabric.provider import (
     AttachResult,
     DispatchedAttaching,
@@ -71,7 +72,9 @@ from tpu_composer.runtime.metrics import (
     fabric_batch_size,
     fabric_calls_total,
     fabric_completion_latency,
+    fabric_event_resyncs_total,
     fabric_inflight,
+    fabric_poll_fallbacks_total,
     fabric_reads_coalesced_total,
 )
 
@@ -94,6 +97,7 @@ class _Op:
     __slots__ = (
         "verb", "resource", "node", "name", "on_ready", "state",
         "result", "error", "submitted", "next_poll", "wait_msg", "ctx",
+        "doorbell", "evented", "was_pending",
     )
 
     def __init__(self, verb: str, resource: ComposableResource, now: float) -> None:
@@ -108,6 +112,16 @@ class _Op:
         self.submitted = now
         self.next_poll = 0.0
         self.wait_msg = ""
+        # Event-plane bookkeeping: ``doorbell`` is a one-shot "a completion
+        # event arrived" flag consumed to schedule an immediate re-poll
+        # (covering the event-lands-while-op-is-INFLIGHT race); ``evented``
+        # is sticky — any event ever touched this op, so a terminal settle
+        # was push-driven, not a safety-net catch; ``was_pending`` marks
+        # ops that parked fabric-pending at least once (only those can
+        # count as poll fallbacks).
+        self.doorbell = False
+        self.evented = False
+        self.was_pending = False
         # Causal handoff from the submitting reconcile span (trace_id = the
         # durable pending_op nonce): the execute pass links it into the
         # dispatch span, and completion spans re-hand it to the requeue.
@@ -143,6 +157,7 @@ class FabricDispatcher:
         snapshot_ttl: float = 0.05,
         done_ttl: float = 300.0,
         owns: Optional[Callable[[str], bool]] = None,
+        fallback_multiplier: float = 20.0,
     ) -> None:
         self.provider = provider
         # Shard fencing gate: owns(resource_name) -> bool, None = every key
@@ -162,6 +177,15 @@ class FabricDispatcher:
         # than 50 ms.
         self.snapshot_ttl = snapshot_ttl
         self.done_ttl = done_ttl
+        # Event plane (fabric/events.py): while an attached FabricSession
+        # is streaming, completion events settle fabric-pending ops and the
+        # per-op safety-net poll parks at poll_interval * fallback_multiplier
+        # instead of the hot loop; session loss snaps parked polls back to
+        # poll_interval. No session (the TPUC_FABRIC_EVENTS=0 escape hatch,
+        # and every pre-event-plane caller) keeps the poll-driven path
+        # bit-identical.
+        self.fallback_multiplier = max(1.0, fallback_multiplier)
+        self._session = None
         self.log = logging.getLogger("FabricDispatcher")
         self._cond = threading.Condition()
         self._lanes: Dict[str, _Lane] = {}
@@ -435,6 +459,100 @@ class FabricDispatcher:
         return dropped
 
     # ------------------------------------------------------------------
+    # event plane (fabric/events.py)
+    # ------------------------------------------------------------------
+    def attach_session(self, session) -> None:
+        """Wire a FabricSession as the primary completion channel.
+
+        An op_completed event is a DOORBELL: it wakes the matching
+        fabric-pending op for an immediate shared-pass re-poll — the
+        settle still reads authoritative state through the idempotent
+        provider verb, so duplicated / reordered / fabricated events can
+        at worst cost one redundant wire call, never a wrong settle. A
+        sequence gap triggers ONE get_resources() resync; session loss
+        snaps every parked poll back to the tight poll_interval."""
+        self._session = session
+        session.on_event(self._on_fabric_event)
+        session.on_gap(self._on_event_gap)
+        session.on_state(self._on_session_state)
+
+    def _events_primary(self) -> bool:
+        """True while push events are supposed to be delivering — the
+        condition under which a timer-driven settle counts as a fallback
+        catch (and under which parked polls may stretch)."""
+        s = self._session
+        return s is not None and s.supported()
+
+    def _park_interval(self) -> float:
+        s = self._session
+        if s is not None and s.supported() and s.healthy():
+            return self.poll_interval * self.fallback_multiplier
+        return self.poll_interval
+
+    def _on_fabric_event(self, ev: FabricEvent) -> None:
+        if ev.type != EVENT_OP_COMPLETED or ev.verb not in _GROUP_VERBS:
+            return
+        key = (ev.verb, ev.resource)
+        with self._cond:
+            op = self._ops.get(key)
+            if op is None:
+                return  # already settled (or never ours): nothing to wake
+            if ev.nonce:
+                po = op.resource.status.pending_op
+                if po is not None and po.nonce and po.nonce != ev.nonce:
+                    # A completion from an EARLIER incarnation of this
+                    # logical op (pre-crash intent, replayed stream):
+                    # waking on it would be harmless, but matching the
+                    # nonce keeps event-driven accounting honest.
+                    return
+            op.evented = True
+            if op.state == _PENDING:
+                op.next_poll = 0.0
+                self._cond.notify_all()
+            else:
+                # Queued/inflight: the provider call racing this event may
+                # still answer a wait sentinel — remember the doorbell so
+                # the park that follows re-polls immediately instead of
+                # waiting out a (possibly stretched) quantum.
+                op.doorbell = True
+
+    def _on_event_gap(self) -> None:
+        """Sequence gap: events were lost. One listing resync refreshes
+        the shared snapshot for inventory/health consumers, and every
+        fabric-pending op re-polls immediately — a lost completion costs
+        one get_resources, not a silent stretched-poll wait."""
+        fabric_event_resyncs_total.inc()
+        with self._cond:
+            self._snap_time = -1e9  # force a fresh listing, not the cache
+        try:
+            self.get_resources()
+        except Exception as e:
+            self.log.warning("gap resync listing failed: %s", e)
+        with self._cond:
+            now = time.monotonic()
+            for lane in self._lanes.values():
+                for op in lane.pending.values():
+                    op.next_poll = min(op.next_poll, now)
+            self._cond.notify_all()
+
+    def _on_session_state(self, healthy: bool) -> None:
+        if healthy:
+            return
+        # Snap back: parked polls stretched while the stream was healthy
+        # must not ride out their long quantum now that nobody will ring
+        # the doorbell — cap every pending op at one tight poll_interval.
+        with self._cond:
+            cap = time.monotonic() + self.poll_interval
+            changed = False
+            for lane in self._lanes.values():
+                for op in lane.pending.values():
+                    if op.next_poll > cap:
+                        op.next_poll = cap
+                        changed = True
+            if changed:
+                self._cond.notify_all()
+
+    # ------------------------------------------------------------------
     # shared snapshot reads
     # ------------------------------------------------------------------
     def get_resources(self) -> List[FabricDevice]:
@@ -685,14 +803,29 @@ class FabricDispatcher:
             lane = self._lanes.setdefault(op.node, _Lane())
             if isinstance(outcome, _WAIT_SENTINELS[op.verb]):
                 op.state = _PENDING
+                op.was_pending = True
                 op.wait_msg = str(outcome)
-                op.next_poll = now + self.poll_interval
+                if op.doorbell:
+                    # A completion event landed while this call was in
+                    # flight: re-poll NOW — the fabric already finished.
+                    op.doorbell = False
+                    op.next_poll = now
+                else:
+                    # Streaming session: park long (the event is the wake
+                    # signal, the poll only a safety net). No session, or
+                    # session down/unsupported: the tight quantum is the
+                    # primary completion path, exactly as before.
+                    op.next_poll = now + self._park_interval()
                 lane.pending[op.name] = op
                 # Fall through to fire on_ready (collected by the worker):
                 # the reconciler gets one immediate pass that observes the
                 # REAL wait sentinel, resetting streaks exactly as the
                 # direct-call path would on fabric-side progress.
                 return
+            if op.was_pending and not op.evented and self._events_primary():
+                # The safety net caught a completion the stream should
+                # have pushed — the "degraded to polling" signal.
+                fabric_poll_fallbacks_total.inc(verb=op.verb)
             op.state = _DONE
             if isinstance(outcome, Exception):
                 op.error = outcome

@@ -21,11 +21,19 @@ FM flow (fti/fm/client.go:100-214).
 
 from __future__ import annotations
 
+import collections
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from tpu_composer.api.types import ComposableResource
+from tpu_composer.fabric.events import (
+    EVENT_HEALTH,
+    EVENT_INVENTORY,
+    EVENT_OP_COMPLETED,
+    FabricEvent,
+)
 from tpu_composer.fabric.provider import (
     AttachResult,
     DeviceHealth,
@@ -35,6 +43,7 @@ from tpu_composer.fabric.provider import (
     HEALTH_OK,
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
+    intent_nonce as _intent_nonce,
 )
 from tpu_composer.topology.slices import is_tpu_model, solve_slice
 
@@ -64,11 +73,21 @@ class InMemoryPool(FabricProvider):
         self,
         chips: Optional[Dict[str, int]] = None,
         async_steps: int = 0,
+        async_delay: float = 0.0,
+        event_buffer: int = 4096,
     ) -> None:
         # Default inventory: enough v4 chips for a 32-chip pod slice plus
         # some loose gpu-compat devices.
         self._chips = dict(chips or {"tpu-v4": 64, "tpu-v5e": 32, "gpu-a100": 8})
         self._async_steps = async_steps
+        # Server-side async (the event plane's natural habitat): with
+        # async_delay > 0 an attach/detach is ACCEPTED (wait sentinel) and
+        # completes ``async_delay`` seconds later on the pool's own timer,
+        # emitting the op_completed event at that moment — unlike
+        # async_steps, where completion only happens when a client poll
+        # drives it. This is what a real pool manager does: the work
+        # finishes whether or not anyone is polling.
+        self._async_delay = async_delay
         self._lock = threading.RLock()
         self._free: Dict[str, List[str]] = {
             model: [f"{model}-chip-{i:04d}" for i in range(n)]
@@ -78,6 +97,16 @@ class InMemoryPool(FabricProvider):
         self._slices: Dict[str, _SliceReservation] = {}
         self._pending_attach: Dict[str, int] = {}  # resource_name -> polls remaining
         self._pending_detach: Dict[str, int] = {}
+        # async_delay mode: resource_name -> monotonic completion deadline.
+        self._attach_ready: Dict[str, float] = {}
+        self._detach_ready: Dict[str, float] = {}
+        # Event plane: bounded sequence-numbered ring + long-poll wakeup.
+        # The Condition shares the pool lock, so emission is atomic with
+        # the state change it reports and waiters release the lock while
+        # parked.
+        self._event_seq = 0
+        self._events: Deque[FabricEvent] = collections.deque(maxlen=event_buffer)
+        self._event_cond = threading.Condition(self._lock)
         self._health: Dict[str, DeviceHealth] = {}  # device_id -> health override
         self._add_failures: Dict[str, int] = {}  # resource_name -> remaining failures
         self._remove_failures: Dict[str, int] = {}
@@ -260,14 +289,25 @@ class InMemoryPool(FabricProvider):
             self._add_failures[name] -= 1
             raise FabricError(f"injected attach failure for {name}")
 
-        pending = self._pending_attach.get(name)
-        if pending is None and self._async_steps > 0:
-            self._pending_attach[name] = self._async_steps
-            raise WaitingDeviceAttaching(f"{name}: attach accepted, in progress")
-        if pending is not None and pending > 0:
-            self._pending_attach[name] = pending - 1
-            if self._pending_attach[name] > 0:
+        if self._async_delay > 0:
+            ready = self._attach_ready.get(name)
+            if ready is None:
+                self._attach_ready[name] = time.monotonic() + self._async_delay
+                self._spawn_async_completion("add", resource)
+                raise WaitingDeviceAttaching(
+                    f"{name}: attach accepted, in progress"
+                )
+            if time.monotonic() < ready:
                 raise WaitingDeviceAttaching(f"{name}: attach in progress")
+        else:
+            pending = self._pending_attach.get(name)
+            if pending is None and self._async_steps > 0:
+                self._pending_attach[name] = self._async_steps
+                raise WaitingDeviceAttaching(f"{name}: attach accepted, in progress")
+            if pending is not None and pending > 0:
+                self._pending_attach[name] = pending - 1
+                if self._pending_attach[name] > 0:
+                    raise WaitingDeviceAttaching(f"{name}: attach in progress")
 
         if spec.type == "tpu" and spec.slice_name:
             att = self._attach_slice_member(resource)
@@ -275,6 +315,16 @@ class InMemoryPool(FabricProvider):
             att = self._attach_loose(resource)
         self._attachments[name] = att
         self._pending_attach.pop(name, None)
+        self._attach_ready.pop(name, None)
+        self._emit_locked(
+            EVENT_OP_COMPLETED, resource=name, verb="add",
+            nonce=_intent_nonce(resource), node=att.node,
+            device_ids=list(att.device_ids), outcome="ok",
+        )
+        self._emit_locked(
+            EVENT_INVENTORY, resource=name, node=att.node,
+            device_ids=list(att.device_ids), detail="attached",
+        )
         return AttachResult(list(att.device_ids), att.cdi_device_id)
 
     def _attach_slice_member(self, resource: ComposableResource) -> _Attachment:
@@ -351,16 +401,37 @@ class InMemoryPool(FabricProvider):
         if att is None:
             self._drop_leaked(resource)
             return  # idempotent
-        pending = self._pending_detach.get(name)
-        if pending is None and self._async_steps > 0:
-            self._pending_detach[name] = self._async_steps
-            raise WaitingDeviceDetaching(f"{name}: detach accepted, in progress")
-        if pending is not None and pending > 0:
-            self._pending_detach[name] = pending - 1
-            if self._pending_detach[name] > 0:
+        if self._async_delay > 0:
+            ready = self._detach_ready.get(name)
+            if ready is None:
+                self._detach_ready[name] = time.monotonic() + self._async_delay
+                self._spawn_async_completion("remove", resource)
+                raise WaitingDeviceDetaching(
+                    f"{name}: detach accepted, in progress"
+                )
+            if time.monotonic() < ready:
                 raise WaitingDeviceDetaching(f"{name}: detach in progress")
+        else:
+            pending = self._pending_detach.get(name)
+            if pending is None and self._async_steps > 0:
+                self._pending_detach[name] = self._async_steps
+                raise WaitingDeviceDetaching(f"{name}: detach accepted, in progress")
+            if pending is not None and pending > 0:
+                self._pending_detach[name] = pending - 1
+                if self._pending_detach[name] > 0:
+                    raise WaitingDeviceDetaching(f"{name}: detach in progress")
         del self._attachments[name]
         self._pending_detach.pop(name, None)
+        self._detach_ready.pop(name, None)
+        self._emit_locked(
+            EVENT_OP_COMPLETED, resource=name, verb="remove",
+            nonce=_intent_nonce(resource), node=att.node,
+            device_ids=list(att.device_ids), outcome="ok",
+        )
+        self._emit_locked(
+            EVENT_INVENTORY, resource=name, node=att.node,
+            device_ids=list(att.device_ids), detail="detached",
+        )
         resv = self._slices.get(att.slice_name) if att.slice_name else None
         still_reserved = (
             {c for grp in resv.groups.values() for c in grp}
@@ -443,6 +514,84 @@ class InMemoryPool(FabricProvider):
             ) for l in self._leaked)
             return out
 
+    # ------------------------------------------------------------------
+    # event plane (server-push; fabric/events.py)
+    # ------------------------------------------------------------------
+    def _emit_locked(self, type_: str, **fields) -> None:
+        """Append one sequence-numbered event and wake long-pollers.
+        Caller holds the pool lock, so the event is atomic with the state
+        change it reports."""
+        self._event_seq += 1
+        self._events.append(FabricEvent(seq=self._event_seq, type=type_, **fields))
+        self._event_cond.notify_all()
+
+    def poll_events(
+        self, cursor: int, timeout: float = 5.0
+    ) -> Tuple[List[FabricEvent], int]:
+        """Long-poll the pool's event ring (provider.py contract): events
+        with seq > cursor, or an empty batch after ``timeout`` seconds of
+        silence. cursor=-1 tails (head seq, no backlog). A cursor older
+        than the ring's oldest retained event surfaces as a sequence gap
+        to the session, which resyncs via get_resources."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._event_cond:
+            if cursor < 0:
+                return [], self._event_seq
+            while True:
+                out = [e for e in self._events if e.seq > cursor]
+                if out:
+                    return out, out[-1].seq
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return [], cursor
+                self._event_cond.wait(remaining)
+
+    def _spawn_async_completion(self, verb: str, resource: ComposableResource) -> None:
+        """async_delay mode: the pool finishes the accepted op on its own
+        timer — re-driving the idempotent verb materializes the result and
+        emits the op_completed event, whether or not any client is
+        polling. Caller holds the lock; the timer runs without it."""
+        # Small margin past the deadline so clock granularity can't make
+        # the timer's own completion call observe "not ready yet".
+        t = threading.Timer(
+            self._async_delay + 0.005, self._complete_async, args=(verb, resource)
+        )
+        t.daemon = True
+        t.start()
+
+    def _complete_async(self, verb: str, resource: ComposableResource) -> None:
+        try:
+            if verb == "add":
+                self.add_resource(resource)
+            else:
+                self.remove_resource(resource)
+        except (WaitingDeviceAttaching, WaitingDeviceDetaching):
+            pass  # a racing injected reset; client polls finish it
+        except FabricError as e:
+            # The op failed at materialization time: push the bad news too.
+            # The event is a doorbell — the dispatcher's immediate re-poll
+            # reads the authoritative error through the idempotent verb
+            # (the ready-deadline entry stays put, so that re-poll falls
+            # through to the same terminal error instead of re-accepting).
+            with self._lock:
+                self._emit_locked(
+                    EVENT_OP_COMPLETED, resource=resource.metadata.name,
+                    verb=verb, nonce=_intent_nonce(resource),
+                    node=resource.spec.target_node, outcome="error",
+                    error=str(e),
+                )
+
+    def _node_of_device(self, device_id: str) -> str:
+        """Best-effort node attribution for health events (caller holds
+        the lock); '' for chips not currently attached anywhere."""
+        for att in self._attachments.values():
+            if device_id in att.device_ids:
+                return att.node
+        for dev in self._leaked:
+            if dev.device_id == device_id:
+                return dev.node
+        return ""
+
     def _release_chip(self, model: str, device_id: str) -> None:
         """Return one chip to inventory — free pool for healthy chips, the
         graveyard for killed ones (a dead chip must never be carved into a
@@ -464,6 +613,11 @@ class InMemoryPool(FabricProvider):
         with self._lock:
             self._dead_ids.add(device_id)
             self._health[device_id] = DeviceHealth("Critical", detail)
+            self._emit_locked(
+                EVENT_HEALTH, device_ids=[device_id],
+                node=self._node_of_device(device_id),
+                state="Critical", detail=detail,
+            )
             for model, lst in self._free.items():
                 if device_id in lst:
                     lst.remove(device_id)
@@ -476,6 +630,11 @@ class InMemoryPool(FabricProvider):
         with self._lock:
             self._dead_ids.discard(device_id)
             self._health.pop(device_id, None)
+            self._emit_locked(
+                EVENT_HEALTH, device_ids=[device_id],
+                node=self._node_of_device(device_id),
+                state=HEALTH_OK, detail="revived",
+            )
             for model, lst in self._graveyard.items():
                 if device_id in lst:
                     lst.remove(device_id)
@@ -500,6 +659,11 @@ class InMemoryPool(FabricProvider):
     def set_health(self, device_id: str, health: DeviceHealth) -> None:
         with self._lock:
             self._health[device_id] = health
+            self._emit_locked(
+                EVENT_HEALTH, device_ids=[device_id],
+                node=self._node_of_device(device_id),
+                state=health.state, detail=health.detail,
+            )
 
     def leak_attachment(self, node: str, model: str, type: str = "") -> str:
         """Create a fabric-side attachment with no local CR (drift source)."""
@@ -512,6 +676,10 @@ class InMemoryPool(FabricProvider):
                 device_id=dev, node=node, model=model,
                 type=type or ("tpu" if is_tpu_model(model) else "gpu"),
             ))
+            self._emit_locked(
+                EVENT_INVENTORY, node=node, device_ids=[dev],
+                detail="attached",
+            )
             return dev
 
     def attachment_record(self, resource_name: str) -> Optional[Dict[str, object]]:

@@ -49,6 +49,16 @@ class TransientFabricError(FabricError):
     occurrences count against breaker thresholds and attach budgets."""
 
 
+def intent_nonce(resource: "ComposableResource") -> str:
+    """The durable intent nonce riding the resource's ``status.pending_op``
+    (PR 5's crash-consistency record) — the key that ties one fabric
+    mutation/completion-event to one logical op. '' when no intent is
+    recorded. The ONE extraction shared by every backend that forwards the
+    nonce over the wire or stamps it into events."""
+    po = resource.status.pending_op
+    return po.nonce if po is not None else ""
+
+
 def classify_fabric_error(cause: Exception, message: str) -> FabricError:
     """Re-wrap a fabric exception under a new message WITHOUT losing its
     transient/terminal classification (providers add call context like
@@ -80,6 +90,13 @@ class UnsupportedBatch(FabricError):
     """The provider has no group attach/detach verb. The FabricDispatcher
     catches this once and falls back to transparent per-item calls — it is
     a capability probe, never an operational failure."""
+
+
+class UnsupportedEvents(FabricError):
+    """The provider has no server-push event stream (``poll_events``). The
+    FabricSession probes once and goes dormant for the process lifetime;
+    the dispatcher's poll timers remain the PRIMARY completion path — a
+    capability probe like UnsupportedBatch, never a failure."""
 
 
 class DispatchedAttaching(WaitingDeviceAttaching):
@@ -201,6 +218,32 @@ class FabricProvider(abc.ABC):
         split retry."""
         raise UnsupportedBatch(
             f"{type(self).__name__} has no group detach verb"
+        )
+
+    # -- event plane (server-push completions; optional) ----------------
+    def poll_events(self, cursor: int, timeout: float = 5.0):
+        """Long-poll the fabric's sequence-numbered event stream.
+
+        Returns ``(events, next_cursor)`` where ``events`` is a list of
+        :class:`tpu_composer.fabric.events.FabricEvent` with ``seq >
+        cursor`` (empty after ``timeout`` seconds of silence) and
+        ``next_cursor`` is the highest sequence number the caller should
+        resume from. ``cursor = -1`` tails: the provider returns no
+        backlog, only its current head sequence number — a fresh session
+        must not replay completions whose ops already settled by polling.
+
+        Events carry op completions (keyed by the durable intent nonce the
+        submitting controller wrote into ``status.pending_op``), device
+        health transitions and inventory deltas. Consumers treat them as
+        doorbells and re-read authoritative state through the idempotent
+        verbs — a provider may therefore emit conservatively (extra events
+        are one redundant wire call, missing events are caught by the
+        safety-net polls).
+
+        The default raises :class:`UnsupportedEvents`; providers without a
+        stream keep today's poll-driven completion path bit-identically."""
+        raise UnsupportedEvents(
+            f"{type(self).__name__} has no event stream"
         )
 
     # -- slice transactions (TPU addition; default no-ops for gpu compat) --

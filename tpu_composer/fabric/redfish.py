@@ -12,11 +12,17 @@ Wire API (Redfish-style):
     GET    /redfish/v1/Systems                        Members list
     GET    /redfish/v1/Systems/{node}                 system + accelerators
     PATCH  /redfish/v1/Systems/{node}                 {"Accelerators": {"Add"|"Remove": ...}}
+    PATCH  /redfish/v1/Systems/{node}                 {"Accelerators": {"AddMembers"|"RemoveMembers": [...]}}
+
+The member-batch PATCH carries a whole per-node wave in ONE composition
+request and answers per-member outcome records (``Results``), closing the
+gap where this backend silently rode the dispatcher's UnsupportedBatch
+per-item fallback — N accelerators on one host cost N PATCHes.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from tpu_composer.api.types import ComposableResource
 from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient, fabric_timeout
@@ -26,9 +32,12 @@ from tpu_composer.fabric.provider import (
     FabricDevice,
     FabricError,
     FabricProvider,
+    TransientFabricError,
+    UnsupportedBatch,
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
     classify_fabric_error,
+    intent_nonce,
 )
 from tpu_composer.fabric.token import TokenCache
 
@@ -47,6 +56,13 @@ class RedfishClient(FabricProvider):
         self._http = JsonHttpClient(
             endpoint.rstrip("/") + "/redfish/v1", token_cache=token_cache, timeout=timeout
         )
+        # Member-batch capability memory: services without the batch PATCH
+        # shape typically reject it with 400, so 400 maps to
+        # UnsupportedBatch — but only until the FIRST successful batch has
+        # proven the shape is understood. After that a 400 is a real
+        # (semantic) whole-call failure: the dispatcher split-retries that
+        # wave per-item WITHOUT permanently latching batching off.
+        self._member_batch_ok = False
 
     def add_resource(self, resource: ComposableResource) -> AttachResult:
         name = resource.metadata.name
@@ -104,6 +120,98 @@ class RedfishClient(FabricProvider):
         if status == 202:
             raise WaitingDeviceDetaching(f"{name}: decomposition task accepted")
 
+    # -- group verbs (one PATCH per per-node wave) ------------------------
+    def add_resources(self, resources: List[ComposableResource]) -> List[object]:
+        return self._batch("Add", resources)
+
+    def remove_resources(self, resources: List[ComposableResource]) -> List[object]:
+        return self._batch("Remove", resources)
+
+    def _batch(self, action: str, resources: List[ComposableResource]) -> List[object]:
+        """Member-batch composition PATCH: per-member outcome records come
+        back in ``Results`` so one bad accelerator degrades one member.
+        A service without the member-batch shape (405/501, or a 400 shape
+        rejection) surfaces as UnsupportedBatch — the dispatcher probes
+        once and falls back to transparent per-item PATCHes; a transport
+        fault raises whole-call and triggers member-by-member split retry."""
+        if not resources:
+            return []
+        node = resources[0].spec.target_node
+        members: List[Dict[str, object]] = []
+        for r in resources:
+            if action == "Add":
+                member: Dict[str, object] = {
+                    "Resource": r.metadata.name,
+                    "Model": r.spec.model,
+                    "Count": r.spec.chip_count,
+                    "Slice": r.spec.slice_name,
+                    "WorkerId": r.spec.worker_id,
+                }
+            else:
+                member = {
+                    "Resource": r.metadata.name,
+                    "DeviceIds": list(r.status.device_ids),
+                }
+            nonce = intent_nonce(r)
+            if nonce:
+                member["Nonce"] = nonce
+            members.append(member)
+        try:
+            _, payload = self._http.request(
+                "PATCH", f"/Systems/{node}",
+                {"Accelerators": {f"{action}Members": members}},
+            )
+        except HttpStatusError as e:
+            if e.code in (405, 501) or (
+                e.code == 400 and not self._member_batch_ok
+            ):
+                raise UnsupportedBatch(
+                    f"redfish service has no member-batch PATCH ({e.code})"
+                ) from None
+            if e.code == 404 and action == "Remove":
+                # System gone: every member's detach is an idempotent no-op
+                # (single-verb parity).
+                return [None] * len(resources)
+            raise classify_fabric_error(e, f"batch {action} {node}: {e}") from e
+        self._member_batch_ok = True
+        results = {
+            rec.get("Resource"): rec
+            for rec in payload.get("Results", [])
+            if isinstance(rec, dict)
+        }
+        return [
+            self._member_outcome(action, r.metadata.name,
+                                 results.get(r.metadata.name))
+            for r in resources
+        ]
+
+    @staticmethod
+    def _member_outcome(action: str, name: str, rec: Optional[dict]) -> object:
+        if rec is None:
+            # Silently dropped member: retryable — the dispatcher's next
+            # pass re-submits it individually.
+            return TransientFabricError(
+                f"batch {action} {name}: service returned no result record"
+            )
+        if rec.get("Error"):
+            cls = TransientFabricError if rec.get("Transient") else FabricError
+            return cls(f"{action.lower()} {name}: {rec['Error']}")
+        state = str(rec.get("State", "")).lower()
+        if state == "attaching":
+            return WaitingDeviceAttaching(f"{name}: composition task accepted")
+        if state == "detaching":
+            return WaitingDeviceDetaching(f"{name}: decomposition task accepted")
+        if action == "Remove":
+            return None
+        ids = list(rec.get("DeviceIds", []))
+        if not ids:
+            return FabricError(
+                f"attach {name}: result record carries no device ids"
+            )
+        return AttachResult(
+            device_ids=ids, cdi_device_id=rec.get("CDIDeviceId", "")
+        )
+
     def check_resource(self, resource: ComposableResource) -> DeviceHealth:
         name = resource.metadata.name
         blocks = self._find_blocks(resource.spec.target_node, name)
@@ -142,6 +250,12 @@ class RedfishClient(FabricProvider):
                                 state=b.get("Status", {}).get("Health", "OK"),
                                 detail=b.get("Status", {}).get("Detail", ""),
                             ),
+                            # Listing fidelity (conformance: owner
+                            # attribution): blocks are labeled with the
+                            # attaching resource, so adoption/syncer get
+                            # exact ownership instead of "".
+                            type=b.get("Type", ""),
+                            resource_name=b.get("Resource", ""),
                         )
                     )
         return out

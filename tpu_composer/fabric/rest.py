@@ -33,6 +33,7 @@ import os
 from typing import Dict, List, Optional
 
 from tpu_composer.api.types import ComposableResource
+from tpu_composer.fabric.events import FabricEvent
 from tpu_composer.fabric.httpx import HttpStatusError, JsonHttpClient, fabric_timeout
 from tpu_composer.fabric.poolapi import PoolApiMixin
 from tpu_composer.fabric.provider import (
@@ -41,9 +42,11 @@ from tpu_composer.fabric.provider import (
     FabricProvider,
     TransientFabricError,
     UnsupportedBatch,
+    UnsupportedEvents,
     WaitingDeviceAttaching,
     WaitingDeviceDetaching,
     classify_fabric_error,
+    intent_nonce as _intent_nonce,
 )
 from tpu_composer.fabric.token import TokenCache
 
@@ -92,6 +95,12 @@ class RestPoolClient(PoolApiMixin, FabricProvider):
             body["slice"] = spec.slice_name
             body["worker_id"] = spec.worker_id
             body["topology"] = spec.topology
+        # The durable intent nonce rides the mutation so the pool's
+        # op_completed event (GET /v1/events) can key the completion back
+        # to this exact logical op.
+        nonce = _intent_nonce(resource)
+        if nonce:
+            body["nonce"] = nonce
         try:
             status, payload = self._http.request(
                 "PUT", f"/attachments/{name}" + self._wait_qs(), body
@@ -119,6 +128,10 @@ class RestPoolClient(PoolApiMixin, FabricProvider):
             if resource.status.device_ids
             else None
         )
+        nonce = _intent_nonce(resource)
+        if nonce:
+            body = dict(body or {})
+            body["nonce"] = nonce
         try:
             status, payload = self._http.request(
                 "DELETE", f"/attachments/{name}" + self._wait_qs(), body
@@ -170,6 +183,9 @@ class RestPoolClient(PoolApiMixin, FabricProvider):
                     "name": r.metadata.name,
                     "device_ids": list(r.status.device_ids),
                 }
+            nonce = _intent_nonce(r)
+            if nonce:
+                item["nonce"] = nonce
             items.append(item)
         try:
             _, payload = self._http.request(
@@ -216,6 +232,40 @@ class RestPoolClient(PoolApiMixin, FabricProvider):
         return AttachResult(
             device_ids=device_ids, cdi_device_id=rec.get("cdi_device_id", "")
         )
+
+    # -- event plane (fabric event session) -------------------------------
+    # One persistent subscription per endpoint:
+    #
+    #     GET /v1/events?cursor=N&timeout=T
+    #
+    # long-polls the pool service's sequence-numbered event stream and
+    # answers {"events": [...], "cursor": M} — a batch of everything past
+    # the resume cursor, or an empty batch after T seconds of silence (the
+    # FabricSession immediately re-polls, so the connection is logically
+    # persistent). A pool service without the route (404/405/501) surfaces
+    # as UnsupportedEvents: the session goes dormant and the dispatcher's
+    # poll timers stay primary.
+    def poll_events(self, cursor: int, timeout: float = 5.0):
+        try:
+            _, payload = self._http.request(
+                "GET", f"/events?cursor={int(cursor)}&timeout={timeout:g}"
+            )
+        except HttpStatusError as e:
+            if e.code in (404, 405, 501):
+                raise UnsupportedEvents(
+                    f"pool service has no event stream ({e.code})"
+                ) from None
+            raise classify_fabric_error(e, f"poll_events: {e}") from e
+        events = [
+            FabricEvent.from_wire(d)
+            for d in payload.get("events", [])
+            if isinstance(d, dict)
+        ]
+        try:
+            next_cursor = int(payload.get("cursor", cursor))
+        except (TypeError, ValueError):
+            next_cursor = cursor
+        return events, next_cursor
 
     def _wait_qs(self) -> str:
         return "?wait=true" if self.synchronous else ""

@@ -294,6 +294,33 @@ fabric_reads_coalesced_total = global_registry.counter(
     " (no provider call; staleness bounded by the batch window)",
 )
 
+#: Fabric event plane (fabric/events.py): server-push op completions over
+#: a persistent session, with the poll timers demoted to safety nets.
+fabric_events_total = global_registry.counter(
+    "tpuc_fabric_events_total",
+    "Server-push fabric events processed by the session, by type"
+    " (op_completed | health | inventory | stale = duplicate/out-of-order"
+    " drop | gap = sequence gap detected)",
+)
+fabric_poll_fallbacks_total = global_registry.counter(
+    "tpuc_fabric_poll_fallbacks_total",
+    "Fabric-pending ops settled by the safety-net poll pass that the event"
+    " stream should have completed, by verb (steady nonzero growth while"
+    " the session reports streaming = events are being missed; climbing"
+    " with the session down = degraded to polling — see OPERATIONS.md)",
+)
+fabric_session_state = global_registry.gauge(
+    "tpuc_fabric_session_state",
+    "Fabric event session state per endpoint (1 = streaming, 0 ="
+    " down/reconnecting, -1 = provider has no event stream; series absent"
+    " = event plane disabled)",
+)
+fabric_event_resyncs_total = global_registry.counter(
+    "tpuc_fabric_event_resyncs_total",
+    "get_resources resyncs triggered by event-stream sequence gaps (one"
+    " per detected gap — the bounded-cost alternative to silent loss)",
+)
+
 #: Crash consistency (durable intent + cold-start adoption + drain).
 adoption_ops_total = global_registry.counter(
     "tpuc_adoption_ops_total",
