@@ -4,7 +4,7 @@
 PYTHON ?= python
 IMG ?= tpu-composer:latest
 
-.PHONY: all test test-fast bench manifests native lint run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak conformance
+.PHONY: all test test-fast bench bench-round manifests native lint run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak conformance
 
 all: native test
 
@@ -28,14 +28,27 @@ test-fast:
 bench:
 	$(PYTHON) bench.py
 
+## bench-round: full end-to-end bench writing the committed round
+## artifact BENCH_$(ROUND).json (headline JSON line incl. event_plane,
+## shard_scaling and the hot-spot report; the uncapped record lands in
+## bench_artifacts/bench_full.json as always). Bump ROUND per round:
+## ROUND=r07 make bench-round
+ROUND ?= r06
+bench-round:
+	$(PYTHON) bench.py | tail -n 1 > BENCH_$(ROUND).json
+	@$(PYTHON) -c "import json; d=json.load(open('BENCH_$(ROUND).json')); print('BENCH_$(ROUND).json:', d['metric'], d['value'], d['unit'])"
+
 ## perf-smoke: fast CI gate — count-based assertions (cache-on vs
 ## cache-off store round trips per attach through the cluster path, and a
 ## batched vs unbatched 8-child same-node fabric wave that must issue
-## strictly fewer attach/detach provider calls), one bounded wall-time
-## guard (causal tracing must add <5% (+50 ms jitter allowance) to the
-## 32-chip wave vs TPUC_TRACE=0, best-of-3), plus the event-plane floor
-## check: poll-driven completion p50 >= poll_interval by construction,
-## event-driven strictly under it with zero safety-net fallbacks
+## strictly fewer attach/detach provider calls), two bounded wall-time
+## guards (causal tracing must add <5% (+50 ms jitter allowance) to the
+## 32-chip wave vs TPUC_TRACE=0, best-of-3; the observatory — always-on
+## sampling profiler + lock wait/hold observation + SLO evaluation — must
+## add <5% to the same wave vs TPUC_PROFILE=0), plus the event-plane
+## floor check: poll-driven completion p50 >= poll_interval by
+## construction, event-driven strictly under it with zero safety-net
+## fallbacks
 perf-smoke:
 	$(PYTHON) -c "import bench; bench.perf_smoke()"
 

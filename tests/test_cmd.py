@@ -106,6 +106,58 @@ class TestBuildManager:
         finally:
             mgr.stop()
 
+    def test_observatory_default_and_escape_hatch(self, monkeypatch, tmp_path):
+        """Default wiring builds the observatory (sampling profiler + SLO
+        engine with the flag-configured knobs); TPUC_PROFILE=0 (or
+        --no-profile) constructs neither and disables the lock-contention
+        observations with the same knob."""
+        monkeypatch.setenv("CDI_PROVIDER_TYPE", "MOCK")
+        monkeypatch.delenv("NODE_AGENT", raising=False)
+        from tpu_composer.fabric.adapter import reset_shared_mock
+        from tpu_composer.runtime import contention, profiler
+
+        reset_shared_mock()
+        args = build_parser().parse_args([
+            "--state-dir", str(tmp_path / "s1"),
+            "--profile-interval", "0.02",
+            "--slo-attach-p99", "7.5",
+            "--slo-queue-p99", "0",
+            "--slo-burn-threshold", "3.0",
+        ])
+        assert args.profile is True
+        mgr = build_manager(args)
+        try:
+            assert mgr.profiler is not None
+            assert mgr.profiler.interval == 0.02
+            assert mgr.slo_engine is not None
+            assert mgr.slo_engine.burn_threshold == 3.0
+            by_name = {o.name: o for o in mgr.slo_engine.objectives}
+            assert by_name["attach_p99"].threshold_s == 7.5
+            assert "queue_wait_p99" not in by_name  # 0 disables
+            assert mgr.slo_engine.recorder is mgr.recorder
+        finally:
+            mgr.stop()
+
+        monkeypatch.setenv("TPUC_PROFILE", "0")
+        reset_shared_mock()
+        args = build_parser().parse_args(["--state-dir", str(tmp_path / "s2")])
+        assert args.profile is False
+        try:
+            # build_manager flips the GLOBAL observatory knobs off; the
+            # outer finally restores them even if construction raises, so
+            # a wiring regression here can't cascade into later tests.
+            mgr = build_manager(args)
+            try:
+                assert mgr.profiler is None
+                assert mgr.slo_engine is None
+                assert not profiler.enabled()
+                assert not contention.enabled()
+            finally:
+                mgr.stop()
+        finally:
+            profiler.set_enabled(True)
+            contention.set_enabled(True)
+
     def test_fabric_events_default_and_escape_hatch(self, monkeypatch, tmp_path):
         """Default wiring attaches a FabricSession to the dispatcher (and
         runs it as a manager runnable); TPUC_FABRIC_EVENTS=0 (or

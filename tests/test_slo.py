@@ -1,0 +1,349 @@
+"""SLO engine: quantile math, multi-window burn rates, and the brownout
+acceptance spine for ISSUE 11.
+
+Tier-1 acceptance: a ChaosFabricProvider brownout stalling the attach path
+trips the attach-to-ready SLO burn alert — SloBreached Event emitted and
+``tpuc_slo_breached{slo="attach_p99"}`` set — and the alert clears after
+recovery; and the SLO fires while the repair breaker is still closed (the
+alert is the EARLY signal, the breaker the containment backstop).
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.types import REQUEST_STATE_RUNNING
+from tpu_composer.controllers.request_controller import (
+    ComposabilityRequestReconciler,
+    RepairConfig,
+    RequestTiming,
+)
+from tpu_composer.controllers.resource_controller import (
+    ComposableResourceReconciler,
+    ResourceTiming,
+)
+from tpu_composer.fabric.chaos import ChaosFabricProvider
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.fabric.provider import FabricError
+from tpu_composer.runtime.events import EventRecorder
+from tpu_composer.runtime.metrics import (
+    Histogram,
+    attach_to_ready_seconds,
+    repair_breaker_open,
+    slo_breached,
+    slo_burn_rate,
+)
+from tpu_composer.runtime.slo import Objective, SloEngine, default_objectives
+from tpu_composer.runtime.store import Store
+
+MODEL = "tpu-v4"
+
+
+# ---------------------------------------------------------------------------
+# Histogram quantiles — the SLO engine's substrate
+# ---------------------------------------------------------------------------
+
+class TestHistogramPercentile:
+    def test_empty_series_returns_none_not_a_boundary(self):
+        h = Histogram("t_slo_empty")
+        assert h.percentile(0.5) is None
+        assert h.percentile(0.99, op="x") is None
+
+    def test_exact_path_while_samples_complete(self):
+        h = Histogram("t_slo_exact")
+        for v in (0.1, 0.2, 0.3, 0.4, 0.5):
+            h.observe(v)
+        assert h.percentile(0.5) == 0.3
+        assert h.percentile(1.0) == 0.5
+
+    def test_bucket_interpolation_after_sample_eviction(self):
+        # Force the bounded sample ring to evict so percentile must fall
+        # back to bucket counts — the answer must interpolate INSIDE the
+        # target bucket, not return its upper bound.
+        h = Histogram("t_slo_interp", buckets=(0.1, 0.2, 0.4, 0.8))
+        h._max_samples = 4
+        h._samples.clear()
+        for _ in range(100):
+            h.observe(0.15)  # all land in the (0.1, 0.2] bucket
+        p50 = h.percentile(0.5)
+        assert p50 is not None
+        assert 0.1 < p50 < 0.2, p50  # interpolated, not the 0.2 boundary
+        # Uniform mass across one bucket: p50 ~ midpoint.
+        assert abs(p50 - 0.15) < 0.011, p50
+
+    def test_count_le_interpolates_within_bucket(self):
+        h = Histogram("t_slo_countle", buckets=(0.1, 0.2, 0.4))
+        for _ in range(10):
+            h.observe(0.15)
+        for _ in range(10):
+            h.observe(0.3)
+        assert h.total_count() == 20
+        # 0.2 covers the whole first occupied bucket.
+        assert h.total_count_le(0.2) == 10
+        # 0.3 is halfway through (0.2, 0.4]: 10 + 10*0.5.
+        assert abs(h.total_count_le(0.3) - 15.0) < 1e-9
+        # Overflow-bucket observations never count as <= a finite value.
+        h.observe(99.0)
+        assert h.total_count_le(0.4) == 20
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate math (driven with an injected clock)
+# ---------------------------------------------------------------------------
+
+def _engine(h, threshold=0.1, target=0.9, **kw):
+    kw.setdefault("fast_window", 30.0)
+    kw.setdefault("slow_window", 300.0)
+    kw.setdefault("burn_threshold", 2.0)
+    return SloEngine(
+        objectives=[Objective("obj", h, threshold, target)], **kw
+    )
+
+
+class TestBurnRate:
+    def test_no_traffic_means_zero_burn(self):
+        h = Histogram("t_burn_idle")
+        eng = _engine(h)
+        eng.evaluate(now=0.0)
+        eng.evaluate(now=10.0)
+        assert eng.burn_rates("obj") == (0.0, 0.0)
+        assert not eng.breached("obj")
+
+    def test_fast_window_trips_before_slow(self):
+        h = Histogram("t_burn_fastfirst")
+        eng = _engine(h)
+        # A long good history fills the slow window...
+        for t in range(0, 280, 10):
+            for _ in range(10):
+                h.observe(0.01)
+            eng.evaluate(now=float(t))
+        # ...then a burst of bad: the fast window (only bad inside it)
+        # saturates while the slow window is still diluted by history.
+        for _ in range(20):
+            h.observe(1.0)
+        eng.evaluate(now=290.0)
+        fast, slow = eng.burn_rates("obj")
+        assert fast >= eng.burn_threshold, (fast, slow)
+        assert slow < eng.burn_threshold, (fast, slow)
+        # Multi-window AND: not breached yet — a blip must not page.
+        assert not eng.breached("obj")
+        assert slo_breached.value(slo="obj") == 0.0
+        # Sustained badness saturates the slow window too -> breach.
+        t = 290.0
+        while not eng.breached("obj") and t < 600.0:
+            t += 10.0
+            for _ in range(20):
+                h.observe(1.0)
+            eng.evaluate(now=t)
+        assert eng.breached("obj"), eng.burn_rates("obj")
+        assert slo_breached.value(slo="obj") == 1.0
+        assert slo_burn_rate.value(slo="obj", window="fast") >= 2.0
+
+    def test_recovery_clears_via_the_fast_window(self):
+        h = Histogram("t_burn_recover")
+        recorder = EventRecorder()
+        eng = _engine(h, recorder=recorder)
+        eng.evaluate(now=0.0)
+        for _ in range(50):
+            h.observe(1.0)
+        eng.evaluate(now=10.0)
+        assert eng.breached("obj")
+        breach_evs = [e for e in recorder.all() if e.reason == "SloBreached"]
+        assert len(breach_evs) == 1 and e_kind(breach_evs[0]) == "SLO"
+        # Good traffic + the bad burst aging out of the fast window.
+        for t in (20.0, 30.0, 41.0, 50.0):
+            for _ in range(30):
+                h.observe(0.01)
+            eng.evaluate(now=t)
+        assert not eng.breached("obj"), eng.burn_rates("obj")
+        assert slo_breached.value(slo="obj") == 0.0
+        assert any(e.reason == "SloRecovered" for e in recorder.all())
+
+    def test_defaults_cover_the_four_objectives(self):
+        names = {o.name for o in default_objectives()}
+        assert names == {
+            "attach_p99", "completion_p50", "queue_wait_p99", "repair_p99"
+        }
+        # Per-objective off switch: a <=0 threshold drops it.
+        assert {o.name for o in default_objectives(queue_p99_s=0)} == {
+            "attach_p99", "completion_p50", "repair_p99"
+        }
+
+
+def e_kind(ev):
+    return ev.kind
+
+
+# ---------------------------------------------------------------------------
+# Brownout acceptance: chaos stalls attaches -> attach SLO burns -> clears
+# ---------------------------------------------------------------------------
+
+def make_world(nodes=4):
+    store = Store()
+    for i in range(nodes):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 8
+        store.create(n)
+    pool = InMemoryPool(chips={MODEL: 64})
+    chaos = ChaosFabricProvider(pool)
+    agent = FakeNodeAgent(pool=pool)
+    req_rec = ComposabilityRequestReconciler(
+        store, chaos,
+        timing=RequestTiming(updating_poll=0.01, cleaning_poll=0.01,
+                             running_poll=5.0, repair_poll=0.01),
+        repair=RepairConfig(),
+    )
+    res_rec = ComposableResourceReconciler(
+        store, chaos, agent,
+        timing=ResourceTiming(health_failure_threshold=2,
+                              health_recovery_threshold=1),
+    )
+    return store, pool, chaos, req_rec, res_rec
+
+
+def pump(store, req_rec, res_rec, names, steps=80, done=None):
+    for _ in range(steps):
+        for name in names:
+            try:
+                req_rec.reconcile(name)
+            except FabricError:
+                pass
+        for c in store.list(ComposableResource):
+            try:
+                res_rec.reconcile(c.metadata.name)
+            except FabricError:
+                pass
+        if done is not None and done():
+            return
+
+
+def running(store, name):
+    req = store.try_get(ComposabilityRequest, name)
+    return req is not None and req.status.state == REQUEST_STATE_RUNNING
+
+
+def attach_batch(store, req_rec, res_rec, names, size=4):
+    for name in names:
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name=name),
+            spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                type="tpu", model=MODEL, size=size)),
+        ))
+    pump(store, req_rec, res_rec, names,
+         done=lambda: all(running(store, n) for n in names))
+    for name in names:
+        assert running(store, name), name
+        store.delete(ComposabilityRequest, name)
+    pump(store, req_rec, res_rec, names, steps=120,
+         done=lambda: all(
+             store.try_get(ComposabilityRequest, n) is None for n in names
+         ))
+
+
+class TestBrownoutSlo:
+    def test_brownout_trips_attach_slo_and_clears_on_recovery(self):
+        store, pool, chaos, req_rec, res_rec = make_world()
+        recorder = req_rec.recorder
+        eng = SloEngine(
+            objectives=[Objective(
+                "attach_p99", attach_to_ready_seconds, 0.1, 0.90,
+                "attach-to-ready under brownout",
+            )],
+            recorder=recorder,
+            fast_window=30.0, slow_window=120.0, burn_threshold=2.0,
+        )
+        # Healthy baseline: fast attaches, well under the 150 ms objective.
+        eng.evaluate(now=0.0)
+        attach_batch(store, req_rec, res_rec, ["ok-1", "ok-2"])
+        eng.evaluate(now=10.0)
+        assert not eng.breached("attach_p99")
+
+        # Brownout: the fabric endpoint goes dark mid-attach. The requests
+        # stall (every provider call raises) until the brownout lifts, so
+        # their eventual attach-to-ready latency carries the outage.
+        chaos.blackout()
+        for name in ("slow-1", "slow-2"):
+            store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name=name),
+                spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                    type="tpu", model=MODEL, size=4)),
+            ))
+        pump(store, req_rec, res_rec, ["slow-1", "slow-2"], steps=5,
+             done=lambda: False)
+        time.sleep(0.2)  # the outage is what the latency histogram records
+        chaos.heal()
+        pump(store, req_rec, res_rec, ["slow-1", "slow-2"],
+             done=lambda: running(store, "slow-1") and running(store, "slow-2"))
+        eng.evaluate(now=20.0)
+        assert eng.breached("attach_p99"), eng.burn_rates("attach_p99")
+        assert slo_breached.value(slo="attach_p99") == 1.0
+        evs = [e for e in recorder.all() if e.reason == "SloBreached"]
+        assert evs and evs[0].kind == "SLO" and evs[0].name == "attach_p99"
+
+        # Recovery: healthy attaches while the bad burst ages out of the
+        # fast window -> the alert clears and says so.
+        for n2 in ("slow-1", "slow-2"):
+            store.delete(ComposabilityRequest, n2)
+        pump(store, req_rec, res_rec, ["slow-1", "slow-2"], steps=120,
+             done=lambda: all(
+                 store.try_get(ComposabilityRequest, n) is None
+                 for n in ("slow-1", "slow-2")))
+        attach_batch(store, req_rec, res_rec, ["ok-3", "ok-4"])
+        eng.evaluate(now=60.0)  # past the fast window's reach of the burst
+        assert not eng.breached("attach_p99"), eng.burn_rates("attach_p99")
+        assert slo_breached.value(slo="attach_p99") == 0.0
+        assert any(e.reason == "SloRecovered" for e in recorder.all())
+
+    def test_brownout_slo_fires_before_repair_breaker_opens(self):
+        # The ordering that makes the SLO the EARLY warning: one node's
+        # brownout slows attaches enough to burn the attach objective
+        # while the degraded fraction is still below the repair breaker's
+        # threshold (breaker needs >50% of >=4 attached members bad).
+        store, pool, chaos, req_rec, res_rec = make_world(nodes=8)
+        eng = SloEngine(
+            objectives=[Objective(
+                "attach_p99", attach_to_ready_seconds, 0.1, 0.90,
+            )],
+            fast_window=30.0, slow_window=120.0, burn_threshold=2.0,
+        )
+        repair_breaker_open.set(0.0)
+        # An established healthy request keeps the breaker's denominator
+        # populated (4 Online members).
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="steady"),
+            spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                type="tpu", model=MODEL, size=16)),
+        ))
+        pump(store, req_rec, res_rec, ["steady"],
+             done=lambda: running(store, "steady"))
+        assert running(store, "steady")
+        eng.evaluate(now=0.0)
+
+        # Brownout stalls NEW attaches (scoped: the endpoint blacks out,
+        # no post-Ready member death — the breaker has nothing to open
+        # for). The attach SLO burns first.
+        chaos.blackout()
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="late"),
+            spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                type="tpu", model=MODEL, size=4)),
+        ))
+        pump(store, req_rec, res_rec, ["late"], steps=5, done=lambda: False)
+        time.sleep(0.2)
+        chaos.heal()
+        pump(store, req_rec, res_rec, ["late", "steady"],
+             done=lambda: running(store, "late"))
+        eng.evaluate(now=10.0)
+        assert eng.breached("attach_p99"), eng.burn_rates("attach_p99")
+        # ...and at that moment the repair breaker never opened: the SLO
+        # alert led, the containment backstop stayed closed.
+        assert repair_breaker_open.value() == 0.0

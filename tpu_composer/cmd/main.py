@@ -34,6 +34,17 @@ SURVEY.md §5 "Config / flag system"):
   TPUC_CHAOS_STORE_*  store-layer fault injection (FAILURE_RATE,
                       CONFLICT_RATE, LATENCY, WATCH_DROP_RATE, SEED) —
                       the apiserver twin of the fabric chaos knobs
+  TPUC_PROFILE        "0" disables the control-plane observatory
+                      (--no-profile): the always-on sampling profiler,
+                      lock-contention histograms AND SLO evaluation
+  TPUC_PROFILE_INTERVAL / TPUC_PROFILE_WINDOW
+                      sampler tick / continuous-window size, seconds
+  TPUC_PROFILE_FILE / TPUC_SLO_FILE
+                      crash-hook dump destinations for the continuous-
+                      profile ring and the /debug/slo snapshot
+  TPUC_SLO_*          objective thresholds and burn-rate windows
+                      (ATTACH_P99, COMPLETION_P50, QUEUE_P99, REPAIR_P99,
+                      FAST_WINDOW, SLOW_WINDOW, BURN_THRESHOLD)
   TPUC_TRACE          "0" disables causal tracing entirely (--no-trace)
   TPUC_TRACE_EVENTS   trace ring capacity in events (--trace-events)
   TPUC_TRACE_FILE     write the Chrome trace ring here at stop AND on
@@ -357,6 +368,97 @@ def build_parser() -> argparse.ArgumentParser:
              " drain-timeout and from the crash hooks"
              " (env TPUC_FLIGHT_FILE; empty disables the dump)",
     )
+    # Control-plane observatory (runtime/profiler.py + runtime/contention
+    # + runtime/slo.py): always-on sampling profiler with per-subsystem
+    # GIL-wait estimates, lock-contention histograms, and the SLO engine
+    # with multi-window burn-rate alerts. One knob gates all three.
+    p.add_argument(
+        "--profile",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_PROFILE", "1") != "0",
+        help="run the control-plane observatory: the always-on stack"
+             " sampler (/debug/profile/continuous), lock wait/hold"
+             " histograms on the hot locks, and SLO burn-rate evaluation"
+             " (/debug/slo). --no-profile or TPUC_PROFILE=0 disables all"
+             " three — the perf-smoke gate holds the enabled path within"
+             " 5%% of this on the 32-chip wave. The on-demand"
+             " /debug/profile burst endpoint works either way",
+    )
+    p.add_argument(
+        "--profile-interval",
+        type=float,
+        default=_env_seconds("TPUC_PROFILE_INTERVAL", 0.05),
+        help="always-on sampler tick, seconds (env TPUC_PROFILE_INTERVAL)",
+    )
+    p.add_argument(
+        "--profile-window",
+        type=float,
+        default=_env_seconds("TPUC_PROFILE_WINDOW", 10.0),
+        help="seconds per continuous-profile window; the ring keeps the"
+             " most recent 30 windows (env TPUC_PROFILE_WINDOW)",
+    )
+    p.add_argument(
+        "--profile-file",
+        default=os.environ.get("TPUC_PROFILE_FILE", ""),
+        help="write the continuous-profile ring here from the crash hooks"
+             " (the soak failure artifact; env TPUC_PROFILE_FILE)",
+    )
+    p.add_argument(
+        "--slo-attach-p99",
+        type=float,
+        default=_env_seconds("TPUC_SLO_ATTACH_P99", 5.0),
+        help="attach-to-ready p99 objective, seconds (<= 0 disables this"
+             " objective; env TPUC_SLO_ATTACH_P99)",
+    )
+    p.add_argument(
+        "--slo-completion-p50",
+        type=float,
+        default=_env_seconds("TPUC_SLO_COMPLETION_P50", 1.0),
+        help="fabric completion-notification p50 objective, seconds"
+             " (env TPUC_SLO_COMPLETION_P50)",
+    )
+    p.add_argument(
+        "--slo-queue-p99",
+        type=float,
+        default=_env_seconds("TPUC_SLO_QUEUE_P99", 1.0),
+        help="work-queue wait p99 objective, seconds"
+             " (env TPUC_SLO_QUEUE_P99)",
+    )
+    p.add_argument(
+        "--slo-repair-p99",
+        type=float,
+        default=_env_seconds("TPUC_SLO_REPAIR_P99", 120.0),
+        help="self-healing time-to-replace p99 objective, seconds"
+             " (env TPUC_SLO_REPAIR_P99)",
+    )
+    p.add_argument(
+        "--slo-fast-window",
+        type=float,
+        default=_env_seconds("TPUC_SLO_FAST_WINDOW", 60.0),
+        help="fast burn-rate window, seconds — reactivity and recovery"
+             " (env TPUC_SLO_FAST_WINDOW)",
+    )
+    p.add_argument(
+        "--slo-slow-window",
+        type=float,
+        default=_env_seconds("TPUC_SLO_SLOW_WINDOW", 600.0),
+        help="slow burn-rate window, seconds — blip filtering: the alert"
+             " fires only when BOTH windows burn above the threshold"
+             " (env TPUC_SLO_SLOW_WINDOW)",
+    )
+    p.add_argument(
+        "--slo-burn-threshold",
+        type=float,
+        default=_env_float("TPUC_SLO_BURN_THRESHOLD", 2.0),
+        help="burn-rate multiple that fires the alert (1.0 = consuming"
+             " exactly the error budget; env TPUC_SLO_BURN_THRESHOLD)",
+    )
+    p.add_argument(
+        "--slo-file",
+        default=os.environ.get("TPUC_SLO_FILE", ""),
+        help="write the /debug/slo snapshot here from the crash hooks"
+             " (env TPUC_SLO_FILE)",
+    )
     # Self-healing data plane (post-Ready failure detection + repair):
     # per-request policy lives on ComposabilityRequest.spec (repairPolicy /
     # maxConcurrentRepairs / repairGraceSeconds); these are the fleet-wide
@@ -594,6 +696,17 @@ def _configure_tracing(args: argparse.Namespace) -> None:
         os.environ["TPUC_TRACE_FILE"] = args.trace_file
     if getattr(args, "flight_file", ""):
         os.environ["TPUC_FLIGHT_FILE"] = args.flight_file
+    # Observatory: one knob (--profile / TPUC_PROFILE) gates the sampler,
+    # the lock-contention observations AND the SLO engine together.
+    from tpu_composer.runtime import contention, profiler
+
+    on = getattr(args, "profile", True)
+    profiler.set_enabled(on)
+    contention.set_enabled(on)
+    if getattr(args, "profile_file", ""):
+        os.environ["TPUC_PROFILE_FILE"] = args.profile_file
+    if getattr(args, "slo_file", ""):
+        os.environ["TPUC_SLO_FILE"] = args.slo_file
 
 
 def build_manager(args: argparse.Namespace) -> Manager:
@@ -700,6 +813,27 @@ def build_manager(args: argparse.Namespace) -> Manager:
             fabric, name=os.environ.get("FABRIC_ENDPOINT", "") or "fabric"
         )
         dispatcher.attach_session(session)
+    profiler_inst = None
+    slo_engine = None
+    if getattr(args, "profile", True):
+        from tpu_composer.runtime.profiler import SamplingProfiler
+        from tpu_composer.runtime.slo import SloEngine, default_objectives
+
+        profiler_inst = SamplingProfiler(
+            interval=getattr(args, "profile_interval", 0.05),
+            window_s=getattr(args, "profile_window", 10.0),
+        )
+        slo_engine = SloEngine(
+            objectives=default_objectives(
+                attach_p99_s=getattr(args, "slo_attach_p99", 5.0),
+                completion_p50_s=getattr(args, "slo_completion_p50", 1.0),
+                queue_p99_s=getattr(args, "slo_queue_p99", 1.0),
+                repair_p99_s=getattr(args, "slo_repair_p99", 120.0),
+            ),
+            fast_window=getattr(args, "slo_fast_window", 60.0),
+            slow_window=getattr(args, "slo_slow_window", 600.0),
+            burn_threshold=getattr(args, "slo_burn_threshold", 2.0),
+        )
     mgr = Manager(
         store=client,
         leader_elect=args.leader_elect,
@@ -712,7 +846,13 @@ def build_manager(args: argparse.Namespace) -> Manager:
         metrics_token_file=args.metrics_token_file or None,
         dispatcher=dispatcher,
         drain_timeout=getattr(args, "drain_timeout", 8.0),
+        profiler=profiler_inst,
+        slo_engine=slo_engine,
     )
+    if slo_engine is not None:
+        # The engine's breach/recovery Events flow through the manager's
+        # recorder (constructed just above).
+        slo_engine.recorder = mgr.recorder
     if dispatcher is not None:
         mgr.add_runnable(dispatcher.run)
     if session is not None:

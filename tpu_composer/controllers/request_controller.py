@@ -97,6 +97,7 @@ from tpu_composer.runtime.metrics import (
     degraded_members,
     reconcile_total,
     repair_breaker_open,
+    repair_time_to_replace_seconds,
     repairs_total,
     scheduler_preemptions_total,
 )
@@ -1123,6 +1124,18 @@ class ComposabilityRequestReconciler(Controller):
                     continue  # retried next pass
             self._delete_children(req, [c])
             repairs_total.inc(outcome="replaced")
+            # Time-to-replace: from the failure record's Degraded
+            # observed_at to this detach — the SLO engine's repair_p99
+            # objective reads this histogram.
+            fr = c.status.failure
+            if fr is not None and fr.observed_at:
+                try:
+                    repair_time_to_replace_seconds.observe(
+                        (parse_iso(now_iso()) - parse_iso(fr.observed_at))
+                        .total_seconds()
+                    )
+                except ValueError:
+                    pass  # unreadable timestamp: skip the observation
             self.recorder.event(
                 req, "Normal", "RepairComplete",
                 f"member {c.name} ({c.spec.target_node}) replaced by"

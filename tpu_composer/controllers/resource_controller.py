@@ -74,6 +74,7 @@ from tpu_composer.fabric.provider import (
     classify_fabric_error,
 )
 from tpu_composer.runtime import tracing
+from tpu_composer.runtime.contention import ObservedLock
 from tpu_composer.runtime.controller import Controller, Result
 from tpu_composer.runtime.events import WARNING, EventRecorder
 from tpu_composer.runtime.shards import ShardFencedError
@@ -205,7 +206,7 @@ class ComposableResourceReconciler(Controller):
         # write that persists it happens outside, with _index_claims
         # covering the gap — holding a 10 ms apiserver write under this
         # lock serialized the whole attach wave's durability points.
-        self._index_lock = threading.Lock()
+        self._index_lock = ObservedLock("chip_index")
         # node -> resource name -> indices assigned but not yet persisted.
         # Consulted by _assign_chip_indices so a concurrently-attaching
         # co-located group can never compute an overlapping set while the

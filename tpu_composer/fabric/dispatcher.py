@@ -68,6 +68,7 @@ from tpu_composer.fabric.provider import (
     WaitingDeviceDetaching,
 )
 from tpu_composer.runtime import tracing
+from tpu_composer.runtime.contention import BusyTracker, ObservedLock
 from tpu_composer.runtime.metrics import (
     fabric_batch_size,
     fabric_calls_total,
@@ -187,7 +188,19 @@ class FabricDispatcher:
         self.fallback_multiplier = max(1.0, fallback_multiplier)
         self._session = None
         self.log = logging.getLogger("FabricDispatcher")
-        self._cond = threading.Condition()
+        # Contention telemetry: the dispatcher lock is one of the hottest
+        # in the process (every submission, settle, snapshot read and
+        # worker turn crosses it). ObservedLock records acquire-wait and
+        # hold time; Condition parks are excluded by the wrapper's
+        # _release_save/_acquire_restore protocol. Reentrant because a
+        # bare Condition() wraps an RLock and the submission facade
+        # re-enters (lazy start() under _call's hold).
+        self._cond = threading.Condition(
+            ObservedLock("dispatcher", reentrant=True)
+        )
+        # Lane saturation: busy seconds per worker turn (provider calls),
+        # level-set into tpuc_worker_busy_ratio{pool="fabric-dispatch"}.
+        self._busy = BusyTracker("fabric-dispatch", workers=self.concurrency)
         self._lanes: Dict[str, _Lane] = {}
         self._ops: Dict[Tuple[str, str], _Op] = {}  # live (queued/inflight/pending)
         self._done: Dict[Tuple[str, str], Tuple[_Op, float]] = {}
@@ -605,11 +618,21 @@ class FabricDispatcher:
                     self._sweep_done(now)
                     task, wake = self._next_task(now)
                     if task is None:
-                        self._cond.wait(timeout=wake)
+                        self._busy.add(0.0)  # idle wake advances the window
+                        # Bounded even when no work is queued: a fully
+                        # idle pool must keep feeding the busy tracker or
+                        # tpuc_worker_busy_ratio freezes at its last
+                        # (possibly saturated) value for the whole idle
+                        # stretch.
+                        self._cond.wait(
+                            timeout=wake if wake is not None else 5.0
+                        )
             lane, verb, ops = task
+            turn_t0 = time.monotonic()
             try:
                 self._execute(verb, ops)
             finally:
+                self._busy.add(time.monotonic() - turn_t0)
                 fired: List[Tuple[_Op, List[Callable[[], None]]]] = []
                 with self._cond:
                     lane.busy = False

@@ -45,6 +45,7 @@ from tpu_composer.fabric.provider import (
     WaitingDeviceDetaching,
     intent_nonce as _intent_nonce,
 )
+from tpu_composer.runtime.contention import ObservedLock
 from tpu_composer.topology.slices import is_tpu_model, solve_slice
 
 
@@ -88,7 +89,12 @@ class InMemoryPool(FabricProvider):
         # drives it. This is what a real pool manager does: the work
         # finishes whether or not anyone is polling.
         self._async_delay = async_delay
-        self._lock = threading.RLock()
+        # Contention telemetry: every attach/detach/listing serializes on
+        # this lock — the pool-side twin of the store lock. The event
+        # Condition below shares it (ObservedLock implements the RLock
+        # save/restore protocol, so long-poll parks are not counted as
+        # hold or wait time).
+        self._lock = ObservedLock("inmem_pool", reentrant=True)
         self._free: Dict[str, List[str]] = {
             model: [f"{model}-chip-{i:04d}" for i in range(n)]
             for model, n in self._chips.items()

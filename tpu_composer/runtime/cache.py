@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Set, Tuple, Type, TypeVar
 
 from tpu_composer.api.meta import ApiObject
 from tpu_composer.api.types import LABEL_MANAGED_BY
+from tpu_composer.runtime.contention import ObservedLock
 from tpu_composer.runtime.metrics import (
     cached_reads_total,
     status_writes_coalesced_total,
@@ -110,7 +111,10 @@ class _KindInformer:
     def __init__(self, store: Store, kind: str, index_keys=DEFAULT_INDEX_KEYS) -> None:
         self._store = store
         self._kind = kind
-        self._lock = threading.Lock()
+        # Contention telemetry: every cached get/list and every watch-event
+        # apply crosses this lock — the read-path hot lock
+        # (tpuc_lock_wait_seconds{lock="informer:<kind>"}).
+        self._lock = ObservedLock(f"informer:{kind}")
         self._objects: Dict[str, ApiObject] = {}
         # label_key -> label_value -> {names}
         self._index_keys = tuple(index_keys)

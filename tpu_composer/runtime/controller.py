@@ -22,10 +22,12 @@ from __future__ import annotations
 import logging
 import queue as _queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
 
 from tpu_composer.runtime import tracing
+from tpu_composer.runtime.contention import BusyTracker
 from tpu_composer.runtime.queue import RateLimitingQueue
 from tpu_composer.runtime.store import ConflictError, Store, WatchEvent
 
@@ -66,7 +68,10 @@ class Controller:
         # ownership can flip while a key sits queued); the shard's new
         # owner re-enqueues them via the manager resync hook.
         self.ownership = ownership
-        self.queue = RateLimitingQueue()
+        self.queue = RateLimitingQueue(name=self.name)
+        # Saturation telemetry: workers report per-turn busy seconds and
+        # the tracker level-sets tpuc_worker_busy_ratio{pool=<name>}.
+        self._busy = BusyTracker(self.name)
         self._watches: List[Tuple[str, Optional[EventMapper], Optional[EventPredicate]]] = []
         self._watch_queues: List = []
         self._threads: List[threading.Thread] = []
@@ -94,7 +99,8 @@ class Controller:
     def start(self, workers: int = 1) -> None:
         self._stop.clear()
         # A stopped queue never accepts again; restart gets a fresh one.
-        self.queue = RateLimitingQueue()
+        self.queue = RateLimitingQueue(name=self.name)
+        self._busy.workers = max(1, workers)
         for kind, mapper, predicate in self._watches:
             q = self.store.watch(kind)
             self._watch_queues.append(q)
@@ -168,7 +174,9 @@ class Controller:
         while not self._stop.is_set():
             key = self.queue.get(timeout=0.2)
             if key is None:
+                self._busy.add(0.0)  # idle wake still advances the window
                 continue
+            turn_t0 = time.monotonic()
             if not self._owned(key):
                 # Shard moved (or was never ours) while the key sat
                 # queued: drop it without reconciling — the shard's owner
@@ -209,3 +217,4 @@ class Controller:
                     self.queue.add_after(key, result.requeue_after)
             finally:
                 self.queue.done(key)
+                self._busy.add(time.monotonic() - turn_t0)

@@ -342,8 +342,10 @@ _crash_dumped = False
 
 
 def dump_crash(reason: str) -> None:
-    """Best-effort black-box write: flight ledger + trace ring, both
-    env-gated ($TPUC_FLIGHT_FILE / $TPUC_TRACE_FILE). Never raises."""
+    """Best-effort black-box write: flight ledger + trace ring + the
+    observatory's continuous-profile ring and SLO snapshot, all env-gated
+    ($TPUC_FLIGHT_FILE / $TPUC_TRACE_FILE / $TPUC_PROFILE_FILE /
+    $TPUC_SLO_FILE). Never raises."""
     global _crash_dumped
     if reason != "atexit":
         _crash_dumped = True
@@ -353,6 +355,22 @@ def dump_crash(reason: str) -> None:
         pass
     try:
         tracing.write_file()
+    except Exception:
+        pass
+    # Late imports: lifecycle is imported by metrics consumers everywhere;
+    # profiler/slo import metrics — importing them at module top would
+    # still be acyclic today, but the crash path should also survive a
+    # partially-imported interpreter at exit.
+    try:
+        from tpu_composer.runtime import profiler as _profiler
+
+        _profiler.dump_file()
+    except Exception:
+        pass
+    try:
+        from tpu_composer.runtime import slo as _slo
+
+        _slo.dump_file()
     except Exception:
         pass
 

@@ -20,8 +20,9 @@ from typing import Callable, List, Optional
 from tpu_composer.runtime.controller import Controller
 from tpu_composer.runtime.events import EventRecorder
 from tpu_composer.runtime.leader import LeaderElector
-from tpu_composer.runtime import lifecycle, tracing
+from tpu_composer.runtime import lifecycle, profiler as profiler_mod, tracing
 from tpu_composer.runtime.metrics import global_registry
+from tpu_composer.runtime.slo import SloEngine
 from tpu_composer.runtime.store import Store
 
 #: /debug/traces responses are capped: a 10k-event ring serializes to
@@ -30,9 +31,41 @@ from tpu_composer.runtime.store import Store
 #: dropped first (the ring's own semantics) and the response says so.
 TRACE_RESPONSE_BYTE_CAP = 2_000_000
 
+#: The /debug index: route -> one-line description. Kept here (not in a
+#: docstring) so the running process is self-describing.
+DEBUG_ENDPOINTS = {
+    "/debug/traces": "Chrome trace-event JSON of recent control-plane spans"
+                     " (?cat=&limit=; open in Perfetto)",
+    "/debug/traces/summary": "per-span-name count/total/max durations (ms)"
+                             " (?cat=)",
+    "/debug/requests": "names with recorded lifecycle timelines",
+    "/debug/requests/<name>": "one CR's timeline: phase transitions,"
+                              " events, span summaries",
+    "/debug/slo": "SLO objectives with fast/slow burn rates and breach"
+                  " state",
+    "/debug/profile": "on-demand stack profile burst"
+                      " (?seconds=&format=top|collapsed|json)",
+    "/debug/profile/continuous": "the always-on profiler's window ring:"
+                                 " per-subsystem wall/CPU/GIL estimates +"
+                                 " top frames",
+}
+
 # A runnable is the analog of manager.Add(RunnableFunc) used by the
 # UpstreamSyncer (upstreamsyncer_controller.go:52-77): start(stop_event).
 Runnable = Callable[[threading.Event], None]
+
+
+def _runnable_name(r) -> str:
+    """Stable thread name for a runnable: the owning class for bound
+    methods (FabricDispatcher.run -> 'FabricDispatcher') and callable
+    instances (UpstreamSyncer), the function name otherwise."""
+    owner = getattr(r, "__self__", None)
+    if owner is not None:
+        return type(owner).__name__
+    name = getattr(r, "__name__", "")
+    if name and name not in ("<lambda>", "run"):
+        return name
+    return type(r).__name__
 
 
 class _PlainTextHandler(http.server.BaseHTTPRequestHandler):
@@ -104,8 +137,63 @@ class _HealthHandler(_PlainTextHandler):
                 self._respond(404, f"no timeline recorded for {name!r}")
             else:
                 self._respond_json(200, json.dumps(timeline, indent=1).encode())
+        elif path in ("/debug", "/debug/"):
+            # Discoverability: every debug route with a one-line purpose —
+            # the endpoints used to exist only in OPERATIONS.md.
+            self._respond_json(200, json.dumps(
+                {"endpoints": DEBUG_ENDPOINTS}, indent=1).encode())
+        elif path == "/debug/slo":
+            eng = self.manager.slo_engine
+            if eng is None:
+                self._respond(503, "slo engine disabled (TPUC_PROFILE=0)")
+            else:
+                self._respond_json(
+                    200, json.dumps(eng.snapshot(), indent=1).encode()
+                )
+        elif path == "/debug/profile/continuous":
+            prof = self.manager.profiler
+            if prof is None:
+                self._respond(503, "profiler disabled (TPUC_PROFILE=0)")
+            else:
+                self._respond_json(200, json.dumps({
+                    "interval_s": prof.interval,
+                    "window_s": prof.window_s,
+                    "windows": prof.windows(),
+                    "summary": prof.thread_summary(),
+                }, indent=1).encode())
+        elif path == "/debug/profile":
+            # On-demand burst profile on this handler thread (explicitly
+            # requested, so it runs even under TPUC_PROFILE=0).
+            self._profile_burst(query)
         else:
             self._respond(404, "not found")
+
+    def _profile_burst(self, query) -> None:
+        from tpu_composer.runtime import profiler as _profiler
+
+        try:
+            seconds = float((query.get("seconds") or ["2"])[0])
+        except ValueError:
+            seconds = 2.0
+        seconds = max(0.1, min(30.0, seconds))
+        fmt = (query.get("format") or ["top"])[0]
+        prof = _profiler.profile_burst(seconds=seconds)
+        if fmt == "collapsed":
+            # Flamegraph-folded text: pipe into flamegraph.pl / speedscope.
+            self._respond(200, prof.collapsed())
+        elif fmt == "json":
+            self._respond_json(200, json.dumps({
+                "seconds": seconds,
+                "threads": prof.thread_summary(),
+                "top": prof.top(25),
+                "collapsed": prof.collapsed().splitlines(),
+            }, indent=1).encode())
+        else:  # top (default)
+            self._respond_json(200, json.dumps({
+                "seconds": seconds,
+                "threads": prof.thread_summary(),
+                "top": prof.top(25),
+            }, indent=1).encode())
 
     @staticmethod
     def _trace_body(query) -> bytes:
@@ -182,6 +270,8 @@ class Manager:
         metrics_token_file: Optional[str] = None,
         dispatcher=None,  # FabricDispatcher to drain at shutdown/handoff
         drain_timeout: float = 8.0,  # seconds; <= 0 disables graceful drain
+        profiler=None,  # SamplingProfiler override (None = default when enabled)
+        slo_engine=None,  # SloEngine override (None = defaults when enabled)
     ) -> None:
         # `is not None`, not `or`: an EMPTY store is falsy (Store.__len__),
         # and silently swapping in a fresh one would orphan the caller's
@@ -222,6 +312,24 @@ class Manager:
         # registered once per process; the lifecycle watch runnable below
         # feeds per-CR phase timelines from this manager's store.
         lifecycle.install()
+        # Control-plane observatory (always-on by default, TPUC_PROFILE=0
+        # escape hatch): the sampling profiler and the SLO burn-rate
+        # engine run as manager-owned threads; /debug/profile* and
+        # /debug/slo on the health port read them.
+        if profiler is not None:
+            self.profiler = profiler
+        else:
+            self.profiler = (
+                profiler_mod.SamplingProfiler()
+                if profiler_mod.enabled() else None
+            )
+        if slo_engine is not None:
+            self.slo_engine = slo_engine
+        else:
+            self.slo_engine = (
+                SloEngine(recorder=self.recorder)
+                if profiler_mod.enabled() else None
+            )
 
     def add_controller(self, controller: Controller) -> None:
         self._controllers.append(controller)
@@ -374,10 +482,34 @@ class Manager:
         t.start()
         self._threads.append(t)
 
+        # Observatory: the always-on stack sampler and the SLO burn-rate
+        # evaluator (both absent under TPUC_PROFILE=0).
+        if self.profiler is not None:
+            t = threading.Thread(
+                target=self.profiler.run, args=(self._stop,),
+                name="profiler", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        if self.slo_engine is not None:
+            t = threading.Thread(
+                target=self.slo_engine.run, args=(self._stop,),
+                name="slo-engine", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
         for c in self._controllers:
             c.start(workers=workers_per_controller)
         for r in self._runnables:
-            t = threading.Thread(target=r, args=(self._stop,), daemon=True)
+            # Named after the runnable (UpstreamSyncer, FabricDispatcher,
+            # FabricSession, ...): the profiler attributes samples by
+            # thread name, and an anonymous Thread-N would land every
+            # runnable in its 'other' bucket.
+            t = threading.Thread(
+                target=r, args=(self._stop,), daemon=True,
+                name=_runnable_name(r),
+            )
             t.start()
             self._threads.append(t)
         self._started = True

@@ -128,15 +128,83 @@ class Histogram:
         with self._lock:
             return sum(self._counts.get(key, []))
 
+    def sum(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._sums.get(key, 0.0)
+
+    def total_count(self) -> int:
+        """Observation count summed across every label set (the SLO
+        engine's traffic denominator — an objective spans all labels)."""
+        with self._lock:
+            return sum(sum(c) for c in self._counts.values())
+
+    def total_count_le(self, value: float) -> float:
+        """Observations <= ``value`` summed across every label set, with
+        linear interpolation inside the bucket containing ``value``.
+        Observations in the +Inf overflow bucket never count as <= a
+        finite value — for an SLO that conservatively counts them as bad."""
+        with self._lock:
+            counts = [list(c) for c in self._counts.values()]
+        total = 0.0
+        for c in counts:
+            total += self._interp_count_le(c, value)
+        return total
+
+    def _interp_count_le(self, counts: List[int], value: float) -> float:
+        # Operates on a COPY of one label set's bucket counts (no lock
+        # needed or held); the inverse walk of percentile's rank lookup.
+        cum = 0.0
+        prev_b = 0.0
+        for i, b in enumerate(self.buckets):
+            c = counts[i]
+            if value >= b:
+                cum += c
+                prev_b = b
+                continue
+            if value > prev_b and b > prev_b:
+                cum += c * (value - prev_b) / (b - prev_b)
+            return cum
+        return cum
+
     def percentile(self, q: float, **labels: str) -> Optional[float]:
-        """Exact percentile from retained samples (bench convenience)."""
+        """Quantile for one label set; ``None`` for an empty series (never
+        a bucket boundary standing in for no data — the SLO engine treats
+        None as "no traffic", not "objective met at 0s").
+
+        Exact from the retained raw samples while they cover every
+        observation; once the bounded sample ring has evicted (count >
+        retained), falls back to the bucket counts with linear
+        interpolation inside the target bucket (histogram_quantile
+        semantics) instead of returning a raw bucket upper bound."""
         key = tuple(sorted(labels.items()))
         with self._lock:
             samples = sorted(self._samples.get(key, []))
-        if not samples:
+            counts = list(self._counts.get(key, []))
+        total = sum(counts)
+        if total == 0:
             return None
-        idx = min(len(samples) - 1, max(0, int(round(q * (len(samples) - 1)))))
-        return samples[idx]
+        if samples and len(samples) == total:
+            idx = min(len(samples) - 1, max(0, int(round(q * (len(samples) - 1)))))
+            return samples[idx]
+        # Bucket interpolation: rank q*total, linear within its bucket.
+        rank = max(0.0, min(1.0, q)) * total
+        cum = 0.0
+        prev_b = 0.0
+        for i, b in enumerate(self.buckets):
+            c = counts[i]
+            if cum + c >= rank and c > 0:
+                frac = (rank - cum) / c
+                return prev_b + frac * (b - prev_b)
+            cum += c
+            prev_b = b
+        # Rank lands in the +Inf overflow bucket: the best honest answer
+        # is the largest retained sample (if any), else the last finite
+        # boundary — flagged nowhere, so keep overflow buckets rare by
+        # choosing bucket layouts that cover the expected range.
+        if samples:
+            return samples[-1]
+        return self.buckets[-1] if self.buckets else None
 
     def label_sets(self) -> List[Dict[str, str]]:
         """Every label combination observed (see Counter.label_sets)."""
@@ -435,6 +503,71 @@ flight_dumps_total = global_registry.counter(
     "tpuc_flight_dumps_total",
     "Flight-recorder dumps written, by reason (drain-timeout |"
     " unhandled-exception | atexit | manual)",
+)
+
+#: Control-plane observatory (runtime/profiler.py + runtime/contention.py
+#: + runtime/slo.py): sampling profiler, lock-contention telemetry, and
+#: the SLO engine with multi-window burn-rate alerts.
+_LOCK_BUCKETS = (
+    1e-6, 1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0, 5.0,
+)
+lock_wait_seconds = global_registry.histogram(
+    "tpuc_lock_wait_seconds",
+    "Time threads spent blocked acquiring an instrumented hot lock, by"
+    " lock (store | inmem_pool | informer:<kind> | dispatcher |"
+    " chip_index). Wait climbing while hold stays flat = contention;"
+    " both climbing = the critical section itself got slower",
+    buckets=_LOCK_BUCKETS,
+)
+lock_hold_seconds = global_registry.histogram(
+    "tpuc_lock_hold_seconds",
+    "Time an instrumented hot lock was held per outermost acquire"
+    " (condition-variable parks inside the hold are excluded — the lock"
+    " is released while parked)",
+    buckets=_LOCK_BUCKETS,
+)
+queue_wait_seconds = global_registry.histogram(
+    "tpuc_queue_wait_seconds",
+    "Seconds a key sat ready in a work queue between enqueue (or delayed-"
+    "entry promotion) and dequeue, by queue (controller name) — the"
+    " saturation signal that climbs before reconcile latency does",
+    buckets=_LOCK_BUCKETS,
+)
+worker_busy_ratio = global_registry.gauge(
+    "tpuc_worker_busy_ratio",
+    "Fraction of the last tracking window the named worker pool spent"
+    " executing (reconciles / fabric calls) rather than parked, by pool"
+    " (controller name | fabric-dispatch). Sustained ~1.0 = the pool is"
+    " saturated and queue wait is about to climb",
+)
+gil_wait_ratio = global_registry.gauge(
+    "tpuc_gil_wait_ratio",
+    "Profiler estimate of the share of a subsystem's runnable wall time"
+    " spent waiting for the GIL rather than executing (runnable samples *"
+    " interval minus measured thread CPU time), by subsystem — the number"
+    " that says whether scale-out is re-serializing on the interpreter",
+)
+profiler_samples_total = global_registry.counter(
+    "tpuc_profiler_samples_total",
+    "Thread-stack samples taken by the always-on sampling profiler",
+)
+slo_burn_rate = global_registry.gauge(
+    "tpuc_slo_burn_rate",
+    "Error-budget burn rate per objective and window (fast | slow):"
+    " bad-fraction / budget over the rolling window. 1.0 = consuming"
+    " exactly the budget; the alert threshold is --slo-burn-threshold",
+)
+slo_breached = global_registry.gauge(
+    "tpuc_slo_breached",
+    "1 while the objective's burn-rate alert is firing (fast AND slow"
+    " windows above the burn threshold; clears when the fast window"
+    " recovers), else 0",
+)
+repair_time_to_replace_seconds = global_registry.histogram(
+    "tpuc_repair_time_to_replace_seconds",
+    "Self-healing repair latency: from the member's failure record"
+    " (Degraded observed_at) to the failed member's detach after its"
+    " replacement came Online (the make-before-break 'replaced' edge)",
 )
 
 

@@ -42,6 +42,7 @@ import time
 from typing import Deque, Dict, Hashable, List, Optional, Tuple
 
 from tpu_composer.runtime import tracing
+from tpu_composer.runtime.metrics import queue_wait_seconds
 
 
 class RateLimitingQueue:
@@ -50,10 +51,14 @@ class RateLimitingQueue:
         base_delay: float = 0.005,
         max_delay: float = 16.0,
         jitter: Optional[random.Random] = None,
+        name: str = "queue",
     ) -> None:
         self._base_delay = base_delay
         self._max_delay = max_delay
         self._rng = jitter or random.Random()
+        #: Label for tpuc_queue_wait_seconds{queue}: controllers pass
+        #: their name so saturation is attributable per queue.
+        self.name = name
         # key -> last jittered delay (decorrelated jitter state)
         self._last_delay: Dict[Hashable, float] = {}
         self._cond = threading.Condition()
@@ -76,6 +81,12 @@ class RateLimitingQueue:
         # worker's pop_context. Bounded by queued+dirty+processing counts.
         self._trace_ctx: Dict[Hashable, tracing.TraceContext] = {}
         self._claimed_ctx: Dict[Hashable, tracing.TraceContext] = {}
+        # key -> monotonic time it became READY (enqueued, or promoted
+        # from the delayed heap): the tpuc_queue_wait_seconds source.
+        # Delayed entries are deliberately not timed from add_after — the
+        # wait that signals saturation is ready-to-run sitting unclaimed,
+        # not an intentional backoff/poll delay.
+        self._enqueued_at: Dict[Hashable, float] = {}
         self._seq = 0
         self._shutdown = False
 
@@ -110,6 +121,7 @@ class RateLimitingQueue:
             if key not in self._queued:
                 self._queued.add(key)
                 self._queue.append(key)
+                self._enqueued_at.setdefault(key, time.monotonic())
                 self._cond.notify()
 
     def pop_context(self, key: Hashable) -> Optional[tracing.TraceContext]:
@@ -202,6 +214,7 @@ class RateLimitingQueue:
             elif key not in self._queued:
                 self._queued.add(key)
                 self._queue.append(key)
+                self._enqueued_at.setdefault(key, now)
 
     def get(self, timeout: Optional[float] = None) -> Optional[Hashable]:
         """Block until a key is ready (or timeout/shutdown → None)."""
@@ -214,6 +227,11 @@ class RateLimitingQueue:
                     key = self._queue.popleft()
                     self._queued.discard(key)
                     self._processing.add(key)
+                    enq = self._enqueued_at.pop(key, None)
+                    if enq is not None:
+                        queue_wait_seconds.observe(
+                            max(0.0, now - enq), queue=self.name
+                        )
                     # Claim the key's parked context ATOMICALLY with the
                     # dequeue: an add() landing after this point (e.g. a
                     # completion latch) parks a context for the NEXT
@@ -241,6 +259,7 @@ class RateLimitingQueue:
                 if key not in self._queued:
                     self._queued.add(key)
                     self._queue.append(key)
+                    self._enqueued_at.setdefault(key, time.monotonic())
                     self._cond.notify()
 
     def shutdown(self) -> None:
@@ -248,6 +267,7 @@ class RateLimitingQueue:
             self._shutdown = True
             self._trace_ctx.clear()
             self._claimed_ctx.clear()
+            self._enqueued_at.clear()
             self._cond.notify_all()
 
     def __len__(self) -> int:

@@ -37,6 +37,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple, Type, TypeVa
 
 from tpu_composer.api.meta import ApiObject, new_uid, now_iso
 from tpu_composer.api.scheme import Scheme, default_scheme
+from tpu_composer.runtime.contention import ObservedLock
 from tpu_composer.runtime.metrics import (
     store_requests_total,
     store_watch_queue_depth,
@@ -128,7 +129,11 @@ class Store:
         pays nanoseconds — the injected mode levels that."""
         self._scheme = scheme or default_scheme()
         self._latency_s = latency_s
-        self._lock = threading.RLock()
+        # Contention telemetry: the store lock serializes every CRUD call
+        # and watch notification — wait/hold land in
+        # tpuc_lock_wait_seconds{lock="store"} (reentrant: admission hooks
+        # run inside create/update and may read back through the store).
+        self._lock = ObservedLock("store", reentrant=True)
         # kind -> name -> object (all cluster-scoped, like the reference's
         # CRDs, +kubebuilder:resource:scope=Cluster). The per-kind secondary
         # index keeps ``list`` from scanning and sorting every kind's keys
