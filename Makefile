@@ -44,11 +44,12 @@ bench-round:
 ## strictly fewer attach/detach provider calls), two bounded wall-time
 ## guards (causal tracing must add <5% (+50 ms jitter allowance) to the
 ## 32-chip wave vs TPUC_TRACE=0, best-of-3; the observatory — always-on
-## sampling profiler + lock wait/hold observation + SLO evaluation — must
-## add <5% to the same wave vs TPUC_PROFILE=0), plus the event-plane
-## floor check: poll-driven completion p50 >= poll_interval by
-## construction, event-driven strictly under it with zero safety-net
-## fallbacks
+## sampling profiler + lock wait/hold observation + SLO evaluation + the
+## fleet telemetry publisher/aggregator at 8x its production cadence —
+## must add <5% to the same wave vs TPUC_PROFILE=0/TPUC_FLEET=0), plus
+## the event-plane floor check: poll-driven completion p50 >=
+## poll_interval by construction, event-driven strictly under it with
+## zero safety-net fallbacks
 perf-smoke:
 	$(PYTHON) -c "import bench; bench.perf_smoke()"
 
@@ -104,9 +105,13 @@ repair-soak:
 ## adoption pass SCOPED to the stolen shards' keys, and converge Ready
 ## with the nonce-checked zero-double-attach invariant — plus no fabric
 ## mutation from the dead replica's identity after its monotonic fencing
-## deadline. A second scenario proves the voluntary rebalance handoff
-## mid-wave. Same black-box contract as the other soaks (TPUC_FLIGHT_FILE /
-## TPUC_TRACE_FILE dumped + uploaded on CI failure).
+## deadline — and the failover must render as ONE stitched trace: the
+## merged per-replica trace files show the pre-crash intent span and the
+## post-crash adopt span under one intent-nonce trace id across two
+## replica pids (TPUC_MERGED_TRACE_FILE captures the merged JSON). A
+## second scenario proves the voluntary rebalance handoff mid-wave. Same
+## black-box contract as the other soaks (TPUC_FLIGHT_FILE /
+## TPUC_TRACE_FILE / TPUC_FLEET_FILE dumped + uploaded on CI failure).
 shard-soak:
 	$(PYTHON) -m pytest tests/test_shard_failover.py -q -m shard -p no:randomly
 

@@ -479,14 +479,17 @@ def summarize_accelerator(accel: dict) -> dict:
     return out
 
 
-def bench_fabric_wave(children: int = 8, fabric_batch: bool = True):
+def bench_fabric_wave(children: int = 8, fabric_batch: bool = True,
+                      fleet: bool = False):
     """Deterministic per-node batching measurement: ``children`` loose
     single-device CRs targeting ONE node attach (and detach) as a wave
     through the live resource controller. No injected latency anywhere —
     the returned numbers are provider-call COUNTS, so the perf-smoke
     assertion on them cannot flake on wall time. With batching on, the
     whole wave coalesces into group calls; off, every child pays its own
-    provider RPC."""
+    provider RPC. ``fleet=True`` runs a FleetPlane (telemetry publisher +
+    aggregator) against the wave's store at 8x the production cadence —
+    the conservative load the observatory overhead gate charges."""
     from tpu_composer.api import (
         ComposableResource,
         ComposableResourceSpec,
@@ -521,6 +524,15 @@ def bench_fabric_wave(children: int = 8, fabric_batch: bool = True):
         timing=ResourceTiming(attach_poll=0.01, visibility_poll=0.01,
                               detach_poll=0.01, detach_fast=0.01,
                               busy_poll=0.01)))
+    if fleet:
+        from tpu_composer.runtime.fleet import FleetPlane
+
+        # Publish + aggregate every 0.25 s (production default is 2 s) so
+        # the short wave still sees several full fleet ticks — the gate
+        # measures a deliberately exaggerated publisher, not an idle one.
+        mgr.add_runnable(FleetPlane(
+            store, identity="bench-fleet", publish_period=0.25,
+        ).run)
     mgr.start(workers_per_controller=8)
     names = [f"wave-{i}" for i in range(children)]
     t0 = time.perf_counter()
@@ -568,6 +580,25 @@ def bench_fabric_wave(children: int = 8, fabric_batch: bool = True):
     }
 
 
+def _histogram_state_delta(after, before):
+    """Per-label bucket-count/sum difference of two ``Histogram.state()``
+    snapshots (same bucket schema) — the per-point slice of a process-
+    cumulative series, so a scaling point's fleet p99 reflects THAT
+    point's wave, not every observation since process start."""
+    prev = {
+        tuple(sorted(dict(labels).items())): (counts, total)
+        for labels, counts, total in before.get("series", [])
+    }
+    series = []
+    for labels, counts, total in after.get("series", []):
+        key = tuple(sorted(dict(labels).items()))
+        pc, ps = prev.get(key, ([0] * len(counts), 0.0))
+        delta = [a - b for a, b in zip(counts, pc)]
+        if any(delta):
+            series.append([labels, delta, total - ps])
+    return {"buckets": list(after.get("buckets", [])), "series": series}
+
+
 def bench_shard_scaling(replica_counts=(1, 2, 4), requests: int = 16,
                         size: int = 4, shards: int = 8,
                         rtt_s: float = 0.01):
@@ -586,7 +617,13 @@ def bench_shard_scaling(replica_counts=(1, 2, 4), requests: int = 16,
     the 2-replica point beats 1 on both placements/sec and p99; 4
     replicas in-proc re-serialize on the GIL. Real multi-process replicas
     keep scaling — this harness is the down payment (curve shape +
-    correctness under concurrent sharded operation), not the end state."""
+    correctness under concurrent sharded operation), not the end state.
+
+    Each replica also runs a FleetPlane, so every point additionally
+    reports the PER-REPLICA placements/sec split (which replica's shard
+    subset serialized — the ROADMAP item 1 offload evidence) and the
+    fleet-merged attach p99 read off the aggregated fleet snapshot, the
+    way a real multi-process fleet would read it."""
     from tpu_composer.agent.fake import FakeNodeAgent
     from tpu_composer.api import (
         ComposabilityRequest,
@@ -606,12 +643,19 @@ def bench_shard_scaling(replica_counts=(1, 2, 4), requests: int = 16,
     from tpu_composer.fabric.dispatcher import FabricDispatcher
     from tpu_composer.runtime.cache import CachedClient
     from tpu_composer.runtime.chaosstore import ChaosStore
+    from tpu_composer.runtime.fleet import FleetPlane
     from tpu_composer.runtime.manager import Manager
     from tpu_composer.runtime.shards import ShardLeaseElector, shard_for
     from tpu_composer.runtime.store import Store
 
+    from tpu_composer.runtime import metrics as _metrics
+    from tpu_composer.runtime.metrics import Histogram as _Histogram
+
     results = {}
     for n_replicas in replica_counts:
+        # Baseline of the process-cumulative attach histogram: the fleet
+        # p99 below is computed over THIS point's delta only.
+        attach_base = _metrics.attach_to_ready_seconds.state()
         store = Store()
         for i in range(max(16, requests * size // 4)):
             n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
@@ -619,6 +663,7 @@ def bench_shard_scaling(replica_counts=(1, 2, 4), requests: int = 16,
             store.create(n)
         pool = _counting_pool()
         replicas = []
+        planes = []
         for i in range(n_replicas):
             slow = ChaosStore(store, latency=rtt_s)
             client = CachedClient(slow)
@@ -633,8 +678,15 @@ def bench_shard_scaling(replica_counts=(1, 2, 4), requests: int = 16,
                 poll_interval=BENCH_FABRIC_POLL_S, concurrency=8,
                 owns=own.owns_key,
             )
+            plane = FleetPlane(
+                slow, identity=f"bench-replica-{i}", num_shards=shards,
+                ownership=own, publish_period=0.25,
+            )
+            planes.append(plane)
             mgr = Manager(store=client, leader_elector=elector,
-                          dispatcher=dispatcher, drain_timeout=0.0)
+                          dispatcher=dispatcher, drain_timeout=0.0,
+                          replica_id=f"bench-replica-{i}", fleet=plane)
+            mgr.add_runnable(plane.run)
             elector.on_acquire.append(
                 lambda wins, c=client, d=dispatcher: adopt_pending_ops(
                     c, pool, d, shards=set(wins), num_shards=shards))
@@ -697,11 +749,48 @@ def bench_shard_scaling(replica_counts=(1, 2, 4), requests: int = 16,
                 )
             wall_s = max(done_ms.values()) / 1e3
             lat = sorted(done_ms.values())
+            # Per-replica split: each request key hashes to one shard, so
+            # end-of-wave ownership attributes every placement to the
+            # replica that reconciled it — the number that says WHICH
+            # replica serialized when the curve flattens.
+            per_replica = {}
+            for idx, m in enumerate(replicas):
+                owned = m._elector.owned_shards()
+                count = sum(
+                    1 for name in names if shard_for(name, shards) in owned
+                )
+                per_replica[f"bench-replica-{idx}"] = {
+                    "shards": len(owned),
+                    "placements": count,
+                    "placements_per_sec": round(count / wall_s, 2),
+                }
+            # Fleet-merged view, read the way a real fleet would read it:
+            # one replica's aggregator over everyone's published
+            # snapshots. The in-proc replicas share one (process-
+            # cumulative) registry, so the per-POINT p99 is the delta of
+            # the published bucket state against this point's baseline.
+            planes[0].tick()
+            fleet_view = planes[0].snapshot()
+            fleet_p99_ms = 0.0
+            snap = planes[0]._last_local
+            attach_state = (
+                snap.histograms.get("tpuc_attach_to_ready_seconds")
+                if snap is not None else None
+            )
+            if attach_state:
+                delta = _histogram_state_delta(attach_state, attach_base)
+                h = _Histogram(f"fleet-delta-{n_replicas}",
+                               buckets=tuple(delta["buckets"]))
+                h.merge(delta)
+                fleet_p99_ms = round((h.percentile_all(0.99) or 0.0) * 1e3, 1)
             results[str(n_replicas)] = {
                 "placements_per_sec": round(len(names) / wall_s, 2),
                 "p50_ms": round(statistics.median(lat), 1),
                 "p99_ms": round(lat[int(0.99 * (len(lat) - 1))], 1),
                 "requests": len(names),
+                "per_replica": per_replica,
+                "fleet_replicas_seen": len(fleet_view.get("replicas", {})),
+                "fleet_attach_p99_ms": fleet_p99_ms,
             }
         finally:
             for m in replicas:
@@ -893,8 +982,10 @@ def bench_observatory_overhead(children: int = 32, repeats: int = 3):
     """Observatory-cost measurement, same shape as bench_tracing_overhead:
     best-of-N 32-chip wave wall time with the FULL observatory on (the
     manager's always-on sampler, lock-contention observation, SLO
-    evaluation) vs the TPUC_PROFILE=0 escape hatch. The perf-smoke gate
-    holds the difference under 5% (+50 ms jitter allowance)."""
+    evaluation, AND the fleet telemetry publisher/aggregator at 8x its
+    production cadence) vs the TPUC_PROFILE=0 / TPUC_FLEET=0 escape
+    hatches. The perf-smoke gate holds the difference under 5% (+50 ms
+    jitter allowance)."""
     from tpu_composer.runtime import contention, profiler
 
     def best(enabled: bool) -> float:
@@ -903,7 +994,8 @@ def bench_observatory_overhead(children: int = 32, repeats: int = 3):
         contention.set_enabled(enabled)
         try:
             return min(
-                bench_fabric_wave(children=children, fabric_batch=True)["wall_s"]
+                bench_fabric_wave(children=children, fabric_batch=True,
+                                  fleet=enabled)["wall_s"]
                 for _ in range(repeats)
             )
         finally:
@@ -970,8 +1062,10 @@ def perf_smoke(cycles: int = 3):
        strictly under that floor with ZERO poll fallbacks. Floor + count
        based — no wall-clock race;
     5. observatory overhead — the always-on sampling profiler + lock
-       wait/hold observation + SLO evaluation together must add <5% to
-       the same wave versus TPUC_PROFILE=0 (same 50 ms allowance).
+       wait/hold observation + SLO evaluation + the fleet telemetry
+       publisher/aggregator (at 8x its production cadence) together must
+       add <5% to the same wave versus TPUC_PROFILE=0 / TPUC_FLEET=0
+       (same 50 ms allowance).
 
     Run via ``make perf-smoke``."""
     on = bench_attach_cluster(cycles=cycles, rtt_s=0.0, cached=True)
@@ -1025,9 +1119,10 @@ def perf_smoke(cycles: int = 3):
     ), (
         "observatory overhead regression: the 32-chip wave took"
         f" {observatory_cost['observatory_on_best_s']}s with the profiler +"
-        " contention telemetry + SLO evaluation on vs"
-        f" {observatory_cost['observatory_off_best_s']}s under TPUC_PROFILE=0"
-        " (expected <5% overhead — always-on observability must stay cheap)"
+        " contention telemetry + SLO evaluation + fleet publisher on vs"
+        f" {observatory_cost['observatory_off_best_s']}s under"
+        " TPUC_PROFILE=0/TPUC_FLEET=0 (expected <5% overhead — always-on"
+        " observability must stay cheap)"
     )
     floor = event_plane["poll_interval_s"]
     ev, po = event_plane["event_driven"], event_plane["poll_driven"]

@@ -105,6 +105,23 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+@pytest.fixture(autouse=True)
+def _reset_trace_replica():
+    """The fleet observatory tags trace events with a process-global
+    replica identity (tracing.set_replica — cmd/main sets it whenever the
+    fleet plane is on, i.e. in every default build_manager). Process-
+    global is right for production and wrong across tests: a leaked tag
+    changes every later test's trace pids and injects process_name
+    metadata into exports. Reset both the module default and this
+    thread's binding after each test."""
+    yield
+    from tpu_composer.runtime import tracing
+
+    tracing.set_replica(None)
+    if hasattr(tracing._tls, "replica"):
+        del tracing._tls.replica
+
+
 @pytest.fixture()
 def store(tmp_path):
     """Fresh in-memory store (no persistence)."""

@@ -100,7 +100,7 @@ class TestSerde:
         assert isinstance(obj, ComposabilityRequest)
         assert set(s.kinds()) == {
             "ComposabilityRequest", "ComposableResource", "Node",
-            "Lease", "ResourceSlice", "DeviceTaintRule",
+            "Lease", "FleetTelemetry", "ResourceSlice", "DeviceTaintRule",
         }
 
     def test_deepcopy_isolation(self):

@@ -218,6 +218,63 @@ class TestBuildManager:
         finally:
             mgr.stop()
 
+    def test_fleet_default_and_escape_hatch(self, monkeypatch, tmp_path):
+        """Default wiring builds the fleet observatory (publisher +
+        aggregator runnable, /debug/fleet via Manager.fleet, replica-
+        tagged trace pids); TPUC_FLEET=0 (or --no-fleet) constructs none
+        of it."""
+        monkeypatch.setenv("CDI_PROVIDER_TYPE", "MOCK")
+        monkeypatch.delenv("NODE_AGENT", raising=False)
+        from tpu_composer.fabric.adapter import reset_shared_mock
+        from tpu_composer.runtime import tracing
+        from tpu_composer.runtime.fleet import FleetPlane
+
+        reset_shared_mock()
+        args = build_parser().parse_args([
+            "--state-dir", str(tmp_path / "s1"),
+            "--fleet-publish-period", "0.7",
+            "--fleet-stale-after", "9.0",
+            "--slo-attach-p99", "7.5",
+        ])
+        assert args.fleet is True
+        try:
+            mgr = build_manager(args)
+            try:
+                assert isinstance(mgr.fleet, FleetPlane)
+                assert mgr.fleet.publish_period == 0.7
+                assert mgr.fleet.stale_after_s == 9.0
+                assert mgr.replica_id == mgr.fleet.identity
+                # Fleet objectives inherit the local SLO thresholds.
+                by_name = {o.name: o for o in mgr.fleet.slo.objectives}
+                assert by_name["fleet_attach_p99"].threshold_s == 7.5
+                assert mgr.fleet.slo.recorder is mgr.recorder
+                assert any(
+                    getattr(r, "__self__", None) is mgr.fleet
+                    for r in mgr._runnables
+                ), "fleet plane never registered as a manager runnable"
+                # Trace events now carry the replica pseudo-pid.
+                assert tracing.current_replica() == mgr.replica_id
+            finally:
+                mgr.stop()
+        finally:
+            tracing.set_replica(None)
+
+        monkeypatch.setenv("TPUC_FLEET", "0")
+        reset_shared_mock()
+        args = build_parser().parse_args(["--state-dir", str(tmp_path / "s2")])
+        assert args.fleet is False
+        mgr = build_manager(args)
+        try:
+            assert mgr.fleet is None
+            assert mgr.replica_id is None
+            assert tracing.current_replica() is None
+            assert not any(
+                isinstance(getattr(r, "__self__", None), FleetPlane)
+                for r in mgr._runnables
+            )
+        finally:
+            mgr.stop()
+
     def test_default_shards_is_unsharded_single_leader_path(
         self, monkeypatch, tmp_path
     ):
@@ -379,13 +436,80 @@ class TestCliProcess:
                 proc.kill()
 
 
+class TestTraceMergeSubcommand:
+    def test_merges_and_stitches_files(self, tmp_path):
+        """`tpu-composer trace-merge` joins per-replica trace files into
+        one stitched Chrome trace: distinct pids, process_name metadata,
+        and a synthetic flow pair connecting spans that share an intent
+        nonce across processes."""
+        import json
+
+        from tpu_composer.cmd.main import main
+        from tpu_composer.runtime import tracing
+
+        tracing.reset()
+        try:
+            tracing.bind_thread("replica-a")
+            with tracing.span("reconcile", cat="controller",
+                              trace_id="nonce-42"):
+                pass
+            doc_a = json.loads(tracing.export_chrome())
+            tracing.reset()
+            tracing.bind_thread("replica-b")
+            with tracing.span("adopt", cat="adoption", trace_id="nonce-42"):
+                pass
+            doc_b = json.loads(tracing.export_chrome())
+        finally:
+            tracing.reset()
+            if hasattr(tracing._tls, "replica"):
+                del tracing._tls.replica
+        fa = tmp_path / "a.json"
+        fb = tmp_path / "b.json"
+        out = tmp_path / "merged.json"
+        fa.write_text(json.dumps(doc_a))
+        fb.write_text(json.dumps(doc_b))
+
+        assert main(["trace-merge", "--out", str(out),
+                     str(fa), str(fb)]) == 0
+        merged = json.loads(out.read_text())
+        spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert len({e["pid"] for e in spans}) == 2
+        names = {
+            e["args"]["name"] for e in merged["traceEvents"]
+            if e.get("name") == "process_name"
+        }
+        assert {"replica-a", "replica-b"} <= names
+        flows = [
+            e for e in merged["traceEvents"]
+            if e.get("ph") in ("s", "f") and e["args"].get("stitched")
+        ]
+        assert len(flows) == 2
+        assert flows[0]["args"]["trace_id"] == "nonce-42"
+        assert merged["metadata"]["stitched_flows"] == 1
+
+    def test_unreadable_input_fails_cleanly(self, tmp_path, capsys):
+        from tpu_composer.cmd.main import main
+
+        assert main(["trace-merge", str(tmp_path / "missing.json")]) == 1
+        assert "trace-merge:" in capsys.readouterr().err
+
+
 class TestCrdGen:
     def test_manifests_shape(self):
         docs = manifests()
         assert set(docs) == {
             "tpu.composer.dev_composabilityrequests.yaml",
             "tpu.composer.dev_composableresources.yaml",
+            "tpu.composer.dev_fleettelemetries.yaml",
         }
+        fleet = docs["tpu.composer.dev_fleettelemetries.yaml"]
+        fleet_spec = (fleet["spec"]["versions"][0]["schema"]
+                      ["openAPIV3Schema"]["properties"]["spec"])
+        assert fleet_spec["required"] == ["identity"]
+        # The payload is schema-free by design: its shape belongs to
+        # runtime/fleet.py, not to a CRD migration.
+        assert fleet_spec["properties"]["payload"][
+            "x-kubernetes-preserve-unknown-fields"] is True
         req = docs["tpu.composer.dev_composabilityrequests.yaml"]
         assert req["spec"]["scope"] == "Cluster"
         version = req["spec"]["versions"][0]
@@ -399,7 +523,7 @@ class TestCrdGen:
         from tpu_composer.api.crdgen import write_manifests
 
         paths = write_manifests(str(tmp_path))
-        assert len(paths) == 2
+        assert len(paths) == 3
         for p in paths:
             with open(p) as f:
                 doc = yaml.safe_load(f)

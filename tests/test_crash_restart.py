@@ -195,8 +195,17 @@ class Incarnation:
             if batched else None
         )
         agent = FakeNodeAgent(pool=pool)
+        # Fleet plane per incarnation: publishes through the same crash
+        # fuse as everything else, so the soak exercises the publisher's
+        # store-failure path and the crash hooks' $TPUC_FLEET_FILE dump
+        # carries a real fleet view when a soak fails.
+        from tpu_composer.runtime.fleet import FleetPlane
+
+        self.fleet = FleetPlane(self.fuse, identity="crash-operator",
+                                publish_period=0.25)
         self.mgr = Manager(store=self.client, dispatcher=self.dispatcher,
-                           drain_timeout=0.0)  # crash harness: never drain
+                           drain_timeout=0.0,  # crash harness: never drain
+                           fleet=self.fleet)
         self.mgr.add_startup_hook(
             lambda: adopt_pending_ops(self.client, pool, self.dispatcher))
         self.mgr.add_controller(ComposabilityRequestReconciler(
@@ -213,6 +222,7 @@ class Incarnation:
         # gap) never false-positives as a leak.
         self.syncer = UpstreamSyncer(self.client, pool, period=0.1, grace=5.0)
         self.mgr.add_runnable(self.syncer)
+        self.mgr.add_runnable(self.fleet.run)
         if self.dispatcher is not None:
             self.mgr.add_runnable(self.dispatcher.run)
         self.mgr.start(workers_per_controller=2)

@@ -1,0 +1,563 @@
+"""Fleet observatory (ISSUE 12): metric merge primitives, the
+publisher/aggregator plane, fleet SLO parity, staleness ageing, trace
+stitching, and the /debug/fleet endpoint.
+
+The acceptance headliners live here as tier-1 tests:
+
+- **Merged-burn parity**: the fleet attach-p99 burn rate computed from
+  two replicas' merged histograms equals the burn rate one replica
+  computes when it handles the whole wave alone — bucket counts are sums,
+  so the equality is exact, not approximate.
+- **Failover ageing**: a kill -9'd replica's snapshot ages out of the
+  aggregate on the observation clock and its per-replica label sets are
+  level-set away, so a dead replica cannot pin the fleet p99 forever.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_composer.api.fleet import FleetTelemetry
+from tpu_composer.api.meta import ObjectMeta
+from tpu_composer.runtime import tracing
+from tpu_composer.runtime.fleet import (
+    FleetPlane,
+    ReplicaTelemetry,
+    dump_file,
+)
+from tpu_composer.runtime.metrics import (
+    Counter,
+    Histogram,
+    fleet_replica_shards,
+    fleet_replicas,
+)
+from tpu_composer.runtime.slo import Objective, SloEngine
+from tpu_composer.runtime.store import Store
+
+
+BUCKETS = (0.1, 0.5, 1.0, 5.0)
+
+
+# ----------------------------------------------------------------------
+# Histogram.merge / Counter.merge primitives
+# ----------------------------------------------------------------------
+class TestMergePrimitives:
+    def test_histogram_merge_preserves_sum_count_and_inf(self):
+        a = Histogram("a", buckets=BUCKETS)
+        b = Histogram("b", buckets=BUCKETS)
+        for v in (0.05, 0.3, 0.7):
+            a.observe(v, type="tpu")
+        for v in (0.2, 2.0, 99.0):  # 99.0 lands in +Inf overflow
+            b.observe(v, type="tpu")
+        b.observe(0.4, type="gpu")
+
+        merged = Histogram("m", buckets=BUCKETS)
+        merged.merge(a)
+        merged.merge(b)
+
+        # _count invariant: total observations add.
+        assert merged.total_count() == a.total_count() + b.total_count() == 7
+        assert merged.count(type="tpu") == 6
+        assert merged.count(type="gpu") == 1
+        # _sum invariant: per-label sums add exactly.
+        assert merged.sum(type="tpu") == pytest.approx(
+            a.sum(type="tpu") + b.sum(type="tpu")
+        )
+        # +Inf invariant on the exposition: the final cumulative bucket
+        # equals _count for every label set (scrape-format law), and the
+        # overflow observation is in it.
+        text = "\n".join(merged.expose())
+        assert 'le="+Inf"} 6' in text  # tpu: 6 including the 99.0 overflow
+        # Conservative SLO accounting: overflow never counts as <= finite.
+        assert merged.total_count_le(5.0) == pytest.approx(6.0)
+
+    def test_histogram_merge_accepts_serialized_state(self):
+        a = Histogram("a", buckets=BUCKETS)
+        a.observe(0.3, verb="add")
+        state = json.loads(json.dumps(a.state()))  # wire round trip
+        merged = Histogram("m", buckets=BUCKETS)
+        merged.merge(state)
+        assert merged.count(verb="add") == 1
+        assert merged.sum(verb="add") == pytest.approx(0.3)
+
+    def test_histogram_merge_bucket_schema_guard(self):
+        a = Histogram("a", buckets=(0.1, 1.0))
+        a.observe(0.05)
+        merged = Histogram("m", buckets=BUCKETS)
+        with pytest.raises(ValueError, match="bucket schema mismatch"):
+            merged.merge(a)
+        # Malformed count vectors raise too — never silently mis-sum.
+        bad = {"buckets": list(BUCKETS), "series": [[{}, [1, 2], 0.1]]}
+        with pytest.raises(ValueError, match="malformed bucket counts"):
+            merged.merge(bad)
+        # The guard fired before any partial mutation.
+        assert merged.total_count() == 0
+
+    def test_counter_merge_sums_label_sets(self):
+        a = Counter("a")
+        a.inc(2, verb="add")
+        b = Counter("b")
+        b.inc(3, verb="add")
+        b.inc(1, verb="remove")
+        merged = Counter("m")
+        merged.merge(a)
+        merged.merge(json.loads(json.dumps(b.state())))
+        assert merged.value(verb="add") == 5
+        assert merged.value(verb="remove") == 1
+        assert merged.total() == 6
+
+
+# ----------------------------------------------------------------------
+# publisher / aggregator plane
+# ----------------------------------------------------------------------
+def _plane(store, ident, hist, token, **kw):
+    kw.setdefault("publish_period", 0.5)
+    kw.setdefault("stale_after_s", 2.0)
+    return FleetPlane(
+        store, ident,
+        histograms={"tpuc_attach_to_ready_seconds": hist},
+        process_token=token, **kw,
+    )
+
+
+class TestFleetPlane:
+    def test_publish_and_aggregate_two_replicas(self):
+        store = Store()
+        ha, hb = Histogram("ha"), Histogram("hb")
+        a = _plane(store, "rep-a", ha, "proc-a")
+        b = _plane(store, "rep-b", hb, "proc-b")
+        ha.observe(0.2, type="tpu")
+        hb.observe(0.4, type="tpu")
+        assert a.publish() and b.publish()
+        view = a.aggregate(now=100.0)
+        assert set(view["replicas"]) == {"rep-a", "rep-b"}
+        merged = view["merged"]["tpuc_attach_to_ready_seconds"]
+        assert merged["count"] == 2
+        assert merged["p99_s"] is not None
+        assert fleet_replicas.value() == 2.0
+        assert fleet_replica_shards.value(replica="rep-a") == 0.0
+
+    def test_process_token_dedup_never_double_counts(self):
+        """Two in-proc replicas share one registry: their snapshots are
+        views of the SAME counters, so the merge must count the process
+        once — N co-located replicas must not multiply fleet traffic."""
+        store = Store()
+        shared = Histogram("shared")
+        shared.observe(0.2)
+        a = _plane(store, "rep-a", shared, "proc-shared")
+        b = _plane(store, "rep-b", shared, "proc-shared")
+        assert a.publish() and b.publish()
+        view = a.aggregate(now=100.0)
+        assert view["merged"]["tpuc_attach_to_ready_seconds"]["count"] == 1
+        # Per-replica identity still distinct in the view.
+        assert set(view["replicas"]) == {"rep-a", "rep-b"}
+
+    def test_schema_mismatch_excludes_contributor_loudly(self):
+        """A replica running different bucket bounds (skewed rolling
+        deploy) is excluded from the merge — never mis-summed."""
+        store = Store()
+        ha = Histogram("ha")  # default buckets
+        hb = Histogram("hb", buckets=(0.1, 1.0))  # skewed schema
+        a = _plane(store, "rep-a", ha, "proc-a")
+        b = _plane(store, "rep-b", hb, "proc-b")
+        ha.observe(0.2)
+        hb.observe(0.2)
+        assert a.publish() and b.publish()
+        view = a.aggregate(now=100.0)
+        # Only rep-a's observation survives; rep-b's skewed series is out.
+        assert view["merged"]["tpuc_attach_to_ready_seconds"]["count"] == 1
+
+    def test_dead_replica_ages_out_and_label_sets_level_set(self):
+        """ISSUE 12 satellite: a kill -9'd replica's snapshot ages out of
+        the aggregate on the OBSERVATION clock (seq unchanged for a full
+        staleness window) and tpuc_fleet_replicas / the per-replica label
+        sets are level-set each tick via Counter.remove — a dead replica
+        cannot pin the fleet p99 forever."""
+        store = Store()
+        ha, hb = Histogram("ha"), Histogram("hb")
+        a = _plane(store, "rep-a", ha, "proc-a", stale_after_s=2.0)
+        b = _plane(store, "rep-b", hb, "proc-b", stale_after_s=2.0)
+        hb.observe(60.0)  # the dead replica's tail-latency poison pill
+        assert a.publish() and b.publish()
+        view = a.aggregate(now=100.0)
+        assert view["merged"]["tpuc_attach_to_ready_seconds"]["count"] == 1
+        assert fleet_replicas.value() == 2.0
+
+        # rep-b dies: its seq never advances again. rep-a keeps ticking.
+        for now in (100.5, 101.0, 101.5):
+            a.publish()
+            view = a.aggregate(now=now)
+            assert view["replicas"]["rep-b"]["stale"] is False
+        a.publish()
+        view = a.aggregate(now=103.5)  # > 2 s since seq last changed
+        assert view["replicas"]["rep-b"]["stale"] is True
+        merged = view["merged"]["tpuc_attach_to_ready_seconds"]
+        assert merged["count"] == 0  # the 60 s observation left the merge
+        assert fleet_replicas.value() == 1.0
+        # rep-b's per-replica series is REMOVED, not frozen at last value.
+        assert {"replica": "rep-b"} not in fleet_replica_shards.label_sets()
+
+        # Resurrection: a republish (seq advances) rejoins the fleet.
+        b.publish()
+        view = a.aggregate(now=104.0)
+        assert view["replicas"]["rep-b"]["stale"] is False
+        assert fleet_replicas.value() == 2.0
+
+    def test_store_blip_does_not_reset_staleness_clocks(self):
+        """A transient list() failure must keep the last view AND the
+        per-replica observation clocks — pruning on a blip would restart
+        every staleness timer and resurrect dead replicas as live for a
+        full window."""
+        from tpu_composer.runtime.store import StoreError
+
+        store = Store()
+        ha, hb = Histogram("ha"), Histogram("hb")
+        a = _plane(store, "rep-a", ha, "proc-a", stale_after_s=2.0)
+        b = _plane(store, "rep-b", hb, "proc-b", stale_after_s=2.0)
+        hb.observe(60.0)
+        assert a.publish() and b.publish()
+        a.aggregate(now=100.0)  # rep-b observed at 100.0; then it dies
+
+        real_list = store.list
+        store.list = lambda *args, **kw: (_ for _ in ()).throw(
+            StoreError("blip")
+        )
+        view = a.aggregate(now=101.0)  # blip mid-ageing
+        assert set(view["replicas"]) == {"rep-a", "rep-b"}  # last view kept
+        store.list = real_list
+
+        a.publish()
+        view = a.aggregate(now=103.0)  # 3 s since rep-b's seq last moved
+        assert view["replicas"]["rep-b"]["stale"] is True, (
+            "the blip reset rep-b's staleness clock"
+        )
+        assert view["merged"]["tpuc_attach_to_ready_seconds"]["count"] == 0
+
+    def test_long_dead_snapshot_gcd_from_store(self):
+        store = Store()
+        ha, hb = Histogram("ha"), Histogram("hb")
+        a = _plane(store, "rep-a", ha, "proc-a", stale_after_s=1.0)
+        b = _plane(store, "rep-b", hb, "proc-b", stale_after_s=1.0)
+        assert a.publish() and b.publish()
+        a.aggregate(now=100.0)
+        # Observed-unchanged for > 10x the staleness window: retired.
+        a.aggregate(now=120.0)
+        names = [o.metadata.name for o in store.list(FleetTelemetry)]
+        assert "telemetry.rep-b" not in names
+        assert "telemetry.rep-a" in names  # self is never aged out
+
+    def test_own_view_survives_store_outage(self):
+        """Publish failures must not blank /debug/fleet: the local
+        snapshot stands in for this replica until the store heals."""
+
+        class DeadStore:
+            def try_get(self, *a, **k):
+                from tpu_composer.runtime.store import StoreError
+
+                raise StoreError("dark")
+
+            create = update = try_get
+
+            def list(self, *a, **k):
+                from tpu_composer.runtime.store import StoreError
+
+                raise StoreError("dark")
+
+            def delete(self, *a, **k):
+                raise AssertionError("unused")
+
+        h = Histogram("h")
+        plane = _plane(DeadStore(), "rep-a", h, "proc-a")
+        assert plane.publish() is False
+        view = plane.aggregate(now=100.0)
+        assert "rep-a" in view["replicas"]
+
+    def test_dump_file(self, tmp_path, monkeypatch):
+        store = Store()
+        h = Histogram("h")
+        plane = _plane(store, "rep-a", h, "proc-a")
+        plane.tick(now=100.0)
+        import tpu_composer.runtime.fleet as fleet_mod
+
+        monkeypatch.setattr(fleet_mod, "_active", plane)
+        path = tmp_path / "fleet.json"
+        monkeypatch.setenv("TPUC_FLEET_FILE", str(path))
+        assert dump_file() == str(path)
+        doc = json.loads(path.read_text())
+        assert doc["identity"] == "rep-a"
+        assert "rep-a" in doc["replicas"]
+
+
+# ----------------------------------------------------------------------
+# fleet SLO parity (acceptance)
+# ----------------------------------------------------------------------
+class TestFleetSloParity:
+    def test_merged_burn_equals_single_replica_burn(self):
+        """ISSUE 12 acceptance: with 2 replicas splitting a wave, the
+        fleet attach-p99 burn rate computed from merged histograms equals
+        the burn rate a single replica computes when handling the whole
+        wave alone. Bucket counts are sums of halves, so equality is
+        exact; the p99 itself may differ by in-bucket interpolation (the
+        lone replica still holds raw samples), bounded by one bucket."""
+        wave = [0.05, 0.1, 0.2, 0.3, 0.4, 0.6, 0.8, 1.5, 2.0, 6.0,
+                7.0, 0.25, 0.35, 0.15, 0.45, 5.5]  # 3 of 16 over 5 s
+        threshold, target = 5.0, 0.75
+
+        # Single replica handles the whole wave.
+        solo = Histogram("solo")
+        solo_engine = SloEngine(
+            objectives=[Objective("attach_p99", solo, threshold, target)],
+            fast_window=60.0, slow_window=600.0,
+        )
+        solo_engine.evaluate(now=0.0)  # t=0 baseline
+        for v in wave:
+            solo.observe(v, type="tpu")
+        solo_engine.evaluate(now=30.0)
+        solo_burn, _ = solo_engine.burn_rates("attach_p99")
+
+        # Two replicas (distinct processes) split the same wave; a third
+        # party aggregates their published snapshots and evaluates the
+        # SAME objective over the merged series.
+        store = Store()
+        ha, hb = Histogram("ha"), Histogram("hb")
+        a = FleetPlane(
+            store, "rep-a", publish_period=0.5,
+            histograms={"tpuc_attach_to_ready_seconds": ha},
+            process_token="proc-a",
+            attach_p99_s=threshold, queue_p99_s=0.0,
+            fast_window=60.0, slow_window=600.0,
+        )
+        b = FleetPlane(
+            store, "rep-b", publish_period=0.5,
+            histograms={"tpuc_attach_to_ready_seconds": hb},
+            process_token="proc-b",
+        )
+        # Patch the fleet objective to the same (threshold, target) pair.
+        a.slo.objectives[0].target = target
+        a.publish(), b.publish()
+        a.aggregate(now=0.0)  # t=0 baseline for the fleet engine
+        for i, v in enumerate(wave):
+            (ha if i % 2 == 0 else hb).observe(v, type="tpu")
+        a.publish(), b.publish()
+        a.aggregate(now=30.0)
+        fleet_burn, _ = a.slo.burn_rates("fleet_attach_p99")
+
+        assert solo_burn > 0  # the wave really burns budget
+        assert fleet_burn == pytest.approx(solo_burn, rel=1e-6), (
+            f"fleet burn {fleet_burn} != solo burn {solo_burn}"
+        )
+
+        # And the merged p99 sits within one bucket of the exact p99.
+        view = a.snapshot()
+        fleet_p99 = view["merged"]["tpuc_attach_to_ready_seconds"]["p99_s"]
+        exact_p99 = solo.percentile(0.99, type="tpu")
+        buckets = solo.buckets
+        hi = next(b_ for b_ in buckets if b_ >= exact_p99)
+        lo = max([b_ for b_ in buckets if b_ < exact_p99], default=0.0)
+        assert lo <= fleet_p99 <= hi, (
+            f"fleet p99 {fleet_p99} outside [{lo}, {hi}] around {exact_p99}"
+        )
+
+
+# ----------------------------------------------------------------------
+# trace stitching (unit level; the failover soak asserts the e2e story)
+# ----------------------------------------------------------------------
+class TestTraceStitching:
+    def setup_method(self):
+        tracing.reset()
+
+    def teardown_method(self):
+        tracing.reset()
+        tracing.set_replica(None)
+        if hasattr(tracing._tls, "replica"):
+            del tracing._tls.replica
+
+    def test_replica_pid_is_stable_and_named(self):
+        pid = tracing.replica_pid("rep-a")
+        assert pid == tracing.replica_pid("rep-a")
+        assert pid != tracing.replica_pid("rep-b")
+        tracing.bind_thread("rep-a")
+        with tracing.span("work", cat="t"):
+            pass
+        evt = tracing.snapshot()[-1]
+        assert evt["pid"] == pid
+        doc = json.loads(tracing.export_chrome())
+        names = [e for e in doc["traceEvents"]
+                 if e.get("name") == "process_name"]
+        assert any(e["args"]["name"] == "rep-a" for e in names)
+        assert doc["metadata"]["epoch_us"] > 0
+
+    def test_merge_stitches_nonce_across_pids(self):
+        """Two files, one trace id, two pids: the merge emits a synthetic
+        flow pair connecting the pre-crash span to the post-crash one."""
+        tracing.bind_thread("rep-a")
+        with tracing.span("reconcile", cat="controller", trace_id="nonce-1"):
+            pass
+        doc_a = json.loads(tracing.export_chrome())
+        tracing.reset()
+        tracing.bind_thread("rep-b")
+        with tracing.span("adopt", cat="adoption", trace_id="nonce-1"):
+            pass
+        doc_b = json.loads(tracing.export_chrome())
+
+        merged = tracing.merge_chrome([doc_a, doc_b])
+        assert merged["metadata"]["stitched_flows"] == 1
+        spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert len({e["pid"] for e in spans}) == 2
+        flows = [e for e in merged["traceEvents"]
+                 if e.get("ph") in ("s", "f")
+                 and e["args"].get("stitched")]
+        assert len(flows) == 2
+        s, f = sorted(flows, key=lambda e: e["ph"], reverse=True)
+        assert s["ph"] == "s" and f["ph"] == "f"
+        assert s["id"] == f["id"]
+        assert s["pid"] != f["pid"]
+        assert s["args"]["trace_id"] == f["args"]["trace_id"] == "nonce-1"
+
+    def test_merge_keeps_same_identity_pid_across_incarnations(self):
+        """Two files from two INCARNATIONS of one replica share its
+        stable pseudo-pid and process_name — the merge must keep them as
+        one Perfetto process (no remap, no fabricated stitch), even when
+        run in a process that recorded nothing (the trace-merge CLI:
+        the decision reads the documents' metadata, not this process's
+        registry)."""
+        tracing.bind_thread("rep-a")
+        with tracing.span("before-crash", cat="t", trace_id="n1"):
+            pass
+        doc_a = json.loads(tracing.export_chrome())
+        tracing.reset()
+        with tracing.span("after-restart", cat="t", trace_id="n1"):
+            pass
+        doc_b = json.loads(tracing.export_chrome())
+        # Simulate the CLI: the merger process never recorded these pids.
+        saved = dict(tracing._pid_names)
+        tracing._pid_names.clear()
+        try:
+            merged = tracing.merge_chrome([doc_a, doc_b])
+        finally:
+            tracing._pid_names.update(saved)
+        spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert len({e["pid"] for e in spans}) == 1
+        assert merged["metadata"]["stitched_flows"] == 0
+
+    def test_merge_rejects_non_object_documents(self):
+        with pytest.raises(ValueError, match="JSON object"):
+            tracing.merge_chrome([[{"name": "x", "ph": "X"}]])
+
+    def test_merge_remaps_colliding_flow_ids(self):
+        """Every process numbers its events from 0, so two files reuse
+        the same flow ids under the one (cat, name) flow key — the merge
+        must renumber the later file's collisions or Perfetto binds
+        causally unrelated flows across replicas."""
+
+        def doc(pid, trace_id):
+            return {
+                "traceEvents": [
+                    {"name": "causal", "cat": "flow", "ph": "s", "id": 2,
+                     "ts": 1.0, "pid": pid, "tid": 1,
+                     "args": {"trace_id": trace_id}},
+                    {"name": "causal", "cat": "flow", "ph": "f", "bp": "e",
+                     "id": 2, "ts": 2.0, "pid": pid, "tid": 2,
+                     "args": {"trace_id": trace_id}},
+                ],
+                "metadata": {"epoch_us": 0.0},
+            }
+
+        merged = tracing.merge_chrome([doc(111, "nonce-a"),
+                                       doc(222, "nonce-b")])
+        flows = [e for e in merged["traceEvents"]
+                 if e.get("ph") in ("s", "f")]
+        by_id = {}
+        for e in flows:
+            by_id.setdefault(e["id"], set()).add(e["args"]["trace_id"])
+        # Each flow id binds exactly one trace — and each file's own
+        # s/f pair still shares one id.
+        assert all(len(traces) == 1 for traces in by_id.values()), by_id
+        assert len(by_id) == 2
+
+    def test_merge_aligns_clocks_and_remaps_colliding_pids(self):
+        base = {
+            "traceEvents": [
+                {"name": "x", "cat": "c", "ph": "X", "ts": 10.0, "dur": 5.0,
+                 "pid": 42, "tid": 1, "args": {"trace_id": "n"}},
+            ],
+            "metadata": {"epoch_us": 1_000_000.0},
+        }
+        later = {
+            "traceEvents": [
+                {"name": "y", "cat": "c", "ph": "X", "ts": 10.0, "dur": 5.0,
+                 "pid": 42, "tid": 1, "args": {"trace_id": "n"}},
+            ],
+            "metadata": {"epoch_us": 1_000_100.0},
+        }
+        merged = tracing.merge_chrome([base, later])
+        spans = sorted(
+            [e for e in merged["traceEvents"] if e.get("ph") == "X"],
+            key=lambda e: e["ts"],
+        )
+        # Second file's events shifted by the 100 us epoch delta.
+        assert spans[1]["ts"] - spans[0]["ts"] == pytest.approx(100.0)
+        # Colliding raw pid remapped so the processes stay distinct.
+        assert spans[0]["pid"] != spans[1]["pid"]
+
+
+# ----------------------------------------------------------------------
+# /debug/fleet endpoint + manager wiring
+# ----------------------------------------------------------------------
+class TestDebugFleetEndpoint:
+    def test_endpoint_serves_fleet_view_and_503_when_disabled(self):
+        from tpu_composer.runtime.manager import Manager
+
+        store = Store()
+        h = Histogram("h")
+        plane = _plane(store, "rep-a", h, "proc-a")
+        plane.tick(now=100.0)
+        mgr = Manager(store=store, health_addr="127.0.0.1:0", fleet=plane)
+        mgr.start()
+        try:
+            port = mgr.health_port
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/fleet").read())
+            assert doc["identity"] == "rep-a"
+            assert "rep-a" in doc["replicas"]
+            index = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug").read())
+            assert "/debug/fleet" in index["endpoints"]
+        finally:
+            mgr.stop()
+
+        mgr = Manager(store=Store(), health_addr="127.0.0.1:0")
+        mgr.start()
+        try:
+            port = mgr.health_port
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/fleet")
+            assert exc.value.code == 503
+        finally:
+            mgr.stop()
+
+    def test_runnable_publishes_on_cadence(self):
+        store = Store()
+        h = Histogram("h")
+        plane = _plane(store, "rep-a", h, "proc-a", publish_period=0.05)
+        stop = threading.Event()
+        t = threading.Thread(target=plane.run, args=(stop,), daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                objs = store.list(FleetTelemetry)
+                if objs and objs[0].spec.seq >= 2:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError("publisher never advanced seq")
+        finally:
+            stop.set()
+            t.join(timeout=2)

@@ -16,7 +16,12 @@ attach wave. The soak asserts the whole robustness contract:
 - no fabric mutation from the dead replica's identity lands after its
   monotonic fencing deadline (split-brain containment),
 - attach-budget / quarantine accounting is bit-identical to an
-  uninterrupted run (all zeros — no fabric fault was injected).
+  uninterrupted run (all zeros — no fabric fault was injected),
+- the failover renders as ONE stitched trace (ISSUE 12): partitioning the
+  shared trace ring into per-replica files and running the trace-merge
+  pass yields a pre-crash intent span (victim pid) and a post-crash adopt
+  span (survivor pid) under one intent-nonce trace id, connected by a
+  synthetic flow arrow across the two pids.
 
 A second scenario proves the REBALANCE path: a replica joining mid-wave is
 handed shards via shed + scoped adoption with the same invariants.
@@ -26,6 +31,8 @@ Run: ``make shard-soak`` (markers slow+shard).
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 
@@ -50,7 +57,9 @@ from tpu_composer.controllers import (
 )
 from tpu_composer.controllers.adoption import adopt_pending_ops
 from tpu_composer.fabric.dispatcher import FabricDispatcher
+from tpu_composer.runtime import tracing
 from tpu_composer.runtime.cache import CachedClient
+from tpu_composer.runtime.fleet import FleetPlane
 from tpu_composer.runtime.manager import Manager
 from tpu_composer.runtime.shards import ShardLeaseElector, shard_for
 from tpu_composer.runtime.store import Store
@@ -126,9 +135,22 @@ class ShardedReplica:
             self.tagged, batch_window=0.01, concurrency=4,
             poll_interval=0.05, owns=own.owns_key,
         )
+        # Fleet plane per replica, on its own stop event so kill() can
+        # end it the way a real SIGKILL would (a dead replica must stop
+        # aggregating — its last view would fight the survivors' gauges).
+        self.fleet = FleetPlane(
+            self.fuse, identity=ident, num_shards=num_shards,
+            ownership=own, publish_period=0.25, stale_after_s=2.0,
+        )
+        self._fleet_stop = threading.Event()
+        self._fleet_thread = None
         self.mgr = Manager(store=self.client, leader_elector=self.elector,
                            dispatcher=self.dispatcher,
-                           drain_timeout=0.0)  # crash harness: never drain
+                           drain_timeout=0.0,  # crash harness: never drain
+                           # Trace events carry the replica identity as
+                           # their Chrome pid — what the stitch assertion
+                           # partitions and merges on.
+                           replica_id=ident, fleet=self.fleet)
         self.elector.on_acquire.append(
             lambda wins: reports.append((ident, dict(wins),
                 adopt_pending_ops(self.client, self.tagged, self.dispatcher,
@@ -155,6 +177,10 @@ class ShardedReplica:
 
     def start(self):
         self.mgr.start(workers_per_controller=2)
+        self._fleet_thread = threading.Thread(
+            target=self.fleet.run, args=(self._fleet_stop,), daemon=True,
+        )
+        self._fleet_thread.start()
 
     def owned(self):
         return self.elector.owned_shards()
@@ -162,12 +188,17 @@ class ShardedReplica:
     def kill(self):
         """SIGKILL analog: writes stop landing, the dispatcher abandons
         lanes and parked outcomes, the renew thread dies — no lease is
-        released; failover happens only through observation expiry."""
+        released; failover happens only through observation expiry. The
+        fleet plane dies with the process: its snapshot's seq freezes in
+        the store, which is exactly what the survivors' staleness clocks
+        age out."""
         self.fuse.die()
         self.dispatcher.kill()
         self.elector._stop.set()
+        self._fleet_stop.set()
 
     def stop(self):
+        self._fleet_stop.set()
         try:
             self.mgr.stop()
         except Exception:
@@ -218,14 +249,27 @@ class TestShardFailoverSoak:
     REPLICAS = 3
 
     def test_kill_minus_nine_mid_wave(self):
-        for cycle, kill_delay in enumerate((0.0, 0.15)):
-            self._one_cycle(cycle, kill_delay)
+        # Two kill points, both pinned to observable in-flight state (not
+        # wall-clock sleeps, which race the wave's completion): cycle 0
+        # kills at the FIRST victim-shard intent, cycle 1 deeper into the
+        # wave, with two victim-shard intents simultaneously in flight.
+        for cycle, min_victim_pending in enumerate((1, 2)):
+            self._one_cycle(cycle, min_victim_pending)
 
-    def _one_cycle(self, cycle, kill_delay):
+    def _one_cycle(self, cycle, min_victim_pending):
         store = _world()
-        pool = RecordingPool(async_steps=2)
+        # async_steps=4 (vs the rebalance scenario's 2): each fabric op
+        # stays pending for several dispatcher re-poll quanta, so the
+        # "min_victim_pending intents simultaneously in flight" kill
+        # condition is reliably reachable — with a faster fabric the
+        # deeper (cycle 1) kill point can race the wave's completion.
+        pool = RecordingPool(async_steps=4)
         mutations = []
         reports = []
+        # Fresh, generous trace ring: the stitch assertion needs the
+        # PRE-crash intent spans still resident after a worst-case
+        # convergence tail — the default 10k ring could age them out.
+        tracing.configure(200_000)
         replicas = [
             ShardedReplica(store, pool, f"replica-{cycle}-{i}", self.K,
                            mutations, reports,
@@ -244,25 +288,60 @@ class TestShardFailoverSoak:
             ), f"shards never balanced: {[r.owned() for r in replicas]}"
 
             _submit_wave(store, size=32)
-            # Mid-wave: durable attach intent on the wire, fabric-async
-            # steps still pending — the widest in-flight window.
-            assert wait_for(
-                lambda: sum(
-                    1 for res in store.list(ComposableResource)
-                    if res.status.pending_op is not None
-                ) >= 2,
-                timeout=15,
-            ), "no pending_op intents ever persisted — kill missed the wave"
-            time.sleep(kill_delay)
 
-            victim = replicas[0]
+            # Mid-wave: durable attach intent on the wire, fabric-async
+            # steps still pending — the widest in-flight window. The
+            # VICTIM is chosen dynamically as the replica owning the most
+            # in-flight intents at the kill instant: the 32-chip wave
+            # materializes as 8 node-children hashed across K=6 shards,
+            # so a pre-chosen replica's shards sometimes hold none of
+            # them — a fixed victim (or a second sequential wait; the
+            # batched wave settles in bursts) flakes. Cycle 1 prefers a
+            # DEEPER kill point (min_victim_pending intents in flight at
+            # once) but degrades to any in-flight intent once a short
+            # grace past submission has elapsed — the stranded-work
+            # invariant is what matters, the depth is flavor.
+            t_submit = time.monotonic()
+            chosen = {}
+
+            def kill_point():
+                pending = [
+                    res.metadata.name
+                    for res in store.list(ComposableResource)
+                    if res.status.pending_op is not None
+                ]
+                if not pending:
+                    return False
+                best, best_c = None, 0
+                for r in replicas:
+                    owned = r.owned()
+                    c = sum(
+                        1 for name in pending
+                        if shard_for(name, self.K) in owned
+                    )
+                    if c > best_c:
+                        best, best_c = r, c
+                if best is None:
+                    return False
+                if best_c >= min_victim_pending or (
+                    time.monotonic() - t_submit > 0.5
+                ):
+                    chosen["victim"] = best
+                    return True
+                return False
+
+            assert wait_for(kill_point, timeout=15), (
+                "no pending_op intent ever in flight on an owned shard"
+                " — kill missed the wave"
+            )
+            victim = chosen["victim"]
+            survivors = [r for r in replicas if r is not victim]
+
             assert victim.owned(), "victim held no shards — nothing to test"
             orphaned = set(victim.owned())
             t_kill = time.monotonic()
             victim.kill()
             fence_deadline = t_kill + victim.elector.renew_deadline_s
-
-            survivors = replicas[1:]
 
             def survivors_own_everything():
                 held = [s for r in survivors for s in r.owned()]
@@ -308,10 +387,86 @@ class TestShardFailoverSoak:
                 f"dead replica mutated the fabric after its fencing"
                 f" deadline: {late}"
             )
+
+            # Fleet view ages the corpse out: a survivor's aggregator must
+            # mark the victim stale (seq frozen past the staleness window
+            # on the survivor's OWN clock) and drop it from the live
+            # count — the "dead replica can't pin fleet p99" satellite,
+            # observed end-to-end through the kill.
+            def victim_aged_out():
+                view = survivors[0].fleet.snapshot()
+                rep = view.get("replicas", {}).get(victim.ident)
+                return rep is not None and rep["stale"] is True
+
+            assert wait_for(victim_aged_out, timeout=10), (
+                "survivors never aged the killed replica out of the"
+                " fleet view: "
+                + repr(survivors[0].fleet.snapshot().get("replicas"))
+            )
+
+            self._assert_failover_stitches(victim)
         finally:
             for r in replicas:
                 r.kill()
                 r.stop()
+            tracing.configure(10_000)  # restore the default ring
+
+    def _assert_failover_stitches(self, victim):
+        """ISSUE 12 acceptance: the failover renders as ONE trace.
+        Partition the shared ring into per-replica-pid trace files (the
+        in-proc stand-in for each process's TPUC_TRACE_FILE), run the
+        trace-merge pass, and assert some intent nonce has a pre-crash
+        span under the victim's pid AND a post-crash adopt span under a
+        survivor's pid, joined by a stitched flow across the two pids."""
+        victim_pid = tracing.replica_pid(victim.ident)
+        by_pid = {}
+        for e in tracing.snapshot():
+            by_pid.setdefault(e.get("pid"), []).append(e)
+        assert victim_pid in by_pid, "victim recorded no trace events"
+        docs = [
+            {"traceEvents": evs, "displayTimeUnit": "ms",
+             "metadata": {"epoch_us": 0.0}}
+            for _pid, evs in sorted(by_pid.items())
+        ]
+        merged = tracing.merge_chrome(docs)
+        merged_path = os.environ.get("TPUC_MERGED_TRACE_FILE")
+        if merged_path:  # CI failure artifact (written on success too)
+            with open(merged_path, "w") as f:
+                json.dump(merged, f)
+
+        spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        by_trace = {}
+        for e in spans:
+            trace_id = (e.get("args") or {}).get("trace_id")
+            if trace_id:
+                by_trace.setdefault(trace_id, []).append(e)
+        stitched = [
+            e for e in merged["traceEvents"]
+            if e.get("ph") in ("s", "f") and e["args"].get("stitched")
+        ]
+        connected = []
+        for trace_id, evs in by_trace.items():
+            pids = {e["pid"] for e in evs}
+            if victim_pid not in pids or len(pids) < 2:
+                continue
+            if not any(
+                e["name"] == "adopt" and e["pid"] != victim_pid
+                for e in evs
+            ):
+                continue
+            if any(
+                f["args"]["trace_id"] == trace_id for f in stitched
+            ):
+                connected.append(trace_id)
+        summary = sorted(
+            (t, sorted({e["pid"] for e in evs}))
+            for t, evs in by_trace.items()
+        )[:10]
+        assert connected, (
+            "no intent nonce rendered as one connected flow across the"
+            " victim's and a survivor's pids after the merge — traces:"
+            f" {summary}"
+        )
 
     def test_rebalance_handoff_mid_wave(self):
         """A replica joining mid-wave is HANDED shards: the incumbent

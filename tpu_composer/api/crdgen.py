@@ -246,6 +246,42 @@ COMPOSABLE_RESOURCE_SCHEMA = _obj(
     }
 )
 
+FLEET_TELEMETRY_SCHEMA = _obj(
+    {
+        "apiVersion": _str(),
+        "kind": _str(),
+        "metadata": {"type": "object"},
+        "spec": _obj(
+            {
+                "identity": _str(
+                    "Publishing replica identity (the shard/member lease"
+                    " identity when sharded)",
+                    min_length=1,
+                ),
+                "seq": _int(
+                    "Monotonic publish counter — the aggregator's staleness"
+                    " observation clock",
+                    minimum=0,
+                ),
+                "processToken": _str(
+                    "One token per OS process; histograms are merged once"
+                    " per process so co-located replicas never double-count"
+                ),
+                "payload": {
+                    "type": "object",
+                    "x-kubernetes-preserve-unknown-fields": True,
+                    "description": "Telemetry snapshot (histogram bucket"
+                    " state, SLO burn rates, GIL ratios, profiler top-N);"
+                    " shape owned by runtime/fleet.py, versioned by the"
+                    " publisher — never by a CRD migration",
+                },
+            },
+            required=["identity"],
+        ),
+        "status": _obj({}),
+    }
+)
+
 
 def crd(kind: str, plural: str, singular: str, short: List[str], schema: Dict) -> Dict:
     """Cluster-scoped CRD with status subresource + printer columns
@@ -304,6 +340,13 @@ def manifests() -> Dict[str, Dict]:
             "composableresource",
             ["cres"],
             COMPOSABLE_RESOURCE_SCHEMA,
+        ),
+        f"{GROUP}_fleettelemetries.yaml": crd(
+            "FleetTelemetry",
+            "fleettelemetries",
+            "fleettelemetry",
+            ["ftel"],
+            FLEET_TELEMETRY_SCHEMA,
         ),
     }
 

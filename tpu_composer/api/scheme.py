@@ -12,6 +12,7 @@ import json
 from typing import Any, Dict, Type
 
 from tpu_composer.api.dra import DeviceTaintRule, ResourceSlice
+from tpu_composer.api.fleet import FleetTelemetry
 from tpu_composer.api.lease import Lease
 from tpu_composer.api.meta import ApiObject
 from tpu_composer.api.types import ComposabilityRequest, ComposableResource, Node
@@ -61,6 +62,7 @@ def default_scheme() -> Scheme:
     s.register(ComposableResource)
     s.register(Node)
     s.register(Lease)
+    s.register(FleetTelemetry)
     s.register(ResourceSlice)
     s.register(DeviceTaintRule)
     return s

@@ -45,6 +45,14 @@ SURVEY.md §5 "Config / flag system"):
   TPUC_SLO_*          objective thresholds and burn-rate windows
                       (ATTACH_P99, COMPLETION_P50, QUEUE_P99, REPAIR_P99,
                       FAST_WINDOW, SLOW_WINDOW, BURN_THRESHOLD)
+  TPUC_FLEET          "0" disables the fleet observatory (--no-fleet):
+                      no telemetry publishing, no cross-replica
+                      aggregation, no /debug/fleet, no replica-tagged
+                      trace pids — per-process observability only
+  TPUC_FLEET_PUBLISH_PERIOD / TPUC_FLEET_STALE_AFTER
+                      fleet snapshot cadence / dead-replica ageing window
+  TPUC_FLEET_FILE     write the /debug/fleet view here from the crash
+                      hooks (--fleet-file)
   TPUC_TRACE          "0" disables causal tracing entirely (--no-trace)
   TPUC_TRACE_EVENTS   trace ring capacity in events (--trace-events)
   TPUC_TRACE_FILE     write the Chrome trace ring here at stop AND on
@@ -67,6 +75,16 @@ SURVEY.md §5 "Config / flag system"):
                       fleet-level repair-storm breaker (--repair-breaker-*)
 
 Run: ``python -m tpu_composer [flags]`` or ``python -m tpu_composer.cmd.main``.
+
+Subcommands (dispatched before operator flag parsing):
+
+  trace-merge [--out merged.json] a.json b.json ...
+      Stitch per-replica Chrome trace files (TPUC_TRACE_FILE output, one
+      per replica process) into ONE connected Perfetto trace: clocks are
+      aligned via each file's epoch anchor, colliding pids remapped, and
+      spans sharing an intent-nonce trace id across processes joined with
+      synthetic flow arrows — a kill -9 failover mid-attach renders as
+      intent-by-A → adopted-by-B across two process rails.
 """
 
 from __future__ import annotations
@@ -459,6 +477,46 @@ def build_parser() -> argparse.ArgumentParser:
         help="write the /debug/slo snapshot here from the crash hooks"
              " (env TPUC_SLO_FILE)",
     )
+    # Fleet observatory (runtime/fleet.py): every replica publishes a
+    # telemetry snapshot into the shared store and aggregates everyone's,
+    # so /debug/fleet and tpuc_fleet_* read the same from any replica.
+    p.add_argument(
+        "--fleet",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_FLEET", "1") != "0",
+        help="run the fleet observatory: publish this replica's telemetry"
+             " snapshot (histogram bucket state, SLO burn rates, GIL"
+             " ratios, owned shards) into the shared store each period,"
+             " aggregate every replica's into fleet-merged SLOs and"
+             " tpuc_fleet_* gauges, serve /debug/fleet, and tag trace"
+             " events with the replica identity as the Chrome trace pid"
+             " (what the trace-merge subcommand stitches on)."
+             " --no-fleet or TPUC_FLEET=0 constructs none of it",
+    )
+    p.add_argument(
+        "--fleet-publish-period",
+        type=float,
+        default=_env_seconds("TPUC_FLEET_PUBLISH_PERIOD", 2.0),
+        help="seconds between fleet telemetry publishes (and aggregation"
+             " ticks; env TPUC_FLEET_PUBLISH_PERIOD)",
+    )
+    p.add_argument(
+        "--fleet-stale-after",
+        type=float,
+        default=_env_seconds("TPUC_FLEET_STALE_AFTER", 0.0),
+        help="seconds a replica's snapshot sequence number may sit"
+             " unchanged on THIS replica's monotonic clock before the"
+             " replica is considered dead and leaves every fleet"
+             " aggregate (0 = 5x the publish period; the leases'"
+             " observation-clock discipline — wall jumps can neither"
+             " hasten nor mask the ageing; env TPUC_FLEET_STALE_AFTER)",
+    )
+    p.add_argument(
+        "--fleet-file",
+        default=os.environ.get("TPUC_FLEET_FILE", ""),
+        help="write the /debug/fleet view here from the crash hooks"
+             " (the soak failure artifact; env TPUC_FLEET_FILE)",
+    )
     # Self-healing data plane (post-Ready failure detection + repair):
     # per-request policy lives on ComposabilityRequest.spec (repairPolicy /
     # maxConcurrentRepairs / repairGraceSeconds); these are the fleet-wide
@@ -707,6 +765,8 @@ def _configure_tracing(args: argparse.Namespace) -> None:
         os.environ["TPUC_PROFILE_FILE"] = args.profile_file
     if getattr(args, "slo_file", ""):
         os.environ["TPUC_SLO_FILE"] = args.slo_file
+    if getattr(args, "fleet_file", ""):
+        os.environ["TPUC_FLEET_FILE"] = args.fleet_file
 
 
 def build_manager(args: argparse.Namespace) -> Manager:
@@ -834,6 +894,45 @@ def build_manager(args: argparse.Namespace) -> Manager:
             slow_window=getattr(args, "slo_slow_window", 600.0),
             burn_threshold=getattr(args, "slo_burn_threshold", 2.0),
         )
+    fleet_plane = None
+    replica_id = None
+    if not getattr(args, "fleet", True):
+        if shard_elector is not None:
+            # --no-fleet means NO replica-tagged trace pids anywhere —
+            # the elector's renew thread included (its default tagging
+            # serves direct harness wiring, not the escape hatch).
+            shard_elector.tag_traces = False
+    else:
+        # Fleet observatory: identity follows the shard/member lease
+        # identity when sharded (the fleet view and the lease table must
+        # name replicas identically), a fresh per-boot identity otherwise.
+        from tpu_composer.runtime import tracing as tracing_mod
+        from tpu_composer.runtime.fleet import FleetPlane
+        from tpu_composer.runtime.leases import default_identity
+
+        replica_id = (
+            shard_elector.identity if shard_elector is not None
+            else default_identity()
+        )
+        # Every trace event this process records carries the replica
+        # identity as its Chrome trace pid — what `tpu-composer
+        # trace-merge` stitches cross-process failovers on.
+        tracing_mod.set_replica(replica_id)
+        fleet_plane = FleetPlane(
+            store,  # raw store, not the cache: snapshots ride beside leases
+            identity=replica_id,
+            num_shards=num_shards,
+            ownership=ownership,
+            publish_period=getattr(args, "fleet_publish_period", 2.0),
+            stale_after_s=getattr(args, "fleet_stale_after", 0.0),
+            attach_p99_s=getattr(args, "slo_attach_p99", 5.0),
+            queue_p99_s=getattr(args, "slo_queue_p99", 1.0),
+            fast_window=getattr(args, "slo_fast_window", 60.0),
+            slow_window=getattr(args, "slo_slow_window", 600.0),
+            burn_threshold=getattr(args, "slo_burn_threshold", 2.0),
+            slo_engine=slo_engine,
+            profiler=profiler_inst,
+        )
     mgr = Manager(
         store=client,
         leader_elect=args.leader_elect,
@@ -848,11 +947,17 @@ def build_manager(args: argparse.Namespace) -> Manager:
         drain_timeout=getattr(args, "drain_timeout", 8.0),
         profiler=profiler_inst,
         slo_engine=slo_engine,
+        replica_id=replica_id,
+        fleet=fleet_plane,
     )
     if slo_engine is not None:
         # The engine's breach/recovery Events flow through the manager's
         # recorder (constructed just above).
         slo_engine.recorder = mgr.recorder
+    if fleet_plane is not None:
+        # Fleet SLO breach Events ride the same recorder as local ones.
+        fleet_plane.slo.recorder = mgr.recorder
+        mgr.add_runnable(fleet_plane.run)
     if dispatcher is not None:
         mgr.add_runnable(dispatcher.run)
     if session is not None:
@@ -991,7 +1096,52 @@ def build_manager(args: argparse.Namespace) -> Manager:
     return mgr
 
 
+def trace_merge_main(argv: List[str]) -> int:
+    """``tpu-composer trace-merge``: stitch per-replica trace files into
+    one connected Chrome/Perfetto trace (see runtime.tracing.merge_chrome
+    for the three passes: clock alignment, pid disambiguation, nonce-keyed
+    flow stitching)."""
+    import json
+
+    from tpu_composer.runtime import tracing
+
+    p = argparse.ArgumentParser(
+        prog="tpu-composer trace-merge",
+        description="merge per-replica Chrome trace files into one"
+                    " stitched trace (open in Perfetto)",
+    )
+    p.add_argument("inputs", nargs="+",
+                   help="per-replica trace JSON files (TPUC_TRACE_FILE"
+                        " dumps / /debug/traces exports)")
+    p.add_argument("--out", default="",
+                   help="write the merged trace here (default: stdout)")
+    args = p.parse_args(argv)
+    try:
+        merged = tracing.merge_files(args.inputs)
+    except (OSError, ValueError, TypeError, KeyError, AttributeError) as e:
+        # Unreadable file, non-JSON, the Array flavor, or events of an
+        # unexpected shape — all surface as one clean CLI error.
+        print(f"trace-merge: {e}", file=sys.stderr)
+        return 1
+    doc = json.dumps(merged)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc)
+        meta = merged.get("metadata", {})
+        print(
+            f"{args.out}: {len(merged['traceEvents'])} events from"
+            f" {meta.get('merged_files', len(args.inputs))} file(s),"
+            f" {meta.get('stitched_flows', 0)} stitched flow(s)"
+        )
+    else:
+        print(doc)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "trace-merge":
+        return trace_merge_main(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper()),

@@ -176,10 +176,13 @@ def adopt_pending_ops(
             # nonce is the trace id, so a Perfetto export shows the dead
             # incarnation's reconcile/dispatch spans and this successor's
             # adoption span under one trace_id — the cross-crash continuity
-            # the kill–restart soak asserts.
+            # the kill–restart soak asserts. The span additionally names
+            # the adopting replica so a merged fleet trace reads "intent by
+            # A, adopted by B" without decoding pseudo-pids.
             with tracing.span(
                 "adopt", cat="adoption", resource=res.metadata.name,
                 verb=verb,
+                replica=tracing.current_replica() or "",
                 ctx=tracing.TraceContext(trace_id=res.status.pending_op.nonce),
             ) as sp:
                 outcome = _adopt_one(

@@ -607,7 +607,13 @@ class FabricDispatcher:
     # ------------------------------------------------------------------
     # worker side
     # ------------------------------------------------------------------
+    #: Fleet identity tagging lane threads' trace events (set by the
+    #: owning Manager alongside the controllers' replica_id).
+    replica_id: Optional[str] = None
+
     def _worker_loop(self) -> None:
+        if self.replica_id:
+            tracing.bind_thread(self.replica_id)
         while True:
             with self._cond:
                 task = None

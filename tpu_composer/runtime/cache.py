@@ -65,8 +65,10 @@ log = logging.getLogger("cache")
 
 #: Kinds never served from cache. Leader-election Leases need linearizable
 #: reads (client-go reads Leases through a direct client, never the
-#: informer — same exclusion KubeStore's route table encodes).
-UNCACHED_KINDS = frozenset({"Lease"})
+#: informer — same exclusion KubeStore's route table encodes), and fleet
+#: telemetry snapshots churn every publish period with no reconcile-path
+#: reader — an informer per kind would pay watch fan-out for nothing.
+UNCACHED_KINDS = frozenset({"Lease", "FleetTelemetry"})
 
 #: Label keys maintained as secondary indexes on every informer. The
 #: ``managed-by`` child lookup is the one selector on the reconcile hot

@@ -54,6 +54,11 @@ class Controller:
     #: logged without a traceback.
     quiet_exceptions: tuple = ()
 
+    #: Fleet identity tagging trace events from this controller's threads
+    #: (set by the owning Manager when it has a replica_id): N in-proc
+    #: replicas sharing one trace ring render as N Perfetto processes.
+    replica_id: Optional[str] = None
+
     def __init__(
         self, store: Store, name: Optional[str] = None, ownership=None
     ) -> None:
@@ -142,6 +147,8 @@ class Controller:
         mapper: Optional[EventMapper],
         predicate: Optional[EventPredicate],
     ) -> None:
+        if self.replica_id:
+            tracing.bind_thread(self.replica_id)
         while not self._stop.is_set():
             try:
                 # Only the expected timeout is absorbed: a bare `except
@@ -171,6 +178,8 @@ class Controller:
         return self.ownership is None or self.ownership.owns_key(key)
 
     def _worker_loop(self) -> None:
+        if self.replica_id:
+            tracing.bind_thread(self.replica_id)
         while not self._stop.is_set():
             key = self.queue.get(timeout=0.2)
             if key is None:

@@ -304,6 +304,14 @@ class KubeStore:
                 "coordination.k8s.io/v1",
                 cacheable=False,
             ),
+            # Fleet telemetry snapshots (runtime/fleet.py): our own CRD
+            # (deploy/crds), read/written by every replica's fleet plane.
+            # Uncacheable like Leases — the aggregator's staleness clock
+            # needs the freshest seq, and the churn would thrash a cache.
+            "FleetTelemetry": _KindRoute(
+                f"{base}/fleettelemetries", f"{GROUP}/{VERSION}",
+                cacheable=False,
+            ),
             # DRA publication + quarantine (reference scans ResourceSlices at
             # gpus.go:207-239 and rules DeviceTaintRules at :894-975).
             "ResourceSlice": _KindRoute(

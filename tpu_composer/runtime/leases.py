@@ -22,6 +22,7 @@ from __future__ import annotations
 import datetime
 import logging
 import os
+import re
 import socket
 import threading
 import time
@@ -45,6 +46,15 @@ def default_identity() -> str:
     """hostname_uuid — the same shape client-go uses (id must be unique per
     replica even on one host)."""
     return f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+
+
+def sanitize_identity(identity: str) -> str:
+    """Identity → DNS-1123-ish object-name fragment, shared by every
+    consumer that names a store object after a replica (``member.<id>``
+    heartbeat leases, ``telemetry.<id>`` fleet snapshots) — one rule, so
+    an operator can correlate a replica's objects across subsystems."""
+    out = re.sub(r"[^a-z0-9.-]+", "-", identity.lower()).strip("-.")
+    return out or "replica"
 
 
 class RenewObservation:

@@ -343,9 +343,10 @@ _crash_dumped = False
 
 def dump_crash(reason: str) -> None:
     """Best-effort black-box write: flight ledger + trace ring + the
-    observatory's continuous-profile ring and SLO snapshot, all env-gated
-    ($TPUC_FLIGHT_FILE / $TPUC_TRACE_FILE / $TPUC_PROFILE_FILE /
-    $TPUC_SLO_FILE). Never raises."""
+    observatory's continuous-profile ring, SLO snapshot and fleet view,
+    all env-gated ($TPUC_FLIGHT_FILE / $TPUC_TRACE_FILE /
+    $TPUC_PROFILE_FILE / $TPUC_SLO_FILE / $TPUC_FLEET_FILE). Never
+    raises."""
     global _crash_dumped
     if reason != "atexit":
         _crash_dumped = True
@@ -371,6 +372,12 @@ def dump_crash(reason: str) -> None:
         from tpu_composer.runtime import slo as _slo
 
         _slo.dump_file()
+    except Exception:
+        pass
+    try:
+        from tpu_composer.runtime import fleet as _fleet
+
+        _fleet.dump_file()
     except Exception:
         pass
 

@@ -43,6 +43,10 @@ DEBUG_ENDPOINTS = {
                               " events, span summaries",
     "/debug/slo": "SLO objectives with fast/slow burn rates and breach"
                   " state",
+    "/debug/fleet": "cross-replica fleet view: live/stale replicas with"
+                    " owned shards, fleet-merged latency percentiles and"
+                    " fleet SLO burn rates (identical from whichever"
+                    " replica you ask)",
     "/debug/profile": "on-demand stack profile burst"
                       " (?seconds=&format=top|collapsed|json)",
     "/debug/profile/continuous": "the always-on profiler's window ring:"
@@ -149,6 +153,14 @@ class _HealthHandler(_PlainTextHandler):
             else:
                 self._respond_json(
                     200, json.dumps(eng.snapshot(), indent=1).encode()
+                )
+        elif path == "/debug/fleet":
+            fleet = self.manager.fleet
+            if fleet is None:
+                self._respond(503, "fleet plane disabled (TPUC_FLEET=0)")
+            else:
+                self._respond_json(
+                    200, json.dumps(fleet.snapshot(), indent=1).encode()
                 )
         elif path == "/debug/profile/continuous":
             prof = self.manager.profiler
@@ -272,6 +284,8 @@ class Manager:
         drain_timeout: float = 8.0,  # seconds; <= 0 disables graceful drain
         profiler=None,  # SamplingProfiler override (None = default when enabled)
         slo_engine=None,  # SloEngine override (None = defaults when enabled)
+        replica_id: Optional[str] = None,  # fleet identity for trace pids
+        fleet=None,  # runtime.fleet.FleetPlane serving /debug/fleet
     ) -> None:
         # `is not None`, not `or`: an EMPTY store is falsy (Store.__len__),
         # and silently swapping in a fresh one would orphan the caller's
@@ -302,6 +316,15 @@ class Manager:
         self._metrics_server: Optional[http.server.ThreadingHTTPServer] = None
         self._dispatcher = dispatcher
         self._drain_timeout = drain_timeout
+        # Fleet observatory plumbing: the replica identity tags every
+        # trace event recorded by this manager's threads (controller
+        # workers, dispatcher lanes, runnables) with a stable pseudo-pid,
+        # so N in-proc replicas sharing one trace ring still render — and
+        # merge — as N distinct Perfetto processes, exactly like real OS
+        # replicas do via their real pids. None (the default) changes
+        # nothing: events keep plain os.getpid().
+        self.replica_id = replica_id
+        self.fleet = fleet
         # Post-leader-acquire / pre-controller-start hooks (cold-start
         # adoption of durable fabric intents, controllers/adoption.py):
         # they run only once leadership is held — a standby must not probe
@@ -330,6 +353,19 @@ class Manager:
                 SloEngine(recorder=self.recorder)
                 if profiler_mod.enabled() else None
             )
+
+    def _bound(self, target):
+        """Wrap a thread target so the thread tags its trace events with
+        this manager's replica identity before running (no-op unbound)."""
+        if not self.replica_id:
+            return target
+        rid = self.replica_id
+
+        def run(*args, **kwargs):
+            tracing.bind_thread(rid)
+            return target(*args, **kwargs)
+
+        return run
 
     def add_controller(self, controller: Controller) -> None:
         self._controllers.append(controller)
@@ -476,7 +512,8 @@ class Manager:
         # transition (phase durations -> tpuc_phase_duration_seconds, the
         # /debug/requests timelines, and the flight recorder's ledger).
         t = threading.Thread(
-            target=lifecycle.watch_runnable(self.store), args=(self._stop,),
+            target=self._bound(lifecycle.watch_runnable(self.store)),
+            args=(self._stop,),
             name="lifecycle-watch", daemon=True,
         )
         t.start()
@@ -499,7 +536,19 @@ class Manager:
             t.start()
             self._threads.append(t)
 
+        # Tag the dispatcher BEFORE any controller starts: a controller
+        # worker's first submission lazily spawns the lane threads, and a
+        # lane that spawns before the tag lands would record untagged pids
+        # for the rest of the process.
+        if self.replica_id and self._dispatcher is not None:
+            if getattr(self._dispatcher, "replica_id", None) is None:
+                self._dispatcher.replica_id = self.replica_id
         for c in self._controllers:
+            # Controller worker/dispatch threads bind the replica identity
+            # themselves (runtime/controller.py) — the attribute survives
+            # stop/start cycles the way a wrapped target would not.
+            if self.replica_id and getattr(c, "replica_id", None) is None:
+                c.replica_id = self.replica_id
             c.start(workers=workers_per_controller)
         for r in self._runnables:
             # Named after the runnable (UpstreamSyncer, FabricDispatcher,
@@ -507,7 +556,7 @@ class Manager:
             # thread name, and an anonymous Thread-N would land every
             # runnable in its 'other' bucket.
             t = threading.Thread(
-                target=r, args=(self._stop,), daemon=True,
+                target=self._bound(r), args=(self._stop,), daemon=True,
                 name=_runnable_name(r),
             )
             t.start()

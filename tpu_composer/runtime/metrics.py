@@ -63,6 +63,22 @@ class Counter:
         with self._lock:
             self._values.pop(key, None)
 
+    def state(self) -> List[List[object]]:
+        """JSON-serializable snapshot: ``[[{label: value}, value], ...]``
+        — what a replica publishes into its fleet telemetry snapshot."""
+        with self._lock:
+            return [[dict(key), v] for key, v in sorted(self._values.items())]
+
+    def merge(self, other) -> None:
+        """Sum another counter's series into this one, label set by label
+        set. ``other`` is a Counter/Gauge or a :meth:`state` list (the
+        deserialized form a fleet snapshot carries)."""
+        series = other.state() if hasattr(other, "state") else other
+        for labels, value in series:
+            key = tuple(sorted(dict(labels).items()))
+            with self._lock:
+                self._values[key] = self._values.get(key, 0.0) + float(value)
+
     def label_sets(self) -> List[Dict[str, str]]:
         """Every label combination this metric has observed (bench/debug
         introspection — e.g. enumerating which phases have durations)."""
@@ -206,10 +222,85 @@ class Histogram:
             return samples[-1]
         return self.buckets[-1] if self.buckets else None
 
+    def percentile_all(self, q: float) -> Optional[float]:
+        """Quantile over ALL label sets combined, from bucket counts with
+        linear interpolation (never raw samples — the label sets' sample
+        rings are not one coherent population). The fleet aggregator's
+        percentile: a merged histogram carries every replica's label sets
+        and the fleet p99 spans them all; ``None`` for an empty series."""
+        with self._lock:
+            cols = list(self._counts.values())
+        if not cols:
+            return None
+        agg = [sum(col[i] for col in cols) for i in range(len(self.buckets) + 1)]
+        total = sum(agg)
+        if total == 0:
+            return None
+        rank = max(0.0, min(1.0, q)) * total
+        cum = 0.0
+        prev_b = 0.0
+        for i, b in enumerate(self.buckets):
+            c = agg[i]
+            if cum + c >= rank and c > 0:
+                frac = (rank - cum) / c
+                return prev_b + frac * (b - prev_b)
+            cum += c
+            prev_b = b
+        return self.buckets[-1] if self.buckets else None
+
     def label_sets(self) -> List[Dict[str, str]]:
         """Every label combination observed (see Counter.label_sets)."""
         with self._lock:
             return [dict(key) for key in self._counts]
+
+    def state(self) -> Dict[str, object]:
+        """JSON-serializable snapshot of the FULL bucket state:
+        ``{"buckets": [...], "series": [[{label: value}, counts, sum]]}``
+        where ``counts`` is per-bucket (len(buckets)+1, last = +Inf
+        overflow). This is what a replica publishes fleet-wide — cumulative
+        counts, so merged series stay monotonic and burn-rate diffs work."""
+        with self._lock:
+            return {
+                "buckets": list(self.buckets),
+                "series": [
+                    [dict(key), list(counts), self._sums.get(key, 0.0)]
+                    for key, counts in sorted(self._counts.items())
+                ],
+            }
+
+    def merge(self, other) -> None:
+        """Sum another histogram's bucket counts and sums into this one,
+        label set by label set. ``other`` is a Histogram or a
+        :meth:`state` dict (a deserialized fleet snapshot).
+
+        Bucket-schema guard: identical-bucket merging is the ONLY sound
+        operation on histograms — summing counts across different bucket
+        layouts silently mis-attributes observations, so mismatched bounds
+        (or a malformed per-bucket count vector) raise ``ValueError``
+        instead of producing a plausible-looking wrong aggregate. Raw
+        samples are deliberately NOT merged: a merged series answers
+        percentiles via bucket interpolation, never via one contributor's
+        sample ring masquerading as the fleet's."""
+        state = other.state() if hasattr(other, "state") else other
+        theirs = tuple(float(b) for b in state.get("buckets", ()))
+        if theirs != self.buckets:
+            raise ValueError(
+                f"histogram bucket schema mismatch merging into"
+                f" {self.name}: {theirs!r} != {self.buckets!r}"
+            )
+        want = len(self.buckets) + 1
+        for labels, counts, sum_ in state.get("series", []):
+            if len(counts) != want:
+                raise ValueError(
+                    f"histogram {self.name}: malformed bucket counts"
+                    f" (got {len(counts)}, want {want})"
+                )
+            key = tuple(sorted(dict(labels).items()))
+            with self._lock:
+                mine = self._counts.setdefault(key, [0] * want)
+                for i, c in enumerate(counts):
+                    mine[i] += int(c)
+                self._sums[key] = self._sums.get(key, 0.0) + float(sum_)
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
@@ -568,6 +659,45 @@ repair_time_to_replace_seconds = global_registry.histogram(
     "Self-healing repair latency: from the member's failure record"
     " (Degraded observed_at) to the failed member's detach after its"
     " replacement came Online (the make-before-break 'replaced' edge)",
+)
+
+#: Fleet observatory (runtime/fleet.py): every replica publishes a
+#: telemetry snapshot into the shared store; the aggregator on EVERY
+#: replica merges them, so these fleet-level series read the same from
+#: whichever replica's /metrics you scrape.
+fleet_replicas = global_registry.gauge(
+    "tpuc_fleet_replicas",
+    "Live operator replicas in the fleet view (publishing telemetry"
+    " snapshots whose sequence number still advances on this replica's"
+    " observation clock). Level-set each aggregation tick: a kill -9'd"
+    " replica drops out after --fleet-stale-after",
+)
+fleet_stale_replicas = global_registry.gauge(
+    "tpuc_fleet_stale_replicas",
+    "Replicas with a published snapshot whose sequence number has sat"
+    " unchanged past the staleness window — dead or partitioned; their"
+    " series are excluded from every fleet aggregate",
+)
+fleet_replica_shards = global_registry.gauge(
+    "tpuc_fleet_replica_shards",
+    "Shard leases each live replica reports owning, by replica identity"
+    " (label sets for stale replicas are removed each tick — a dead"
+    " replica must not linger in the fleet view)",
+)
+fleet_attach_p99_seconds = global_registry.gauge(
+    "tpuc_fleet_attach_p99_seconds",
+    "Fleet-merged attach-to-ready p99 (identical-bucket histogram"
+    " summation across live replica processes, bucket-interpolated)",
+)
+fleet_queue_wait_p99_seconds = global_registry.gauge(
+    "tpuc_fleet_queue_wait_p99_seconds",
+    "Fleet-merged work-queue wait p99 across live replica processes",
+)
+fleet_publishes_total = global_registry.counter(
+    "tpuc_fleet_publishes_total",
+    "Telemetry snapshots this replica published into the shared store,"
+    " by outcome (ok | error; a dormant publisher — store without the"
+    " FleetTelemetry kind — counts nothing after its first probe)",
 )
 
 

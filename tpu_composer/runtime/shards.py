@@ -47,7 +47,6 @@ the single-leader path is untouched, bit-identical to every prior PR.
 from __future__ import annotations
 
 import logging
-import re
 import threading
 import time
 import zlib
@@ -55,7 +54,12 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from tpu_composer.api.lease import Lease, LeaseSpec
 from tpu_composer.api.meta import ObjectMeta, now_iso
-from tpu_composer.runtime.leases import RenewObservation, default_identity
+from tpu_composer.runtime import tracing
+from tpu_composer.runtime.leases import (
+    RenewObservation,
+    default_identity,
+    sanitize_identity as _sanitize,
+)
 from tpu_composer.runtime.metrics import (
     shard_handoffs_total,
     shard_ownership_gauge,
@@ -119,13 +123,6 @@ class ShardOwnership:
     def _discard(self, shard: int) -> None:
         with self._lock:
             self._owned.discard(shard)
-
-
-def _sanitize(identity: str) -> str:
-    """Lease object names must be DNS-1123-ish on a real apiserver; the
-    default identity carries an underscore (hostname_uuid)."""
-    out = re.sub(r"[^a-z0-9.-]+", "-", identity.lower()).strip("-.")
-    return out or "replica"
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -207,6 +204,11 @@ class ShardLeaseElector:
         # renew_time) pair on our monotonic clock.
         self._obs: Dict[str, RenewObservation] = {}
         self._failing = False  # fast-retry cadence while renewals fail
+        #: Tag the renew thread's trace events (adopt spans from the
+        #: on_acquire hooks) with this replica's identity pid. Default on
+        #: for direct harness use; cmd/main flips it off under --no-fleet
+        #: so the escape hatch leaves every event on plain os.getpid().
+        self.tag_traces = True
 
     # ------------------------------------------------------------------
     def shard_lease_name(self, shard: int) -> str:
@@ -611,6 +613,12 @@ class ShardLeaseElector:
         self._thread.start()
 
     def _loop(self) -> None:
+        # The renew thread runs the scoped-adoption on_acquire hooks, whose
+        # adopt spans must carry THIS replica's trace pid — a failover's
+        # post-crash adoption renders as the stealing replica's process in
+        # a merged fleet trace, not as an anonymous shared pid.
+        if self.tag_traces:
+            tracing.bind_thread(self.identity)
         fail_retry = min(1.0, self.renew_period_s)
         wait = 0.0  # first tick immediately
         while not self._stop.wait(wait):

@@ -168,6 +168,11 @@ class SloEngine:
         d_bad = bad_now - base[2]
         if d_total <= 0:
             return 0.0, 0.0, 0.0
+        # Clamp, don't trust, a shrinking bad count: a FLEET series can go
+        # backwards when a dead replica's snapshot ages out of the merge
+        # mid-window — a negative burn rate would read as "earning budget
+        # back", which no objective ever does.
+        d_bad = max(0.0, d_bad)
         return (d_bad / d_total) / budget, d_total, d_bad
 
     def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:

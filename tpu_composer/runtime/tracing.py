@@ -49,6 +49,7 @@ import os
 import threading
 import time
 import uuid
+import zlib
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -71,6 +72,58 @@ _sinks: List[Callable[[Dict[str, Any]], None]] = []
 #: flow-start to flow-finish on (name, cat, id), and the ids are unique.
 _FLOW_NAME = "causal"
 _FLOW_CAT = "flow"
+
+#: Fleet identity for the Chrome trace "pid" column. Real OS replicas get
+#: distinct os.getpid() values for free; the REPLICA tagging exists so (a)
+#: merged multi-replica traces carry human process names, and (b) in-proc
+#: replicas (bench harnesses, the shard-failover soak) render as distinct
+#: Perfetto processes even though they share one interpreter. The module
+#: default covers a whole process (cmd/main sets it once); bind_thread
+#: overrides per thread for in-proc multi-replica harnesses.
+_replica_default: Optional[Tuple[str, int]] = None
+_pid_names: Dict[int, str] = {}
+
+
+def replica_pid(identity: str) -> int:
+    """Stable pseudo-pid for a replica identity (crc32, PYTHONHASHSEED-
+    independent like shard_for): the same replica gets the same trace pid
+    across restarts, so multi-incarnation merges line up."""
+    return 100_000 + zlib.crc32(identity.encode("utf-8")) % 800_000
+
+
+def set_replica(identity: Optional[str]) -> None:
+    """Tag every event this PROCESS records with ``identity`` as its trace
+    pid (None restores plain os.getpid()). cmd/main calls this when the
+    fleet plane is on."""
+    global _replica_default
+    if identity is None:
+        _replica_default = None
+        return
+    pid = replica_pid(identity)
+    _pid_names[pid] = identity
+    _replica_default = (identity, pid)
+
+
+def bind_thread(identity: str) -> None:
+    """Tag events recorded by THIS thread with ``identity``'s trace pid —
+    the in-proc multi-replica hook: each replica's manager binds its
+    controller workers, dispatcher lanes and runnables, so one shared ring
+    still renders as N Perfetto processes."""
+    pid = replica_pid(identity)
+    _pid_names[pid] = identity
+    _tls.replica = (identity, pid)
+
+
+def current_replica() -> Optional[str]:
+    """The identity whose pid this thread's events carry (thread binding
+    first, then the process default), or None when untagged."""
+    bound = getattr(_tls, "replica", None) or _replica_default
+    return bound[0] if bound else None
+
+
+def _pid() -> int:
+    bound = getattr(_tls, "replica", None) or _replica_default
+    return bound[1] if bound else os.getpid()
 
 
 def _now_us() -> float:
@@ -112,7 +165,7 @@ class TraceContext:
         fid = _new_id()
         evt = {
             "name": _FLOW_NAME, "cat": _FLOW_CAT, "ph": "s", "id": fid,
-            "ts": _now_us(), "pid": os.getpid(), "tid": _tid(),
+            "ts": _now_us(), "pid": _pid(), "tid": _tid(),
             "args": {"trace_id": self.trace_id},
         }
         with _lock:
@@ -136,7 +189,7 @@ def _consume_flow(ctx: TraceContext, ts: Optional[float] = None) -> None:
     evt = {
         "name": _FLOW_NAME, "cat": _FLOW_CAT, "ph": "f", "bp": "e",
         "id": ctx.flow_id, "ts": ts if ts is not None else _now_us(),
-        "pid": os.getpid(), "tid": _tid(),
+        "pid": _pid(), "tid": _tid(),
         "args": {"trace_id": ctx.trace_id},
     }
     with _lock:
@@ -256,7 +309,7 @@ def span(
             "ph": "X",  # complete event
             "ts": begin,
             "dur": end - begin,
-            "pid": os.getpid(),
+            "pid": _pid(),
             "tid": _tid(),
             "id": sid,
             "args": {k: _safe(v) for k, v in args.items()},
@@ -291,13 +344,36 @@ def snapshot(
     return events
 
 
+def _process_name_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Chrome metadata events naming each known replica pid present in
+    ``events`` — Perfetto's process rail shows the identity, not a number."""
+    pids = {e.get("pid") for e in events}
+    return [
+        {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+         "args": {"name": name}}
+        for pid, name in sorted(_pid_names.items())
+        if pid in pids
+    ]
+
+
 def export_chrome(events: Optional[List[Dict[str, Any]]] = None) -> str:
     """Chrome trace-event format (the JSON Object flavor) — open in
     chrome://tracing or https://ui.perfetto.dev. Flow events ("ph": s/f)
-    render as arrows connecting spans across threads."""
+    render as arrows connecting spans across threads.
+
+    The export carries two merge anchors the ring events themselves lack:
+    ``process_name`` metadata events for every replica-tagged pid, and a
+    top-level ``metadata.epoch_us`` (the wall-clock instant of ts=0) so
+    :func:`merge_chrome` can align files from processes whose monotonic
+    trace clocks started at different moments."""
     if events is None:
         events = snapshot()
-    return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
+    epoch_us = time.time() * 1e6 - _now_us()
+    return json.dumps({
+        "traceEvents": _process_name_events(events) + events,
+        "displayTimeUnit": "ms",
+        "metadata": {"epoch_us": epoch_us},
+    })
 
 
 def write_file(path: Optional[str] = None) -> Optional[str]:
@@ -336,3 +412,161 @@ def trace_events(trace_id: str) -> List[Dict[str, Any]]:
         e for e in snapshot()
         if e.get("args", {}).get("trace_id") == trace_id
     ]
+
+
+# ----------------------------------------------------------------------
+# cross-process trace merging (the fleet observatory's stitch pass)
+# ----------------------------------------------------------------------
+def merge_chrome(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-replica Chrome trace documents into ONE stitched trace.
+
+    Three passes make a kill -9 failover render as a single connected
+    Perfetto story instead of N unrelated fragments:
+
+    1. **Clock alignment.** Each document's ``metadata.epoch_us`` (the
+       wall instant of its ts=0) shifts its events onto one shared
+       timeline — two processes' monotonic trace clocks start at
+       different moments, and unshifted spans would interleave nonsense.
+       Documents without the anchor (pre-fleet exports) merge unshifted.
+    2. **Pid disambiguation.** Documents whose events collide on a pid
+       (two unrelated hosts can reuse an OS pid) get the later file's
+       colliding pids remapped to a free range; replica-tagged pseudo-pids
+       (:func:`replica_pid`) are already collision-managed and keep their
+       values, so process_name metadata stays attached.
+    3. **Flow stitching.** Span events sharing one ``args.trace_id`` (the
+       durable intent nonce) across DIFFERENT pids get synthetic flow
+       start/finish pairs connecting each cross-pid neighbor in time order
+       — the arrow from replica A's pre-crash attach span to replica B's
+       post-crash adopt span that no single process could have emitted.
+       Stitched flows carry ``args.stitched = true`` so a reader can tell
+       reconstructed causality from recorded causality.
+    """
+    for doc in docs:
+        if not isinstance(doc, dict):
+            raise ValueError(
+                "trace document is not a JSON object — only the Chrome"
+                " JSON-Object flavor ({'traceEvents': [...]}) merges"
+            )
+    merged: List[Dict[str, Any]] = []
+    epochs = [
+        float((d.get("metadata") or {}).get("epoch_us") or 0.0) for d in docs
+    ]
+    known = [e for e in epochs if e > 0]
+    base = min(known) if known else 0.0
+    used_pids: set = set()
+    # pid -> process_name already merged under that pid. A colliding pid
+    # is kept only when both files NAME it identically (two incarnations
+    # of one replica — replica_pid is stable across restarts exactly so
+    # their files line up); unnamed or differently-named collisions are
+    # remapped. Read from the DOCUMENTS' own metadata, never from this
+    # process's registry — the trace-merge CLI runs in a process that
+    # recorded nothing.
+    pid_owner: Dict[int, str] = {}
+    used_ids: set = set()
+    max_id = 0
+    for doc, epoch in zip(docs, epochs):
+        events = [dict(e) for e in doc.get("traceEvents", [])]
+        shift = (epoch - base) if epoch > 0 else 0.0
+        doc_pids = {e.get("pid") for e in events if "pid" in e}
+        doc_names = {
+            e["pid"]: e["args"]["name"]
+            for e in events
+            if e.get("name") == "process_name"
+            and isinstance(e.get("args"), dict) and "name" in e["args"]
+        }
+        remap: Dict[int, int] = {}
+        for pid in sorted(p for p in doc_pids if isinstance(p, int)):
+            if pid not in used_pids:
+                continue
+            name = doc_names.get(pid, "")
+            if name and pid_owner.get(pid) == name:
+                continue  # same replica identity — same Perfetto process
+            new = pid
+            while new in used_pids:
+                new += 1_000_000
+            remap[pid] = new
+        # Event ids restart at 0 in every process, so every file reuses
+        # flow ids 1..N under the one (cat, name) flow key — colliding
+        # ids from a later file must be remapped or Perfetto binds
+        # causally unrelated flows across replicas. One mapping per file
+        # keeps its own s/f pairs intact; replacement ids dodge both the
+        # already-merged ids and this file's own (a replacement equal to
+        # a later id in the same file would be a fresh collision).
+        doc_ids = {
+            e["id"] for e in events if isinstance(e.get("id"), int)
+        }
+        id_remap: Dict[int, int] = {}
+        for e in events:
+            if shift and "ts" in e:
+                e["ts"] = e["ts"] + shift
+            if e.get("pid") in remap:
+                e["pid"] = remap[e["pid"]]
+            eid = e.get("id")
+            if isinstance(eid, int):
+                if eid in id_remap:
+                    e["id"] = id_remap[eid]
+                elif eid in used_ids:
+                    max_id += 1
+                    while max_id in used_ids or max_id in doc_ids:
+                        max_id += 1
+                    id_remap[eid] = max_id
+                    e["id"] = max_id
+                max_id = max(max_id, e["id"])
+        used_ids.update(
+            e["id"] for e in events if isinstance(e.get("id"), int)
+        )
+        used_pids.update(e.get("pid") for e in events if "pid" in e)
+        for pid, name in doc_names.items():
+            pid_owner.setdefault(remap.get(pid, pid), name)
+        merged.extend(events)
+
+    # Stitch: one synthetic flow per cross-pid neighbor pair per trace id.
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for e in merged:
+        if e.get("ph") != "X":
+            continue
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(e)
+    stitches: List[Dict[str, Any]] = []
+    next_id = max_id + 1
+    for trace_id, spans in by_trace.items():
+        if len({s["pid"] for s in spans}) < 2:
+            continue
+        spans.sort(key=lambda s: s.get("ts", 0.0))
+        for a, b in zip(spans, spans[1:]):
+            if a["pid"] == b["pid"]:
+                continue
+            args = {"trace_id": trace_id, "stitched": True}
+            stitches.append({
+                "name": _FLOW_NAME, "cat": _FLOW_CAT, "ph": "s",
+                "id": next_id, "ts": a["ts"] + a.get("dur", 0.0),
+                "pid": a["pid"], "tid": a.get("tid", 0), "args": dict(args),
+            })
+            stitches.append({
+                "name": _FLOW_NAME, "cat": _FLOW_CAT, "ph": "f", "bp": "e",
+                "id": next_id, "ts": b["ts"],
+                "pid": b["pid"], "tid": b.get("tid", 0), "args": dict(args),
+            })
+            next_id += 1
+    merged.extend(stitches)
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "epoch_us": base,
+            "merged_files": len(docs),
+            "stitched_flows": len(stitches) // 2,
+        },
+    }
+
+
+def merge_files(paths: List[str]) -> Dict[str, Any]:
+    """Load per-replica trace files (``write_file`` / crash-hook output)
+    and return the stitched merge — the ``tpu-composer trace-merge``
+    subcommand's engine."""
+    docs = []
+    for path in paths:
+        with open(path) as f:
+            docs.append(json.load(f))
+    return merge_chrome(docs)
