@@ -4,7 +4,7 @@
 PYTHON ?= python
 IMG ?= tpu-composer:latest
 
-.PHONY: all test test-fast bench bench-round manifests native lint run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak conformance
+.PHONY: all test test-fast bench bench-round manifests native lint run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak migrate-soak conformance
 
 all: native test
 
@@ -95,6 +95,19 @@ chaos-soak:
 ## (TPUC_FLIGHT_FILE / TPUC_TRACE_FILE dumped + uploaded on CI failure).
 repair-soak:
 	$(PYTHON) -m pytest tests/test_repair_soak.py -q -m repair -p no:randomly
+
+## migrate-soak: live-migration kill–restart soak (tests/test_crash_restart.py
+## TestMigrationCrashSoak, markers slow+migrate): a NodeMaintenance drain on a
+## node under a live 2-host slice is hard-killed at EVERY operator write inside
+## the migration (cordon, evacuation mark, replacement create, Migrating mark,
+## cutover coordinate flip, grace stamp, source-detach chain), restarted
+## against the same store + fabric, and required to converge: node empty,
+## maintenance Drained, chips conserved, zero nonce-checked double-attaches,
+## and the make-before-break order intact — the source member is never
+## released before a replacement-era attach is live. Same black-box contract
+## as the other soaks (TPUC_FLIGHT_FILE / TPUC_TRACE_FILE on CI failure).
+migrate-soak:
+	$(PYTHON) -m pytest tests/test_crash_restart.py -q -m migrate -p no:randomly
 
 ## shard-soak: shard-failover chaos soak (tests/test_shard_failover.py,
 ## markers slow+shard): three full operator replicas over one shared store

@@ -911,6 +911,182 @@ def bench_event_plane(ops: int = 16, poll_interval: float = 0.5,
     }
 
 
+def bench_migration(async_delay: float = 0.05, grace_s: float = 0.0):
+    """Live slice migration vs delete/re-solve: evacuation time and
+    JOB-VISIBLE pause, same world both ways.
+
+    World: 4 nodes x 4 slots, one Running 2-host slice, fabric attach
+    completing server-side after ``async_delay`` (the event-plane pool
+    mode) so the make-before-break overlap has something real to hide. A
+    sampler watches worker coverage (every worker id has an Online member)
+    at ~2 ms; the pause is the cumulative uncovered time between drain
+    start and convergence:
+
+    - **migration**: a NodeMaintenance drain — replacement attaches while
+      the source keeps serving, coordinates cut over, source detaches.
+      Pause ~0: no worker ever loses its Online member.
+    - **delete/re-solve** (the pre-migration defrag/evacuation shape):
+      the member is deleted and the owner re-solves — the worker is dark
+      for the whole re-attach.
+    """
+    import threading as _threading
+
+    from tpu_composer.api import (
+        ComposabilityRequest,
+        ComposabilityRequestSpec,
+        ComposableResource,
+        Node,
+        NodeMaintenance,
+        NodeMaintenanceSpec,
+        ObjectMeta,
+        ResourceDetails,
+    )
+    from tpu_composer.api.types import LABEL_MANAGED_BY, REQUEST_STATE_RUNNING
+    from tpu_composer.agent.fake import FakeNodeAgent
+    from tpu_composer.controllers import (
+        ComposabilityRequestReconciler,
+        ComposableResourceReconciler,
+        MaintenanceTiming,
+        NodeMaintenanceReconciler,
+        RequestTiming,
+        ResourceTiming,
+    )
+    from tpu_composer.fabric.dispatcher import FabricDispatcher
+    from tpu_composer.fabric.inmem import InMemoryPool
+    from tpu_composer.runtime.manager import Manager
+    from tpu_composer.runtime.store import Store
+
+    def one_world():
+        store = Store()
+        for i in range(4):
+            n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+            n.status.tpu_slots = 4
+            store.create(n)
+        pool = InMemoryPool(async_delay=async_delay)
+        dispatcher = FabricDispatcher(pool, batch_window=0.005,
+                                      poll_interval=0.01)
+        mgr = Manager(store=store, dispatcher=dispatcher)
+        mgr.add_controller(ComposabilityRequestReconciler(
+            store, pool,
+            timing=RequestTiming(updating_poll=0.01, cleaning_poll=0.01,
+                                 running_poll=0.2, repair_poll=0.01)))
+        mgr.add_controller(ComposableResourceReconciler(
+            store, pool, FakeNodeAgent(pool=pool), dispatcher=dispatcher,
+            timing=ResourceTiming(attach_poll=0.01, visibility_poll=0.01,
+                                  detach_poll=0.01, detach_fast=0.01,
+                                  busy_poll=0.01)))
+        mgr.add_controller(NodeMaintenanceReconciler(
+            store, timing=MaintenanceTiming(drain_poll=0.01)))
+        mgr.add_runnable(dispatcher.run)
+        mgr.start(workers_per_controller=4)
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="job"),
+            spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                type="tpu", model="tpu-v4", size=8),
+                repair_grace_seconds=grace_s),
+        ))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            req = store.try_get(ComposabilityRequest, "job")
+            if req is not None and req.status.state == REQUEST_STATE_RUNNING:
+                live = [c for c in store.list(ComposableResource)
+                        if not c.being_deleted]
+                if len(live) == 2 and all(
+                    c.status.state == "Online" for c in live
+                ):
+                    return store, pool, mgr, dispatcher, req
+            time.sleep(0.005)
+        raise RuntimeError("migration bench world never reached Running")
+
+    def workers_covered(store, num_workers=2):
+        # A Migrating source is still attached and serving (that is the
+        # whole point of make-before-break); only a worker with neither an
+        # Online nor a Migrating member is dark.
+        covered = set()
+        for c in store.list(ComposableResource):
+            if not c.being_deleted and c.status.state in (
+                "Online", "Migrating",
+            ) and c.metadata.labels.get(LABEL_MANAGED_BY) == "job":
+                covered.add(c.spec.worker_id)
+        return len(covered) >= num_workers
+
+    def measure(evacuate, settled):
+        store, pool, mgr, dispatcher, req = one_world()
+        victim_node = req.status.slice.worker_hostnames[0]
+        pause = {"s": 0.0}
+        stop = _threading.Event()
+
+        def sampler():
+            last = time.perf_counter()
+            while not stop.is_set():
+                time.sleep(0.002)
+                now = time.perf_counter()
+                try:
+                    if not workers_covered(store):
+                        pause["s"] += now - last
+                except Exception:
+                    pass
+                last = now
+
+        t = _threading.Thread(target=sampler, daemon=True)
+        try:
+            t0 = time.perf_counter()
+            t.start()
+            evacuate(store, victim_node)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if settled(store, victim_node):
+                    break
+                time.sleep(0.005)
+            else:
+                raise RuntimeError("evacuation never settled")
+            evac_s = time.perf_counter() - t0
+        finally:
+            stop.set()
+            t.join(timeout=2)
+            mgr.stop()
+            dispatcher.kill()
+        return {"evacuation_s": round(evac_s, 4),
+                "job_visible_pause_s": round(pause["s"], 4)}
+
+    def node_empty_and_running(store, node):
+        req = store.try_get(ComposabilityRequest, "job")
+        if req is None or req.status.state != REQUEST_STATE_RUNNING:
+            return False
+        live = [c for c in store.list(ComposableResource)
+                if not c.being_deleted]
+        return (
+            len(live) == 2
+            and all(c.status.state == "Online" for c in live)
+            and not any(c.spec.target_node == node for c in live)
+        )
+
+    def drain_migrate(store, node):
+        store.create(NodeMaintenance(
+            metadata=ObjectMeta(name="bench-drain"),
+            spec=NodeMaintenanceSpec(node_name=node),
+        ))
+
+    def drain_delete(store, node):
+        # The pre-migration evacuation shape (old defrag executor): delete
+        # the member; cordon the node so the re-solve lands elsewhere
+        # (matching what the drain achieves, minus the live move).
+        from tpu_composer.agent.publisher import DevicePublisher
+
+        DevicePublisher(store).quarantine_node(node, "bench-delete-drain")
+        for c in store.list(ComposableResource):
+            if c.spec.target_node == node and not c.being_deleted:
+                store.delete(ComposableResource, c.metadata.name)
+
+    migrate = measure(drain_migrate, node_empty_and_running)
+    delete = measure(drain_delete, node_empty_and_running)
+    return {
+        "async_delay_s": async_delay,
+        "migrate": migrate,
+        "delete_resolve": delete,
+    }
+
+
 def _lock_wait_snapshot():
     """Per-lock (sum_seconds, acquires) from tpuc_lock_wait_seconds."""
     from tpu_composer.runtime.metrics import lock_wait_seconds
@@ -1190,6 +1366,20 @@ def main():
         shard_scaling = bench_shard_scaling()
     except Exception as e:
         shard_scaling = {"error": str(e)}
+    # Headline carries the compact curve (throughput + latency per replica
+    # count); the per-replica ownership split and fleet view live in
+    # bench_full.json — PR 11's split fattened the block past the headline
+    # budget and silently dropped the whole curve from the trajectory.
+    if isinstance(shard_scaling, dict) and "error" not in shard_scaling:
+        shard_headline = {
+            k: {kk: v.get(kk) for kk in (
+                "placements_per_sec", "p50_ms", "p99_ms",
+                "fleet_attach_p99_ms",
+            ) if v.get(kk) is not None}
+            for k, v in shard_scaling.items()
+        }
+    else:
+        shard_headline = shard_scaling
     try:
         _, hot_shard = profile_during(
             bench_shard_scaling, replica_counts=(2,),
@@ -1208,6 +1398,21 @@ def main():
         }
     except Exception as e:
         event_plane = {"error": str(e)}
+    # Live migration vs delete/re-solve: evacuation time and job-visible
+    # pause for the same node drain (the make-before-break dividend).
+    try:
+        mig = bench_migration()
+        migration = {
+            "evacuation_ms": round(mig["migrate"]["evacuation_s"] * 1e3, 1),
+            "pause_ms": round(
+                mig["migrate"]["job_visible_pause_s"] * 1e3, 1),
+            "delete_evacuation_ms": round(
+                mig["delete_resolve"]["evacuation_s"] * 1e3, 1),
+            "delete_pause_ms": round(
+                mig["delete_resolve"]["job_visible_pause_s"] * 1e3, 1),
+        }
+    except Exception as e:
+        migration = {"error": str(e)}
     try:
         accel = bench_accelerator()
     except ImportError as e:
@@ -1242,9 +1447,10 @@ def main():
         "raw_inproc_p90_ms": round(attach_raw["p90"], 3),
         "raw_inproc_store_rtts": attach_raw["rtts_per_attach"],
         "baseline_p50_ms": REFERENCE_P50_MS,
-        "shard_scaling": shard_scaling,
+        "shard_scaling": shard_headline,
         "hot_spots": {"attach_32chip": hot_32, "shard_2replica": hot_shard},
         "event_plane": event_plane,
+        "migration": migration,
         "phase_durations": phase_durations,
         "accelerator": summarize_accelerator(accel),
         "full_record": "bench_artifacts/bench_full.json",
@@ -1264,7 +1470,9 @@ def main():
         with open(os.path.join(art_dir, "bench_full.json"), "w") as f:
             json.dump({"headline": {k: v for k, v in out.items()
                                     if k != "extra"},
-                       "extra": {**extra, "accelerator": accel}}, f, indent=1)
+                       "extra": {**extra, "accelerator": accel,
+                                 "shard_scaling": shard_scaling}},
+                      f, indent=1)
     except OSError:
         pass
 

@@ -88,6 +88,13 @@ def pytest_configure(config):
         " under Ready slices; always also marked slow; run with"
         " `make repair-soak` or `pytest -m repair`)",
     )
+    config.addinivalue_line(
+        "markers",
+        "migrate: live-migration / maintenance-drain soak (kill–restart"
+        " fuse scan across every migration intent point; always also"
+        " marked slow; run with `make migrate-soak` or"
+        " `pytest -m migrate`)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -100,7 +100,8 @@ class TestSerde:
         assert isinstance(obj, ComposabilityRequest)
         assert set(s.kinds()) == {
             "ComposabilityRequest", "ComposableResource", "Node",
-            "Lease", "FleetTelemetry", "ResourceSlice", "DeviceTaintRule",
+            "NodeMaintenance", "Lease", "FleetTelemetry", "ResourceSlice",
+            "DeviceTaintRule",
         }
 
     def test_deepcopy_isolation(self):

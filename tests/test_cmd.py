@@ -275,6 +275,59 @@ class TestBuildManager:
         finally:
             mgr.stop()
 
+    def test_migrate_default_and_escape_hatch(self, monkeypatch, tmp_path):
+        """Default wiring constructs the live-migration verb end to end:
+        the NodeMaintenance drain controller, the request controller's
+        migration driver (flag-configured knobs), and the defrag executor
+        in migrate mode. TPUC_MIGRATE=0 (or --no-migrate) constructs NONE
+        of it — no maintenance controller, driver disabled, defrag back to
+        delete/re-solve — bit-identical to the pre-migration operator."""
+        monkeypatch.setenv("CDI_PROVIDER_TYPE", "MOCK")
+        monkeypatch.delenv("NODE_AGENT", raising=False)
+        from tpu_composer.controllers import (
+            ComposabilityRequestReconciler,
+            NodeMaintenanceReconciler,
+        )
+        from tpu_composer.fabric.adapter import reset_shared_mock
+
+        reset_shared_mock()
+        args = build_parser().parse_args([
+            "--state-dir", str(tmp_path / "s1"),
+            "--migrate-max-concurrent", "5",
+            "--migrate-breaker-fraction", "0.4",
+            "--migrate-drain-deadline", "123",
+        ])
+        assert args.migrate is True
+        mgr = build_manager(args)
+        try:
+            maint = [c for c in mgr._controllers
+                     if isinstance(c, NodeMaintenanceReconciler)]
+            assert len(maint) == 1
+            assert maint[0].timing.default_deadline == 123
+            req = next(c for c in mgr._controllers
+                       if isinstance(c, ComposabilityRequestReconciler))
+            assert req.migrate.enabled is True
+            assert req.migrate.max_concurrent == 5
+            assert req.migrate.breaker_fraction == 0.4
+            assert req.scheduler.defrag.mode == "migrate"
+        finally:
+            mgr.stop()
+
+        monkeypatch.setenv("TPUC_MIGRATE", "0")
+        reset_shared_mock()
+        args = build_parser().parse_args(["--state-dir", str(tmp_path / "s2")])
+        assert args.migrate is False
+        mgr = build_manager(args)
+        try:
+            assert not any(isinstance(c, NodeMaintenanceReconciler)
+                           for c in mgr._controllers)
+            req = next(c for c in mgr._controllers
+                       if isinstance(c, ComposabilityRequestReconciler))
+            assert req.migrate.enabled is False
+            assert req.scheduler.defrag.mode == "delete"
+        finally:
+            mgr.stop()
+
     def test_default_shards_is_unsharded_single_leader_path(
         self, monkeypatch, tmp_path
     ):
@@ -501,7 +554,17 @@ class TestCrdGen:
             "tpu.composer.dev_composabilityrequests.yaml",
             "tpu.composer.dev_composableresources.yaml",
             "tpu.composer.dev_fleettelemetries.yaml",
+            "tpu.composer.dev_nodemaintenances.yaml",
         }
+        maint = docs["tpu.composer.dev_nodemaintenances.yaml"]
+        maint_spec = (maint["spec"]["versions"][0]["schema"]
+                      ["openAPIV3Schema"]["properties"]["spec"])
+        assert maint_spec["required"] == ["node_name"]
+        maint_states = (maint["spec"]["versions"][0]["schema"]
+                        ["openAPIV3Schema"]["properties"]["status"]
+                        ["properties"]["state"]["enum"])
+        assert maint_states == ["", "Cordoned", "Draining", "Drained",
+                                "Aborted"]
         fleet = docs["tpu.composer.dev_fleettelemetries.yaml"]
         fleet_spec = (fleet["spec"]["versions"][0]["schema"]
                       ["openAPIV3Schema"]["properties"]["spec"])
@@ -523,7 +586,7 @@ class TestCrdGen:
         from tpu_composer.api.crdgen import write_manifests
 
         paths = write_manifests(str(tmp_path))
-        assert len(paths) == 3
+        assert len(paths) == 4
         for p in paths:
             with open(p) as f:
                 doc = yaml.safe_load(f)

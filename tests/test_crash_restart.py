@@ -48,6 +48,8 @@ from tpu_composer.agent.publisher import is_node_quarantine_marker
 from tpu_composer.controllers import (
     ComposabilityRequestReconciler,
     ComposableResourceReconciler,
+    MaintenanceTiming,
+    NodeMaintenanceReconciler,
     RequestTiming,
     ResourceTiming,
     UpstreamSyncer,
@@ -210,13 +212,19 @@ class Incarnation:
             lambda: adopt_pending_ops(self.client, pool, self.dispatcher))
         self.mgr.add_controller(ComposabilityRequestReconciler(
             self.client, pool,
-            timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.05)))
+            timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.05,
+                                 repair_poll=0.05)))
         self.mgr.add_controller(ComposableResourceReconciler(
             self.client, pool, agent,
             timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.05,
                                   detach_poll=0.05, detach_fast=0.05,
                                   busy_poll=0.05),
             dispatcher=self.dispatcher))
+        # Live-migration verb (default wiring): the maintenance drain
+        # controller rides along so the migration crash soak can hard-stop
+        # mid-drain; inert for worlds without NodeMaintenance objects.
+        self.mgr.add_controller(NodeMaintenanceReconciler(
+            self.client, timing=MaintenanceTiming(drain_poll=0.05)))
         # Anti-drift backstop, grace wide enough that the ms-wide "attach
         # landed, status write in flight" window (and the crash-to-restart
         # gap) never false-positives as a leak.
@@ -560,6 +568,182 @@ class TestGracefulDrain:
             dispatcher.add_resource(res)
         assert pool.get_resources() == []  # nothing reached the fabric
         dispatcher.stop()
+
+
+# ----------------------------------------------------------------------
+# live migration under kill -9 (ISSUE 13): crash at every intent point
+# ----------------------------------------------------------------------
+def _migration_setup(async_steps=1):
+    """World with wave-a (2 hosts x 4 chips) + wave-b (1 host x 4) Running
+    — one free node left, exactly enough for one migrated member."""
+    store = _fresh_world()
+    pool = RecordingPool(async_steps=async_steps)
+    inc = Incarnation(store, pool, cached=False, batched=True)
+    _submit_wave(store)
+    assert wait_for(lambda: _all_running(store)), "setup attach"
+    inc.kill()
+    from tpu_composer.api import ComposabilityRequest as _CR
+
+    req = store.get(_CR, "wave-a")
+    victim_node = req.status.slice.worker_hostnames[0]
+    pre_members = {
+        c.metadata.name for c in store.list(ComposableResource)
+        if not c.being_deleted
+    }
+    sources = {
+        c.metadata.name for c in store.list(ComposableResource)
+        if c.spec.target_node == victim_node and not c.being_deleted
+    }
+    return store, pool, victim_node, pre_members, sources
+
+
+def _submit_drain(store, node):
+    from tpu_composer.api import NodeMaintenance, NodeMaintenanceSpec
+
+    store.create(NodeMaintenance(
+        metadata=ObjectMeta(name="drain"),
+        spec=NodeMaintenanceSpec(node_name=node),
+    ))
+
+
+def _drain_converged(store, node):
+    from tpu_composer.api import NodeMaintenance
+    from tpu_composer.api.maintenance import MAINTENANCE_STATE_DRAINED
+
+    try:
+        m = store.try_get(NodeMaintenance, "drain")
+        if m is None or m.status.state != MAINTENANCE_STATE_DRAINED:
+            return False
+        if any(
+            c.spec.target_node == node
+            for c in store.list(ComposableResource) if not c.being_deleted
+        ):
+            return False
+        return _all_running(store)
+    except Exception:
+        return False
+
+
+def _assert_drain_converged(store, pool, node, sources):
+    """Post-drain invariants: node empty, chips conserved, every intent
+    retired, the source never released before a replacement (a member
+    that joined after drain start) was attached — make-before-break held
+    across the kill — and one fabric mutation per intent nonce."""
+    for res in store.list(ComposableResource):
+        assert res.status.pending_op is None, res.status.to_dict()
+        assert not res.status.quarantined, res.status.to_dict()
+    assert not [
+        d for d in pool.get_resources() if d.node == node
+    ], "drained node still holds fabric attachments"
+    assert len(pool.get_resources()) == 12
+    assert pool.free_chips("tpu-v4") == 64 - 12
+    assert_no_double_attach(pool.events)
+    # Make-before-break across the crash: each evacuated source's release
+    # happens strictly after an attach of a post-drain member that was
+    # still attached at release time.
+    for src in sources:
+        rel_idx = next(
+            (i for i, ev in enumerate(pool.events)
+             if ev[0] == "release" and ev[1] == src), None,
+        )
+        assert rel_idx is not None, f"source {src} never released"
+        attached_new = set()
+        for ev in pool.events[:rel_idx]:
+            if ev[0] == "attach" and ev[1] not in sources:
+                attached_new.add(ev[1])
+            elif ev[0] == "release":
+                attached_new.discard(ev[1])
+        # At least one replacement-era member (not an original source)
+        # attached and still attached when the source was released. The
+        # original siblings count too — but they attached before the
+        # sources released, so the invariant is only satisfiable by the
+        # make-before-break ordering for the drained node's member.
+        assert attached_new, (
+            f"source {src} released with no live replacement attach"
+            f" before it: {pool.events}"
+        )
+
+
+class TestMigrationCrashRestart:
+    """Tier-1 smoke: one deterministic kill mid-migration (the midpoint
+    intent write) converges after restart with zero double-attach and the
+    source never detached before its replacement was Online. The full
+    every-intent-point scan is the slow+migrate soak below."""
+
+    def test_midpoint_crash_converges(self):
+        store, pool, node, pre, sources = _migration_setup()
+        # Control: count the migration phase's operator writes.
+        inc = Incarnation(store, pool, cached=False, batched=True)
+        _submit_drain(store, node)
+        assert wait_for(lambda: _drain_converged(store, node), timeout=30), (
+            "control drain never converged"
+        )
+        w_migrate = inc.fuse.mutations
+        inc.kill()
+        _assert_drain_converged(store, pool, node, sources)
+        assert w_migrate > 3, "fuse range is meaningless"
+
+        # Crash at the midpoint intent write, restart, converge.
+        store, pool, node, pre, sources = _migration_setup()
+        inc = Incarnation(store, pool, cached=False, batched=True,
+                          fuse=max(1, w_migrate // 2))
+        _submit_drain(store, node)
+        wait_for(lambda: inc.fuse.dead.is_set()
+                 or _drain_converged(store, node), timeout=20)
+        inc.kill()
+        inc = Incarnation(store, pool, cached=False, batched=True)
+        try:
+            assert wait_for(
+                lambda: _drain_converged(store, node), timeout=30,
+            ), (
+                "post-crash drain never converged: "
+                + repr([r.status.to_dict()
+                        for r in store.list(ComposableResource)])
+            )
+            _assert_drain_converged(store, pool, node, sources)
+        finally:
+            inc.kill()
+
+
+@pytest.mark.slow
+@pytest.mark.migrate
+class TestMigrationCrashSoak:
+    """The full fuse scan: kill -9 at EVERY operator write inside the
+    maintenance drain + live migration (cordon write, evacuation mark,
+    replacement create, Migrating mark, migration record, cutover
+    coordinate flip, grace stamp, source detach chain), restart, and
+    require convergence with zero double-attach and the make-before-break
+    event order intact."""
+
+    def test_every_intent_point_converges(self):
+        store, pool, node, pre, sources = _migration_setup()
+        inc = Incarnation(store, pool, cached=False, batched=True)
+        _submit_drain(store, node)
+        assert wait_for(lambda: _drain_converged(store, node), timeout=30)
+        w_migrate = inc.fuse.mutations
+        inc.kill()
+        _assert_drain_converged(store, pool, node, sources)
+
+        for fuse in range(1, w_migrate + 1):
+            store, pool, node, pre, sources = _migration_setup()
+            inc = Incarnation(store, pool, cached=False, batched=True,
+                              fuse=fuse)
+            _submit_drain(store, node)
+            wait_for(lambda: inc.fuse.dead.is_set()
+                     or _drain_converged(store, node), timeout=20)
+            inc.kill()
+            inc = Incarnation(store, pool, cached=False, batched=True)
+            try:
+                assert wait_for(
+                    lambda: _drain_converged(store, node), timeout=30,
+                ), (
+                    f"[fuse={fuse}] drain never converged after restart: "
+                    + repr([r.status.to_dict()
+                            for r in store.list(ComposableResource)])
+                )
+                _assert_drain_converged(store, pool, node, sources)
+            finally:
+                inc.kill()
 
 
 # ----------------------------------------------------------------------

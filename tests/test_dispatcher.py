@@ -487,6 +487,67 @@ class TestReconcilerParity:
             disp.stop()
 
 
+class TestMigrationOrderedPairs:
+    """`remove_resource(after=("add", repl))`: a migrating source's detach
+    parks — cross-lane — until the replacement's attach settles, so the
+    fabric can never see the release before the attach even if controller
+    sequencing raced (crash replay, adoption re-drives)."""
+
+    def test_remove_waits_for_named_add_cross_lane(self, pool):
+        gate = threading.Event()
+        real_add = pool.add_resource
+
+        def slow_add(r):
+            gate.wait(5)
+            return real_add(r)
+
+        pool.add_resource = slow_add
+        pool._group = False  # force the single verb through slow_add
+        d = new_dispatcher(pool, batch_window=0.0)
+        try:
+            # Source attached directly (it pre-exists the migration).
+            src = cr("src", node="node-a")
+            pool.add_resource = real_add
+            consume_add(d, src)
+            pool.add_resource = slow_add
+            # Replacement attach on node-b is stuck at the provider.
+            repl = cr("repl", node="node-b")
+            with pytest.raises(DispatchedAttaching):
+                d.add_resource(repl)
+            # The source's detach is ordered after it — must NOT reach the
+            # provider while the add is live.
+            with pytest.raises(DispatchedDetaching):
+                d.remove_resource(src, after=("add", "repl"))
+            time.sleep(0.1)
+            assert ("remove", "src") not in pool.mutation_order()
+            assert d.op_state("remove", "src") == "queued"
+            # Attach completes -> the parked remove proceeds.
+            gate.set()
+            drain(d, "add", "repl")
+            drain(d, "remove", "src")
+            order = pool.mutation_order()
+            assert order.index(("add", "repl")) < order.index(
+                ("remove", "src")
+            )
+        finally:
+            gate.set()
+            d.stop()
+
+    def test_settled_or_unknown_target_imposes_no_wait(self, pool):
+        d = new_dispatcher(pool, batch_window=0.0)
+        try:
+            src = cr("src2", node="node-a")
+            consume_add(d, src)
+            # The named add never existed in this process (restart case):
+            # the remove proceeds immediately.
+            with pytest.raises(DispatchedDetaching):
+                d.remove_resource(src, after=("add", "ghost-repl"))
+            assert drain(d, "remove", "src2") in ("done", None)
+            assert ("remove", "src2") in pool.mutation_order()
+        finally:
+            d.stop()
+
+
 class TestCompletionLatch:
     def test_latch_requeues_key_on_completion(self):
         store, pool, chaos, rec, disp = make_world(True)

@@ -1,0 +1,830 @@
+"""Live slice migration + node maintenance drains (ISSUE 13 tentpole).
+
+Tier-1 acceptance spine: a NodeMaintenance on a host carrying live slice
+members cordons it (durable quarantine marker, distinct maintenance
+reason), the owning requests' migration drivers move every member
+make-before-break (replacement Online BEFORE the source detaches, the
+coordinate cutover being the slice-change event workloads reshard on), the
+node empties before the deadline, and the window lifts when the object is
+deleted. Alongside: deadline-expiry abort semantics, per-request + fleet
+surge budgets, the fleet migration breaker freezing evacuation during a
+brownout, node-escalation evacuation, and the defrag executor's migrate
+mode (defrag becomes safe against live jobs). The kill–restart
+every-intent-point scan lives in test_crash_restart.py (markers
+slow+migrate -> `make migrate-soak`).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.agent.publisher import node_quarantined
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    NodeMaintenance,
+    NodeMaintenanceSpec,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.api.maintenance import (
+    MAINTENANCE_STATE_ABORTED,
+    MAINTENANCE_STATE_DRAINED,
+)
+from tpu_composer.api.types import (
+    ANNOTATION_EVACUATE,
+    ANNOTATION_REPLACES,
+    REPAIR_NONE,
+    REQUEST_STATE_RUNNING,
+    RESOURCE_STATE_DEGRADED,
+    RESOURCE_STATE_MIGRATING,
+    RESOURCE_STATE_ONLINE,
+)
+from tpu_composer.controllers import (
+    ComposabilityRequestReconciler,
+    ComposableResourceReconciler,
+    MaintenanceTiming,
+    MigrateConfig,
+    NodeMaintenanceReconciler,
+    RequestTiming,
+    ResourceTiming,
+)
+from tpu_composer.controllers.request_controller import RepairConfig
+from tpu_composer.fabric.chaos import ChaosFabricProvider
+from tpu_composer.fabric.inmem import InMemoryPool
+from tpu_composer.fabric.provider import FabricError
+from tpu_composer.runtime.metrics import (
+    migration_breaker_open,
+    migrations_total,
+    node_maintenances_active,
+)
+from tpu_composer.runtime.store import Store
+from tpu_composer.scheduler import DefragLoop
+
+MODEL = "tpu-v4"
+
+
+def make_world(nodes=4, slots=8, chips=64, migrate=None, repair=None,
+               failure_threshold=2, recovery_threshold=1,
+               node_degrade_threshold=0, default_deadline=1800.0):
+    """Step-driven harness (no Manager threads): store + chaos-wrapped
+    mock pool + request/resource/maintenance reconcilers."""
+    store = Store()
+    for i in range(nodes):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = slots
+        store.create(n)
+    pool = InMemoryPool(chips={MODEL: chips})
+    chaos = ChaosFabricProvider(pool)
+    agent = FakeNodeAgent(pool=pool)
+    req_rec = ComposabilityRequestReconciler(
+        store, chaos,
+        timing=RequestTiming(updating_poll=0.01, cleaning_poll=0.01,
+                             running_poll=5.0, repair_poll=0.01),
+        repair=repair or RepairConfig(),
+        migrate=migrate or MigrateConfig(),
+    )
+    res_rec = ComposableResourceReconciler(
+        store, chaos, agent,
+        timing=ResourceTiming(
+            health_failure_threshold=failure_threshold,
+            health_recovery_threshold=recovery_threshold,
+            node_degrade_threshold=node_degrade_threshold,
+        ),
+    )
+    maint_rec = NodeMaintenanceReconciler(
+        store,
+        timing=MaintenanceTiming(drain_poll=0.01,
+                                 default_deadline=default_deadline),
+        publisher=res_rec.publisher,
+    )
+    return store, pool, chaos, req_rec, res_rec, maint_rec
+
+
+def make_request(store, name="req-1", size=8, **spec_kw):
+    store.create(ComposabilityRequest(
+        metadata=ObjectMeta(name=name),
+        spec=ComposabilityRequestSpec(
+            resource=ResourceDetails(type="tpu", model=MODEL, size=size),
+            **spec_kw,
+        ),
+    ))
+
+
+def members(store):
+    return [c for c in store.list(ComposableResource) if not c.being_deleted]
+
+
+def converged(store, name="req-1"):
+    req = store.try_get(ComposabilityRequest, name)
+    if req is None:
+        return False
+    live = [c for c in members(store)
+            if c.metadata.labels.get("app.kubernetes.io/managed-by") == name]
+    return (
+        req.status.state == REQUEST_STATE_RUNNING
+        and len(live) == req.status.slice.num_hosts
+        and all(c.status.state == RESOURCE_STATE_ONLINE for c in live)
+    )
+
+
+def pump(store, req_rec, res_rec, maint_rec, steps=120, invariant=None,
+         done=None, sleep=0.0):
+    """One event-loop turn per step: every maintenance object, every
+    request, every resource."""
+    for _ in range(steps):
+        for m in store.list(NodeMaintenance):
+            try:
+                maint_rec.reconcile(m.metadata.name)
+            except FabricError:
+                pass
+        for r in store.list(ComposabilityRequest):
+            try:
+                req_rec.reconcile(r.metadata.name)
+            except FabricError:
+                pass
+        for c in store.list(ComposableResource):
+            try:
+                res_rec.reconcile(c.metadata.name)
+            except FabricError:
+                pass
+        if invariant is not None:
+            invariant()
+        if done is not None and done():
+            return
+        if sleep:
+            time.sleep(sleep)
+
+
+def to_running(store, req_rec, res_rec, maint_rec, name="req-1"):
+    pump(store, req_rec, res_rec, maint_rec,
+         done=lambda: converged(store, name))
+    req = store.get(ComposabilityRequest, name)
+    assert req.status.state == REQUEST_STATE_RUNNING, req.status.to_dict()
+    return req
+
+
+def no_duplicate_attachments(pool):
+    ids = [d.device_id for d in pool.get_resources()]
+    assert len(ids) == len(set(ids)), f"duplicate attachments: {ids}"
+
+
+def drain(store, node, name="mx", deadline=0.0):
+    store.create(NodeMaintenance(
+        metadata=ObjectMeta(name=name),
+        spec=NodeMaintenanceSpec(node_name=node,
+                                 deadline_seconds=deadline),
+    ))
+
+
+# ---------------------------------------------------------------------------
+# NodeMaintenance drain: the e2e acceptance spine
+# ---------------------------------------------------------------------------
+
+class TestMaintenanceDrain:
+    def test_drain_migrates_make_before_break(self):
+        store, pool, chaos, req_rec, res_rec, maint_rec = make_world()
+        make_request(store, size=8)  # 2 hosts x 4 chips
+        req = to_running(store, req_rec, res_rec, maint_rec)
+        victim_node = req.status.slice.worker_hostnames[0]
+        source = next(c for c in members(store)
+                      if c.spec.target_node == victim_node)
+        started = migrations_total.value(trigger="maintenance",
+                                         outcome="started")
+        cutover = migrations_total.value(trigger="maintenance",
+                                         outcome="cutover")
+        completed = migrations_total.value(trigger="maintenance",
+                                           outcome="completed")
+
+        drain(store, victim_node)
+
+        # Make-before-break invariant, checked every turn: the source may
+        # only disappear after its replacement is Online.
+        seen = {"repl_online_before_source_gone": False}
+
+        def invariant():
+            no_duplicate_attachments(pool)
+            src = store.try_get(ComposableResource, source.name)
+            repl = next(
+                (c for c in store.list(ComposableResource)
+                 if c.metadata.annotations.get(ANNOTATION_REPLACES)
+                 == source.name),
+                None,
+            )
+            if repl is not None and repl.status.state == RESOURCE_STATE_ONLINE:
+                seen["repl_online_before_source_gone"] = True
+            if src is None or src.being_deleted:
+                assert seen["repl_online_before_source_gone"], (
+                    "source detached before its replacement was Online"
+                )
+            # The cordon holds for the whole drain.
+            assert node_quarantined(store, victim_node)
+
+        def done():
+            m = store.try_get(NodeMaintenance, "mx")
+            return (m is not None
+                    and m.status.state == MAINTENANCE_STATE_DRAINED
+                    and converged(store))
+
+        pump(store, req_rec, res_rec, maint_rec, invariant=invariant,
+             done=done)
+        m = store.get(NodeMaintenance, "mx")
+        assert m.status.state == MAINTENANCE_STATE_DRAINED, (
+            m.status.to_dict()
+        )
+        assert m.status.evacuated == 1
+        req = store.get(ComposabilityRequest, "req-1")
+        live = members(store)
+        assert len(live) == 2
+        assert all(c.status.state == RESOURCE_STATE_ONLINE for c in live)
+        assert not [c for c in live if c.spec.target_node == victim_node]
+        # Worker 0's authoritative coordinates followed the cutover.
+        new_w = next(c for c in live
+                     if c.spec.worker_id == source.spec.worker_id)
+        assert new_w.name != source.name
+        assert new_w.spec.target_node != victim_node
+        assert req.status.slice.worker_hostnames[source.spec.worker_id] == (
+            new_w.spec.target_node
+        )
+        # The migration record retired with the move.
+        assert req.status.migration == {}
+        # Fabric: nothing left on the drained node, chips conserved.
+        assert not [d for d in pool.get_resources()
+                    if d.node == victim_node]
+        assert len(pool.get_resources()) == 8
+        assert migrations_total.value(
+            trigger="maintenance", outcome="started") == started + 1
+        assert migrations_total.value(
+            trigger="maintenance", outcome="cutover") == cutover + 1
+        assert migrations_total.value(
+            trigger="maintenance", outcome="completed") == completed + 1
+        assert node_maintenances_active.value() == 0.0  # Drained != active
+
+    def test_deleting_maintenance_uncordons(self):
+        store, pool, chaos, req_rec, res_rec, maint_rec = make_world()
+        make_request(store, size=8)
+        req = to_running(store, req_rec, res_rec, maint_rec)
+        victim_node = req.status.slice.worker_hostnames[0]
+        drain(store, victim_node)
+        pump(store, req_rec, res_rec, maint_rec, done=lambda: (
+            (store.try_get(NodeMaintenance, "mx") or NodeMaintenance())
+            .status.state == MAINTENANCE_STATE_DRAINED
+        ))
+        assert node_quarantined(store, victim_node)
+        store.delete(NodeMaintenance, "mx")
+        pump(store, req_rec, res_rec, maint_rec, steps=5, done=lambda: (
+            store.try_get(NodeMaintenance, "mx") is None
+        ))
+        assert store.try_get(NodeMaintenance, "mx") is None
+        assert not node_quarantined(store, victim_node)
+
+    def test_escalation_quarantine_marker_is_never_cleared(self):
+        """A drain on a node that ALREADY carries a non-maintenance
+        quarantine marker (attach-budget / escalation reason) must not
+        clear that marker on completion — it is not ours."""
+        store, pool, chaos, req_rec, res_rec, maint_rec = make_world()
+        make_request(store, size=8)
+        req = to_running(store, req_rec, res_rec, maint_rec)
+        victim_node = req.status.slice.worker_hostnames[0]
+        res_rec.publisher.quarantine_node(victim_node, "post-ready-failures")
+        drain(store, victim_node)
+        pump(store, req_rec, res_rec, maint_rec, done=lambda: (
+            (store.try_get(NodeMaintenance, "mx") or NodeMaintenance())
+            .status.state == MAINTENANCE_STATE_DRAINED
+        ))
+        store.delete(NodeMaintenance, "mx")
+        pump(store, req_rec, res_rec, maint_rec, steps=5, done=lambda: (
+            store.try_get(NodeMaintenance, "mx") is None
+        ))
+        assert node_quarantined(store, victim_node), (
+            "maintenance cleanup cleared a marker it did not place"
+        )
+
+    def test_drain_deadline_expiry_aborts(self):
+        """No spare capacity -> the migration cannot place; the drain must
+        abort at the deadline: marks withdrawn, node uncordoned, members
+        untouched and Online, request still Running."""
+        store, pool, chaos, req_rec, res_rec, maint_rec = make_world(nodes=2)
+        make_request(store, size=8)  # fills both nodes — nowhere to go
+        req = to_running(store, req_rec, res_rec, maint_rec)
+        victim_node = req.status.slice.worker_hostnames[0]
+        aborted = migrations_total.value(trigger="maintenance",
+                                         outcome="aborted")
+        drain(store, victim_node, deadline=0.15)
+        pump(store, req_rec, res_rec, maint_rec, sleep=0.02, done=lambda: (
+            (store.try_get(NodeMaintenance, "mx") or NodeMaintenance())
+            .status.state == MAINTENANCE_STATE_ABORTED
+        ))
+        m = store.get(NodeMaintenance, "mx")
+        assert m.status.state == MAINTENANCE_STATE_ABORTED, m.status.to_dict()
+        assert "deadline expired" in m.status.message
+        assert not node_quarantined(store, victim_node), "abort must uncordon"
+        assert migrations_total.value(
+            trigger="maintenance", outcome="aborted") == aborted + 1
+        # Members untouched: still Online on their original nodes, marks
+        # withdrawn, and the request settles back to clean Running.
+        pump(store, req_rec, res_rec, maint_rec, steps=20,
+             done=lambda: converged(store))
+        for c in members(store):
+            assert c.status.state == RESOURCE_STATE_ONLINE
+            assert ANNOTATION_EVACUATE not in c.metadata.annotations
+        assert store.get(ComposabilityRequest, "req-1").status.state == (
+            REQUEST_STATE_RUNNING
+        )
+
+    def test_repair_policy_none_members_are_never_claimed(self):
+        """repairPolicy=None opted out of the replacement machinery
+        migration rides on: a drain must not claim (or move) its members
+        — they hold the drain until the deadline aborts, and the status
+        message says why."""
+        store, pool, chaos, req_rec, res_rec, maint_rec = make_world()
+        make_request(store, size=8, repair_policy=REPAIR_NONE)
+        req = to_running(store, req_rec, res_rec, maint_rec)
+        victim_node = req.status.slice.worker_hostnames[0]
+        drain(store, victim_node, deadline=0.15)
+        pump(store, req_rec, res_rec, maint_rec, steps=30)
+        m = store.get(NodeMaintenance, "mx")
+        assert "unmigratable: repairPolicy=None" in m.status.message
+        for c in members(store):
+            assert ANNOTATION_EVACUATE not in c.metadata.annotations
+            assert c.status.state == RESOURCE_STATE_ONLINE
+        pump(store, req_rec, res_rec, maint_rec, sleep=0.02, done=lambda: (
+            store.get(NodeMaintenance, "mx").status.state
+            == MAINTENANCE_STATE_ABORTED
+        ))
+        assert (store.get(NodeMaintenance, "mx").status.state
+                == MAINTENANCE_STATE_ABORTED)
+        assert not node_quarantined(store, victim_node)
+
+    def test_node_name_is_immutable(self, store):
+        from tpu_composer.admission.validating import (
+            AdmissionDenied,
+            register_validating_webhooks,
+        )
+
+        register_validating_webhooks(store)
+        store.create(NodeMaintenance(
+            metadata=ObjectMeta(name="mx"),
+            spec=NodeMaintenanceSpec(node_name="worker-0"),
+        ))
+        m = store.get(NodeMaintenance, "mx")
+        m.spec.node_name = "worker-1"
+        with pytest.raises(AdmissionDenied):
+            store.update(m)
+        # And a second drain for the same node is rejected outright.
+        with pytest.raises(AdmissionDenied):
+            store.create(NodeMaintenance(
+                metadata=ObjectMeta(name="mx2"),
+                spec=NodeMaintenanceSpec(node_name="worker-0"),
+            ))
+
+    def test_surge_budgets_bound_concurrent_migrations(self):
+        """Two single-host slices packed on one node; a drain with the
+        fleet cap at 1 must move them one at a time — never two Migrating
+        members at once — and still empty the node."""
+        store, pool, chaos, req_rec, res_rec, maint_rec = make_world(
+            migrate=MigrateConfig(max_concurrent=1),
+        )
+        make_request(store, "req-1", size=4)
+        to_running(store, req_rec, res_rec, maint_rec, "req-1")
+        make_request(store, "req-2", size=4)
+        to_running(store, req_rec, res_rec, maint_rec, "req-2")
+        nodes = {c.spec.target_node for c in members(store)}
+        assert len(nodes) == 1, (
+            f"tightest-fit should have packed both on one node: {nodes}"
+        )
+        (packed,) = nodes
+
+        def invariant():
+            migrating = [c for c in store.list(ComposableResource)
+                         if c.status.state == RESOURCE_STATE_MIGRATING
+                         and not c.being_deleted]
+            assert len(migrating) <= 1, (
+                f"fleet surge cap exceeded: {[c.name for c in migrating]}"
+            )
+            no_duplicate_attachments(pool)
+
+        drain(store, packed)
+        pump(store, req_rec, res_rec, maint_rec, steps=300,
+             invariant=invariant, done=lambda: (
+                 (store.try_get(NodeMaintenance, "mx") or NodeMaintenance())
+                 .status.state == MAINTENANCE_STATE_DRAINED
+                 and converged(store, "req-1") and converged(store, "req-2")
+             ))
+        m = store.get(NodeMaintenance, "mx")
+        assert m.status.state == MAINTENANCE_STATE_DRAINED, m.status.to_dict()
+        assert m.status.evacuated == 2
+        assert not [c for c in members(store)
+                    if c.spec.target_node == packed]
+
+    def test_breaker_freezes_evacuation_during_brownout(self):
+        """While the fleet is browning out (degraded fraction above the
+        migration threshold), a drain marks members but starts NOTHING;
+        when the brownout lifts the drain proceeds."""
+        # 4-slot nodes: every 4-chip member fills its host, so the sick
+        # request's members can never share the drained node with req-1
+        # (None-policy members are never claimed by a drain and would
+        # legitimately hold it open).
+        store, pool, chaos, req_rec, res_rec, maint_rec = make_world(
+            slots=4,
+            migrate=MigrateConfig(breaker_fraction=0.25,
+                                  breaker_min_members=2),
+        )
+        # Sick request: repairPolicy None keeps its members Degraded (no
+        # repair churn) for the duration of the brownout.
+        make_request(store, "req-2", size=8, repair_policy=REPAIR_NONE)
+        to_running(store, req_rec, res_rec, maint_rec, "req-2")
+        make_request(store, "req-1", size=4)
+        to_running(store, req_rec, res_rec, maint_rec, "req-1")
+        sick = [c for c in members(store)
+                if c.metadata.labels.get("app.kubernetes.io/managed-by")
+                == "req-2"]
+        from tpu_composer.fabric.provider import DeviceHealth
+
+        killed = []
+        for c in sick:
+            pool.set_health(c.status.device_ids[0],
+                            DeviceHealth("Critical", "brownout"))
+            killed.append(c.status.device_ids[0])
+        pump(store, req_rec, res_rec, maint_rec, steps=10, done=lambda: all(
+            store.get(ComposableResource, c.name).status.state
+            == RESOURCE_STATE_DEGRADED for c in sick
+        ))
+        victim_node = next(
+            c.spec.target_node for c in members(store)
+            if c.metadata.labels.get("app.kubernetes.io/managed-by")
+            == "req-1"
+        )
+        drain(store, victim_node)
+        pump(store, req_rec, res_rec, maint_rec, steps=30)
+        assert migration_breaker_open.value() == 1.0
+        assert not [c for c in store.list(ComposableResource)
+                    if c.status.state == RESOURCE_STATE_MIGRATING], (
+            "evacuation started through an open migration breaker"
+        )
+        assert (store.get(NodeMaintenance, "mx").status.state
+                != MAINTENANCE_STATE_DRAINED)
+        # Brownout lifts: members recover in place, the breaker closes,
+        # and the drain completes.
+        for dev in killed:
+            pool.set_health(dev, DeviceHealth("OK"))
+        pump(store, req_rec, res_rec, maint_rec, steps=300, done=lambda: (
+            (store.try_get(NodeMaintenance, "mx") or NodeMaintenance())
+            .status.state == MAINTENANCE_STATE_DRAINED
+        ))
+        assert migration_breaker_open.value() == 0.0
+        assert (store.get(NodeMaintenance, "mx").status.state
+                == MAINTENANCE_STATE_DRAINED)
+
+
+# ---------------------------------------------------------------------------
+# Node-escalation evacuation (trigger b): move the living off a dying host
+# ---------------------------------------------------------------------------
+
+class TestEscalationEvacuation:
+    def test_online_members_evacuate_a_quarantined_node(self):
+        store, pool, chaos, req_rec, res_rec, maint_rec = make_world(
+            node_degrade_threshold=1,
+        )
+        make_request(store, "req-1", size=4)
+        to_running(store, req_rec, res_rec, maint_rec, "req-1")
+        make_request(store, "req-2", size=4)
+        to_running(store, req_rec, res_rec, maint_rec, "req-2")
+        nodes = {c.spec.target_node for c in members(store)}
+        assert len(nodes) == 1
+        (packed,) = nodes
+        healthy = next(c for c in members(store)
+                       if c.metadata.labels.get(
+                           "app.kubernetes.io/managed-by") == "req-2")
+        victim = next(c for c in members(store)
+                      if c.metadata.labels.get(
+                          "app.kubernetes.io/managed-by") == "req-1")
+        completed = migrations_total.value(trigger="evacuation",
+                                           outcome="completed")
+        # One member dies post-Ready; threshold 1 quarantines the host.
+        pool.kill_device(victim.status.device_ids[0])
+        pump(store, req_rec, res_rec, maint_rec, steps=10,
+             done=lambda: node_quarantined(store, packed))
+        assert node_quarantined(store, packed)
+        # The still-healthy sibling on the quarantined host is evacuated
+        # make-before-break (not left to die there), and the degraded one
+        # is repaired off it — the node fully empties.
+        pump(store, req_rec, res_rec, maint_rec, steps=300, done=lambda: (
+            converged(store, "req-1") and converged(store, "req-2")
+            and not [c for c in members(store)
+                     if c.spec.target_node == packed]
+        ))
+        assert not [c for c in members(store)
+                    if c.spec.target_node == packed], (
+            [c.status.to_dict() for c in members(store)]
+        )
+        moved = next(c for c in members(store)
+                     if c.metadata.labels.get(
+                         "app.kubernetes.io/managed-by") == "req-2")
+        assert moved.spec.target_node != packed
+        assert migrations_total.value(
+            trigger="evacuation", outcome="completed") == completed + 1
+        # The healthy member was MIGRATED (annotation-attributed), not
+        # repaired: its hardware never failed.
+        assert healthy.name != moved.name
+
+
+# ---------------------------------------------------------------------------
+# Defrag in migrate mode: safe against live workloads
+# ---------------------------------------------------------------------------
+
+class TestDefragMigrate:
+    def _fragmented_world(self):
+        store, pool, chaos, req_rec, res_rec, maint_rec = make_world()
+        req_rec.scheduler.defrag.mode = "migrate"
+        for name in ("r1", "r2", "r3", "r4"):
+            make_request(store, name, size=4)
+            to_running(store, req_rec, res_rec, maint_rec, name)
+        # Punch holes: r1+r2 packed one host, r3+r4 on another; deleting
+        # r2 and r4 leaves two half-empty hosts.
+        for name in ("r2", "r4"):
+            store.delete(ComposabilityRequest, name)
+        pump(store, req_rec, res_rec, maint_rec, steps=60, done=lambda: (
+            store.try_get(ComposabilityRequest, "r2") is None
+            and store.try_get(ComposabilityRequest, "r4") is None
+        ))
+        return store, pool, chaos, req_rec, res_rec, maint_rec
+
+    def test_defrag_executes_via_live_migration(self):
+        store, pool, chaos, req_rec, res_rec, maint_rec = (
+            self._fragmented_world()
+        )
+        planner = req_rec.scheduler.defrag
+        plan = planner.plan()
+        assert len(plan.migrations) == 1
+        mover = plan.migrations[0].resource
+        started = planner.execute(plan)
+        assert started == 1
+        # Nothing was deleted: the member is marked for live evacuation.
+        child = store.get(ComposableResource, mover)
+        assert not child.being_deleted
+        assert child.metadata.annotations[ANNOTATION_EVACUATE] == "defrag"
+
+        # The owner stays Running with its member attached throughout —
+        # defrag is now safe against a live workload.
+        owner = plan.migrations[0].request
+
+        def invariant():
+            req = store.get(ComposabilityRequest, owner)
+            assert req.status.state == REQUEST_STATE_RUNNING, (
+                "defrag disrupted a Running request"
+            )
+            attached = [c for c in members(store)
+                        if c.metadata.labels.get(
+                            "app.kubernetes.io/managed-by") == owner
+                        and c.status.state in (RESOURCE_STATE_ONLINE,
+                                               RESOURCE_STATE_MIGRATING)]
+            assert attached, "owner lost every attached member mid-defrag"
+            no_duplicate_attachments(pool)
+
+        pump(store, req_rec, res_rec, maint_rec, steps=200,
+             invariant=invariant, done=lambda: (
+                 converged(store, "r1") and converged(store, "r3")
+                 and len({c.spec.target_node for c in members(store)}) == 1
+             ))
+        hosts = {c.spec.target_node for c in members(store)}
+        assert len(hosts) == 1, f"defrag never consolidated: {hosts}"
+        # Idempotent: a settled cluster plans nothing.
+        assert planner.plan().empty
+
+    def test_unmigratable_candidates_are_gated_with_reasons(self):
+        """repairPolicy=None opts a request out of the replacement
+        machinery migration rides on: in migrate mode its members anchor
+        their hosts and the skip reason is surfaced."""
+        store, pool, chaos, req_rec, res_rec, maint_rec = make_world()
+        req_rec.scheduler.defrag.mode = "migrate"
+        for name in ("r1", "r2", "r3", "r4"):
+            make_request(store, name, size=4, repair_policy=REPAIR_NONE)
+            to_running(store, req_rec, res_rec, maint_rec, name)
+        for name in ("r2", "r4"):
+            store.delete(ComposabilityRequest, name)
+        pump(store, req_rec, res_rec, maint_rec, steps=60, done=lambda: (
+            store.try_get(ComposabilityRequest, "r2") is None
+            and store.try_get(ComposabilityRequest, "r4") is None
+        ))
+        planner = req_rec.scheduler.defrag
+        plan = planner.plan()
+        assert plan.empty, plan.migrations
+        assert planner.last_skips.get("repairPolicy=None", 0) >= 2, (
+            planner.last_skips
+        )
+
+    def test_loop_report_and_breaker_freeze(self):
+        store, pool, chaos, req_rec, res_rec, maint_rec = (
+            self._fragmented_world()
+        )
+        loop = DefragLoop(store, req_rec.scheduler.defrag, execute=False)
+        report = loop.report()
+        assert report["mode"] == "migrate"
+        assert report["frozen"] is False
+        assert len(report["dry_run"]["migrations"]) == 1
+        assert isinstance(report["dry_run"]["skips"], dict)
+        # Open breaker: planning (and the report's dry-run) freezes.
+        from tpu_composer.runtime.metrics import repair_breaker_open
+
+        repair_breaker_open.set(1.0)
+        try:
+            frozen_report = loop.report()
+            assert frozen_report["frozen"] is True
+            assert frozen_report["dry_run"]["migrations"] == []
+            assert loop.run_once().empty
+            assert loop.last_report["frozen"] is True
+        finally:
+            repair_breaker_open.set(0.0)
+
+
+# ---------------------------------------------------------------------------
+# Workload continuity: the drain's cutover event drives checkpoint+reshard
+# (test_reshard discipline) and the loss curve stays continuous
+# ---------------------------------------------------------------------------
+
+class TestMaintenanceDrivesReshard:
+    """ISSUE 13 e2e acceptance (workload half): the full threaded operator
+    drains a node under a live training slice; the trainer's WATCH on the
+    request observes the migration cutover (worker_hostnames change at
+    constant chip count — the slice-change event), reshards the live train
+    state onto the post-cutover mesh, and the next losses match the
+    never-drained run to tolerance."""
+
+    def test_drain_cutover_reshards_loss_continuously(self):
+        # Degrade exactly like test_reshard does on hosts whose jax lacks
+        # the workload layer's imports: skip, never fail.
+        pytest.importorskip(
+            "tpu_composer.parallel",
+            reason="workload layer unavailable on this host",
+        )
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_composer.models.transformer import ModelConfig
+        from tpu_composer.parallel import (
+            TrainConfig,
+            make_mesh,
+            make_train_state,
+            make_train_step,
+        )
+        from tpu_composer.parallel.train import reshard_train_state
+        from tpu_composer.runtime.manager import Manager
+
+        tc = TrainConfig(model=ModelConfig(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+            max_seq=32, dtype=jnp.float32))
+        devices = jax.devices()
+        assert len(devices) >= 8
+
+        def batches(n, batch=4, seq=32):
+            key = jax.random.key(7)
+            return [jax.random.randint(jax.random.fold_in(key, i),
+                                       (batch, seq), 0, tc.model.vocab_size)
+                    for i in range(n)]
+
+        def run(mesh, state, tokens_list):
+            step_fn, batch_sharding = make_train_step(tc, mesh)
+            losses = []
+            for tokens in tokens_list:
+                state, metrics = step_fn(
+                    state, jax.device_put(tokens, batch_sharding))
+                losses.append(float(metrics["loss"]))
+            return state, losses
+
+        store = Store()
+        for i in range(4):
+            n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+            n.status.tpu_slots = 4
+            store.create(n)
+        pool = InMemoryPool()
+        mgr = Manager(store=store)
+        mgr.add_controller(ComposabilityRequestReconciler(
+            store, pool,
+            timing=RequestTiming(updating_poll=0.02, cleaning_poll=0.02,
+                                 running_poll=0.5, repair_poll=0.02)))
+        mgr.add_controller(ComposableResourceReconciler(
+            store, pool, FakeNodeAgent(pool=pool),
+            timing=ResourceTiming(attach_poll=0.02, visibility_poll=0.02,
+                                  detach_poll=0.02, detach_fast=0.02,
+                                  busy_poll=0.02)))
+        mgr.add_controller(NodeMaintenanceReconciler(
+            store, timing=MaintenanceTiming(drain_poll=0.05)))
+        mgr.start(workers_per_controller=2)
+        try:
+            q = store.watch("ComposabilityRequest")
+            store.create(ComposabilityRequest(
+                metadata=ObjectMeta(name="train-job"),
+                spec=ComposabilityRequestSpec(resource=ResourceDetails(
+                    type="tpu", model=MODEL, size=8)),
+            ))
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                req = store.try_get(ComposabilityRequest, "train-job")
+                if (req is not None
+                        and req.status.state == REQUEST_STATE_RUNNING):
+                    break
+                time.sleep(0.02)
+            req = store.get(ComposabilityRequest, "train-job")
+            assert req.status.state == REQUEST_STATE_RUNNING
+            hosts_before = list(req.status.slice.worker_hostnames)
+
+            mesh8 = make_mesh({"dp": 2, "sp": 2, "tp": 2},
+                              devices=devices[:8])
+            data = batches(5)
+            # Control: never drained.
+            state_c = make_train_state(tc, jax.random.key(0), mesh8)
+            state_c, losses_c = run(mesh8, state_c, data)
+
+            # Live run: 3 steps, then the operator drains worker 0's host.
+            state_r = make_train_state(tc, jax.random.key(0), mesh8)
+            state_r, losses_a = run(mesh8, state_r, data[:3])
+            drain(store, hosts_before[0], name="train-drain")
+
+            # The trainer's WATCH observes the cutover: a Running event
+            # whose worker_hostnames moved at the same chip count.
+            resharded = False
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                evt = q.get(timeout=5)
+                if (evt.obj.metadata.name == "train-job"
+                        and evt.type != "DELETED"
+                        and evt.obj.status.state == REQUEST_STATE_RUNNING
+                        and evt.obj.status.slice.num_hosts == 2
+                        and list(evt.obj.status.slice.worker_hostnames)
+                        != hosts_before):
+                    s = evt.obj.status.slice
+                    n_chips = s.num_hosts * s.chips_per_host
+                    assert n_chips == 8, "migration must not resize"
+                    mesh_after = make_mesh({"dp": 2, "sp": 2, "tp": 2},
+                                           devices=devices[:n_chips])
+                    state_r = reshard_train_state(tc, state_r, mesh_after)
+                    resharded = True
+                    break
+            assert resharded, "watch never delivered the migration cutover"
+
+            state_r, losses_b = run(mesh_after, state_r, data[3:])
+            drained = losses_a + losses_b
+            assert drained == pytest.approx(losses_c, rel=2e-4), (
+                f"loss diverged across the drain cutover: {drained}"
+                f" vs {losses_c}"
+            )
+            # And the drain itself completes: node empty, slice whole.
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                m = store.try_get(NodeMaintenance, "train-drain")
+                if (m is not None
+                        and m.status.state == MAINTENANCE_STATE_DRAINED):
+                    break
+                time.sleep(0.05)
+            assert store.get(NodeMaintenance, "train-drain").status.state \
+                == MAINTENANCE_STATE_DRAINED
+            assert not [c for c in members(store)
+                        if c.spec.target_node == hosts_before[0]]
+        finally:
+            mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# /debug/defrag endpoint
+# ---------------------------------------------------------------------------
+
+class TestDebugDefragEndpoint:
+    def test_endpoint_serves_report_and_503_without_loop(self, store):
+        import json
+        import urllib.request
+
+        from tpu_composer.runtime.manager import Manager
+        from tpu_composer.scheduler import ClusterScheduler
+
+        scheduler = ClusterScheduler(store, defrag_mode="migrate")
+        loop = DefragLoop(store, scheduler.defrag, execute=False)
+        mgr = Manager(store=store, health_addr="127.0.0.1:0", defrag=loop)
+        mgr.start()
+        try:
+            port = mgr.health_port
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/defrag").read())
+            assert body["mode"] == "migrate"
+            assert "dry_run" in body and "last_pass" in body
+            index = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug").read())
+            assert "/debug/defrag" in index["endpoints"]
+        finally:
+            mgr.stop()
+
+        mgr = Manager(store=Store(), health_addr="127.0.0.1:0")
+        mgr.start()
+        try:
+            port = mgr.health_port
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/defrag")
+            assert e.value.code == 503
+        finally:
+            mgr.stop()

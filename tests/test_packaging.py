@@ -52,7 +52,7 @@ class TestInstaller:
         with open(out) as f:
             docs = [d for d in yaml.safe_load_all(f) if d]
         kinds = [d["kind"] for d in docs]
-        assert kinds.count("CustomResourceDefinition") == 2
+        assert kinds.count("CustomResourceDefinition") == 4
         for expected in ("Deployment", "DaemonSet", "ClusterRole",
                          "ValidatingWebhookConfiguration"):
             assert expected in kinds, f"missing {expected}: {kinds}"
@@ -68,14 +68,15 @@ class TestBundle:
         }
         assert "metadata/annotations.yaml" in files
         assert "manifests/tpu-composer.clusterserviceversion.yaml" in files
-        assert sum(1 for f in files if "tpu.composer.dev_" in f) == 2
+        assert sum(1 for f in files if "tpu.composer.dev_" in f) == 4
 
         with open(os.path.join(out, "manifests",
                                "tpu-composer.clusterserviceversion.yaml")) as f:
             csv = yaml.safe_load(f)
         owned = csv["spec"]["customresourcedefinitions"]["owned"]
         assert {o["kind"] for o in owned} == {
-            "ComposabilityRequest", "ComposableResource"
+            "ComposabilityRequest", "ComposableResource",
+            "FleetTelemetry", "NodeMaintenance",
         }
         assert csv["spec"]["install"]["spec"]["deployments"], "no deployment embedded"
 

@@ -79,6 +79,32 @@ def validate_request(store: Store, req: ComposabilityRequest) -> None:
                 )
 
 
+def validate_maintenance(store: Store, obj, old=None) -> None:
+    """NodeMaintenance admission: schema validation, node_name
+    immutability (retargeting a live drain would orphan the old node's
+    cordon marker and evacuation marks — delete and recreate instead),
+    and one-drain-per-node — two live drains for the same host would race
+    each other's cordon marker and double-claim the same members."""
+    if obj.being_deleted:
+        return
+    obj.validate()
+    if old is not None and old.spec.node_name != obj.spec.node_name:
+        raise AdmissionDenied(
+            "spec.node_name is immutable: delete the NodeMaintenance"
+            " (uncordoning the old node) and create a new one"
+        )
+    from tpu_composer.api.maintenance import NodeMaintenance
+
+    for other in store.list(NodeMaintenance):
+        if other.name == obj.name or other.being_deleted:
+            continue
+        if other.spec.node_name == obj.spec.node_name:
+            raise AdmissionDenied(
+                f"nodeMaintenance {other.name} already drains"
+                f" {obj.spec.node_name}"
+            )
+
+
 def register_validating_webhooks(store: Store) -> None:
     """Hook the rules into create/update, like SetupWebhookWithManager
     (cmd/main.go:196-201)."""
@@ -88,3 +114,9 @@ def register_validating_webhooks(store: Store) -> None:
             validate_request(store, new)
 
     store.register_admission("ComposabilityRequest", hook)
+
+    def maint_hook(op: str, new, old) -> None:
+        if op in ("CREATE", "UPDATE"):
+            validate_maintenance(store, new, old=old)
+
+    store.register_admission("NodeMaintenance", maint_hook)

@@ -6,6 +6,11 @@ dict/JSON serde, a kind registry, and field validators mirroring the
 kubebuilder validation markers.
 """
 
+from tpu_composer.api.maintenance import (
+    NodeMaintenance,
+    NodeMaintenanceSpec,
+    NodeMaintenanceStatus,
+)
 from tpu_composer.api.meta import ObjectMeta, OwnerReference, now_iso
 from tpu_composer.api.scheme import Scheme, default_scheme
 from tpu_composer.api.types import (
@@ -38,6 +43,9 @@ __all__ = [
     "Node",
     "NodeSpec",
     "NodeStatus",
+    "NodeMaintenance",
+    "NodeMaintenanceSpec",
+    "NodeMaintenanceStatus",
     "ResourceDetails",
     "ResourceStatus",
     "OtherSpec",

@@ -139,6 +139,22 @@ _PENDING_OP = _obj(
     " adoption pass reconstructs in-flight work from this after a crash.",
 )
 
+_MIGRATION_RECORD = _obj(
+    {
+        "member": _str("Migrating (source) member"),
+        "replacement": _str("Target-side child riding the normal attach"),
+        "from_node": _str(),
+        "to_node": _str(),
+        "trigger": _str("maintenance | evacuation | defrag"),
+        "phase": _str("attaching | cutover"),
+        "nonce": _str("Migration trace identity"),
+        "started_at": _str(),
+    },
+    desc="One in-flight live migration of a slice member"
+    " (make-before-break: replacement attaches, coordinates cut over,"
+    " source detaches after the drain grace).",
+)
+
 _SLICE_STATUS = _obj(
     {
         "name": _str(),
@@ -202,6 +218,10 @@ COMPOSABILITY_REQUEST_SCHEMA = _obj(
                 },
                 "slice": _SLICE_STATUS,
                 "scalar_resource": _RESOURCE_DETAILS,
+                "migration": {
+                    "type": "object",
+                    "additionalProperties": _MIGRATION_RECORD,
+                },
                 "first_ready_time": _str(),
             }
         ),
@@ -283,6 +303,48 @@ FLEET_TELEMETRY_SCHEMA = _obj(
 )
 
 
+NODE_MAINTENANCE_SCHEMA = _obj(
+    {
+        "apiVersion": _str(),
+        "kind": _str(),
+        "metadata": {"type": "object"},
+        "spec": _obj(
+            {
+                "node_name": _str(
+                    "Host to cordon and drain (live migration evacuates"
+                    " every member make-before-break)",
+                    min_length=1,
+                ),
+                "deadline_seconds": {
+                    "type": "number",
+                    "description": "Seconds the drain may run before"
+                    " aborting; 0 uses the operator default"
+                    " (--migrate-drain-deadline), negative disables the"
+                    " deadline",
+                },
+                "reason": _str("Operator note, surfaced in events/status"),
+            },
+            required=["node_name"],
+        ),
+        "status": _obj(
+            {
+                "state": _str(
+                    enum=["", "Cordoned", "Draining", "Drained", "Aborted"]
+                ),
+                "started_at": _str("Draining transition; the deadline clock"),
+                "evacuated": _int(
+                    "Members evacuated off the node by this drain", minimum=0
+                ),
+                "remaining": _int(
+                    "Live members still on the node", minimum=0
+                ),
+                "message": _str(),
+            }
+        ),
+    }
+)
+
+
 def crd(kind: str, plural: str, singular: str, short: List[str], schema: Dict) -> Dict:
     """Cluster-scoped CRD with status subresource + printer columns
     (reference: cluster-scoped markers, composabilityrequest_types.go:82-84)."""
@@ -347,6 +409,13 @@ def manifests() -> Dict[str, Dict]:
             "fleettelemetry",
             ["ftel"],
             FLEET_TELEMETRY_SCHEMA,
+        ),
+        f"{GROUP}_nodemaintenances.yaml": crd(
+            "NodeMaintenance",
+            "nodemaintenances",
+            "nodemaintenance",
+            ["nmaint"],
+            NODE_MAINTENANCE_SCHEMA,
         ),
     }
 

@@ -14,6 +14,7 @@ from typing import Any, Dict, Type
 from tpu_composer.api.dra import DeviceTaintRule, ResourceSlice
 from tpu_composer.api.fleet import FleetTelemetry
 from tpu_composer.api.lease import Lease
+from tpu_composer.api.maintenance import NodeMaintenance
 from tpu_composer.api.meta import ApiObject
 from tpu_composer.api.types import ComposabilityRequest, ComposableResource, Node
 
@@ -63,6 +64,7 @@ def default_scheme() -> Scheme:
     s.register(Node)
     s.register(Lease)
     s.register(FleetTelemetry)
+    s.register(NodeMaintenance)
     s.register(ResourceSlice)
     s.register(DeviceTaintRule)
     return s

@@ -58,6 +58,14 @@ RESOURCE_STATE_DELETING = "Deleting"
 # Online (plus the drain grace) this member is force-detached.
 RESOURCE_STATE_DEGRADED = "Degraded"
 RESOURCE_STATE_REPAIRING = "Repairing"
+# Live migration (the evacuation analog of Repairing, but for a HEALTHY
+# member being moved off its host — maintenance drain, node-escalation
+# evacuation, defrag): the migration driver committed to moving this
+# member; a replacement child is attaching on the target node while this
+# member keeps serving. Once the replacement is Online the request's
+# coordinates cut over (the slice-change event workloads reshard on) and
+# this member is force-detached after the drain grace.
+RESOURCE_STATE_MIGRATING = "Migrating"
 
 # Device types — reference enum gpu|cxlmemory (composabilityrequest_types.go:41);
 # tpu is our first-class addition.
@@ -114,6 +122,22 @@ ANNOTATION_REPLACED_BY = "tpu.composer.dev/replaced-by"
 # Wall-clock ISO stamp set on the failed member when its replacement came
 # Online: the drain grace window runs from here (crash-safe clock).
 ANNOTATION_REPAIR_DRAIN_START = "tpu.composer.dev/repair-drain-start"
+# Live migration (evacuation) marks. ANNOTATION_EVACUATE on a member asks
+# its owner's migration driver to move it make-before-break; the value
+# names the trigger ("maintenance:<name>" | "evacuation" | "defrag") so
+# tpuc_migrations_total and the status.migration record attribute the move.
+# Durable on the child so a crash mid-drain resumes instead of forgetting
+# which members a NodeMaintenance already claimed.
+ANNOTATION_EVACUATE = "tpu.composer.dev/evacuate"
+# Optional placement hint from the defrag planner: the verified target the
+# plan predicted. The migration driver honors it only if it still fits;
+# otherwise it re-places via the scheduler like any other migration.
+ANNOTATION_EVACUATE_TARGET = "tpu.composer.dev/evacuate-target"
+
+# Migration triggers (the label values on tpuc_migrations_total{trigger}).
+MIGRATE_TRIGGER_MAINTENANCE = "maintenance"
+MIGRATE_TRIGGER_EVACUATION = "evacuation"
+MIGRATE_TRIGGER_DEFRAG = "defrag"
 LABEL_MANAGED_BY = "app.kubernetes.io/managed-by"
 LABEL_READY_TO_DETACH = "tpu.composer.dev/ready-to-detach-device-id"
 
@@ -388,6 +412,64 @@ class FailureRecord:
 
 
 @dataclass
+class MigrationRecord:
+    """One in-flight live migration of a slice member, recorded on the
+    owning request's status (keyed by the migrating member's name).
+
+    Written when the migration driver commits to the move (replacement
+    child created, member marked Migrating) and removed when the source is
+    detached (or the move is retired). Durable so a restarted operator —
+    and any workload watching the request — sees WHERE each worker is
+    moving, WHY, and how far along the make-before-break sequence it is.
+    ``phase``: "attaching" (replacement still coming up; source remains the
+    authoritative host) | "cutover" (replacement Online; coordinates point
+    at the target and the drain grace runs before the source detach).
+    """
+
+    member: str = ""  # migrating (source) ComposableResource name
+    replacement: str = ""  # the target-side child riding the normal attach
+    from_node: str = ""
+    to_node: str = ""
+    trigger: str = ""  # maintenance | evacuation | defrag
+    phase: str = ""  # attaching | cutover
+    #: Migration identity: the trace id every migrate.* span joins, so the
+    #: whole move renders as one connected trace across reconciles.
+    nonce: str = ""
+    started_at: str = ""  # wall-clock ISO (duration metric anchors here)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"member": self.member}
+        if self.replacement:
+            d["replacement"] = self.replacement
+        if self.from_node:
+            d["from_node"] = self.from_node
+        if self.to_node:
+            d["to_node"] = self.to_node
+        if self.trigger:
+            d["trigger"] = self.trigger
+        if self.phase:
+            d["phase"] = self.phase
+        if self.nonce:
+            d["nonce"] = self.nonce
+        if self.started_at:
+            d["started_at"] = self.started_at
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MigrationRecord":
+        return cls(
+            member=d.get("member", ""),
+            replacement=d.get("replacement", ""),
+            from_node=d.get("from_node", ""),
+            to_node=d.get("to_node", ""),
+            trigger=d.get("trigger", ""),
+            phase=d.get("phase", ""),
+            nonce=d.get("nonce", ""),
+            started_at=d.get("started_at", ""),
+        )
+
+
+@dataclass
 class ResourceStatus:
     """Per-child summary folded into the request status.
 
@@ -489,6 +571,9 @@ class ComposabilityRequestStatus:
     # (composabilityrequest_types.go:71, used at composabilityrequest_controller.go:495,:570-579)
     scalar_resource: Optional[ResourceDetails] = None
     slice: SliceStatus = field(default_factory=SliceStatus)
+    # In-flight live migrations, keyed by the migrating member's name
+    # (live-migration verb; see MigrationRecord).
+    migration: Dict[str, MigrationRecord] = field(default_factory=dict)
     # Set once on the first transition to Running; guards the attach-to-ready
     # histogram against re-observation on recovery transitions.
     first_ready_time: str = ""
@@ -506,6 +591,8 @@ class ComposabilityRequestStatus:
         s = self.slice.to_dict()
         if s:
             d["slice"] = s
+        if self.migration:
+            d["migration"] = {k: v.to_dict() for k, v in self.migration.items()}
         return d
 
     @classmethod
@@ -519,6 +606,10 @@ class ComposabilityRequestStatus:
             },
             scalar_resource=ResourceDetails.from_dict(sr) if sr is not None else None,
             slice=SliceStatus.from_dict(d.get("slice", {})),
+            migration={
+                k: MigrationRecord.from_dict(v)
+                for k, v in d.get("migration", {}).items()
+            },
             first_ready_time=d.get("first_ready_time", ""),
         )
 

@@ -67,6 +67,15 @@ SURVEY.md §5 "Config / flag system"):
   TPUC_LEASE_DURATION / TPUC_LEASE_RENEW_PERIOD
                       lease timing for both the single-leader and shard
                       electors (--lease-duration / --lease-renew-period)
+  TPUC_MIGRATE        "0" disables the live-migration verb (--no-migrate):
+                      no NodeMaintenance controller, no migration driver,
+                      no node-escalation evacuation, and the defrag
+                      executor reverts to delete/re-solve
+  TPUC_MIGRATE_MAX_CONCURRENT / TPUC_MIGRATE_BREAKER_FRACTION /
+  TPUC_MIGRATE_DRAIN_DEADLINE
+                      fleet migration surge cap, migration-breaker
+                      threshold, and the default NodeMaintenance drain
+                      deadline (--migrate-*)
   TPUC_HEALTH_FAILURE_THRESHOLD   consecutive failed health probes before
                       an Online member goes Degraded (--health-failure-threshold)
   TPUC_NODE_DEGRADE_THRESHOLD     per-node Degraded transitions that
@@ -554,6 +563,51 @@ def build_parser() -> argparse.ArgumentParser:
              " a tiny fleet's single failure is not a brownout"
              " (env TPUC_REPAIR_BREAKER_MIN_MEMBERS)",
     )
+    # Live migration + node maintenance drains: the make-before-break verb
+    # that evacuates capacity (NodeMaintenance drains, node-escalation
+    # evacuation, defrag) without killing the job.
+    p.add_argument(
+        "--migrate",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_MIGRATE", "1") != "0",
+        help="enable the live-migration verb: the NodeMaintenance drain"
+             " controller, the request controllers' migration driver"
+             " (healthy members marked for evacuation move"
+             " make-before-break), node-escalation evacuation, and the"
+             " defrag executor's migrate mode. --no-migrate or"
+             " TPUC_MIGRATE=0 constructs none of it — no maintenance"
+             " controller, no evacuations, defrag back to delete/re-solve"
+             " — bit-identical to the pre-migration operator",
+    )
+    p.add_argument(
+        "--migrate-max-concurrent",
+        type=int,
+        default=_env_int("TPUC_MIGRATE_MAX_CONCURRENT", 2),
+        help="fleet-wide cap on members migrating at once — an N-node"
+             " maintenance wave trickles instead of stampeding"
+             " (per-request surge stays spec.maxConcurrentRepairs;"
+             " env TPUC_MIGRATE_MAX_CONCURRENT)",
+    )
+    p.add_argument(
+        "--migrate-breaker-fraction",
+        type=float,
+        default=_env_float("TPUC_MIGRATE_BREAKER_FRACTION", 0.25),
+        help="freeze NEW evacuations (and park cutover detaches) while"
+             " more than this fraction of attached members is"
+             " Degraded/Repairing — a brownout must never trigger a mass"
+             " evacuation; deliberately tighter than the repair breaker"
+             " (env TPUC_MIGRATE_BREAKER_FRACTION)",
+    )
+    p.add_argument(
+        "--migrate-drain-deadline",
+        type=float,
+        default=_env_seconds("TPUC_MIGRATE_DRAIN_DEADLINE", 1800.0),
+        help="default NodeMaintenance drain deadline, seconds (applies"
+             " when spec.deadline_seconds is 0; a drain that cannot"
+             " finish aborts — marks withdrawn, node uncordoned — instead"
+             " of wedging half-drained; <= 0 disables the default;"
+             " env TPUC_MIGRATE_DRAIN_DEADLINE)",
+    )
     p.add_argument(
         "--repair-dwell",
         type=float,
@@ -998,15 +1052,29 @@ def build_manager(args: argparse.Namespace) -> Manager:
         mgr.add_startup_hook(
             lambda: adopt_pending_ops(client, fabric, dispatcher)
         )
-    from tpu_composer.controllers.request_controller import RepairConfig
+    from tpu_composer.controllers.request_controller import (
+        MigrateConfig,
+        RepairConfig,
+    )
     from tpu_composer.controllers.resource_controller import ResourceTiming
     from tpu_composer.scheduler import ClusterScheduler, DefragLoop
 
-    scheduler = ClusterScheduler(client)
+    migrate_on = getattr(args, "migrate", True)
+    # Defrag executor mode follows the migration switch: with the verb on,
+    # executed plans become live make-before-break moves (safe against
+    # running workloads); the escape hatch restores delete/re-solve.
+    scheduler = ClusterScheduler(
+        client, defrag_mode="migrate" if migrate_on else "delete"
+    )
     repair_cfg = RepairConfig(
         breaker_fraction=getattr(args, "repair_breaker_fraction", 0.5),
         breaker_min_members=getattr(args, "repair_breaker_min_members", 4),
         min_degraded_seconds=getattr(args, "repair_dwell", 0.0),
+    )
+    migrate_cfg = MigrateConfig(
+        enabled=migrate_on,
+        max_concurrent=max(1, getattr(args, "migrate_max_concurrent", 2)),
+        breaker_fraction=getattr(args, "migrate_breaker_fraction", 0.25),
     )
     res_timing = ResourceTiming(
         health_failure_threshold=getattr(args, "health_failure_threshold", 3),
@@ -1016,6 +1084,7 @@ def build_manager(args: argparse.Namespace) -> Manager:
                                                       recorder=mgr.recorder,
                                                       scheduler=scheduler,
                                                       repair=repair_cfg,
+                                                      migrate=migrate_cfg,
                                                       ownership=ownership))
     res_rec = ComposableResourceReconciler(client, fabric, agent,
                                            timing=res_timing,
@@ -1023,8 +1092,27 @@ def build_manager(args: argparse.Namespace) -> Manager:
                                            dispatcher=dispatcher,
                                            ownership=ownership)
     mgr.add_controller(res_rec)
+    if migrate_on:
+        # Node maintenance drains (controllers/maintenance.py): cordon +
+        # drain-via-migration + deadline abort. Only with the verb on —
+        # the escape hatch constructs no maintenance machinery at all.
+        from tpu_composer.controllers.maintenance import (
+            MaintenanceTiming,
+            NodeMaintenanceReconciler,
+        )
+
+        mgr.add_controller(NodeMaintenanceReconciler(
+            client,
+            timing=MaintenanceTiming(
+                default_deadline=getattr(
+                    args, "migrate_drain_deadline", 1800.0
+                ),
+            ),
+            recorder=mgr.recorder,
+            ownership=ownership,
+        ))
     if args.defrag_interval > 0:
-        mgr.add_runnable(DefragLoop(
+        defrag_loop = DefragLoop(
             client, scheduler.defrag,
             period=args.defrag_interval,
             execute=args.defrag_execute,
@@ -1036,7 +1124,10 @@ def build_manager(args: argparse.Namespace) -> Manager:
                 (lambda: ownership.owns_shard(0))
                 if ownership is not None else None
             ),
-        ))
+        )
+        mgr.add_runnable(defrag_loop)
+        # /debug/defrag (dry-run plan + skip reasons) reads this handle.
+        mgr.defrag = defrag_loop
     mgr.add_runnable(UpstreamSyncer(client, fabric, period=args.sync_period,
                                     grace=args.sync_grace,
                                     recorder=mgr.recorder,

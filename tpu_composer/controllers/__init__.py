@@ -5,12 +5,17 @@ Reference analog: internal/controller — ComposabilityRequest reconciler
 (per chip-group lifecycle), UpstreamSyncer (fabric↔local anti-drift).
 """
 
+from tpu_composer.controllers.maintenance import (
+    MaintenanceTiming,
+    NodeMaintenanceReconciler,
+)
 from tpu_composer.controllers.resource_controller import (
     ComposableResourceReconciler,
     ResourceTiming,
 )
 from tpu_composer.controllers.request_controller import (
     ComposabilityRequestReconciler,
+    MigrateConfig,
     RequestTiming,
 )
 from tpu_composer.controllers.syncer import UpstreamSyncer
@@ -19,6 +24,9 @@ __all__ = [
     "ComposableResourceReconciler",
     "ResourceTiming",
     "ComposabilityRequestReconciler",
+    "MigrateConfig",
     "RequestTiming",
+    "MaintenanceTiming",
+    "NodeMaintenanceReconciler",
     "UpstreamSyncer",
 ]

@@ -50,15 +50,19 @@ identical semantics.
 
 from __future__ import annotations
 
+import threading
+import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from tpu_composer.agent.publisher import quarantined_nodes
 from tpu_composer.api.meta import now_iso, parse_iso
 from tpu_composer.api.types import (
     ANNOTATION_DELETE_DEVICE,
+    ANNOTATION_EVACUATE,
+    ANNOTATION_EVACUATE_TARGET,
     ANNOTATION_LAST_USED_TIME,
     ANNOTATION_REPAIR_DRAIN_START,
     ANNOTATION_REPLACED_BY,
@@ -68,6 +72,8 @@ from tpu_composer.api.types import (
     ComposableResourceSpec,
     FINALIZER,
     LABEL_MANAGED_BY,
+    MIGRATE_TRIGGER_EVACUATION,
+    MigrationRecord,
     Node,
     REPAIR_DETACH_ONLY,
     REPAIR_NONE,
@@ -78,6 +84,7 @@ from tpu_composer.api.types import (
     REQUEST_STATE_RUNNING,
     REQUEST_STATE_UPDATING,
     RESOURCE_STATE_DEGRADED,
+    RESOURCE_STATE_MIGRATING,
     RESOURCE_STATE_ONLINE,
     RESOURCE_STATE_REPAIRING,
     ResourceStatus,
@@ -92,9 +99,13 @@ from tpu_composer.fabric.provider import (
 from tpu_composer.runtime.controller import Controller, Result
 from tpu_composer.runtime.shards import ShardFencedError
 from tpu_composer.runtime.events import WARNING, EventRecorder
+from tpu_composer.runtime import tracing
 from tpu_composer.runtime.metrics import (
     attach_to_ready_seconds,
     degraded_members,
+    migration_breaker_open,
+    migration_duration_seconds,
+    migrations_total,
     reconcile_total,
     repair_breaker_open,
     repair_time_to_replace_seconds,
@@ -152,9 +163,42 @@ class RepairConfig:
     min_degraded_seconds: float = 0.0
 
 
+@dataclass
+class MigrateConfig:
+    """Live-migration (evacuation) knobs — the make-before-break verb that
+    moves a HEALTHY member off its host without killing the job. Three
+    triggers share it: NodeMaintenance drains, node-escalation evacuation,
+    and the defrag executor. Per-request surge still comes from
+    spec.maxConcurrentRepairs (a migration occupies the same
+    replacement-attach machinery a repair does); these bound the FLEET."""
+
+    #: Master switch (--migrate / TPUC_MIGRATE=0): off = the migration
+    #: driver never runs and no member is ever auto-marked for evacuation.
+    enabled: bool = True
+    #: Fleet-wide cap on members in Migrating at once — an N-node
+    #: maintenance wave must trickle, not stampede, however many requests
+    #: are involved.
+    max_concurrent: int = 2
+    #: Fleet migration breaker: no NEW evacuation starts (and cutover
+    #: detaches wait) while more than this fraction of attached members is
+    #: Degraded/Repairing — a brownout looks exactly like a dying node,
+    #: and evacuating through it would amplify the outage. Deliberately
+    #: tighter than the repair breaker: migrations are discretionary.
+    breaker_fraction: float = 0.25
+    #: ...armed only at this many attached members (tiny-fleet guard).
+    breaker_min_members: int = 4
+
+
 def generate_resource_name(device_type: str) -> str:
     """`<type>-<uuid>` (stringutils.go:26-33)."""
     return f"{device_type}-{uuid.uuid4()}"
+
+
+def evacuate_trigger(child: ComposableResource) -> str:
+    """Map a member's evacuation annotation to the metric/record trigger
+    label ("maintenance:<name>" -> "maintenance")."""
+    raw = child.metadata.annotations.get(ANNOTATION_EVACUATE, "")
+    return raw.split(":", 1)[0] if raw else MIGRATE_TRIGGER_EVACUATION
 
 
 class ComposabilityRequestReconciler(Controller):
@@ -169,6 +213,7 @@ class ComposabilityRequestReconciler(Controller):
         recorder: Optional[EventRecorder] = None,
         scheduler: Optional[ClusterScheduler] = None,
         repair: Optional[RepairConfig] = None,
+        migrate: Optional[MigrateConfig] = None,
         ownership=None,  # runtime.shards.ShardOwnership; None = unsharded
     ) -> None:
         # Sharded mode: this replica reconciles only requests whose key
@@ -184,10 +229,22 @@ class ComposabilityRequestReconciler(Controller):
         self.timing = timing or RequestTiming()
         self.recorder = recorder or EventRecorder()
         self.repair = repair or RepairConfig()
+        self.migrate = migrate or MigrateConfig()
         # Repair-breaker edge detection: the freeze/resume transitions are
         # logged + evented exactly once (the state itself is level-checked
         # every repair pass).
         self._repairs_frozen = False
+        # Migration-breaker twin (tighter threshold; see MigrateConfig).
+        self._migrations_frozen = False
+        # Fleet migration cap accounting: the cap is check-then-act over a
+        # store scan, and concurrent request reconciles (worker pool) would
+        # otherwise all read the same pre-start count and stampede past it.
+        # The lock serializes the budget check + starts within this
+        # replica; _recent_migration_starts covers the window where a
+        # just-started member's Migrating status write has not landed (or
+        # lost a conflict) and is invisible to the scan.
+        self._migrate_lock = threading.Lock()
+        self._recent_migration_starts: Dict[str, float] = {}
         # The cluster-wide placement authority (scheduler/). Shared with the
         # DefragLoop when cmd/main wires one; tests may inject their own.
         self.scheduler = scheduler or ClusterScheduler(store)
@@ -466,11 +523,14 @@ class ComposabilityRequestReconciler(Controller):
             and c.spec.slice_name == slice_name
             and c.spec.force_detach == res.force_detach
             and not c.status.quarantined
-            # Degraded/Repairing members never re-enter a solved slice: a
-            # re-solve reaching this path replaces them on fresh capacity
-            # (the break-before-make fallback the repair driver leans on).
+            # Degraded/Repairing/Migrating members never re-enter a solved
+            # slice: a re-solve reaching this path replaces them on fresh
+            # capacity (the break-before-make fallback the repair and
+            # migration drivers lean on; a Migrating source's replacement
+            # already claims its worker id, so keeping both would collide).
             and c.status.state not in (
                 RESOURCE_STATE_DEGRADED, RESOURCE_STATE_REPAIRING,
+                RESOURCE_STATE_MIGRATING,
             )
             and c.spec.target_node not in quarantined_nodes
             and self.store.try_get(Node, c.spec.target_node) is not None
@@ -681,6 +741,7 @@ class ComposabilityRequestReconciler(Controller):
                 and not c.status.quarantined
                 and c.status.state not in (
                     RESOURCE_STATE_DEGRADED, RESOURCE_STATE_REPAIRING,
+                    RESOURCE_STATE_MIGRATING,
                 )
                 and c.spec.target_node not in quarantined_nodes
                 and self.store.try_get(Node, c.spec.target_node) is not None
@@ -790,6 +851,7 @@ class ComposabilityRequestReconciler(Controller):
             c for c in children.values()
             if c.status.quarantined or c.status.state in (
                 RESOURCE_STATE_DEGRADED, RESOURCE_STATE_REPAIRING,
+                RESOURCE_STATE_MIGRATING,
             )
         ]
         if unusable:
@@ -926,6 +988,32 @@ class ComposabilityRequestReconciler(Controller):
             # any repair ran): recompute so the breaker gauge and the
             # resume edge don't stay latched open.
             self._repairs_frozen_now(req)
+        # Live migration: healthy members marked for evacuation (a
+        # NodeMaintenance drain, the defrag executor) or already mid-move,
+        # plus the node-escalation upgrade — still-Online members on a
+        # quarantined host are moved off make-before-break instead of
+        # waiting to die there. Repairs take precedence (a Degraded member
+        # is a present outage; an evacuation is a scheduled one).
+        migrants = self._migration_candidates(req, live)
+        if migrants:
+            return self._drive_migrations(req, live, migrants)
+        if self._migrations_frozen:
+            self._migrations_frozen_now(req)  # resume edge, like repairs
+        if req.status.migration:
+            # Janitor: records whose member vanished outside the driver
+            # (node-gone GC, manual delete) must not linger in status.
+            live_names = {c.name for c in live}
+            stale = [m for m in req.status.migration if m not in live_names]
+            if stale:
+                for m in stale:
+                    req.status.migration.pop(m, None)
+                try:
+                    self._write_status(req)
+                except (ConflictError, NotFoundError):
+                    pass  # re-pruned next pass
+        # With migration enabled a Migrating member is never seen here (it
+        # is always a candidate above); with the escape hatch off, a
+        # member stranded mid-move falls through to the full re-solve.
         if any(c.status.state != RESOURCE_STATE_ONLINE for c in live):
             # Unknown non-Online state -> full re-solve. (Scalar requests
             # must also go through NodeAllocating, not Updating: the fold
@@ -1094,22 +1182,7 @@ class ComposabilityRequestReconciler(Controller):
                 continue  # replacement still attaching — event-driven wait
             # Replacement Online: run the drain grace, then force-detach
             # the failed member.
-            start_iso = c.metadata.annotations.get(ANNOTATION_REPAIR_DRAIN_START, "")
-            if not start_iso:
-                c.metadata.annotations[ANNOTATION_REPAIR_DRAIN_START] = now_iso()
-                try:
-                    self.store.update(c)
-                except (ConflictError, NotFoundError):
-                    pass  # clock starts on the retry
-                still_in_flight += 1
-                continue
-            try:
-                elapsed = (
-                    parse_iso(now_iso()) - parse_iso(start_iso)
-                ).total_seconds()
-            except ValueError:
-                elapsed = req.spec.repair_grace_seconds  # unreadable: no extra wait
-            if elapsed < req.spec.repair_grace_seconds:
+            if not self._drain_grace_expired(c, req.spec.repair_grace_seconds):
                 still_in_flight += 1
                 continue
             if not c.spec.force_detach:
@@ -1254,42 +1327,33 @@ class ComposabilityRequestReconciler(Controller):
                 break  # capacity/fabric problem — no point trying siblings now
         return Result(requeue_after=self.timing.repair_poll)
 
-    def _start_replacement(
-        self, req: ComposabilityRequest, c: ComposableResource
-    ) -> None:
-        """Make-before-break front half: place a replacement member on
-        healthy capacity, re-carve the slice worker's chips there (tpu),
-        create the replacement child, and mark the failed member Repairing.
-        The replacement's attach then runs the normal Attaching machinery —
-        durable pending_op intent, dispatcher batching, attach budget — so
-        a crash mid-repair is adopted like any other in-flight attach."""
+    # -- shared replacement machinery (repair AND migration ride it) ----
+    def _pick_replacement_node(
+        self, req: ComposabilityRequest, c: ComposableResource,
+        quarantined: set, exclude: set,
+    ) -> str:
+        """Place ONE replacement for member ``c`` on healthy capacity
+        (slice-aware for tpu, scalar otherwise)."""
         res = req.spec.resource
-        quarantined = self._quarantined_nodes()
-        exclude = {
-            ch.spec.target_node
-            for ch in self._children(req) if not ch.being_deleted
-        }
         if res.type == "tpu" and c.spec.slice_name:
             shape = solve_slice(res.model, res.size, res.topology)
-            nodes = self.scheduler.place_extra(
+            return self.scheduler.place_extra(
                 req, shape, exclude=exclude, count=1, quarantined=quarantined
-            )
-            node = nodes[0]
-            # Fabric step: swap worker w's chip group onto the new node
-            # from healthy inventory (raises UnsupportedRepair -> caller
-            # falls back; FabricError -> retried next pass, nothing
-            # created yet).
-            self._slice_fabric(req).repair_slice_member(
-                c.spec.slice_name, c.spec.worker_id, node
-            )
-        else:
-            picked = self.scheduler.place_scalar(
-                req, 1, [ch.spec.target_node for ch in self._children(req)
-                         if not ch.being_deleted],
-                quarantined,
-            )
-            node = picked[0]
+            )[0]
+        return self.scheduler.place_scalar(
+            req, 1,
+            [ch.spec.target_node for ch in self._children(req)
+             if not ch.being_deleted],
+            quarantined,
+        )[0]
 
+    def _build_replacement_child(
+        self, req: ComposabilityRequest, c: ComposableResource, node: str
+    ) -> ComposableResource:
+        """The replacement ComposableResource taking over ``c``'s worker
+        slot on ``node`` — identical shape for repair and migration; the
+        ``replaces`` annotation makes the pairing durable."""
+        res = req.spec.resource
         repl = ComposableResource()
         repl.metadata.name = generate_resource_name(res.type)
         repl.metadata.labels[LABEL_MANAGED_BY] = req.name
@@ -1306,18 +1370,75 @@ class ComposabilityRequestReconciler(Controller):
             topology=c.spec.topology,
         )
         repl.set_owner(req)
-        self.store.create(repl)
+        return repl
 
-        # Mark the failed member Repairing (annotation first — the update
-        # bumps rv — then the state on the returned object) so the surge
-        # accounting and a restarted operator see the repair in flight.
-        c.metadata.annotations[ANNOTATION_REPLACED_BY] = repl.metadata.name
+    def _pair_and_mark(
+        self, c: ComposableResource, repl_name: str, state: str
+    ) -> None:
+        """Durably point the source at its replacement and move it to
+        Repairing/Migrating. Write losses are benign: the replacement
+        already exists, and the drivers' 1b passes re-mark from the
+        ``replaces`` pairing."""
+        c.metadata.annotations[ANNOTATION_REPLACED_BY] = repl_name
         try:
             c = self.store.update(c)
-            c.status.state = RESOURCE_STATE_REPAIRING
+            c.status.state = state
             self.store.update_status(c)
         except (ConflictError, NotFoundError):
-            pass  # next pass re-marks; the replacement already exists
+            pass
+
+    def _drain_grace_expired(
+        self, c: ComposableResource, grace: float
+    ) -> bool:
+        """Crash-safe drain-grace clock shared by repair and migration:
+        stamps the window's start on first call (False — wait), then
+        reports whether ``grace`` seconds have elapsed."""
+        start_iso = c.metadata.annotations.get(ANNOTATION_REPAIR_DRAIN_START, "")
+        if not start_iso:
+            c.metadata.annotations[ANNOTATION_REPAIR_DRAIN_START] = now_iso()
+            try:
+                self.store.update(c)
+            except (ConflictError, NotFoundError):
+                pass  # clock starts on the retry
+            return False
+        try:
+            elapsed = (
+                parse_iso(now_iso()) - parse_iso(start_iso)
+            ).total_seconds()
+        except ValueError:
+            return True  # unreadable stamp: no extra wait
+        return elapsed >= grace
+
+    def _start_replacement(
+        self, req: ComposabilityRequest, c: ComposableResource
+    ) -> None:
+        """Make-before-break front half: place a replacement member on
+        healthy capacity, re-carve the slice worker's chips there (tpu),
+        create the replacement child, and mark the failed member Repairing.
+        The replacement's attach then runs the normal Attaching machinery —
+        durable pending_op intent, dispatcher batching, attach budget — so
+        a crash mid-repair is adopted like any other in-flight attach."""
+        res = req.spec.resource
+        quarantined = self._quarantined_nodes()
+        exclude = {
+            ch.spec.target_node
+            for ch in self._children(req) if not ch.being_deleted
+        }
+        node = self._pick_replacement_node(req, c, quarantined, exclude)
+        if res.type == "tpu" and c.spec.slice_name:
+            # Fabric step: swap worker w's chip group onto the new node
+            # from healthy inventory (raises UnsupportedRepair -> caller
+            # falls back; FabricError -> retried next pass, nothing
+            # created yet).
+            self._slice_fabric(req).repair_slice_member(
+                c.spec.slice_name, c.spec.worker_id, node
+            )
+        repl = self._build_replacement_child(req, c, node)
+        self.store.create(repl)
+
+        # Mark the failed member Repairing so the surge accounting and a
+        # restarted operator see the repair in flight.
+        self._pair_and_mark(c, repl.metadata.name, RESOURCE_STATE_REPAIRING)
         # Bookkeeping on the parent: the replacement's row (placement
         # claim) and the authoritative coordinates for worker w.
         req.status.resources[repl.metadata.name] = ResourceStatus(
@@ -1340,6 +1461,428 @@ class ComposabilityRequestReconciler(Controller):
             f" {repl.metadata.name} on {node}"
             f" (worker {c.spec.worker_id})",
         )
+
+    # ------------------------------------------------------------------
+    # live migration driver (healthy-member evacuation, Running state)
+    # ------------------------------------------------------------------
+    def _migration_candidates(
+        self, req: ComposabilityRequest, live: List[ComposableResource]
+    ) -> List[ComposableResource]:
+        """Members this pass should move: explicitly marked for evacuation
+        (maintenance drain / defrag), already mid-move (Migrating), or —
+        the node-escalation upgrade — still Online on a host that carries
+        a NON-maintenance quarantine marker (attach-budget exhaustion or
+        post-Ready escalation: the hardware under them is failing; move
+        them before they die there). Maintenance cordons are excluded from
+        the auto-mark so the drain's own marks keep their attribution."""
+        if not self.migrate.enabled:
+            return []
+        # repairPolicy=None opts the request out of the replacement
+        # machinery migration rides on (the same invariant the defrag
+        # planner's migratability gate states): never mark and never start
+        # moves for it. Members already mid-move (a policy change while a
+        # migration was in flight) are still progressed to completion —
+        # abandoning a half-cutover move helps nobody.
+        opted_out = req.spec.repair_policy == REPAIR_NONE
+        out = []
+        bad_nodes: Optional[set] = None
+        for c in live:
+            if c.status.state == RESOURCE_STATE_MIGRATING:
+                out.append(c)
+                continue
+            if opted_out or c.status.state != RESOURCE_STATE_ONLINE:
+                continue  # repairs own every failed state
+            if c.metadata.annotations.get(ANNOTATION_EVACUATE):
+                out.append(c)
+                continue
+            if bad_nodes is None:
+                bad_nodes = self._escalation_quarantined_nodes()
+            if c.spec.target_node in bad_nodes:
+                # Durable auto-mark so a crash mid-evacuation resumes and
+                # the trigger label survives into the record/metric.
+                c.metadata.annotations[ANNOTATION_EVACUATE] = (
+                    MIGRATE_TRIGGER_EVACUATION
+                )
+                try:
+                    c = self.store.update(c)
+                    out.append(c)
+                except (ConflictError, NotFoundError):
+                    pass  # re-marked next pass
+        return out
+
+    def _escalation_quarantined_nodes(self) -> set:
+        """Quarantined hosts whose marker is NOT a maintenance cordon."""
+        from tpu_composer.agent.publisher import is_node_quarantine_marker
+        from tpu_composer.api.dra import DeviceTaintRule
+        from tpu_composer.api.maintenance import MAINTENANCE_REASON_PREFIX
+
+        return {
+            r.spec.node_name
+            for r in self.store.list(DeviceTaintRule)
+            if is_node_quarantine_marker(r)
+            and not r.spec.reason.startswith(MAINTENANCE_REASON_PREFIX)
+        }
+
+    def _migrations_frozen_now(self, req: ComposabilityRequest) -> bool:
+        """Fleet migration breaker: evacuations are DISCRETIONARY — when
+        the fleet is browning out (degraded fraction above the migration
+        threshold, tighter than the repair breaker's), starting or
+        finishing them would pile scheduled disruption onto an outage.
+        Level-checked every migration pass; edges evented once."""
+        cfg = self.migrate
+        attached = bad = 0
+        for r in self.store.list(ComposableResource):
+            if r.being_deleted:
+                continue
+            if r.status.state in (
+                RESOURCE_STATE_ONLINE, RESOURCE_STATE_DEGRADED,
+                RESOURCE_STATE_REPAIRING, RESOURCE_STATE_MIGRATING,
+            ):
+                attached += 1
+                if r.status.state in (
+                    RESOURCE_STATE_DEGRADED, RESOURCE_STATE_REPAIRING,
+                ):
+                    bad += 1
+        frozen = (
+            attached >= max(1, cfg.breaker_min_members)
+            and bad > cfg.breaker_fraction * attached
+        )
+        migration_breaker_open.set(1.0 if frozen else 0.0)
+        if frozen and not self._migrations_frozen:
+            msg = (
+                f"migrations frozen: {bad}/{attached} attached members"
+                f" degraded (> {cfg.breaker_fraction:.0%}) — a brownout"
+                " must not trigger a mass evacuation; drains resume when"
+                " the fleet recovers"
+            )
+            self.recorder.event(req, WARNING, "MigrationsFrozen", msg)
+            self.log.warning("%s", msg)
+            migrations_total.inc(trigger="fleet", outcome="frozen")
+        elif not frozen and self._migrations_frozen:
+            self.recorder.event(
+                req, "Normal", "MigrationsResumed",
+                f"degraded fraction receded ({bad}/{attached});"
+                " evacuations resume",
+            )
+        self._migrations_frozen = frozen
+        return frozen
+
+    def _fleet_migration_budget(self) -> int:
+        """Remaining fleet-wide migration slots. Caller holds
+        ``_migrate_lock``: the count is a store scan, and the slots must
+        be claimed atomically with it. Recently-started members whose
+        Migrating write has not landed yet are counted via the in-memory
+        overlay (pruned once the scan sees them, the member vanishes, or
+        the entry ages out — a lost status write is re-marked by step 1b
+        within a pass or two). Cross-REPLICA reads share only the store,
+        so a sharded fleet can briefly overshoot by at most one start per
+        replica; the cap is a stampede brake, not a hard invariant."""
+        migrating = {
+            r.metadata.name
+            for r in self.store.list(ComposableResource)
+            if r.status.state == RESOURCE_STATE_MIGRATING
+            and not r.being_deleted
+        }
+        now = time.monotonic()
+        self._recent_migration_starts = {
+            n: t for n, t in self._recent_migration_starts.items()
+            if n not in migrating
+            and now - t < 30.0
+            and self.store.try_get(ComposableResource, n) is not None
+        }
+        return self.migrate.max_concurrent - len(migrating) - len(
+            self._recent_migration_starts
+        )
+
+    def _drive_migrations(
+        self,
+        req: ComposabilityRequest,
+        live: List[ComposableResource],
+        migrants: List[ComposableResource],
+    ) -> Result:
+        frozen = self._migrations_frozen_now(req)
+        by_replaces = {
+            c.metadata.annotations.get(ANNOTATION_REPLACES): c
+            for c in live if c.metadata.annotations.get(ANNOTATION_REPLACES)
+        }
+        migrating = [
+            c for c in migrants
+            if c.status.state == RESOURCE_STATE_MIGRATING
+        ]
+        marked = [
+            c for c in migrants if c.status.state == RESOURCE_STATE_ONLINE
+        ]
+        status_dirty = False
+        in_flight = 0
+
+        # 1. Progress in-flight moves (make-before-break back half).
+        for c in migrating:
+            trigger = evacuate_trigger(c)
+            record = req.status.migration.get(c.name)
+            if record is None:
+                # Crash window between the child writes and the request's
+                # status write: rebuild the record from the durable
+                # annotations so duration/trace identity survive-ish.
+                record = MigrationRecord(
+                    member=c.name, from_node=c.spec.target_node,
+                    trigger=trigger, phase="attaching",
+                    nonce=uuid.uuid4().hex[:12], started_at=now_iso(),
+                )
+                req.status.migration[c.name] = record
+                status_dirty = True
+            repl = by_replaces.get(c.name)
+            if repl is None or repl.status.quarantined:
+                # Replacement died before Online. The source is HEALTHY —
+                # revert it to Online and retry the move fresh (the
+                # evacuation annotation stays, so the next pass re-places
+                # elsewhere; a quarantined target is excluded by the
+                # allocator gates).
+                if repl is not None:
+                    self._delete_children(req, [repl])
+                c.metadata.annotations.pop(ANNOTATION_REPLACED_BY, None)
+                c.metadata.annotations.pop(ANNOTATION_REPAIR_DRAIN_START, None)
+                try:
+                    c = self.store.update(c)
+                    c.status.state = RESOURCE_STATE_ONLINE
+                    self.store.update_status(c)
+                except (ConflictError, NotFoundError):
+                    pass  # retried next pass
+                req.status.migration.pop(c.name, None)
+                status_dirty = True
+                migrations_total.inc(trigger=trigger, outcome="retried")
+                continue
+            if record.replacement != repl.name:
+                record.replacement = repl.name
+                record.to_node = repl.spec.target_node
+                status_dirty = True
+            if repl.status.state != RESOURCE_STATE_ONLINE:
+                in_flight += 1
+                continue  # replacement attaching — event-driven wait
+            # Cutover: the replacement is Online. Flip the authoritative
+            # coordinates to the target — THIS status write is the
+            # slice-change event workloads watch to checkpoint + reshard
+            # onto the moved mesh (the test_reshard discipline) — then run
+            # the drain grace before detaching the source.
+            w = repl.spec.worker_id
+            if (
+                req.spec.resource.type == "tpu"
+                and 0 <= w < len(req.status.slice.worker_hostnames)
+                and req.status.slice.worker_hostnames[w] != repl.spec.target_node
+            ):
+                req.status.slice.worker_hostnames[w] = repl.spec.target_node
+                status_dirty = True
+            if record.phase != "cutover":
+                record.phase = "cutover"
+                status_dirty = True
+                migrations_total.inc(trigger=trigger, outcome="cutover")
+                with tracing.span(
+                    "migrate.cutover", cat="controller",
+                    trace_id=record.nonce or None, object=req.name,
+                    resource=c.name, node=repl.spec.target_node,
+                ):
+                    self.recorder.event(
+                        req, "Normal", "MigrationCutover",
+                        f"worker {w} now serves from {repl.name}"
+                        f" ({repl.spec.target_node}); draining source"
+                        f" {c.name} ({c.spec.target_node})",
+                    )
+            if frozen:
+                # Breaker open: the cutover stands (capacity was added),
+                # but the source detach — a capacity REMOVAL — waits.
+                in_flight += 1
+                continue
+            if not self._drain_grace_expired(
+                c, req.spec.repair_grace_seconds
+            ):
+                in_flight += 1
+                continue
+            if not c.spec.force_detach:
+                # The workload has had the whole grace window since the
+                # cutover event to reshard off this member; load checks
+                # against it would wedge the drain behind a client that
+                # never releases.
+                c.spec.force_detach = True
+                try:
+                    c = self.store.update(c)
+                except (ConflictError, NotFoundError):
+                    in_flight += 1
+                    continue
+            with tracing.span(
+                "migrate.complete", cat="controller",
+                trace_id=record.nonce or None, object=req.name,
+                resource=c.name, node=c.spec.target_node,
+            ):
+                self._delete_children(req, [c])
+            migrations_total.inc(trigger=trigger, outcome="completed")
+            if record.started_at:
+                try:
+                    migration_duration_seconds.observe(
+                        (parse_iso(now_iso()) - parse_iso(record.started_at))
+                        .total_seconds(),
+                        trigger=trigger,
+                    )
+                except ValueError:
+                    pass
+            req.status.migration.pop(c.name, None)
+            status_dirty = True
+            self.recorder.event(
+                req, "Normal", "MigrationComplete",
+                f"member {c.name} evacuated {record.from_node} ->"
+                f" {record.to_node or repl.spec.target_node}"
+                f" (trigger: {trigger}); detaching source",
+            )
+
+        # 1b. Complete interrupted transitions: a marked member that
+        # already HAS a live replacement lost its Migrating mark (crash or
+        # write conflict mid-_start_migration). Re-mark instead of placing
+        # a second replacement.
+        fresh = []
+        for c in marked:
+            if by_replaces.get(c.name) is None:
+                fresh.append(c)
+                continue
+            c.status.state = RESOURCE_STATE_MIGRATING
+            try:
+                self.store.update_status(c)
+            except (ConflictError, NotFoundError):
+                pass  # retried next pass; the replacement already exists
+            in_flight += 1
+
+        # 2. Start new moves within the surge budgets (per-request AND
+        # fleet-wide) — never while the breaker is open. The fleet budget
+        # check and the starts it authorizes are one atomic section:
+        # concurrent request reconciles must not all read the pre-start
+        # count and stampede past --migrate-max-concurrent.
+        if not frozen and fresh:
+            per_request = max(1, req.spec.max_concurrent_repairs) - in_flight
+            with self._migrate_lock:
+                budget = min(per_request, self._fleet_migration_budget())
+                for c in fresh[: max(0, budget)]:
+                    trigger = evacuate_trigger(c)
+                    try:
+                        self._start_migration(req, c, trigger)
+                        self._recent_migration_starts[c.name] = (
+                            time.monotonic()
+                        )
+                        status_dirty = True
+                    except UnsupportedRepair:
+                        # Provider cannot re-carve a worker in place: fall
+                        # back to break-before-make — detach the member
+                        # and let the re-solve rebuild it elsewhere (the
+                        # cordon keeps the drained host out of the
+                        # re-placement).
+                        if not c.spec.force_detach:
+                            c.spec.force_detach = True
+                            try:
+                                c = self.store.update(c)
+                            except (ConflictError, NotFoundError):
+                                continue
+                        self._delete_children(req, [c])
+                        migrations_total.inc(trigger=trigger,
+                                             outcome="fallback")
+                        self.recorder.event(
+                            req, WARNING, "MigrationFallback",
+                            f"provider has no in-place member move;"
+                            f" detaching {c.name} and re-solving"
+                            " (break-before-make)",
+                        )
+                    except (AllocationError, FabricError) as e:
+                        migrations_total.inc(trigger=trigger,
+                                             outcome="failed")
+                        msg = (
+                            f"migration of {c.name} failed (will retry): {e}"
+                        )
+                        if req.status.error != msg:
+                            req.status.error = msg
+                            status_dirty = True
+                            self.recorder.event(
+                                req, WARNING, "MigrationFailed", msg
+                            )
+                        break  # capacity/fabric problem — siblings too
+        if status_dirty:
+            try:
+                self._write_status(req)
+            except (ConflictError, NotFoundError):
+                pass  # rebuilt from durable child state next pass
+        return Result(requeue_after=self.timing.repair_poll)
+
+    def _start_migration(
+        self, req: ComposabilityRequest, c: ComposableResource, trigger: str
+    ) -> None:
+        """Make-before-break front half for a HEALTHY member: place the
+        replacement (honoring a defrag target hint when it still fits),
+        re-carve the slice worker's chips there (tpu), create the
+        replacement child, and mark the source Migrating. The replacement
+        rides the normal Attaching machinery — durable pending_op intent,
+        dispatcher batching, PR 5 adoption — so a crash mid-migration is
+        recovered like any other in-flight attach. Mutates req.status in
+        memory; the caller's single end-of-pass write persists it."""
+        res = req.spec.resource
+        quarantined = self._quarantined_nodes()
+        exclude = {
+            ch.spec.target_node
+            for ch in self._children(req) if not ch.being_deleted
+        }
+        node = self._migration_target(c, exclude, quarantined)
+        if node is None:
+            node = self._pick_replacement_node(req, c, quarantined, exclude)
+        if res.type == "tpu" and c.spec.slice_name:
+            # Re-carve worker w's chip group on the target from healthy
+            # inventory (UnsupportedRepair -> caller falls back; the
+            # source group stays attached until the source detaches).
+            self._slice_fabric(req).repair_slice_member(
+                c.spec.slice_name, c.spec.worker_id, node
+            )
+
+        nonce = uuid.uuid4().hex[:12]
+        repl = self._build_replacement_child(req, c, node)
+        with tracing.span(
+            "migrate.start", cat="controller", trace_id=nonce,
+            object=req.name, resource=c.name, node=node, trigger=trigger,
+        ):
+            self.store.create(repl)
+        # Step 1b re-marks if this write loses; the replacement exists.
+        self._pair_and_mark(c, repl.metadata.name, RESOURCE_STATE_MIGRATING)
+        # Bookkeeping on the parent: the replacement's placement claim and
+        # the durable migration record. The authoritative coordinates do
+        # NOT flip yet — the source still serves worker w until cutover.
+        req.status.resources[repl.metadata.name] = ResourceStatus(
+            node_name=node,
+            worker_id=c.spec.worker_id if res.type == "tpu" else -1,
+        )
+        req.status.migration[c.name] = MigrationRecord(
+            member=c.name,
+            replacement=repl.metadata.name,
+            from_node=c.spec.target_node,
+            to_node=node,
+            trigger=trigger,
+            phase="attaching",
+            nonce=nonce,
+            started_at=now_iso(),
+        )
+        migrations_total.inc(trigger=trigger, outcome="started")
+        self.recorder.event(
+            req, "Normal", "MigrationStarted",
+            f"evacuating member {c.name} ({c.spec.target_node}) to"
+            f" {repl.metadata.name} on {node}"
+            f" (worker {c.spec.worker_id}, trigger: {trigger})",
+        )
+
+    def _migration_target(
+        self, c: ComposableResource, exclude: set, quarantined: set
+    ) -> Optional[str]:
+        """Honor the defrag planner's verified target hint when it still
+        fits; None sends the caller to the scheduler."""
+        hint = c.metadata.annotations.get(ANNOTATION_EVACUATE_TARGET, "")
+        if not hint or hint in exclude or hint in quarantined:
+            return None
+        node = self.store.try_get(Node, hint)
+        if node is None or not node.status.ready or node.spec.unschedulable:
+            return None
+        used = self.scheduler.engine.used_slots_map()
+        if node.status.tpu_slots - used.get(hint, 0) < c.spec.chip_count:
+            return None
+        return hint
 
     def _shrink_to_zero(self, req: ComposabilityRequest, children) -> Result:
         if children:

@@ -47,6 +47,7 @@ from tpu_composer.agent.nodeagent import AgentError, DeviceBusyError, NodeAgent
 from tpu_composer.agent.publisher import quarantined_nodes, retire_node
 from tpu_composer.api.meta import now_iso
 from tpu_composer.api.types import (
+    ANNOTATION_REPLACED_BY,
     ComposabilityRequest,
     ComposableResource,
     FailureRecord,
@@ -60,6 +61,7 @@ from tpu_composer.api.types import (
     RESOURCE_STATE_DELETING,
     RESOURCE_STATE_DETACHING,
     RESOURCE_STATE_EMPTY,
+    RESOURCE_STATE_MIGRATING,
     RESOURCE_STATE_ONLINE,
     RESOURCE_STATE_REPAIRING,
 )
@@ -336,6 +338,8 @@ class ComposableResourceReconciler(Controller):
             return self._handle_degraded(res)
         if state == RESOURCE_STATE_REPAIRING:
             return self._handle_repairing(res)
+        if state == RESOURCE_STATE_MIGRATING:
+            return self._handle_migrating(res)
         if state == RESOURCE_STATE_DETACHING:
             return self._handle_detaching(res)
         if state == RESOURCE_STATE_DELETING:
@@ -805,8 +809,19 @@ class ComposableResourceReconciler(Controller):
         if self.dispatcher is None:
             return self.fabric.remove_resource(res)
         name = res.metadata.name
+        # Migration/repair-ordered op pair: a source member that has a
+        # named replacement parks its detach behind the replacement's
+        # attach at the DISPATCHER level — even if controller sequencing
+        # raced (crash replay, adoption re-drives), the fabric can never
+        # see the source release before the target attach settled. A
+        # replacement already settled (or unknown to this process) imposes
+        # no wait.
+        after = None
+        repl = res.metadata.annotations.get(ANNOTATION_REPLACED_BY, "")
+        if repl:
+            after = ("add", repl)
         return self.dispatcher.remove_resource(
-            res, on_ready=lambda: self.queue.add(name)
+            res, on_ready=lambda: self.queue.add(name), after=after
         )
 
     def fabric_attached(self, node: str) -> Optional[List]:
@@ -1037,6 +1052,35 @@ class ComposableResourceReconciler(Controller):
         if teardown is not None:
             return teardown
         return Result(requeue_after=self.timing.degraded_poll)
+
+    def _handle_migrating(self, res: ComposableResource) -> Result:
+        """A HEALTHY member the migration driver is moving: it keeps
+        serving (and keeps its damped health watch — migration is not
+        immunity) while its replacement attaches; the owning request
+        performs the cutover and the post-grace detach. A member that
+        fails mid-move transitions Degraded and the repair driver takes
+        over — its 1b pass finds the already-live replacement via the
+        replaces annotation and completes the swap as a repair."""
+        teardown = self._begin_teardown(res)
+        if teardown is not None:
+            return teardown
+        name = res.name
+        health = self.fabric.check_resource(res)
+        fabric_requests_total.inc(op="check", outcome=health.state.lower())
+        if health.healthy:
+            self._health_streaks.pop(name, None)
+            return Result(requeue_after=self.timing.degraded_poll)
+        streak = self._health_streaks.get(name, 0) + 1
+        self._health_streaks[name] = streak
+        if streak < max(1, self.timing.health_failure_threshold):
+            return Result(requeue_after=self.timing.degraded_poll)
+        return self._degrade(
+            res,
+            reason="health-probe",
+            detail=f"fabric health {health.state}: {health.detail}",
+            source="health-probe",
+            probes=streak,
+        )
 
     def _handle_detaching(self, res: ComposableResource) -> Result:
         node = res.spec.target_node

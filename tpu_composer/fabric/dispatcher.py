@@ -98,7 +98,7 @@ class _Op:
     __slots__ = (
         "verb", "resource", "node", "name", "on_ready", "state",
         "result", "error", "submitted", "next_poll", "wait_msg", "ctx",
-        "doorbell", "evented", "was_pending",
+        "doorbell", "evented", "was_pending", "after",
     )
 
     def __init__(self, verb: str, resource: ComposableResource, now: float) -> None:
@@ -123,6 +123,15 @@ class _Op:
         self.doorbell = False
         self.evented = False
         self.was_pending = False
+        # Migration-ordered op pairs: this op may not be ISSUED to the
+        # provider while the named (verb, resource) op is still live in
+        # the dispatcher — the live-migration guarantee that a source
+        # member's detach can never overtake its replacement's attach,
+        # enforced at the fabric boundary as defense-in-depth below the
+        # controller's make-before-break sequencing. Gone/settled target
+        # = no constraint (the attach already reached the fabric or never
+        # will through this process).
+        self.after: Optional[Tuple[str, str]] = None
         # Causal handoff from the submitting reconcile span (trace_id = the
         # durable pending_op nonce): the execute pass links it into the
         # dispatch span, and completion spans re-hand it to the requeue.
@@ -332,10 +341,16 @@ class FabricDispatcher:
     def remove_resource(
         self, resource: ComposableResource,
         on_ready: Optional[Callable[[], None]] = None,
+        after: Optional[Tuple[str, str]] = None,
     ) -> None:
-        return self._call(VERB_REMOVE, resource, on_ready)
+        """``after=(verb, name)`` orders this detach behind another op:
+        it is not issued to the provider while that op is still live here
+        (migration-ordered pairs — a migrating source's detach parks
+        behind its replacement's attach)."""
+        return self._call(VERB_REMOVE, resource, on_ready, after=after)
 
-    def _call(self, verb: str, resource: ComposableResource, on_ready):
+    def _call(self, verb: str, resource: ComposableResource, on_ready,
+              after: Optional[Tuple[str, str]] = None):
         name = resource.metadata.name
         key = (verb, name)
         with self._cond:
@@ -362,6 +377,8 @@ class FabricDispatcher:
                     )
                 self.start()  # lazy start: facade usable without wiring order
                 op = _Op(verb, resource, time.monotonic())
+                if after is not None and after != op.key:
+                    op.after = after
                 active = tracing.context()
                 if active is not None:
                     # Flow-start on the submitting thread, bound to the
@@ -425,6 +442,11 @@ class FabricDispatcher:
                     lane.fifo.remove(op)
                 except ValueError:
                     pass
+            # An op ordered `after` this one may be parked on its lane
+            # waiting for this key to leave the live table — wake the
+            # workers so it re-evaluates now rather than on the next
+            # unrelated completion.
+            self._cond.notify_all()
             return True
 
     def abandon_unowned(self) -> int:
@@ -716,7 +738,11 @@ class FabricDispatcher:
         """Longest same-verb FIFO prefix, capped at max_batch, stopping at
         any op whose resource still has an earlier op engaged with the
         fabric (per-resource serialization: a detach must never be issued
-        while its attach is still materializing, and vice versa)."""
+        while its attach is still materializing, and vice versa) — or at
+        an op ordered ``after`` another op that is still live anywhere in
+        the dispatcher (migration pairs: the source detach parks, possibly
+        cross-lane, until its replacement's attach settles; that settle
+        notifies the condition and this lane re-evaluates)."""
         ops: List[_Op] = []
         verb = lane.fifo[0].verb
         engaged = set(lane.pending)
@@ -724,6 +750,11 @@ class FabricDispatcher:
             op = lane.fifo[0]
             if op.verb != verb or op.name in engaged:
                 break
+            if op.after is not None:
+                blocker = self._ops.get(op.after)
+                if blocker is not None and blocker is not op:
+                    break
+                op.after = None  # settled or gone — constraint retired
             lane.fifo.popleft()
             op.state = _INFLIGHT
             ops.append(op)

@@ -312,6 +312,12 @@ class KubeStore:
                 f"{base}/fleettelemetries", f"{GROUP}/{VERSION}",
                 cacheable=False,
             ),
+            # Node maintenance drains (live-migration verb): our own CRD
+            # (deploy/crds), written by operators and reconciled by the
+            # maintenance controller.
+            "NodeMaintenance": _KindRoute(
+                f"{base}/nodemaintenances", f"{GROUP}/{VERSION}"
+            ),
             # DRA publication + quarantine (reference scans ResourceSlices at
             # gpus.go:207-239 and rules DeviceTaintRules at :894-975).
             "ResourceSlice": _KindRoute(

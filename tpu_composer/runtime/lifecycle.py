@@ -61,6 +61,10 @@ _RESOURCE_PHASES = {
     # device) and the make-before-break window while a replacement attaches.
     "Degraded": "Degraded",
     "Repairing": "Repairing",
+    # Live migration: a healthy member being evacuated make-before-break
+    # (maintenance drain / node evacuation / defrag) while its replacement
+    # attaches on the target node.
+    "Migrating": "Migrating",
     "Detaching": "Detaching",
     "Deleting": "Terminating",
 }

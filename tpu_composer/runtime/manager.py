@@ -47,6 +47,9 @@ DEBUG_ENDPOINTS = {
                     " owned shards, fleet-merged latency percentiles and"
                     " fleet SLO burn rates (identical from whichever"
                     " replica you ask)",
+    "/debug/defrag": "defragmentation report: a fresh dry-run plan (never"
+                     " executed) with per-candidate skip reasons, plus the"
+                     " last periodic pass's record and breaker state",
     "/debug/profile": "on-demand stack profile burst"
                       " (?seconds=&format=top|collapsed|json)",
     "/debug/profile/continuous": "the always-on profiler's window ring:"
@@ -161,6 +164,16 @@ class _HealthHandler(_PlainTextHandler):
             else:
                 self._respond_json(
                     200, json.dumps(fleet.snapshot(), indent=1).encode()
+                )
+        elif path == "/debug/defrag":
+            loop = self.manager.defrag
+            if loop is None:
+                self._respond(
+                    503, "defrag loop not running (--defrag-interval 0)"
+                )
+            else:
+                self._respond_json(
+                    200, json.dumps(loop.report(), indent=1).encode()
                 )
         elif path == "/debug/profile/continuous":
             prof = self.manager.profiler
@@ -286,6 +299,7 @@ class Manager:
         slo_engine=None,  # SloEngine override (None = defaults when enabled)
         replica_id: Optional[str] = None,  # fleet identity for trace pids
         fleet=None,  # runtime.fleet.FleetPlane serving /debug/fleet
+        defrag=None,  # scheduler.DefragLoop serving /debug/defrag
     ) -> None:
         # `is not None`, not `or`: an EMPTY store is falsy (Store.__len__),
         # and silently swapping in a fresh one would orphan the caller's
@@ -325,6 +339,9 @@ class Manager:
         # nothing: events keep plain os.getpid().
         self.replica_id = replica_id
         self.fleet = fleet
+        # Defrag loop handle for /debug/defrag (dry-run plan + skip
+        # reasons); None = loop not wired (--defrag-interval 0).
+        self.defrag = defrag
         # Post-leader-acquire / pre-controller-start hooks (cold-start
         # adoption of durable fabric intents, controllers/adoption.py):
         # they run only once leadership is held — a standby must not probe

@@ -527,6 +527,38 @@ repair_breaker_open = global_registry.gauge(
     " above threshold — repairs and repair detaches frozen), else 0",
 )
 
+#: Live migration + node maintenance drains (the evacuation verb).
+migrations_total = global_registry.counter(
+    "tpuc_migrations_total",
+    "Live-migration driver actions by trigger (maintenance | evacuation |"
+    " defrag) and outcome (started = replacement placed + attaching;"
+    " cutover = coordinates flipped to the target, drain grace running;"
+    " completed = source detached after its replacement came Online;"
+    " retried = replacement died, migration re-attempted; fallback ="
+    " provider has no in-place member move, detached + re-solved"
+    " break-before-make; failed = placement/fabric error, retried next"
+    " pass; frozen = migration breaker freeze edge; aborted = evacuation"
+    " mark withdrawn by a drain deadline)",
+)
+migration_duration_seconds = global_registry.histogram(
+    "tpuc_migration_duration_seconds",
+    "End-to-end live-migration latency: from the migration record's"
+    " started_at (replacement created) to the source member's detach"
+    " (make-before-break complete), by trigger",
+)
+migration_breaker_open = global_registry.gauge(
+    "tpuc_migration_breaker_open",
+    "1 while the fleet migration breaker is open (degraded fraction above"
+    " the migration threshold — no NEW evacuations start and cutover"
+    " detaches wait; a brownout must never trigger a mass evacuation),"
+    " else 0",
+)
+node_maintenances_active = global_registry.gauge(
+    "tpuc_node_maintenances",
+    "NodeMaintenance drains currently active (Cordoned/Draining),"
+    " level-set by the maintenance controller",
+)
+
 #: Sharded control plane (runtime/shards.py + runtime/leases.py): K shard
 #: leases across N replicas, with live handoff and partition fencing.
 lease_transitions_total = global_registry.counter(

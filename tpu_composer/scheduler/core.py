@@ -52,7 +52,7 @@ class Placement:
 
 
 class ClusterScheduler:
-    def __init__(self, store) -> None:
+    def __init__(self, store, defrag_mode: str = "delete") -> None:
         self.store = store
         self.engine = PlacementEngine(store)
         self.queue = SchedulerQueue()
@@ -64,8 +64,13 @@ class ClusterScheduler:
         # between its check and its delete, evicting a Running worker
         # with nowhere to re-land.
         self.alloc_lock = threading.Lock()
+        # defrag_mode="migrate" (cmd/main's default with live migration
+        # enabled) makes the executor emit evacuation marks the owners'
+        # migration drivers act on make-before-break; "delete" keeps the
+        # legacy delete/re-solve executor (escape hatch + direct tests).
         self.defrag = DefragPlanner(
-            store, self.engine, queue=self.queue, lock=self.alloc_lock
+            store, self.engine, queue=self.queue, lock=self.alloc_lock,
+            mode=defrag_mode,
         )
 
     # ------------------------------------------------------------------

@@ -16,11 +16,23 @@ Safety properties:
 - only members of **single-host**, **Running** slices whose owner allows
   disruption (``preemptionPolicy != Never``) migrate — moving one worker of
   a multi-host gang would invalidate its ICI topology mid-flight;
-- execution goes through the existing resize machinery: the migrated
-  member's ComposableResource is deleted, its owner re-enters
+- execution has two modes. ``mode="migrate"`` (cmd/main's default with the
+  live-migration verb enabled) never deletes anything: each verified
+  migration is handed to the owner's migration driver as a durable
+  evacuation mark (``tpu.composer.dev/evacuate=defrag`` plus the verified
+  target as a hint), and the member moves make-before-break — defrag is
+  safe to run with ``--defrag-execute`` against live workloads.
+  ``mode="delete"`` is the legacy shape (and the TPUC_MIGRATE=0 escape
+  hatch): the member's ComposableResource is deleted, its owner re-enters
   NodeAllocating, and the placement engine's tightest-fit scoring lands the
-  re-solve on the packed target (the plan records the predicted target and
-  ``execute`` re-verifies it still fits before touching anything);
+  re-solve on the packed target. Both modes re-verify the plan against
+  fresh state before touching anything;
+- planning is gated on MIGRATABILITY in migrate mode: a request whose
+  ``repairPolicy`` is ``None`` has opted out of the replacement machinery
+  migration rides on, so its members anchor their hosts; and an open
+  repair/migration breaker skips the pass entirely (evacuating through a
+  brownout is how outages amplify). Skip reasons are tallied into
+  ``last_report`` and served by the manager's ``/debug/defrag`` endpoint;
 - a plan is idempotent: once executed and settled, the next ``plan()``
   finds no migration that improves the fragmentation score and returns
   empty.
@@ -35,20 +47,28 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set
 
 from tpu_composer.agent.publisher import quarantined_nodes
+from tpu_composer.api.meta import now_iso
 from tpu_composer.api.types import (
+    ANNOTATION_EVACUATE,
+    ANNOTATION_EVACUATE_TARGET,
     ComposabilityRequest,
     ComposableResource,
     LABEL_MANAGED_BY,
+    MIGRATE_TRIGGER_DEFRAG,
     Node,
     PREEMPT_NEVER,
+    REPAIR_NONE,
     REQUEST_STATE_RUNNING,
+    RESOURCE_STATE_ONLINE,
 )
 from tpu_composer.runtime.events import EventRecorder
 from tpu_composer.runtime.metrics import (
+    migration_breaker_open,
+    repair_breaker_open,
     scheduler_defrag_migrations_total,
     scheduler_fragmentation_score,
 )
-from tpu_composer.runtime.store import NotFoundError, StoreError
+from tpu_composer.runtime.store import ConflictError, NotFoundError, StoreError
 
 
 @dataclass(frozen=True)
@@ -65,6 +85,11 @@ class DefragPlan:
     migrations: List[Migration] = field(default_factory=list)
     frag_before: float = 0.0
     frag_after: float = 0.0
+    #: Why candidates were excluded from THIS plan, reason -> count —
+    #: carried on the plan itself so a report pairs migrations and skips
+    #: from the same pass (the shared last_skips is only the latest
+    #: complete snapshot, which a concurrent pass may have replaced).
+    skips: Dict[str, int] = field(default_factory=dict)
 
     @property
     def empty(self) -> bool:
@@ -72,7 +97,8 @@ class DefragPlan:
 
 
 class DefragPlanner:
-    def __init__(self, store, engine, queue=None, lock=None) -> None:
+    def __init__(self, store, engine, queue=None, lock=None,
+                 mode: str = "delete") -> None:
         self.store = store
         self.engine = engine
         # The scheduler's pending queue, when wired (ClusterScheduler
@@ -84,6 +110,20 @@ class DefragPlanner:
         # verify+delete runs under it so a concurrent placement can't
         # fill the verified target between the check and the delete.
         self.lock = lock
+        # Execution mode: "migrate" hands each verified migration to the
+        # owner's live-migration driver (durable evacuation mark + target
+        # hint — make-before-break, safe against live jobs); "delete" is
+        # the legacy delete/re-solve shape kept for the TPUC_MIGRATE=0
+        # escape hatch and direct-construction tests.
+        self.mode = mode
+        # Why candidates were excluded from the last plan(), reason ->
+        # count — the /debug/defrag dry-run report's substance (a planner
+        # that silently plans nothing is indistinguishable from a healthy
+        # defragmented fleet without this). Each plan() tallies into a
+        # LOCAL dict and publishes it whole at the end, so a /debug/defrag
+        # dry-run racing the periodic loop's pass can never blend the two
+        # passes' counts (last complete snapshot wins).
+        self.last_skips: Dict[str, int] = {}
         self.log = logging.getLogger("DefragPlanner")
 
     # ------------------------------------------------------------------
@@ -102,7 +142,8 @@ class DefragPlanner:
             and not n.spec.unschedulable
             and n.metadata.name not in quarantined
         }
-        movable, anchored = self._occupants(nodes)
+        skips: Dict[str, int] = {}
+        movable, anchored = self._occupants(nodes, skips)
 
         # Vacate candidates: hosts with movable occupants and nothing
         # anchoring them, emptiest first (fewest chips to relocate per
@@ -157,9 +198,10 @@ class DefragPlanner:
                 vacated.add(src)
 
         frag_after = self.engine.fragmentation(quarantined, sim_used)
+        self.last_skips = skips  # one atomic publish per completed plan
         if frag_after >= frag_before:
-            return DefragPlan([], frag_before, frag_before)
-        return DefragPlan(migrations, frag_before, frag_after)
+            return DefragPlan([], frag_before, frag_before, skips=skips)
+        return DefragPlan(migrations, frag_before, frag_after, skips=skips)
 
     def _best_target(
         self,
@@ -184,10 +226,13 @@ class DefragPlanner:
                 best = (key, name)
         return best[1] if best else None
 
-    def _occupants(self, nodes: Dict[str, Node]):
+    def _occupants(self, nodes: Dict[str, Node], skips: Dict[str, int]):
         """Split live TPU chip groups into movable (single-host Running
-        slice, disruption allowed, sub-host group) vs anchoring (everything
-        else pins its host in place)."""
+        slice, disruption allowed — and in migrate mode MIGRATABLE:
+        ``repairPolicy != None``, since live migration rides the
+        replacement machinery that policy opts out of — sub-host group) vs
+        anchoring (everything else pins its host in place). Every
+        exclusion tallies a reason into ``skips``."""
         requests = {r.name: r for r in self.store.list(ComposabilityRequest)}
         movable: Dict[str, List[Migration]] = {}
         anchored: Set[str] = set()
@@ -198,16 +243,8 @@ class DefragPlanner:
             if node not in nodes:
                 continue
             owner = requests.get(c.metadata.labels.get(LABEL_MANAGED_BY, ""))
-            if (
-                c.spec.type == "tpu"
-                and owner is not None
-                and not owner.being_deleted
-                and owner.spec.preemption_policy != PREEMPT_NEVER
-                and owner.spec.resource.target_node == ""
-                and owner.status.state == REQUEST_STATE_RUNNING
-                and owner.status.slice.num_hosts == 1
-                and c.spec.chip_count < nodes[node].status.tpu_slots
-            ):
+            reason = self._immovable_reason(c, owner, nodes[node])
+            if reason is None:
                 movable.setdefault(node, []).append(
                     Migration(
                         request=owner.name,
@@ -218,21 +255,56 @@ class DefragPlanner:
                     )
                 )
             else:
+                skips[reason] = skips.get(reason, 0) + 1
                 anchored.add(node)
         return movable, anchored
+
+    def _immovable_reason(self, c, owner, node: Node) -> Optional[str]:
+        """Why this chip group anchors its host (None = movable)."""
+        if c.spec.type != "tpu":
+            return "non-tpu"
+        if owner is None or owner.being_deleted:
+            return "no-live-owner"
+        if owner.spec.preemption_policy == PREEMPT_NEVER:
+            return "preemptionPolicy=Never"
+        if owner.spec.resource.target_node:
+            return "pinned-target-node"
+        if owner.status.state != REQUEST_STATE_RUNNING:
+            return "owner-not-running"
+        if owner.status.slice.num_hosts != 1:
+            return "multi-host-slice"
+        if c.spec.chip_count >= node.status.tpu_slots:
+            return "whole-host-group"
+        if self.mode == "migrate":
+            if owner.spec.repair_policy == REPAIR_NONE:
+                # Live migration rides the replacement machinery;
+                # repairPolicy=None opted this request out of it — the
+                # planner must not propose moves nobody will execute.
+                return "repairPolicy=None"
+            if c.status.state not in (RESOURCE_STATE_ONLINE,):
+                # Degraded/Repairing/Migrating members belong to the
+                # repair or migration driver already in flight.
+                return f"member-{c.status.state or 'pending'}"
+            if c.metadata.annotations.get(ANNOTATION_EVACUATE):
+                return "already-evacuating"
+        return None
 
     # ------------------------------------------------------------------
     def execute(
         self, plan: DefragPlan, recorder: Optional[EventRecorder] = None
     ) -> int:
-        """Drive a dry-run plan through the existing resize machinery:
-        delete each migrated member so its owner re-solves onto the packed
-        target. Re-verifies every migration against fresh state — a stale
-        entry (child gone, target filled up meanwhile) is skipped, not
-        forced — and runs each verify+delete under the scheduler's
-        allocation lock (when wired) so a concurrent placement cannot fill
-        the verified target between the check and the delete. Returns the
-        number of migrations actually started."""
+        """Start a dry-run plan's migrations. In ``migrate`` mode each
+        verified entry becomes a durable evacuation mark (+ target hint)
+        on the member — the owner's live-migration driver moves it
+        make-before-break, so a Running workload never loses the member
+        before its replacement is Online. In ``delete`` mode (legacy /
+        escape hatch) the member is deleted and its owner re-solves onto
+        the packed target. Either way every entry is re-verified against
+        fresh state — a stale one (child gone, target filled up meanwhile)
+        is skipped, not forced — under the scheduler's allocation lock
+        (when wired) so a concurrent placement cannot fill the verified
+        target between the check and the act. Returns the number of
+        migrations actually started."""
         started = 0
         quarantined = quarantined_nodes(self.store)
         for m in plan.migrations:
@@ -278,16 +350,37 @@ class DefragPlanner:
                 m.request,
             )
             return False
-        try:
-            self.store.delete(ComposableResource, m.resource)
-        except NotFoundError:
-            return False
-        except StoreError as e:
-            self.log.warning(
-                "defrag migration of %s (%s -> %s) failed: %s",
-                m.resource, m.from_node, m.to_node, e,
+        if self.mode == "migrate":
+            if (
+                child.metadata.annotations.get(ANNOTATION_EVACUATE)
+                or child.status.state != RESOURCE_STATE_ONLINE
+            ):
+                return False  # already moving (or not movable right now)
+            child.metadata.annotations[ANNOTATION_EVACUATE] = (
+                MIGRATE_TRIGGER_DEFRAG
             )
-            return False
+            child.metadata.annotations[ANNOTATION_EVACUATE_TARGET] = m.to_node
+            try:
+                self.store.update(child)
+            except (ConflictError, NotFoundError):
+                return False  # world moved on — re-planned next pass
+            except StoreError as e:
+                self.log.warning(
+                    "defrag evacuation mark on %s (%s -> %s) failed: %s",
+                    m.resource, m.from_node, m.to_node, e,
+                )
+                return False
+        else:
+            try:
+                self.store.delete(ComposableResource, m.resource)
+            except NotFoundError:
+                return False
+            except StoreError as e:
+                self.log.warning(
+                    "defrag migration of %s (%s -> %s) failed: %s",
+                    m.resource, m.from_node, m.to_node, e,
+                )
+                return False
         scheduler_defrag_migrations_total.inc()
         if recorder is not None:
             req = self.store.try_get(ComposabilityRequest, m.request)
@@ -295,7 +388,9 @@ class DefragPlanner:
                 recorder.event(
                     req, "Normal", "DefragMigration",
                     f"migrating worker {m.resource} "
-                    f"{m.from_node} -> {m.to_node} to defragment capacity",
+                    f"{m.from_node} -> {m.to_node} to defragment capacity"
+                    + (" (live, make-before-break)"
+                       if self.mode == "migrate" else ""),
                 )
         return True
 
@@ -363,6 +458,9 @@ class DefragLoop:
         # at a time, and the duty fails over with the shard lease. None
         # (unsharded) runs every tick, today's behavior.
         self.gate = gate
+        # Last pass's report for /debug/defrag: what was planned, what was
+        # skipped and why, whether a breaker froze the pass.
+        self.last_report: Dict[str, object] = {}
         self.log = logging.getLogger("DefragLoop")
 
     def __call__(self, stop_event: threading.Event) -> None:
@@ -374,22 +472,66 @@ class DefragLoop:
             except StoreError as e:  # pragma: no cover - wire-store only
                 self.log.warning("defrag pass failed: %s", e)
 
+    def _frozen(self) -> bool:
+        """Migrate-mode planning is pointless (and planning THROUGH a
+        brownout would be worse than pointless) while the repair or
+        migration breaker is open — the migration driver would freeze
+        every move anyway. Delete mode predates the breakers and keeps
+        its legacy behavior."""
+        if self.planner.mode != "migrate":
+            return False
+        return (
+            repair_breaker_open.value() > 0
+            or migration_breaker_open.value() > 0
+        )
+
     def run_once(self) -> DefragPlan:
+        if self._frozen():
+            self.last_report = {
+                "at": now_iso(),
+                "mode": self.planner.mode,
+                "execute": self.execute,
+                "frozen": True,
+                "skips": {"breaker-open": 1},
+                "migrations": [],
+            }
+            self.log.info(
+                "defrag pass skipped: repair/migration breaker open"
+                " (brownout — no planning, no evacuation)"
+            )
+            return DefragPlan()
         plan = self.planner.plan()
         # Gauge reflects the CURRENT cluster, not the plan's prediction —
         # execution is asynchronous (owners re-solve on their own clock).
         scheduler_fragmentation_score.set(plan.frag_before)
+        report: Dict[str, object] = {
+            "at": now_iso(),
+            "mode": self.planner.mode,
+            "execute": self.execute,
+            "frozen": False,
+            "frag_before": plan.frag_before,
+            "frag_after": plan.frag_after,
+            "skips": dict(plan.skips),
+            "migrations": [
+                {"request": m.request, "resource": m.resource,
+                 "from": m.from_node, "to": m.to_node, "chips": m.chips}
+                for m in plan.migrations
+            ],
+        }
         if plan.empty:
+            self.last_report = report
             return plan
         summary = ", ".join(
             f"{m.resource}:{m.from_node}->{m.to_node}" for m in plan.migrations
         )
         if self.execute:
             n = self.planner.execute(plan, recorder=self.recorder)
+            report["started"] = n
             self.log.info(
-                "defrag executed %d/%d migration(s) (frag %.3f -> %.3f): %s",
-                n, len(plan.migrations), plan.frag_before, plan.frag_after,
-                summary,
+                "defrag executed %d/%d migration(s) via %s (frag %.3f ->"
+                " %.3f): %s",
+                n, len(plan.migrations), self.planner.mode,
+                plan.frag_before, plan.frag_after, summary,
             )
         else:
             self.log.info(
@@ -398,4 +540,35 @@ class DefragLoop:
                 len(plan.migrations), plan.frag_before, plan.frag_after,
                 summary,
             )
+        self.last_report = report
         return plan
+
+    def report(self) -> Dict[str, object]:
+        """The /debug/defrag payload: a FRESH dry-run plan (never
+        executed, whatever --defrag-execute says) alongside the last
+        periodic pass's record."""
+        if self._frozen():
+            return {
+                "mode": self.planner.mode,
+                "execute": self.execute,
+                "frozen": True,
+                "dry_run": {"migrations": [], "skips": {"breaker-open": 1}},
+                "last_pass": self.last_report,
+            }
+        plan = self.planner.plan()
+        return {
+            "mode": self.planner.mode,
+            "execute": self.execute,
+            "frozen": False,
+            "dry_run": {
+                "frag_before": plan.frag_before,
+                "frag_after": plan.frag_after,
+                "migrations": [
+                    {"request": m.request, "resource": m.resource,
+                     "from": m.from_node, "to": m.to_node, "chips": m.chips}
+                    for m in plan.migrations
+                ],
+                "skips": dict(plan.skips),
+            },
+            "last_pass": self.last_report,
+        }
