@@ -4,7 +4,7 @@
 PYTHON ?= python
 IMG ?= tpu-composer:latest
 
-.PHONY: all test test-fast bench bench-round manifests native lint run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak migrate-soak conformance
+.PHONY: all test test-fast bench bench-round manifests native lint lint-syntax analyze typecheck run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak migrate-soak conformance
 
 all: native test
 
@@ -168,9 +168,45 @@ docker-buildx:
 	docker buildx build --push --platform=$(PLATFORMS) --tag $(IMG) .
 	- docker buildx rm tpu-composer-builder
 
-## lint: syntax check every module
+## lint: ruff over the tree (config: pyproject.toml — correctness-tier
+## rules E9/F63/F7/F82/E722). Falls back to the plain syntax check when
+## ruff is not installed (the container image does not bake it in; CI
+## pip-installs it).
 lint:
+	@if $(PYTHON) -m ruff --version >/dev/null 2>&1; then \
+		$(PYTHON) -m ruff check tpu_composer tests bench.py __graft_entry__.py; \
+	else \
+		echo "ruff not installed — falling back to lint-syntax"; \
+		$(MAKE) lint-syntax; \
+	fi
+
+## lint-syntax: the pre-ruff fallback — compile-check every module
+lint-syntax:
 	$(PYTHON) -m compileall -q tpu_composer tests bench.py __graft_entry__.py
+
+## analyze: tpuc-lint — the repo-invariant AST pass suite
+## (tpu_composer/analysis): fenced fabric mutation paths, the
+## Attaching/Detaching intent protocol, observation-clock discipline,
+## bare-except and unnamed-thread bans, and the env-knob/metric
+## doc-drift gates against docs/OPERATIONS.md. Exits non-zero on any
+## violation; every pass is proven by a known-bad fixture
+## (tests/analysis_fixtures/, driven by tests/test_analysis.py).
+analyze:
+	$(PYTHON) -m tpu_composer.analysis
+
+## typecheck: mypy over the core-module allowlist (pyproject.toml
+## [[tool.mypy.overrides]] — leases, shards, dispatcher, slo: the
+## modules where a type confusion is a production incident). Skips with
+## a notice when mypy is not installed (CI pip-installs it).
+typecheck:
+	@if $(PYTHON) -m mypy --version >/dev/null 2>&1; then \
+		$(PYTHON) -m mypy tpu_composer/runtime/leases.py \
+			tpu_composer/runtime/shards.py \
+			tpu_composer/fabric/dispatcher.py \
+			tpu_composer/runtime/slo.py; \
+	else \
+		echo "mypy not installed — typecheck skipped (CI runs it)"; \
+	fi
 
 clean:
 	rm -rf native/build dist bundle

@@ -1,0 +1,9 @@
+"""Known-bad: a worker loop eating its own bugs."""
+
+
+def dispatch_loop(queue):
+    while True:
+        try:
+            queue.get(timeout=0.2)
+        except:  # noqa: E722 — BAD: swallows mapper bugs AND KeyboardInterrupt
+            pass

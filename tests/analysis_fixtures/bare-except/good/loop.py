@@ -1,0 +1,16 @@
+"""Fixed form: catch what you mean; log real bugs loudly."""
+
+import logging
+import queue as queue_mod
+
+log = logging.getLogger(__name__)
+
+
+def dispatch_loop(queue):
+    while True:
+        try:
+            queue.get(timeout=0.2)
+        except queue_mod.Empty:
+            continue
+        except Exception:
+            log.exception("dispatch loop bug — item dropped, loop survives")

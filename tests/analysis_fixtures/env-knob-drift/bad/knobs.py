@@ -1,0 +1,5 @@
+"""Known-bad: a control-plane knob nobody wired or documented."""
+
+import os
+
+_window = float(os.environ.get("TPUC_FIXTURE_UNDOCUMENTED_KNOB", "1.0"))

@@ -1,0 +1,5 @@
+"""Fixed form: the knob is wired in cmd/main.py and documented."""
+
+import os
+
+_trace_on = os.environ.get("TPUC_TRACE", "1") != "0"

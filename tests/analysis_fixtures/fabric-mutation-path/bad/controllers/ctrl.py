@@ -1,0 +1,11 @@
+"""Known-bad: raw fabric mutation from a controller, no fence."""
+
+
+class Controller:
+    def reconcile(self, res):
+        # BAD: bypasses shard fencing — a replica fenced mid-reconcile
+        # would still mutate the fabric.
+        return self.fabric.add_resource(res)
+
+    def teardown(self, res):
+        self.provider.remove_resources([res])  # BAD: raw group verb

@@ -1,0 +1,16 @@
+"""Fixed form: every mutation rides a fenced path."""
+
+
+class Controller:
+    def reconcile(self, res):
+        return self.dispatcher.add_resource(res)  # dispatcher owns= gate
+
+    def repair(self, req, c, node):
+        # fence-checked facade
+        self._slice_fabric(req).repair_slice_member(
+            c.spec.slice_name, c.spec.worker_id, node
+        )
+
+    def _fabric_remove(self, res):
+        self._fence_check(res)  # designated wrapper: fence precedes the call
+        return self.fabric.remove_resource(res)

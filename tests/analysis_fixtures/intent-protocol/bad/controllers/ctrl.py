@@ -1,0 +1,12 @@
+"""Known-bad: Attaching transition persisted without its intent."""
+
+RESOURCE_STATE_ATTACHING = "Attaching"
+
+
+class Controller:
+    def handle_none(self, res):
+        res.status.state = RESOURCE_STATE_ATTACHING
+        # BAD: no pending_op before the persisting write — a crash after
+        # update_status but before the fabric call leaves an Attaching
+        # object the adoption pass cannot classify.
+        self.store.update_status(res)

@@ -1,0 +1,27 @@
+"""Fixed form: the durable intent rides the same status write."""
+
+RESOURCE_STATE_ATTACHING = "Attaching"
+RESOURCE_STATE_DETACHING = "Detaching"
+RESOURCE_STATE_DELETING = "Deleting"
+
+
+class Controller:
+    def handle_none(self, res):
+        res.status.state = RESOURCE_STATE_ATTACHING
+        res.status.pending_op = self._new_intent("add", res)
+        self.store.update_status(res)
+
+    def begin_teardown(self, res):
+        # Conditional transition (the real _handle_attaching shape): the
+        # pass accepts it because pending_op is assigned in the window.
+        res.status.state = (
+            RESOURCE_STATE_DETACHING
+            if res.status.device_ids
+            else RESOURCE_STATE_DELETING
+        )
+        res.status.pending_op = (
+            self._new_intent("remove", res)
+            if res.status.state == RESOURCE_STATE_DETACHING
+            else None
+        )
+        self.store.update_status(res)

@@ -1,0 +1,7 @@
+"""Known-bad: a registered series the runbook never mentions."""
+
+
+def register(registry):
+    return registry.counter(
+        "tpuc_fixture_undocumented_series_total", "not in OPERATIONS.md"
+    )

@@ -1,0 +1,5 @@
+"""Fixed form: the series appears in the OPERATIONS.md metric tables."""
+
+
+def register(registry):
+    return registry.counter("tpuc_reconcile_total", "documented series")

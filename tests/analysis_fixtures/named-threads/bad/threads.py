@@ -1,0 +1,9 @@
+"""Known-bad: anonymous thread — profiler buckets it under 'other'."""
+
+import threading
+
+
+def start(worker):
+    t = threading.Thread(target=worker, daemon=True)  # BAD: no name=
+    t.start()
+    return t

@@ -1,0 +1,9 @@
+"""Fixed form: named for profiler attribution + lockdep reports."""
+
+import threading
+
+
+def start(worker):
+    t = threading.Thread(target=worker, name="fixture-worker", daemon=True)
+    t.start()
+    return t

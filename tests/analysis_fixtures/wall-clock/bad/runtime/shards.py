@@ -1,0 +1,10 @@
+"""Known-bad: wall-clock read inside lease-steal logic."""
+
+import time
+
+
+class Elector:
+    def stealable(self, holder, renew_time, lease_duration):
+        # BAD: an NTP step or VM pause makes this hasten (or forever
+        # block) a steal — the PR 8 observation-clock bug class.
+        return time.time() - renew_time > lease_duration

@@ -1,0 +1,10 @@
+"""Fixed form: steal decisions ride the contender's monotonic clock."""
+
+import time
+
+
+class Elector:
+    def stealable(self, observation, lease_duration):
+        # The (holder, renewTime) pair must sit UNCHANGED for a full
+        # lease duration on our own monotonic clock.
+        return time.monotonic() - observation.first_seen > lease_duration

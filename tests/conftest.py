@@ -45,10 +45,68 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
 import pytest  # noqa: E402
 
+from tpu_composer.analysis import lockdep  # noqa: E402
 from tpu_composer.runtime.store import Store  # noqa: E402
+
+# Lockdep: the whole suite runs under the lock-order witness (strict —
+# the acquire that closes an acquisition-order cycle raises
+# LockOrderViolation right there, with both stacks), so tier-1 doubles as
+# a standing ABBA-deadlock detector across every ObservedLock
+# (store/informer/pool/dispatcher/chip-index). TPUC_LOCKDEP=0 is the
+# escape hatch; the ABBA regression fixture in test_analysis.py swaps in
+# a scoped witness so its deliberately-poisoned graph never leaks here.
+_LOCKDEP_ON = os.environ.get("TPUC_LOCKDEP", "1") != "0"
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Teardown backstop: a cycle first observed on a background thread
+    raises in THAT thread (threading.excepthook), which a passing test
+    can outrun — any report still recorded here fails the session."""
+    witness = lockdep.current()
+    if witness is None:
+        return
+    # $TPUC_LOCKDEP_FILE artifact (CI uploads it). Under xdist every
+    # worker process has its own witness — suffix the dump per worker so
+    # the controller's (empty) graph can't clobber a worker's report.
+    path = os.environ.get("TPUC_LOCKDEP_FILE", "")
+    worker = os.environ.get("PYTEST_XDIST_WORKER", "")
+    if path and worker:
+        base, ext = os.path.splitext(path)
+        os.environ["TPUC_LOCKDEP_FILE"] = f"{base}-{worker}{ext}"
+    try:
+        lockdep.dump_file()
+    finally:
+        if path:
+            os.environ["TPUC_LOCKDEP_FILE"] = path
+    if witness.reports:
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        lines = [
+            "lockdep: %d lock-order violation(s) observed during the run:"
+            % len(witness.reports)
+        ]
+        for report in witness.reports:
+            lines.append(lockdep.format_report(report))
+        text = "\n".join(lines)
+        if tr is not None:
+            tr.write_sep("=", "lockdep violations", red=True)
+            tr.write_line(text)
+        else:
+            print(text)
+        session.exitstatus = 1
+        # exitstatus mutation only propagates for in-process runs; under
+        # xdist the controller recomputes exit codes from TEST reports
+        # and would go green. Raising here crashes the worker, which the
+        # controller does surface — the backstop must fail CI's
+        # `make test-par` run too.
+        raise pytest.UsageError(
+            f"lockdep: {len(witness.reports)} lock-order violation(s)"
+            " recorded by background threads — see report above"
+        )
 
 
 def pytest_configure(config):
+    if _LOCKDEP_ON:
+        lockdep.enable(strict=True)
     config.addinivalue_line(
         "markers",
         "tpu: requires real TPU hardware (run with TPUC_TESTS_ON_TPU=1)",

@@ -1,0 +1,563 @@
+"""Invariant analyzer suite: tpuc-lint passes + the lockdep witness.
+
+Every lint pass is PROVEN here: it must flag its known-bad fixture
+(tests/analysis_fixtures/<pass-id>/bad/) and accept the fixed form
+(good/). A pass without a failing fixture checks nothing. The lockdep
+half covers cycle detection, declared orders, reentrancy, cond-park
+bookkeeping — and the ABBA regression: the PR 3 store-lock/
+informer-start deadlock shape, rebuilt with two real threads, must be
+caught by the witness.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_composer.analysis import all_passes
+from tpu_composer.analysis import lockdep
+from tpu_composer.analysis.__main__ import main as lint_main
+from tpu_composer.analysis.core import run_passes
+from tpu_composer.runtime.contention import ObservedLock
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+
+PASS_IDS = [
+    "fabric-mutation-path",
+    "intent-protocol",
+    "wall-clock",
+    "bare-except",
+    "named-threads",
+    "env-knob-drift",
+    "metric-doc-drift",
+]
+
+
+def _pass(pass_id):
+    matches = [p for p in all_passes() if p.id == pass_id]
+    assert matches, f"pass {pass_id} not registered"
+    return matches[0]
+
+
+# ---------------------------------------------------------------------------
+# tpuc-lint: every pass proven against its fixtures
+# ---------------------------------------------------------------------------
+
+
+class TestLintFixtures:
+    @pytest.mark.parametrize("pass_id", PASS_IDS)
+    def test_pass_fails_on_known_bad_fixture(self, pass_id):
+        bad = os.path.join(FIXTURES, pass_id, "bad")
+        violations = run_passes([_pass(pass_id)], paths=[bad])
+        assert violations, f"{pass_id} did not flag its known-bad fixture"
+        assert all(v.pass_id == pass_id for v in violations)
+        # Violations are anchored and carry the invariant they encode.
+        for v in violations:
+            assert v.line > 0
+            assert v.invariant
+            assert v.path in v.format()
+
+    @pytest.mark.parametrize("pass_id", PASS_IDS)
+    def test_pass_accepts_fixed_fixture(self, pass_id):
+        good = os.path.join(FIXTURES, pass_id, "good")
+        violations = run_passes([_pass(pass_id)], paths=[good])
+        assert violations == [], [v.format() for v in violations]
+
+    def test_fence_must_precede_the_raw_call(self, tmp_path):
+        # A _fence_check AFTER the mutation is not a fence.
+        d = tmp_path / "controllers"
+        d.mkdir()
+        (d / "late.py").write_text(
+            "class C:\n"
+            "    def bad(self, res):\n"
+            "        out = self.fabric.add_resource(res)\n"
+            "        self._fence_check(res)\n"
+            "        return out\n"
+        )
+        violations = run_passes(
+            [_pass("fabric-mutation-path")], paths=[str(d)]
+        )
+        assert len(violations) == 1
+
+    def test_closure_does_not_inherit_outer_fence(self, tmp_path):
+        # A deferred inner body runs long after the outer fence checked.
+        d = tmp_path / "controllers"
+        d.mkdir()
+        (d / "closure.py").write_text(
+            "class C:\n"
+            "    def outer(self, res):\n"
+            "        self._fence_check(res)\n"
+            "        def later():\n"
+            "            return self.fabric.add_resource(res)\n"
+            "        return later\n"
+        )
+        violations = run_passes(
+            [_pass("fabric-mutation-path")], paths=[str(d)]
+        )
+        assert len(violations) == 1
+
+    def test_fence_inside_closure_does_not_cover_outer_body(self, tmp_path):
+        # The converse of the closure test: a _fence_check inside a
+        # (possibly never-called) inner def must not fence the OUTER
+        # function's raw mutation.
+        d = tmp_path / "controllers"
+        d.mkdir()
+        (d / "inner_fence.py").write_text(
+            "class C:\n"
+            "    def reconcile(self, res):\n"
+            "        def cb():\n"
+            "            self._fence_check(res)\n"
+            "        return self.fabric.add_resource(res)\n"
+        )
+        violations = run_passes(
+            [_pass("fabric-mutation-path")], paths=[str(d)]
+        )
+        assert len(violations) == 1
+
+    def test_doc_mention_must_be_whole_identifier(self, tmp_path):
+        # TPUC_SLO is a PREFIX of documented knobs (TPUC_SLO_FAST_WINDOW)
+        # but is not itself documented — substring matching would let it
+        # slide through the drift gate.
+        (tmp_path / "knob.py").write_text(
+            'import os\n_x = os.environ.get("TPUC_SLO", "")\n'
+        )
+        violations = run_passes(
+            [_pass("env-knob-drift")], paths=[str(tmp_path)]
+        )
+        assert violations, "prefix-of-documented knob slid through"
+
+    def test_intent_after_the_persisting_write_is_flagged(self, tmp_path):
+        d = tmp_path / "controllers"
+        d.mkdir()
+        (d / "late.py").write_text(
+            "class C:\n"
+            "    def handle(self, res):\n"
+            '        res.status.state = "Attaching"\n'
+            "        self.store.update_status(res)\n"
+            "        res.status.pending_op = self._new_intent('add', res)\n"
+        )
+        violations = run_passes([_pass("intent-protocol")], paths=[str(d)])
+        assert len(violations) == 1
+
+    def test_docstring_mentions_are_not_references(self, tmp_path):
+        # Prose naming a knob must not count as a read site.
+        (tmp_path / "doc.py").write_text(
+            '"""Mentions TPUC_FIXTURE_UNDOCUMENTED_KNOB in prose only."""\n'
+        )
+        violations = run_passes(
+            [_pass("env-knob-drift")], paths=[str(tmp_path)]
+        )
+        assert violations == []
+
+
+class TestSuppressions:
+    def test_line_level_suppression(self, tmp_path):
+        (tmp_path / "a.py").write_text(
+            "try:\n"
+            "    pass\n"
+            "except:  # tpuc: ignore[bare-except] — fixture exception\n"
+            "    pass\n"
+        )
+        assert run_passes([_pass("bare-except")], paths=[str(tmp_path)]) == []
+
+    def test_suppression_is_per_pass(self, tmp_path):
+        # Suppressing one pass never silences another on the same line.
+        (tmp_path / "a.py").write_text(
+            "try:\n"
+            "    pass\n"
+            "except:  # tpuc: ignore[named-threads]\n"
+            "    pass\n"
+        )
+        violations = run_passes([_pass("bare-except")], paths=[str(tmp_path)])
+        assert len(violations) == 1
+
+    def test_file_level_suppression(self, tmp_path):
+        (tmp_path / "a.py").write_text(
+            "# tpuc: ignore-file[bare-except] — whole-module exception\n"
+            "try:\n"
+            "    pass\n"
+            "except:\n"
+            "    pass\n"
+        )
+        assert run_passes([_pass("bare-except")], paths=[str(tmp_path)]) == []
+
+    def test_file_level_suppression_must_be_near_the_top(self, tmp_path):
+        lines = ["x = %d" % i for i in range(12)]
+        lines.append("# tpuc: ignore-file[bare-except]")
+        lines += ["try:", "    pass", "except:", "    pass"]
+        (tmp_path / "a.py").write_text("\n".join(lines) + "\n")
+        violations = run_passes([_pass("bare-except")], paths=[str(tmp_path)])
+        assert len(violations) == 1
+
+
+class TestTreeClean:
+    def test_default_scope_is_clean(self):
+        """The make-analyze gate, in-suite: the tree must satisfy every
+        pass (each in-tree fix cites the pass that caught it)."""
+        violations = run_passes(all_passes())
+        assert violations == [], "\n".join(v.format() for v in violations)
+
+
+class TestCli:
+    def test_list_exits_zero(self, capsys):
+        assert lint_main(["--list"]) == 0
+        out = capsys.readouterr().out
+        for pass_id in PASS_IDS:
+            assert pass_id in out
+
+    def test_bad_fixture_exits_one(self, capsys):
+        bad = os.path.join(FIXTURES, "bare-except", "bad")
+        assert lint_main(["--pass", "bare-except", "--paths", bad]) == 1
+
+    def test_good_fixture_exits_zero(self, capsys):
+        good = os.path.join(FIXTURES, "bare-except", "good")
+        assert lint_main(["--pass", "bare-except", "--paths", good]) == 0
+
+    def test_unknown_pass_exits_two(self, capsys):
+        assert lint_main(["--pass", "no-such-pass"]) == 2
+
+    def test_json_output_parses(self, capsys):
+        bad = os.path.join(FIXTURES, "named-threads", "bad")
+        rc = lint_main(["--pass", "named-threads", "--paths", bad, "--json"])
+        assert rc == 1
+        lines = [
+            ln for ln in capsys.readouterr().out.splitlines() if ln.strip()
+        ]
+        docs = [json.loads(ln) for ln in lines]
+        assert docs and all(d["pass"] == "named-threads" for d in docs)
+        assert all({"path", "line", "message", "invariant"} <= set(d) for d in docs)
+
+
+# ---------------------------------------------------------------------------
+# lockdep: unit semantics
+# ---------------------------------------------------------------------------
+
+
+class TestLockdepUnits:
+    def test_two_lock_cycle_detected_nonstrict(self):
+        with lockdep.scoped_witness(strict=False) as w:
+            a, b = ObservedLock("a"), ObservedLock("b")
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+            assert len(w.reports) == 1
+            report = w.reports[0]
+            assert report["kind"] == "cycle"
+            assert report["closing_edge"] == {"held": "b", "acquired": "a"}
+            # Both stacks in the formatted report: the closing acquire's
+            # and the first-seen evidence for the prior a->b edge.
+            text = lockdep.format_report(report)
+            assert "prior edge a -> b" in text
+            assert "acquisition stack" in text
+
+    def test_strict_mode_raises_at_the_closing_acquire(self):
+        with lockdep.scoped_witness(strict=True):
+            a, b = ObservedLock("a"), ObservedLock("b")
+            with a:
+                with b:
+                    pass
+            with b:
+                with pytest.raises(lockdep.LockOrderViolation):
+                    a.acquire()
+
+    def test_transitive_cycle_through_three_classes(self):
+        with lockdep.scoped_witness(strict=False) as w:
+            a, b, c = ObservedLock("a"), ObservedLock("b"), ObservedLock("c")
+            with a:
+                with b:
+                    pass
+            with b:
+                with c:
+                    pass
+            with c:
+                with a:
+                    pass
+            assert len(w.reports) == 1
+            assert w.reports[0]["cycle"] == ["a", "b", "c", "a"]
+
+    def test_reentrant_reacquire_is_not_an_ordering_event(self):
+        with lockdep.scoped_witness(strict=True) as w:
+            lock = ObservedLock("r", reentrant=True)
+            with lock:
+                with lock:
+                    pass
+            assert w.snapshot()["edges"] == []
+            assert w.reports == []
+
+    def test_same_class_nesting_is_counted_not_cycled(self):
+        # Two Store instances in a 2-replica harness share the class
+        # name; holding one while acquiring the other must not report.
+        with lockdep.scoped_witness(strict=True) as w:
+            s1, s2 = ObservedLock("store"), ObservedLock("store")
+            with s1:
+                with s2:
+                    pass
+            assert w.reports == []
+            assert w.nested_same_class == 1
+
+    def test_cond_park_releases_the_held_entry(self):
+        # A cond.wait park must pop the lock from the held stack (and the
+        # wakeup must re-push WITHOUT edges) — otherwise every lock the
+        # thread touches after a park grows phantom cond->X edges.
+        with lockdep.scoped_witness(strict=True) as w:
+            cond_lock = ObservedLock("cond", reentrant=True)
+            cond = threading.Condition(cond_lock)
+            other = ObservedLock("other")
+            woke = []
+
+            def waiter():
+                with cond:
+                    cond.wait(timeout=2.0)
+                with other:  # after the park: must not edge cond->other
+                    woke.append(True)
+
+            t = threading.Thread(target=waiter, name="lockdep-park")
+            t.start()
+            time.sleep(0.05)
+            with cond:
+                cond.notify_all()
+            t.join(timeout=5)
+            assert woke
+            edges = {
+                (e["held"], e["acquired"]) for e in w.snapshot()["edges"]
+            }
+            assert ("cond", "other") not in edges
+            assert w.reports == []
+
+    def test_declared_order_raises_without_a_full_cycle(self):
+        with lockdep.scoped_witness(strict=True) as w:
+            w.declare_order("store", "informer:*")
+            store = ObservedLock("store", reentrant=True)
+            informer = ObservedLock("informer:composableresources")
+            # Legal direction: store held, informer acquired.
+            with store:
+                with informer:
+                    pass
+            # Reversed: first sight raises — no prior edge needed.
+            with informer:
+                with pytest.raises(lockdep.LockOrderViolation) as exc:
+                    store.acquire()
+            assert "declared" in str(exc.value)
+            assert w.reports[0]["kind"] == "declared-order"
+
+    def test_failed_nonblocking_acquire_leaves_no_phantom_hold(self):
+        with lockdep.scoped_witness(strict=True) as w:
+            contended = ObservedLock("contended")
+            other = ObservedLock("other2")
+            contended.acquire()  # this thread now owns it...
+            try:
+                fail = []
+
+                def contender():
+                    # ...so this acquire fails and must pop its
+                    # speculative held entry.
+                    fail.append(contended.acquire(blocking=False))
+                    with other:
+                        pass
+
+                t = threading.Thread(target=contender, name="lockdep-fail")
+                t.start()
+                t.join(timeout=5)
+                assert fail == [False]
+                edges = {
+                    (e["held"], e["acquired"]) for e in w.snapshot()["edges"]
+                }
+                assert ("contended", "other2") not in edges
+            finally:
+                contended.release()
+
+    def test_report_dedup_one_report_per_bad_edge(self):
+        with lockdep.scoped_witness(strict=False) as w:
+            a, b = ObservedLock("a"), ObservedLock("b")
+            with a:
+                with b:
+                    pass
+            for _ in range(5):
+                with b:
+                    with a:
+                        pass
+            assert len(w.reports) == 1
+
+    def test_snapshot_dump_roundtrip(self, tmp_path):
+        with lockdep.scoped_witness(strict=False) as w:
+            a, b = ObservedLock("a"), ObservedLock("b")
+            with a:
+                with b:
+                    pass
+            path = tmp_path / "lockdep.json"
+            w.dump(str(path))
+            doc = json.loads(path.read_text())
+            assert doc["classes"] == ["a", "b"]
+            assert doc["edges"][0]["held"] == "a"
+            assert doc["edges"][0]["acquired"] == "b"
+            assert doc["edges"][0]["stack"]
+
+    def test_scoped_witness_restores_the_suite_witness(self):
+        before = lockdep.current()
+        with lockdep.scoped_witness(strict=False) as w:
+            assert lockdep.current() is w
+        assert lockdep.current() is before
+
+    def test_held_stack_survives_a_witness_swap(self):
+        # Held stacks are process truth, shared across witnesses: a lock
+        # acquired before a scoped_witness swap must release cleanly
+        # inside it — a per-witness stack would strand the entry and
+        # fabricate edges in later unrelated tests.
+        with lockdep.scoped_witness(strict=True) as outer_w:
+            lock = ObservedLock("swap-held")
+            other = ObservedLock("swap-other")
+            lock.acquire()
+            with lockdep.scoped_witness(strict=True):
+                lock.release()  # must pop the SHARED stack, not no-op
+            with other:  # stale entry would edge swap-held -> swap-other
+                pass
+            edges = {
+                (e["held"], e["acquired"])
+                for e in outer_w.snapshot()["edges"]
+            }
+            assert ("swap-held", "swap-other") not in edges
+
+
+# ---------------------------------------------------------------------------
+# the PR 3 ABBA regression: two real threads, opposite orders
+# ---------------------------------------------------------------------------
+
+
+class TestAbbaRegression:
+    """The shape the PR 3 review caught by hand: admission hooks holding
+    the Store lock read through the informer cache, while a lazy informer
+    start holding the cache lock listed through the store. The witness
+    must catch it from the ORDER GRAPH alone — even though the two
+    threads here never actually deadlock (barriers serialize them)."""
+
+    def _run_both_orders(self, w):
+        store = ObservedLock("store", reentrant=True)
+        informer = ObservedLock("informer:composableresources")
+        first_done = threading.Event()
+        caught = []
+
+        def admission_hook_path():
+            # Store._lock held -> read through the cache.
+            with store:
+                with informer:
+                    pass
+            first_done.set()
+
+        def lazy_informer_start_path():
+            # Cache lock held -> initial list through the store.
+            first_done.wait(timeout=5)
+            try:
+                with informer:
+                    with store:
+                        pass
+            except lockdep.LockOrderViolation as e:
+                caught.append(e)
+
+        t1 = threading.Thread(
+            target=admission_hook_path, name="admission-hook"
+        )
+        t2 = threading.Thread(
+            target=lazy_informer_start_path, name="informer-start"
+        )
+        t1.start()
+        t2.start()
+        t1.join(timeout=5)
+        t2.join(timeout=5)
+        return caught
+
+    def test_witness_catches_the_abba_shape(self):
+        with lockdep.scoped_witness(strict=True) as w:
+            caught = self._run_both_orders(w)
+            assert caught, "witness missed the PR 3 ABBA shape"
+            assert len(w.reports) == 1
+            report = w.reports[0]
+            assert report["kind"] == "cycle"
+            assert set(report["cycle"]) == {
+                "store", "informer:composableresources",
+            }
+            # The report names the thread that closed the cycle and
+            # carries evidence for the prior edge.
+            assert report["thread"] == "informer-start"
+            assert report["evidence"][0]["thread"] == "admission-hook"
+
+    def test_declared_order_catches_it_even_first(self):
+        # With the suite's declared store-before-informer order the
+        # REVERSED acquisition alone is flagged — the witness does not
+        # need to have seen the legal direction first.
+        with lockdep.scoped_witness(strict=True) as w:
+            w.declare_order("store", "informer:*")
+            store = ObservedLock("store", reentrant=True)
+            informer = ObservedLock("informer:composableresources")
+            with informer:
+                with pytest.raises(lockdep.LockOrderViolation):
+                    store.acquire()
+            assert w.reports[0]["kind"] == "declared-order"
+
+    def test_suite_witness_runs_and_declares_the_store_order(self):
+        # conftest enables the process-wide witness for tier-1 (the
+        # standing deadlock detector); its declared order carries the
+        # PR 3 lesson. Skipped only under the TPUC_LOCKDEP=0 hatch.
+        w = lockdep.current()
+        if w is None:
+            pytest.skip("suite lockdep disabled via TPUC_LOCKDEP=0")
+        declared = {
+            (d["earlier"], d["later"]) for d in w.snapshot()["declared"]
+        }
+        assert ("store", "informer:*") in declared
+        assert w.strict
+
+
+# ---------------------------------------------------------------------------
+# /debug/lockdep endpoint
+# ---------------------------------------------------------------------------
+
+
+class TestLockdepEndpoint:
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30
+        ) as resp:
+            return resp.read().decode()
+
+    def test_debug_lockdep_serves_the_graph(self):
+        from tpu_composer.runtime.manager import Manager
+        from tpu_composer.runtime.store import Store
+
+        if lockdep.current() is None:
+            pytest.skip("suite lockdep disabled via TPUC_LOCKDEP=0")
+        mgr = Manager(store=Store(), health_addr="127.0.0.1:0")
+        mgr.start()
+        try:
+            doc = json.loads(self._get(mgr.health_port, "/debug/lockdep"))
+            assert {"classes", "edges", "reports", "declared"} <= set(doc)
+            idx = json.loads(self._get(mgr.health_port, "/debug"))
+            assert "/debug/lockdep" in idx["endpoints"]
+        finally:
+            mgr.stop()
+
+    def test_debug_lockdep_503_when_disabled(self):
+        from tpu_composer.runtime.manager import Manager
+        from tpu_composer.runtime.store import Store
+
+        prev = lockdep.current()
+        lockdep.disable()
+        try:
+            mgr = Manager(store=Store(), health_addr="127.0.0.1:0")
+            mgr.start()
+            try:
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    self._get(mgr.health_port, "/debug/lockdep")
+                assert exc.value.code == 503
+            finally:
+                mgr.stop()
+        finally:
+            if prev is not None:
+                with lockdep._witness_lock:
+                    lockdep._witness = prev

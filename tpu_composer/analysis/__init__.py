@@ -1,0 +1,35 @@
+"""Invariant analyzer suite: tpuc-lint AST passes + the lockdep witness.
+
+Twelve PRs of concurrency machinery rest on invariants that used to live
+only in review comments: fence-checked fabric mutation paths (PR 8), the
+Attaching/Detaching intent protocol (PR 5), observation-clock discipline
+in lease logic (PR 8), named threads for profiler attribution (PR 10),
+and the env-knob / metric documentation contract (OPERATIONS.md). This
+package makes each of them machine-checked:
+
+- ``tpuc-lint`` (``python -m tpu_composer.analysis`` / ``make analyze``):
+  an AST-walking pass framework (core.py) with one pass per invariant
+  (passes/), each proven by a known-bad fixture under
+  ``tests/analysis_fixtures/``.
+- ``lockdep`` (lockdep.py): a runtime lock-order witness fed by
+  ``ObservedLock`` (runtime/contention.py). Per-thread held-lock stacks
+  feed a global acquisition-order graph; a cycle is a potential ABBA
+  deadlock (the PR 3 store-lock/informer-start shape) and raises in
+  tests. Enabled suite-wide via tests/conftest.py so tier-1 doubles as a
+  standing deadlock detector.
+"""
+
+from tpu_composer.analysis.core import (  # noqa: F401
+    LintFile,
+    Pass,
+    Violation,
+    run_passes,
+)
+
+
+def all_passes():
+    """The registered pass list (imported lazily so ``lockdep`` users
+    never pay for the AST machinery)."""
+    from tpu_composer.analysis.passes import PASSES
+
+    return list(PASSES)

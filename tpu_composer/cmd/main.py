@@ -247,6 +247,12 @@ def build_parser() -> argparse.ArgumentParser:
              "--no-in-cluster selects the standalone store)",
     )
     p.add_argument(
+        "--namespace",
+        default=os.environ.get("TPUC_NAMESPACE", "tpu-composer-system"),
+        help="namespace for the operator's namespaced objects (leader/"
+             "shard Leases) in cluster mode (env TPUC_NAMESPACE)",
+    )
+    p.add_argument(
         "--in-cluster",
         action=argparse.BooleanOptionalAction,
         default=None,
@@ -423,6 +429,27 @@ def build_parser() -> argparse.ArgumentParser:
         default=_env_seconds("TPUC_PROFILE_WINDOW", 10.0),
         help="seconds per continuous-profile window; the ring keeps the"
              " most recent 30 windows (env TPUC_PROFILE_WINDOW)",
+    )
+    # Lockdep witness (tpu_composer/analysis/lockdep.py): ObservedLock
+    # feeds per-thread held-lock stacks into a global acquisition-order
+    # graph; a cycle is a potential ABBA deadlock. The test suite runs it
+    # strict (raise at the offending acquire); in production it records
+    # reports served on /debug/lockdep.
+    p.add_argument(
+        "--lockdep",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_LOCKDEP", "0") == "1",
+        help="enable the lock-order witness on the observed hot locks:"
+             " acquisition-order cycles (potential ABBA deadlocks) are"
+             " recorded and served on /debug/lockdep (env TPUC_LOCKDEP;"
+             " default off — the suite-wide strict mode lives in the test"
+             " conftest)",
+    )
+    p.add_argument(
+        "--lockdep-file",
+        default=os.environ.get("TPUC_LOCKDEP_FILE", ""),
+        help="dump the lockdep order graph + cycle reports here on"
+             " shutdown (env TPUC_LOCKDEP_FILE; empty disables)",
     )
     p.add_argument(
         "--profile-file",
@@ -752,7 +779,9 @@ def build_store(args: argparse.Namespace):
         # KubeStore's reflector cache is the wire-path twin of the
         # standalone CachedClient — one flag governs both.
         store = KubeStore(
-            config=cfg, cache_reads=getattr(args, "cached_reads", True)
+            config=cfg,
+            cache_reads=getattr(args, "cached_reads", True),
+            namespace=getattr(args, "namespace", None),
         )
     else:
         log.info("store: standalone (state_dir=%s)",
@@ -821,6 +850,15 @@ def _configure_tracing(args: argparse.Namespace) -> None:
         os.environ["TPUC_SLO_FILE"] = args.slo_file
     if getattr(args, "fleet_file", ""):
         os.environ["TPUC_FLEET_FILE"] = args.fleet_file
+    # Lockdep witness: production runs non-strict (record + serve on
+    # /debug/lockdep — a detector must not crash a serving operator);
+    # strict raising is the TEST suite's mode, enabled by conftest.
+    if getattr(args, "lockdep", False):
+        from tpu_composer.analysis import lockdep
+
+        lockdep.enable(strict=False)
+    if getattr(args, "lockdep_file", ""):
+        os.environ["TPUC_LOCKDEP_FILE"] = args.lockdep_file
 
 
 def build_manager(args: argparse.Namespace) -> Manager:

@@ -182,7 +182,11 @@ class ShardedLoader:
             except Exception as e:  # surface errors at the consumer
                 q.put(e)
 
-        t = threading.Thread(target=worker, daemon=True)
+        # Named for profiler attribution (caught by tpuc-lint
+        # named-threads).
+        t = threading.Thread(
+            target=worker, name="data-pipeline-prefetch", daemon=True
+        )
         t.start()
         try:
             while True:

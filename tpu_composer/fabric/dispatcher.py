@@ -55,9 +55,14 @@ import time
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from tpu_composer.api.types import ComposableResource
-from tpu_composer.fabric.events import EVENT_OP_COMPLETED, FabricEvent
+from tpu_composer.fabric.events import (
+    EVENT_OP_COMPLETED,
+    FabricEvent,
+    FabricSession,
+)
 from tpu_composer.fabric.provider import (
     AttachResult,
+    DeviceHealth,
     DispatchedAttaching,
     DispatchedDetaching,
     FabricDevice,
@@ -349,8 +354,13 @@ class FabricDispatcher:
         behind its replacement's attach)."""
         return self._call(VERB_REMOVE, resource, on_ready, after=after)
 
-    def _call(self, verb: str, resource: ComposableResource, on_ready,
-              after: Optional[Tuple[str, str]] = None):
+    def _call(
+        self,
+        verb: str,
+        resource: ComposableResource,
+        on_ready: Optional[Callable[[], None]],
+        after: Optional[Tuple[str, str]] = None,
+    ) -> Optional[AttachResult]:
         name = resource.metadata.name
         key = (verb, name)
         with self._cond:
@@ -496,7 +506,7 @@ class FabricDispatcher:
     # ------------------------------------------------------------------
     # event plane (fabric/events.py)
     # ------------------------------------------------------------------
-    def attach_session(self, session) -> None:
+    def attach_session(self, session: FabricSession) -> None:
         """Wire a FabricSession as the primary completion channel.
 
         An op_completed event is a DOORBELL: it wakes the matching
@@ -620,10 +630,10 @@ class FabricDispatcher:
         return list(snap or [])
 
     # pass-through verbs: synchronous callers keep the raw provider contract
-    def check_resource(self, resource: ComposableResource):
+    def check_resource(self, resource: ComposableResource) -> DeviceHealth:
         return self.provider.check_resource(resource)
 
-    def __getattr__(self, name: str):
+    def __getattr__(self, name: str) -> object:
         return getattr(self.provider, name)
 
     # ------------------------------------------------------------------
@@ -700,7 +710,9 @@ class FabricDispatcher:
                             except Exception:
                                 self.log.exception("on_ready latch failed")
 
-    def _next_task(self, now: float):
+    def _next_task(
+        self, now: float
+    ) -> Tuple[Optional[Tuple["_Lane", str, List["_Op"]]], Optional[float]]:
         """Pick one lane turn: a window-expired FIFO batch, or a due shared
         poll of fabric-pending ops. Returns (task, wait_hint_seconds)."""
         wake: Optional[float] = None
@@ -849,7 +861,7 @@ class FabricDispatcher:
             fabric_calls_total.inc(verb=verb, batched="false")
             self._settle(op, out)
 
-    def _settle(self, op: _Op, outcome) -> None:
+    def _settle(self, op: _Op, outcome: object) -> None:
         """Record one member's outcome: result, fabric wait, or error."""
         now = time.monotonic()
         with self._cond:

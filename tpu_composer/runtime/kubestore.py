@@ -253,8 +253,15 @@ class KubeStore:
         watch_reconnect_s: float = 1.0,
         cache_reads: bool = True,
         cache_sync_timeout_s: float = 5.0,
+        namespace: Optional[str] = None,
     ) -> None:
         self._cfg = config or KubeConfig.load(kubeconfig)
+        # Namespace for the namespaced kinds (Leases, FleetTelemetry):
+        # cmd/main wires --namespace / TPUC_NAMESPACE through here; the
+        # env read below is the fallback for direct constructions.
+        self._namespace = namespace or os.environ.get(
+            "TPUC_NAMESPACE", "tpu-composer-system"
+        )
         self._scheme = scheme or default_scheme()
         self._lock = threading.RLock()
         self._admission: List[Tuple[str, AdmissionHook]] = []
@@ -299,7 +306,7 @@ class KubeStore:
             # the coordination.k8s.io wire form (api/lease.py).
             "Lease": _KindRoute(
                 "/apis/coordination.k8s.io/v1/namespaces/"
-                + os.environ.get("TPUC_NAMESPACE", "tpu-composer-system")
+                + self._namespace
                 + "/leases",
                 "coordination.k8s.io/v1",
                 cacheable=False,

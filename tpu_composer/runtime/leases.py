@@ -27,7 +27,7 @@ import socket
 import threading
 import time
 import uuid
-from typing import Optional
+from typing import Any, Optional
 
 from tpu_composer.api.lease import Lease, LeaseSpec
 from tpu_composer.api.meta import ObjectMeta, now_iso, parse_iso
@@ -100,7 +100,9 @@ class RenewObservation:
 class LeaseElector:
     def __init__(
         self,
-        store,
+        # Duck-typed Store/KubeStore/CachedClient — the elector only
+        # needs get/create/update + the CAS error taxonomy.
+        store: Any,
         name: str = LEADER_ELECTION_ID,
         identity: str = "",
         lease_duration_s: float = 15.0,

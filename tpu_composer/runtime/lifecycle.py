@@ -384,6 +384,12 @@ def dump_crash(reason: str) -> None:
         _fleet.dump_file()
     except Exception:
         pass
+    try:
+        from tpu_composer.analysis import lockdep as _lockdep
+
+        _lockdep.dump_file()
+    except Exception:
+        pass
 
 
 def _atexit_hook() -> None:

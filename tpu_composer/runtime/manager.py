@@ -55,6 +55,10 @@ DEBUG_ENDPOINTS = {
     "/debug/profile/continuous": "the always-on profiler's window ring:"
                                  " per-subsystem wall/CPU/GIL estimates +"
                                  " top frames",
+    "/debug/lockdep": "lock-order witness state: acquisition-order graph"
+                      " edges with first-seen stacks, declared orders and"
+                      " any cycle (potential ABBA deadlock) reports"
+                      " (503 unless --lockdep/TPUC_LOCKDEP=1)",
 }
 
 # A runnable is the analog of manager.Add(RunnableFunc) used by the
@@ -186,6 +190,18 @@ class _HealthHandler(_PlainTextHandler):
                     "windows": prof.windows(),
                     "summary": prof.thread_summary(),
                 }, indent=1).encode())
+        elif path == "/debug/lockdep":
+            from tpu_composer.analysis import lockdep
+
+            witness = lockdep.current()
+            if witness is None:
+                self._respond(
+                    503, "lockdep witness disabled (--lockdep/TPUC_LOCKDEP=1)"
+                )
+            else:
+                self._respond_json(
+                    200, json.dumps(witness.snapshot(), indent=1).encode()
+                )
         elif path == "/debug/profile":
             # On-demand burst profile on this handler thread (explicitly
             # requested, so it runs even under TPUC_PROFILE=0).
@@ -595,7 +611,11 @@ class Manager:
                 self.lost_leadership = True
                 # stop() joins threads including this one; run it from a
                 # helper thread to avoid self-join.
-                threading.Thread(target=self.stop, daemon=True).start()
+                # Named for profiler attribution (caught by tpuc-lint
+                # named-threads).
+                threading.Thread(
+                    target=self.stop, name="manager-stop", daemon=True
+                ).start()
                 return
 
     def stop(self) -> None:

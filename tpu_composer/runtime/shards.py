@@ -50,7 +50,7 @@ import logging
 import threading
 import time
 import zlib
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from tpu_composer.api.lease import Lease, LeaseSpec
 from tpu_composer.api.meta import ObjectMeta, now_iso
@@ -143,7 +143,9 @@ class ShardLeaseElector:
 
     def __init__(
         self,
-        store,
+        # Duck-typed Store/KubeStore/CachedClient (same contract as
+        # LeaseElector: get/create/update + the CAS error taxonomy).
+        store: Any,
         num_shards: int,
         identity: str = "",
         name: str = SHARD_ELECTION_ID,

@@ -108,7 +108,9 @@ class SloEngine:
     def __init__(
         self,
         objectives: Optional[List[Objective]] = None,
-        recorder=None,
+        # Duck-typed events recorder (runtime/events.py): only .event()
+        # is used, for the SloBreached/SloRecovered edges.
+        recorder: Optional[Any] = None,
         fast_window: float = 60.0,
         slow_window: float = 600.0,
         burn_threshold: float = 2.0,

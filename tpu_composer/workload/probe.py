@@ -928,8 +928,11 @@ def _drive_child(
     )
 
     stderr_buf: List[str] = []
+    # Reader threads named for profiler attribution (caught by tpuc-lint
+    # named-threads).
     t_err = threading.Thread(
-        target=lambda: stderr_buf.extend(proc.stderr), daemon=True  # type: ignore[arg-type]
+        target=lambda: stderr_buf.extend(proc.stderr),  # type: ignore[arg-type]
+        name="probe-stderr-reader", daemon=True,
     )
     t_err.start()
 
@@ -941,7 +944,9 @@ def _drive_child(
             lines.append(line)
         done.set()
 
-    t_out = threading.Thread(target=reader, daemon=True)
+    t_out = threading.Thread(
+        target=reader, name="probe-stdout-reader", daemon=True
+    )
     t_out.start()
 
     failed_stage: Optional[str] = None
