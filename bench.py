@@ -102,7 +102,7 @@ def _bench_dispatcher(pool, enabled: bool):
 
 def bench_attach_to_ready(cycles: int = 40, size: int = 8,
                           store_latency_s: float = 0.0, cached: bool = True,
-                          fabric_batch: bool = True):
+                          fabric_batch: bool = True, decisions: bool = True):
     """Full request lifecycle through the live threaded operator.
 
     ``store_latency_s`` > 0 injects an apiserver-like round trip into every
@@ -119,7 +119,12 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8,
     blocking fabric calls inside reconcile workers. The bench's own
     readiness polls go through a separate read-only cached observer so
     harness reads never pollute the control loop's RTT count (or pay the
-    injected latency)."""
+    injected latency). ``decisions`` mirrors TPUC_DECISIONS: True (the
+    production default) runs the full decision observatory — the
+    scheduler's decision ledger, the goodput tracker on the lifecycle
+    watch, and a fast-cadence capacity sampler — and the result carries
+    its first goodput/capacity numbers; False is the escape-hatch control
+    the perf-smoke overhead gate compares against."""
     from tpu_composer.api import (
         ComposabilityRequest,
         ComposabilityRequestSpec,
@@ -151,10 +156,31 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8,
     agent = FakeNodeAgent(pool=pool)
     dispatcher = _bench_dispatcher(pool, fabric_batch)
     mgr = Manager(store=client)
+    from tpu_composer.scheduler import ClusterScheduler
+
+    scheduler = ClusterScheduler(client, decisions=decisions)
+    goodput_tracker = None
+    capacity_obs = None
+    if decisions:
+        from tpu_composer.runtime import lifecycle as lifecycle_mod
+        from tpu_composer.runtime.capacity import CapacityObservatory
+        from tpu_composer.runtime.goodput import GoodputTracker
+
+        # The full production-default decision observatory: ledger (above),
+        # goodput fed by the manager's lifecycle watch, and the capacity
+        # sampler at a deliberately fast cadence (production default 5 s).
+        goodput_tracker = GoodputTracker()
+        lifecycle_mod.add_transition_sink(goodput_tracker.observe)
+        capacity_obs = CapacityObservatory(
+            client, scheduler.engine, goodput=goodput_tracker, period=0.1,
+        )
+        mgr.add_runnable(capacity_obs.run)
     mgr.add_controller(ComposabilityRequestReconciler(
-        client, pool, timing=RequestTiming(updating_poll=0.01, cleaning_poll=0.01)))
+        client, pool, scheduler=scheduler,
+        timing=RequestTiming(updating_poll=0.01, cleaning_poll=0.01)))
     mgr.add_controller(ComposableResourceReconciler(
         client, pool, agent, dispatcher=dispatcher,
+        decision_ledger=scheduler.ledger,
         timing=ResourceTiming(attach_poll=0.01, visibility_poll=0.01,
                               detach_poll=0.01, detach_fast=0.01, busy_poll=0.01)))
     mgr.start(workers_per_controller=2)
@@ -193,9 +219,13 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8,
         if dispatcher is not None:
             dispatcher.stop()
         observer.stop_informers()
+        if goodput_tracker is not None:
+            from tpu_composer.runtime import lifecycle as lifecycle_mod
+
+            lifecycle_mod.remove_transition_sink(goodput_tracker.observe)
 
     latencies_ms.sort()
-    return {
+    out = {
         "p50": statistics.median(latencies_ms),
         "p90": latencies_ms[int(0.9 * (len(latencies_ms) - 1))],
         "max": latencies_ms[-1],
@@ -206,6 +236,24 @@ def bench_attach_to_ready(cycles: int = 40, size: int = 8,
         ),
         "fabric_calls": dict(pool.fabric_calls),
     }
+    if decisions:
+        led = scheduler.ledger
+        out["decisions_recorded"] = sum(
+            len(led.explain(n)["decisions"]) for n in led.names()
+        )
+        r = goodput_tracker.ratio()
+        if r is not None:
+            out["goodput_ratio"] = round(r, 4)
+        cap = capacity_obs.snapshot()
+        if cap["latest"] is not None:
+            out["capacity_timeline"] = {
+                "samples": cap["samples"],
+                "latest_free_chips": cap["latest"]["free_chips"],
+                "latest_largest_slice_chips":
+                    cap["latest"]["largest_slice_chips"],
+                "latest_fragmentation": cap["latest"]["fragmentation"],
+            }
+    return out
 
 
 def bench_accelerator():
@@ -1188,6 +1236,48 @@ def bench_observatory_overhead(children: int = 32, repeats: int = 3):
     }
 
 
+def bench_decision_overhead(cycles: int = 8, size: int = 4,
+                            repeats: int = 3):
+    """Decision-observatory cost on the REQUEST path (the ledger's hot
+    path lives in ClusterScheduler.place, which the fabric-wave harness
+    never exercises): best-of-N attach-to-ready p50 over a 32-chip run
+    (``cycles`` x ``size``) with the full decision plane on — ledger with
+    candidate verdicts, goodput tracker on the lifecycle watch, capacity
+    sampler at 50x production cadence — vs the TPUC_DECISIONS=0 control.
+    Count-based half: with cached reads the whole observatory runs off
+    informer snapshots, so it must add ~ZERO store wire round trips per
+    attach (the per-attach RTT counts on/off may differ only by noise)."""
+
+    def run(enabled: bool):
+        best = None
+        for _ in range(repeats):
+            r = bench_attach_to_ready(cycles=cycles, size=size, cached=True,
+                                      decisions=enabled)
+            if best is None or r["p50"] < best["p50"]:
+                best = r
+        return best
+
+    off = run(False)
+    on = run(True)
+    out = {
+        "cycles": cycles,
+        "size": size,
+        "decisions_on_p50_ms": round(on["p50"], 3),
+        "decisions_off_p50_ms": round(off["p50"], 3),
+        "overhead_pct": round(
+            (on["p50"] / max(off["p50"], 1e-9) - 1.0) * 100, 2
+        ),
+        "rtts_per_attach_on": on["rtts_per_attach"],
+        "rtts_per_attach_off": off["rtts_per_attach"],
+        "decisions_recorded": on.get("decisions_recorded", 0),
+    }
+    if "goodput_ratio" in on:
+        out["goodput_ratio"] = on["goodput_ratio"]
+    if "capacity_timeline" in on:
+        out["capacity_timeline"] = on["capacity_timeline"]
+    return out
+
+
 def bench_tracing_overhead(children: int = 32, repeats: int = 3):
     """Tracing-cost measurement on the 32-chip same-node wave: best-of-N
     wall time with causal tracing recording every span/flow vs the
@@ -1241,7 +1331,13 @@ def perf_smoke(cycles: int = 3):
        wait/hold observation + SLO evaluation + the fleet telemetry
        publisher/aggregator (at 8x its production cadence) together must
        add <5% to the same wave versus TPUC_PROFILE=0 / TPUC_FLEET=0
-       (same 50 ms allowance).
+       (same 50 ms allowance);
+    6. decision-ledger overhead — the scheduler decision observatory
+       (ledger + goodput accounting + capacity sampler) must add <5% to
+       the 32-chip REQUEST-path run's best-of-3 attach p50 versus
+       TPUC_DECISIONS=0 (same 50 ms allowance), and — count-based — must
+       add no store wire round trips per attach under cached reads (the
+       whole plane runs off informer snapshots).
 
     Run via ``make perf-smoke``."""
     on = bench_attach_cluster(cycles=cycles, rtt_s=0.0, cached=True)
@@ -1250,6 +1346,7 @@ def perf_smoke(cycles: int = 3):
     wave_off = bench_fabric_wave(children=8, fabric_batch=False)
     tracing_cost = bench_tracing_overhead(children=32, repeats=3)
     observatory_cost = bench_observatory_overhead(children=32, repeats=3)
+    decision_cost = bench_decision_overhead(cycles=8, size=4, repeats=3)
     event_plane = bench_event_plane(ops=12, poll_interval=0.5)
     out = {
         "metric": "perf_smoke_store_rtts_per_attach",
@@ -1264,6 +1361,11 @@ def perf_smoke(cycles: int = 3):
         "observatory_overhead_pct": observatory_cost["overhead_pct"],
         "observatory_on_best_s": observatory_cost["observatory_on_best_s"],
         "observatory_off_best_s": observatory_cost["observatory_off_best_s"],
+        "decision_overhead_pct": decision_cost["overhead_pct"],
+        "decision_on_p50_ms": decision_cost["decisions_on_p50_ms"],
+        "decision_off_p50_ms": decision_cost["decisions_off_p50_ms"],
+        "decision_rtts_on": decision_cost["rtts_per_attach_on"],
+        "decision_rtts_off": decision_cost["rtts_per_attach_off"],
         "event_completion_p50_s": event_plane["event_driven"]["p50_s"],
         "poll_completion_p50_s": event_plane["poll_driven"]["p50_s"],
         "event_poll_fallbacks": event_plane["event_driven"]["poll_fallbacks"],
@@ -1299,6 +1401,31 @@ def perf_smoke(cycles: int = 3):
         f" {observatory_cost['observatory_off_best_s']}s under"
         " TPUC_PROFILE=0/TPUC_FLEET=0 (expected <5% overhead — always-on"
         " observability must stay cheap)"
+    )
+    assert (
+        decision_cost["decisions_on_p50_ms"]
+        <= decision_cost["decisions_off_p50_ms"] * 1.05 + 50.0
+    ), (
+        "decision-ledger overhead regression: the 32-chip request run's"
+        f" attach p50 was {decision_cost['decisions_on_p50_ms']}ms with the"
+        " decision ledger + goodput accounting + capacity sampler on vs"
+        f" {decision_cost['decisions_off_p50_ms']}ms under TPUC_DECISIONS=0"
+        " (expected <5% overhead — every placement explaining itself must"
+        " stay cheap)"
+    )
+    assert decision_cost["decisions_recorded"] > 0, (
+        "decision-ledger bench harness broke: the enabled run recorded no"
+        " decisions — the overhead measurement is not exercising the ledger"
+    )
+    assert (
+        decision_cost["rtts_per_attach_on"]
+        <= decision_cost["rtts_per_attach_off"] + 1.0
+    ), (
+        "decision-ledger wire-cost regression: cached-read attaches paid"
+        f" {decision_cost['rtts_per_attach_on']} store RTTs/attach with the"
+        f" ledger on vs {decision_cost['rtts_per_attach_off']} off — the"
+        " candidate/inputs scans must run off informer snapshots, not the"
+        " wire"
     )
     floor = event_plane["poll_interval_s"]
     ev, po = event_plane["event_driven"], event_plane["poll_driven"]
@@ -1413,6 +1540,21 @@ def main():
         }
     except Exception as e:
         migration = {"error": str(e)}
+    # Decision observatory: ledger + goodput + capacity-timeline cost vs
+    # the TPUC_DECISIONS=0 control, plus the round's first goodput and
+    # capacity numbers (from the enabled run's own sampling).
+    try:
+        dc = bench_decision_overhead()
+        decision_plane = {
+            "overhead_pct": dc["overhead_pct"],
+            "p50_on_ms": dc["decisions_on_p50_ms"],
+            "p50_off_ms": dc["decisions_off_p50_ms"],
+            "decisions_recorded": dc["decisions_recorded"],
+            "goodput_ratio": dc.get("goodput_ratio"),
+            "capacity_timeline": dc.get("capacity_timeline"),
+        }
+    except Exception as e:
+        decision_plane = {"error": str(e)}
     try:
         accel = bench_accelerator()
     except ImportError as e:
@@ -1451,6 +1593,7 @@ def main():
         "hot_spots": {"attach_32chip": hot_32, "shard_2replica": hot_shard},
         "event_plane": event_plane,
         "migration": migration,
+        "decision_plane": decision_plane,
         "phase_durations": phase_durations,
         "accelerator": summarize_accelerator(accel),
         "full_record": "bench_artifacts/bench_full.json",

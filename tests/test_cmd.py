@@ -275,6 +275,91 @@ class TestBuildManager:
         finally:
             mgr.stop()
 
+    def test_decisions_default_and_escape_hatch(self, monkeypatch, tmp_path):
+        """Default wiring builds the scheduler decision observatory: the
+        decision ledger (ClusterScheduler + defrag + /debug explain route
+        + Queued/Placed events via the manager recorder), the goodput
+        tracker (lifecycle sink + goodput SLO objective + fleet
+        publication), and the capacity sampler runnable. TPUC_DECISIONS=0
+        (or --no-decisions) constructs NONE of it."""
+        monkeypatch.setenv("CDI_PROVIDER_TYPE", "MOCK")
+        monkeypatch.delenv("NODE_AGENT", raising=False)
+        from tpu_composer.controllers import ComposabilityRequestReconciler
+        from tpu_composer.fabric.adapter import reset_shared_mock
+        from tpu_composer.runtime import lifecycle
+        from tpu_composer.runtime.capacity import CapacityObservatory
+        from tpu_composer.runtime.goodput import GoodputTracker
+        from tpu_composer.runtime.slo import GoodputObjective
+        from tpu_composer.scheduler import DecisionLedger
+
+        reset_shared_mock()
+        args = build_parser().parse_args([
+            "--state-dir", str(tmp_path / "s1"),
+            "--capacity-sample-period", "0.9",
+            "--slo-goodput-target", "0.92",
+        ])
+        assert args.decisions is True
+        mgr = build_manager(args)
+        try:
+            rec = next(c for c in mgr._controllers
+                       if isinstance(c, ComposabilityRequestReconciler))
+            assert isinstance(rec.scheduler.ledger, DecisionLedger)
+            assert mgr.decisions is rec.scheduler.ledger
+            assert rec.scheduler.ledger.recorder is mgr.recorder
+            assert rec.scheduler.defrag.decision_ledger is (
+                rec.scheduler.ledger
+            )
+            assert isinstance(mgr.goodput, GoodputTracker)
+            assert mgr.goodput.observe in lifecycle._transition_sinks
+            assert isinstance(mgr.capacity, CapacityObservatory)
+            assert mgr.capacity.period == 0.9
+            assert mgr.capacity.goodput is mgr.goodput
+            assert any(
+                getattr(r, "__self__", None) is mgr.capacity
+                for r in mgr._runnables
+            ), "capacity sampler never registered as a manager runnable"
+            by_name = {o.name: o for o in mgr.slo_engine.objectives}
+            assert isinstance(by_name["goodput"], GoodputObjective)
+            assert by_name["goodput"].target == 0.92
+            assert by_name["goodput"].tracker is mgr.goodput
+            # Queue-wait breaches name the dominant hold-back reason.
+            assert mgr.slo_engine.annotators["queue_wait_p99"] == (
+                rec.scheduler.ledger.dominant_hold_back_reason
+            )
+        finally:
+            mgr.stop()
+        # Manager.stop unregistered the lifecycle sink.
+        assert all(
+            getattr(s, "__self__", None) is not mgr.goodput
+            for s in lifecycle._transition_sinks
+        )
+
+        monkeypatch.setenv("TPUC_DECISIONS", "0")
+        reset_shared_mock()
+        sinks_before = len(lifecycle._transition_sinks)
+        args = build_parser().parse_args(["--state-dir", str(tmp_path / "s2")])
+        assert args.decisions is False
+        mgr = build_manager(args)
+        try:
+            rec = next(c for c in mgr._controllers
+                       if isinstance(c, ComposabilityRequestReconciler))
+            assert rec.scheduler.ledger is None
+            assert rec.scheduler.defrag.decision_ledger is None
+            assert mgr.decisions is None
+            assert mgr.goodput is None
+            assert mgr.capacity is None
+            assert len(lifecycle._transition_sinks) == sinks_before
+            assert "goodput" not in {
+                o.name for o in mgr.slo_engine.objectives
+            }
+            assert "queue_wait_p99" not in mgr.slo_engine.annotators
+            assert not any(
+                isinstance(getattr(r, "__self__", None), CapacityObservatory)
+                for r in mgr._runnables
+            )
+        finally:
+            mgr.stop()
+
     def test_migrate_default_and_escape_hatch(self, monkeypatch, tmp_path):
         """Default wiring constructs the live-migration verb end to end:
         the NodeMaintenance drain controller, the request controller's

@@ -1,0 +1,520 @@
+"""Decision ledger: every placement explains itself.
+
+Unit tests for the ledger's ring/dedup/reason-tally mechanics, integration
+tests driving the real reconcilers (placed / held-back / preempting records
+with candidate verdicts, tiebreak rationale and binding constraints), the
+32-chip acceptance replay (every placement, hold-back and preemption has a
+record; one hold-back names its binding resource; the explain endpoint and
+CLI serve it), and the capacity observatory's supply-curve arithmetic."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_composer.api import ComposabilityRequest, ComposableResource
+from tpu_composer.api.types import (
+    PREEMPT_NEVER,
+    REQUEST_STATE_NODE_ALLOCATING,
+    REQUEST_STATE_RUNNING,
+)
+from tpu_composer.runtime import tracing
+from tpu_composer.runtime.capacity import (
+    CapacityObservatory,
+    largest_placeable_slice,
+)
+from tpu_composer.runtime.events import EventRecorder
+from tpu_composer.runtime.metrics import (
+    capacity_free_chips,
+    capacity_largest_slice_chips,
+    scheduler_held_back_total,
+)
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.store import Store
+from tpu_composer.scheduler import DecisionLedger, DecisionRecord
+from tpu_composer.scheduler import ledger as ledger_mod
+from tpu_composer.fabric.provider import FabricError
+
+from tests.test_scheduler import (  # noqa: F401 (world helpers)
+    make_request,
+    make_world,
+    pump,
+    run_to_ready,
+)
+
+
+def _rec(request="r", kind=ledger_mod.KIND_PLACE,
+         outcome=ledger_mod.OUTCOME_PLACED, summary="s", **kw):
+    return DecisionRecord(request=request, kind=kind, outcome=outcome,
+                          summary=summary, **kw)
+
+
+# ---------------------------------------------------------------------------
+# ledger mechanics
+# ---------------------------------------------------------------------------
+class TestLedgerMechanics:
+    def test_fresh_records_append_and_get_ids(self):
+        led = DecisionLedger()
+        a = led.record(_rec(summary="first"))
+        b = led.record(_rec(summary="second"))
+        assert a.decision_id and b.decision_id and a.decision_id != b.decision_id
+        assert b.seq > a.seq
+        doc = led.explain("r")
+        assert [d["summary"] for d in doc["decisions"]] == ["first", "second"]
+        assert doc["latest"]["summary"] == "second"
+
+    def test_identical_repeats_collapse(self):
+        """A queued request re-deciding per backoff tick must not churn the
+        ring (or spam events): identical consecutive decisions collapse
+        into one record with a repeats counter."""
+        recorder = EventRecorder()
+        led = DecisionLedger(recorder=recorder)
+        for _ in range(5):
+            led.record(_rec(outcome=ledger_mod.OUTCOME_HELD_BACK,
+                            summary="held back: need 2 hosts",
+                            binding={"resource": "tpu-ports"}))
+        doc = led.explain("r")
+        assert len(doc["decisions"]) == 1
+        assert doc["latest"]["repeats"] == 5
+        # One Queued event for five identical decisions.
+        events = [e for e in recorder.all() if e.reason == "Queued"]
+        assert len(events) == 1
+        # ...but a DIFFERENT decision appends (and events) again.
+        led.record(_rec(summary="placed on worker-0"))
+        assert len(led.explain("r")["decisions"]) == 2
+        assert [e.reason for e in recorder.all()] == ["Queued", "Placed"]
+
+    def test_ring_and_object_bounds(self):
+        led = DecisionLedger(per_object=4, max_objects=3)
+        for i in range(10):
+            led.record(_rec(summary=f"s{i}"))
+        assert len(led.explain("r")["decisions"]) == 4
+        for i in range(5):
+            led.record(_rec(request=f"other-{i}", summary="x"))
+        assert len(led.names()) <= 3
+
+    def test_bump_if_recent_rate_limits_without_sliding(self):
+        """Repeat hold-backs inside the rescan window collapse without a
+        rebuild; the window anchors at the last FULL record, so bumps
+        cannot defer the shortfall refresh forever; and the binding
+        resource gates the match (a gate hold never collapses into a
+        capacity hold)."""
+        led = DecisionLedger()
+        led.record(_rec(outcome=ledger_mod.OUTCOME_HELD_BACK, summary="h",
+                        binding={"resource": "tpu-ports"}))
+        first = led.latest("r")
+        anchor = first.mono
+        assert led.bump_if_recent(
+            "r", ledger_mod.KIND_PLACE, ledger_mod.OUTCOME_HELD_BACK,
+            exclude_resources=("backfill-gate", "fabric-reservation"),
+        ) is first
+        assert first.repeats == 2
+        assert first.mono == anchor  # bump did not slide the window
+        # Resource filters: exact-match misses, exclusion hits.
+        assert led.bump_if_recent(
+            "r", ledger_mod.KIND_PLACE, ledger_mod.OUTCOME_HELD_BACK,
+            resource="backfill-gate",
+        ) is None
+        # Past the window: the caller must rebuild (full rescan).
+        assert led.bump_if_recent(
+            "r", ledger_mod.KIND_PLACE, ledger_mod.OUTCOME_HELD_BACK,
+            within_s=0.0,
+        ) is None
+
+    def test_dominant_hold_back_reason(self):
+        led = DecisionLedger()
+        for i in range(3):
+            led.record(_rec(outcome=ledger_mod.OUTCOME_HELD_BACK,
+                            summary=f"a{i}",
+                            binding={"resource": "tpu-ports"}))
+        led.record(_rec(outcome=ledger_mod.OUTCOME_HELD_BACK, summary="b",
+                        binding={"resource": "backfill-gate"}))
+        assert led.dominant_hold_back_reason().startswith("tpu-ports")
+
+    def test_dump_round_trip(self, tmp_path):
+        led = DecisionLedger()
+        led.record(_rec(summary="placed on worker-1",
+                        chosen=["worker-1"], tiebreak="tightest-fit"))
+        path = str(tmp_path / "decisions.json")
+        assert led.dump(path) == path
+        doc = json.loads(open(path).read())
+        assert doc["requests"]["r"][0]["chosen"] == ["worker-1"]
+
+    def test_latest_placed_skips_holds(self):
+        led = DecisionLedger()
+        led.record(_rec(summary="placed", chosen=["w0"]))
+        led.record(_rec(outcome=ledger_mod.OUTCOME_HELD_BACK, summary="h",
+                        binding={"resource": "tpu-ports"}))
+        assert led.latest_placed("r").chosen == ["w0"]
+
+    def test_link_decision_records_nonce_and_consumes_flow(self):
+        led = DecisionLedger()
+        ctx = tracing.new_trace("d-test")
+        with tracing.span("scheduler.decide", cat="scheduler"):
+            flows = [ctx.handoff()]
+        rec = _rec(summary="placed", chosen=["w0"])
+        rec.flows = flows
+        led.record(rec)
+        did = led.link_decision("r", "nonce-1")
+        assert did == rec.decision_id
+        assert rec.nonces == ["nonce-1"]
+        assert rec.flows == []  # consumed
+        # Unknown owner / no placed decision: quiet no-ops.
+        assert led.link_decision("ghost", "n") == ""
+
+
+class TestLedgerPlumbing:
+    def test_dump_file_via_env(self, tmp_path, monkeypatch):
+        """The crash hooks' path: the ACTIVE ledger dumps to
+        $TPUC_DECISIONS_FILE (the soak failure artifact)."""
+        led = DecisionLedger()
+        led.record(_rec(summary="placed on w0", chosen=["w0"]))
+        path = str(tmp_path / "ring.json")
+        monkeypatch.setenv("TPUC_DECISIONS_FILE", path)
+        assert ledger_mod.dump_file() == path
+        assert "w0" in open(path).read()
+        ledger_mod.deactivate(led)
+        assert ledger_mod.dump_file() is None
+
+    def test_queue_wait_breach_names_dominant_hold_back(self):
+        """Satellite: the queue-wait SLO breach Event carries the ledger's
+        dominant hold-back reason as its probable cause."""
+        from tpu_composer.runtime.metrics import Histogram
+        from tpu_composer.runtime.slo import Objective, SloEngine
+
+        led = DecisionLedger()
+        for i in range(4):
+            led.record(_rec(outcome=ledger_mod.OUTCOME_HELD_BACK,
+                            summary=f"h{i}", request=f"r{i}",
+                            binding={"resource": "tpu-ports"}))
+        hist = Histogram("test_queue_wait_annot")
+        recorder = EventRecorder()
+        eng = SloEngine(
+            objectives=[Objective("queue_wait_p99", hist, 1.0, 0.99)],
+            recorder=recorder, fast_window=10.0, slow_window=30.0,
+        )
+        eng.annotators["queue_wait_p99"] = led.dominant_hold_back_reason
+        eng.evaluate(now=0.0)
+        for _ in range(50):
+            hist.observe(30.0)  # every sample blows the 1s threshold
+        eng.evaluate(now=20.0)
+        eng.evaluate(now=40.0)
+        breaches = [e for e in recorder.all() if e.reason == "SloBreached"]
+        assert breaches, "queue-wait objective never breached"
+        assert "probable cause: tpu-ports" in breaches[-1].message
+
+
+# ---------------------------------------------------------------------------
+# decisions through the real reconcilers
+# ---------------------------------------------------------------------------
+class TestPlacementDecisions:
+    def test_placed_record_matches_execution_and_joins_intents(self):
+        store, pool, req_rec, res_rec = make_world(n_nodes=4)
+        led = req_rec.scheduler.ledger
+        assert led is not None  # default construction has the ledger ON
+        make_request(store, "gang", size=8)  # 2 hosts x 4 chips
+        run_to_ready(store, req_rec, res_rec, "gang")
+
+        rec = led.latest_placed("gang")
+        assert rec is not None and rec.kind == "place"
+        req = store.get(ComposabilityRequest, "gang")
+        assert sorted(rec.chosen) == sorted(req.status.slice.worker_hostnames)
+        assert rec.demand == {"num_hosts": 2, "chips_per_host": 4}
+        assert "tightest-fit" in rec.tiebreak
+        # Candidate verdicts cover the cluster, fitting nodes first.
+        assert {c["node"] for c in rec.candidates} == {
+            f"worker-{i}" for i in range(4)
+        }
+        assert all(c["verdict"] == "ok" for c in rec.candidates[:2])
+        # Inputs digest: what the decision saw.
+        assert rec.inputs["schedulable_hosts"] == 4
+        assert rec.inputs["free_chips"] == 16
+        # The attach intents joined the decision (link_decision at mint).
+        assert len(rec.nonces) == 2
+        # The decision span exists under the decision id's trace.
+        spans = [e for e in tracing.trace_events(rec.decision_id)
+                 if e.get("ph") == "X"]
+        assert any(e["name"] == "scheduler.decide" for e in spans)
+
+    def test_hold_back_names_binding_resource(self):
+        store, pool, req_rec, res_rec = make_world(n_nodes=2)
+        led = req_rec.scheduler.ledger
+        make_request(store, "occupant-0", size=4, target="worker-0",
+                     policy=PREEMPT_NEVER)
+        make_request(store, "occupant-1", size=4, target="worker-1",
+                     policy=PREEMPT_NEVER)
+        for n in ("occupant-0", "occupant-1"):
+            run_to_ready(store, req_rec, res_rec, n)
+
+        before = scheduler_held_back_total.total()
+        make_request(store, "starved", size=8)  # needs 2 free hosts; 0 exist
+        pump(store, req_rec, res_rec, steps=3)
+        req = store.get(ComposabilityRequest, "starved")
+        assert req.status.state in ("", REQUEST_STATE_NODE_ALLOCATING)
+
+        rec = led.latest("starved")
+        assert rec.outcome == "held-back"
+        assert rec.binding["resource"] == "tpu-ports"
+        assert rec.binding["fitting_hosts"] == 0
+        assert rec.binding["short_hosts"] == 2
+        assert "tpu-ports" in rec.summary
+        # The hold-back's decision id IS its scheduler.decide span's trace
+        # id — the Perfetto join works for non-placed outcomes too.
+        spans = [e for e in tracing.trace_events(rec.decision_id)
+                 if e.get("ph") == "X"]
+        assert any(e["name"] == "scheduler.decide" for e in spans)
+        # The labeled counter moved under the binding reason, and the
+        # unlabeled pre-ledger semantics survive as the sum over labels.
+        after = scheduler_held_back_total.total()
+        assert after > before
+        label_sum = sum(
+            scheduler_held_back_total.value(**labels)
+            for labels in scheduler_held_back_total.label_sets()
+        )
+        assert label_sum == pytest.approx(after)
+        assert scheduler_held_back_total.value(reason="tpu-ports") > 0
+
+    def test_preempt_record_carries_minimality_rationale(self):
+        store, pool, req_rec, res_rec = make_world(n_nodes=1)
+        led = req_rec.scheduler.ledger
+        make_request(store, "batch", size=4)
+        run_to_ready(store, req_rec, res_rec, "batch")
+        make_request(store, "urgent", size=4, priority=10)
+        pump(store, req_rec, res_rec, steps=2)
+
+        rec = next(r for r in reversed(
+            led.explain("urgent")["decisions"]
+        ) if r["outcome"] == "preempting")
+        assert rec["victims"] == ["batch"]
+        assert "exhaustive" in rec["victim_rationale"]
+        assert "cardinality" in rec["victim_rationale"]
+
+    def test_gate_hold_back_names_protected_request(self):
+        """The backfill gate's hold-back record names the higher-priority
+        pending demand it is protecting (binding: backfill-gate)."""
+        store, pool, req_rec, res_rec = make_world(n_nodes=1)
+        led = req_rec.scheduler.ledger
+        make_request(store, "occupant", size=4, policy=PREEMPT_NEVER)
+        run_to_ready(store, req_rec, res_rec, "occupant")
+        make_request(store, "hp", size=4, priority=50)
+        pump(store, req_rec, res_rec, steps=3)  # hp queues (Never blocks)
+        store.delete(ComposabilityRequest, "occupant")
+        # Drain only the occupant so capacity frees while hp still queues.
+        for _ in range(20):
+            try:
+                req_rec.reconcile("occupant")
+            except FabricError:
+                pass
+            for c in store.list(ComposableResource):
+                try:
+                    res_rec.reconcile(c.metadata.name)
+                except FabricError:
+                    pass
+            if store.try_get(ComposabilityRequest, "occupant") is None:
+                break
+        make_request(store, "lp", size=4, priority=0)
+        with pytest.raises(Exception):
+            req_rec.reconcile("lp")
+        rec = led.latest("lp")
+        assert rec.outcome == "held-back"
+        assert rec.binding["resource"] == "backfill-gate"
+        assert rec.binding["protecting"] == "hp"
+        assert scheduler_held_back_total.value(reason="backfill-gate") > 0
+
+    def test_queued_and_placed_events_ride_the_recorder(self):
+        store, pool, req_rec, res_rec = make_world(n_nodes=1)
+        make_request(store, "occ", size=4, policy=PREEMPT_NEVER)
+        run_to_ready(store, req_rec, res_rec, "occ")
+        make_request(store, "waiting", size=4)
+        pump(store, req_rec, res_rec, steps=3)
+        reasons = {e.reason for e in req_rec.recorder.for_object(
+            kind="ComposabilityRequest", name="waiting")}
+        assert "Queued" in reasons
+        reasons_occ = {e.reason for e in req_rec.recorder.for_object(
+            kind="ComposabilityRequest", name="occ")}
+        assert "Placed" in reasons_occ
+
+    def test_disabled_ledger_constructs_nothing(self):
+        from tpu_composer.scheduler import ClusterScheduler
+
+        store = Store()
+        sched = ClusterScheduler(store, decisions=False)
+        assert sched.ledger is None
+        assert sched.defrag.decision_ledger is None
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 32-chip sim replay + explain endpoint + CLI
+# ---------------------------------------------------------------------------
+class TestExplainAcceptance:
+    def _build_32chip_story(self):
+        """8 hosts x 4 ports = 32 chips: placements, one minimal
+        preemption, and one capacity hold-back whose record must name the
+        binding resource."""
+        store, pool, req_rec, res_rec = make_world(
+            n_nodes=8, chips={"tpu-v4": 32}
+        )
+        # Fill six hosts with whole-host batch jobs; fragment a seventh.
+        for i in range(6):
+            make_request(store, f"batch-{i}", size=4, target=f"worker-{i}")
+        make_request(store, "frag", size=2, target="worker-6")
+        for i in range(6):
+            run_to_ready(store, req_rec, res_rec, f"batch-{i}")
+        run_to_ready(store, req_rec, res_rec, "frag")
+        # Priority-100 2-host gang: must preempt exactly the 2-chip frag.
+        make_request(store, "inference", size=8, priority=100)
+        pump(store, req_rec, res_rec, steps=40)
+        run_to_ready(store, req_rec, res_rec, "inference")
+        # Priority-0 gang with nowhere to go: the hold-back.
+        make_request(store, "starved", size=8)
+        pump(store, req_rec, res_rec, steps=3)
+        return store, req_rec, res_rec
+
+    def test_every_decision_has_a_record_and_endpoint_serves_it(self):
+        store, req_rec, res_rec = self._build_32chip_story()
+        led = req_rec.scheduler.ledger
+
+        # Every placement has a record whose chosen hosts match execution.
+        for r in store.list(ComposabilityRequest):
+            if r.status.state != REQUEST_STATE_RUNNING:
+                continue
+            rec = led.latest_placed(r.name)
+            assert rec is not None, f"{r.name} placed without a record"
+            assert sorted(rec.chosen) == sorted(
+                r.status.slice.worker_hostnames
+            ), r.name
+        # The preemption explained itself.
+        pre = [d for d in led.explain("inference")["decisions"]
+               if d["outcome"] == "preempting"]
+        assert pre and pre[0]["victims"] == ["frag"]
+        assert "minimal" in pre[0]["victim_rationale"]
+        # The hold-back names its binding resource.
+        hold = led.latest("starved")
+        assert hold.outcome == "held-back"
+        assert hold.binding["resource"] == "tpu-ports"
+        assert hold.binding["short_hosts"] >= 1
+        # The victim's ring still shows its own original placement AND the
+        # re-queue story (held-back after eviction).
+        frag_outcomes = [d["outcome"] for d in
+                         led.explain("frag")["decisions"]]
+        assert "placed" in frag_outcomes
+
+        # /debug/scheduler/explain/<name> serves the ring.
+        mgr = Manager(store=store, health_addr="127.0.0.1:0",
+                      decisions=led)
+        mgr.start()
+        try:
+            port = mgr.health_port
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/scheduler/explain/starved"
+            ) as resp:
+                doc = json.load(resp)
+            assert doc["latest"]["binding"]["resource"] == "tpu-ports"
+            assert doc["latest"]["outcome"] == "held-back"
+            # Unknown CR -> 404; and the /debug index lists the route.
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/scheduler/explain/ghost"
+                )
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug"
+            ) as resp:
+                idx = json.load(resp)
+            assert "/debug/scheduler/explain/<name>" in idx["endpoints"]
+        finally:
+            mgr.stop()
+
+    def test_explain_cli_from_live_operator_and_dump(self, tmp_path, capsys):
+        from tpu_composer.cmd.main import main as cmd_main
+
+        store, req_rec, res_rec = self._build_32chip_story()
+        led = req_rec.scheduler.ledger
+        mgr = Manager(store=store, health_addr="127.0.0.1:0", decisions=led)
+        mgr.start()
+        try:
+            rc = cmd_main(["explain", "starved",
+                           "--addr", f"127.0.0.1:{mgr.health_port}"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "held-back" in out and "tpu-ports" in out
+        finally:
+            mgr.stop()
+        # And offline, from a crash dump.
+        path = str(tmp_path / "decisions.json")
+        led.dump(path)
+        rc = cmd_main(["explain", "inference", "--file", path])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "preempting" in out and "frag" in out
+        # Unknown request exits non-zero.
+        assert cmd_main(["explain", "ghost", "--file", path]) == 1
+
+    def test_endpoint_503_when_disabled(self):
+        mgr = Manager(store=Store(), health_addr="127.0.0.1:0")
+        mgr.start()
+        try:
+            for route in ("/debug/scheduler/explain/x",
+                          "/debug/scheduler/capacity", "/debug/goodput"):
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{mgr.health_port}{route}"
+                    )
+                    assert False, f"expected 503 for {route}"
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503, route
+        finally:
+            mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# capacity observatory
+# ---------------------------------------------------------------------------
+class TestCapacity:
+    def test_largest_placeable_slice_arithmetic(self):
+        assert largest_placeable_slice({}) == 0
+        assert largest_placeable_slice({"a": 0, "b": 0}) == 0
+        # One host, 4 free -> a 1x4 slice.
+        assert largest_placeable_slice({"a": 4}) == 4
+        # [4, 4, 2, 1]: 2 hosts x 4 chips beats 3 hosts x 2 and 4 x 1.
+        assert largest_placeable_slice(
+            {"a": 4, "b": 4, "c": 2, "d": 1}
+        ) == 8
+        # [3, 3, 3]: 3 hosts x 3 chips.
+        assert largest_placeable_slice({"a": 3, "b": 3, "c": 3}) == 9
+
+    def test_sampler_sets_gauges_and_ring(self):
+        store, pool, req_rec, res_rec = make_world(n_nodes=4)
+        make_request(store, "half", size=8)  # occupies 2 of 4 hosts
+        run_to_ready(store, req_rec, res_rec, "half")
+        obs = CapacityObservatory(store, req_rec.scheduler.engine,
+                                  period=1.0, ring=8)
+        sample = obs.sample()
+        assert sample["free_chips"] == 8
+        assert sample["largest_slice_chips"] == 8  # 2 empty hosts x 4
+        assert sample["hosts_by_free"] == {"0": 2, "4": 2}
+        assert capacity_free_chips.value() == 8.0
+        assert capacity_largest_slice_chips.value() == 8.0
+        snap = obs.snapshot()
+        assert snap["latest"]["free_chips"] == 8
+        assert len(snap["timeline"]) == 1
+
+    def test_sampler_serves_on_manager_endpoint(self):
+        store, pool, req_rec, res_rec = make_world(n_nodes=2)
+        obs = CapacityObservatory(store, req_rec.scheduler.engine)
+        obs.sample()
+        mgr = Manager(store=store, health_addr="127.0.0.1:0", capacity=obs)
+        mgr.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mgr.health_port}/debug/scheduler/capacity"
+            ) as resp:
+                doc = json.load(resp)
+            assert doc["latest"]["free_chips"] == 8
+        finally:
+            mgr.stop()

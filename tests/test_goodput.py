@@ -1,0 +1,226 @@
+"""Goodput accounting: Ready-serving time vs queued/degraded/repairing/
+migrating wall time, deterministic against an injected clock.
+
+The acceptance sequence the ISSUE names — degrade -> repair -> recover —
+drives the tracker through member transitions and asserts the ratio
+matches the phase clock exactly. Plus: the SLO objective's monotonic
+counters, the fleet plane's cross-replica merge, and the live-reconciler
+integration (the lifecycle watch feeds the tracker)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from tpu_composer.api import ComposabilityRequest
+from tpu_composer.api.types import REQUEST_STATE_RUNNING
+from tpu_composer.runtime import lifecycle
+from tpu_composer.runtime.goodput import GoodputTracker
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.metrics import goodput_ratio
+from tpu_composer.runtime.slo import GoodputObjective, SloEngine
+from tpu_composer.runtime.store import Store
+
+from tests.test_scheduler import make_request, make_world, run_to_ready
+
+REQ = "ComposabilityRequest"
+RES = "ComposableResource"
+
+
+class TestPhaseClock:
+    def test_degrade_repair_recover_matches_phase_clock(self):
+        """The ISSUE's acceptance sequence: the ratio must equal the phase
+        arithmetic exactly (injected clock, no wall time)."""
+        t = GoodputTracker(now=lambda: 0.0)
+        t.observe(REQ, "job", "", now=0.0)            # queued
+        t.observe(REQ, "job", "Updating", now=3.0)    # queued 3s
+        t.observe(REQ, "job", "Running", now=5.0)     # provisioning 2s
+        # Member degrades while the request stays Running.
+        t.observe(RES, "job-m0", "Degraded", owner="job", now=10.0)  # ready 5s
+        t.observe(RES, "job-m0", "Repairing", owner="job", now=12.0)  # degraded 2s
+        t.observe(RES, "job-m0", "Online", owner="job", now=15.0)     # repairing 3s
+        t.observe(REQ, "job", "Cleaning", now=20.0)   # ready 5s more
+
+        view = t.request_view("job", now=20.0)
+        assert view["seconds"] == {
+            "queued": 3.0, "provisioning": 2.0, "ready": 10.0,
+            "degraded": 2.0, "repairing": 3.0,
+        }
+        # ratio = ready / total = 10 / 20
+        assert view["goodput_ratio"] == pytest.approx(0.5)
+        total, lost = t.counts(now=20.0)
+        assert total == pytest.approx(20.0)
+        assert lost == pytest.approx(10.0)
+        # Terminating time is excluded: the clock froze at Cleaning.
+        total2, lost2 = t.counts(now=100.0)
+        assert (total2, lost2) == (total, lost)
+
+    def test_worst_impairment_wins_and_recovery_restores_ready(self):
+        t = GoodputTracker(now=lambda: 0.0)
+        t.observe(REQ, "job", "Running", now=0.0)
+        t.observe(RES, "m0", "Degraded", owner="job", now=2.0)
+        t.observe(RES, "m1", "Repairing", owner="job", now=4.0)  # degraded 2s
+        # Repairing outranks Degraded while both are impaired.
+        t.observe(RES, "m1", "Online", owner="job", now=7.0)     # repairing 3s
+        t.observe(RES, "m0", "Online", owner="job", now=9.0)     # degraded 2s
+        view = t.request_view("job", now=10.0)
+        assert view["seconds"] == {
+            "ready": 3.0, "degraded": 4.0, "repairing": 3.0,
+        }
+
+    def test_migrating_member_counts_as_lost(self):
+        t = GoodputTracker(now=lambda: 0.0)
+        t.observe(REQ, "job", "Running", now=0.0)
+        t.observe(RES, "m0", "Migrating", owner="job", now=5.0)
+        t.observe(RES, "m0", "Online", owner="job", now=8.0)
+        view = t.request_view("job", now=10.0)
+        assert view["seconds"]["migrating"] == pytest.approx(3.0)
+        assert view["seconds"]["ready"] == pytest.approx(7.0)
+
+    def test_deleted_request_retires_into_process_totals(self):
+        t = GoodputTracker(now=lambda: 0.0)
+        t.observe(REQ, "job", "Running", now=0.0)
+        t.observe(REQ, "job", "(deleted)", now=4.0)
+        assert t.names() == []
+        total, lost = t.counts(now=10.0)
+        assert total == pytest.approx(4.0)
+        assert lost == pytest.approx(0.0)
+        assert t.ratio(now=10.0) == pytest.approx(1.0)
+
+    def test_counts_monotonic_with_in_progress_accrual(self):
+        t = GoodputTracker(now=lambda: 0.0)
+        t.observe(REQ, "job", "", now=0.0)
+        a = t.counts(now=1.0)
+        b = t.counts(now=2.0)
+        assert b[0] > a[0] and b[1] > a[1]  # queued time keeps accruing
+
+    def test_gauge_level_set(self):
+        t = GoodputTracker(now=lambda: 0.0)
+        t.observe(REQ, "job", "Running", now=0.0)
+        t.set_gauges(now=10.0)
+        assert goodput_ratio.value() == pytest.approx(1.0)
+
+
+class TestGoodputSlo:
+    def test_objective_burns_on_lost_time(self):
+        """The goodput objective rides the stock burn-window machinery:
+        losing wall time past budget trips the alert; recovery clears it."""
+        clk = [0.0]
+        t = GoodputTracker(now=lambda: clk[0])
+        obj = GoodputObjective(t, target=0.9)
+        eng = SloEngine(objectives=[obj], fast_window=10.0, slow_window=30.0,
+                        burn_threshold=2.0)
+        t.observe(REQ, "job", "Running", now=0.0)
+        eng.evaluate(now=0.0)
+        assert not eng.breached("goodput")
+        # All-serving: burn stays 0.
+        clk[0] = 5.0
+        eng.evaluate(now=5.0)
+        assert not eng.breached("goodput")
+        # Degrade: from t=5 every second is lost -> burn way past 2x the
+        # 10% budget on both windows.
+        t.observe(RES, "m0", "Degraded", owner="job", now=5.0)
+        clk[0] = 40.0
+        eng.evaluate(now=40.0)
+        assert eng.breached("goodput")
+        # Recover: the fast window refills with serving time and clears.
+        t.observe(RES, "m0", "Online", owner="job", now=40.0)
+        clk[0] = 75.0
+        eng.evaluate(now=75.0)
+        assert not eng.breached("goodput")
+
+
+class TestFleetGoodput:
+    def test_fleet_merge_sums_process_counters(self):
+        from tpu_composer.runtime.fleet import FleetPlane
+        from tpu_composer.runtime.metrics import fleet_goodput_ratio
+
+        store = Store()
+        t1 = GoodputTracker(now=lambda: 100.0)
+        t1.observe(REQ, "a", "Running", now=0.0)   # 100s ready at read time
+        t2 = GoodputTracker(now=lambda: 100.0)
+        t2.observe(REQ, "b", "", now=0.0)          # 100s queued at read time
+        p1 = FleetPlane(store, identity="r1", goodput=t1,
+                        process_token="p1")
+        p2 = FleetPlane(store, identity="r2", goodput=t2,
+                        process_token="p2")
+        p1.publish()
+        p2.publish()
+        view = p1.aggregate(now=0.0)
+        gp = view["merged"]["goodput"]
+        assert gp["total_s"] == pytest.approx(200.0)
+        assert gp["lost_s"] == pytest.approx(100.0)
+        assert gp["ratio"] == pytest.approx(0.5)
+        assert fleet_goodput_ratio.value() == pytest.approx(0.5)
+        # Per-replica goodput counters surface in the fleet view.
+        assert view["replicas"]["r2"]["goodput"]["lost_s"] == pytest.approx(
+            100.0
+        )
+
+
+class TestLiveIntegration:
+    def test_lifecycle_watch_feeds_tracker(self):
+        """End to end on the real reconcilers: the manager's lifecycle
+        watch observes the request reaching Running and the tracker
+        accounts serving time for it."""
+        store, pool, req_rec, res_rec = make_world(n_nodes=2)
+        tracker = GoodputTracker()
+        lifecycle.add_transition_sink(tracker.observe)
+        stop = threading.Event()
+        watcher = threading.Thread(
+            target=lifecycle.watch_runnable(store), args=(stop,),
+            name="lifecycle-watch-test", daemon=True,
+        )
+        watcher.start()
+        try:
+            make_request(store, "job", size=4)
+            run_to_ready(store, req_rec, res_rec, "job")
+            for _ in range(100):
+                view = tracker.request_view("job")
+                if view is not None and view["category"] == "ready":
+                    break
+                threading.Event().wait(0.02)
+            view = tracker.request_view("job")
+            assert view is not None
+            assert view["category"] == "ready"
+            assert store.get(ComposabilityRequest, "job").status.state == (
+                REQUEST_STATE_RUNNING
+            )
+            total, lost = tracker.counts()
+            assert total > 0
+        finally:
+            stop.set()
+            watcher.join(timeout=5)
+            lifecycle.remove_transition_sink(tracker.observe)
+
+    def test_manager_stop_unregisters_sink(self):
+        tracker = GoodputTracker()
+        lifecycle.add_transition_sink(tracker.observe)
+        mgr = Manager(store=Store(), goodput=tracker)
+        mgr.start()
+        mgr.stop()
+        assert all(
+            getattr(s, "__self__", None) is not tracker
+            for s in lifecycle._transition_sinks
+        )
+
+    def test_goodput_endpoint(self):
+        import json
+        import urllib.request
+
+        tracker = GoodputTracker(now=lambda: 10.0)
+        tracker.observe(REQ, "job", "Running", now=0.0)
+        mgr = Manager(store=Store(), health_addr="127.0.0.1:0",
+                      goodput=tracker)
+        mgr.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mgr.health_port}/debug/goodput"
+            ) as resp:
+                doc = json.load(resp)
+            assert doc["ratio"] == pytest.approx(1.0)
+            assert doc["requests"]["job"]["category"] == "ready"
+        finally:
+            mgr.stop()
+            lifecycle.remove_transition_sink(tracker.observe)

@@ -52,7 +52,10 @@ def make_world(n_nodes=4, slots=4, chips=None):
     pool = InMemoryPool(chips=chips or {"tpu-v4": 64})
     agent = FakeNodeAgent(pool=pool)
     req_rec = ComposabilityRequestReconciler(store, pool)
-    res_rec = ComposableResourceReconciler(store, pool, agent)
+    res_rec = ComposableResourceReconciler(
+        store, pool, agent,
+        decision_ledger=req_rec.scheduler.ledger,
+    )
     return store, pool, req_rec, res_rec
 
 

@@ -54,7 +54,10 @@ class Cluster:
         self.pool = InMemoryPool(chips={"tpu-v4": chips})
         agent = FakeNodeAgent(pool=self.pool)
         self.req_rec = ComposabilityRequestReconciler(self.store, self.pool)
-        self.res_rec = ComposableResourceReconciler(self.store, self.pool, agent)
+        self.res_rec = ComposableResourceReconciler(
+            self.store, self.pool, agent,
+            decision_ledger=self.req_rec.scheduler.ledger,
+        )
 
     # -- trace events --------------------------------------------------
     def arrive(self, name, size, priority=0, target=""):
@@ -132,6 +135,36 @@ class Cluster:
                 assert (
                     len({c.spec.target_node for c in live})
                     == r.status.slice.num_hosts
+                )
+        # 3. Every decision explains itself: the decision ledger has a
+        #    record for every executed placement whose chosen hosts match
+        #    what actually ran, and every request stuck in allocation
+        #    carries a hold-back/preempt record saying why.
+        led = self.req_rec.scheduler.ledger
+        assert led is not None
+        for r in self.store.list(ComposabilityRequest):
+            if (
+                r.status.state == REQUEST_STATE_RUNNING
+                and r.spec.resource.size > 0
+                and r.status.slice.num_hosts
+            ):
+                rec = led.latest_placed(r.name)
+                assert rec is not None, f"{r.name} placed without a record"
+                if rec.kind == "place":
+                    assert sorted(rec.chosen) == sorted(
+                        r.status.slice.worker_hostnames
+                    ), (
+                        f"{r.name}: record chose {rec.chosen}, execution"
+                        f" ran on {r.status.slice.worker_hostnames}"
+                    )
+                else:  # place-extra: a grow/repair delta within the slice
+                    assert set(rec.chosen) <= set(
+                        r.status.slice.worker_hostnames
+                    ), r.name
+            elif r.status.state in ("", "NodeAllocating") and r.status.error:
+                assert led.latest(r.name) is not None, (
+                    f"{r.name} queued ({r.status.error!r}) with no"
+                    " decision record"
                 )
 
 

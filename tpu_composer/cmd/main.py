@@ -78,6 +78,21 @@ SURVEY.md §5 "Config / flag system"):
                       deadline (--migrate-*)
   TPUC_HEALTH_FAILURE_THRESHOLD   consecutive failed health probes before
                       an Online member goes Degraded (--health-failure-threshold)
+  TPUC_DECISIONS      "0" disables the scheduler decision observatory
+                      (--no-decisions): no decision ledger (every
+                      placement/hold-back/preemption record), no goodput
+                      accounting, no capacity timeline, no
+                      /debug/scheduler/* or /debug/goodput endpoints
+  TPUC_DECISIONS_FILE write the decision ring here from the crash hooks
+                      (--decisions-file; the soak failure artifact beside
+                      the flight/profile/SLO/fleet black boxes)
+  TPUC_CAPACITY_SAMPLE_PERIOD
+                      seconds between capacity-timeline samples
+                      (--capacity-sample-period)
+  TPUC_SLO_GOODPUT_TARGET
+                      goodput SLO target fraction (--slo-goodput-target;
+                      0.95 = at most 5% of accounted request wall time
+                      may be non-serving; <= 0 drops the objective)
   TPUC_NODE_DEGRADE_THRESHOLD     per-node Degraded transitions that
                       escalate to node quarantine (--node-degrade-threshold)
   TPUC_REPAIR_BREAKER_FRACTION / TPUC_REPAIR_BREAKER_MIN_MEMBERS
@@ -94,6 +109,13 @@ Subcommands (dispatched before operator flag parsing):
       spans sharing an intent-nonce trace id across processes joined with
       synthetic flow arrows — a kill -9 failover mid-attach renders as
       intent-by-A → adopted-by-B across two process rails.
+
+  explain <cr> [--addr host:port] [--file decisions.json] [--json]
+      Print the scheduler's decision ring for one ComposabilityRequest —
+      where it landed and why, what held it back and which resource was
+      binding, whom it preempted and why that set was minimal. Reads a
+      running operator's /debug/scheduler/explain/<cr> (default
+      127.0.0.1:8081), or a $TPUC_DECISIONS_FILE crash dump with --file.
 """
 
 from __future__ import annotations
@@ -513,6 +535,50 @@ def build_parser() -> argparse.ArgumentParser:
         help="write the /debug/slo snapshot here from the crash hooks"
              " (env TPUC_SLO_FILE)",
     )
+    # Scheduler decision observatory (scheduler/ledger.py +
+    # runtime/goodput.py + runtime/capacity.py): every placement decision
+    # explains itself, goodput accounting rides the lifecycle tracker,
+    # and the capacity timeline samples the supply curve. One knob.
+    p.add_argument(
+        "--decisions",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_DECISIONS", "1") != "0",
+        help="run the scheduler decision observatory: a per-CR decision"
+             " ledger (inputs digest, candidate verdicts, tiebreak and"
+             " binding-constraint rationale; /debug/scheduler/explain/"
+             "<name> and `tpu-composer explain <cr>`), per-request goodput"
+             " accounting (tpuc_goodput_ratio + the goodput SLO"
+             " objective), and the capacity timeline sampler"
+             " (/debug/scheduler/capacity). --no-decisions or"
+             " TPUC_DECISIONS=0 constructs none of it — the perf-smoke"
+             " gate holds the enabled path within 5%% of this on the"
+             " 32-chip wave",
+    )
+    p.add_argument(
+        "--decisions-file",
+        default=os.environ.get("TPUC_DECISIONS_FILE", ""),
+        help="write the decision ring here from the crash hooks (the soak"
+             " failure artifact beside the flight/profile/SLO/fleet black"
+             " boxes; env TPUC_DECISIONS_FILE)",
+    )
+    p.add_argument(
+        "--capacity-sample-period",
+        type=float,
+        default=_env_seconds("TPUC_CAPACITY_SAMPLE_PERIOD", 5.0),
+        help="seconds between capacity-timeline samples (largest-"
+             "placeable-slice, free-chip distribution, fragmentation,"
+             " goodput; env TPUC_CAPACITY_SAMPLE_PERIOD)",
+    )
+    p.add_argument(
+        "--slo-goodput-target",
+        type=float,
+        default=_env_float("TPUC_SLO_GOODPUT_TARGET", 0.95),
+        help="goodput SLO target: the fraction of accounted request wall"
+             " time that must be Ready-serving (0.95 = a 5%% lost-time"
+             " budget; burn-rate alerting like every other objective;"
+             " <= 0 or --no-decisions drops the objective"
+             " (env TPUC_SLO_GOODPUT_TARGET)",
+    )
     # Fleet observatory (runtime/fleet.py): every replica publishes a
     # telemetry snapshot into the shared store and aggregates everyone's,
     # so /debug/fleet and tpuc_fleet_* read the same from any replica.
@@ -850,6 +916,8 @@ def _configure_tracing(args: argparse.Namespace) -> None:
         os.environ["TPUC_SLO_FILE"] = args.slo_file
     if getattr(args, "fleet_file", ""):
         os.environ["TPUC_FLEET_FILE"] = args.fleet_file
+    if getattr(args, "decisions_file", ""):
+        os.environ["TPUC_DECISIONS_FILE"] = args.decisions_file
     # Lockdep witness: production runs non-strict (record + serve on
     # /debug/lockdep — a detector must not crash a serving operator);
     # strict raising is the TEST suite's mode, enabled by conftest.
@@ -965,23 +1033,46 @@ def build_manager(args: argparse.Namespace) -> Manager:
             fabric, name=os.environ.get("FABRIC_ENDPOINT", "") or "fabric"
         )
         dispatcher.attach_session(session)
+    # Scheduler decision observatory: the goodput tracker exists before
+    # the SLO engine (its objective joins the engine's list at
+    # construction) and before the fleet plane (which publishes its
+    # counters). TPUC_DECISIONS=0 constructs none of this.
+    decisions_on = getattr(args, "decisions", True)
+    goodput_tracker = None
+    if decisions_on:
+        from tpu_composer.runtime import lifecycle as lifecycle_mod
+        from tpu_composer.runtime.goodput import GoodputTracker
+
+        goodput_tracker = GoodputTracker()
+        # Fed by the manager's lifecycle watch; Manager.stop unregisters.
+        lifecycle_mod.add_transition_sink(goodput_tracker.observe)
     profiler_inst = None
     slo_engine = None
     if getattr(args, "profile", True):
         from tpu_composer.runtime.profiler import SamplingProfiler
-        from tpu_composer.runtime.slo import SloEngine, default_objectives
+        from tpu_composer.runtime.slo import (
+            GoodputObjective,
+            SloEngine,
+            default_objectives,
+        )
 
         profiler_inst = SamplingProfiler(
             interval=getattr(args, "profile_interval", 0.05),
             window_s=getattr(args, "profile_window", 10.0),
         )
+        objectives = default_objectives(
+            attach_p99_s=getattr(args, "slo_attach_p99", 5.0),
+            completion_p50_s=getattr(args, "slo_completion_p50", 1.0),
+            queue_p99_s=getattr(args, "slo_queue_p99", 1.0),
+            repair_p99_s=getattr(args, "slo_repair_p99", 120.0),
+        )
+        goodput_target = getattr(args, "slo_goodput_target", 0.95)
+        if goodput_tracker is not None and goodput_target > 0:
+            objectives.append(
+                GoodputObjective(goodput_tracker, target=goodput_target)
+            )
         slo_engine = SloEngine(
-            objectives=default_objectives(
-                attach_p99_s=getattr(args, "slo_attach_p99", 5.0),
-                completion_p50_s=getattr(args, "slo_completion_p50", 1.0),
-                queue_p99_s=getattr(args, "slo_queue_p99", 1.0),
-                repair_p99_s=getattr(args, "slo_repair_p99", 120.0),
-            ),
+            objectives=objectives,
             fast_window=getattr(args, "slo_fast_window", 60.0),
             slow_window=getattr(args, "slo_slow_window", 600.0),
             burn_threshold=getattr(args, "slo_burn_threshold", 2.0),
@@ -1024,6 +1115,7 @@ def build_manager(args: argparse.Namespace) -> Manager:
             burn_threshold=getattr(args, "slo_burn_threshold", 2.0),
             slo_engine=slo_engine,
             profiler=profiler_inst,
+            goodput=goodput_tracker,
         )
     mgr = Manager(
         store=client,
@@ -1041,6 +1133,7 @@ def build_manager(args: argparse.Namespace) -> Manager:
         slo_engine=slo_engine,
         replica_id=replica_id,
         fleet=fleet_plane,
+        goodput=goodput_tracker,
     )
     if slo_engine is not None:
         # The engine's breach/recovery Events flow through the manager's
@@ -1102,8 +1195,31 @@ def build_manager(args: argparse.Namespace) -> Manager:
     # executed plans become live make-before-break moves (safe against
     # running workloads); the escape hatch restores delete/re-solve.
     scheduler = ClusterScheduler(
-        client, defrag_mode="migrate" if migrate_on else "delete"
+        client, defrag_mode="migrate" if migrate_on else "delete",
+        decisions=decisions_on, recorder=mgr.recorder,
     )
+    if scheduler.ledger is not None:
+        # /debug/scheduler/explain/<name> + the crash-hook dump handle.
+        mgr.decisions = scheduler.ledger
+        if slo_engine is not None:
+            # Queue-wait SLO breaches name their probable cause: the
+            # dominant binding resource among recent hold-backs.
+            slo_engine.annotators["queue_wait_p99"] = (
+                scheduler.ledger.dominant_hold_back_reason
+            )
+        if fleet_plane is not None:
+            fleet_plane.slo.annotators["fleet_queue_wait_p99"] = (
+                scheduler.ledger.dominant_hold_back_reason
+            )
+    if decisions_on:
+        from tpu_composer.runtime.capacity import CapacityObservatory
+
+        capacity_obs = CapacityObservatory(
+            client, scheduler.engine, goodput=goodput_tracker,
+            period=getattr(args, "capacity_sample_period", 5.0),
+        )
+        mgr.capacity = capacity_obs
+        mgr.add_runnable(capacity_obs.run)
     repair_cfg = RepairConfig(
         breaker_fraction=getattr(args, "repair_breaker_fraction", 0.5),
         breaker_min_members=getattr(args, "repair_breaker_min_members", 4),
@@ -1128,7 +1244,8 @@ def build_manager(args: argparse.Namespace) -> Manager:
                                            timing=res_timing,
                                            recorder=mgr.recorder,
                                            dispatcher=dispatcher,
-                                           ownership=ownership)
+                                           ownership=ownership,
+                                           decision_ledger=scheduler.ledger)
     mgr.add_controller(res_rec)
     if migrate_on:
         # Node maintenance drains (controllers/maintenance.py): cordon +
@@ -1267,10 +1384,122 @@ def trace_merge_main(argv: List[str]) -> int:
     return 0
 
 
+def _format_decision(rec: dict) -> List[str]:
+    """Human rendering of one DecisionRecord document."""
+    out = [
+        f"[{rec.get('at', '?')}] {rec.get('kind', '?')} ->"
+        f" {rec.get('outcome', '?')}"
+        + (f" (x{rec['repeats']})" if rec.get("repeats", 1) > 1 else "")
+        + (f"  id={rec['decision_id']}" if rec.get("decision_id") else ""),
+        f"  {rec.get('summary', '')}",
+    ]
+    binding = rec.get("binding")
+    if binding:
+        detail = ", ".join(
+            f"{k}={v}" for k, v in binding.items() if k != "resource"
+        )
+        out.append(
+            f"  binding: {binding.get('resource', '?')}"
+            + (f" ({detail})" if detail else "")
+        )
+    if rec.get("victims"):
+        out.append(
+            f"  victims: {', '.join(rec['victims'])}"
+            f" — {rec.get('victim_rationale', '')}"
+        )
+    inputs = rec.get("inputs")
+    if inputs:
+        out.append(
+            f"  saw: {inputs.get('free_chips', '?')} free chips on"
+            f" {inputs.get('schedulable_hosts', '?')} hosts,"
+            f" fragmentation {inputs.get('fragmentation', '?')},"
+            f" queue depth {inputs.get('queue_depth', '?')}"
+        )
+    rejected = [
+        c for c in rec.get("candidates", []) if c.get("verdict") != "ok"
+    ]
+    if rejected:
+        shown = ", ".join(
+            f"{c['node']}: {c['verdict']}" for c in rejected[:8]
+        )
+        more = len(rejected) - 8
+        out.append(
+            "  rejected: " + shown + (f" (+{more} more)" if more > 0 else "")
+        )
+    if rec.get("nonces"):
+        out.append(f"  executed by intents: {', '.join(rec['nonces'])}")
+    return out
+
+
+def explain_main(argv: List[str]) -> int:
+    """``tpu-composer explain <cr>``: print the scheduler's decision ring
+    for one request, from a running operator's health port or a
+    $TPUC_DECISIONS_FILE crash dump."""
+    import json
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    p = argparse.ArgumentParser(
+        prog="tpu-composer explain",
+        description="why did the scheduler place / queue / preempt this"
+                    " request the way it did",
+    )
+    p.add_argument("name", help="ComposabilityRequest name")
+    p.add_argument("--addr", default="127.0.0.1:8081",
+                   help="running operator's health endpoint"
+                        " (default 127.0.0.1:8081)")
+    p.add_argument("--file", default="",
+                   help="read a decision-ring dump (TPUC_DECISIONS_FILE /"
+                        " --decisions-file output) instead of a live"
+                        " operator")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw JSON document instead of the"
+                        " human rendering")
+    args = p.parse_args(argv)
+    if args.file:
+        try:
+            with open(args.file) as f:
+                dump = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"explain: {e}", file=sys.stderr)
+            return 1
+        records = (dump.get("requests") or {}).get(args.name)
+        if not records:
+            print(f"explain: no decisions recorded for {args.name!r} in"
+                  f" {args.file}", file=sys.stderr)
+            return 1
+        doc = {"request": args.name, "latest": records[-1],
+               "decisions": records}
+    else:
+        url = (f"http://{args.addr}/debug/scheduler/explain/"
+               f"{urllib.parse.quote(args.name)}")
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                doc = json.load(resp)
+        except urllib.error.HTTPError as e:
+            print(f"explain: {e.code} {e.reason} — {e.read().decode(errors='replace')}",
+                  file=sys.stderr)
+            return 1
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"explain: cannot reach {url}: {e}", file=sys.stderr)
+            return 1
+    if args.json:
+        print(json.dumps(doc, indent=1))
+        return 0
+    print(f"{args.name}: {len(doc['decisions'])} recorded decision(s)")
+    for rec in doc["decisions"]:
+        for line in _format_decision(rec):
+            print(line)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "trace-merge":
         return trace_merge_main(argv[1:])
+    if argv and argv[0] == "explain":
+        return explain_main(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper()),

@@ -248,6 +248,14 @@ class ComposabilityRequestReconciler(Controller):
         # The cluster-wide placement authority (scheduler/). Shared with the
         # DefragLoop when cmd/main wires one; tests may inject their own.
         self.scheduler = scheduler or ClusterScheduler(store)
+        # The decision ledger's Queued/Placed/Preempting events ride this
+        # controller's recorder (the ledger is constructed before the
+        # recorder exists when cmd/main builds the scheduler first).
+        if (
+            self.scheduler.ledger is not None
+            and self.scheduler.ledger.recorder is None
+        ):
+            self.scheduler.ledger.recorder = self.recorder
         # Placement decisions must be serialized: two concurrent allocations
         # would otherwise both pick the same least-loaded node before either
         # writes its placeholders (the reference gets this implicitly from

@@ -181,10 +181,17 @@ class ComposableResourceReconciler(Controller):
         publisher=None,  # DevicePublisher; default built on the store
         dispatcher=None,  # fabric.dispatcher.FabricDispatcher; None = direct
         ownership=None,  # runtime.shards.ShardOwnership; None = unsharded
+        decision_ledger=None,  # scheduler.DecisionLedger; None = no joins
     ) -> None:
         super().__init__(store, ownership=ownership)
         self.fabric = fabric
         self.agent = agent
+        # THE scheduler's decision ledger (cmd/main wires the same
+        # instance the request controller's ClusterScheduler records
+        # into): attach intents join the placement decision that planned
+        # them at mint time. Explicit handle, never the process-global —
+        # in-proc multi-replica harnesses run one ledger per replica.
+        self.decision_ledger = decision_ledger
         # Fabric I/O pipeline: with a dispatcher, attach/detach SUBMIT and
         # return — the worker thread never blocks on the fabric, same-node
         # submissions coalesce into group calls, and completion re-enqueues
@@ -750,6 +757,15 @@ class ComposableResourceReconciler(Controller):
         # exists so the transition write and the fabric submission that
         # follow in this same reconcile belong to the op's trace.
         tracing.adopt_trace(tracing.TraceContext(trace_id=po.nonce))
+        if verb == "add" and self.decision_ledger is not None:
+            # Join the placement decision that planned this worker: the
+            # ledger's pending flow handle becomes the Perfetto arrow
+            # scheduler.decide -> this reconcile's span, and the nonce is
+            # recorded on the decision so /debug/scheduler/explain shows
+            # which intents executed it.
+            self.decision_ledger.link_decision(
+                res.metadata.labels.get(LABEL_MANAGED_BY, ""), po.nonce
+            )
         return po
 
     def _ensure_intent(

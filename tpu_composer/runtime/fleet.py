@@ -60,6 +60,7 @@ from tpu_composer.runtime.leases import (
 from tpu_composer.runtime.metrics import (
     Histogram,
     fleet_attach_p99_seconds,
+    fleet_goodput_ratio,
     fleet_publishes_total,
     fleet_queue_wait_p99_seconds,
     fleet_replica_shards,
@@ -118,6 +119,9 @@ class ReplicaTelemetry:
     gil: Dict[str, float] = field(default_factory=dict)
     #: profiler top-N frames (self/cumulative sample counts)
     profiler_top: List[Dict[str, Any]] = field(default_factory=list)
+    #: goodput counters {"total_s", "lost_s"} (cumulative, process-scoped
+    #: like the histograms — deduped per process token in the merge)
+    goodput: Dict[str, float] = field(default_factory=dict)
     published_at: str = ""
 
     def to_payload(self) -> Dict[str, Any]:
@@ -127,6 +131,7 @@ class ReplicaTelemetry:
             "slo": self.slo,
             "gil": self.gil,
             "profilerTop": self.profiler_top,
+            "goodput": self.goodput,
             "publishedAt": self.published_at,
         }
 
@@ -142,6 +147,9 @@ class ReplicaTelemetry:
             slo=dict(p.get("slo") or {}),
             gil={k: float(v) for k, v in (p.get("gil") or {}).items()},
             profiler_top=list(p.get("profilerTop") or []),
+            goodput={
+                k: float(v) for k, v in (p.get("goodput") or {}).items()
+            },
             published_at=p.get("publishedAt", "") or "",
         )
 
@@ -200,6 +208,7 @@ class FleetPlane:
         profiler=None,
         recorder=None,
         process_token: str = "",
+        goodput=None,  # runtime.goodput.GoodputTracker (None = not published)
     ) -> None:
         self.store = store
         self.identity = identity
@@ -219,6 +228,7 @@ class FleetPlane:
         )
         self._local_slo = slo_engine  # None -> slo.active() at publish time
         self._profiler = profiler  # None -> profiler.active() at publish time
+        self._goodput = goodput
         self._seq = 0
         self._dormant = False  # store has no FleetTelemetry kind
         self._lock = threading.Lock()
@@ -307,6 +317,14 @@ class FleetPlane:
         if prof is not None:
             try:
                 snap.profiler_top = prof.top(5)
+            except Exception:  # pragma: no cover - defensive
+                pass
+        if self._goodput is not None:
+            try:
+                total, lost = self._goodput.counts()
+                snap.goodput = {
+                    "total_s": round(total, 6), "lost_s": round(lost, 6),
+                }
             except Exception:  # pragma: no cover - defensive
                 pass
         self._last_local = snap
@@ -471,6 +489,30 @@ class FleetPlane:
                 "p50_s": merged.percentile_all(0.50),
                 "p99_s": merged.percentile_all(0.99),
             }
+        # Goodput merges like the histograms: once per process (the
+        # tracker's counters are process-scoped), summed across the fleet.
+        gp_total = sum(
+            t.goodput.get("total_s", 0.0) for t in by_process.values()
+            if t.goodput
+        )
+        gp_lost = sum(
+            t.goodput.get("lost_s", 0.0) for t in by_process.values()
+            if t.goodput
+        )
+        if gp_total > 0:
+            fleet_goodput_ratio.set(
+                round((gp_total - gp_lost) / gp_total, 6)
+            )
+            merged_stats["goodput"] = {
+                "total_s": round(gp_total, 3),
+                "lost_s": round(gp_lost, 3),
+                "ratio": round((gp_total - gp_lost) / gp_total, 6),
+            }
+        else:
+            # Level-set like the other fleet gauges: no replica publishes
+            # goodput -> the series leaves /metrics rather than freezing
+            # at its last value.
+            fleet_goodput_ratio.remove()
         self.slo.evaluate(now)
 
         # Level-set the fleet gauges; dead replicas' label sets removed
@@ -506,6 +548,7 @@ class FleetPlane:
                     "slo": t.slo,
                     "gil": t.gil,
                     "profiler_top": t.profiler_top,
+                    "goodput": t.goodput,
                 }
                 for ident, t in sorted({**live, **stale}.items())
             },

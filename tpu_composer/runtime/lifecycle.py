@@ -262,6 +262,23 @@ class FlightRecorder:
 #: Process-global ledger, like tracing's ring and the metrics registry.
 recorder = FlightRecorder()
 
+#: Transition sinks: callables fed every watch-observed state transition
+#: as (kind, name, state, owner) — owner is the managing request for
+#: ComposableResources, "" otherwise. The goodput tracker subscribes;
+#: sink exceptions are swallowed so an accounting bug can't kill the
+#: lifecycle watch.
+_transition_sinks: List[Callable[[str, str, str, str], None]] = []
+
+
+def add_transition_sink(fn: Callable[[str, str, str, str], None]) -> None:
+    if fn not in _transition_sinks:
+        _transition_sinks.append(fn)
+
+
+def remove_transition_sink(fn: Callable[[str, str, str, str], None]) -> None:
+    if fn in _transition_sinks:
+        _transition_sinks.remove(fn)
+
 
 # ----------------------------------------------------------------------
 # watch-fed state tracking (a Manager runnable)
@@ -319,8 +336,17 @@ def watch_runnable(store) -> Callable[[threading.Event], None]:
 
 def _apply(kind: str, ev) -> None:
     name = ev.obj.metadata.name
+    owner = ""
+    if kind == "ComposableResource":
+        # The managing request (LABEL_MANAGED_BY, inlined to keep this
+        # module api-import-free) — what the goodput tracker charges a
+        # member's Degraded/Repairing/Migrating time against.
+        owner = ev.obj.metadata.labels.get(
+            "app.kubernetes.io/managed-by", ""
+        )
     if ev.type == "DELETED":
         recorder.record_state(kind, name, _DELETED_STATE)
+        _feed_sinks(kind, name, _DELETED_STATE, owner)
         return
     trace_id = ""
     po = getattr(ev.obj.status, "pending_op", None)
@@ -329,6 +355,15 @@ def _apply(kind: str, ev) -> None:
     detail = getattr(ev.obj.status, "error", "") or ""
     recorder.record_state(kind, name, ev.obj.status.state,
                           trace_id=trace_id, detail=detail[:160])
+    _feed_sinks(kind, name, ev.obj.status.state, owner)
+
+
+def _feed_sinks(kind: str, name: str, state: str, owner: str) -> None:
+    for sink in list(_transition_sinks):
+        try:
+            sink(kind, name, state, owner)
+        except Exception:
+            log.exception("lifecycle transition sink failed")
 
 
 # ----------------------------------------------------------------------
@@ -347,10 +382,10 @@ _crash_dumped = False
 
 def dump_crash(reason: str) -> None:
     """Best-effort black-box write: flight ledger + trace ring + the
-    observatory's continuous-profile ring, SLO snapshot and fleet view,
-    all env-gated ($TPUC_FLIGHT_FILE / $TPUC_TRACE_FILE /
-    $TPUC_PROFILE_FILE / $TPUC_SLO_FILE / $TPUC_FLEET_FILE). Never
-    raises."""
+    observatory's continuous-profile ring, SLO snapshot, fleet view and
+    the scheduler's decision ring, all env-gated ($TPUC_FLIGHT_FILE /
+    $TPUC_TRACE_FILE / $TPUC_PROFILE_FILE / $TPUC_SLO_FILE /
+    $TPUC_FLEET_FILE / $TPUC_DECISIONS_FILE). Never raises."""
     global _crash_dumped
     if reason != "atexit":
         _crash_dumped = True
@@ -388,6 +423,12 @@ def dump_crash(reason: str) -> None:
         from tpu_composer.analysis import lockdep as _lockdep
 
         _lockdep.dump_file()
+    except Exception:
+        pass
+    try:
+        from tpu_composer.scheduler import ledger as _ledger
+
+        _ledger.dump_file()
     except Exception:
         pass
 

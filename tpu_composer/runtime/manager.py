@@ -59,6 +59,16 @@ DEBUG_ENDPOINTS = {
                       " edges with first-seen stacks, declared orders and"
                       " any cycle (potential ABBA deadlock) reports"
                       " (503 unless --lockdep/TPUC_LOCKDEP=1)",
+    "/debug/scheduler/explain/<name>": "one CR's decision ring: every"
+                      " placement / hold-back / preemption with inputs"
+                      " digest, candidate verdicts, tiebreak rationale and"
+                      " binding constraint (503 under TPUC_DECISIONS=0)",
+    "/debug/scheduler/capacity": "capacity timeline: largest-placeable-"
+                      "slice, free-chip distribution, fragmentation and"
+                      " goodput samples on the observatory cadence",
+    "/debug/goodput": "per-request goodput accounting: Ready-serving vs"
+                      " queued/degraded/repairing/migrating wall seconds"
+                      " and the fleet-local ratio",
 }
 
 # A runnable is the analog of manager.Add(RunnableFunc) used by the
@@ -178,6 +188,47 @@ class _HealthHandler(_PlainTextHandler):
             else:
                 self._respond_json(
                     200, json.dumps(loop.report(), indent=1).encode()
+                )
+        elif path.startswith("/debug/scheduler/explain/"):
+            # The decision ledger's per-CR ring: why this request landed
+            # where it did / is still queued / preempted whom.
+            led = self.manager.decisions
+            if led is None:
+                self._respond(
+                    503, "decision ledger disabled (TPUC_DECISIONS=0)"
+                )
+            else:
+                name = urllib.parse.unquote(
+                    path[len("/debug/scheduler/explain/"):]
+                )
+                doc = led.explain(name)
+                if doc is None:
+                    self._respond(
+                        404, f"no scheduler decisions recorded for {name!r}"
+                    )
+                else:
+                    self._respond_json(
+                        200, json.dumps(doc, indent=1).encode()
+                    )
+        elif path == "/debug/scheduler/capacity":
+            cap = self.manager.capacity
+            if cap is None:
+                self._respond(
+                    503, "capacity observatory disabled (TPUC_DECISIONS=0)"
+                )
+            else:
+                self._respond_json(
+                    200, json.dumps(cap.snapshot(), indent=1).encode()
+                )
+        elif path == "/debug/goodput":
+            gp = self.manager.goodput
+            if gp is None:
+                self._respond(
+                    503, "goodput accounting disabled (TPUC_DECISIONS=0)"
+                )
+            else:
+                self._respond_json(
+                    200, json.dumps(gp.snapshot(), indent=1).encode()
                 )
         elif path == "/debug/profile/continuous":
             prof = self.manager.profiler
@@ -316,6 +367,9 @@ class Manager:
         replica_id: Optional[str] = None,  # fleet identity for trace pids
         fleet=None,  # runtime.fleet.FleetPlane serving /debug/fleet
         defrag=None,  # scheduler.DefragLoop serving /debug/defrag
+        decisions=None,  # scheduler.DecisionLedger serving explain routes
+        capacity=None,  # runtime.capacity.CapacityObservatory
+        goodput=None,  # runtime.goodput.GoodputTracker
     ) -> None:
         # `is not None`, not `or`: an EMPTY store is falsy (Store.__len__),
         # and silently swapping in a fresh one would orphan the caller's
@@ -358,6 +412,14 @@ class Manager:
         # Defrag loop handle for /debug/defrag (dry-run plan + skip
         # reasons); None = loop not wired (--defrag-interval 0).
         self.defrag = defrag
+        # Decision observatory handles (all None under TPUC_DECISIONS=0):
+        # the scheduler's decision ledger (/debug/scheduler/explain/*),
+        # the capacity timeline sampler (/debug/scheduler/capacity) and
+        # the goodput tracker (/debug/goodput; its lifecycle transition
+        # sink is unregistered at stop()).
+        self.decisions = decisions
+        self.capacity = capacity
+        self.goodput = goodput
         # Post-leader-acquire / pre-controller-start hooks (cold-start
         # adoption of durable fabric intents, controllers/adoption.py):
         # they run only once leadership is held — a standby must not probe
@@ -670,6 +732,11 @@ class Manager:
         for t in self._threads:
             t.join(timeout=5)
         self._threads.clear()
+        # Unregister the goodput tracker's lifecycle sink: the sink list
+        # is process-global, and a test (or bench) cycling managers must
+        # not accumulate dead trackers behind every later transition.
+        if self.goodput is not None:
+            lifecycle.remove_transition_sink(self.goodput.observe)
         # Informer shutdown AFTER the controllers: their stop() paths may
         # still read through the cache, and the store watches the informers
         # hold must unsubscribe before the process exits.

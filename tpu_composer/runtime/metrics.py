@@ -595,8 +595,20 @@ scheduler_preemptions_total = global_registry.counter(
 )
 scheduler_held_back_total = global_registry.counter(
     "tpuc_scheduler_held_back_total",
-    "Placements deferred by the backfill gate to protect a pending"
-    " higher-priority request",
+    "Placement attempts that could not be granted, by reason"
+    " (backfill-gate = deferred to protect a pending higher-priority"
+    " request | tpu-ports = not enough hosts with free TPU ports |"
+    " node-resources = hosts had ports but failed cpu/memory/pod caps |"
+    " target-node = the pinned host is missing/quarantined/full |"
+    " capacity = no placement and the decision ledger is off). The"
+    " unlabeled pre-ledger total is the sum over reasons",
+)
+scheduler_decisions_total = global_registry.counter(
+    "tpuc_scheduler_decisions_total",
+    "Scheduler decisions recorded in the decision ledger, by kind (place |"
+    " place-scalar | place-extra | defrag-skip | defrag-migrate) and"
+    " outcome (placed | held-back | preempting | skipped | evacuating)."
+    " Collapsed reconcile-retry repeats count once per retry",
 )
 scheduler_fragmentation_score = global_registry.gauge(
     "tpuc_scheduler_fragmentation_score",
@@ -725,11 +737,55 @@ fleet_queue_wait_p99_seconds = global_registry.gauge(
     "tpuc_fleet_queue_wait_p99_seconds",
     "Fleet-merged work-queue wait p99 across live replica processes",
 )
+fleet_goodput_ratio = global_registry.gauge(
+    "tpuc_fleet_goodput_ratio",
+    "Fleet-merged goodput: Ready-serving seconds over total accounted"
+    " wall seconds across live replica processes (1.0 = every request"
+    " spent its whole life serving)",
+)
 fleet_publishes_total = global_registry.counter(
     "tpuc_fleet_publishes_total",
     "Telemetry snapshots this replica published into the shared store,"
     " by outcome (ok | error; a dormant publisher — store without the"
     " FleetTelemetry kind — counts nothing after its first probe)",
+)
+
+
+#: Goodput & capacity observatory (runtime/goodput.py +
+#: runtime/capacity.py): per-request serving-time accounting on the
+#: lifecycle tracker, and the capacity timeline the scheduler's decisions
+#: are judged against (largest-placeable-slice / free-chip distribution —
+#: utilization CURVES, not points; arXiv:2404.06467).
+goodput_ratio = global_registry.gauge(
+    "tpuc_goodput_ratio",
+    "Ready-serving wall seconds over total accounted wall seconds across"
+    " every tracked request (queued + provisioning + degraded + repairing"
+    " + migrating time is the lost share; terminating time is excluded)."
+    " 1.0 = perfect goodput",
+)
+goodput_seconds_total = global_registry.counter(
+    "tpuc_goodput_seconds_total",
+    "Cumulative request wall seconds by category (ready | queued |"
+    " provisioning | degraded | repairing | migrating), settled at each"
+    " phase transition — the goodput ratio's numerator (ready) and"
+    " denominator (sum) as first-class series",
+)
+capacity_largest_slice_chips = global_registry.gauge(
+    "tpuc_capacity_largest_slice_chips",
+    "Largest TPU slice (hosts x chips-per-host) composable RIGHT NOW from"
+    " free schedulable capacity — the headroom number a pending gang"
+    " compares its demand against",
+)
+capacity_free_chips = global_registry.gauge(
+    "tpuc_capacity_free_chips",
+    "Free TPU ports across schedulable (ready, uncordoned, unquarantined)"
+    " hosts — the capacity timeline's raw supply curve",
+)
+capacity_hosts_by_free = global_registry.gauge(
+    "tpuc_capacity_hosts_by_free",
+    "Schedulable hosts by exact free-TPU-port count (label free=N),"
+    " level-set each sample — the free-chip distribution whose shape"
+    " distinguishes fragmentation from exhaustion",
 )
 
 

@@ -45,7 +45,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from types import SimpleNamespace
-from typing import Any, Deque, Dict, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from tpu_composer.runtime.metrics import (
     Histogram,
@@ -80,6 +80,35 @@ class Objective:
         total = float(self.histogram.total_count())
         good = self.histogram.total_count_le(self.threshold_s)
         return total, max(0.0, total - good)
+
+
+class GoodputObjective(Objective):
+    """A goodput objective over the :class:`~tpu_composer.runtime.goodput.
+    GoodputTracker`'s cumulative second counters instead of a histogram:
+    total wall seconds are the event stream, lost (non-serving) seconds
+    are the bad events, and ``target`` is the serving fraction promised
+    (0.95 -> a 5% lost-time budget). Both counters are monotonic including
+    in-progress accrual, so the burn-window diffing works unchanged —
+    burn 1.0 means the fleet is losing wall time exactly at budget."""
+
+    def __init__(
+        self, tracker: Any, target: float = 0.95, name: str = "goodput"
+    ) -> None:
+        super().__init__(
+            name=name,
+            histogram=None,  # type: ignore[arg-type]
+            threshold_s=0.0,  # not a latency objective
+            target=target,
+            description=(
+                "goodput: Ready-serving share of accounted request wall"
+                " time (queued/provisioning/degraded/repairing/migrating"
+                " time is the lost share)"
+            ),
+        )
+        self.tracker = tracker
+
+    def counts(self) -> Tuple[float, float]:
+        return self.tracker.counts()
 
 
 class _SloRef:
@@ -128,6 +157,12 @@ class SloEngine:
         self._state: Dict[str, _State] = {
             o.name: _State() for o in self.objectives
         }
+        # Breach-Event annotators: objective name -> zero-arg callable
+        # returning extra context for the alert message ("" = nothing).
+        # cmd/main wires the queue-wait objective to the decision ledger's
+        # dominant hold-back reason, so the alert names its probable cause
+        # instead of just its symptom.
+        self.annotators: Dict[str, Callable[[], str]] = {}
 
     # ------------------------------------------------------------------
     def run(self, stop_event: threading.Event) -> None:
@@ -239,11 +274,27 @@ class SloEngine:
         self, obj: Objective, breached: bool, fast: float, slow: float
     ) -> None:
         if breached:
+            # Latency objectives render the percentile promise; ratio
+            # objectives (threshold_s <= 0, e.g. goodput) render the
+            # fraction promise — "(p95 <= 0s)" would read as nonsense.
+            promise = (
+                f"(p{obj.target * 100:g} <= {obj.threshold_s:g}s)"
+                if obj.threshold_s > 0
+                else f"(>= {obj.target * 100:g}% good)"
+            )
             msg = (
                 f"{obj.name}: error budget burning at {fast:.1f}x (fast)"
                 f" / {slow:.1f}x (slow) — {obj.description or 'objective'}"
-                f" (p{obj.target * 100:g} <= {obj.threshold_s:g}s) violated"
+                f" {promise} violated"
             )
+            annotate = self.annotators.get(obj.name)
+            if annotate is not None:
+                try:
+                    extra = annotate()
+                except Exception:  # pragma: no cover - defensive
+                    extra = ""
+                if extra:
+                    msg += f"; probable cause: {extra}"
             log.warning("SLO BREACH %s", msg)
         else:
             msg = (

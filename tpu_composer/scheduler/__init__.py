@@ -12,6 +12,7 @@ from tpu_composer.scheduler.defrag import (
     DefragPlanner,
     Migration,
 )
+from tpu_composer.scheduler.ledger import DecisionLedger, DecisionRecord
 from tpu_composer.scheduler.placement import (
     AllocationError,
     PlacementEngine,
@@ -23,6 +24,8 @@ from tpu_composer.scheduler.queue import PendingEntry, SchedulerQueue
 __all__ = [
     "AllocationError",
     "ClusterScheduler",
+    "DecisionLedger",
+    "DecisionRecord",
     "DefragLoop",
     "DefragPlan",
     "DefragPlanner",

@@ -98,9 +98,15 @@ class DefragPlan:
 
 class DefragPlanner:
     def __init__(self, store, engine, queue=None, lock=None,
-                 mode: str = "delete") -> None:
+                 mode: str = "delete", decision_ledger=None) -> None:
         self.store = store
         self.engine = engine
+        # The scheduler's DecisionLedger, when wired: planner skips and
+        # executed migrations land in the owners' decision rings so
+        # "why is defrag not consolidating my worker" / "why did my
+        # worker move" answer themselves via /debug/scheduler/explain.
+        # None (TPUC_DECISIONS=0, or direct construction) records nothing.
+        self.decision_ledger = decision_ledger
         # The scheduler's pending queue, when wired (ClusterScheduler
         # does): execute() refuses migrations whose owner's re-placement
         # the backfill gate would hold back — without this, a "capacity
@@ -143,7 +149,8 @@ class DefragPlanner:
             and n.metadata.name not in quarantined
         }
         skips: Dict[str, int] = {}
-        movable, anchored = self._occupants(nodes, skips)
+        skip_owners: Dict[str, Dict[str, str]] = {}
+        movable, anchored = self._occupants(nodes, skips, skip_owners)
 
         # Vacate candidates: hosts with movable occupants and nothing
         # anchoring them, emptiest first (fewest chips to relocate per
@@ -199,9 +206,34 @@ class DefragPlanner:
 
         frag_after = self.engine.fragmentation(quarantined, sim_used)
         self.last_skips = skips  # one atomic publish per completed plan
+        self._record_skips(skip_owners)
         if frag_after >= frag_before:
             return DefragPlan([], frag_before, frag_before, skips=skips)
         return DefragPlan(migrations, frag_before, frag_after, skips=skips)
+
+    def _record_skips(
+        self, skip_owners: Dict[str, Dict[str, str]]
+    ) -> None:
+        """One defrag-skip decision per excluded OWNER per pass — the
+        ledger collapses identical repeats across periodic passes, so a
+        steady-state skip costs one record with a repeats counter."""
+        if self.decision_ledger is None:
+            return
+        from tpu_composer.scheduler import ledger as ledger_mod
+
+        for owner, members in sorted(skip_owners.items()):
+            reasons = sorted(set(members.values()))
+            self.decision_ledger.record(ledger_mod.DecisionRecord(
+                request=owner,
+                kind=ledger_mod.KIND_DEFRAG_SKIP,
+                outcome=ledger_mod.OUTCOME_SKIPPED,
+                binding={"resource": "defrag-migratability",
+                         "members": members},
+                summary=(
+                    "defrag left member(s) in place:"
+                    f" {', '.join(reasons)}"
+                ),
+            ))
 
     def _best_target(
         self,
@@ -226,7 +258,12 @@ class DefragPlanner:
                 best = (key, name)
         return best[1] if best else None
 
-    def _occupants(self, nodes: Dict[str, Node], skips: Dict[str, int]):
+    def _occupants(
+        self,
+        nodes: Dict[str, Node],
+        skips: Dict[str, int],
+        skip_owners: Optional[Dict[str, Dict[str, str]]] = None,
+    ):
         """Split live TPU chip groups into movable (single-host Running
         slice, disruption allowed — and in migrate mode MIGRATABLE:
         ``repairPolicy != None``, since live migration rides the
@@ -257,6 +294,8 @@ class DefragPlanner:
             else:
                 skips[reason] = skips.get(reason, 0) + 1
                 anchored.add(node)
+                if skip_owners is not None and owner is not None:
+                    skip_owners.setdefault(owner.name, {})[c.name] = reason
         return movable, anchored
 
     def _immovable_reason(self, c, owner, node: Node) -> Optional[str]:
@@ -382,6 +421,21 @@ class DefragPlanner:
                 )
                 return False
         scheduler_defrag_migrations_total.inc()
+        if self.decision_ledger is not None:
+            from tpu_composer.scheduler import ledger as ledger_mod
+
+            self.decision_ledger.record(ledger_mod.DecisionRecord(
+                request=m.request,
+                kind=ledger_mod.KIND_DEFRAG_MIGRATE,
+                outcome=ledger_mod.OUTCOME_EVACUATING,
+                chosen=[m.to_node],
+                tiebreak="tightest-fit consolidation target",
+                summary=(
+                    f"defrag {'evacuating' if self.mode == 'migrate' else 'migrating'}"
+                    f" worker {m.resource}: {m.from_node} -> {m.to_node}"
+                    f" ({m.chips} chips) to reassemble contiguous capacity"
+                ),
+            ))
         if recorder is not None:
             req = self.store.try_get(ComposabilityRequest, m.request)
             if req is not None:

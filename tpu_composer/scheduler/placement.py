@@ -384,6 +384,98 @@ class PlacementEngine:
         return fresh[:count]
 
     # ------------------------------------------------------------------
+    # decision-ledger explain helpers (never on the hot path: built only
+    # when the scheduler's DecisionLedger is enabled)
+    # ------------------------------------------------------------------
+    def node_verdict(
+        self,
+        req: ComposabilityRequest,
+        node: Node,
+        chips: int,
+        used: Dict[str, int],
+        quarantined: Set[str],
+        exclude: Set[str] = frozenset(),
+    ) -> Optional[str]:
+        """Why this node cannot host ``chips`` for ``req`` (None = it
+        can). The explain twin of :meth:`node_fits`, split so each
+        rejection names its constraint instead of collapsing to bool."""
+        name = node.metadata.name
+        if name in exclude:
+            return "excluded"
+        if name in quarantined:
+            return "quarantined"
+        if not node.status.ready:
+            return "not-ready"
+        if node.spec.unschedulable:
+            return "cordoned"
+        free = node.status.tpu_slots - used.get(name, 0)
+        if free < chips:
+            return f"no-tpu-ports free={max(0, free)} need={chips}"
+        other = req.spec.resource.other_spec
+        if other is not None and (
+            node.status.milli_cpu < other.milli_cpu
+            or node.status.memory < other.memory
+            or node.status.ephemeral_storage < other.ephemeral_storage
+            or node.status.allowed_pod_number < other.allowed_pod_number
+        ):
+            return "node-resources"
+        return None
+
+    def candidate_verdicts(
+        self,
+        req: ComposabilityRequest,
+        chips: int,
+        quarantined: Set[str],
+        used: Dict[str, int],
+        exclude: Set[str] = frozenset(),
+    ) -> List[Dict[str, object]]:
+        """Every node's verdict for one worker's chip group — the
+        candidates-considered section of a DecisionRecord. Sorted fitting
+        nodes first (tightest-fit order, mirroring the picker), then
+        rejected ones by name."""
+        out: List[Dict[str, object]] = []
+        for n in self.store.list(Node):
+            verdict = self.node_verdict(req, n, chips, used, quarantined,
+                                        exclude=exclude)
+            out.append({
+                "node": n.metadata.name,
+                "free": max(0, n.status.tpu_slots
+                            - used.get(n.metadata.name, 0)),
+                "verdict": verdict or "ok",
+            })
+        out.sort(key=lambda c: (
+            c["verdict"] != "ok", c["free"] if c["verdict"] == "ok" else 0,
+            c["node"],
+        ))
+        return out
+
+    def tiebreak_rationale(
+        self, chosen: Sequence[str], used: Dict[str, int]
+    ) -> str:
+        """Reconstruct why THESE hosts won from the same inputs the picker
+        scored: the tightest-fit leftover sum, and the ICI window span when
+        every chosen host carries a parseable fabric index. Read-only over
+        the decision's own ``used`` map — the hot picker stays untouched."""
+        if not chosen:
+            return ""
+        frees = []
+        for name in chosen:
+            node = self.store.try_get(Node, name)
+            if node is None:
+                return "tightest-fit"
+            frees.append(node.status.tpu_slots - used.get(name, 0))
+        parts = [f"tightest-fit leftover={sum(frees)}"]
+        if len(chosen) > 1:
+            idx = [host_index(n) for n in chosen]
+            if all(i is not None for i in idx):
+                span = max(idx) - min(idx) - (len(chosen) - 1)  # type: ignore[arg-type]
+                parts.append(
+                    "ICI-contiguous window" if span == 0
+                    else f"ICI window span={span}"
+                )
+        return ", ".join(parts)
+
+    # ------------------------------------------------------------------
     # feasibility probes (gate + preemption simulation)
     # ------------------------------------------------------------------
     def schedulable_nodes(self, quarantined: Set[str]) -> List[Node]:

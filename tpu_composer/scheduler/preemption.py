@@ -60,6 +60,11 @@ class Preemptor:
     def __init__(self, store, engine) -> None:
         self.store = store
         self.engine = engine
+        # Minimality rationale for the LAST compute_victims call — the
+        # decision ledger reads it right after the call returns. Safe as
+        # instance state because every caller runs under the scheduler's
+        # allocation lock (core.place is the only production call site).
+        self.last_search: dict = {}
 
     # ------------------------------------------------------------------
     def compute_victims(
@@ -71,10 +76,13 @@ class Preemptor:
     ) -> List[str]:
         """Minimal victim set making `req`'s shape placeable, or [] when
         preemption is disallowed or cannot help."""
+        self.last_search = {}
         if req.spec.preemption_policy != PREEMPT_LOWER_PRIORITY:
+            self.last_search = {"mode": "disallowed"}
             return []
         candidates = self._candidates(req, quarantined)
         if not candidates:
+            self.last_search = {"mode": "no-candidates", "candidates": 0}
             return []
 
         # ONE node snapshot for every feasibility probe: the exhaustive
@@ -115,6 +123,9 @@ class Preemptor:
         )
 
         if not feasible(tuple(candidates)):
+            self.last_search = {
+                "mode": "infeasible", "candidates": len(candidates),
+            }
             return []  # even evicting everyone eligible wouldn't fit
 
         if len(candidates) <= _EXHAUSTIVE_MAX_CANDIDATES:
@@ -131,9 +142,22 @@ class Preemptor:
                     if best is None or key < best[0]:
                         best = (key, combo)
                 if best is not None:
+                    self.last_search = {
+                        "mode": "exhaustive",
+                        "candidates": len(candidates),
+                        "set_size": size,
+                        "victim_priority_sum": best[0][0],
+                        "victim_chips": best[0][1],
+                    }
                     return [c.name for c in best[1]]
 
-        return self._greedy_prune(candidates, feasible)
+        victims = self._greedy_prune(candidates, feasible)
+        self.last_search = {
+            "mode": "greedy+prune",
+            "candidates": len(candidates),
+            "set_size": len(victims),
+        }
+        return victims
 
     # ------------------------------------------------------------------
     def _greedy_prune(self, candidates, feasible) -> List[str]:
