@@ -4,7 +4,7 @@
 PYTHON ?= python
 IMG ?= tpu-composer:latest
 
-.PHONY: all test test-fast bench bench-round manifests native lint lint-syntax analyze typecheck run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak migrate-soak conformance
+.PHONY: all test test-fast bench bench-round manifests native lint lint-syntax analyze typecheck run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak migrate-soak brownout-soak conformance
 
 all: native test
 
@@ -108,6 +108,20 @@ repair-soak:
 ## as the other soaks (TPUC_FLIGHT_FILE / TPUC_TRACE_FILE on CI failure).
 migrate-soak:
 	$(PYTHON) -m pytest tests/test_crash_restart.py -q -m migrate -p no:randomly
+
+## brownout-soak: dark-store brownout soak (tests/test_brownout_soak.py,
+## markers slow+brownout): churning mixed-priority request load while the
+## ChaosStore blacks out for randomized >=5s windows AND the fabric browns
+## out simultaneously. The survival layer must ride it out: store breaker
+## fails writes fast (reads stay informer-warm), overload governor sheds
+## low-priority reconciles while high-priority keeps the tight path, the
+## syncer's orphan grace clocks freeze, and the watchdog never
+## false-positives. Converges with nonce-checked zero double-attach,
+## bounded queue depth, high-priority goodput >= 2x low-priority during
+## shed, and every shed explainable in the decision ledger
+## (reason=overload). Same black-box contract as the other soaks.
+brownout-soak:
+	$(PYTHON) -m pytest tests/test_brownout_soak.py -q -m brownout -p no:randomly
 
 ## shard-soak: shard-failover chaos soak (tests/test_shard_failover.py,
 ## markers slow+shard): three full operator replicas over one shared store

@@ -153,6 +153,14 @@ def pytest_configure(config):
         " marked slow; run with `make migrate-soak` or"
         " `pytest -m migrate`)",
     )
+    config.addinivalue_line(
+        "markers",
+        "brownout: dark-store brownout soak (randomized timed store"
+        " blackouts + fabric brownout under churning load; the overload"
+        " governor / store breaker / watchdog survival layer must ride"
+        " it out; always also marked slow; run with `make brownout-soak`"
+        " or `pytest -m brownout`)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

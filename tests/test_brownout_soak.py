@@ -1,0 +1,275 @@
+"""Dark-store brownout soak: the survival layer rides out its own outage.
+
+ISSUE-16 acceptance: churning request load while the ChaosStore blacks out
+for randomized >=5s windows AND the fabric browns out simultaneously. The
+store circuit breaker (under the CachedClient, so reads stay informer-warm)
+fails writes fast, the overload governor folds the open breaker into Shed
+and defers low-priority reconciles while high-priority keeps the tight
+path, and the watchdog watches it all without a single false positive.
+
+Converges after heal with:
+- nonce-checked zero double-attach (RecordingPool journal),
+- queue depth bounded by a constant throughout,
+- zero watchdog stalls (everything kept beating through the brownout),
+- high-priority goodput >= 2x low-priority while shedding,
+- every shed explainable: the decision ledger holds a reason=overload
+  hold-back for a shed low-priority request.
+
+Marked slow+brownout: excluded from tier-1; run with `make brownout-soak`
+or `pytest -m brownout`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from test_crash_restart import RecordingPool, assert_no_double_attach
+
+from tpu_composer.agent.fake import FakeNodeAgent
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    ComposableResource,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.controllers.request_controller import (
+    ComposabilityRequestReconciler,
+    RequestTiming,
+)
+from tpu_composer.controllers.resource_controller import (
+    ComposableResourceReconciler,
+    ResourceTiming,
+)
+from tpu_composer.controllers.syncer import UpstreamSyncer
+from tpu_composer.fabric.breaker import BreakerConfig, BreakerFabricProvider
+from tpu_composer.fabric.chaos import ChaosFabricProvider
+from tpu_composer.runtime.cache import CachedClient
+from tpu_composer.runtime.chaosstore import ChaosStore
+from tpu_composer.runtime.manager import Manager
+from tpu_composer.runtime.overload import (
+    SHED,
+    OverloadGovernor,
+    request_shed_gate,
+)
+from tpu_composer.runtime.store import Store
+from tpu_composer.runtime.storebreaker import BreakingStore
+from tpu_composer.runtime.watchdog import Watchdog
+from tpu_composer.scheduler.ledger import OUTCOME_HELD_BACK, DecisionLedger
+
+BLACKOUTS = 2            # randomized dark-store windows
+BLACKOUT_MIN_S = 5.0     # ISSUE-16: randomized >=5s windows
+BLACKOUT_MAX_S = 6.0
+FABRIC_FAILURE_RATE = 0.15
+HIGH, LOW = 100, 0       # straddle the governor's priority cutoff (50)
+QUEUE_DEPTH_BOUND = 200  # "bounded by a constant"
+
+
+@pytest.mark.slow
+@pytest.mark.brownout
+def test_dark_store_brownout_rides_through():
+    raw = Store()
+    for i in range(8):
+        n = Node(metadata=ObjectMeta(name=f"worker-{i}"))
+        n.status.tpu_slots = 8
+        raw.create(n)
+    pool = RecordingPool(chips={"tpu-v4": 64})
+
+    # Fabric brownout runs for the WHOLE soak, concurrent with the store
+    # blackouts — production-shaped wrapping as in the chaos soak.
+    chaos_fab = ChaosFabricProvider(
+        pool, failure_rate=FABRIC_FAILURE_RATE, seed=1616)
+    fabric = BreakerFabricProvider(
+        chaos_fab, endpoint="brownout-pool",
+        config=BreakerConfig(failure_threshold=8, reset_timeout=0.5),
+    )
+
+    # Store stack, exactly as cmd/main wires it: chaos injector under the
+    # circuit breaker under the informer cache. The harness itself reads
+    # and writes `raw` directly — the driver's view never browns out.
+    chaos_store = ChaosStore(raw, seed=1616)
+    breaker = BreakingStore(
+        chaos_store, failure_threshold=3, reset_timeout=0.4,
+        resync_rate=200.0, resync_window=1.0,
+    )
+    client = CachedClient(breaker)
+
+    agent = FakeNodeAgent(pool=pool)
+    ledger = DecisionLedger()
+    watchdog = Watchdog(stall_after=8.0)
+    # exit_ticks * period = 2.0s of Shed residence after the breaker
+    # closes: the window where high-priority drains while low-priority is
+    # still deferred (shed_quantum=4.0 means every deferral outlives it).
+    governor = OverloadGovernor(
+        period=0.05, enter_ticks=2, exit_ticks=40,
+        shed_quantum=4.0, priority_cutoff=50,
+        ledger=ledger, store_breaker=breaker,
+    )
+    governor.watchdog = watchdog
+
+    req_rec = ComposabilityRequestReconciler(
+        client, fabric,
+        timing=RequestTiming(updating_poll=0.05, cleaning_poll=0.02,
+                             running_poll=5.0))
+    req_rec.shed_gate = request_shed_gate(governor, client)
+    res_rec = ComposableResourceReconciler(
+        client, fabric, agent,
+        timing=ResourceTiming(attach_poll=0.05, visibility_poll=0.02,
+                              detach_poll=0.05, detach_fast=0.02,
+                              busy_poll=0.05, attach_budget=12))
+
+    mgr = Manager(client, health_addr="127.0.0.1:0", watchdog=watchdog,
+                  overload=governor, storebreaker=breaker)
+    mgr.add_controller(req_rec)
+    mgr.add_controller(res_rec)
+    for c in (req_rec, res_rec):
+        c.watchdog = watchdog
+        governor.add_queue(lambda c=c: len(c.queue))
+    # grace=8 outlives the worst post-heal per-key backoff; suspend
+    # freezes the orphan clocks while the store is dark (a stale diff
+    # must not reclaim healthy mid-attach devices).
+    mgr.add_runnable(UpstreamSyncer(client, fabric, period=0.1, grace=8.0,
+                                    suspend=breaker.is_open))
+    mgr.add_runnable(watchdog.run)
+    mgr.add_runnable(governor.run)
+    mgr.start(workers_per_controller=2)
+
+    fails: list = []
+    stop = threading.Event()
+    stats_lock = threading.Lock()
+    #: cycles whose request reached Running WHILE the governor was in Shed
+    shed_done = {HIGH: 0, LOW: 0}
+    low_names: list = []
+    max_depth = [0]
+    saw_shed = [False]
+
+    def monitor() -> None:
+        while not stop.wait(0.02):
+            depth = len(req_rec.queue) + len(res_rec.queue)
+            if depth > max_depth[0]:
+                max_depth[0] = depth
+            if governor.state == SHED:
+                saw_shed[0] = True
+
+    def lane(lane_id: int, priority: int) -> None:
+        i = 0
+        while not stop.is_set():
+            name = f"brownout-p{priority}-{lane_id}-{i}"
+            i += 1
+            if priority == LOW:
+                with stats_lock:
+                    low_names.append(name)
+            raw.create(ComposabilityRequest(
+                metadata=ObjectMeta(name=name),
+                spec=ComposabilityRequestSpec(
+                    resource=ResourceDetails(
+                        type="tpu", model="tpu-v4", size=4),
+                    priority=priority),
+            ))
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                r = raw.try_get(ComposabilityRequest, name)
+                if r is not None and r.status.state == "Running":
+                    if governor.state == SHED:
+                        with stats_lock:
+                            shed_done[priority] += 1
+                    break
+                time.sleep(0.01)
+            else:
+                fails.append(f"{name}: never Running (stuck through brownout)")
+                return
+            raw.delete(ComposabilityRequest, name)
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                if raw.try_get(ComposabilityRequest, name) is None:
+                    break
+                time.sleep(0.01)
+            else:
+                fails.append(f"{name}: teardown never completed")
+                return
+
+    threads = [threading.Thread(target=monitor)]
+    for lane_id in range(2):
+        threads.append(threading.Thread(target=lane, args=(lane_id, HIGH)))
+        threads.append(threading.Thread(target=lane, args=(lane_id, LOW)))
+
+    try:
+        for t in threads[1:]:
+            t.start()
+        time.sleep(1.0)  # warm the informers + a few clean cycles
+        threads[0].start()
+
+        schedule = chaos_store.script_random_blackouts(
+            BLACKOUTS, min_s=BLACKOUT_MIN_S, max_s=BLACKOUT_MAX_S,
+            min_gap_s=1.0, max_gap_s=2.0,
+        )
+        # Ride until the last window ends, plus the Shed residue where
+        # the priority split is measured, plus drain headroom.
+        last_end = max(e for _, e in schedule)
+        while time.monotonic() < last_end + 4.0 and not fails:
+            time.sleep(0.1)
+        chaos_store.heal()  # parity with ChaosFabricProvider.heal()
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        # Settle: syncer reclaim + any in-flight detaches.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if (pool.free_chips("tpu-v4") == 64
+                    and not raw.list(ComposableResource)):
+                break
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        mgr.stop()
+
+    assert not fails, fails[:10]
+
+    # The brownout actually happened and the survival layer engaged.
+    assert breaker.trips >= BLACKOUTS, (
+        f"store breaker tripped {breaker.trips}x for {BLACKOUTS} blackouts")
+    assert saw_shed[0], "governor never entered Shed — the soak proved nothing"
+    assert chaos_fab.injected > 0, "fabric brownout never fired"
+
+    # Nonce-checked zero double-attach + full convergence.
+    assert_no_double_attach(pool.events)
+    assert pool.free_chips("tpu-v4") == 64
+    assert pool.get_resources() == []
+    leftovers = [k for k in raw.keys()
+                 if k[0] in ("ComposabilityRequest", "ComposableResource")]
+    assert leftovers == [], leftovers[:10]
+
+    # Queue depth stayed bounded by a constant through the whole outage.
+    assert max_depth[0] < QUEUE_DEPTH_BOUND, (
+        f"queue depth peaked at {max_depth[0]}")
+
+    # Zero watchdog false positives: everything kept beating.
+    subs = watchdog.snapshot()["subsystems"]
+    stalled = {n: s["stalls"] for n, s in subs.items() if s["stalls"]}
+    assert not stalled, f"watchdog false positives: {stalled}"
+
+    # Priority split while shedding: high-priority goodput >= 2x low.
+    assert shed_done[HIGH] >= 2, (
+        f"no high-priority goodput during shed: {shed_done}")
+    assert shed_done[HIGH] >= 2 * max(1, shed_done[LOW]), (
+        f"shed did not protect high priority: {shed_done}")
+
+    # Every shed is explainable: a reason=overload hold-back in the ledger.
+    assert governor.sheds > 0
+    explained = False
+    for name in low_names:
+        doc = ledger.explain(name)
+        if doc is None:
+            continue
+        for d in doc["decisions"]:
+            if (d["kind"] == "shed" and d["outcome"] == OUTCOME_HELD_BACK
+                    and d.get("binding", {}).get("resource") == "overload"):
+                explained = True
+                break
+        if explained:
+            break
+    assert explained, "no shed hold-back with reason=overload in the ledger"

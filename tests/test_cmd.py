@@ -413,6 +413,102 @@ class TestBuildManager:
         finally:
             mgr.stop()
 
+    def test_survival_layer_default_and_escape_hatches(
+        self, monkeypatch, tmp_path
+    ):
+        """ISSUE 16 acceptance: default wiring constructs the whole
+        survival layer — the overload governor (manager runnable, shed
+        gate on the request controller, cadence stretches), the store
+        breaker (BreakingStore UNDER the CachedClient), and the subsystem
+        watchdog (on every controller, restartable governor registration).
+        TPUC_OVERLOAD=0 / TPUC_WATCHDOG=0 / TPUC_STORE_BREAKER=0 each
+        construct NONE of their machinery."""
+        monkeypatch.setenv("CDI_PROVIDER_TYPE", "MOCK")
+        monkeypatch.delenv("NODE_AGENT", raising=False)
+        from tpu_composer.controllers import ComposabilityRequestReconciler
+        from tpu_composer.fabric.adapter import reset_shared_mock
+        from tpu_composer.runtime.cache import CachedClient
+        from tpu_composer.runtime.overload import OverloadGovernor
+        from tpu_composer.runtime.storebreaker import BreakingStore
+        from tpu_composer.runtime.watchdog import Watchdog
+
+        reset_shared_mock()
+        args = build_parser().parse_args([
+            "--state-dir", str(tmp_path / "s1"),
+            "--overload-period", "0.7",
+            "--overload-shed-quantum", "3.0",
+            "--store-breaker-threshold", "7",
+            "--watchdog-stall-after", "12.0",
+        ])
+        assert args.overload is True
+        assert args.watchdog is True
+        assert args.store_breaker is True
+        mgr = build_manager(args)
+        try:
+            gov = mgr.overload
+            assert isinstance(gov, OverloadGovernor)
+            assert gov.period == 0.7
+            assert gov.shed_quantum == 3.0
+            assert any(getattr(r, "__self__", None) is gov
+                       for r in mgr._runnables)
+            req = next(c for c in mgr._controllers
+                       if isinstance(c, ComposabilityRequestReconciler))
+            assert req.shed_gate is not None
+            # Only the request controller sheds; everything else keeps
+            # the tight path.
+            assert all(
+                c.shed_gate is None for c in mgr._controllers if c is not req
+            )
+            # Every controller's queue depth feeds the governor.
+            assert len(gov._queues) == len(mgr._controllers)
+            # Store breaker sits UNDER the cached client: reads stay
+            # informer-warm through an outage.
+            assert isinstance(mgr.storebreaker, BreakingStore)
+            assert mgr.storebreaker.failure_threshold == 7
+            assert isinstance(req.store, CachedClient)
+            assert req.store.store is mgr.storebreaker
+            assert gov.store_breaker is mgr.storebreaker
+            # Watchdog: on every controller, runs as a runnable, the
+            # governor is pre-registered restartable.
+            wd = mgr.watchdog
+            assert isinstance(wd, Watchdog)
+            assert wd.stall_after == 12.0
+            assert all(c.watchdog is wd for c in mgr._controllers)
+            assert any(getattr(r, "__self__", None) is wd
+                       for r in mgr._runnables)
+            subs = wd.snapshot()["subsystems"]
+            assert subs["OverloadGovernor"]["restartable"] is True
+            assert wd.restarter is not None
+        finally:
+            mgr.stop()
+
+        monkeypatch.setenv("TPUC_OVERLOAD", "0")
+        monkeypatch.setenv("TPUC_WATCHDOG", "0")
+        monkeypatch.setenv("TPUC_STORE_BREAKER", "0")
+        reset_shared_mock()
+        args = build_parser().parse_args(["--state-dir", str(tmp_path / "s2")])
+        assert args.overload is False
+        assert args.watchdog is False
+        assert args.store_breaker is False
+        mgr = build_manager(args)
+        try:
+            assert mgr.overload is None
+            assert mgr.watchdog is None
+            assert mgr.storebreaker is None
+            req = next(c for c in mgr._controllers
+                       if isinstance(c, ComposabilityRequestReconciler))
+            assert req.shed_gate is None
+            assert all(c.watchdog is None for c in mgr._controllers)
+            assert isinstance(req.store, CachedClient)
+            assert not isinstance(req.store.store, BreakingStore)
+            assert not any(
+                isinstance(getattr(r, "__self__", None),
+                           (OverloadGovernor, Watchdog))
+                for r in mgr._runnables
+            )
+        finally:
+            mgr.stop()
+
     def test_default_shards_is_unsharded_single_leader_path(
         self, monkeypatch, tmp_path
     ):

@@ -1,0 +1,532 @@
+"""Units for the control-plane survival layer (ISSUE 16).
+
+Covers the three subsystems in isolation plus the queue's herd re-spread:
+
+- BreakingStore: trip on consecutive StoreErrors, fail-fast while open,
+  half-open probe, 409/404-are-healthy classification, post-heal resync
+  pacing;
+- OverloadGovernor: hysteresis (enter/exit ticks), cadence stretching and
+  restoration, shed policy (priority cutoff / deletion exemption), ledger
+  hold-backs with reason=overload;
+- Watchdog: slow-but-progressing loops never trip (false-positive
+  discipline), a wedged restartable subsystem is detected and restarted
+  exactly once per stall edge, budget exhaustion stops restarts;
+- RateLimitingQueue: a stale backoff herd (the post-outage signature) is
+  released over the spread quantum, not in one instant; fresh backoff
+  entries promote unthrottled.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from tpu_composer.api import (
+    ComposabilityRequest,
+    ComposabilityRequestSpec,
+    Node,
+    ObjectMeta,
+    ResourceDetails,
+)
+from tpu_composer.runtime.overload import (
+    OK,
+    SHED,
+    WARN,
+    OverloadGovernor,
+    request_shed_gate,
+)
+from tpu_composer.runtime.queue import RateLimitingQueue
+from tpu_composer.runtime.store import NotFoundError, Store, StoreError
+from tpu_composer.runtime.storebreaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakingStore,
+)
+from tpu_composer.runtime.watchdog import Watchdog
+from tpu_composer.scheduler.ledger import OUTCOME_HELD_BACK, DecisionLedger
+
+
+class _FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class _FlakyStore:
+    """Store stub whose get() fails with StoreError while dark."""
+
+    def __init__(self) -> None:
+        self.dark = False
+        self.calls = 0
+
+    def get(self, cls, name):
+        self.calls += 1
+        if self.dark:
+            raise StoreError("dark")
+        if name == "missing":
+            raise NotFoundError(name)
+        return name
+
+    def list(self, cls, label_selector=None):
+        self.calls += 1
+        if self.dark:
+            raise StoreError("dark")
+        return []
+
+    @property
+    def scheme(self):
+        class _S:
+            @staticmethod
+            def kinds():
+                return ["Thing"]
+
+            @staticmethod
+            def lookup(kind):
+                return object
+
+        return _S()
+
+
+# ----------------------------------------------------------------------
+# BreakingStore
+# ----------------------------------------------------------------------
+class TestBreakingStore:
+    def _breaker(self, inner=None, **kw):
+        clk = _FakeClock()
+        sleeps: list = []
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("reset_timeout", 5.0)
+        b = BreakingStore(
+            inner or _FlakyStore(), clock=clk, sleep=sleeps.append,
+            rng=random.Random(42), **kw,
+        )
+        return b, clk, sleeps
+
+    def test_trips_after_consecutive_failures_and_fails_fast(self):
+        b, clk, _ = self._breaker()
+        inner = b._inner
+        inner.dark = True
+        for _ in range(3):
+            with pytest.raises(StoreError):
+                b.get(object, "x")
+        assert b.state() == OPEN
+        wire_calls = inner.calls
+        # While open: rejected WITHOUT a wire attempt.
+        with pytest.raises(StoreError, match="breaker open"):
+            b.get(object, "x")
+        assert inner.calls == wire_calls
+
+    def test_conflict_and_notfound_reset_the_streak(self):
+        b, clk, _ = self._breaker()
+        inner = b._inner
+        inner.dark = True
+        for _ in range(2):
+            with pytest.raises(StoreError):
+                b.get(object, "x")
+        inner.dark = False
+        # A 404 is the store WORKING — streak resets.
+        assert b.try_get(object, "missing") is None
+        inner.dark = True
+        for _ in range(2):
+            with pytest.raises(StoreError):
+                b.get(object, "x")
+        assert b.state() == CLOSED  # 2 + reset + 2 < threshold twice
+
+    def test_probe_heals_idle_plane_without_traffic(self):
+        # The governor's active probe: fail-fast no-op inside the retry
+        # window (ZERO wire attempts), one cheap list past it; a healed
+        # store closes, a still-dark one re-arms the window.
+        b, clk, _ = self._breaker()
+        inner = b._inner
+        inner.dark = True
+        for _ in range(3):
+            with pytest.raises(StoreError):
+                b.get(object, "x")
+        assert b.is_open()
+        wire = inner.calls
+        assert b.probe() is False
+        assert inner.calls == wire  # inside the window: no wire attempt
+        clk.advance(10.0)           # past the jittered reset
+        assert b.probe() is False   # store still dark: probe fails...
+        assert inner.calls == wire + 1
+        assert b.is_open()          # ...and the breaker re-opens
+        clk.advance(10.0)
+        inner.dark = False
+        assert b.probe() is True    # healed store: probe closes it
+        assert b.state() == CLOSED
+        assert b.probe() is True    # closed breaker: instant no-op
+
+    def test_half_open_probe_closes_on_success(self):
+        b, clk, _ = self._breaker()
+        inner = b._inner
+        inner.dark = True
+        for _ in range(3):
+            with pytest.raises(StoreError):
+                b.get(object, "x")
+        assert b.is_open()
+        # Before the (jittered) reset timeout: still failing fast.
+        clk.advance(1.0)
+        with pytest.raises(StoreError, match="breaker open"):
+            b.get(object, "x")
+        # Past it: one probe admitted; store healed -> closes.
+        clk.advance(6.0)
+        inner.dark = False
+        assert b.get(object, "y") == "y"
+        assert b.state() == CLOSED
+        snap = b.snapshot()
+        assert snap["trips"] == 1
+        assert snap["outage_seconds_total"] >= 7.0
+
+    def test_failed_probe_reopens(self):
+        b, clk, _ = self._breaker()
+        inner = b._inner
+        inner.dark = True
+        for _ in range(3):
+            with pytest.raises(StoreError):
+                b.get(object, "x")
+        clk.advance(7.0)
+        with pytest.raises(StoreError, match="dark"):
+            b.get(object, "x")  # the probe hits the wire and fails
+        assert b.state() == OPEN
+
+    def test_resync_pacing_gates_the_post_heal_herd(self):
+        b, clk, sleeps = self._breaker(
+            resync_rate=10.0, resync_window=5.0,
+        )
+        inner = b._inner
+        inner.dark = True
+        for _ in range(3):
+            with pytest.raises(StoreError):
+                b.get(object, "x")
+        clk.advance(7.0)
+        inner.dark = False
+
+        # The breaker's injected sleep must also advance the fake clock,
+        # or the token bucket never accrues.
+        def sleeping(dt):
+            sleeps.append(dt)
+            clk.advance(dt)
+
+        b._sleep = sleeping
+        assert b.get(object, "probe") == "probe"  # closes; bucket EMPTY
+        for i in range(5):
+            b.get(object, f"k{i}")
+        # 10 tokens/s from empty: each call after the close edge had to
+        # wait for its token.
+        assert sleeps, "recovery drain was never paced"
+        assert b.snapshot()["resyncs_paced_total"] >= 5
+        # Past the window the bucket is bypassed: no further sleeps.
+        clk.advance(10.0)
+        n = len(sleeps)
+        b.get(object, "later")
+        assert len(sleeps) == n
+
+    def test_watch_passthrough_is_ungated(self):
+        store = Store()
+        b = BreakingStore(store, failure_threshold=1)
+        b._state = OPEN  # force open
+        q = b.watch("Node")  # the informer's lifeline: never rejected
+        assert q is not None
+        store.stop_watch(q)
+
+
+# ----------------------------------------------------------------------
+# OverloadGovernor
+# ----------------------------------------------------------------------
+class _Cadenced:
+    period = 2.0
+
+
+class TestOverloadGovernor:
+    @pytest.fixture(autouse=True)
+    def _quiet_global_signals(self):
+        # The governor reads PROCESS-GLOBAL gauges. Controller tests that
+        # ran earlier in the suite leave worker busy-ratio series behind
+        # (a parked worker's last sample can sit at ~1.0), which would
+        # trip the Warn signal under these depth-only scenarios.
+        from tpu_composer.runtime.metrics import worker_busy_ratio
+
+        for labels in worker_busy_ratio.label_sets():
+            worker_busy_ratio.remove(**labels)
+        yield
+
+    def _gov(self, **kw):
+        kw.setdefault("enter_ticks", 2)
+        kw.setdefault("exit_ticks", 2)
+        return OverloadGovernor(rng=random.Random(7), **kw)
+
+    def test_hysteresis_enter_and_step_down(self):
+        g = self._gov(depth_warn=10, depth_shed=100)
+        depth = [0]
+        g.add_queue(lambda: depth[0])
+        assert g.tick() == OK
+        depth[0] = 500
+        assert g.tick() == OK      # 1 tick above: blip, no transition
+        assert g.tick() == SHED    # 2nd consecutive: straight to shed
+        depth[0] = 0
+        assert g.tick() == SHED    # 1 tick below
+        assert g.tick() == WARN    # de-escalation steps DOWN one level
+        assert g.tick() == WARN
+        assert g.tick() == OK      # two more ticks: warn -> ok
+
+    def test_warn_stretches_and_ok_restores_cadences(self):
+        g = self._gov(depth_warn=10, depth_shed=100, stretch_factor=4.0)
+        target = _Cadenced()
+        target.period = 2.0
+        g.stretch(target, "period")
+        depth = [50]
+        g.add_queue(lambda: depth[0])
+        g.tick()
+        g.tick()
+        assert g.state == WARN
+        assert target.period == pytest.approx(8.0)
+        depth[0] = 0
+        g.tick()
+        g.tick()
+        assert g.state == OK
+        assert target.period == pytest.approx(2.0)
+
+    def test_store_breaker_open_is_a_shed_signal(self):
+        class _Brk:
+            open = True
+
+            def is_open(self):
+                return self.open
+
+        brk = _Brk()
+        g = self._gov(store_breaker=brk)
+        g.tick()
+        g.tick()
+        assert g.state == SHED
+        assert g.snapshot()["signals"]["store_breaker_open"] is True
+
+    def test_tick_probes_open_breaker_so_idle_planes_recover(self):
+        # Liveness: Shed defers ALL low-priority work, so a plane with
+        # nothing else pending would never issue the call that closes
+        # the breaker — the governor's tick must probe it itself, and
+        # the SAME tick's evaluation must see the closed breaker.
+        clk = _FakeClock()
+        inner = _FlakyStore()
+        b = BreakingStore(inner, failure_threshold=3, reset_timeout=5.0,
+                          clock=clk, sleep=lambda s: None,
+                          rng=random.Random(42))
+        inner.dark = True
+        for _ in range(3):
+            with pytest.raises(StoreError):
+                b.get(object, "x")
+        assert b.is_open()
+        g = self._gov(store_breaker=b, enter_ticks=1, exit_ticks=1)
+        assert g.tick() == SHED
+        inner.dark = False          # store heals; NO controller traffic
+        clk.advance(10.0)           # past the breaker's retry window
+        assert g.tick() == WARN     # probe closed it; step down begins
+        assert b.state() == CLOSED
+        assert g.tick() == OK
+
+    def test_shed_delay_policy(self):
+        g = self._gov(priority_cutoff=50, shed_quantum=4.0)
+        assert g.shed_delay(0) is None          # not shedding yet
+        g.state = SHED
+        d = g.shed_delay(0)
+        assert d is not None and 2.0 <= d <= 4.0  # U(0.5,1.0) x quantum
+        assert g.shed_delay(100) is None        # high priority exempt
+        assert g.shed_delay(0, deleting=True) is None  # deletions exempt
+
+    def test_note_shed_lands_in_the_ledger_with_reason_overload(self):
+        led = DecisionLedger()
+        g = self._gov(ledger=led)
+        g.state = SHED
+        for _ in range(3):  # repeats collapse via bump_if_recent
+            g.note_shed("req-low", priority=0)
+        doc = led.explain("req-low")
+        assert doc is not None
+        latest = doc["decisions"][-1]
+        assert latest["outcome"] == OUTCOME_HELD_BACK
+        assert latest["binding"]["resource"] == "overload"
+        assert latest["binding"]["reason"] == "overload"
+        assert latest["repeats"] == 3
+        assert g.sheds == 3
+
+    def test_request_shed_gate_reads_priority_and_deletion(self):
+        store = Store()
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="low"),
+            spec=ComposabilityRequestSpec(
+                resource=ResourceDetails(type="tpu", model="v4", size=1),
+                priority=0,
+            ),
+        ))
+        store.create(ComposabilityRequest(
+            metadata=ObjectMeta(name="high"),
+            spec=ComposabilityRequestSpec(
+                resource=ResourceDetails(type="tpu", model="v4", size=1),
+                priority=100,
+            ),
+        ))
+        g = self._gov(priority_cutoff=50, shed_quantum=4.0)
+        gate = request_shed_gate(g, store)
+        assert gate("low") is None   # governor Ok: everything runs
+        g.state = SHED
+        assert gate("low") is not None
+        assert gate("high") is None
+        assert gate("gone") is None  # unknown key fails open
+
+
+# ----------------------------------------------------------------------
+# Watchdog
+# ----------------------------------------------------------------------
+class TestWatchdog:
+    def test_slow_but_progressing_never_trips(self):
+        clk = _FakeClock()
+        wd = Watchdog(stall_after=10.0, capture_burst=False, clock=clk)
+        wd.register("slowpoke")
+        for _ in range(40):  # 200s of slow-but-steady 5s iterations
+            clk.advance(5.0)
+            wd.beat("slowpoke")
+            assert wd.scan() == 0
+        assert wd.snapshot()["subsystems"]["slowpoke"]["stalls"] == 0
+
+    def test_wedged_subsystem_restarted_exactly_once_per_stall(self):
+        clk = _FakeClock()
+        restarts: list = []
+        wd = Watchdog(
+            stall_after=10.0, restart_budget=3, capture_burst=False,
+            clock=clk,
+        )
+        wd.register(
+            "wedged", restartable=True,
+            restart=lambda: restarts.append(1) or True,
+        )
+        clk.advance(11.0)
+        assert wd.scan() == 1
+        assert restarts == [1]
+        # Same wedge, next scans: the restart reset the grace window, and
+        # the stall edge re-arms only via beat or restart — no repeat
+        # restart until a fresh threshold crossing.
+        assert wd.scan() == 0
+        assert restarts == [1]
+        clk.advance(11.0)
+        assert wd.scan() == 1
+        assert len(restarts) == 2
+
+    def test_restart_budget_bounds_respawns(self):
+        clk = _FakeClock()
+        restarts: list = []
+        wd = Watchdog(
+            stall_after=10.0, restart_budget=2, capture_burst=False,
+            clock=clk,
+        )
+        wd.register(
+            "chronic", restartable=True,
+            restart=lambda: restarts.append(1) or True,
+        )
+        for _ in range(5):
+            clk.advance(11.0)
+            wd.scan()
+        assert len(restarts) == 2  # budget, not stall count
+        assert wd.snapshot()["subsystems"]["chronic"]["stalls"] >= 3
+
+    def test_beat_auto_registers_and_unregister_stops_tracking(self):
+        clk = _FakeClock()
+        wd = Watchdog(stall_after=10.0, capture_burst=False, clock=clk)
+        wd.beat("anon-worker")
+        assert "anon-worker" in wd.snapshot()["subsystems"]
+        wd.unregister("anon-worker")
+        clk.advance(100.0)
+        assert wd.scan() == 0  # gone: a clean exit can't phantom-stall
+
+
+# ----------------------------------------------------------------------
+# Queue herd re-spread (the post-outage thundering-herd regression)
+# ----------------------------------------------------------------------
+class TestQueueHerdSpread:
+    def test_stale_backoff_herd_released_over_spread_quantum(self):
+        q = RateLimitingQueue(
+            base_delay=0.001, jitter=random.Random(3),
+            herd_threshold=4, herd_spread=1.0, herd_stale=0.25,
+        )
+        for i in range(20):
+            q.add_rate_limited(f"k{i}")
+        # All 20 came due during the "blackout" (nobody drained): stale.
+        time.sleep(0.35)
+        with q._cond:
+            q._promote_ready(time.monotonic())
+            promoted = len(q._queue)
+            remaining = [t for t, _, _, _ in q._delayed]
+        now = time.monotonic()
+        assert promoted == 4, "only herd_threshold may release at once"
+        assert len(remaining) == 16
+        # The regression assertion: the re-spread covers the quantum
+        # instead of a single instant.
+        assert all(now - 0.01 <= t <= now + 1.05 for t in remaining)
+        assert max(remaining) - min(remaining) > 0.2, (
+            "herd re-spread collapsed into one instant"
+        )
+
+    def test_fresh_backoff_entries_promote_unthrottled(self):
+        q = RateLimitingQueue(
+            base_delay=0.001, jitter=random.Random(3),
+            herd_threshold=4, herd_spread=1.0, herd_stale=0.25,
+        )
+        for i in range(20):
+            q.add_rate_limited(f"k{i}")
+        time.sleep(0.05)  # due but NOT stale: normal operation
+        with q._cond:
+            q._promote_ready(time.monotonic())
+            assert len(q._queue) == 20
+            assert not q._delayed
+
+    def test_plain_add_after_entries_never_re_spread(self):
+        q = RateLimitingQueue(
+            base_delay=0.001, jitter=random.Random(3),
+            herd_threshold=2, herd_spread=5.0, herd_stale=0.25,
+        )
+        for i in range(10):
+            q.add_after(f"poll{i}", 0.01)  # gen=None: liveness polls
+        time.sleep(0.35)  # stale by the backoff rule — but not backoff
+        with q._cond:
+            q._promote_ready(time.monotonic())
+            assert len(q._queue) == 10
+
+
+# ----------------------------------------------------------------------
+# Watchdog-in-manager integration: respawn hook
+# ----------------------------------------------------------------------
+def test_manager_respawn_hook_restarts_a_dead_runnable():
+    from tpu_composer.runtime.manager import Manager
+
+    runs: list = []
+    lives = threading.Semaphore(0)
+
+    class Flaky:
+        def run(self, stop_event):
+            runs.append(threading.current_thread().name)
+            lives.release()
+            # First life dies instantly (the wedge analog); the respawned
+            # one parks on the stop event like a healthy runnable.
+            if len(runs) > 1:
+                stop_event.wait(30)
+
+    wd = Watchdog(stall_after=30.0, capture_burst=False)
+    mgr = Manager(Store(), watchdog=wd)
+    flaky = Flaky()
+    mgr.add_runnable(flaky.run)
+    mgr.start()
+    try:
+        assert lives.acquire(timeout=5)
+        assert wd.restarter is not None
+        assert wd.restarter("Flaky") is True
+        assert lives.acquire(timeout=5)
+        assert runs == ["Flaky", "Flaky"]
+        assert wd.restarter("NoSuchRunnable") is False
+    finally:
+        mgr.stop()

@@ -47,6 +47,28 @@ class TestDriftTracking:
         # no duplicate on the next pass
         assert syncer.sync_once(now=102.0) == 0
 
+    def test_suspended_syncer_freezes_grace_clocks(self, world):
+        """ISSUE-16 ride-through: while the store breaker is open the
+        diff is known-stale — no detach-CRs, and suspension is FROZEN
+        time: a pre-outage orphan must re-age a full grace after heal."""
+        store, pool, _ = world
+        dark = [False]
+        syncer = UpstreamSyncer(store, pool, period=0.01, grace=100.0,
+                                suspend=lambda: dark[0])
+        leaked = pool.leak_attachment("worker-1", "tpu-v4")
+        syncer.sync_once(now=0.0)
+        assert leaked in syncer.tracked_missing
+        dark[0] = True
+        # Grace would have LONG expired — but the store is dark, so the
+        # clock freezes (re-stamped each suspended pass) and nothing acts.
+        assert syncer.sync_once(now=500.0) == 0
+        assert store.list(ComposableResource) == []
+        dark[0] = False
+        # Healed: the orphan's clock restarted at the last dark pass —
+        # still inside the fresh grace, then reclaimed once it re-ages.
+        assert syncer.sync_once(now=501.0) == 0
+        assert syncer.sync_once(now=601.0) == 1
+
     def test_locally_owned_devices_not_flagged(self, world):
         store, pool, syncer = world
         pool.reserve_slice("s1", "tpu-v4", "2x2x1", ["worker-0"])

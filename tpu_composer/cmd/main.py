@@ -710,6 +710,143 @@ def build_parser() -> argparse.ArgumentParser:
              " chance to recover in place instead of being replaced"
              " (env TPUC_REPAIR_DWELL)",
     )
+    # Control-plane survival layer (runtime/overload.py, storebreaker.py,
+    # watchdog.py): the governor degrades by policy under overload, the
+    # store breaker rides out apiserver outages, the watchdog catches
+    # wedged subsystems. Three independent escape hatches.
+    p.add_argument(
+        "--overload",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_OVERLOAD", "1") != "0",
+        help="run the overload governor: fold queue depth, worker"
+             " saturation, queue-wait p99, SLO burn and breaker states"
+             " into an Ok/Warn/Shed state with hysteresis"
+             " (tpuc_overload_state, /debug/overload). Warn stretches"
+             " non-critical cadences (defrag, capacity sampler, fleet"
+             " publish, ledger rescans); Shed additionally defers"
+             " low-priority request reconciles, each deferral ledgered as"
+             " a hold-back with reason=overload. --no-overload or"
+             " TPUC_OVERLOAD=0 constructs none of it",
+    )
+    p.add_argument(
+        "--overload-period",
+        type=float,
+        default=_env_seconds("TPUC_OVERLOAD_PERIOD", 1.0),
+        help="seconds between governor evaluation ticks"
+             " (env TPUC_OVERLOAD_PERIOD)",
+    )
+    p.add_argument(
+        "--overload-depth-warn",
+        type=int,
+        default=_env_int("TPUC_OVERLOAD_DEPTH_WARN", 256),
+        help="summed controller queue depth entering Warn"
+             " (env TPUC_OVERLOAD_DEPTH_WARN)",
+    )
+    p.add_argument(
+        "--overload-depth-shed",
+        type=int,
+        default=_env_int("TPUC_OVERLOAD_DEPTH_SHED", 1024),
+        help="summed controller queue depth entering Shed"
+             " (env TPUC_OVERLOAD_DEPTH_SHED)",
+    )
+    p.add_argument(
+        "--overload-priority-cutoff",
+        type=int,
+        default=_env_int("TPUC_OVERLOAD_PRIORITY_CUTOFF", 50),
+        help="requests with spec.priority below this are shed-eligible;"
+             " >= keeps the tight path even while shedding"
+             " (env TPUC_OVERLOAD_PRIORITY_CUTOFF)",
+    )
+    p.add_argument(
+        "--overload-shed-quantum",
+        type=float,
+        default=_env_seconds("TPUC_OVERLOAD_SHED_QUANTUM", 5.0),
+        help="defer quantum for shed reconciles, seconds (jittered to"
+             " U(0.5, 1.0)x so releases spread;"
+             " env TPUC_OVERLOAD_SHED_QUANTUM)",
+    )
+    p.add_argument(
+        "--overload-stretch",
+        type=float,
+        default=_env_float("TPUC_OVERLOAD_STRETCH", 4.0),
+        help="multiplier applied to non-critical cadences while in"
+             " Warn/Shed (env TPUC_OVERLOAD_STRETCH)",
+    )
+    p.add_argument(
+        "--store-breaker",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_STORE_BREAKER", "1") != "0",
+        help="wrap the object store in a circuit breaker UNDER the read"
+             " cache: consecutive StoreErrors trip it open (writes fail"
+             " fast into per-key backoff, informer reads keep serving),"
+             " a half-open probe closes it, and the close edge paces the"
+             " resync herd through a token bucket"
+             " (tpuc_store_breaker_open, /debug/storebreaker)."
+             " --no-store-breaker or TPUC_STORE_BREAKER=0 constructs"
+             " none of it",
+    )
+    p.add_argument(
+        "--store-breaker-threshold",
+        type=int,
+        default=_env_int("TPUC_STORE_BREAKER_THRESHOLD", 5),
+        help="consecutive store failures (StoreError; 409/404 reset the"
+             " streak) that trip the breaker open"
+             " (env TPUC_STORE_BREAKER_THRESHOLD)",
+    )
+    p.add_argument(
+        "--store-breaker-reset",
+        type=float,
+        default=_env_seconds("TPUC_STORE_BREAKER_RESET", 5.0),
+        help="seconds (±20%% jitter) before an open store breaker admits"
+             " its half-open probe (env TPUC_STORE_BREAKER_RESET)",
+    )
+    p.add_argument(
+        "--store-breaker-resync-rate",
+        type=float,
+        default=_env_float("TPUC_STORE_BREAKER_RESYNC_RATE", 50.0),
+        help="post-heal resync pacing, wire calls per second admitted"
+             " through the recovery token bucket (tpuc_resync_paced_total"
+             " counts paced callers; env TPUC_STORE_BREAKER_RESYNC_RATE)",
+    )
+    p.add_argument(
+        "--store-breaker-resync-window",
+        type=float,
+        default=_env_seconds("TPUC_STORE_BREAKER_RESYNC_WINDOW", 2.0),
+        help="seconds after a breaker close during which the pacing"
+             " bucket gates wire calls; outside it the bucket is bypassed"
+             " (env TPUC_STORE_BREAKER_RESYNC_WINDOW)",
+    )
+    p.add_argument(
+        "--watchdog",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_WATCHDOG", "1") != "0",
+        help="run the subsystem watchdog: controller workers, dispatcher"
+             " lanes and manager runnables heartbeat a registry; a stalled"
+             " subsystem raises a WatchdogStall Event + flight-record +"
+             " on-demand profiler burst of the wedged stack"
+             " (tpuc_watchdog_stalls_total), restartable runnables are"
+             " respawned inside a restart budget"
+             " (tpuc_watchdog_restarts_total), and chronic stalls dump"
+             " the black boxes. --no-watchdog or TPUC_WATCHDOG=0"
+             " constructs none of it",
+    )
+    p.add_argument(
+        "--watchdog-stall-after",
+        type=float,
+        default=_env_seconds("TPUC_WATCHDOG_STALL_AFTER", 30.0),
+        help="seconds without a heartbeat before a subsystem is flagged"
+             " stalled (healthy workers beat multiple times per second,"
+             " so the default has wide false-positive margin;"
+             " env TPUC_WATCHDOG_STALL_AFTER)",
+    )
+    p.add_argument(
+        "--watchdog-restart-budget",
+        type=int,
+        default=_env_int("TPUC_WATCHDOG_RESTART_BUDGET", 3),
+        help="restarts allowed per restartable subsystem; past it the"
+             " watchdog stops restarting and dumps the black boxes"
+             " (env TPUC_WATCHDOG_RESTART_BUDGET)",
+    )
     p.add_argument(
         "--workers",
         type=int,
@@ -932,12 +1069,30 @@ def _configure_tracing(args: argparse.Namespace) -> None:
 def build_manager(args: argparse.Namespace) -> Manager:
     _configure_tracing(args)
     store = build_store(args)
+    # Store circuit breaker (runtime/storebreaker.py), UNDER the read
+    # cache so informer reads keep serving at zero RTT through an outage
+    # while writes fail fast into per-key backoff. Deliberately NOT on
+    # the `store` handle the electors/fleet use below: leases need their
+    # own linearizable path, breaker-gated or not.
+    storebreaker = None
+    breaker_store = store
+    if getattr(args, "store_breaker", True):
+        from tpu_composer.runtime.storebreaker import BreakingStore
+
+        breaker_store = BreakingStore(
+            store,
+            failure_threshold=getattr(args, "store_breaker_threshold", 5),
+            reset_timeout=getattr(args, "store_breaker_reset", 5.0),
+            resync_rate=getattr(args, "store_breaker_resync_rate", 50.0),
+            resync_window=getattr(args, "store_breaker_resync_window", 2.0),
+        )
+        storebreaker = breaker_store
     # Informer read cache (runtime/cache.py): controllers, scheduler,
     # syncer and admission all read through `client`; only writes reach
     # `store`. KubeStore passes through unchanged (it caches internally).
     from tpu_composer.runtime.cache import maybe_cached
 
-    client = maybe_cached(store, getattr(args, "cached_reads", True))
+    client = maybe_cached(breaker_store, getattr(args, "cached_reads", True))
     from tpu_composer.fabric.adapter import TracedFabricProvider
 
     # Every fabric verb becomes a trace span (runtime/tracing.py); the
@@ -1117,6 +1272,19 @@ def build_manager(args: argparse.Namespace) -> Manager:
             profiler=profiler_inst,
             goodput=goodput_tracker,
         )
+    # Subsystem watchdog (runtime/watchdog.py): controller workers,
+    # dispatcher lanes and the governor heartbeat it; Manager.start hands
+    # it the runnable-respawn hook.
+    watchdog = None
+    if getattr(args, "watchdog", True):
+        from tpu_composer.runtime.watchdog import Watchdog
+
+        watchdog = Watchdog(
+            stall_after=getattr(args, "watchdog_stall_after", 30.0),
+            restart_budget=getattr(args, "watchdog_restart_budget", 3),
+        )
+        if dispatcher is not None:
+            dispatcher.watchdog = watchdog
     mgr = Manager(
         store=client,
         leader_elect=args.leader_elect,
@@ -1134,7 +1302,12 @@ def build_manager(args: argparse.Namespace) -> Manager:
         replica_id=replica_id,
         fleet=fleet_plane,
         goodput=goodput_tracker,
+        watchdog=watchdog,
+        storebreaker=storebreaker,
     )
+    if watchdog is not None:
+        watchdog.recorder = mgr.recorder
+        mgr.add_runnable(watchdog.run)
     if slo_engine is not None:
         # The engine's breach/recovery Events flow through the manager's
         # recorder (constructed just above).
@@ -1234,12 +1407,13 @@ def build_manager(args: argparse.Namespace) -> Manager:
         health_failure_threshold=getattr(args, "health_failure_threshold", 3),
         node_degrade_threshold=getattr(args, "node_degrade_threshold", 3),
     )
-    mgr.add_controller(ComposabilityRequestReconciler(client, fabric,
-                                                      recorder=mgr.recorder,
-                                                      scheduler=scheduler,
-                                                      repair=repair_cfg,
-                                                      migrate=migrate_cfg,
-                                                      ownership=ownership))
+    req_rec = ComposabilityRequestReconciler(client, fabric,
+                                             recorder=mgr.recorder,
+                                             scheduler=scheduler,
+                                             repair=repair_cfg,
+                                             migrate=migrate_cfg,
+                                             ownership=ownership)
+    mgr.add_controller(req_rec)
     res_rec = ComposableResourceReconciler(client, fabric, agent,
                                            timing=res_timing,
                                            recorder=mgr.recorder,
@@ -1283,10 +1457,16 @@ def build_manager(args: argparse.Namespace) -> Manager:
         mgr.add_runnable(defrag_loop)
         # /debug/defrag (dry-run plan + skip reasons) reads this handle.
         mgr.defrag = defrag_loop
-    mgr.add_runnable(UpstreamSyncer(client, fabric, period=args.sync_period,
-                                    grace=args.sync_grace,
-                                    recorder=mgr.recorder,
-                                    ownership=ownership))
+    mgr.add_runnable(UpstreamSyncer(
+        client, fabric, period=args.sync_period,
+        grace=args.sync_grace,
+        recorder=mgr.recorder,
+        ownership=ownership,
+        # Outage ride-through: freeze the orphan grace clocks while the
+        # store breaker is open — a dark store's diff must not reclaim
+        # healthy mid-attach devices whose status writes couldn't land.
+        suspend=storebreaker.is_open if storebreaker is not None else None,
+    ))
     # Event-driven visibility: /dev change events nudge the resource
     # controller instead of waiting out a poll quantum (BASELINE.md) —
     # inotify directly for a local agent, HTTP long-poll per node for the
@@ -1339,6 +1519,76 @@ def build_manager(args: argparse.Namespace) -> Manager:
                 webhook.run(stop_event)
 
             mgr.add_runnable(serve_webhooks)
+    # Overload governor (runtime/overload.py): built last so every signal
+    # source and stretchable cadence already exists. TPUC_OVERLOAD=0
+    # constructs none of it — no governor thread, no shed gate, no
+    # cadence stretching.
+    if getattr(args, "overload", True):
+        from tpu_composer.runtime.metrics import (
+            fabric_breaker_state,
+            slo_breached as _slo_breached_gauge,
+        )
+        from tpu_composer.runtime.overload import (
+            OverloadGovernor,
+            request_shed_gate,
+        )
+
+        governor = OverloadGovernor(
+            period=getattr(args, "overload_period", 1.0),
+            depth_warn=getattr(args, "overload_depth_warn", 256),
+            depth_shed=getattr(args, "overload_depth_shed", 1024),
+            stretch_factor=getattr(args, "overload_stretch", 4.0),
+            shed_quantum=getattr(args, "overload_shed_quantum", 5.0),
+            priority_cutoff=getattr(args, "overload_priority_cutoff", 50),
+            ledger=scheduler.ledger,
+            store_breaker=storebreaker,
+            # The fabric breaker publishes per-endpoint state gauges
+            # (0 closed / 1 open / 2 half-open): any fully-open endpoint
+            # is a Warn signal.
+            fabric_open=lambda: any(
+                float(v) == 1.0 for _, v in fabric_breaker_state.state()
+            ),
+            slo_breached=lambda: any(
+                float(v) >= 1.0 for _, v in _slo_breached_gauge.state()
+            ),
+            recorder=mgr.recorder,
+        )
+        governor.watchdog = watchdog
+        # Live queue depths: queues are re-created by Controller.start(),
+        # so close over the controller, not today's queue object.
+        for c in mgr._controllers:
+            governor.add_queue(lambda c=c: len(c.queue))
+        # Non-critical cadences stretched in Warn/Shed (all read live
+        # each tick by their loops).
+        if mgr.defrag is not None:
+            governor.stretch(mgr.defrag, "period")
+        if mgr.capacity is not None:
+            governor.stretch(mgr.capacity, "period")
+        if fleet_plane is not None:
+            governor.stretch(fleet_plane, "publish_period")
+        if scheduler.ledger is not None:
+            governor.stretch(scheduler.ledger, "hold_rescan_s")
+        # The shed gate guards ONLY the request controller: resource
+        # reconciles, health probes, detaches and repairs keep the tight
+        # path no matter the state.
+        req_rec.shed_gate = request_shed_gate(governor, client)
+        mgr.overload = governor
+        mgr.add_runnable(governor.run)
+    if watchdog is not None:
+        # Worker loops beat under their thread names (auto-registered on
+        # first beat); the governor runnable is restartable — it is pure
+        # policy and respawns safely mid-flight.
+        for c in mgr._controllers:
+            c.watchdog = watchdog
+        if mgr.overload is not None:
+            watchdog.register(
+                "OverloadGovernor",
+                stall_after=max(
+                    watchdog.stall_after,
+                    10.0 * getattr(args, "overload_period", 1.0),
+                ),
+                restartable=True,
+            )
     return mgr
 
 

@@ -29,7 +29,7 @@ from __future__ import annotations
 import datetime
 import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from tpu_composer.api.dra import DeviceTaintRule, DeviceTaintRuleSpec
 from tpu_composer.api.meta import ObjectMeta, parse_iso
@@ -83,12 +83,20 @@ class UpstreamSyncer:
         recorder: Optional[EventRecorder] = None,
         vanish_threshold: int = 2,
         ownership=None,
+        suspend: Optional[Callable[[], bool]] = None,
     ) -> None:
         self.store = store
         self.fabric = fabric
         self.period = period
         self.grace = grace
         self.recorder = recorder or EventRecorder()
+        # Outage ride-through (cmd/main wires the store breaker's is_open
+        # here): while the store is dark, "device not in any CR" proves
+        # nothing — status writes can't land, so the diff would reclaim
+        # healthy mid-attach devices. While suspended every orphan grace
+        # clock freezes and no detach-CRs are created; a real orphan must
+        # re-age a FULL grace after heal.
+        self.suspend = suspend
         # Shard ownership (runtime.shards.ShardOwnership): with N replicas
         # each running a syncer against the same fabric, every mutating
         # sweep is partitioned by key hash — orphan reclamation by device
@@ -133,6 +141,13 @@ class UpstreamSyncer:
     def sync_once(self, now: Optional[float] = None) -> int:
         """One diff pass; returns the number of detach-CRs created."""
         now = time.monotonic() if now is None else now
+        if self.suspend is not None and self.suspend():
+            # Store outage: the local view is known-stale. Re-stamp every
+            # missing clock so suspension is frozen time, not accrued
+            # grace — the post-heal pass starts each orphan's clock over.
+            for dev_id in self._missing:
+                self._missing[dev_id] = now
+            return 0
         if not self._loaded:
             # Only a SUCCESSFUL load retires the flag: a transient list
             # failure here must not permanently disable clock resumption

@@ -215,6 +215,11 @@ class FabricDispatcher:
         # Lane saturation: busy seconds per worker turn (provider calls),
         # level-set into tpuc_worker_busy_ratio{pool="fabric-dispatch"}.
         self._busy = BusyTracker("fabric-dispatch", workers=self.concurrency)
+        # Liveness hook (wired by cmd/main when the watchdog is enabled):
+        # lane workers beat under their thread name every turn/idle wake
+        # (bounded by the 5s idle cond timeout, far inside the default
+        # stall threshold).
+        self.watchdog = None
         self._lanes: Dict[str, _Lane] = {}
         self._ops: Dict[Tuple[str, str], _Op] = {}  # live (queued/inflight/pending)
         self._done: Dict[Tuple[str, str], Tuple[_Op, float]] = {}
@@ -646,69 +651,82 @@ class FabricDispatcher:
     def _worker_loop(self) -> None:
         if self.replica_id:
             tracing.bind_thread(self.replica_id)
-        while True:
-            with self._cond:
-                task = None
-                while task is None:
-                    if self._shutdown:
-                        return
-                    now = time.monotonic()
-                    self._sweep_done(now)
-                    task, wake = self._next_task(now)
-                    if task is None:
-                        self._busy.add(0.0)  # idle wake advances the window
-                        # Bounded even when no work is queued: a fully
-                        # idle pool must keep feeding the busy tracker or
-                        # tpuc_worker_busy_ratio freezes at its last
-                        # (possibly saturated) value for the whole idle
-                        # stretch.
-                        self._cond.wait(
-                            timeout=wake if wake is not None else 5.0
-                        )
-            lane, verb, ops = task
-            turn_t0 = time.monotonic()
-            try:
-                self._execute(verb, ops)
-            finally:
-                self._busy.add(time.monotonic() - turn_t0)
-                fired: List[Tuple[_Op, List[Callable[[], None]]]] = []
+        wd, wd_name = self.watchdog, threading.current_thread().name
+        try:
+            while True:
                 with self._cond:
-                    lane.busy = False
-                    for op in ops:
-                        # Fire but RETAIN the latch (each reconcile pass
-                        # re-registers, replacing the list, so it stays at
-                        # one entry): a parked outcome keeps its latch so an
-                        # in-process stop() can re-fire it — without this, a
-                        # restart between completion and consumption would
-                        # silently strand the result until a poll timer.
-                        if op.on_ready:
-                            fired.append((op, list(op.on_ready)))
-                    # Prune empty lanes so churning fleets don't grow the
-                    # lane map forever (O(1): a batch shares one node).
-                    node = ops[0].node
-                    if self._lanes.get(node) is lane and lane.idle():
-                        del self._lanes[node]
-                    self._cond.notify_all()
-                for op, callbacks in fired:
-                    # The completion side of the causal chain: a short span
-                    # in the op's trace wraps the latch, so the queue.add
-                    # the latch performs hands a flow off to the next
-                    # reconcile — Perfetto shows dispatch -> completion ->
-                    # requeued reconcile as connected arrows across threads.
-                    ctx = (
-                        tracing.TraceContext(trace_id=op.ctx.trace_id)
-                        if op.ctx is not None else None
-                    )
-                    with tracing.span(
-                        "dispatch.complete", cat="dispatcher",
-                        resource=op.name, verb=op.verb, state=op.state,
-                        ctx=ctx,
-                    ):
-                        for cb in callbacks:
-                            try:
-                                cb()
-                            except Exception:
-                                self.log.exception("on_ready latch failed")
+                    task = None
+                    while task is None:
+                        if self._shutdown:
+                            return
+                        if wd is not None:
+                            # Beat per wake (idle waits are ≤5s, well
+                            # inside the default stall threshold). The
+                            # watchdog's plain lock nests safely under
+                            # the dispatcher cond's ObservedLock.
+                            wd.beat(wd_name)
+                        now = time.monotonic()
+                        self._sweep_done(now)
+                        task, wake = self._next_task(now)
+                        if task is None:
+                            self._busy.add(0.0)  # idle wake advances the window
+                            # Bounded even when no work is queued: a fully
+                            # idle pool must keep feeding the busy tracker or
+                            # tpuc_worker_busy_ratio freezes at its last
+                            # (possibly saturated) value for the whole idle
+                            # stretch.
+                            self._cond.wait(
+                                timeout=wake if wake is not None else 5.0
+                            )
+                lane, verb, ops = task
+                turn_t0 = time.monotonic()
+                try:
+                    self._execute(verb, ops)
+                finally:
+                    self._busy.add(time.monotonic() - turn_t0)
+                    fired: List[Tuple[_Op, List[Callable[[], None]]]] = []
+                    with self._cond:
+                        lane.busy = False
+                        for op in ops:
+                            # Fire but RETAIN the latch (each reconcile pass
+                            # re-registers, replacing the list, so it stays at
+                            # one entry): a parked outcome keeps its latch so an
+                            # in-process stop() can re-fire it — without this, a
+                            # restart between completion and consumption would
+                            # silently strand the result until a poll timer.
+                            if op.on_ready:
+                                fired.append((op, list(op.on_ready)))
+                        # Prune empty lanes so churning fleets don't grow the
+                        # lane map forever (O(1): a batch shares one node).
+                        node = ops[0].node
+                        if self._lanes.get(node) is lane and lane.idle():
+                            del self._lanes[node]
+                        self._cond.notify_all()
+                    for op, callbacks in fired:
+                        # The completion side of the causal chain: a short span
+                        # in the op's trace wraps the latch, so the queue.add
+                        # the latch performs hands a flow off to the next
+                        # reconcile — Perfetto shows dispatch -> completion ->
+                        # requeued reconcile as connected arrows across threads.
+                        ctx = (
+                            tracing.TraceContext(trace_id=op.ctx.trace_id)
+                            if op.ctx is not None else None
+                        )
+                        with tracing.span(
+                            "dispatch.complete", cat="dispatcher",
+                            resource=op.name, verb=op.verb, state=op.state,
+                            ctx=ctx,
+                        ):
+                            for cb in callbacks:
+                                try:
+                                    cb()
+                                except Exception:
+                                    self.log.exception("on_ready latch failed")
+        finally:
+            if wd is not None:
+                # A clean shutdown must not race the final scan into a
+                # phantom stall.
+                wd.unregister(wd_name)
 
     def _next_task(
         self, now: float

@@ -601,10 +601,18 @@ def maybe_cached(store, enabled: bool):
     like the bare store would — the informer then sits ABOVE the fault
     injector, the same position it has over a flaky real apiserver."""
     from tpu_composer.runtime.chaosstore import ChaosStore
+    from tpu_composer.runtime.storebreaker import BreakingStore
 
-    inproc = isinstance(store, Store) or (
-        isinstance(store, ChaosStore) and isinstance(store._inner, Store)
-    )
-    if enabled and inproc:
+    def _inproc(s) -> bool:
+        if isinstance(s, Store):
+            return True
+        # Fault injector / circuit breaker wrappers cache like the bare
+        # store would — the informer sits ABOVE them, so reads keep
+        # serving at zero RTT through an injected or real outage.
+        if isinstance(s, (ChaosStore, BreakingStore)):
+            return _inproc(s._inner)
+        return False
+
+    if enabled and _inproc(store):
         return CachedClient(store)
     return store

@@ -22,7 +22,13 @@ has to absorb:
   events; a permanently stale informer cannot). docs/OPERATIONS.md
   documents the pairing;
 - ``fail_verb(verb, times)`` / ``blackout()`` / ``heal()``: scripted and
-  total-outage modes, mirroring the fabric chaos knobs.
+  total-outage modes, mirroring the fabric chaos knobs;
+- ``blackout_for(seconds)`` / ``script_blackouts(windows)`` /
+  ``script_random_blackouts(...)``: TIMED outage windows on an injectable
+  clock, so an outage test scripts duration instead of counting mutations
+  — the dark-store brownout soak's instrument. ``heal()`` clears every
+  scripted fault (timed windows included), parity with
+  ``ChaosFabricProvider.heal``.
 
 All injections count into ``tpuc_store_chaos_injected_total{verb,mode}``.
 Wired through cmd flags (``--chaos-store-*`` / ``TPUC_CHAOS_STORE_*``),
@@ -90,6 +96,7 @@ class ChaosStore:
         watch_drop_rate: float = 0.0,
         seed: int = 0,
         sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._inner = inner
         self.failure_rate = failure_rate
@@ -98,8 +105,13 @@ class ChaosStore:
         self.watch_drop_rate = watch_drop_rate
         self._rng = random.Random(seed)
         self._sleep = sleep
+        self._clock = clock
         self._lock = threading.Lock()
         self._blackout = False
+        #: timed blackout windows: absolute (start, end) on self._clock.
+        #: blackout_for() appends one starting now; script_blackouts()
+        #: appends future ones. Expired windows are pruned lazily.
+        self._blackout_windows: List[Tuple[float, float]] = []
         self._verb_failures: Dict[str, int] = {}  # verb -> remaining (-1 forever)
         self.calls = 0
         self.injected = 0
@@ -112,9 +124,71 @@ class ChaosStore:
         with self._lock:
             self._blackout = True
 
+    def blackout_for(self, seconds: float) -> None:
+        """Timed outage: every CRUD call fails for ``seconds`` from now,
+        then the store heals itself (no explicit heal() needed) — tests
+        script outage DURATION instead of counting mutations."""
+        now = self._clock()
+        with self._lock:
+            self._blackout_windows.append((now, now + seconds))
+
+    def script_blackouts(
+        self, windows: List[Tuple[float, float]]
+    ) -> List[Tuple[float, float]]:
+        """Schedule blackout windows ``[(start_in_s, duration_s), ...]``
+        relative to now; returns the absolute (start, end) schedule."""
+        now = self._clock()
+        sched = [(now + start, now + start + dur) for start, dur in windows]
+        with self._lock:
+            self._blackout_windows.extend(sched)
+        return sched
+
+    def script_random_blackouts(
+        self,
+        count: int,
+        min_s: float = 5.0,
+        max_s: float = 8.0,
+        min_gap_s: float = 1.0,
+        max_gap_s: float = 3.0,
+    ) -> List[Tuple[float, float]]:
+        """Randomized outage script (the brownout soak's driver): ``count``
+        windows of U(min_s, max_s) seconds separated by U(min_gap_s,
+        max_gap_s) gaps, drawn from the seeded rng; returns the absolute
+        (start, end) schedule so the test knows exactly when the store is
+        dark."""
+        rel: List[Tuple[float, float]] = []
+        at = 0.0
+        with self._lock:
+            for _ in range(count):
+                dur = self._rng.uniform(min_s, max_s)
+                rel.append((at, dur))
+                at += dur + self._rng.uniform(min_gap_s, max_gap_s)
+        return self.script_blackouts(rel)
+
+    def blackout_active(self) -> bool:
+        """True while any blackout (switched or timed) is in force."""
+        with self._lock:
+            return self._blackout_now(self._clock())
+
+    def _blackout_now(self, now: float) -> bool:
+        # caller holds the lock; prunes expired windows as it goes
+        if self._blackout:
+            return True
+        if self._blackout_windows:
+            self._blackout_windows = [
+                (s, e) for s, e in self._blackout_windows if e > now
+            ]
+            return any(s <= now for s, _ in self._blackout_windows)
+        return False
+
     def heal(self) -> None:
+        """Clear every injected fault: the blackout switch, all timed and
+        scripted blackout windows, and scripted verb failures — parity
+        with ``ChaosFabricProvider.heal()`` (rate-based knobs stay; they
+        are configuration, not state)."""
         with self._lock:
             self._blackout = False
+            self._blackout_windows.clear()
             self._verb_failures.clear()
 
     def fail_verb(self, verb: str, times: int = 1) -> None:
@@ -135,7 +209,7 @@ class ChaosStore:
                 self._sleep(delay)
         with self._lock:
             self.calls += 1
-            if self._blackout:
+            if self._blackout_now(self._clock()):
                 self.injected += 1
                 store_chaos_injected_total.inc(verb=verb, mode="transient")
                 raise StoreError(f"chaos: apiserver blackout ({verb} {kind})")

@@ -73,6 +73,12 @@ class Controller:
         # ownership can flip while a key sits queued); the shard's new
         # owner re-enqueues them via the manager resync hook.
         self.ownership = ownership
+        # Liveness + load-shedding hooks (wired by cmd/main when enabled):
+        # workers beat the watchdog under their thread name every queue
+        # wake, and the request controller's shed_gate defers low-priority
+        # keys while the overload governor is shedding.
+        self.watchdog = None
+        self.shed_gate: Optional[Callable[[str], Optional[float]]] = None
         self.queue = RateLimitingQueue(name=self.name)
         # Saturation telemetry: workers report per-turn busy seconds and
         # the tracker level-sets tpuc_worker_busy_ratio{pool=<name>}.
@@ -180,50 +186,81 @@ class Controller:
     def _worker_loop(self) -> None:
         if self.replica_id:
             tracing.bind_thread(self.replica_id)
-        while not self._stop.is_set():
-            key = self.queue.get(timeout=0.2)
-            if key is None:
-                self._busy.add(0.0)  # idle wake still advances the window
-                continue
-            turn_t0 = time.monotonic()
-            if not self._owned(key):
-                # Shard moved (or was never ours) while the key sat
-                # queued: drop it without reconciling — the shard's owner
-                # serves it. pop_context first so the parked trace handoff
-                # can't leak; done() releases the processing mark.
-                self.queue.pop_context(key)
-                self.queue.done(key)
-                continue
-            # Cross-thread causality: an add() made inside a traced span (a
-            # dispatcher completion latch, a sibling reconcile) parked a
-            # TraceContext for this key — joining it here draws the Chrome
-            # flow arrow from that span into this reconcile and makes the
-            # trace_id (the pending_op nonce) this thread's active trace.
-            ctx = self.queue.pop_context(key)
+        wd, wd_name = self.watchdog, threading.current_thread().name
+        try:
+            while not self._stop.is_set():
+                key = self.queue.get(timeout=0.2)
+                if wd is not None:
+                    # Every wake — idle or not — is progress: a healthy
+                    # worker beats ≥5x/s (get timeout 0.2s), so the
+                    # default stall threshold has huge margin.
+                    wd.beat(wd_name)
+                if key is None:
+                    self._busy.add(0.0)  # idle wake still advances the window
+                    continue
+                self._work_one(key)
+        finally:
+            if wd is not None:
+                # A clean shutdown must not race the final scan into a
+                # phantom stall.
+                wd.unregister(wd_name)
+
+    def _work_one(self, key: str) -> None:
+        turn_t0 = time.monotonic()
+        if not self._owned(key):
+            # Shard moved (or was never ours) while the key sat
+            # queued: drop it without reconciling — the shard's owner
+            # serves it. pop_context first so the parked trace handoff
+            # can't leak; done() releases the processing mark.
+            self.queue.pop_context(key)
+            self.queue.done(key)
+            return
+        if self.shed_gate is not None:
+            # Overload shed: the gate (runtime.overload.request_shed_gate)
+            # returns a defer delay for low-priority keys while the
+            # governor is shedding, or None to proceed. Deferral re-parks
+            # the key WITHOUT counting a rate-limit failure — the work is
+            # healthy, the control plane isn't. Gate bugs fail open.
             try:
-                with tracing.span(
-                    "reconcile", cat="controller",
-                    controller=self.name, object=key, ctx=ctx,
-                ) as sp:
-                    result = self.reconcile(key)  # type: ignore[arg-type]
-                    sp["outcome"] = (
-                        f"requeue:{result.requeue_after:g}s"
-                        if result and result.requeue_after > 0 else "done"
-                    )
-            except ConflictError:
-                # Stale read — immediate retry with fresh state (controller-
-                # runtime requeues conflicts without logging an error).
-                self.queue.add_rate_limited(key)
-            except Exception as e:
-                if isinstance(e, self.quiet_exceptions):
-                    self.log.warning("reconcile %s: %s", key, e)
-                else:
-                    self.log.exception("reconcile %s failed", key)
-                self.queue.add_rate_limited(key)
-            else:
-                self.queue.forget(key)
-                if result and result.requeue_after > 0:
-                    self.queue.add_after(key, result.requeue_after)
-            finally:
+                delay = self.shed_gate(key)
+            except Exception:
+                delay = None
+            if delay is not None and delay > 0:
+                self.queue.pop_context(key)
+                self.queue.add_after(key, delay)
                 self.queue.done(key)
-                self._busy.add(time.monotonic() - turn_t0)
+                self._busy.add(0.0)
+                return
+        # Cross-thread causality: an add() made inside a traced span (a
+        # dispatcher completion latch, a sibling reconcile) parked a
+        # TraceContext for this key — joining it here draws the Chrome
+        # flow arrow from that span into this reconcile and makes the
+        # trace_id (the pending_op nonce) this thread's active trace.
+        ctx = self.queue.pop_context(key)
+        try:
+            with tracing.span(
+                "reconcile", cat="controller",
+                controller=self.name, object=key, ctx=ctx,
+            ) as sp:
+                result = self.reconcile(key)  # type: ignore[arg-type]
+                sp["outcome"] = (
+                    f"requeue:{result.requeue_after:g}s"
+                    if result and result.requeue_after > 0 else "done"
+                )
+        except ConflictError:
+            # Stale read — immediate retry with fresh state (controller-
+            # runtime requeues conflicts without logging an error).
+            self.queue.add_rate_limited(key)
+        except Exception as e:
+            if isinstance(e, self.quiet_exceptions):
+                self.log.warning("reconcile %s: %s", key, e)
+            else:
+                self.log.exception("reconcile %s failed", key)
+            self.queue.add_rate_limited(key)
+        else:
+            self.queue.forget(key)
+            if result and result.requeue_after > 0:
+                self.queue.add_after(key, result.requeue_after)
+        finally:
+            self.queue.done(key)
+            self._busy.add(time.monotonic() - turn_t0)

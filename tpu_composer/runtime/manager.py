@@ -15,7 +15,7 @@ import logging
 import os
 import threading
 import urllib.parse
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from tpu_composer.runtime.controller import Controller
 from tpu_composer.runtime.events import EventRecorder
@@ -69,6 +69,15 @@ DEBUG_ENDPOINTS = {
     "/debug/goodput": "per-request goodput accounting: Ready-serving vs"
                       " queued/degraded/repairing/migrating wall seconds"
                       " and the fleet-local ratio",
+    "/debug/overload": "overload governor state: Ok/Warn/Shed with the"
+                       " signals behind the verdict, stretched cadences"
+                       " and shed counts (503 under TPUC_OVERLOAD=0)",
+    "/debug/watchdog": "subsystem heartbeat registry: last-beat age,"
+                       " stall/restart counts per subsystem and the last"
+                       " stall's profiler burst (503 under TPUC_WATCHDOG=0)",
+    "/debug/storebreaker": "store circuit breaker: state, trips, outage"
+                           " seconds and resync-pacing status (503 under"
+                           " TPUC_STORE_BREAKER=0)",
 }
 
 # A runnable is the analog of manager.Add(RunnableFunc) used by the
@@ -230,6 +239,34 @@ class _HealthHandler(_PlainTextHandler):
                 self._respond_json(
                     200, json.dumps(gp.snapshot(), indent=1).encode()
                 )
+        elif path == "/debug/overload":
+            gov = self.manager.overload
+            if gov is None:
+                self._respond(
+                    503, "overload governor disabled (TPUC_OVERLOAD=0)"
+                )
+            else:
+                self._respond_json(
+                    200, json.dumps(gov.snapshot(), indent=1).encode()
+                )
+        elif path == "/debug/watchdog":
+            wd = self.manager.watchdog
+            if wd is None:
+                self._respond(503, "watchdog disabled (TPUC_WATCHDOG=0)")
+            else:
+                self._respond_json(
+                    200, json.dumps(wd.snapshot(), indent=1).encode()
+                )
+        elif path == "/debug/storebreaker":
+            brk = self.manager.storebreaker
+            if brk is None:
+                self._respond(
+                    503, "store breaker disabled (TPUC_STORE_BREAKER=0)"
+                )
+            else:
+                self._respond_json(
+                    200, json.dumps(brk.snapshot(), indent=1).encode()
+                )
         elif path == "/debug/profile/continuous":
             prof = self.manager.profiler
             if prof is None:
@@ -370,6 +407,9 @@ class Manager:
         decisions=None,  # scheduler.DecisionLedger serving explain routes
         capacity=None,  # runtime.capacity.CapacityObservatory
         goodput=None,  # runtime.goodput.GoodputTracker
+        overload=None,  # runtime.overload.OverloadGovernor
+        watchdog=None,  # runtime.watchdog.Watchdog
+        storebreaker=None,  # runtime.storebreaker.BreakingStore
     ) -> None:
         # `is not None`, not `or`: an EMPTY store is falsy (Store.__len__),
         # and silently swapping in a fresh one would orphan the caller's
@@ -420,6 +460,22 @@ class Manager:
         self.decisions = decisions
         self.capacity = capacity
         self.goodput = goodput
+        # Survival-layer handles (all None under their TPUC_*=0 hatches):
+        # the overload governor (/debug/overload), the subsystem watchdog
+        # (/debug/watchdog) and the store circuit breaker
+        # (/debug/storebreaker).
+        self.overload = overload
+        self.watchdog = watchdog
+        self.storebreaker = storebreaker
+        if watchdog is not None:
+            # A stalled RESTARTABLE runnable is respawned through this
+            # hook: the old thread is abandoned (daemon, unjoinable while
+            # wedged) and a fresh one takes over its name. Unknown names
+            # (nothing started yet) just return False.
+            watchdog.restarter = self._respawn_runnable
+        #: runnable-name -> runnable, built by start(); the watchdog's
+        #: respawn hook resolves restart targets through it.
+        self._runnable_by_name: Dict[str, Runnable] = {}
         # Post-leader-acquire / pre-controller-start hooks (cold-start
         # adoption of durable fabric intents, controllers/adoption.py):
         # they run only once leadership is held — a standby must not probe
@@ -650,13 +706,30 @@ class Manager:
             # FabricSession, ...): the profiler attributes samples by
             # thread name, and an anonymous Thread-N would land every
             # runnable in its 'other' bucket.
+            name = _runnable_name(r)
+            self._runnable_by_name[name] = r
             t = threading.Thread(
                 target=self._bound(r), args=(self._stop,), daemon=True,
-                name=_runnable_name(r),
+                name=name,
             )
             t.start()
             self._threads.append(t)
         self._started = True
+
+    def _respawn_runnable(self, name: str) -> bool:
+        """Watchdog respawn hook: start a fresh thread for the runnable
+        registered under ``name``. The wedged thread is left behind — it
+        is a daemon, and joining it would wedge the watchdog too."""
+        r = self._runnable_by_name.get(name)
+        if r is None or self._stop.is_set():
+            return False
+        t = threading.Thread(
+            target=self._bound(r), args=(self._stop,), daemon=True, name=name
+        )
+        t.start()
+        self._threads.append(t)
+        self.log.warning("respawned runnable %s after watchdog stall", name)
+        return True
 
     def _leadership_watchdog(self) -> None:
         while not self._stop.wait(1.0):

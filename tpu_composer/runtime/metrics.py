@@ -789,5 +789,51 @@ capacity_hosts_by_free = global_registry.gauge(
 )
 
 
+#: Control-plane survival layer (runtime/overload.py + runtime/storebreaker.py
+#: + runtime/watchdog.py): the operator protecting itself from its own
+#: brownouts — overload shedding, store-outage ride-through, stall detection.
+overload_state = global_registry.gauge(
+    "tpuc_overload_state",
+    "Overload governor state (0 = ok, 1 = warn: non-critical cadences"
+    " stretched, 2 = shed: low-priority request reconciles deferred to the"
+    " stretched backoff quantum while the tight path keeps running)",
+)
+overload_sheds_total = global_registry.counter(
+    "tpuc_overload_sheds_total",
+    "Reconcile passes deferred by the overload governor while in shed"
+    " state, by class (request = low-priority ComposabilityRequest"
+    " reconciles). Every shed also lands in the decision ledger as a"
+    " hold-back with reason=overload",
+)
+store_breaker_open = global_registry.gauge(
+    "tpuc_store_breaker_open",
+    "1 while the store circuit breaker is open (apiserver outage: writes"
+    " fail fast, reads keep serving from the informer cache) or half-open"
+    " (probing), else 0",
+)
+store_outage_seconds_total = global_registry.counter(
+    "tpuc_store_outage_seconds_total",
+    "Cumulative wall seconds the store breaker spent open, settled at each"
+    " close edge — the ride-through clock an outage postmortem reads",
+)
+resync_paced_total = global_registry.counter(
+    "tpuc_resync_paced_total",
+    "Store calls delayed by the post-outage token-bucket resync limiter"
+    " (the recovery drain's pacing: N controllers x K backed-off keys must"
+    " not stampede the just-healed apiserver)",
+)
+watchdog_stalls_total = global_registry.counter(
+    "tpuc_watchdog_stalls_total",
+    "Heartbeat stalls detected by the subsystem watchdog, by subsystem"
+    " (counted once per stall edge, not per scan — a healthy suite runs at"
+    " zero; any growth names the wedged thread)",
+)
+watchdog_restarts_total = global_registry.counter(
+    "tpuc_watchdog_restarts_total",
+    "Stalled restartable runnables restarted by the watchdog, by subsystem"
+    " (bounded by --watchdog-restart-budget per subsystem)",
+)
+
+
 def timed() -> float:
     return time.monotonic()

@@ -12,7 +12,11 @@ controller-runtime reconciler in /root/reference/internal/controller. Contract:
   decorrelated jitter (failures) — deterministic 2^n backoff made every key
   that failed during a fabric blackout requeue in the same instant when it
   healed (thundering herd into the just-recovered endpoint); jitter spreads
-  the recovery wave while keeping the same expected growth;
+  the recovery wave while keeping the same expected growth. Jitter alone is
+  not enough when an OUTAGE aligns the expiries: backoff entries that all
+  came due during a blackout used to mass-promote in one ``_promote_ready``
+  pass on heal, so promotion now re-spreads any such stale herd past
+  ``herd_threshold`` over one ``herd_spread`` quantum;
 - ``forget(key)`` resets the backoff (successful reconcile) AND lazily
   invalidates the key's pending backoff entries: a key that succeeded must
   not be woken again by a stale pre-success failure requeue. Plain
@@ -52,10 +56,23 @@ class RateLimitingQueue:
         max_delay: float = 16.0,
         jitter: Optional[random.Random] = None,
         name: str = "queue",
+        herd_threshold: int = 8,
+        herd_spread: float = 1.0,
+        herd_stale: float = 0.25,
     ) -> None:
         self._base_delay = base_delay
         self._max_delay = max_delay
         self._rng = jitter or random.Random()
+        # Post-outage herd pacing (see _promote_ready): one promotion pass
+        # finding more than herd_threshold backoff entries that ALL went
+        # stale (ready more than herd_stale ago — the signature of backoffs
+        # expiring during a blackout while the workers were wedged on the
+        # dead store) promotes the first herd_threshold and re-spreads the
+        # rest over U(0, herd_spread) so heal does not release the whole
+        # herd in one instant. herd_spread <= 0 disables the pacing.
+        self._herd_threshold = max(1, herd_threshold)
+        self._herd_spread = herd_spread
+        self._herd_stale = herd_stale
         #: Label for tpuc_queue_wait_seconds{queue}: controllers pass
         #: their name so saturation is attributable per queue.
         self.name = name
@@ -195,8 +212,30 @@ class RateLimitingQueue:
     # ------------------------------------------------------------------
     def _promote_ready(self, now: float) -> None:
         # caller holds the lock
+        stale_promoted = 0
         while self._delayed and self._delayed[0][0] <= now:
-            _, _, key, gen = heapq.heappop(self._delayed)
+            ready_t, _, key, gen = heapq.heappop(self._delayed)
+            if (
+                gen is not None
+                and self._herd_spread > 0
+                and now - ready_t > self._herd_stale
+            ):
+                # Backoff entry that expired a while ago — the workers
+                # were not draining when it came due (store blackout, a
+                # long stall). If a whole herd of them arrives in THIS
+                # pass, promote only the first herd_threshold and
+                # re-spread the rest with fresh jittered ready times:
+                # per-key decorrelated jitter spreads failures in time,
+                # but a blackout ALIGNS the expiries and heal would
+                # otherwise release them all in the same instant.
+                stale_promoted += 1
+                if stale_promoted > self._herd_threshold:
+                    self._seq += 1
+                    heapq.heappush(self._delayed, (
+                        now + self._rng.uniform(0.0, self._herd_spread),
+                        self._seq, key, gen,
+                    ))
+                    continue
             if gen is not None:
                 current = self._backoff_gen.get(key, 0)
                 left = self._backoff_pending.get(key, 1) - 1
