@@ -4,7 +4,7 @@
 PYTHON ?= python
 IMG ?= tpu-composer:latest
 
-.PHONY: all test test-fast bench bench-round manifests native lint lint-syntax analyze typecheck run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak migrate-soak brownout-soak conformance
+.PHONY: all test test-fast bench bench-round manifests native lint lint-syntax analyze typecheck run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak migrate-soak brownout-soak proc-smoke churn-bench conformance
 
 all: native test
 
@@ -141,6 +141,33 @@ brownout-soak:
 ## TPUC_TRACE_FILE / TPUC_FLEET_FILE dumped + uploaded on CI failure).
 shard-soak:
 	$(PYTHON) -m pytest tests/test_shard_failover.py -q -m shard -p no:randomly
+
+## proc-smoke: process-mode fleet smoke (tests/test_proc_fleet.py, markers
+## slow+proc): ProcFleet spawns FULL operator replicas as real OS
+## processes (python -m tpu_composer --shards K) against one served sim
+## apiserver + fake fabric. Two scenarios, both seeded and wall-bounded:
+## (1) kill -9 the replica owning the most in-flight intents mid-burst —
+## survivors must steal its shard leases within the lease bound, drain
+## every orphaned pending_op, converge all CRs Running with the
+## nonce-checked zero-double-attach invariant, and the merged per-pid
+## traces (victim's pre-kill /debug/traces snapshot + survivors' exit
+## dumps) must stitch into ONE connected flow across two real pids;
+## (2) a 2-process seeded mini-churn (TPUC_PROC_SMOKE_SEED overrides)
+## that must converge with per-replica artifacts (flight/trace/fleet/
+## port/log) present. TPUC_PROC_WORKDIR redirects the fleet workdir so
+## CI uploads the per-replica black boxes on failure.
+proc-smoke:
+	$(PYTHON) -m pytest tests/test_proc_fleet.py -q -m proc -p no:randomly
+
+## churn-bench: the macro-scale churn scaling curve (bench_proc_scaling):
+## one seeded churn plan replayed against 1/2/4 full operator replicas as
+## real OS processes over one served sim apiserver (50ms modeled RTT) —
+## placements/sec, queue-wait p50/p99, goodput ratio and reconciles-per-CR
+## per point. The committed round headline (BENCH_rNN.json extra.
+## proc_scaling) comes from bench-round; this target prints the full
+## curve standalone.
+churn-bench:
+	$(PYTHON) -c "import bench, json; print(json.dumps(bench.bench_proc_scaling(), indent=1))"
 
 ## watch-relay: poll the TPU tunnel relay; auto-capture the full on-chip
 ## probe to bench_artifacts/ the moment it answers (run at round start)

@@ -155,6 +155,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "proc: process-mode fleet soak (ProcFleet spawns full operator"
+        " replicas as real OS processes against the served sim apiserver"
+        " + fake fabric; kill -9 failover and mini-churn smoke; always"
+        " also marked slow; run with `make proc-smoke` or"
+        " `pytest -m proc`)",
+    )
+    config.addinivalue_line(
+        "markers",
         "brownout: dark-store brownout soak (randomized timed store"
         " blackouts + fabric brownout under churning load; the overload"
         " governor / store breaker / watchdog survival layer must ride"

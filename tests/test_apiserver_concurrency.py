@@ -1,0 +1,128 @@
+"""Multi-process hammer for the served sim apiserver (ISSUE 17 satellite).
+
+The proc-mode fleet points N real operator processes at ONE
+tpu_composer.sim.apiserver instance, so the fake's wire semantics must be
+atomic under genuine OS-level concurrency, not just under in-proc threads:
+
+- CAS atomicity: 4 worker PROCESSES race optimistic-concurrency increments
+  on one object. Every PUT carries the resourceVersion it read; the server
+  must admit exactly one writer per version (409 the rest), so the final
+  counter equals the sum of admitted increments — a lost update would
+  leave the counter short.
+- Watch ordering: a watcher streaming throughout the hammer must see the
+  object's resourceVersions strictly increase, with the final event
+  matching the stored object — interleaved mutations from four processes
+  must never reorder or tear the event stream.
+
+Tier-1 fast (no markers): the hammer is ~100 CAS wins across 4 processes,
+a couple of seconds end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import urllib.request
+
+from tpu_composer.sim.apiserver import FakeApiServer
+
+PREFIX = "/apis/test.dev/v1/counters"
+
+# Worker subprocess: pure stdlib so spawn cost stays milliseconds. Loops
+# optimistic-concurrency increments until it lands `wins` of them, then
+# prints its win count. argv: base_url, object_url, wins.
+_WORKER = r"""
+import json, sys, urllib.error, urllib.request
+
+base, url, wins = sys.argv[1], sys.argv[2], int(sys.argv[3])
+landed = 0
+while landed < wins:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        obj = json.load(resp)
+    obj["spec"]["count"] += 1
+    body = json.dumps(obj).encode()
+    req = urllib.request.Request(
+        url, data=body, method="PUT",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10):
+            landed += 1
+    except urllib.error.HTTPError as e:
+        if e.code != 409:
+            raise
+print(landed)
+"""
+
+
+def test_four_process_cas_hammer_loses_no_updates():
+    srv = FakeApiServer(
+        {PREFIX: {"kind": "Counter", "apiVersion": "test.dev/v1"}}
+    )
+    base = srv.start()
+    try:
+        srv.put_object(
+            PREFIX,
+            {"apiVersion": "test.dev/v1", "kind": "Counter",
+             "metadata": {"name": "shared"}, "spec": {"count": 0}},
+        )
+        obj_url = f"{base}{PREFIX}/shared"
+
+        # Watcher thread: stream every modification while the processes
+        # fight, recording each event's resourceVersion in arrival order.
+        rvs = []
+        watch_url = f"{base}{PREFIX}?watch=true&resourceVersion=0"
+        watcher_err = []
+
+        def watch():
+            try:
+                with urllib.request.urlopen(watch_url, timeout=60) as resp:
+                    for line in resp:
+                        ev = json.loads(line)
+                        rv = int(ev["object"]["metadata"]["resourceVersion"])
+                        rvs.append((ev["type"], rv, ev["object"]))
+                        if ev["object"].get("spec", {}).get("count") == 100:
+                            return
+            except Exception as e:  # surfaced in the main thread's assert
+                watcher_err.append(e)
+
+        wt = threading.Thread(target=watch, daemon=True)
+        wt.start()
+
+        workers = [
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER, base, obj_url, "25"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            for _ in range(4)
+        ]
+        landed = 0
+        for w in workers:
+            out, err = w.communicate(timeout=60)
+            assert w.returncode == 0, f"worker failed: {err}"
+            landed += int(out.strip())
+        assert landed == 100
+
+        # CAS atomicity: the counter holds every admitted increment.
+        with urllib.request.urlopen(obj_url, timeout=10) as resp:
+            final = json.load(resp)
+        assert final["spec"]["count"] == 100, (
+            f"lost updates: {final['spec']['count']} != 100"
+        )
+
+        wt.join(timeout=30)
+        assert not watcher_err, f"watcher died: {watcher_err[0]!r}"
+        assert not wt.is_alive(), "watcher never saw the final count"
+
+        # Watch ordering: resourceVersions strictly increase and the
+        # stream's last event is the stored final object.
+        seen = [rv for (_t, rv, _o) in rvs]
+        assert seen == sorted(set(seen)), (
+            f"watch stream reordered or duplicated versions: {seen}"
+        )
+        assert rvs[-1][2]["spec"]["count"] == 100
+        assert rvs[-1][1] == int(final["metadata"]["resourceVersion"])
+    finally:
+        srv.stop()

@@ -1,0 +1,92 @@
+"""Churn generator/simulator contract tests (ISSUE 17).
+
+The macro-scale churn layer (tpu_composer.sim.churn) must be boringly
+deterministic: the same seed yields byte-identical plans (trace digests)
+and identical simulation outputs, because the proc-scaling bench compares
+1/2/4-replica runs of ONE plan and a flaky generator would turn the curve
+into noise. And it must actually sustain macro scale — the ISSUE's
+acceptance floor is ≥5k nodes / ≥50k CRs in a bounded run.
+"""
+
+from __future__ import annotations
+
+from tpu_composer.sim.churn import (
+    ARRIVE,
+    CANCEL,
+    MIGRATE,
+    RESIZE,
+    generate_plan,
+    simulate,
+)
+
+
+def test_same_seed_same_plan():
+    a = generate_plan(seed=7, requests=500, duration_s=30.0, nodes=64)
+    b = generate_plan(seed=7, requests=500, duration_s=30.0, nodes=64)
+    assert a.trace_digest() == b.trace_digest()
+    assert a.events == b.events
+    c = generate_plan(seed=8, requests=500, duration_s=30.0, nodes=64)
+    assert c.trace_digest() != a.trace_digest()
+
+
+def test_plan_shape_and_ordering():
+    plan = generate_plan(seed=3, requests=300, duration_s=20.0, nodes=32)
+    counts = plan.counts()
+    assert counts[ARRIVE] == 300
+    assert {e.kind for e in plan.events} <= {ARRIVE, CANCEL, RESIZE, MIGRATE}
+    # Events are replayable in order: time-sorted, with arrivals first
+    # among same-instant events so a cancel never precedes its arrival.
+    times = [e.at_s for e in plan.events]
+    assert times == sorted(times)
+    born = set()
+    for e in plan.events:
+        if e.kind == ARRIVE:
+            born.add(e.name)
+        elif e.kind in (CANCEL, RESIZE):
+            assert e.name in born, f"{e.kind} before arrival: {e.name}"
+
+
+def test_simulate_deterministic():
+    plan = generate_plan(seed=11, requests=2000, duration_s=60.0, nodes=128)
+    first = simulate(plan)
+    second = simulate(plan)
+    assert first == second
+    assert first["digest"] == plan.trace_digest()
+
+
+def test_simulate_invariants_under_generous_capacity():
+    # Capacity >> demand: nothing ever queues, goodput is perfect.
+    plan = generate_plan(
+        seed=5, requests=200, duration_s=20.0, nodes=512, chips_per_node=8,
+        max_size=2, cancel_frac=0.0, resize_frac=0.0, migrate_frac=0.0,
+    )
+    out = simulate(plan)
+    assert out["arrivals"] == 200
+    assert out["placed_total"] == 200
+    assert out["still_queued"] == 0
+    assert out["queue_wait_p99_s"] == 0.0
+    assert out["goodput_ratio"] == 1.0
+
+
+def test_macro_scale_inventory():
+    """The ISSUE acceptance floor: a ≥5k-node / ≥50k-CR plan generates
+    and simulates deterministically in one bounded run."""
+    plan = generate_plan(
+        seed=17, requests=52_000, duration_s=600.0, nodes=6_000,
+        chips_per_node=4, max_size=4,
+    )
+    assert plan.counts()[ARRIVE] >= 50_000
+    assert plan.nodes >= 5_000
+    out = simulate(plan)
+    assert out["digest"] == plan.trace_digest()
+    assert 0.0 <= out["goodput_ratio"] <= 1.0
+    assert out["queue_wait_p99_s"] >= out["queue_wait_p50_s"]
+    # Bounds: a migrated member re-queues and re-places, so placements
+    # can exceed arrivals, but never by more than the migration count;
+    # live/queued/cancelled populations stay within the arrival set.
+    assert out["placed_total"] <= out["arrivals"] + out["migrated_members"]
+    assert out["still_running"] <= out["placed_total"]
+    assert (
+        out["still_running"] + out["still_queued"] <= out["arrivals"]
+    )
+    assert out["cancelled_before_place"] <= plan.counts().get(CANCEL, 0)

@@ -24,8 +24,17 @@ from tpu_composer.agent.nodeagent import (
 
 
 class FakeNodeAgent(NodeAgent):
-    def __init__(self, pool=None) -> None:
+    def __init__(self, pool=None, fabric=None, fabric_ttl_s: float = 0.05) -> None:
         self._pool = pool  # InMemoryPool or None
+        # Wire-mode visibility: when the pool lives in another process
+        # (proc-mode fleet, REST provider), chip enumeration follows the
+        # fabric's own attachment listing via provider.get_resources().
+        # A short TTL cache keeps visibility polls from hammering the
+        # fabric service during wide attach waves.
+        self._fabric = fabric  # FabricProvider or None
+        self._fabric_ttl_s = fabric_ttl_s
+        self._fabric_cache: Optional[Dict[str, Set[str]]] = None
+        self._fabric_cache_at = 0.0
         self._lock = threading.RLock()
         self._drivers: Dict[str, str] = {}  # node -> DriverType (default HOST)
         self._no_driver: Set[str] = set()
@@ -64,9 +73,34 @@ class FakeNodeAgent(NodeAgent):
                 return False
             if self._pool is not None:
                 attached = set(self._pool.attached_to(node))
+            elif self._fabric is not None:
+                attached = self._fabric_attached().get(node, set())
             else:
                 attached = self._visible.get(node, set())
             return bool(device_ids) and set(device_ids) <= attached
+
+    def _fabric_attached(self) -> Dict[str, Set[str]]:
+        """node -> attached device ids, via the wire provider (TTL-cached).
+        Caller holds self._lock."""
+        import time as _time
+
+        now = _time.monotonic()
+        if (
+            self._fabric_cache is None
+            or now - self._fabric_cache_at >= self._fabric_ttl_s
+        ):
+            try:
+                listing = self._fabric.get_resources()
+            except Exception:
+                if self._fabric_cache is not None:
+                    return self._fabric_cache  # stale beats a crashed poll
+                raise
+            out: Dict[str, Set[str]] = {}
+            for d in listing:
+                out.setdefault(d.node, set()).add(d.device_id)
+            self._fabric_cache = out
+            self._fabric_cache_at = now
+        return self._fabric_cache
 
     def check_no_loads(self, node: str, device_ids: List[str], group: str = "") -> bool:
         with self._lock:

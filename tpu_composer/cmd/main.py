@@ -64,9 +64,23 @@ SURVEY.md §5 "Config / flag system"):
                       K>1 = N replicas each own a hash partition of keys
   TPUC_SHARD_REPLICAS expected replica count (--shard-replicas): damps the
                       first replica's startup grab during a rolling deploy
+  TPUC_REPLICA_ID     stable replica identity (--replica-id) for member
+                      leases, fleet telemetry and trace process names;
+                      default is a fresh hostname_uuid per boot. The
+                      proc-mode supervisor (fleet/proc.py) pins one per
+                      spawned replica
+  TPUC_PORT_FILE      write {"pid","health_port","replica_id"} JSON here
+                      after startup (--port-file) — the supervisor's
+                      race-free discovery of a :0 health bind
   TPUC_LEASE_DURATION / TPUC_LEASE_RENEW_PERIOD
                       lease timing for both the single-leader and shard
                       electors (--lease-duration / --lease-renew-period)
+  TPUC_POLL_SCALE     multiplier over the reconcilers' lifecycle requeue
+                      cadences (attach/visibility/detach/busy/cleanup
+                      re-polls); 1.0 (default) = production cadences.
+                      Bench/smoke harnesses shrink it so throughput, not
+                      the polling latency floor, is what gets measured
+
   TPUC_MIGRATE        "0" disables the live-migration verb (--no-migrate):
                       no NodeMaintenance controller, no migration driver,
                       no node-escalation evacuation, and the defrag
@@ -121,6 +135,7 @@ Subcommands (dispatched before operator flag parsing):
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 import signal
@@ -178,6 +193,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=":8081",
         help="host:port for /healthz, /readyz and /metrics (empty to disable)",
     )
+    p.add_argument(
+        "--port-file",
+        default=os.environ.get("TPUC_PORT_FILE", ""),
+        help="after startup, write a JSON line {\"pid\", \"health_port\","
+             " \"replica_id\"} here. With a :0 health bind this is how a"
+             " supervisor (fleet/proc.py) discovers the real bound port"
+             " race-free (env TPUC_PORT_FILE)",
+    )
     # Secure metrics (reference cmd/main.go:109-127: HTTPS + authn/authz
     # filter; here TLS + bearer-token authorization from a mounted secret).
     p.add_argument(
@@ -234,6 +257,16 @@ def build_parser() -> argparse.ArgumentParser:
              " replica-1; live membership (renewing replicas) governs the"
              " balance target afterwards. 0 disables"
              " (env TPUC_SHARD_REPLICAS)",
+    )
+    p.add_argument(
+        "--replica-id",
+        default=os.environ.get("TPUC_REPLICA_ID", ""),
+        help="stable replica identity for shard/member leases, fleet"
+             " telemetry and trace process names. Default: a fresh"
+             " hostname_uuid per boot. Supervisors (fleet/proc.py) pin it"
+             " so /debug/fleet and trace-merge attribute real pids without"
+             " collision remapping across restarts"
+             " (env TPUC_REPLICA_ID)",
     )
     p.add_argument(
         "--lease-duration",
@@ -928,12 +961,17 @@ def pick_node_agent(store: Optional[Store] = None) -> NodeAgent:
         )
     if kind == "FAKE":
         # Wired to the mock pool when that is the provider, so visibility
-        # follows attachment in single-box/bench runs.
+        # follows attachment in single-box/bench runs. With a remote
+        # provider (proc-mode fleet: REST pool in another process) the
+        # agent instead follows the fabric's own attachment listing — the
+        # out-of-process analog of the same "chips enumerate once the
+        # fabric programs the link" behavior.
         provider = new_fabric_provider()
         from tpu_composer.fabric.inmem import InMemoryPool
 
-        pool = provider if isinstance(provider, InMemoryPool) else None
-        return FakeNodeAgent(pool=pool)
+        if isinstance(provider, InMemoryPool):
+            return FakeNodeAgent(pool=provider)
+        return FakeNodeAgent(fabric=provider)
     raise SystemExit(f"unknown NODE_AGENT {kind!r} (want FAKE or LOCAL)")
 
 
@@ -1121,6 +1159,9 @@ def build_manager(args: argparse.Namespace) -> Manager:
             expected_replicas=max(0, getattr(args, "shard_replicas", 0)),
             lease_duration_s=getattr(args, "lease_duration", 15.0),
             renew_period_s=getattr(args, "lease_renew_period", 5.0),
+            # Stable spawned-replica identity (proc-mode fleet): member
+            # lease, fleet telemetry and trace pid all share this name.
+            identity=getattr(args, "replica_id", "") or "",
         )
         ownership = shard_elector.ownership
         elector = shard_elector
@@ -1250,7 +1291,7 @@ def build_manager(args: argparse.Namespace) -> Manager:
 
         replica_id = (
             shard_elector.identity if shard_elector is not None
-            else default_identity()
+            else getattr(args, "replica_id", "") or default_identity()
         )
         # Every trace event this process records carries the replica
         # identity as its Chrome trace pid — what `tpu-composer
@@ -1359,6 +1400,7 @@ def build_manager(args: argparse.Namespace) -> Manager:
     from tpu_composer.controllers.request_controller import (
         MigrateConfig,
         RepairConfig,
+        RequestTiming,
     )
     from tpu_composer.controllers.resource_controller import ResourceTiming
     from tpu_composer.scheduler import ClusterScheduler, DefragLoop
@@ -1403,11 +1445,36 @@ def build_manager(args: argparse.Namespace) -> Manager:
         max_concurrent=max(1, getattr(args, "migrate_max_concurrent", 2)),
         breaker_fraction=getattr(args, "migrate_breaker_fraction", 0.25),
     )
+    # TPUC_POLL_SCALE: one multiplier over the reconcilers' lifecycle
+    # requeue cadences (attach/visibility/detach/busy/cleanup re-polls).
+    # Production runs at 1.0. The proc-mode harnesses (fleet/proc.py,
+    # bench_proc_scaling, make proc-smoke) shrink it so a real-process
+    # replica's measured throughput is its reconcile capacity, not the
+    # polling latency floor — the same cadences every in-proc bench tunes
+    # through RequestTiming/ResourceTiming directly. Event-driven safety
+    # nets (running_poll, health_poll) stay unscaled on purpose.
+    try:
+        poll_scale = float(os.environ.get("TPUC_POLL_SCALE", "") or 1.0)
+    except ValueError:
+        poll_scale = 1.0
+    poll_scale = max(0.001, poll_scale)
+    _rt, _qt = ResourceTiming(), RequestTiming()
     res_timing = ResourceTiming(
+        attach_poll=_rt.attach_poll * poll_scale,
+        visibility_poll=_rt.visibility_poll * poll_scale,
+        detach_poll=_rt.detach_poll * poll_scale,
+        detach_fast=_rt.detach_fast * poll_scale,
+        busy_poll=_rt.busy_poll * poll_scale,
         health_failure_threshold=getattr(args, "health_failure_threshold", 3),
         node_degrade_threshold=getattr(args, "node_degrade_threshold", 3),
     )
+    req_timing = RequestTiming(
+        updating_poll=_qt.updating_poll * poll_scale,
+        cleaning_poll=_qt.cleaning_poll * poll_scale,
+        repair_poll=_qt.repair_poll * poll_scale,
+    )
     req_rec = ComposabilityRequestReconciler(client, fabric,
+                                             timing=req_timing,
                                              recorder=mgr.recorder,
                                              scheduler=scheduler,
                                              repair=repair_cfg,
@@ -1779,6 +1846,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.leader_elect,
     )
     mgr.start(workers_per_controller=args.workers)
+    if getattr(args, "port_file", ""):
+        # Written AFTER start so a :0 health bind reports its real port; the
+        # tmp+rename makes the appearance of the file itself the readiness
+        # signal a supervisor polls on (no half-written JSON window).
+        doc = json.dumps({
+            "pid": os.getpid(),
+            "health_port": mgr.health_port,
+            "replica_id": mgr.replica_id,
+        })
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(doc + "\n")
+        os.replace(tmp, args.port_file)
     mgr.wait()
     if mgr.lost_leadership:
         log.error("exiting: leadership lost (restart to rejoin as standby)")

@@ -1,0 +1,10 @@
+"""tpu_composer.fleet — multi-process operator fleets.
+
+The proc-mode supervisor (``proc.py``) spawns N full cmd/main operator
+replicas as real OS processes against a shared wire-level store
+(tpu_composer.sim.apiserver) and a served fake fabric — the harness that
+finally measures the sharded control plane without the GIL in the frame.
+
+Distinct from tpu_composer.runtime.fleet (the fleet *telemetry* plane each
+replica runs); this package is the thing that launches the replicas.
+"""

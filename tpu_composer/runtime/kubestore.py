@@ -37,6 +37,7 @@ kubeconfig parsing) — no kubernetes client dependency.
 from __future__ import annotations
 
 import base64
+import http.client
 import json
 import logging
 import os
@@ -256,6 +257,12 @@ class KubeStore:
         namespace: Optional[str] = None,
     ) -> None:
         self._cfg = config or KubeConfig.load(kubeconfig)
+        # Per-thread persistent HTTP connection (keep-alive). A fresh
+        # TCP connect per request costs getaddrinfo + handshake + a
+        # server-side thread spawn — ~20% of reconcile-worker CPU under
+        # the proc-mode churn bench. Watches (stream=True) still get
+        # dedicated connections; this pool is for the short verbs only.
+        self._conn_local = threading.local()
         # Namespace for the namespaced kinds (Leases, FleetTelemetry):
         # cmd/main wires --namespace / TPUC_NAMESPACE through here; the
         # env read below is the fallback for direct constructions.
@@ -366,41 +373,92 @@ class KubeStore:
     ):
         url = self._cfg.host.rstrip("/") + path
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
-        if data is not None:
-            req.add_header("Content-Type", "application/json")
-        if self._cfg.token:
-            req.add_header("Authorization", f"Bearer {self._cfg.token}")
-        kwargs: Dict[str, Any] = {"timeout": timeout}
-        if url.startswith("https"):
-            kwargs["context"] = self._ssl_ctx
-        try:
-            resp = urllib.request.urlopen(req, **kwargs)
-        except urllib.error.HTTPError as e:
-            payload = e.read().decode(errors="replace")
-            try:
-                status = json.loads(payload)
-            except (ValueError, TypeError):
-                status = {"message": payload}
-            msg = f"{method} {path}: {e.code} {status.get('reason', '')} {status.get('message', '')}"
-            if e.code == 404:
-                raise NotFoundError(msg) from None
-            if e.code == 409:
-                if status.get("reason") == "AlreadyExists":
-                    raise AlreadyExistsError(msg) from None
-                raise ConflictError(msg) from None
-            raise StoreError(msg) from None
-        except (urllib.error.URLError, OSError) as e:
-            # Transport failures (apiserver unreachable, DNS, socket
-            # timeout) must surface as StoreError like every other API
-            # failure — callers' retry/absorb policies are typed on the
-            # Store exception hierarchy, not on urllib internals.
-            raise StoreError(f"{method} {path}: {e}") from None
         if stream:
-            return resp
-        payload = resp.read().decode()
-        return json.loads(payload) if payload else {}
+            # Watches hold their response open for minutes — they must
+            # not occupy (or be torn down with) the per-thread verb
+            # connection, so they go through urllib on a dedicated one.
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Accept", "application/json")
+            if data is not None:
+                req.add_header("Content-Type", "application/json")
+            if self._cfg.token:
+                req.add_header("Authorization", f"Bearer {self._cfg.token}")
+            kwargs: Dict[str, Any] = {"timeout": timeout}
+            if url.startswith("https"):
+                kwargs["context"] = self._ssl_ctx
+            try:
+                return urllib.request.urlopen(req, **kwargs)
+            except urllib.error.HTTPError as e:
+                raise self._http_error(method, path, e.code,
+                                       e.read().decode(errors="replace"))
+            except (urllib.error.URLError, OSError) as e:
+                # Transport failures (apiserver unreachable, DNS, socket
+                # timeout) must surface as StoreError like every other
+                # API failure — callers' retry/absorb policies are typed
+                # on the Store exception hierarchy, not on urllib
+                # internals.
+                raise StoreError(f"{method} {path}: {e}") from None
+        headers = {"Accept": "application/json"}
+        if data is not None:
+            headers["Content-Type"] = "application/json"
+        if self._cfg.token:
+            headers["Authorization"] = f"Bearer {self._cfg.token}"
+        # Keep-alive with one retry: a pooled connection the server
+        # idle-closed between requests surfaces as a transport error
+        # before any response bytes — retrying once on a fresh
+        # connection is the standard (urllib3-style) recovery. A
+        # failure on a brand-new connection is a real outage and
+        # propagates immediately.
+        for attempt in (0, 1):
+            conn = getattr(self._conn_local, "conn", None)
+            reused = conn is not None
+            if conn is None:
+                conn = self._new_connection(timeout)
+                self._conn_local.conn = conn
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            else:
+                conn.timeout = timeout
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read().decode(errors="replace")
+                code = resp.status
+            except (http.client.HTTPException, OSError) as e:
+                conn.close()
+                self._conn_local.conn = None
+                if reused and attempt == 0:
+                    continue
+                raise StoreError(f"{method} {path}: {e}") from None
+            if code >= 400:
+                raise self._http_error(method, path, code, payload)
+            return json.loads(payload) if payload else {}
+        raise StoreError(f"{method} {path}: retry fell through")  # unreachable
+
+    def _new_connection(self, timeout: float):
+        host = urllib.parse.urlsplit(self._cfg.host)
+        if host.scheme == "https":
+            return http.client.HTTPSConnection(
+                host.netloc, timeout=timeout, context=self._ssl_ctx
+            )
+        return http.client.HTTPConnection(host.netloc, timeout=timeout)
+
+    @staticmethod
+    def _http_error(method: str, path: str, code: int, payload: str):
+        """Map an apiserver error status to the Store exception hierarchy
+        (returned, not raised, so callers control the traceback)."""
+        try:
+            status = json.loads(payload)
+        except (ValueError, TypeError):
+            status = {"message": payload}
+        msg = f"{method} {path}: {code} {status.get('reason', '')} {status.get('message', '')}"
+        if code == 404:
+            return NotFoundError(msg)
+        if code == 409:
+            if status.get("reason") == "AlreadyExists":
+                return AlreadyExistsError(msg)
+            return ConflictError(msg)
+        return StoreError(msg)
 
     # ------------------------------------------------------------------
     # serde helpers

@@ -338,7 +338,10 @@ class _HealthHandler(_PlainTextHandler):
         total = len(events)
 
         def body(evts) -> bytes:
-            doc = {"traceEvents": evts, "displayTimeUnit": "ms"}
+            # Full merge-ready shape (process_name metadata + epoch_us):
+            # a SIGKILLed replica's pre-kill /debug/traces snapshot is its
+            # half of the cross-process failover merge.
+            doc = tracing.chrome_doc(evts)
             if len(evts) < total:
                 doc["truncated"] = total - len(evts)
             return json.dumps(doc).encode()

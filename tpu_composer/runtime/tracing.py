@@ -356,24 +356,30 @@ def _process_name_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     ]
 
 
-def export_chrome(events: Optional[List[Dict[str, Any]]] = None) -> str:
-    """Chrome trace-event format (the JSON Object flavor) — open in
-    chrome://tracing or https://ui.perfetto.dev. Flow events ("ph": s/f)
-    render as arrows connecting spans across threads.
-
-    The export carries two merge anchors the ring events themselves lack:
+def chrome_doc(events: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
+    """The Chrome JSON-Object trace document for ``events`` (default: the
+    ring), carrying the two merge anchors the ring events themselves lack:
     ``process_name`` metadata events for every replica-tagged pid, and a
     top-level ``metadata.epoch_us`` (the wall-clock instant of ts=0) so
     :func:`merge_chrome` can align files from processes whose monotonic
-    trace clocks started at different moments."""
+    trace clocks started at different moments. Every trace exit path —
+    file dumps, /debug/traces scrapes, pre-kill snapshots — must ship this
+    shape or its events merge unlabeled and unaligned."""
     if events is None:
         events = snapshot()
     epoch_us = time.time() * 1e6 - _now_us()
-    return json.dumps({
+    return {
         "traceEvents": _process_name_events(events) + events,
         "displayTimeUnit": "ms",
         "metadata": {"epoch_us": epoch_us},
-    })
+    }
+
+
+def export_chrome(events: Optional[List[Dict[str, Any]]] = None) -> str:
+    """Chrome trace-event format (the JSON Object flavor) — open in
+    chrome://tracing or https://ui.perfetto.dev. Flow events ("ph": s/f)
+    render as arrows connecting spans across threads."""
+    return json.dumps(chrome_doc(events))
 
 
 def write_file(path: Optional[str] = None) -> Optional[str]:

@@ -1,0 +1,15 @@
+"""tpu_composer.sim — the simulated-cluster layer.
+
+Wire-level fakes and workload generators that exist to exercise the real
+operator stack, promoted out of tests/ so they can be launched as standalone
+processes (the proc-mode fleet) and driven by benches:
+
+- ``apiserver``: the kube-apiserver fake speaking the real K8s wire protocol,
+  launchable via ``python -m tpu_composer.sim.apiserver`` (tests/fake_apiserver
+  re-exports it for the existing suites);
+- ``churn``: the deterministic, seeded macro-scale churn generator driving
+  thousands of concurrent ComposabilityRequests against a 5-10k-node
+  simulated inventory.
+
+Nothing here runs in production; cmd/main never imports it.
+"""

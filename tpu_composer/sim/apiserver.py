@@ -1,0 +1,874 @@
+"""In-process kube-apiserver fake speaking the real K8s wire protocol.
+
+The envtest analog for this repo (SURVEY.md §4 layer 1): the reference runs
+every controller suite against a real kube-apiserver+etcd spun up per suite
+(/root/reference/internal/controller/suite_test.go:357-385). We get the same
+fidelity boundary — controllers talk HTTP/JSON to a server enforcing apiserver
+semantics — without vendoring the binaries: this server implements
+
+- typed REST: POST/GET/PUT/DELETE on ``/apis/<group>/<version>/<plural>``
+  and ``/api/v1/nodes`` (core group);
+- the status subresource (``PUT .../status`` only persists status);
+- optimistic concurrency: stale ``resourceVersion`` → 409 Conflict,
+  duplicate create → 409 AlreadyExists (Status body with ``reason`` set the
+  way apimachinery does);
+- finalizer-gated deletion: DELETE with finalizers present marks
+  ``deletionTimestamp``; a PUT removing the last finalizer purges;
+- spec-change generation bump; system-owned uid/creationTimestamp;
+- ``?labelSelector=`` equality filtering on lists;
+- ``?watch=true`` chunked streaming watches with ``resourceVersion``
+  resume and JSON-per-line events, ADDED/MODIFIED/DELETED.
+
+Promoted from tests/fake_apiserver.py (which re-exports this module) so it
+is launchable as a standalone shared store for the proc-mode fleet
+(fleet/proc.py):
+
+    python -m tpu_composer.sim.apiserver --nodes 8 --url-file /tmp/api.json
+
+Concurrency contract (multi-process hardening): every rv allocation, object
+mutation, and watch-event publication happens under ``_State.lock``, so the
+event log is totally ordered by rv no matter how many client processes write
+in parallel; a CAS PUT observes-and-replaces atomically (lost updates are
+impossible — one of two racing writers gets 409 Conflict); the listen
+backlog is sized for whole fleets of replicas dialing at once.
+
+Used by test_kubestore.py for the full operator e2e on a cluster-shaped API,
+by bench.py's attach_cluster/proc_scaling benches, and by ProcFleet as the
+shared wire-level store under real-OS-process replicas.
+"""
+
+from __future__ import annotations
+
+import base64
+import collections
+import json
+import ssl
+import sys
+import threading
+import time
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Deque, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlparse
+
+#: Listen backlog. ThreadingHTTPServer's default request_queue_size of 5 is
+#: tuned for one polite in-process client; a 4-replica proc fleet (each with
+#: per-kind reflectors, lease renewers, and reconcile workers opening fresh
+#: connections) can burst far past it and see ECONNREFUSED. Real apiservers
+#: listen deep; so do we.
+_LISTEN_BACKLOG = 128
+
+#: Rolling cap on the wire-level request log. The log exists for
+#: cache-efficiency assertions in unit tests (thousands of entries at most);
+#: under a macro-scale churn bench it would otherwise grow without bound.
+_REQUEST_LOG_CAP = 100_000
+
+
+def _apply_jsonpatch(obj: Dict[str, Any], patch: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Minimal RFC 6902 apply (add/replace/remove) — what a real apiserver
+    does with a mutating webhook's JSONPatch response."""
+    out = json.loads(json.dumps(obj))
+    for op in patch:
+        parts = [p.replace("~1", "/").replace("~0", "~")
+                 for p in op["path"].lstrip("/").split("/")]
+        parent = out
+        for p in parts[:-1]:
+            parent = parent[int(p)] if isinstance(parent, list) else parent.setdefault(p, {})
+        leaf = parts[-1]
+        if op["op"] in ("add", "replace"):
+            if isinstance(parent, list):
+                if leaf == "-":
+                    parent.append(op["value"])
+                else:
+                    parent.insert(int(leaf), op["value"]) if op["op"] == "add" \
+                        else parent.__setitem__(int(leaf), op["value"])
+            else:
+                parent[leaf] = op["value"]
+        elif op["op"] == "remove":
+            if isinstance(parent, list):
+                parent.pop(int(leaf))
+            else:
+                parent.pop(leaf, None)
+        else:
+            raise ValueError(f"unsupported JSONPatch op {op['op']!r}")
+    return out
+
+
+def _status_body(code: int, reason: str, message: str) -> bytes:
+    return json.dumps(
+        {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Failure",
+            "code": code,
+            "reason": reason,
+            "message": message,
+        }
+    ).encode()
+
+
+class _Server(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a fleet-sized accept queue."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+    request_queue_size = _LISTEN_BACKLOG
+
+    def handle_error(self, request, client_address):  # pragma: no cover
+        # A SIGKILLed replica (proc-mode failover tests) tears down its
+        # sockets mid-response; the resulting BrokenPipe/ConnectionReset in
+        # the handler thread is expected churn, not a server bug. Everything
+        # else keeps the stock stderr traceback.
+        exc = sys.exc_info()[1]
+        if isinstance(exc, ConnectionError):
+            return
+        super().handle_error(request, client_address)
+
+
+class _State:
+    """The 'etcd' — one rv counter, objects by (prefix, name), watch fanout,
+    and a bounded per-prefix event log with a compaction horizon (real etcd
+    compacts; a watch resuming from before the horizon gets 410 Expired)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.rv = 0
+        # (path_prefix, name) -> object dict
+        self.objects: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        # watch subscribers: list of (path_prefix, queue-ish list, condition)
+        self.watchers: List[Tuple[str, List[Dict[str, Any]], threading.Condition]] = []
+        # True event history, exactly as etcd's WAL serves watch resumes:
+        # (rv, prefix, type, object). A resume within the horizon replays
+        # real events — including DELETED, which the current-state replay
+        # the pre-r5 fake did could never produce.
+        self.event_log: List[Tuple[int, str, str, Dict[str, Any]]] = []
+        # Watches resuming from rv <= compacted_rv are answered with an
+        # ERROR event carrying a 410 Status, like a compacted etcd.
+        self.compacted_rv = 0
+
+    def next_rv(self) -> int:
+        self.rv += 1
+        return self.rv
+
+    def notify(self, prefix: str, etype: str, obj: Dict[str, Any]) -> None:
+        # ONE immutable snapshot shared by the event log and every watcher
+        # buffer: callers hold self.lock, watch writers only serialize, and
+        # nothing mutates a published event — so the per-watcher deep-copy
+        # the pre-proc fake did was O(watchers × object) for nothing. With
+        # 4 process replicas each watching every kind, that constant
+        # matters at churn-bench rates.
+        snapshot = json.loads(json.dumps(obj))
+        event = {"type": etype, "object": snapshot}
+        self.event_log.append(
+            (int(snapshot["metadata"]["resourceVersion"]), prefix, etype, snapshot)
+        )
+        if len(self.event_log) > 10_000:
+            # Rolling auto-compaction, like etcd's: dropping history moves
+            # the 410 horizon forward, so long soaks stay bounded and
+            # clients resuming from far behind get the Expired persona.
+            dropped = self.event_log[:5_000]
+            self.event_log = self.event_log[5_000:]
+            self.compacted_rv = max(self.compacted_rv, dropped[-1][0])
+        for wprefix, buf, cond in list(self.watchers):
+            if wprefix == prefix:
+                with cond:
+                    buf.append(event)
+                    cond.notify_all()
+
+    def compact(self, up_to_rv: Optional[int] = None) -> None:
+        """Discard event history ≤ up_to_rv (default: everything so far).
+        The next watch resume from inside the discarded range gets 410."""
+        horizon = self.rv if up_to_rv is None else up_to_rv
+        self.compacted_rv = max(self.compacted_rv, horizon)
+        self.event_log = [e for e in self.event_log if e[0] > horizon]
+
+
+class FakeApiServer:
+    """HTTP kube-apiserver fake. ``resources`` maps path prefixes to config:
+
+        {"/apis/tpu.composer.dev/v1alpha1/composabilityrequests":
+             {"kind": "ComposabilityRequest"}, ...}
+
+    Start with ``start()``; ``url`` gives the base endpoint. Objects can be
+    seeded/inspected directly via ``put_object``/``get_object`` (the tests'
+    equivalent of kubectl).
+    """
+
+    def __init__(self, resources: Dict[str, Dict[str, Any]]) -> None:
+        self.resources = resources
+        self.state = _State()
+        self.fail_hooks: List[Any] = []  # callables (method, path) -> Optional[(code, reason, msg)]
+        # Wire-level request log [(method, path)] — the envtest-style probe
+        # for how chatty a client is (cache-efficiency assertions). Bounded:
+        # a macro-scale churn run would otherwise hold every request ever.
+        self.request_log: Deque[Tuple[str, str]] = collections.deque(
+            maxlen=_REQUEST_LOG_CAP
+        )
+        # Admission webhook registrations, called out over the wire exactly
+        # as a real apiserver would (the envtest WebhookInstallOptions
+        # analog — /root/reference/internal/webhook/v1alpha1/
+        # webhook_suite_test.go:74-144). Each entry:
+        #   {"prefix": <resource path prefix>, "url": <webhook endpoint>,
+        #    "operations": {"CREATE", "UPDATE"}}
+        # A denied review fails the API call with 403; a JSONPatch response
+        # is applied to the object before it is stored.
+        self.webhooks: List[Dict[str, Any]] = []
+        # Injected per-request latency (seconds) — models apiserver RTT for
+        # latency benchmarks. Applied once per HTTP request (streaming watch
+        # events after connect are push, not request/response).
+        self.latency_s: float = 0.0
+        # Live streaming-watch sockets, for the socket-kill persona
+        # (kill_watch_connections): a mid-stream TCP reset is how real
+        # apiserver restarts/LB failovers present to client watches.
+        self.active_watch_conns: List[Any] = []
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _deny(self, code: int, reason: str, message: str) -> None:
+                body = _status_body(code, reason, message)
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _ok(self, payload: Dict[str, Any], code: int = 200) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _route(self) -> Optional[Tuple[str, Optional[str], Dict[str, Any], bool]]:
+                """→ (prefix, name|None, resource_cfg, is_status)"""
+                parsed = urlparse(self.path)
+                path = unquote(parsed.path).rstrip("/")
+                for prefix, cfg in server.resources.items():
+                    if path == prefix:
+                        return prefix, None, cfg, False
+                    if path.startswith(prefix + "/"):
+                        rest = path[len(prefix) + 1 :]
+                        if rest.endswith("/status"):
+                            return prefix, rest[: -len("/status")], cfg, True
+                        if "/" not in rest:
+                            return prefix, rest, cfg, False
+                return None
+
+            def _maybe_fail(self) -> bool:
+                with server.state.lock:
+                    server.request_log.append((self.command, self.path))
+                if server.latency_s:
+                    time.sleep(server.latency_s)
+                # Snapshot: hooks are armed/disarmed from other threads
+                # (and, proc-mode, while many handler threads are in here).
+                for hook in list(server.fail_hooks):
+                    out = hook(self.command, self.path)
+                    if out:
+                        self._deny(*out)
+                        return True
+                return False
+
+            # ---- verbs ----
+            def do_GET(self) -> None:
+                if self._maybe_fail():
+                    return
+                routed = self._route()
+                if not routed:
+                    return self._deny(404, "NotFound", f"no route {self.path}")
+                prefix, name, cfg, _ = routed
+                qs = parse_qs(urlparse(self.path).query)
+                st = server.state
+                if name:
+                    with st.lock:
+                        obj = st.objects.get((prefix, name))
+                    if obj is None:
+                        return self._deny(404, "NotFound", f"{name} not found")
+                    return self._ok(obj)
+                if qs.get("watch", ["false"])[0] == "true":
+                    return self._watch(prefix, qs)
+                with st.lock:
+                    items = [
+                        o for (p, _), o in sorted(st.objects.items()) if p == prefix
+                    ]
+                    list_rv = st.rv
+                sel = qs.get("labelSelector", [None])[0]
+                if sel:
+                    pairs = dict(kv.split("=", 1) for kv in sel.split(","))
+                    items = [
+                        o
+                        for o in items
+                        if all(
+                            (o["metadata"].get("labels") or {}).get(k) == v
+                            for k, v in pairs.items()
+                        )
+                    ]
+                return self._ok(
+                    {
+                        "kind": cfg["kind"] + "List",
+                        "apiVersion": cfg.get("apiVersion", "v1"),
+                        # rv snapshotted under the same lock as the items:
+                        # a list must never advertise an rv newer than its
+                        # contents, or a watch resumed from it skips events
+                        # (only observable with parallel writer processes).
+                        "metadata": {"resourceVersion": str(list_rv)},
+                        "items": items,
+                    }
+                )
+
+            def _watch(self, prefix: str, qs: Dict[str, List[str]]) -> None:
+                st = server.state
+                since = int(qs.get("resourceVersion", ["0"])[0] or 0)
+                buf: List[Dict[str, Any]] = []
+                cond = threading.Condition()
+                expired = False
+                with st.lock:
+                    if since and since < st.compacted_rv:
+                        # Resume from inside the compacted range: a real
+                        # apiserver answers 200 + one ERROR event carrying a
+                        # 410 Status, then ends the watch. The client must
+                        # relist (this is the path envtest exercises that a
+                        # replay-current-state fake never can).
+                        expired = True
+                    elif since:
+                        # Faithful resume: replay the true event history —
+                        # including DELETED — exactly as etcd serves a watch
+                        # from a historical rv inside the horizon. Replay and
+                        # subscription happen under ONE lock hold, so a write
+                        # landing while we replay is either in the history we
+                        # replay or in the buffer we just subscribed — never
+                        # both, never neither (the lost-event/duplicate race
+                        # a 4-process hammer exposes immediately).
+                        for rv, p, etype, o in st.event_log:
+                            if p == prefix and rv > since:
+                                buf.append({"type": etype, "object": o})
+                        st.watchers.append((prefix, buf, cond))
+                    else:
+                        # No resume rv: current state as ADDED (legacy
+                        # list+watch-from-now shape).
+                        for (p, _), o in sorted(st.objects.items()):
+                            if p == prefix:
+                                buf.append(
+                                    {"type": "ADDED",
+                                     "object": json.loads(json.dumps(o))}
+                                )
+                        st.watchers.append((prefix, buf, cond))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def _write(evt: Dict[str, Any]) -> None:
+                    line = (json.dumps(evt) + "\n").encode()
+                    self.wfile.write(f"{len(line):x}\r\n".encode())
+                    self.wfile.write(line + b"\r\n")
+
+                if expired:
+                    try:
+                        _write({
+                            "type": "ERROR",
+                            "object": {
+                                "kind": "Status", "apiVersion": "v1",
+                                "status": "Failure", "code": 410,
+                                "reason": "Expired",
+                                "message": (
+                                    f"too old resource version: {since} "
+                                    f"({st.compacted_rv})"
+                                ),
+                            },
+                        })
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError, OSError):
+                        pass
+                    return
+                with st.lock:
+                    server.active_watch_conns.append(self.connection)
+                try:
+                    while not getattr(server, "_shutdown", False):
+                        with cond:
+                            if not buf:
+                                cond.wait(timeout=0.5)
+                            events, buf[:] = list(buf), []
+                        for evt in events:
+                            _write(evt)
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    with st.lock:
+                        st.watchers = [
+                            w for w in st.watchers if w[1] is not buf
+                        ]
+                        try:
+                            server.active_watch_conns.remove(self.connection)
+                        except ValueError:
+                            pass
+
+            def _read_body(self) -> Dict[str, Any]:
+                n = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def _admit(self, prefix: str, operation: str,
+                       obj: Dict[str, Any],
+                       old: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+                """Run registered webhooks over the wire. Returns the
+                (possibly patched) object, or None after sending a denial."""
+                for hook in list(server.webhooks):
+                    if hook["prefix"] != prefix:
+                        continue
+                    if operation not in hook.get("operations", {"CREATE", "UPDATE"}):
+                        continue
+                    review = {
+                        "apiVersion": "admission.k8s.io/v1",
+                        "kind": "AdmissionReview",
+                        "request": {
+                            "uid": str(uuid.uuid4()),
+                            "operation": operation,
+                            "object": obj,
+                            "oldObject": old,
+                        },
+                    }
+                    data = json.dumps(review).encode()
+                    req = urllib.request.Request(
+                        hook["url"], data=data, method="POST",
+                        headers={"Content-Type": "application/json"},
+                    )
+                    kwargs: Dict[str, Any] = {"timeout": 10}
+                    if hook["url"].startswith("https"):
+                        ctx = ssl.create_default_context()
+                        ctx.check_hostname = False
+                        ctx.verify_mode = ssl.CERT_NONE  # self-signed test certs
+                        kwargs["context"] = ctx
+                    try:
+                        with urllib.request.urlopen(req, **kwargs) as resp:
+                            out = json.loads(resp.read())
+                    except (OSError, ValueError) as e:
+                        # failurePolicy: Fail — the reference's default for
+                        # its validating webhook.
+                        self._deny(500, "InternalError",
+                                   f"webhook {hook['url']} unreachable: {e}")
+                        return None
+                    response = out.get("response") or {}
+                    if not response.get("allowed", False):
+                        msg = ((response.get("status") or {}).get("message")
+                               or "admission denied")
+                        self._deny(403, "Forbidden", msg)
+                        return None
+                    if response.get("patch"):
+                        patch = json.loads(
+                            base64.b64decode(response["patch"]))
+                        obj = _apply_jsonpatch(obj, patch)
+                return obj
+
+            def do_POST(self) -> None:
+                if self._maybe_fail():
+                    return
+                routed = self._route()
+                if not routed:
+                    return self._deny(404, "NotFound", f"no route {self.path}")
+                prefix, name, cfg, _ = routed
+                if name:
+                    return self._deny(405, "MethodNotAllowed", "POST to item")
+                obj = self._read_body()
+                meta = obj.setdefault("metadata", {})
+                oname = meta.get("name", "")
+                if not oname:
+                    return self._deny(422, "Invalid", "metadata.name required")
+                obj = self._admit(prefix, "CREATE", obj, None)
+                if obj is None:
+                    return  # webhook denied; response already sent
+                meta = obj.setdefault("metadata", {})
+                st = server.state
+                with st.lock:
+                    if (prefix, oname) in st.objects:
+                        return self._deny(
+                            409, "AlreadyExists", f"{oname} already exists"
+                        )
+                    meta["uid"] = meta.get("uid") or str(uuid.uuid4())
+                    meta["resourceVersion"] = str(st.next_rv())
+                    meta["generation"] = 1
+                    meta.setdefault(
+                        "creationTimestamp",
+                        time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    )
+                    meta.pop("deletionTimestamp", None)
+                    st.objects[(prefix, oname)] = obj
+                    st.notify(prefix, "ADDED", obj)
+                return self._ok(obj, 201)
+
+            def do_PUT(self) -> None:
+                if self._maybe_fail():
+                    return
+                routed = self._route()
+                if not routed:
+                    return self._deny(404, "NotFound", f"no route {self.path}")
+                prefix, name, cfg, is_status = routed
+                if not name:
+                    return self._deny(405, "MethodNotAllowed", "PUT to collection")
+                incoming = self._read_body()
+                st = server.state
+                # Admission sees spec updates, not status subresource writes
+                # (matching real webhook rules scoped to the main resource).
+                if not is_status:
+                    with st.lock:
+                        old = st.objects.get((prefix, name))
+                        old = json.loads(json.dumps(old)) if old else None
+                    incoming = self._admit(prefix, "UPDATE", incoming, old)
+                    if incoming is None:
+                        return
+                with st.lock:
+                    stored = st.objects.get((prefix, name))
+                    if stored is None:
+                        return self._deny(404, "NotFound", f"{name} not found")
+                    in_rv = str(incoming.get("metadata", {}).get("resourceVersion", ""))
+                    if in_rv and in_rv != stored["metadata"]["resourceVersion"]:
+                        return self._deny(
+                            409,
+                            "Conflict",
+                            f"resourceVersion {in_rv} != {stored['metadata']['resourceVersion']}",
+                        )
+                    new = json.loads(json.dumps(stored))
+                    if is_status:
+                        new["status"] = incoming.get("status", {})
+                    else:
+                        spec_changed = incoming.get("spec") != stored.get("spec")
+                        new["spec"] = incoming.get("spec", {})
+                        # mutable metadata
+                        im = incoming.get("metadata", {})
+                        for k in ("labels", "annotations", "finalizers", "ownerReferences"):
+                            if k in im:
+                                new["metadata"][k] = im[k]
+                            else:
+                                new["metadata"].pop(k, None)
+                        if spec_changed:
+                            new["metadata"]["generation"] = (
+                                int(stored["metadata"].get("generation", 1)) + 1
+                            )
+                    new["metadata"]["resourceVersion"] = str(st.next_rv())
+                    if (
+                        new["metadata"].get("deletionTimestamp")
+                        and not new["metadata"].get("finalizers")
+                    ):
+                        del st.objects[(prefix, name)]
+                        st.notify(prefix, "DELETED", new)
+                        return self._ok(new)
+                    st.objects[(prefix, name)] = new
+                    st.notify(prefix, "MODIFIED", new)
+                    return self._ok(new)
+
+            def do_DELETE(self) -> None:
+                if self._maybe_fail():
+                    return
+                routed = self._route()
+                if not routed:
+                    return self._deny(404, "NotFound", f"no route {self.path}")
+                prefix, name, cfg, _ = routed
+                if not name:
+                    return self._deny(405, "MethodNotAllowed", "DELETE collection")
+                st = server.state
+                with st.lock:
+                    stored = st.objects.get((prefix, name))
+                    if stored is None:
+                        return self._deny(404, "NotFound", f"{name} not found")
+                    if stored["metadata"].get("finalizers"):
+                        if not stored["metadata"].get("deletionTimestamp"):
+                            new = json.loads(json.dumps(stored))
+                            new["metadata"]["deletionTimestamp"] = time.strftime(
+                                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                            )
+                            new["metadata"]["resourceVersion"] = str(st.next_rv())
+                            st.objects[(prefix, name)] = new
+                            st.notify(prefix, "MODIFIED", new)
+                            return self._ok(new)
+                        return self._ok(stored)
+                    del st.objects[(prefix, name)]
+                    # Deletion is a write: the DELETED event carries a fresh
+                    # rv (etcd semantics) so watch resumes ordered after
+                    # older MODIFIEDs still replay it.
+                    stored = json.loads(json.dumps(stored))
+                    stored["metadata"]["resourceVersion"] = str(st.next_rv())
+                    st.notify(prefix, "DELETED", stored)
+                    return self._ok(stored)
+
+        self._handler_cls = Handler
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._shutdown = False
+
+    # ------------------------------------------------------------------
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self._httpd = _Server((host, port), self._handler_cls)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="fake-apiserver"
+        )
+        self._thread.start()
+        return self.url
+
+    @property
+    def url(self) -> str:
+        assert self._httpd
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        self._shutdown = True
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    # ------------------------------------------------------------------
+    # hostile-wire personas (VERDICT r4 missing #3)
+    # ------------------------------------------------------------------
+    def compact(self, up_to_rv: Optional[int] = None) -> None:
+        """Etcd compaction: discard watch history; resumes from inside the
+        discarded range get a 410 Expired ERROR event and must relist."""
+        with self.state.lock:
+            self.state.compact(up_to_rv)
+
+    def kill_watch_connections(self) -> int:
+        """Socket-level reset of every live streaming watch (no clean HTTP
+        end). Returns how many were killed."""
+        import socket as _socket
+
+        with self.state.lock:
+            conns = list(self.active_watch_conns)
+        for conn in conns:
+            try:
+                conn.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+        return len(conns)
+
+    def sever_watches(self, settle_s: float = 0.3) -> None:
+        """Kill live watch sockets until none remain for ``settle_s``.
+        Meant to run with a ``watch_blocker`` armed: reconnects are refused,
+        so quiescence is permanent — closes the race where a watch was
+        between reconnects (or mid-handshake) at the instant of a single
+        kill and survived into the 'gap'."""
+        quiet_since = None
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if self.kill_watch_connections():
+                quiet_since = None
+            else:
+                quiet_since = quiet_since or time.monotonic()
+                if time.monotonic() - quiet_since >= settle_s:
+                    return
+            time.sleep(0.02)
+
+    def watch_blocker(self):
+        """A fail-hook that 503s watch (re)connection attempts while armed —
+        appended to ``fail_hooks`` to hold the stream down during a gap:
+
+            unblock = srv.watch_blocker()
+            ... mutate world ...
+            unblock()
+        """
+        def hook(method: str, path: str):
+            if method == "GET" and "watch=true" in path:
+                return (503, "ServiceUnavailable", "watch blocked by test")
+            return None
+
+        self.fail_hooks.append(hook)
+
+        def unblock() -> None:
+            try:
+                self.fail_hooks.remove(hook)
+            except ValueError:
+                pass
+
+        return unblock
+
+    # ------------------------------------------------------------------
+    # test-side kubectl
+    # ------------------------------------------------------------------
+    def put_object(self, prefix: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """Seed/replace an object directly (bypasses conflict checks)."""
+        st = self.state
+        name = obj["metadata"]["name"]
+        with st.lock:
+            existed = (prefix, name) in st.objects
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("uid", str(uuid.uuid4()))
+            meta["resourceVersion"] = str(st.next_rv())
+            meta.setdefault("generation", 1)
+            meta.setdefault(
+                "creationTimestamp", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            )
+            st.objects[(prefix, name)] = obj
+            st.notify(prefix, "MODIFIED" if existed else "ADDED", obj)
+        return obj
+
+    def get_object(self, prefix: str, name: str) -> Optional[Dict[str, Any]]:
+        with self.state.lock:
+            obj = self.state.objects.get((prefix, name))
+            return json.loads(json.dumps(obj)) if obj else None
+
+    def delete_object(self, prefix: str, name: str) -> None:
+        st = self.state
+        with st.lock:
+            obj = st.objects.pop((prefix, name), None)
+            if obj:
+                obj = json.loads(json.dumps(obj))
+                obj["metadata"]["resourceVersion"] = str(st.next_rv())
+                st.notify(prefix, "DELETED", obj)
+
+
+def operator_resources(
+    group: str, version: str, namespace: str = "tpu-composer-system"
+) -> Dict[str, Dict[str, Any]]:
+    """The standard route map for operator-on-cluster harnesses — ONE
+    definition shared by the e2e fixtures, bench.py, and the proc-mode
+    fleet so a new published resource can't silently diverge between them.
+    ``namespace`` scopes the namespaced kinds (Leases, matching KubeStore's
+    --namespace / TPUC_NAMESPACE routing)."""
+    return {
+        f"/apis/{group}/{version}/composabilityrequests": {
+            "kind": "ComposabilityRequest", "apiVersion": f"{group}/{version}",
+        },
+        f"/apis/{group}/{version}/composableresources": {
+            "kind": "ComposableResource", "apiVersion": f"{group}/{version}",
+        },
+        "/api/v1/nodes": {"kind": "Node", "apiVersion": "v1"},
+        "/apis/resource.k8s.io/v1beta1/resourceslices": {
+            "kind": "ResourceSlice", "apiVersion": "resource.k8s.io/v1beta1",
+        },
+        "/apis/resource.k8s.io/v1alpha3/devicetaintrules": {
+            "kind": "DeviceTaintRule", "apiVersion": "resource.k8s.io/v1alpha3",
+        },
+        # The control-plane-infrastructure kinds: leader/shard Leases, fleet
+        # telemetry snapshots, maintenance drains. In-proc suites drive these
+        # through an in-memory Store, so the pre-proc fake never routed
+        # them — a full cmd/main replica over the wire needs all three.
+        "/apis/coordination.k8s.io/v1/namespaces/" + namespace + "/leases": {
+            "kind": "Lease", "apiVersion": "coordination.k8s.io/v1",
+        },
+        f"/apis/{group}/{version}/fleettelemetries": {
+            "kind": "FleetTelemetry", "apiVersion": f"{group}/{version}",
+        },
+        f"/apis/{group}/{version}/nodemaintenances": {
+            "kind": "NodeMaintenance", "apiVersion": f"{group}/{version}",
+        },
+    }
+
+
+def core_node_doc(name: str, chips: int = 4,
+                  chip_resource: str = "tpu.composer.dev/chips") -> Dict[str, Any]:
+    """A core-v1-shaped Node as kubelet would publish it."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "status": {
+            "allocatable": {
+                "cpu": "8",
+                "memory": "32Gi",
+                "ephemeral-storage": "100Gi",
+                "pods": "110",
+                chip_resource: str(chips),
+            },
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+# ----------------------------------------------------------------------
+# standalone launcher: python -m tpu_composer.sim.apiserver
+# ----------------------------------------------------------------------
+def main(argv: Optional[List[str]] = None) -> int:
+    """Serve the fake apiserver (and optionally a fake fabric) as a
+    standalone process — the shared store a ProcFleet of real operator
+    replicas dials into. Prints one JSON line with the bound URLs (and
+    writes it to --url-file for supervisors that redirect stdout)."""
+    import argparse
+    import signal
+    import sys
+
+    from tpu_composer import GROUP, VERSION
+
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_composer.sim.apiserver",
+        description="standalone kube-apiserver fake for proc-mode fleets",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0, help="0 = ephemeral")
+    p.add_argument("--namespace", default="tpu-composer-system",
+                   help="namespace for the namespaced routes (Leases)")
+    p.add_argument("--nodes", type=int, default=0,
+                   help="seed N Ready core-v1 Nodes (node-0000...)")
+    p.add_argument("--chips", type=int, default=4, help="chips per seeded node")
+    p.add_argument("--latency", type=float, default=0.0,
+                   help="injected per-request latency (seconds)")
+    p.add_argument("--fabric", action="store_true",
+                   help="also serve a fake fabric (REST pool dialect) backed"
+                        " by an InMemoryPool sized to the seeded inventory")
+    p.add_argument("--fabric-chips", default="",
+                   help="fabric pool inventory, MODEL=N[,MODEL=N...]"
+                        " (default: tpu-v4 sized to nodes*chips)")
+    p.add_argument("--url-file", default="",
+                   help="write the JSON discovery line here too")
+    args = p.parse_args(argv)
+
+    srv = FakeApiServer(operator_resources(GROUP, VERSION, args.namespace))
+    srv.latency_s = args.latency
+    srv.start(host=args.host, port=args.port)
+    for i in range(args.nodes):
+        srv.put_object(
+            "/api/v1/nodes", core_node_doc(f"node-{i:04d}", chips=args.chips)
+        )
+
+    fabric_url = None
+    fabric_srv = None
+    if args.fabric:
+        from tpu_composer.fabric.inmem import InMemoryPool
+        try:
+            from tests.fake_fabric import FakeFabricServer
+        except ImportError as e:
+            print(f"--fabric needs tests/fake_fabric.py importable "
+                  f"(run from the repo root): {e}", file=sys.stderr)
+            srv.stop()
+            return 2
+        if args.fabric_chips:
+            chips = {
+                m: int(n)
+                for m, n in (kv.split("=", 1)
+                             for kv in args.fabric_chips.split(","))
+            }
+        else:
+            chips = {"tpu-v4": max(args.nodes, 1) * args.chips}
+        fabric_srv = FakeFabricServer(pool=InMemoryPool(chips=chips))
+        fabric_url = fabric_srv.url
+
+    discovery = {
+        "apiserver": srv.url,
+        "fabric": fabric_url,
+        "namespace": args.namespace,
+        "nodes": args.nodes,
+    }
+    line = json.dumps(discovery)
+    print(line, flush=True)
+    if args.url_file:
+        with open(args.url_file, "w") as f:
+            f.write(line + "\n")
+
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    try:
+        while not done.wait(0.5):
+            pass
+    finally:
+        if fabric_srv is not None:
+            fabric_srv.close()
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
