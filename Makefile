@@ -37,6 +37,7 @@ ROUND ?= r06
 bench-round:
 	$(PYTHON) bench.py | tail -n 1 > BENCH_$(ROUND).json
 	@$(PYTHON) -c "import json; d=json.load(open('BENCH_$(ROUND).json')); print('BENCH_$(ROUND).json:', d['metric'], d['value'], d['unit'])"
+	$(PYTHON) -c "import bench; bench.assert_round_gates('BENCH_$(ROUND).json')"
 
 ## perf-smoke: fast CI gate — count-based assertions (cache-on vs
 ## cache-off store round trips per attach through the cluster path, and a

@@ -327,6 +327,17 @@ def build_parser() -> argparse.ArgumentParser:
              " identical, latency is not)",
     )
     p.add_argument(
+        "--native-sched",
+        action=argparse.BooleanOptionalAction,
+        default=None,
+        help="run placement decisions on the watch-maintained chip-index"
+             " snapshot, scanned by the native kernel"
+             " (native/libtpusched.so) when built, else a bit-identical"
+             " pure-Python port. --no-native-sched or TPUC_NATIVE_SCHED=0"
+             " restores the legacy per-decision store walks. Default:"
+             " enabled (env TPUC_NATIVE_SCHED)",
+    )
+    p.add_argument(
         "--fabric-batch",
         action=argparse.BooleanOptionalAction,
         default=os.environ.get("TPUC_FABRIC_BATCH", "1") != "0",
@@ -1412,6 +1423,14 @@ def build_manager(args: argparse.Namespace) -> Manager:
     scheduler = ClusterScheduler(
         client, defrag_mode="migrate" if migrate_on else "delete",
         decisions=decisions_on, recorder=mgr.recorder,
+        native_sched=getattr(args, "native_sched", None),
+    )
+    # Which kernel decisions will actually run on: "native" (packed
+    # snapshot + libtpusched.so), "python" (snapshot, pure-Python port),
+    # or "legacy" (per-decision store walks) — the fallback chain is
+    # silent by design, so say where it landed.
+    logging.getLogger("setup").info(
+        "placement engine kernel: %s", scheduler.engine.kernel_kind
     )
     if scheduler.ledger is not None:
         # /debug/scheduler/explain/<name> + the crash-hook dump handle.

@@ -48,10 +48,12 @@ from tpu_composer.runtime.metrics import (
     scheduler_time_to_placement_seconds,
 )
 from tpu_composer.scheduler import ledger as ledger_mod
+from tpu_composer.scheduler import native as sched_native
 from tpu_composer.scheduler.defrag import DefragPlanner
 from tpu_composer.scheduler.ledger import DecisionLedger, DecisionRecord
 from tpu_composer.scheduler.placement import AllocationError, PlacementEngine
 from tpu_composer.scheduler.preemption import Preemptor
+from tpu_composer.scheduler.snapshot import ChipIndexSnapshot
 from tpu_composer.scheduler.queue import PendingEntry, SchedulerQueue
 from tpu_composer.topology.slices import SliceShape
 
@@ -59,7 +61,10 @@ from tpu_composer.topology.slices import SliceShape
 #: maps into every record — past the cap the digest keeps the distribution
 #: (free-ports -> host count) instead of the per-node map.
 _DIGEST_NODE_CAP = 64
-_CANDIDATE_CAP = 64
+# The ledger owns the candidates-per-record truncation policy; the
+# scheduler threads it into the engine's verdict scan so no more than
+# this many candidate dicts are ever materialized per decision.
+_CANDIDATE_CAP = ledger_mod.CANDIDATE_CAP
 
 
 @dataclass
@@ -92,9 +97,31 @@ class ClusterScheduler:
         defrag_mode: str = "delete",
         decisions: bool = True,
         recorder=None,  # duck-typed EventRecorder for ledger events
+        native_sched: Optional[bool] = None,  # None = TPUC_NATIVE_SCHED
     ) -> None:
         self.store = store
-        self.engine = PlacementEngine(store)
+        # Snapshot + native-kernel layer (--native-sched, default on):
+        # incrementally-maintained packed arrays replace the per-decision
+        # store walks, and the fit/verdict/victim scans run in
+        # native/tpusched.cc when built. The snapshot declines stores it
+        # cannot watch losslessly (e.g. chaos wrappers) and the kernel
+        # declines to load when the .so is absent — each falls back one
+        # layer with bit-identical decisions.
+        if native_sched is None:
+            native_sched = sched_native.native_sched_enabled()
+        self.snapshot: Optional[ChipIndexSnapshot] = None
+        native = None
+        if native_sched:
+            try:
+                snap = ChipIndexSnapshot(store)
+            except Exception:
+                snap = None
+            if snap is not None and snap.active:
+                self.snapshot = snap
+                native = sched_native.native_lib()
+        self.engine = PlacementEngine(
+            store, snapshot=self.snapshot, native=native
+        )
         self.queue = SchedulerQueue()
         self.preemptor = Preemptor(store, self.engine)
         # THE allocation lock: the request controller serializes its
@@ -169,6 +196,7 @@ class ClusterScheduler:
                 req, ledger_mod.KIND_PLACE, demand, nodes, quarantined,
                 occupied, used, chips=shape.chips_per_host, ctx=ctx,
             )
+            self._assume(req.name, nodes, shape.chips_per_host)
         return Placement(nodes=nodes)
 
     def place_scalar(
@@ -252,6 +280,8 @@ class ClusterScheduler:
                 chips=probe_chips, ctx=ctx,
                 exclude=set(exclude),
             )
+            if self.snapshot is not None:
+                self.snapshot.assume(req.name, add)
         return nodes
 
     def _admit(
@@ -329,7 +359,21 @@ class ClusterScheduler:
                     quarantined, occupied, used,
                     chips=shape.chips_per_host, ctx=ctx, exclude=exclude,
                 )
+            self._assume(req.name, nodes, shape.chips_per_host)
         return nodes
+
+    def _assume(self, request: str, nodes, chips_per_host: int) -> None:
+        """Fold a just-granted placement into the snapshot (no-op without
+        one): on an async watch store the placeholder rows the controller
+        is about to write are not visible yet, and the next decision under
+        the lock must not double-book the granted capacity. Superseded by
+        the request's real rows when the watch delivers them."""
+        if self.snapshot is None:
+            return
+        claims: Dict[str, int] = {}
+        for n in nodes:
+            claims[n] = claims.get(n, 0) + chips_per_host
+        self.snapshot.assume(request, claims)
 
     def forget(self, name: str) -> None:
         """Drop a request from the pending queue (deletion path)."""
@@ -392,6 +436,7 @@ class ClusterScheduler:
                 0, n.status.tpu_slots - occupied.get(n.metadata.name, 0)
             )
         digest: Dict[str, object] = {
+            "engine": self.engine.kernel_kind,
             "schedulable_hosts": len(free_by_node),
             "free_chips": sum(free_by_node.values()),
             "fragmentation": round(
@@ -416,8 +461,9 @@ class ClusterScheduler:
         if self.ledger is None:
             return
         candidates = self.engine.candidate_verdicts(
-            req, chips, quarantined, used, exclude=exclude
-        )[:_CANDIDATE_CAP]
+            req, chips, quarantined, used, exclude=exclude,
+            cap=_CANDIDATE_CAP,
+        )
         tiebreak = self.engine.tiebreak_rationale(nodes, used)
         rec = DecisionRecord(
             request=req.name,
@@ -593,8 +639,9 @@ class ClusterScheduler:
             demand=demand,
             inputs=self._inputs_digest(quarantined, occupied),
             candidates=self.engine.candidate_verdicts(
-                req, demand["chips_per_host"], quarantined, used
-            )[:_CANDIDATE_CAP],
+                req, demand["chips_per_host"], quarantined, used,
+                cap=_CANDIDATE_CAP,
+            ),
             victims=list(victims),
             victim_rationale=rationale,
             binding=search,

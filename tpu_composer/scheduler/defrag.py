@@ -561,6 +561,12 @@ class DefragLoop:
         report: Dict[str, object] = {
             "at": now_iso(),
             "mode": self.planner.mode,
+            # Which capacity accounting the plan read ("native"/"python" =
+            # the watch-maintained snapshot, "legacy" = store walks) — a
+            # stale-snapshot suspicion starts by checking this.
+            "engine": getattr(
+                self.planner.engine, "kernel_kind", "legacy"
+            ),
             "execute": self.execute,
             "frozen": False,
             "frag_before": plan.frag_before,
@@ -612,6 +618,9 @@ class DefragLoop:
         plan = self.planner.plan()
         return {
             "mode": self.planner.mode,
+            "engine": getattr(
+                self.planner.engine, "kernel_kind", "legacy"
+            ),
             "execute": self.execute,
             "frozen": False,
             "dry_run": {

@@ -79,6 +79,14 @@ OUTCOME_PREEMPTING = "preempting"
 OUTCOME_SKIPPED = "skipped"
 OUTCOME_EVACUATING = "evacuating"
 
+#: How many candidate verdicts a record keeps. The ledger owns this
+#: truncation policy, and the scheduler passes it DOWN into the engine's
+#: verdict scan (``candidate_verdicts(..., cap=CANDIDATE_CAP)``) so only
+#: this many per-node dicts are ever materialized — truncating after a
+#: full O(nodes) materialization was half the decision-plane overhead
+#: BENCH_r10 measured.
+CANDIDATE_CAP = 64
+
 
 @dataclass
 class DecisionRecord:
@@ -358,6 +366,10 @@ class DecisionLedger:
             records = [r.to_doc() for r in ring]
         return {
             "request": name,
+            # Which kernel produced the latest decision ("native" /
+            # "python" / "legacy") — surfaced at the top so a triage of a
+            # surprising placement starts from which engine layer ran it.
+            "engine": records[-1].get("inputs", {}).get("engine", ""),
             "latest": records[-1],
             "decisions": records,
         }

@@ -1,0 +1,174 @@
+"""ctypes binding to the native placement kernel (native/tpusched.cc).
+
+Loads ``libtpusched.so`` from (in order) $TPUSCHED_LIB, the repo's
+``native/build`` directory, or the system loader. Returns None when
+absent so the engine falls back to the pure-Python kernel in
+scheduler/snapshot.py with bit-identical decisions (differential-fuzzed
+in tests/test_native_sched.py) — the library is an optimization for the
+O(cluster) scans under the allocation lock, not a requirement.
+
+``TPUC_NATIVE_SCHED=0`` disables the whole native-scheduler layer
+(snapshot AND kernel); the scheduler then runs the legacy store-walk
+engine unchanged.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import List, Optional, Tuple
+
+_lock = threading.Lock()
+_loaded = False
+_lib: Optional["_NativeLib"] = None
+
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def native_sched_enabled() -> bool:
+    """The master switch for the snapshot + native-kernel layer."""
+    return os.environ.get("TPUC_NATIVE_SCHED", "1") != "0"
+
+
+class _NativeLib:
+    def __init__(self, cdll: ctypes.CDLL) -> None:
+        self._c = cdll
+        self._c.tpus_version.restype = ctypes.c_int
+        self._c.tpus_scan.restype = ctypes.c_int32
+        self._c.tpus_scan.argtypes = [
+            ctypes.c_int32,
+            _I32P, _I32P, _I32P, _U8P,
+            _I64P, _I64P, _I64P, _I64P,
+            ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32,
+            _I32P, _I32P, _I32P, _I32P,
+        ]
+        self._c.tpus_victims.restype = ctypes.c_int32
+        self._c.tpus_victims.argtypes = [
+            ctypes.c_int32,
+            _I32P, _I32P, _U8P,
+            _I64P, _I64P, _I64P, _I64P,
+            ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32,
+            _I64P, _I64P, _I32P,
+            _I32P, _I32P, _I32P,
+            ctypes.c_int32, ctypes.c_int32,
+            _I32P, _I64P,
+        ]
+
+    def version(self) -> int:
+        return int(self._c.tpus_version())
+
+    def scan(
+        self, n, slots, used, hidx, flags, cpu, mem, eph, pods,
+        other, chips: int, count: int,
+    ):
+        """Mirror of snapshot.py's py_scan over the same packed arrays:
+        returns (num_ok, out_free, out_verdict, out_order, sel) with
+        sel=None when no selection was requested or fewer than ``count``
+        nodes fit. Raises OSError on a kernel-reported argument error so
+        the caller can fall back to the Python path."""
+        out_free = (ctypes.c_int32 * n)()
+        out_verdict = (ctypes.c_int32 * n)()
+        out_order = (ctypes.c_int32 * n)()
+        out_sel = (ctypes.c_int32 * max(1, count))()
+        num_ok = self._c.tpus_scan(
+            n, slots, used, hidx, flags, cpu, mem, eph, pods,
+            1 if other is not None else 0,
+            other.milli_cpu if other is not None else 0,
+            other.memory if other is not None else 0,
+            other.ephemeral_storage if other is not None else 0,
+            other.allowed_pod_number if other is not None else 0,
+            chips, count,
+            out_free, out_verdict, out_order, out_sel,
+        )
+        if num_ok < 0:
+            raise OSError("tpus_scan rejected its arguments")
+        sel = None
+        if count >= 1 and num_ok >= count:
+            sel = [out_sel[i] for i in range(count)]
+        return num_ok, out_free, out_verdict, out_order, sel
+
+    def victims(
+        self, n, slots, used, usable, cpu, mem, eph, pods,
+        other, chips: int, num_hosts: int,
+        target_mode: int, target_idx: int,
+        cand_prio, cand_chips, cand_rank,
+        freed_off, freed_idx, freed_amt,
+        max_exh_cands: int, max_exh_size: int,
+    ) -> Tuple[List[int], dict]:
+        """Returns (victim candidate indices, last_search-shaped info).
+        Raises OSError on a kernel-reported argument error."""
+        ncand = len(cand_rank)
+        out_sel = (ctypes.c_int32 * max(1, ncand))()
+        out_info = (ctypes.c_int64 * 4)()
+        nv = self._c.tpus_victims(
+            n, slots, used, usable, cpu, mem, eph, pods,
+            1 if other is not None else 0,
+            other.milli_cpu if other is not None else 0,
+            other.memory if other is not None else 0,
+            other.ephemeral_storage if other is not None else 0,
+            other.allowed_pod_number if other is not None else 0,
+            chips, num_hosts, target_mode, target_idx,
+            ncand, cand_prio, cand_chips, cand_rank,
+            freed_off, freed_idx, freed_amt,
+            max_exh_cands, max_exh_size,
+            out_sel, out_info,
+        )
+        if nv < 0:
+            raise OSError("tpus_victims rejected its arguments")
+        mode = int(out_info[0])
+        if mode == 1:
+            info = {
+                "mode": "exhaustive",
+                "candidates": ncand,
+                "set_size": int(out_info[1]),
+                "victim_priority_sum": int(out_info[2]),
+                "victim_chips": int(out_info[3]),
+            }
+        elif mode == 2:
+            info = {
+                "mode": "greedy+prune",
+                "candidates": ncand,
+                "set_size": int(out_info[1]),
+            }
+        else:
+            info = {"mode": "infeasible", "candidates": ncand}
+        return [out_sel[i] for i in range(nv)], info
+
+
+def _candidate_paths() -> List[str]:
+    paths = []
+    env = os.environ.get("TPUSCHED_LIB")
+    if env:
+        paths.append(env)
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    paths.append(os.path.join(here, "native", "build", "libtpusched.so"))
+    paths.append("libtpusched.so")
+    return paths
+
+
+def native_lib() -> Optional[_NativeLib]:
+    """Load (once) and return the native library, or None. The
+    TPUC_NATIVE_SCHED=0 kill switch is enforced by the caller
+    (ClusterScheduler) — the load result is cached process-wide and must
+    not capture a transient env state."""
+    global _loaded, _lib
+    with _lock:
+        if _loaded:
+            return _lib
+        _loaded = True
+        for path in _candidate_paths():
+            try:
+                _lib = _NativeLib(ctypes.CDLL(path))
+                return _lib
+            except (OSError, AttributeError):
+                continue
+        return None

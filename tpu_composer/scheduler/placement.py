@@ -39,6 +39,7 @@ from tpu_composer.api.types import (
     Node,
 )
 from tpu_composer.fabric.provider import FabricError
+from tpu_composer.scheduler import snapshot as snap_mod
 from tpu_composer.topology.slices import SliceShape
 
 
@@ -71,10 +72,45 @@ class PlacementEngine:
     write-response folding in the client preserves the
     placeholders-visible-under-the-lock invariant the docstring above
     relies on.
+
+    With a :class:`~tpu_composer.scheduler.snapshot.ChipIndexSnapshot`
+    attached (ClusterScheduler wires one unless TPUC_NATIVE_SCHED=0), the
+    capacity views come from incrementally-maintained accounting instead
+    of store walks, and the fit search / candidate-verdict scan run over
+    the snapshot's packed arrays — through the native kernel
+    (native/tpusched.cc) when loaded, else the bit-identical pure-Python
+    port. One scan serves both the host selection and the decision
+    ledger's candidate doc (the retained-scan reuse in
+    candidate_verdicts), which is what brought the decision-plane
+    overhead back under the perf-smoke gate.
     """
 
-    def __init__(self, store) -> None:
+    def __init__(self, store, snapshot=None, native=None) -> None:
         self.store = store
+        #: ChipIndexSnapshot or None (legacy store-walk engine).
+        self.snapshot = snapshot
+        #: scheduler.native._NativeLib or None (pure-Python kernel).
+        self.native = native
+        # The last packed scan (fit search or verdict scan) and its
+        # identity key — candidate_verdicts reuses it when the decision
+        # inputs are unchanged instead of re-scanning the cluster.
+        self._last_scan: Optional[tuple] = None
+        #: "native" | "python" | "legacy" — which kernel produced the last
+        #: selection (observability: cmd/main logs it, bench records it).
+        self.last_scan_kind = "legacy"
+
+    def _snap(self):
+        s = self.snapshot
+        return s if s is not None and s.active else None
+
+    @property
+    def kernel_kind(self) -> str:
+        """Which engine layer decisions run on: "native" (packed snapshot
+        + C kernel), "python" (packed snapshot, pure-Python kernel), or
+        "legacy" (per-decision store walks)."""
+        if self._snap() is None:
+            return "legacy"
+        return "native" if self.native is not None else "python"
 
     # ------------------------------------------------------------------
     # capacity accounting
@@ -103,6 +139,10 @@ class PlacementEngine:
         Allocation holds the controller's lock, so per-candidate rescans
         would serialize the whole fleet behind O(N*R) work — hence both
         maps from one pass."""
+        snap = self._snap()
+        if snap is not None:
+            snap.sync()
+            return snap.capacity_views(exclude_request)
         occupied: Dict[str, int] = {}
         without: Dict[str, int] = {}
         existing = {c.name: c for c in self.store.list(ComposableResource)}
@@ -258,6 +298,22 @@ class PlacementEngine:
         between the two."""
         if used is None:
             used = self.used_slots_map(req.name)
+        if count < 1:
+            return []
+        snap = self._snap()
+        if snap is not None:
+            num_ok, _free, _verd, _order, sel = self._kernel_scan(
+                req, shape.chips_per_host, quarantined, exclude, used,
+                count, snap,
+            )
+            if sel is None:
+                raise AllocationError(
+                    f"need {count} {'more ' if exclude else ''}hosts with"
+                    f" {shape.chips_per_host} free TPU ports for"
+                    f" {shape.topology}, only {num_ok} available"
+                )
+            names = snap.names
+            return [names[i] for i in sel]
         candidates = [
             n for n in self.store.list(Node)
             if n.metadata.name not in exclude
@@ -384,6 +440,78 @@ class PlacementEngine:
         return fresh[:count]
 
     # ------------------------------------------------------------------
+    # packed-array kernel dispatch (snapshot attached): native scan when
+    # the library is loaded, bit-identical pure-Python port otherwise
+    # ------------------------------------------------------------------
+    def _scan_inputs_key(self, chips, quarantined, exclude, used, other, snap):
+        """Identity of one scan's inputs. ``used`` rides by object id:
+        within one snapshot version the capacity views for a given exclude
+        set are deterministic, and exclude/quarantine are in the key, so
+        an id collision across decisions can only alias an identical
+        scan."""
+        okey = None if other is None else (
+            other.milli_cpu, other.memory,
+            other.ephemeral_storage, other.allowed_pod_number,
+        )
+        return (
+            chips, tuple(sorted(quarantined)), tuple(sorted(exclude)),
+            id(used), snap.version, okey,
+        )
+
+    def _kernel_scan(self, req, chips, quarantined, exclude, used, count, snap):
+        """One pass over the packed snapshot: per-node free + verdict
+        codes, the candidate ordering, and (count >= 1) the selected host
+        indices. The scan is retained so candidate_verdicts for the same
+        decision reuses it instead of walking the cluster again."""
+        snap.ensure_dense()
+        n = len(snap.names)
+        used_arr = snap.pack_used(used)
+        flags = snap.pack_flags(quarantined, exclude)
+        other = req.spec.resource.other_spec
+        res = None
+        if self.native is not None:
+            try:
+                res = self.native.scan(
+                    n, snap._slots, used_arr, snap._hidx, flags,
+                    snap._cpu, snap._mem, snap._eph, snap._pods,
+                    other, chips, count,
+                )
+                self.last_scan_kind = "native"
+            except OSError:
+                res = None
+        if res is None:
+            res = snap_mod.py_scan(
+                n, snap._slots, used_arr, snap._hidx, flags,
+                snap._cpu, snap._mem, snap._eph, snap._pods,
+                other, chips, count,
+            )
+            self.last_scan_kind = "python"
+        key = self._scan_inputs_key(chips, quarantined, exclude, used,
+                                    other, snap)
+        self._last_scan = (key, list(snap.names), res)
+        return res
+
+    def _scan_candidates(self, names, res, chips, cap=None):
+        """Materialize the candidates-considered doc from a retained scan
+        — only the first ``cap`` dicts when the ledger will truncate
+        anyway (the O(nodes)-dicts materialization was half the decision-
+        plane regression BENCH_r10 measured)."""
+        _num_ok, free, verd, order, _sel = res
+        total = len(order) if cap is None else min(cap, len(order))
+        out: List[Dict[str, object]] = []
+        for k in range(total):
+            i = order[k]
+            v = verd[i]
+            if v == snap_mod.V_NO_PORTS:
+                vs = f"no-tpu-ports free={free[i]} need={chips}"
+            else:
+                vs = snap_mod.VERDICT_STR[v]
+            out.append({
+                "node": names[i], "free": int(free[i]), "verdict": vs,
+            })
+        return out
+
+    # ------------------------------------------------------------------
     # decision-ledger explain helpers (never on the hot path: built only
     # when the scheduler's DecisionLedger is enabled)
     # ------------------------------------------------------------------
@@ -428,11 +556,29 @@ class PlacementEngine:
         quarantined: Set[str],
         used: Dict[str, int],
         exclude: Set[str] = frozenset(),
+        cap: Optional[int] = None,
     ) -> List[Dict[str, object]]:
         """Every node's verdict for one worker's chip group — the
         candidates-considered section of a DecisionRecord. Sorted fitting
         nodes first (tightest-fit order, mirroring the picker), then
-        rejected ones by name."""
+        rejected ones by name. ``cap`` truncates AFTER the sort (what the
+        ledger's candidate cap would keep anyway). With a snapshot
+        attached, the verdicts come from the same packed scan the
+        placement already ran when the inputs match — the second full
+        walk BENCH_r10 charged to the decision plane is gone."""
+        snap = self._snap()
+        if snap is not None:
+            other = req.spec.resource.other_spec
+            key = self._scan_inputs_key(chips, quarantined, exclude, used,
+                                        other, snap)
+            if self._last_scan is not None and self._last_scan[0] == key:
+                _key, names, res = self._last_scan
+            else:
+                res = self._kernel_scan(
+                    req, chips, quarantined, exclude, used, 0, snap
+                )
+                names = self._last_scan[1]
+            return self._scan_candidates(names, res, chips, cap=cap)
         out: List[Dict[str, object]] = []
         for n in self.store.list(Node):
             verdict = self.node_verdict(req, n, chips, used, quarantined,
@@ -447,7 +593,7 @@ class PlacementEngine:
             c["verdict"] != "ok", c["free"] if c["verdict"] == "ok" else 0,
             c["node"],
         ))
-        return out
+        return out if cap is None else out[:cap]
 
     def tiebreak_rationale(
         self, chosen: Sequence[str], used: Dict[str, int]

@@ -122,6 +122,16 @@ class Preemptor:
             key=lambda c: (c.priority, c.total_chips, c.creation, c.name)
         )
 
+        # Native kernel (native/tpusched.cc tpus_victims) when the engine
+        # carries a packed snapshot and the library loaded: the same
+        # exhaustive-then-greedy search over the packed arrays, probes in
+        # O(freed entries) instead of O(nodes). None = fall back to the
+        # Python search below (bit-identical by the differential fuzz).
+        native = self._native_search(req, shape, quarantined, used, candidates)
+        if native is not None:
+            victims, self.last_search = native
+            return victims
+
         if not feasible(tuple(candidates)):
             self.last_search = {
                 "mode": "infeasible", "candidates": len(candidates),
@@ -158,6 +168,90 @@ class Preemptor:
             "set_size": len(victims),
         }
         return victims
+
+    # ------------------------------------------------------------------
+    def _native_search(
+        self,
+        req: ComposabilityRequest,
+        shape: SliceShape,
+        quarantined: Set[str],
+        used: Dict[str, int],
+        candidates: List[_Candidate],
+    ):
+        """Pack the sorted candidates + capacity state and run the victim
+        search in the native kernel. Returns (victims, last_search) or
+        None when the native path is unavailable (no snapshot, no library,
+        or a freed node the snapshot does not know — fall back to the
+        Python search)."""
+        engine = self.engine
+        snap_of = getattr(engine, "_snap", None)
+        snap = snap_of() if snap_of is not None else None
+        lib = getattr(engine, "native", None)
+        if snap is None or lib is None:
+            return None
+        import ctypes
+
+        snap.ensure_dense()
+        names = snap.names
+        idx = snap._idx
+        n = len(names)
+        # All-zero state mask == ready, schedulable, not quarantined —
+        # exactly the usable set the Python search probes against.
+        flags = snap.pack_flags(quarantined, frozenset())
+        usable = (ctypes.c_uint8 * max(1, n))(
+            *[1 if flags[i] == 0 else 0 for i in range(n)]
+        )
+        target = req.spec.resource.target_node
+        target_mode = target_idx = 0
+        if target:
+            ti = idx.get(target)
+            if ti is not None and usable[ti]:
+                target_mode, target_idx = 1, ti
+            else:
+                # Target set but gone/unusable: no combo is ever feasible
+                # (the Python search's target_node-is-None case).
+                target_mode = 2
+        used_arr = snap.pack_used(used)
+        ncand = len(candidates)
+        cand_prio = (ctypes.c_int64 * ncand)(*[c.priority for c in candidates])
+        cand_chips = (ctypes.c_int64 * ncand)(
+            *[c.total_chips for c in candidates]
+        )
+        # Name ranks: rank order == name lexicographic order, so the
+        # kernel's rank-sequence comparison is the tuple-of-names tiebreak.
+        by_name = sorted(range(ncand), key=lambda i: candidates[i].name)
+        ranks = [0] * ncand
+        for r, i in enumerate(by_name):
+            ranks[i] = r
+        cand_rank = (ctypes.c_int32 * ncand)(*ranks)
+        off = [0]
+        fidx: List[int] = []
+        famt: List[int] = []
+        for c in candidates:
+            for node, chips in c.freed.items():
+                i = idx.get(node)
+                if i is None:
+                    return None  # freed node unknown to the snapshot
+                fidx.append(i)
+                famt.append(chips)
+            off.append(len(fidx))
+        freed_off = (ctypes.c_int32 * (ncand + 1))(*off)
+        freed_idx = (ctypes.c_int32 * max(1, len(fidx)))(*fidx)
+        freed_amt = (ctypes.c_int32 * max(1, len(famt)))(*famt)
+        try:
+            sel, info = lib.victims(
+                n, snap._slots, used_arr, usable,
+                snap._cpu, snap._mem, snap._eph, snap._pods,
+                req.spec.resource.other_spec,
+                shape.chips_per_host, shape.num_hosts,
+                target_mode, target_idx,
+                cand_prio, cand_chips, cand_rank,
+                freed_off, freed_idx, freed_amt,
+                _EXHAUSTIVE_MAX_CANDIDATES, _EXHAUSTIVE_MAX_SIZE,
+            )
+        except OSError:
+            return None
+        return [candidates[i].name for i in sel], info
 
     # ------------------------------------------------------------------
     def _greedy_prune(self, candidates, feasible) -> List[str]:

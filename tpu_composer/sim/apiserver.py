@@ -226,6 +226,14 @@ class FakeApiServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # TCP_NODELAY, like the real apiserver (Go's net stack enables
+            # it on every accepted conn). Without it, keep-alive clients
+            # stall ~40ms per request: the handler writes response headers
+            # and body as separate small sends, and Nagle holds the second
+            # until the client's delayed ACK — invisible on one-shot
+            # connections, a 1.4x attach-p50 tax on pooled ones (the
+            # BENCH_r10 keep-alive regression).
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # quiet
                 pass
