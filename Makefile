@@ -50,7 +50,10 @@ bench-round:
 ## must add <5% to the same wave vs TPUC_PROFILE=0/TPUC_FLEET=0), plus
 ## the event-plane floor check: poll-driven completion p50 >=
 ## poll_interval by construction, event-driven strictly under it with
-## zero safety-net fallbacks
+## zero safety-net fallbacks, and the wire-ops-at-idle gate: with a
+## healthy fabric event stream the idle window must see ~zero unprompted
+## relists (strictly below the poll-driven control) and ~zero apiserver
+## wire ops at constant cluster state
 perf-smoke:
 	$(PYTHON) -c "import bench; bench.perf_smoke()"
 

@@ -858,7 +858,7 @@ def bench_shard_scaling(replica_counts=(1, 2, 4), requests: int = 16,
     return results
 
 
-def bench_proc_scaling(replica_counts=(1, 2, 4), requests: int = 96,
+def bench_proc_scaling(replica_counts=None, requests: int = 96,
                        nodes: int = 48, chips_per_node: int = 4,
                        shards: int = 8, seed: int = 17,
                        rtt_s: float = 0.05,
@@ -912,10 +912,29 @@ def bench_proc_scaling(replica_counts=(1, 2, 4), requests: int = 96,
         cancel_frac=0.0, resize_frac=0.0, migrate_frac=0.0,
     )
     base_dir = workdir or tempfile.mkdtemp(prefix="bench-proc-")
+    cpu_count = os.cpu_count() or 1
+    cap_note = ""
+    if replica_counts is None:
+        # Default curve: 1/2/4 everywhere, 8 only where the box has the
+        # cores to actually RUN 8 full operator processes. Below that the
+        # extra replicas just time-slice (see the regime note above) and
+        # the point would measure the scheduler, not the control plane.
+        if cpu_count >= 8:
+            replica_counts = (1, 2, 4, 8)
+        else:
+            replica_counts = (1, 2, 4)
+            cap_note = (
+                f"8-replica point skipped: os.cpu_count()={cpu_count} < 8"
+                " — added replicas would time-slice one core, not scale"
+            )
     results = {"plan": {"seed": seed, "requests": requests,
                         "digest": plan.trace_digest()[:12],
                         "rtt_ms": rtt_s * 1e3, "workers": workers,
-                        "poll_scale": poll_scale}}
+                        "poll_scale": poll_scale,
+                        "replica_counts": list(replica_counts),
+                        "cpu_count": cpu_count}}
+    if cap_note:
+        results["plan"]["replica_cap_note"] = cap_note
     for n_replicas in replica_counts:
         fleet = ProcFleet(
             os.path.join(base_dir, f"n{n_replicas}"),
@@ -1117,6 +1136,126 @@ def bench_event_plane(ops: int = 16, poll_interval: float = 0.5,
         "poll_interval_s": poll_interval,
         "async_delay_s": async_delay,
         "injected_rtt_s": rtt_s,
+        "event_driven": run(events=True),
+        "poll_driven": run(events=False),
+    }
+
+
+def bench_wire_idle(window_s: float = 2.0, period: float = 0.4,
+                    fallback_multiplier: float = 20.0):
+    """Wire ops at IDLE: steady-state control traffic at constant cluster
+    state, event-driven vs poll-driven (ISSUE 19 gate).
+
+    The UpstreamSyncer — the last timed relister after the wire-plane-v2
+    demotion — runs for ``window_s`` against a live FakeApiServer (store
+    reads watch-cache-fed) and a fabric pool, with nothing changing in the
+    cluster. Two configurations:
+
+    - **poll_driven** (session=None): the pre-demotion shape — one
+      ``get_resources()`` relist per ``period``.
+    - **event_driven**: a healthy FabricSession streams; the relist is
+      demoted to ``period x fallback_multiplier`` so the idle window sees
+      ZERO unprompted fabric relists, and a fabric inventory event rings
+      the doorbell for an immediate pass.
+
+    Everything asserted on is a COUNT (provider get_resources calls,
+    apiserver request_log growth), never wall time, so the perf_smoke gate
+    is deterministic: event-driven idle relists must be strictly below the
+    poll-driven control and ~zero, the store wire ops at idle must be ~zero
+    on both (the watch cache already bought that), and the doorbell must
+    produce exactly the one reactive pass."""
+    import sys
+    import threading
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from fake_apiserver import FakeApiServer, operator_resources
+
+    from tpu_composer import GROUP, VERSION
+    from tpu_composer.api.types import (
+        ComposableResource,
+        ComposableResourceSpec,
+        ObjectMeta,
+    )
+    from tpu_composer.controllers.syncer import UpstreamSyncer
+    from tpu_composer.fabric.events import FabricSession
+    from tpu_composer.runtime.kubestore import KubeConfig, KubeStore
+
+    def run(events: bool):
+        pool = _counting_pool(chips={"gpu-a100": 4})
+        srv = FakeApiServer(operator_resources(GROUP, VERSION))
+        srv.start()
+        store = KubeStore(config=KubeConfig(host=srv.url),
+                          watch_reconnect_s=0.05, cache_reads=True)
+        session = None
+        syncer = None
+        stop = threading.Event()
+        thread = None
+        try:
+            if events:
+                session = FabricSession(pool, poll_timeout=1.0,
+                                        retry_base=0.01)
+                session.start()
+                deadline = time.monotonic() + 5
+                while not session.healthy() and time.monotonic() < deadline:
+                    time.sleep(0.002)
+                if not session.healthy():
+                    raise RuntimeError("event session never connected")
+            syncer = UpstreamSyncer(
+                store, pool, period=period, grace=600.0, session=session,
+                fallback_multiplier=fallback_multiplier,
+            )
+            # Priming pass OUTSIDE the measured window: starts the
+            # reflector list+watch per kind, loads the durable trackers.
+            syncer.sync_once()
+            time.sleep(0.3)  # let the watch streams fully establish
+            fab0 = pool.fabric_calls["get"]
+            req0 = len(srv.request_log)
+            thread = threading.Thread(
+                target=syncer, args=(stop,), daemon=True,
+                name="wire-idle-syncer")
+            thread.start()
+            time.sleep(window_s)
+            idle_fabric = pool.fabric_calls["get"] - fab0
+            idle_store = len(srv.request_log) - req0
+            out = {
+                "idle_fabric_relists": idle_fabric,
+                "idle_store_wire_ops": idle_store,
+                "window_s": window_s,
+                "period_s": period,
+            }
+            if events:
+                # Doorbell: one real inventory change must produce one
+                # reactive pass (count observed, latency reported).
+                fab1 = pool.fabric_calls["get"]
+                t0 = time.perf_counter()
+                pool.add_resource(ComposableResource(
+                    metadata=ObjectMeta(name="wire-idle-dev"),
+                    spec=ComposableResourceSpec(
+                        type="gpu", model="gpu-a100",
+                        target_node="wire-idle-node", chip_count=1,
+                    ),
+                ))
+                deadline = time.monotonic() + 5
+                while (pool.fabric_calls["get"] == fab1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.005)
+                out["doorbell_relists"] = pool.fabric_calls["get"] - fab1
+                out["doorbell_s"] = round(time.perf_counter() - t0, 4)
+            return out
+        finally:
+            stop.set()
+            if syncer is not None:
+                syncer._wake.set()
+            if thread is not None:
+                thread.join(timeout=5)
+            if session is not None:
+                session.stop()
+            store.close()
+            srv.stop()
+
+    return {
+        "fallback_multiplier": fallback_multiplier,
         "event_driven": run(events=True),
         "poll_driven": run(events=False),
     }
@@ -1553,7 +1692,10 @@ def assert_round_gates(path: str) -> None:
     - decision_plane.overhead_pct < 5 (the perf-smoke budget for the
       ledger + goodput + capacity observatory on the request path);
     - placement_engine native >= 5x the pure-Python kernel on the 5k-node
-      fit search, whenever the native library was available for the round.
+      fit search, whenever the native library was available for the round;
+    - wire_plane idle relists: with the fabric event stream healthy the
+      idle window must see at most 1 unprompted relist AND strictly fewer
+      than the poll-driven control (wire plane v2's at-idle claim).
     """
     with open(path) as f:
         doc = json.load(f)
@@ -1562,14 +1704,14 @@ def assert_round_gates(path: str) -> None:
     # blocks (decision_plane among them) — the full record keeps them
     # verbatim, so gate against it when the headline dropped a block.
     full_rel = extra.get("full_record")
-    if full_rel and not all(k in extra
-                            for k in ("decision_plane", "placement_engine")):
+    if full_rel and not all(k in extra for k in (
+            "decision_plane", "placement_engine", "wire_plane")):
         full_path = os.path.join(os.path.dirname(os.path.abspath(path)),
                                  full_rel)
         try:
             with open(full_path) as f:
                 full_extra = json.load(f).get("extra", {})
-            for k in ("decision_plane", "placement_engine"):
+            for k in ("decision_plane", "placement_engine", "wire_plane"):
                 extra.setdefault(k, full_extra.get(k, {}))
         except (OSError, ValueError):
             pass
@@ -1595,6 +1737,18 @@ def assert_round_gates(path: str) -> None:
                 f"placement_engine speedup_native_vs_python={speedup}"
                 " under the 5x floor on the 5k-node fit search"
             )
+    wp = extra.get("wire_plane") or {}
+    if "error" in wp:
+        failures.append(f"wire_plane errored: {wp['error']}")
+    elif wp.get("idle_relists_event") is None:
+        failures.append("wire_plane.idle_relists_event missing")
+    elif not (wp["idle_relists_event"] <= 1
+              and wp["idle_relists_event"] < wp.get("idle_relists_poll", 0)):
+        failures.append(
+            f"wire_plane idle relists: event={wp['idle_relists_event']}"
+            f" poll={wp.get('idle_relists_poll')} — streaming steady state"
+            " must be ~silent and strictly below the poll-driven control"
+        )
     if failures:
         raise SystemExit(
             f"BENCH ROUND GATE FAILED ({path}):\n  - "
@@ -1950,7 +2104,14 @@ def perf_smoke(cycles: int = 3):
        within 10% (+50 ms) of the no-governor baseline while a
        low-priority request is provably held (never Running, with at
        least one shed recorded), and the post-heal recovery drain must
-       actually be paced (paced burst >= unpaced control's wall).
+       actually be paced (paced burst >= unpaced control's wall);
+    8. wire ops at idle — at constant cluster state with a healthy fabric
+       event stream, the syncer's relist demotion must leave the idle
+       window with STRICTLY fewer unprompted ``get_resources()`` relists
+       than the poll-driven control (and at most one), the store wire ops
+       at idle must stay ~zero on both (watch-cache-fed reads), and one
+       fabric inventory event must ring exactly one reactive pass. All
+       counts — no wall-time race.
 
     Run via ``make perf-smoke``."""
     on = bench_attach_cluster(cycles=cycles, rtt_s=0.0, cached=True)
@@ -1962,6 +2123,7 @@ def perf_smoke(cycles: int = 3):
     decision_cost = bench_decision_overhead(cycles=8, size=4, repeats=3)
     overload_cost = bench_overload(cycles=6, size=4, repeats=2)
     event_plane = bench_event_plane(ops=12, poll_interval=0.5)
+    wire_idle = bench_wire_idle(window_s=2.0, period=0.4)
     out = {
         "metric": "perf_smoke_store_rtts_per_attach",
         "cache_on": on["rtts_per_attach"],
@@ -1992,6 +2154,13 @@ def perf_smoke(cycles: int = 3):
         "event_completion_p50_s": event_plane["event_driven"]["p50_s"],
         "poll_completion_p50_s": event_plane["poll_driven"]["p50_s"],
         "event_poll_fallbacks": event_plane["event_driven"]["poll_fallbacks"],
+        "idle_relists_event": wire_idle["event_driven"]["idle_fabric_relists"],
+        "idle_relists_poll": wire_idle["poll_driven"]["idle_fabric_relists"],
+        "idle_store_ops_event":
+            wire_idle["event_driven"]["idle_store_wire_ops"],
+        "idle_store_ops_poll": wire_idle["poll_driven"]["idle_store_wire_ops"],
+        "idle_doorbell_relists": wire_idle["event_driven"]["doorbell_relists"],
+        "idle_doorbell_s": wire_idle["event_driven"]["doorbell_s"],
     }
     print(json.dumps(out))
     assert on["rtts_per_attach"] * 2 <= off["rtts_per_attach"], (
@@ -2106,6 +2275,37 @@ def perf_smoke(cycles: int = 3):
         " by the safety-net poll during a healthy streaming session"
         " (expected zero — every completion should arrive as a push event)"
     )
+    wi_ev = wire_idle["event_driven"]
+    wi_po = wire_idle["poll_driven"]
+    assert wi_po["idle_fabric_relists"] >= 2, (
+        f"wire-idle harness broke: the poll-driven control did only"
+        f" {wi_po['idle_fabric_relists']} relist(s) in a"
+        f" {wi_po['window_s']}s window at period {wi_po['period_s']}s —"
+        " the control is not exercising the timed relist path"
+    )
+    assert wi_ev["idle_fabric_relists"] < wi_po["idle_fabric_relists"], (
+        "wire-ops-at-idle regression: with a healthy event stream the"
+        f" syncer did {wi_ev['idle_fabric_relists']} unprompted fabric"
+        f" relist(s) at idle vs {wi_po['idle_fabric_relists']} poll-driven"
+        " (expected strictly fewer — the relist demotion is not engaging)"
+    )
+    assert wi_ev["idle_fabric_relists"] <= 1, (
+        "wire-ops-at-idle regression: the event-driven idle window saw"
+        f" {wi_ev['idle_fabric_relists']} unprompted fabric relists"
+        " (expected ~zero — steady state should be silent while the"
+        " stream is healthy)"
+    )
+    assert wi_ev["idle_store_wire_ops"] <= 2, (
+        "wire-ops-at-idle regression: the event-driven idle window put"
+        f" {wi_ev['idle_store_wire_ops']} requests on the apiserver wire"
+        " at constant cluster state (expected ~zero — reads must stay"
+        " watch-cache-fed)"
+    )
+    assert wi_ev["doorbell_relists"] >= 1, (
+        "wire-plane doorbell regression: a fabric inventory event did not"
+        " produce a reactive syncer pass within 5s — event-driven"
+        " anti-drift is not wired"
+    )
     return out
 
 
@@ -2206,6 +2406,21 @@ def main():
         }
     except Exception as e:
         event_plane = {"error": str(e)}
+    # Wire plane v2: idle-window control traffic (unprompted fabric
+    # relists + apiserver wire ops at constant cluster state), streaming
+    # vs poll-driven, plus the inventory-doorbell reaction time.
+    try:
+        wi = bench_wire_idle()
+        wire_plane = {
+            "idle_relists_event": wi["event_driven"]["idle_fabric_relists"],
+            "idle_relists_poll": wi["poll_driven"]["idle_fabric_relists"],
+            "idle_store_ops_event":
+                wi["event_driven"]["idle_store_wire_ops"],
+            "doorbell_ms": round(
+                wi["event_driven"]["doorbell_s"] * 1e3, 1),
+        }
+    except Exception as e:
+        wire_plane = {"error": str(e)}
     # Live migration vs delete/re-solve: evacuation time and job-visible
     # pause for the same node drain (the make-before-break dividend).
     try:
@@ -2298,6 +2513,7 @@ def main():
         "proc_scaling": proc_headline,
         "hot_spots": {"attach_32chip": hot_32, "shard_2replica": hot_shard},
         "event_plane": event_plane,
+        "wire_plane": wire_plane,
         "migration": migration,
         "decision_plane": decision_plane,
         "placement_engine": placement_engine,
@@ -2368,7 +2584,8 @@ def main():
                             # bench_full.json) drop before it does.
                             for key in ("shard_scaling", "overload",
                                         "decision_plane", "migration",
-                                        "event_plane", "proc_scaling"):
+                                        "event_plane", "wire_plane",
+                                        "proc_scaling"):
                                 out["extra"].pop(key, None)
                                 line = json.dumps(out)
                                 if len(line) <= HEADLINE_BUDGET_CHARS:

@@ -14,6 +14,12 @@ atomic under genuine OS-level concurrency, not just under in-proc threads:
   matching the stored object — interleaved mutations from four processes
   must never reorder or tear the event stream.
 
+Since the wire-plane-v2 sharding (ISSUE 19) the server's state is one
+lock per kind, not one global lock — so a second hammer drives TWO kinds
+at once over a single multiplexed tpuc-mux/1 socket: per-kind CAS
+atomicity and watch ordering must hold exactly as before, while the
+global resourceVersion counter stays strictly monotonic across kinds.
+
 Tier-1 fast (no markers): the hammer is ~100 CAS wins across 4 processes,
 a couple of seconds end to end.
 """
@@ -26,9 +32,11 @@ import sys
 import threading
 import urllib.request
 
+from tpu_composer.runtime import wiremux
 from tpu_composer.sim.apiserver import FakeApiServer
 
 PREFIX = "/apis/test.dev/v1/counters"
+PREFIX_B = "/apis/test.dev/v1/gauges"
 
 # Worker subprocess: pure stdlib so spawn cost stays milliseconds. Loops
 # optimistic-concurrency increments until it lands `wins` of them, then
@@ -125,4 +133,91 @@ def test_four_process_cas_hammer_loses_no_updates():
         assert rvs[-1][2]["spec"]["count"] == 100
         assert rvs[-1][1] == int(final["metadata"]["resourceVersion"])
     finally:
+        srv.stop()
+
+
+def test_two_kind_mux_hammer_against_sharded_locks():
+    """8 threads CAS-increment two KINDS concurrently over ONE mux socket,
+    with a live mux watch per kind. Per-kind locks must preserve CAS
+    atomicity and per-kind watch ordering, and the shared rv counter must
+    stay strictly monotonic across both kinds (no torn next_rv)."""
+    srv = FakeApiServer({
+        PREFIX: {"kind": "Counter", "apiVersion": "test.dev/v1"},
+        PREFIX_B: {"kind": "Gauge", "apiVersion": "test.dev/v1"},
+    })
+    base = srv.start()
+    client = wiremux.MuxClient(base)
+    wins_per_worker, workers_per_kind = 15, 4
+    target = wins_per_worker * workers_per_kind
+    try:
+        for prefix, kind in ((PREFIX, "Counter"), (PREFIX_B, "Gauge")):
+            srv.put_object(prefix, {
+                "apiVersion": "test.dev/v1", "kind": kind,
+                "metadata": {"name": "shared"}, "spec": {"count": 0}})
+
+        events = {PREFIX: [], PREFIX_B: []}
+        watch_errs = []
+
+        def watch(prefix):
+            try:
+                w = client.watch(
+                    f"{prefix}?watch=true&resourceVersion=0", timeout=30)
+                for line in w:
+                    ev = json.loads(line)
+                    events[prefix].append(
+                        int(ev["object"]["metadata"]["resourceVersion"]))
+                    if ev["object"]["spec"].get("count") == target:
+                        w.shutdown()
+                        return
+            except Exception as e:
+                watch_errs.append(e)
+
+        errs = []
+
+        def hammer(prefix):
+            landed = 0
+            try:
+                while landed < wins_per_worker:
+                    code, obj = client.request(
+                        "GET", f"{prefix}/shared", timeout=30)
+                    assert code == 200, (code, obj)
+                    obj["spec"]["count"] += 1
+                    code, out = client.request(
+                        "PUT", f"{prefix}/shared", body=obj, timeout=30)
+                    if code == 200:
+                        landed += 1
+                    else:
+                        assert code == 409, (code, out)
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=watch, args=(p,))
+                   for p in (PREFIX, PREFIX_B)]
+        threads += [threading.Thread(target=hammer, args=(p,))
+                    for p in (PREFIX, PREFIX_B)
+                    for _ in range(workers_per_kind)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, f"hammer died: {errs[0]!r}"
+        assert not watch_errs, f"watcher died: {watch_errs[0]!r}"
+        assert not any(t.is_alive() for t in threads), "hammer deadlocked"
+
+        for prefix in (PREFIX, PREFIX_B):
+            code, final = client.request("GET", f"{prefix}/shared")
+            assert code == 200
+            assert final["spec"]["count"] == target, (
+                f"{prefix}: lost updates under per-kind locking:"
+                f" {final['spec']['count']} != {target}")
+            seen = events[prefix]
+            assert seen == sorted(set(seen)), (
+                f"{prefix}: watch stream reordered/duplicated: {seen}")
+        # Global rv monotonicity across kinds: both kinds draw from one
+        # counter, so their version sets must never collide.
+        assert not set(events[PREFIX]) & set(events[PREFIX_B]), (
+            "two kinds shared a resourceVersion — next_rv tore under"
+            " per-kind locks")
+    finally:
+        client.close()
         srv.stop()

@@ -15,6 +15,8 @@ the per-node slice object).
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from typing import List, Optional
 
 from tpu_composer.api.dra import (
@@ -242,3 +244,128 @@ class DevicePublisher:
         if sl is None:
             return []
         return [d for d in sl.spec.devices if not self.tainted(d.uuid)]
+
+
+class InventoryPublisher:
+    """Event-fed ResourceSlice drift repair (wire plane v2, part c).
+
+    The publication writes themselves ride the attach/detach paths; what
+    used to require a poll is noticing that a node's published slice no
+    longer matches what the fabric actually has attached (slice object
+    deleted by an operator, publication lost to a crash between attach and
+    publish). This runnable re-checks on fabric *inventory events* — the
+    push signal that composed capacity changed — with the timed pass
+    demoted to a ``period × fallback_multiplier`` safety net while the
+    event session streams (the same shape as UpstreamSyncer's relist
+    demotion). At constant cluster state it performs zero wire ops beyond
+    the cache-fed reads: visibility checks go through the informer cache,
+    and ``get_resources()`` runs only when an event or the safety net
+    fires.
+
+    Repair policy is deliberately conservative: only a group whose chips
+    are *entirely* unpublished on its node is re-published (a partial set
+    is an in-flight controller mutation, not drift), and only when its
+    owning ComposableResource is findable, Online, not terminating, and
+    has no pending fabric op. A repaired entry carries no CDI device id —
+    the controller's own publication (which knows it) wins on the next
+    reconcile since _mutate_slice replaces the group's entries wholesale.
+    """
+
+    def __init__(
+        self,
+        store,
+        fabric,
+        session=None,
+        period: float = 60.0,
+        fallback_multiplier: float = 20.0,
+    ) -> None:
+        self.store = store
+        self.fabric = fabric
+        self.publisher = DevicePublisher(store)
+        self.session = session
+        self.period = period
+        self.fallback_multiplier = max(1.0, fallback_multiplier)
+        self.log = logging.getLogger("InventoryPublisher")
+        self.repairs = 0  # introspection (tests / debug)
+        self._wake = threading.Event()
+        if session is not None:
+            from tpu_composer.fabric.events import EVENT_INVENTORY
+
+            def _on_event(evt, _kind=EVENT_INVENTORY):
+                if evt.type == _kind:
+                    self._wake.set()
+
+            session.on_event(_on_event)
+            session.on_gap(self._wake.set)
+
+    def effective_period(self) -> float:
+        if self.session is not None and self.session.healthy():
+            return self.period * self.fallback_multiplier
+        return self.period
+
+    def reconcile_once(self) -> int:
+        """One repair pass; returns how many groups were re-published."""
+        from tpu_composer.api.types import (
+            ComposableResource,
+            RESOURCE_STATE_ONLINE,
+        )
+        from tpu_composer.fabric.provider import FabricError
+
+        try:
+            devices = self.fabric.get_resources()
+            resources = {r.name: r for r in self.store.list(ComposableResource)}
+        except FabricError:
+            return 0  # fabric outage: nothing to diff against
+        groups: dict = {}
+        for dev in devices:
+            if dev.node and dev.slice_name and dev.resource_name:
+                groups.setdefault(
+                    (dev.node, dev.slice_name, dev.resource_name), []
+                ).append(dev)
+        repaired = 0
+        for (node, group, owner_name), devs in sorted(groups.items()):
+            owner = resources.get(owner_name)
+            if (
+                owner is None
+                or owner.being_deleted
+                or owner.status.state != RESOURCE_STATE_ONLINE
+                or owner.status.pending_op is not None
+            ):
+                continue  # mid-flight or dying: the controller owns this
+            ids = [d.device_id for d in devs]
+            if not self.publisher.devices_invisible(node, ids):
+                continue  # fully or partially published: not our drift
+            self.publisher.publish_group(node, group, ids, devs[0].model)
+            self.log.warning(
+                "republished %d chip(s) of %s on %s (slice publication had"
+                " vanished while the fabric still reports the attachment)",
+                len(ids), group, node,
+            )
+            repaired += 1
+        self.repairs += repaired
+        return repaired
+
+    # Manager runnable entry point (same contract as UpstreamSyncer).
+    def __call__(self, stop_event: threading.Event) -> None:
+        from tpu_composer.fabric.events import doorbell_wait
+        from tpu_composer.runtime.store import StoreError
+
+        last_pass = float("-inf")
+        while not stop_event.is_set():
+            # Same burst coalescing as the syncer: churn rings the
+            # inventory doorbell once per attach/detach, so repair
+            # passes are floored at the base period instead of running
+            # once per event.
+            doorbell_wait(
+                stop_event, self._wake,
+                deadline=time.monotonic() + self.effective_period(),
+                floor=last_pass + self.period,
+            )
+            if stop_event.is_set():
+                return
+            self._wake.clear()
+            last_pass = time.monotonic()
+            try:
+                self.reconcile_once()
+            except StoreError as e:
+                self.log.warning("slice repair pass failed: %s", e)

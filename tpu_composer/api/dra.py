@@ -96,6 +96,14 @@ class ResourceSliceSpec:
             devices=[SliceDevice.from_dict(x) for x in d.get("devices", [])],
         )
 
+    def uuids_for_group(self, group: str) -> List[str]:
+        """Chip uuids this slice publishes for one composed group
+        (``SliceDevice.slice_name``). The publisher's group-scoped
+        mutate/repair paths key on this — one membership definition, so
+        publication and drift-repair can't disagree on what 'the group's
+        devices' means."""
+        return [d.uuid for d in self.devices if d.slice_name == group]
+
     def validate(self) -> None:
         pass
 

@@ -338,6 +338,20 @@ def build_parser() -> argparse.ArgumentParser:
              " enabled (env TPUC_NATIVE_SCHED)",
     )
     p.add_argument(
+        "--wire-mux",
+        action=argparse.BooleanOptionalAction,
+        default=os.environ.get("TPUC_WIRE_MUX", "1") != "0",
+        help="carry every store verb AND watch of this replica on ONE"
+             " persistent framed connection (tpuc-mux/1: length-prefixed"
+             " JSON frames, correlation-id pipelining, watches as"
+             " server-push streams) instead of per-request keep-alive HTTP"
+             " plus one dedicated connection per watch. Falls back to HTTP"
+             " automatically when the apiserver has no /mux endpoint."
+             " --no-wire-mux or TPUC_WIRE_MUX=0 forces the HTTP path"
+             " bit-identically (cluster mode only; the standalone store"
+             " has no wire)",
+    )
+    p.add_argument(
         "--fabric-batch",
         action=argparse.BooleanOptionalAction,
         default=os.environ.get("TPUC_FABRIC_BATCH", "1") != "0",
@@ -1034,6 +1048,7 @@ def build_store(args: argparse.Namespace):
             config=cfg,
             cache_reads=getattr(args, "cached_reads", True),
             namespace=getattr(args, "namespace", None),
+            wire_mux=getattr(args, "wire_mux", None),
         )
     else:
         log.info("store: standalone (state_dir=%s)",
@@ -1552,7 +1567,29 @@ def build_manager(args: argparse.Namespace) -> Manager:
         # store breaker is open — a dark store's diff must not reclaim
         # healthy mid-attach devices whose status writes couldn't land.
         suspend=storebreaker.is_open if storebreaker is not None else None,
+        # Wire plane v2: while the fabric event session streams, the timed
+        # get_resources() relist stretches to a safety net (same
+        # multiplier the dispatcher's poll fallback uses) and inventory
+        # events trigger immediate passes instead.
+        session=session,
+        fallback_multiplier=getattr(
+            args, "fabric_poll_fallback_mult", 20.0
+        ),
     ))
+    if session is not None:
+        from tpu_composer.agent.publisher import InventoryPublisher
+
+        # Push-fed DRA publication repair: inventory events (not a poll)
+        # re-check that every fabric-attached group is still published in
+        # its node's ResourceSlice; the timed pass is the same demoted
+        # safety net as the syncer's.
+        mgr.add_runnable(InventoryPublisher(
+            client, fabric, session=session,
+            period=args.sync_period,
+            fallback_multiplier=getattr(
+                args, "fabric_poll_fallback_mult", 20.0
+            ),
+        ))
     # Event-driven visibility: /dev change events nudge the resource
     # controller instead of waiting out a poll quantum (BASELINE.md) —
     # inotify directly for a local agent, HTTP long-poll per node for the

@@ -84,12 +84,29 @@ class UpstreamSyncer:
         vanish_threshold: int = 2,
         ownership=None,
         suspend: Optional[Callable[[], bool]] = None,
+        session=None,
+        fallback_multiplier: float = 20.0,
     ) -> None:
         self.store = store
         self.fabric = fabric
         self.period = period
         self.grace = grace
         self.recorder = recorder or EventRecorder()
+        # Event-driven anti-drift (wire plane v2, same shape as the
+        # dispatcher's poll-fallback): while the FabricSession streams,
+        # the timed get_resources() relist is demoted to a
+        # period × fallback_multiplier safety net, and inventory events /
+        # gap recoveries ring self._wake for an immediate pass instead.
+        # session=None (or a down/unsupported stream) keeps the plain
+        # timed cadence — polling stays the primary path exactly as
+        # before.
+        self.session = session
+        self.fallback_multiplier = max(1.0, fallback_multiplier)
+        self._wake = threading.Event()
+        if session is not None:
+            session.on_event(self._on_fabric_event)
+            session.on_gap(self._wake.set)
+            session.on_state(lambda _healthy: self._wake.set())
         # Outage ride-through (cmd/main wires the store breaker's is_open
         # here): while the store is dark, "device not in any CR" proves
         # nothing — status writes can't land, so the diff would reclaim
@@ -126,9 +143,43 @@ class UpstreamSyncer:
     def _owned(self, key: str) -> bool:
         return self.ownership is None or self.ownership.owns_key(key)
 
+    def _on_fabric_event(self, evt) -> None:
+        # Inventory transitions (chips added/removed/moved) are exactly
+        # what the diff pass exists to reconcile; completion/health events
+        # have their own consumers and don't ring here.
+        from tpu_composer.fabric.events import EVENT_INVENTORY
+
+        if evt.type == EVENT_INVENTORY:
+            self._wake.set()
+
+    def effective_period(self) -> float:
+        """Seconds until the next unprompted pass: ``period`` while polling
+        is primary, ``period × fallback_multiplier`` while the fabric event
+        stream is healthy (the relist is then only drift insurance)."""
+        if self.session is not None and self.session.healthy():
+            return self.period * self.fallback_multiplier
+        return self.period
+
     # The Manager runnable entry point (mgr.Add(RunnableFunc) analog).
     def __call__(self, stop_event: threading.Event) -> None:
-        while not stop_event.wait(self.period):
+        from tpu_composer.fabric.events import doorbell_wait
+
+        last_pass = float("-inf")
+        while not stop_event.is_set():
+            # Doorbell-driven passes are floored at the base period: a
+            # churny fabric rings once per attach/detach, and relisting
+            # per event would cost MORE wire ops than the timed poll
+            # this plane demoted. Bursts coalesce to one pass per
+            # period; a ring after a quiet stretch fires immediately.
+            doorbell_wait(
+                stop_event, self._wake,
+                deadline=time.monotonic() + self.effective_period(),
+                floor=last_pass + self.period,
+            )
+            if stop_event.is_set():
+                return
+            self._wake.clear()
+            last_pass = time.monotonic()
             try:
                 self.sync_once()
             except (FabricError, StoreError) as e:

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple
 
@@ -129,6 +130,34 @@ class FabricEvent:
             state=str(d.get("state", "")),
             detail=str(d.get("detail", "")),
         )
+
+
+def doorbell_wait(stop_event: threading.Event, wake: threading.Event,
+                  deadline: float, floor: float) -> None:
+    """Park an event-paced reconcile loop until its next pass is due.
+
+    Returns when ``stop_event`` is set, the unprompted ``deadline``
+    passes, or ``wake`` is rung AND ``time.monotonic() >= floor``. The
+    floor is the burst coalescer: a churny fabric fires one inventory
+    event per attach/detach, and without it every doorbell-driven
+    consumer (syncer relist, slice-repair pass) degenerates into a full
+    listing PER EVENT — more wire ops than the timed poll it replaced.
+    Callers set ``floor = last_pass + period`` so event-driven passes
+    never run hotter than the base poll cadence, while a doorbell after
+    a quiet stretch still fires immediately.
+    """
+    while not stop_event.is_set():
+        now = time.monotonic()
+        if now >= deadline:
+            return
+        if wake.is_set():
+            if now >= floor:
+                return
+            # Wake already rung: waiting on the (set) event would spin,
+            # so sleep out the remaining floor in stop-responsive chunks.
+            time.sleep(min(floor - now, 0.25))
+        else:
+            wake.wait(min(deadline - now, 0.25))
 
 
 class FabricSession:

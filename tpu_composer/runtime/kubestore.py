@@ -57,10 +57,12 @@ from tpu_composer import GROUP, VERSION
 from tpu_composer.api.meta import ApiObject, ObjectMeta
 from tpu_composer.api.scheme import Scheme, default_scheme
 from tpu_composer.api.types import Node, NodeStatus
+from tpu_composer.runtime import wiremux
 from tpu_composer.runtime.metrics import (
     cached_reads_total,
     status_writes_coalesced_total,
     store_requests_total,
+    wire_mux_active,
 )
 from tpu_composer.runtime.store import (
     ADDED,
@@ -256,6 +258,7 @@ class KubeStore:
         cache_reads: bool = True,
         cache_sync_timeout_s: float = 5.0,
         namespace: Optional[str] = None,
+        wire_mux: Optional[bool] = None,
     ) -> None:
         self._cfg = config or KubeConfig.load(kubeconfig)
         # Per-thread persistent HTTP connection (keep-alive). A fresh
@@ -263,7 +266,20 @@ class KubeStore:
         # server-side thread spawn — ~20% of reconcile-worker CPU under
         # the proc-mode churn bench. Watches (stream=True) still get
         # dedicated connections; this pool is for the short verbs only.
+        # With the mux transport active neither pool is touched — every
+        # verb and watch rides ONE framed socket — but both remain the
+        # fallback path (TPUC_WIRE_MUX=0, or a server without /mux).
         self._conn_local = threading.local()
+        # Multiplexed framed transport (runtime/wiremux.py): one socket
+        # per replica, correlation-id pipelining, watches as server-push
+        # frames. None until first use; permanently disabled after the
+        # server declines the upgrade.
+        if wire_mux is None:
+            wire_mux = os.environ.get("TPUC_WIRE_MUX", "1") != "0"
+        self._wire_mux = wire_mux
+        self._mux: Optional[wiremux.MuxClient] = None
+        self._mux_lock = threading.Lock()
+        self._mux_failed = False
         # Namespace for the namespaced kinds (Leases, FleetTelemetry):
         # cmd/main wires --namespace / TPUC_NAMESPACE through here; the
         # env read below is the fallback for direct constructions.
@@ -372,6 +388,31 @@ class KubeStore:
         timeout: float = 30.0,
         stream: bool = False,
     ):
+        mux = self._mux_client()
+        if mux is not None:
+            try:
+                if stream:
+                    # Watch: a server-push stream on the shared socket.
+                    # MuxWatch iterates JSON lines exactly like the urllib
+                    # response the HTTP path returns, so _WatchThread is
+                    # transport-blind.
+                    return mux.watch(path, timeout=timeout)
+                code, payload = mux.request(method, path, body=body,
+                                            timeout=timeout)
+                if code >= 400:
+                    raise self._http_error(method, path, code, payload)
+                return payload if isinstance(payload, dict) else {}
+            except wiremux.MuxHTTPError as e:
+                raise self._http_error(method, path, e.code, e.body)
+            except wiremux.MuxUnsupported:
+                # Server has no /mux endpoint: permanent per-store HTTP
+                # fallback (logged once inside _mux_client's next call).
+                self._mux_disable("server declined tpuc-mux/1 upgrade")
+            except wiremux.MuxError as e:
+                # Transport failure on the framed socket: same contract as
+                # an HTTP transport failure — typed StoreError, reconnect
+                # happens lazily on the next call.
+                raise StoreError(f"{method} {path}: {e}") from None
         url = self._cfg.host.rstrip("/") + path
         data = json.dumps(body).encode() if body is not None else None
         if stream:
@@ -436,6 +477,37 @@ class KubeStore:
             return json.loads(payload) if payload else {}
         raise StoreError(f"{method} {path}: retry fell through")  # unreachable
 
+    def _mux_client(self) -> Optional[wiremux.MuxClient]:
+        """The shared framed-transport client, or None when the store is on
+        the HTTP path (kill switch off, or the server declined /mux)."""
+        if not self._wire_mux or self._mux_failed:
+            return None
+        with self._mux_lock:
+            if self._mux is None:
+                ctx = (
+                    self._ssl_ctx
+                    if self._cfg.host.startswith("https")
+                    else None
+                )
+                self._mux = wiremux.MuxClient(
+                    self._cfg.host, ssl_context=ctx, token=self._cfg.token
+                )
+                wire_mux_active.set(1)
+            return self._mux
+
+    def _mux_disable(self, reason: str) -> None:
+        """Permanent fallback to the keep-alive HTTP path for this store."""
+        if not self._mux_failed:
+            logging.getLogger("tpu_composer.kubestore").warning(
+                "wire mux disabled, falling back to HTTP: %s", reason
+            )
+        self._mux_failed = True
+        wire_mux_active.set(0)
+        with self._mux_lock:
+            mux, self._mux = self._mux, None
+        if mux is not None:
+            mux.close()
+
     def _new_connection(self, timeout: float):
         host = urllib.parse.urlsplit(self._cfg.host)
         if host.scheme == "https":
@@ -462,13 +534,18 @@ class KubeStore:
         return conn
 
     @staticmethod
-    def _http_error(method: str, path: str, code: int, payload: str):
+    def _http_error(method: str, path: str, code: int, payload):
         """Map an apiserver error status to the Store exception hierarchy
-        (returned, not raised, so callers control the traceback)."""
-        try:
-            status = json.loads(payload)
-        except (ValueError, TypeError):
-            status = {"message": payload}
+        (returned, not raised, so callers control the traceback). ``payload``
+        is the raw response body string on the HTTP path, an already-decoded
+        Status dict on the mux path."""
+        if isinstance(payload, dict):
+            status = payload
+        else:
+            try:
+                status = json.loads(payload)
+            except (ValueError, TypeError):
+                status = {"message": payload}
         msg = f"{method} {path}: {code} {status.get('reason', '')} {status.get('message', '')}"
         if code == 404:
             return NotFoundError(msg)
@@ -794,6 +871,10 @@ class KubeStore:
             self._reflectors.clear()
         for refl in refls:
             refl.stop()
+        with self._mux_lock:
+            mux, self._mux = self._mux, None
+        if mux is not None:
+            mux.close()
         self._cfg.cleanup()
 
 
@@ -834,10 +915,21 @@ class _WatchThread(threading.Thread):
         self._stop.set()
         resp = self._resp
         if resp is not None:
-            # Closing the HTTPResponse (a BufferedReader) from this thread
-            # would block on the reader lock the watch thread holds inside its
-            # blocked read. Shut the raw socket down instead: the blocked recv
-            # returns EOF and the thread exits on its own.
+            # A mux watch exposes shutdown(): it cancels the stream on the
+            # shared socket without touching the socket itself (other verbs
+            # and watches keep riding it).
+            shut = getattr(resp, "shutdown", None)
+            if shut is not None:
+                try:
+                    shut()
+                except Exception:
+                    pass
+                return
+            # HTTP watch: closing the HTTPResponse (a BufferedReader) from
+            # this thread would block on the reader lock the watch thread
+            # holds inside its blocked read. Shut the raw socket down
+            # instead: the blocked recv returns EOF and the thread exits on
+            # its own.
             try:
                 import socket as _socket
 
@@ -954,7 +1046,16 @@ class _WatchThread(threading.Thread):
                         last_err_log = now
                     backoff = min(backoff * 2, 30.0)
             finally:
-                self._resp = None
+                resp, self._resp = self._resp, None
+                # A mux stream being abandoned (idle-timeout reconnect, 410
+                # relist) must be cancelled on the shared socket, or the
+                # server keeps pushing to a stream nobody reads.
+                shut = getattr(resp, "shutdown", None)
+                if shut is not None:
+                    try:
+                        shut()
+                    except Exception:
+                        pass
             if not self._stop.is_set():
                 self._stop.wait(backoff if not connected else self._reconnect_s)
 

@@ -424,6 +424,12 @@ store_watch_queue_depth = global_registry.gauge(
     "Undrained events per store watcher queue (a growing depth means a"
     " slow consumer — the unbounded queue would otherwise hide it)",
 )
+wire_mux_active = global_registry.gauge(
+    "tpuc_wire_mux_active",
+    "1 while the store client is on the multiplexed framed transport"
+    " (tpuc-mux/1); 0 after falling back to per-request keep-alive HTTP"
+    " (server declined the upgrade or TPUC_WIRE_MUX=0)",
+)
 
 #: Fabric I/O pipeline (fabric/dispatcher.py): per-node batched group
 #: attach, async dispatch, completion-driven requeue.

@@ -1,0 +1,462 @@
+"""Multiplexed framed wire transport — the v2 store wire plane.
+
+One persistent socket per (client, apiserver) pair carries every verb and
+every watch concurrently: length-prefixed JSON frames with correlation ids,
+pipelined from all controller threads, with watch events arriving as
+server-push frames on the same connection. This is the Dagger/RPCAcc lesson
+(PAPERS.md): per-request HTTP overhead — request lines, header parsing, a
+server thread handoff per verb, and one dedicated socket per watch —
+dominates tight RPC paths; a framed mux amortizes all of it over a single
+connection.
+
+Protocol (version ``tpuc-mux/1``):
+
+- Handshake: a plain HTTP/1.1 ``GET /mux`` with ``Upgrade: tpuc-mux/1``;
+  the server answers ``101 Switching Protocols`` and both sides switch to
+  framed mode on the same socket. A server that answers anything else does
+  not speak mux — the client falls back to HTTP permanently (the
+  degraded-to-HTTP runbook row in docs/OPERATIONS.md).
+- Frames: 4-byte big-endian unsigned length, then that many bytes of UTF-8
+  JSON. Hard cap ``MAX_FRAME`` guards against corrupt prefixes.
+- Client → server:
+    ``{"id": N, "method": "GET|POST|PUT|DELETE", "path": ..., "body": ...}``
+      one verb; a path carrying ``watch=true`` opens a watch stream whose
+      stream id IS the request id.
+    ``{"cancel": N}`` — stop watch stream N.
+- Server → client:
+    ``{"id": N, "code": C, "body": {...}}`` — verb response (or the watch
+      accept/denial: a watch ack carries ``"watch": true``).
+    ``{"watch": N, "event": {...}}`` — one watch event (same JSON the HTTP
+      chunked watch writes per line, including the 410 ERROR persona).
+    ``{"watch": N, "end": true}`` — stream N ended server-side.
+
+Method/path/body are byte-identical to the HTTP path, so everything keyed
+on them — the sim apiserver's request_log assertions, fail-hook personas,
+``watch_blocker``'s ``"watch=true" in path`` match — behaves the same with
+the mux on or off. ``TPUC_WIRE_MUX=0`` / ``--no-wire-mux`` disables the
+client entirely and the PR 17 keep-alive HTTP path runs untouched.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import queue
+import socket
+import ssl
+import struct
+import threading
+import urllib.parse
+from typing import Any, Dict, Optional, Tuple
+
+log = logging.getLogger("wiremux")
+
+#: Protocol token in the Upgrade header; bump on incompatible frame changes.
+PROTOCOL = "tpuc-mux/1"
+
+#: Upgrade endpoint path on the apiserver.
+MUX_PATH = "/mux"
+
+#: Refuse frames larger than this — a corrupt length prefix must not make
+#: us try to allocate gigabytes.
+MAX_FRAME = 64 * 1024 * 1024
+
+_LEN = struct.Struct(">I")
+
+
+class MuxError(Exception):
+    """Transport-level mux failure (connect, send, connection died)."""
+
+
+class MuxUnsupported(MuxError):
+    """The server rejected the /mux upgrade: fall back to HTTP for good."""
+
+
+class MuxHTTPError(MuxError):
+    """An API error response frame (code >= 400); carries the Status body."""
+
+    def __init__(self, code: int, body: Any) -> None:
+        super().__init__(f"HTTP {code}")
+        self.code = code
+        self.body = body if isinstance(body, dict) else {"message": str(body)}
+
+
+# ----------------------------------------------------------------------
+# frame codec (shared by client and the sim apiserver's mux endpoint)
+# ----------------------------------------------------------------------
+def encode_frame(obj: Dict[str, Any]) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    return _LEN.pack(len(payload)) + payload
+
+
+def read_exact(fp, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes from a file-like object, riding out partial
+    reads across frame boundaries. None on clean EOF at a frame boundary;
+    MuxError on EOF mid-frame (truncated peer)."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = fp.read(n - got)
+        if not chunk:
+            if got == 0:
+                return None
+            raise MuxError(f"truncated frame: wanted {n} bytes, got {got}")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(fp) -> Optional[Dict[str, Any]]:
+    """One frame off a blocking file-like object; None on clean EOF."""
+    head = read_exact(fp, _LEN.size)
+    if head is None:
+        return None
+    (size,) = _LEN.unpack(head)
+    if size > MAX_FRAME:
+        raise MuxError(f"frame of {size} bytes exceeds cap {MAX_FRAME}")
+    body = read_exact(fp, size)
+    if body is None:
+        raise MuxError("EOF between frame header and body")
+    return json.loads(body)
+
+
+# ----------------------------------------------------------------------
+# client
+# ----------------------------------------------------------------------
+class _Pending:
+    """One in-flight verb awaiting its response frame."""
+
+    __slots__ = ("event", "code", "body", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.code: Optional[int] = None
+        self.body: Any = None
+        self.error: Optional[MuxError] = None
+
+
+class MuxWatch:
+    """One watch stream riding the mux connection.
+
+    Iterates JSON-line byte strings — the exact shape ``urllib``'s chunked
+    watch response yields line by line — so ``_WatchThread`` consumes both
+    transports through one loop. ``shutdown()`` mirrors the raw-socket
+    shutdown the HTTP path uses to unblock a reader from another thread.
+    """
+
+    _END = object()
+
+    def __init__(self, conn: "_MuxConn", stream_id: int, timeout: float) -> None:
+        self._conn = conn
+        self._id = stream_id
+        self._timeout = timeout
+        self._events: "queue.Queue[Any]" = queue.Queue()
+        self._closed = False
+
+    # fed by the connection reader thread
+    def _push(self, event: Dict[str, Any]) -> None:
+        self._events.put(event)
+
+    def _end(self) -> None:
+        self._events.put(self._END)
+
+    def __iter__(self) -> "MuxWatch":
+        return self
+
+    def __next__(self) -> bytes:
+        if self._closed:
+            raise StopIteration
+        try:
+            # The per-event timeout doubles as the liveness check, exactly
+            # like the HTTP watch's socket timeout: a quiet stream raises
+            # and the watch thread reconnects from its resume cursor.
+            item = self._events.get(timeout=self._timeout)
+        except queue.Empty:
+            raise socket.timeout(f"mux watch {self._id}: idle") from None
+        if item is self._END:
+            self._closed = True
+            raise StopIteration
+        return (json.dumps(item) + "\n").encode()
+
+    def shutdown(self) -> None:
+        """Stop the stream from another thread: best-effort cancel to the
+        server, then a local end marker so a blocked __next__ returns."""
+        self._conn.cancel_watch(self._id)
+        self._end()
+
+    close = shutdown
+
+
+class _MuxConn:
+    """One live framed connection: socket, reader thread, correlation maps."""
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.rfile = sock.makefile("rb")
+        self._wlock = threading.Lock()
+        self._lock = threading.Lock()
+        self._pending: Dict[int, _Pending] = {}
+        self._watches: Dict[int, MuxWatch] = {}
+        self.dead = threading.Event()
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True, name="mux-reader"
+        )
+        self._reader.start()
+
+    # -- sending -------------------------------------------------------
+    def send(self, frame: Dict[str, Any]) -> None:
+        data = encode_frame(frame)
+        try:
+            with self._wlock:
+                self.sock.sendall(data)
+        except OSError as e:
+            self._fail(MuxError(f"mux send: {e}"))
+            raise MuxError(f"mux send: {e}") from None
+
+    def cancel_watch(self, stream_id: int) -> None:
+        with self._lock:
+            self._watches.pop(stream_id, None)
+        if not self.dead.is_set():
+            try:
+                self.send({"cancel": stream_id})
+            except MuxError:
+                pass
+
+    # -- registration --------------------------------------------------
+    def add_pending(self, rid: int) -> _Pending:
+        p = _Pending()
+        with self._lock:
+            if self.dead.is_set():
+                raise MuxError("mux connection is down")
+            self._pending[rid] = p
+        return p
+
+    def drop_pending(self, rid: int) -> None:
+        with self._lock:
+            self._pending.pop(rid, None)
+
+    def add_watch(self, rid: int, w: MuxWatch) -> None:
+        with self._lock:
+            if self.dead.is_set():
+                raise MuxError("mux connection is down")
+            self._watches[rid] = w
+
+    # -- reader --------------------------------------------------------
+    def _read_loop(self) -> None:
+        err: Optional[MuxError] = None
+        try:
+            while True:
+                frame = read_frame(self.rfile)
+                if frame is None:
+                    err = MuxError("mux connection closed by server")
+                    break
+                self._dispatch(frame)
+        except (MuxError, OSError, ValueError) as e:
+            err = e if isinstance(e, MuxError) else MuxError(f"mux read: {e}")
+        self._fail(err or MuxError("mux connection closed"))
+
+    def _dispatch(self, frame: Dict[str, Any]) -> None:
+        if "watch" in frame and "id" not in frame:
+            sid = frame["watch"]
+            with self._lock:
+                w = self._watches.get(sid)
+                if frame.get("end"):
+                    self._watches.pop(sid, None)
+            if w is None:
+                return
+            if frame.get("end"):
+                w._end()
+            else:
+                w._push(frame.get("event") or {})
+            return
+        rid = frame.get("id")
+        with self._lock:
+            p = self._pending.pop(rid, None)
+        if p is None:
+            return  # response to a request whose waiter timed out
+        p.code = int(frame.get("code", 500))
+        p.body = frame.get("body")
+        p.event.set()
+
+    def _fail(self, err: MuxError) -> None:
+        """Connection is gone: everything in flight fails, every watch
+        stream ends (its consumer reconnects with a resume cursor)."""
+        with self._lock:
+            if self.dead.is_set():
+                return
+            self.dead.set()
+            pending = list(self._pending.values())
+            self._pending.clear()
+            watches = list(self._watches.values())
+            self._watches.clear()
+        for p in pending:
+            p.error = err
+            p.event.set()
+        for w in watches:
+            w._end()
+        self.close()
+
+    def close(self) -> None:
+        self.dead.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class MuxClient:
+    """Multiplexed apiserver client: one connection, many concurrent verbs
+    and watches. Thread-safe; reconnects transparently on the next call
+    after a connection loss (watch consumers re-open their own streams)."""
+
+    def __init__(
+        self,
+        base_url: str,
+        ssl_context: Optional[ssl.SSLContext] = None,
+        token: Optional[str] = None,
+        connect_timeout: float = 10.0,
+    ) -> None:
+        split = urllib.parse.urlsplit(base_url)
+        self._host = split.hostname or "127.0.0.1"
+        self._port = split.port or (443 if split.scheme == "https" else 80)
+        self._tls = split.scheme == "https"
+        self._ssl_ctx = ssl_context
+        self._token = token
+        self._connect_timeout = connect_timeout
+        self._ids = itertools.count(1)
+        self._conn: Optional[_MuxConn] = None
+        self._conn_lock = threading.Lock()
+        self._closed = False
+
+    # -- connection management -----------------------------------------
+    def _handshake(self) -> _MuxConn:
+        try:
+            sock = socket.create_connection(
+                (self._host, self._port), timeout=self._connect_timeout
+            )
+        except OSError as e:
+            raise MuxError(f"mux connect {self._host}:{self._port}: {e}") from None
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        try:
+            if self._tls:
+                ctx = self._ssl_ctx or ssl.create_default_context()
+                sock = ctx.wrap_socket(sock, server_hostname=self._host)
+            lines = [
+                f"GET {MUX_PATH} HTTP/1.1",
+                f"Host: {self._host}:{self._port}",
+                f"Upgrade: {PROTOCOL}",
+                "Connection: Upgrade",
+            ]
+            if self._token:
+                lines.append(f"Authorization: Bearer {self._token}")
+            sock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
+            # Read the HTTP response head byte-by-byte up to the blank line —
+            # anything past it is the first frame and must stay in the stream.
+            head = b""
+            while b"\r\n\r\n" not in head:
+                b1 = sock.recv(1)
+                if not b1:
+                    raise MuxError("mux handshake: connection closed")
+                head += b1
+                if len(head) > 65536:
+                    raise MuxError("mux handshake: oversized response head")
+            status = head.split(b"\r\n", 1)[0].decode(errors="replace")
+            parts = status.split()
+            if len(parts) < 2 or parts[1] != "101":
+                raise MuxUnsupported(
+                    f"server declined mux upgrade: {status!r}"
+                )
+        except MuxError:
+            sock.close()
+            raise
+        except OSError as e:
+            sock.close()
+            raise MuxError(f"mux handshake: {e}") from None
+        # Handshake done: clear the connect timeout — reads are framed and
+        # blocking from here; per-request deadlines live client-side.
+        sock.settimeout(None)
+        return _MuxConn(sock)
+
+    def _ensure_conn(self) -> _MuxConn:
+        conn = self._conn
+        if conn is not None and not conn.dead.is_set():
+            return conn
+        with self._conn_lock:
+            if self._closed:
+                raise MuxError("mux client closed")
+            conn = self._conn
+            if conn is not None and not conn.dead.is_set():
+                return conn
+            conn = self._handshake()
+            self._conn = conn
+            return conn
+
+    # -- verbs ---------------------------------------------------------
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict[str, Any]] = None,
+        timeout: float = 30.0,
+    ) -> Tuple[int, Any]:
+        """One pipelined verb. Returns (status code, decoded body). Retries
+        once on a send that hit an already-dead pooled connection (same
+        recovery the keep-alive HTTP path does); a connection that dies
+        while the request is in flight surfaces as MuxError — the caller's
+        normal retry/absorb policy applies."""
+        for attempt in (0, 1):
+            conn = self._ensure_conn()
+            rid = next(self._ids)
+            pending = conn.add_pending(rid)
+            try:
+                conn.send({"id": rid, "method": method, "path": path,
+                           "body": body})
+            except MuxError:
+                conn.drop_pending(rid)
+                if attempt == 0:
+                    continue
+                raise
+            if not pending.event.wait(timeout):
+                conn.drop_pending(rid)
+                raise MuxError(f"{method} {path}: mux response timeout")
+            if pending.error is not None:
+                raise pending.error
+            return pending.code or 500, pending.body
+        raise MuxError(f"{method} {path}: mux retry fell through")
+
+    def watch(self, path: str, timeout: float = 30.0) -> MuxWatch:
+        """Open a watch stream (path carries ``watch=true`` + resume rv).
+        Returns once the server acks; raises MuxHTTPError on denial (e.g.
+        a fail-hook 503) so callers map it like an HTTP error status."""
+        conn = self._ensure_conn()
+        rid = next(self._ids)
+        pending = conn.add_pending(rid)
+        w = MuxWatch(conn, rid, timeout)
+        conn.add_watch(rid, w)
+        try:
+            conn.send({"id": rid, "method": "GET", "path": path, "body": None})
+        except MuxError:
+            conn.drop_pending(rid)
+            raise
+        if not pending.event.wait(timeout):
+            conn.drop_pending(rid)
+            conn.cancel_watch(rid)
+            raise MuxError(f"GET {path}: mux watch-open timeout")
+        if pending.error is not None:
+            raise pending.error
+        if (pending.code or 500) >= 400:
+            with conn._lock:
+                conn._watches.pop(rid, None)
+            raise MuxHTTPError(pending.code or 500, pending.body)
+        return w
+
+    def close(self) -> None:
+        with self._conn_lock:
+            self._closed = True
+            conn, self._conn = self._conn, None
+        if conn is not None:
+            conn.close()

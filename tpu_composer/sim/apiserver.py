@@ -17,7 +17,11 @@ semantics — without vendoring the binaries: this server implements
 - spec-change generation bump; system-owned uid/creationTimestamp;
 - ``?labelSelector=`` equality filtering on lists;
 - ``?watch=true`` chunked streaming watches with ``resourceVersion``
-  resume and JSON-per-line events, ADDED/MODIFIED/DELETED.
+  resume and JSON-per-line events, ADDED/MODIFIED/DELETED;
+- the ``tpuc-mux/1`` framed transport (``GET /mux`` + Upgrade): every verb
+  and watch of one client multiplexed over a single socket as
+  length-prefixed JSON frames (runtime/wiremux.py defines the protocol),
+  served alongside plain HTTP by the same verb plane.
 
 Promoted from tests/fake_apiserver.py (which re-exports this module) so it
 is launchable as a standalone shared store for the proc-mode fleet
@@ -25,12 +29,19 @@ is launchable as a standalone shared store for the proc-mode fleet
 
     python -m tpu_composer.sim.apiserver --nodes 8 --url-file /tmp/api.json
 
-Concurrency contract (multi-process hardening): every rv allocation, object
-mutation, and watch-event publication happens under ``_State.lock``, so the
-event log is totally ordered by rv no matter how many client processes write
-in parallel; a CAS PUT observes-and-replaces atomically (lost updates are
-impossible — one of two racing writers gets 409 Conflict); the listen
-backlog is sized for whole fleets of replicas dialing at once.
+Concurrency contract (multi-process hardening): state is sharded per kind —
+each path prefix owns a ``_KindState`` with its own lock, objects, watch
+fanout, and bounded event log — so replicas writing different kinds never
+serialize on each other (the pre-r11 single ``_State.lock`` made the sim
+the fleet's scaling ceiling). Within one kind, every rv allocation, object
+mutation, and watch-event publication happens under that kind's lock, so
+the per-kind event log is totally ordered by rv no matter how many client
+processes write in parallel; a CAS PUT observes-and-replaces atomically
+(lost updates are impossible — one of two racing writers gets 409
+Conflict). resourceVersions still come from one global monotonic counter
+(its own small leaf lock), so rvs stay comparable across kinds exactly as
+one etcd revision counter serves all keys. The listen backlog is sized for
+whole fleets of replicas dialing at once.
 
 Used by test_kubestore.py for the full operator e2e on a cluster-shaped API,
 by bench.py's attach_cluster/proc_scaling benches, and by ProcFleet as the
@@ -48,9 +59,12 @@ import threading
 import time
 import urllib.request
 import uuid
+from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Deque, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlparse
+
+from tpu_composer.runtime import wiremux
 
 #: Listen backlog. ThreadingHTTPServer's default request_queue_size of 5 is
 #: tuned for one polite in-process client; a 4-replica proc fleet (each with
@@ -63,6 +77,13 @@ _LISTEN_BACKLOG = 128
 #: cache-efficiency assertions in unit tests (thousands of entries at most);
 #: under a macro-scale churn bench it would otherwise grow without bound.
 _REQUEST_LOG_CAP = 100_000
+
+#: Verb workers per mux connection. Frames pipeline from every controller
+#: thread of one replica; handling them serially would stack the injected
+#: latency_s (the RTT model) request-by-request and erase the pipelining the
+#: transport exists for. Sixteen matches a replica's plausible concurrent
+#: verb count (reconcile workers + lease + telemetry + syncer).
+_MUX_VERB_WORKERS = 16
 
 
 def _apply_jsonpatch(obj: Dict[str, Any], patch: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -95,17 +116,19 @@ def _apply_jsonpatch(obj: Dict[str, Any], patch: List[Dict[str, Any]]) -> Dict[s
     return out
 
 
+def _status_doc(code: int, reason: str, message: str) -> Dict[str, Any]:
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure",
+        "code": code,
+        "reason": reason,
+        "message": message,
+    }
+
+
 def _status_body(code: int, reason: str, message: str) -> bytes:
-    return json.dumps(
-        {
-            "kind": "Status",
-            "apiVersion": "v1",
-            "status": "Failure",
-            "code": code,
-            "reason": reason,
-            "message": message,
-        }
-    ).encode()
+    return json.dumps(_status_doc(code, reason, message)).encode()
 
 
 class _Server(ThreadingHTTPServer):
@@ -126,66 +149,178 @@ class _Server(ThreadingHTTPServer):
         super().handle_error(request, client_address)
 
 
-class _State:
-    """The 'etcd' — one rv counter, objects by (prefix, name), watch fanout,
-    and a bounded per-prefix event log with a compaction horizon (real etcd
-    compacts; a watch resuming from before the horizon gets 410 Expired)."""
+class _KindState:
+    """One kind's shard of the 'etcd': its own lock, objects by name, watch
+    fanout, and a bounded event log with a per-kind compaction horizon."""
 
     def __init__(self) -> None:
         self.lock = threading.RLock()
-        self.rv = 0
-        # (path_prefix, name) -> object dict
-        self.objects: Dict[Tuple[str, str], Dict[str, Any]] = {}
-        # watch subscribers: list of (path_prefix, queue-ish list, condition)
-        self.watchers: List[Tuple[str, List[Dict[str, Any]], threading.Condition]] = []
+        self.objects: Dict[str, Dict[str, Any]] = {}
+        # watch subscribers: (buffer, condition) pairs
+        self.watchers: List[Tuple[List[Dict[str, Any]], threading.Condition]] = []
         # True event history, exactly as etcd's WAL serves watch resumes:
-        # (rv, prefix, type, object). A resume within the horizon replays
-        # real events — including DELETED, which the current-state replay
-        # the pre-r5 fake did could never produce.
-        self.event_log: List[Tuple[int, str, str, Dict[str, Any]]] = []
+        # (rv, type, object). A resume within the horizon replays real
+        # events — including DELETED, which the current-state replay the
+        # pre-r5 fake did could never produce.
+        self.event_log: List[Tuple[int, str, Dict[str, Any]]] = []
         # Watches resuming from rv <= compacted_rv are answered with an
         # ERROR event carrying a 410 Status, like a compacted etcd.
         self.compacted_rv = 0
 
+
+class _ObjectsView:
+    """(prefix, name)-keyed dict facade over the per-kind shards.
+
+    Harness code that predates sharding (tests, bench pollers, ProcFleet's
+    shard/intent scans) reads and mutates ``state.objects`` as one flat
+    dict; this view keeps that surface while each operation takes only the
+    touched shard's lock. ``items()`` is a cross-shard snapshot — each
+    shard internally consistent, shards read in sequence."""
+
+    _MISSING = object()
+
+    def __init__(self, state: "_State") -> None:
+        self._state = state
+
+    def items(self) -> List[Tuple[Tuple[str, str], Dict[str, Any]]]:
+        out: List[Tuple[Tuple[str, str], Dict[str, Any]]] = []
+        for prefix, ks in self._state.kinds():
+            with ks.lock:
+                out.extend(
+                    ((prefix, name), obj)
+                    for name, obj in sorted(ks.objects.items())
+                )
+        return out
+
+    def keys(self) -> List[Tuple[str, str]]:
+        return [k for k, _ in self.items()]
+
+    def values(self) -> List[Dict[str, Any]]:
+        return [v for _, v in self.items()]
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        total = 0
+        for _, ks in self._state.kinds():
+            with ks.lock:
+                total += len(ks.objects)
+        return total
+
+    def get(self, key: Tuple[str, str], default: Any = None) -> Any:
+        prefix, name = key
+        ks = self._state.kind(prefix)
+        with ks.lock:
+            return ks.objects.get(name, default)
+
+    def __getitem__(self, key: Tuple[str, str]) -> Dict[str, Any]:
+        out = self.get(key, self._MISSING)
+        if out is self._MISSING:
+            raise KeyError(key)
+        return out
+
+    def __contains__(self, key: Tuple[str, str]) -> bool:
+        return self.get(key, self._MISSING) is not self._MISSING
+
+    def __setitem__(self, key: Tuple[str, str], obj: Dict[str, Any]) -> None:
+        prefix, name = key
+        ks = self._state.kind(prefix)
+        with ks.lock:
+            ks.objects[name] = obj
+
+    def __delitem__(self, key: Tuple[str, str]) -> None:
+        prefix, name = key
+        ks = self._state.kind(prefix)
+        with ks.lock:
+            del ks.objects[name]
+
+    def pop(self, key: Tuple[str, str], *default: Any) -> Any:
+        prefix, name = key
+        ks = self._state.kind(prefix)
+        with ks.lock:
+            return ks.objects.pop(name, *default)
+
+
+class _State:
+    """The 'etcd' — one global rv counter over per-kind shards, each with
+    its own objects, watch fanout, and bounded event log (real etcd
+    compacts; a watch resuming from before the horizon gets 410 Expired).
+
+    ``lock`` survives as the legacy coarse lock: external harnesses hold it
+    around multi-step reads of ``objects``; the server's own verb paths use
+    only the per-kind shard locks (that coarse lock serializing all
+    replicas was the proc-scaling ceiling ROADMAP item 1 named)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self._rv_lock = threading.Lock()
+        self._rv = 0
+        self._kinds: Dict[str, _KindState] = {}
+        self._kinds_lock = threading.Lock()
+        # (path_prefix, name)-keyed dict facade over the shards
+        self.objects = _ObjectsView(self)
+
+    @property
+    def rv(self) -> int:
+        return self._rv
+
+    def kind(self, prefix: str) -> _KindState:
+        with self._kinds_lock:
+            ks = self._kinds.get(prefix)
+            if ks is None:
+                ks = self._kinds[prefix] = _KindState()
+            return ks
+
+    def kinds(self) -> List[Tuple[str, _KindState]]:
+        with self._kinds_lock:
+            return sorted(self._kinds.items())
+
     def next_rv(self) -> int:
-        self.rv += 1
-        return self.rv
+        # Leaf lock (kind lock → rv lock): rvs stay globally comparable
+        # across shards, like one etcd revision counter over all keys.
+        with self._rv_lock:
+            self._rv += 1
+            return self._rv
 
     def notify(self, prefix: str, etype: str, obj: Dict[str, Any]) -> None:
-        # ONE immutable snapshot shared by the event log and every watcher
-        # buffer: callers hold self.lock, watch writers only serialize, and
-        # nothing mutates a published event — so the per-watcher deep-copy
-        # the pre-proc fake did was O(watchers × object) for nothing. With
-        # 4 process replicas each watching every kind, that constant
-        # matters at churn-bench rates.
+        """Publish one event. Caller holds ``kind(prefix).lock`` — that is
+        what totally orders the kind's event log by rv. ONE immutable
+        snapshot is shared by the event log and every watcher buffer:
+        nothing mutates a published event, so the per-watcher deep-copy
+        the pre-proc fake did was O(watchers × object) for nothing."""
+        ks = self.kind(prefix)
         snapshot = json.loads(json.dumps(obj))
         event = {"type": etype, "object": snapshot}
-        self.event_log.append(
-            (int(snapshot["metadata"]["resourceVersion"]), prefix, etype, snapshot)
+        ks.event_log.append(
+            (int(snapshot["metadata"]["resourceVersion"]), etype, snapshot)
         )
-        if len(self.event_log) > 10_000:
+        if len(ks.event_log) > 10_000:
             # Rolling auto-compaction, like etcd's: dropping history moves
             # the 410 horizon forward, so long soaks stay bounded and
             # clients resuming from far behind get the Expired persona.
-            dropped = self.event_log[:5_000]
-            self.event_log = self.event_log[5_000:]
-            self.compacted_rv = max(self.compacted_rv, dropped[-1][0])
-        for wprefix, buf, cond in list(self.watchers):
-            if wprefix == prefix:
-                with cond:
-                    buf.append(event)
-                    cond.notify_all()
+            dropped = ks.event_log[:5_000]
+            ks.event_log = ks.event_log[5_000:]
+            ks.compacted_rv = max(ks.compacted_rv, dropped[-1][0])
+        for buf, cond in list(ks.watchers):
+            with cond:
+                buf.append(event)
+                cond.notify_all()
 
     def compact(self, up_to_rv: Optional[int] = None) -> None:
-        """Discard event history ≤ up_to_rv (default: everything so far).
-        The next watch resume from inside the discarded range gets 410."""
-        horizon = self.rv if up_to_rv is None else up_to_rv
-        self.compacted_rv = max(self.compacted_rv, horizon)
-        self.event_log = [e for e in self.event_log if e[0] > horizon]
+        """Discard event history ≤ up_to_rv (default: everything so far)
+        in every shard. The next watch resume from inside the discarded
+        range gets 410."""
+        horizon = self._rv if up_to_rv is None else up_to_rv
+        for _, ks in self.kinds():
+            with ks.lock:
+                ks.compacted_rv = max(ks.compacted_rv, horizon)
+                ks.event_log = [e for e in ks.event_log if e[0] > horizon]
 
 
 class FakeApiServer:
-    """HTTP kube-apiserver fake. ``resources`` maps path prefixes to config:
+    """HTTP + mux kube-apiserver fake. ``resources`` maps path prefixes to
+    config:
 
         {"/apis/tpu.composer.dev/v1alpha1/composabilityrequests":
              {"kind": "ComposabilityRequest"}, ...}
@@ -200,8 +335,10 @@ class FakeApiServer:
         self.state = _State()
         self.fail_hooks: List[Any] = []  # callables (method, path) -> Optional[(code, reason, msg)]
         # Wire-level request log [(method, path)] — the envtest-style probe
-        # for how chatty a client is (cache-efficiency assertions). Bounded:
-        # a macro-scale churn run would otherwise hold every request ever.
+        # for how chatty a client is (cache-efficiency assertions). Mux
+        # verbs log the same (method, path) strings as HTTP ones, so the
+        # assertions hold on either transport. Bounded: a macro-scale churn
+        # run would otherwise hold every request ever.
         self.request_log: Deque[Tuple[str, str]] = collections.deque(
             maxlen=_REQUEST_LOG_CAP
         )
@@ -215,12 +352,15 @@ class FakeApiServer:
         # is applied to the object before it is stored.
         self.webhooks: List[Dict[str, Any]] = []
         # Injected per-request latency (seconds) — models apiserver RTT for
-        # latency benchmarks. Applied once per HTTP request (streaming watch
-        # events after connect are push, not request/response).
+        # latency benchmarks. Applied once per request on either transport
+        # (streaming watch events after connect are push, not
+        # request/response).
         self.latency_s: float = 0.0
         # Live streaming-watch sockets, for the socket-kill persona
         # (kill_watch_connections): a mid-stream TCP reset is how real
-        # apiserver restarts/LB failovers present to client watches.
+        # apiserver restarts/LB failovers present to client watches. A mux
+        # connection carrying watches registers here too — killing it takes
+        # the verbs down with the watches, exactly like an LB failover.
         self.active_watch_conns: List[Any] = []
         server = self
 
@@ -239,14 +379,12 @@ class FakeApiServer:
                 pass
 
             def _deny(self, code: int, reason: str, message: str) -> None:
-                body = _status_body(code, reason, message)
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send(code, _status_doc(code, reason, message))
 
             def _ok(self, payload: Dict[str, Any], code: int = 200) -> None:
+                self._send(code, payload)
+
+            def _send(self, code: int, payload: Dict[str, Any]) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -254,119 +392,34 @@ class FakeApiServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _route(self) -> Optional[Tuple[str, Optional[str], Dict[str, Any], bool]]:
-                """→ (prefix, name|None, resource_cfg, is_status)"""
-                parsed = urlparse(self.path)
-                path = unquote(parsed.path).rstrip("/")
-                for prefix, cfg in server.resources.items():
-                    if path == prefix:
-                        return prefix, None, cfg, False
-                    if path.startswith(prefix + "/"):
-                        rest = path[len(prefix) + 1 :]
-                        if rest.endswith("/status"):
-                            return prefix, rest[: -len("/status")], cfg, True
-                        if "/" not in rest:
-                            return prefix, rest, cfg, False
-                return None
-
             def _maybe_fail(self) -> bool:
-                with server.state.lock:
-                    server.request_log.append((self.command, self.path))
-                if server.latency_s:
-                    time.sleep(server.latency_s)
-                # Snapshot: hooks are armed/disarmed from other threads
-                # (and, proc-mode, while many handler threads are in here).
-                for hook in list(server.fail_hooks):
-                    out = hook(self.command, self.path)
-                    if out:
-                        self._deny(*out)
-                        return True
+                out = server._check_fail(self.command, self.path)
+                if out:
+                    self._deny(*out)
+                    return True
                 return False
 
-            # ---- verbs ----
+            # ---- verbs (thin shims over the shared verb plane) ----
             def do_GET(self) -> None:
+                if urlparse(self.path).path == wiremux.MUX_PATH:
+                    return self._mux_session()
                 if self._maybe_fail():
                     return
-                routed = self._route()
+                routed = server._route_path(self.path)
                 if not routed:
                     return self._deny(404, "NotFound", f"no route {self.path}")
                 prefix, name, cfg, _ = routed
                 qs = parse_qs(urlparse(self.path).query)
-                st = server.state
-                if name:
-                    with st.lock:
-                        obj = st.objects.get((prefix, name))
-                    if obj is None:
-                        return self._deny(404, "NotFound", f"{name} not found")
-                    return self._ok(obj)
-                if qs.get("watch", ["false"])[0] == "true":
+                if not name and qs.get("watch", ["false"])[0] == "true":
                     return self._watch(prefix, qs)
-                with st.lock:
-                    items = [
-                        o for (p, _), o in sorted(st.objects.items()) if p == prefix
-                    ]
-                    list_rv = st.rv
-                sel = qs.get("labelSelector", [None])[0]
-                if sel:
-                    pairs = dict(kv.split("=", 1) for kv in sel.split(","))
-                    items = [
-                        o
-                        for o in items
-                        if all(
-                            (o["metadata"].get("labels") or {}).get(k) == v
-                            for k, v in pairs.items()
-                        )
-                    ]
-                return self._ok(
-                    {
-                        "kind": cfg["kind"] + "List",
-                        "apiVersion": cfg.get("apiVersion", "v1"),
-                        # rv snapshotted under the same lock as the items:
-                        # a list must never advertise an rv newer than its
-                        # contents, or a watch resumed from it skips events
-                        # (only observable with parallel writer processes).
-                        "metadata": {"resourceVersion": str(list_rv)},
-                        "items": items,
-                    }
-                )
+                code, payload = server.handle_verb("GET", self.path, None)
+                self._send(code, payload)
 
             def _watch(self, prefix: str, qs: Dict[str, List[str]]) -> None:
                 st = server.state
+                ks = st.kind(prefix)
                 since = int(qs.get("resourceVersion", ["0"])[0] or 0)
-                buf: List[Dict[str, Any]] = []
-                cond = threading.Condition()
-                expired = False
-                with st.lock:
-                    if since and since < st.compacted_rv:
-                        # Resume from inside the compacted range: a real
-                        # apiserver answers 200 + one ERROR event carrying a
-                        # 410 Status, then ends the watch. The client must
-                        # relist (this is the path envtest exercises that a
-                        # replay-current-state fake never can).
-                        expired = True
-                    elif since:
-                        # Faithful resume: replay the true event history —
-                        # including DELETED — exactly as etcd serves a watch
-                        # from a historical rv inside the horizon. Replay and
-                        # subscription happen under ONE lock hold, so a write
-                        # landing while we replay is either in the history we
-                        # replay or in the buffer we just subscribed — never
-                        # both, never neither (the lost-event/duplicate race
-                        # a 4-process hammer exposes immediately).
-                        for rv, p, etype, o in st.event_log:
-                            if p == prefix and rv > since:
-                                buf.append({"type": etype, "object": o})
-                        st.watchers.append((prefix, buf, cond))
-                    else:
-                        # No resume rv: current state as ADDED (legacy
-                        # list+watch-from-now shape).
-                        for (p, _), o in sorted(st.objects.items()):
-                            if p == prefix:
-                                buf.append(
-                                    {"type": "ADDED",
-                                     "object": json.loads(json.dumps(o))}
-                                )
-                        st.watchers.append((prefix, buf, cond))
+                buf, cond, expired = server._subscribe(prefix, since)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -377,20 +430,9 @@ class FakeApiServer:
                     self.wfile.write(f"{len(line):x}\r\n".encode())
                     self.wfile.write(line + b"\r\n")
 
-                if expired:
+                if expired is not None:
                     try:
-                        _write({
-                            "type": "ERROR",
-                            "object": {
-                                "kind": "Status", "apiVersion": "v1",
-                                "status": "Failure", "code": 410,
-                                "reason": "Expired",
-                                "message": (
-                                    f"too old resource version: {since} "
-                                    f"({st.compacted_rv})"
-                                ),
-                            },
-                        })
+                        _write(expired)
                         self.wfile.flush()
                     except (BrokenPipeError, ConnectionResetError, OSError):
                         pass
@@ -409,10 +451,11 @@ class FakeApiServer:
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
                 finally:
-                    with st.lock:
-                        st.watchers = [
-                            w for w in st.watchers if w[1] is not buf
+                    with ks.lock:
+                        ks.watchers = [
+                            w for w in ks.watchers if w[0] is not buf
                         ]
+                    with st.lock:
                         try:
                             server.active_watch_conns.remove(self.connection)
                         except ValueError:
@@ -422,192 +465,458 @@ class FakeApiServer:
                 n = int(self.headers.get("Content-Length", "0"))
                 return json.loads(self.rfile.read(n) or b"{}")
 
-            def _admit(self, prefix: str, operation: str,
-                       obj: Dict[str, Any],
-                       old: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
-                """Run registered webhooks over the wire. Returns the
-                (possibly patched) object, or None after sending a denial."""
-                for hook in list(server.webhooks):
-                    if hook["prefix"] != prefix:
-                        continue
-                    if operation not in hook.get("operations", {"CREATE", "UPDATE"}):
-                        continue
-                    review = {
-                        "apiVersion": "admission.k8s.io/v1",
-                        "kind": "AdmissionReview",
-                        "request": {
-                            "uid": str(uuid.uuid4()),
-                            "operation": operation,
-                            "object": obj,
-                            "oldObject": old,
-                        },
-                    }
-                    data = json.dumps(review).encode()
-                    req = urllib.request.Request(
-                        hook["url"], data=data, method="POST",
-                        headers={"Content-Type": "application/json"},
-                    )
-                    kwargs: Dict[str, Any] = {"timeout": 10}
-                    if hook["url"].startswith("https"):
-                        ctx = ssl.create_default_context()
-                        ctx.check_hostname = False
-                        ctx.verify_mode = ssl.CERT_NONE  # self-signed test certs
-                        kwargs["context"] = ctx
-                    try:
-                        with urllib.request.urlopen(req, **kwargs) as resp:
-                            out = json.loads(resp.read())
-                    except (OSError, ValueError) as e:
-                        # failurePolicy: Fail — the reference's default for
-                        # its validating webhook.
-                        self._deny(500, "InternalError",
-                                   f"webhook {hook['url']} unreachable: {e}")
-                        return None
-                    response = out.get("response") or {}
-                    if not response.get("allowed", False):
-                        msg = ((response.get("status") or {}).get("message")
-                               or "admission denied")
-                        self._deny(403, "Forbidden", msg)
-                        return None
-                    if response.get("patch"):
-                        patch = json.loads(
-                            base64.b64decode(response["patch"]))
-                        obj = _apply_jsonpatch(obj, patch)
-                return obj
-
             def do_POST(self) -> None:
+                body = self._read_body()
                 if self._maybe_fail():
                     return
-                routed = self._route()
-                if not routed:
-                    return self._deny(404, "NotFound", f"no route {self.path}")
-                prefix, name, cfg, _ = routed
-                if name:
-                    return self._deny(405, "MethodNotAllowed", "POST to item")
-                obj = self._read_body()
-                meta = obj.setdefault("metadata", {})
-                oname = meta.get("name", "")
-                if not oname:
-                    return self._deny(422, "Invalid", "metadata.name required")
-                obj = self._admit(prefix, "CREATE", obj, None)
-                if obj is None:
-                    return  # webhook denied; response already sent
-                meta = obj.setdefault("metadata", {})
-                st = server.state
-                with st.lock:
-                    if (prefix, oname) in st.objects:
-                        return self._deny(
-                            409, "AlreadyExists", f"{oname} already exists"
-                        )
-                    meta["uid"] = meta.get("uid") or str(uuid.uuid4())
-                    meta["resourceVersion"] = str(st.next_rv())
-                    meta["generation"] = 1
-                    meta.setdefault(
-                        "creationTimestamp",
-                        time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                    )
-                    meta.pop("deletionTimestamp", None)
-                    st.objects[(prefix, oname)] = obj
-                    st.notify(prefix, "ADDED", obj)
-                return self._ok(obj, 201)
+                code, payload = server.handle_verb("POST", self.path, body)
+                self._send(code, payload)
 
             def do_PUT(self) -> None:
+                body = self._read_body()
                 if self._maybe_fail():
                     return
-                routed = self._route()
-                if not routed:
-                    return self._deny(404, "NotFound", f"no route {self.path}")
-                prefix, name, cfg, is_status = routed
-                if not name:
-                    return self._deny(405, "MethodNotAllowed", "PUT to collection")
-                incoming = self._read_body()
-                st = server.state
-                # Admission sees spec updates, not status subresource writes
-                # (matching real webhook rules scoped to the main resource).
-                if not is_status:
-                    with st.lock:
-                        old = st.objects.get((prefix, name))
-                        old = json.loads(json.dumps(old)) if old else None
-                    incoming = self._admit(prefix, "UPDATE", incoming, old)
-                    if incoming is None:
-                        return
-                with st.lock:
-                    stored = st.objects.get((prefix, name))
-                    if stored is None:
-                        return self._deny(404, "NotFound", f"{name} not found")
-                    in_rv = str(incoming.get("metadata", {}).get("resourceVersion", ""))
-                    if in_rv and in_rv != stored["metadata"]["resourceVersion"]:
-                        return self._deny(
-                            409,
-                            "Conflict",
-                            f"resourceVersion {in_rv} != {stored['metadata']['resourceVersion']}",
-                        )
-                    new = json.loads(json.dumps(stored))
-                    if is_status:
-                        new["status"] = incoming.get("status", {})
-                    else:
-                        spec_changed = incoming.get("spec") != stored.get("spec")
-                        new["spec"] = incoming.get("spec", {})
-                        # mutable metadata
-                        im = incoming.get("metadata", {})
-                        for k in ("labels", "annotations", "finalizers", "ownerReferences"):
-                            if k in im:
-                                new["metadata"][k] = im[k]
-                            else:
-                                new["metadata"].pop(k, None)
-                        if spec_changed:
-                            new["metadata"]["generation"] = (
-                                int(stored["metadata"].get("generation", 1)) + 1
-                            )
-                    new["metadata"]["resourceVersion"] = str(st.next_rv())
-                    if (
-                        new["metadata"].get("deletionTimestamp")
-                        and not new["metadata"].get("finalizers")
-                    ):
-                        del st.objects[(prefix, name)]
-                        st.notify(prefix, "DELETED", new)
-                        return self._ok(new)
-                    st.objects[(prefix, name)] = new
-                    st.notify(prefix, "MODIFIED", new)
-                    return self._ok(new)
+                code, payload = server.handle_verb("PUT", self.path, body)
+                self._send(code, payload)
 
             def do_DELETE(self) -> None:
                 if self._maybe_fail():
                     return
-                routed = self._route()
-                if not routed:
-                    return self._deny(404, "NotFound", f"no route {self.path}")
-                prefix, name, cfg, _ = routed
-                if not name:
-                    return self._deny(405, "MethodNotAllowed", "DELETE collection")
-                st = server.state
-                with st.lock:
-                    stored = st.objects.get((prefix, name))
-                    if stored is None:
-                        return self._deny(404, "NotFound", f"{name} not found")
-                    if stored["metadata"].get("finalizers"):
-                        if not stored["metadata"].get("deletionTimestamp"):
-                            new = json.loads(json.dumps(stored))
-                            new["metadata"]["deletionTimestamp"] = time.strftime(
-                                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                code, payload = server.handle_verb("DELETE", self.path, None)
+                self._send(code, payload)
+
+            # ---- tpuc-mux/1 framed transport ----
+            def _mux_session(self) -> None:
+                """Upgrade this connection to framed mode and serve it until
+                EOF: verbs pipeline through a small worker pool, each watch
+                gets a dedicated pusher thread (the HTTP equivalent is one
+                handler thread per watch connection). All response and push
+                frames serialize on one write lock."""
+                self.send_response(101, "Switching Protocols")
+                self.send_header("Upgrade", wiremux.PROTOCOL)
+                self.send_header("Connection", "Upgrade")
+                self.end_headers()
+                self.wfile.flush()
+                self.close_connection = True
+                conn = self.connection
+                wlock = threading.Lock()
+                watch_stops: Dict[int, threading.Event] = {}
+                pool = ThreadPoolExecutor(
+                    max_workers=_MUX_VERB_WORKERS, thread_name_prefix="mux-verb"
+                )
+
+                def send(frame: Dict[str, Any]) -> None:
+                    data = wiremux.encode_frame(frame)
+                    with wlock:
+                        conn.sendall(data)
+
+                try:
+                    while not getattr(server, "_shutdown", False):
+                        frame = wiremux.read_frame(self.rfile)
+                        if frame is None:
+                            break
+                        if "cancel" in frame:
+                            stop = watch_stops.get(frame["cancel"])
+                            if stop is not None:
+                                stop.set()
+                            continue
+                        rid = frame.get("id")
+                        method = frame.get("method", "GET")
+                        path = frame.get("path", "")
+                        qs = parse_qs(urlparse(path).query)
+                        is_watch = (
+                            method == "GET"
+                            and qs.get("watch", ["false"])[0] == "true"
+                        )
+                        routed = server._route_path(path) if is_watch else None
+                        if is_watch and routed and not routed[1]:
+                            stop = threading.Event()
+                            watch_stops[rid] = stop
+                            threading.Thread(
+                                target=server._mux_watch,
+                                args=(rid, path, routed[0], send, stop, conn),
+                                daemon=True,
+                                name=f"mux-watch-{rid}",
+                            ).start()
+                        else:
+                            pool.submit(
+                                server._mux_verb, rid, method, path,
+                                frame.get("body"), send,
                             )
-                            new["metadata"]["resourceVersion"] = str(st.next_rv())
-                            st.objects[(prefix, name)] = new
-                            st.notify(prefix, "MODIFIED", new)
-                            return self._ok(new)
-                        return self._ok(stored)
-                    del st.objects[(prefix, name)]
-                    # Deletion is a write: the DELETED event carries a fresh
-                    # rv (etcd semantics) so watch resumes ordered after
-                    # older MODIFIEDs still replay it.
-                    stored = json.loads(json.dumps(stored))
-                    stored["metadata"]["resourceVersion"] = str(st.next_rv())
-                    st.notify(prefix, "DELETED", stored)
-                    return self._ok(stored)
+                except (wiremux.MuxError, OSError, ValueError):
+                    pass  # truncated/corrupt peer or dead socket: drop session
+                finally:
+                    for stop in watch_stops.values():
+                        stop.set()
+                    pool.shutdown(wait=False)
 
         self._handler_cls = Handler
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._shutdown = False
+
+    # ------------------------------------------------------------------
+    # shared verb plane (HTTP handlers and the mux endpoint both call in)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _status(code: int, reason: str, message: str) -> Tuple[int, Dict[str, Any]]:
+        return code, _status_doc(code, reason, message)
+
+    def _check_fail(
+        self, method: str, path: str
+    ) -> Optional[Tuple[int, str, str]]:
+        """Request-log + injected latency + fail-hook personas. Runs once
+        per request on either transport, with identical (method, path)
+        strings — so request-counting assertions and path-matching hooks
+        (watch_blocker) can't tell mux from HTTP."""
+        self.request_log.append((method, path))
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        # Snapshot: hooks are armed/disarmed from other threads (and,
+        # proc-mode, while many handler threads are in here).
+        for hook in list(self.fail_hooks):
+            out = hook(method, path)
+            if out:
+                return out
+        return None
+
+    def _route_path(
+        self, path: str
+    ) -> Optional[Tuple[str, Optional[str], Dict[str, Any], bool]]:
+        """→ (prefix, name|None, resource_cfg, is_status)"""
+        parsed = urlparse(path)
+        p = unquote(parsed.path).rstrip("/")
+        for prefix, cfg in self.resources.items():
+            if p == prefix:
+                return prefix, None, cfg, False
+            if p.startswith(prefix + "/"):
+                rest = p[len(prefix) + 1 :]
+                if rest.endswith("/status"):
+                    return prefix, rest[: -len("/status")], cfg, True
+                if "/" not in rest:
+                    return prefix, rest, cfg, False
+        return None
+
+    def _admit(
+        self,
+        prefix: str,
+        operation: str,
+        obj: Dict[str, Any],
+        old: Optional[Dict[str, Any]],
+    ) -> Tuple[Optional[Dict[str, Any]], Optional[Tuple[int, Dict[str, Any]]]]:
+        """Run registered webhooks over the wire. Returns (patched object,
+        None) on admission, (None, (code, status)) on denial/failure."""
+        for hook in list(self.webhooks):
+            if hook["prefix"] != prefix:
+                continue
+            if operation not in hook.get("operations", {"CREATE", "UPDATE"}):
+                continue
+            review = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {
+                    "uid": str(uuid.uuid4()),
+                    "operation": operation,
+                    "object": obj,
+                    "oldObject": old,
+                },
+            }
+            data = json.dumps(review).encode()
+            req = urllib.request.Request(
+                hook["url"], data=data, method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            kwargs: Dict[str, Any] = {"timeout": 10}
+            if hook["url"].startswith("https"):
+                ctx = ssl.create_default_context()
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE  # self-signed test certs
+                kwargs["context"] = ctx
+            try:
+                with urllib.request.urlopen(req, **kwargs) as resp:
+                    out = json.loads(resp.read())
+            except (OSError, ValueError) as e:
+                # failurePolicy: Fail — the reference's default for its
+                # validating webhook.
+                return None, self._status(
+                    500, "InternalError",
+                    f"webhook {hook['url']} unreachable: {e}",
+                )
+            response = out.get("response") or {}
+            if not response.get("allowed", False):
+                msg = ((response.get("status") or {}).get("message")
+                       or "admission denied")
+                return None, self._status(403, "Forbidden", msg)
+            if response.get("patch"):
+                patch = json.loads(base64.b64decode(response["patch"]))
+                obj = _apply_jsonpatch(obj, patch)
+        return obj, None
+
+    def handle_verb(
+        self, method: str, path: str, body: Optional[Dict[str, Any]]
+    ) -> Tuple[int, Dict[str, Any]]:
+        """One non-watch REST verb, transport-agnostic: (code, payload)."""
+        routed = self._route_path(path)
+        if not routed:
+            return self._status(404, "NotFound", f"no route {path}")
+        prefix, name, cfg, is_status = routed
+        st = self.state
+        ks = st.kind(prefix)
+
+        if method == "GET":
+            if name:
+                with ks.lock:
+                    obj = ks.objects.get(name)
+                if obj is None:
+                    return self._status(404, "NotFound", f"{name} not found")
+                return 200, obj
+            qs = parse_qs(urlparse(path).query)
+            with ks.lock:
+                items = [o for _, o in sorted(ks.objects.items())]
+                # rv snapshotted while holding the kind lock: a list must
+                # never advertise an rv newer than its contents for this
+                # kind, or a watch resumed from it skips events (only
+                # observable with parallel writer processes). Same-kind
+                # writes serialize on ks.lock, so every event this kind
+                # publishes after this snapshot carries rv > list_rv.
+                list_rv = st.rv
+            sel = qs.get("labelSelector", [None])[0]
+            if sel:
+                pairs = dict(kv.split("=", 1) for kv in sel.split(","))
+                items = [
+                    o
+                    for o in items
+                    if all(
+                        (o["metadata"].get("labels") or {}).get(k) == v
+                        for k, v in pairs.items()
+                    )
+                ]
+            return 200, {
+                "kind": cfg["kind"] + "List",
+                "apiVersion": cfg.get("apiVersion", "v1"),
+                "metadata": {"resourceVersion": str(list_rv)},
+                "items": items,
+            }
+
+        if method == "POST":
+            if name:
+                return self._status(405, "MethodNotAllowed", "POST to item")
+            obj = body if body is not None else {}
+            meta = obj.setdefault("metadata", {})
+            oname = meta.get("name", "")
+            if not oname:
+                return self._status(422, "Invalid", "metadata.name required")
+            obj, denied = self._admit(prefix, "CREATE", obj, None)
+            if denied is not None:
+                return denied
+            meta = obj.setdefault("metadata", {})
+            with ks.lock:
+                if oname in ks.objects:
+                    return self._status(
+                        409, "AlreadyExists", f"{oname} already exists"
+                    )
+                meta["uid"] = meta.get("uid") or str(uuid.uuid4())
+                meta["resourceVersion"] = str(st.next_rv())
+                meta["generation"] = 1
+                meta.setdefault(
+                    "creationTimestamp",
+                    time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                )
+                meta.pop("deletionTimestamp", None)
+                ks.objects[oname] = obj
+                st.notify(prefix, "ADDED", obj)
+            return 201, obj
+
+        if method == "PUT":
+            if not name:
+                return self._status(405, "MethodNotAllowed", "PUT to collection")
+            incoming = body if body is not None else {}
+            # Admission sees spec updates, not status subresource writes
+            # (matching real webhook rules scoped to the main resource).
+            if not is_status:
+                with ks.lock:
+                    old = ks.objects.get(name)
+                    old = json.loads(json.dumps(old)) if old else None
+                incoming, denied = self._admit(prefix, "UPDATE", incoming, old)
+                if denied is not None:
+                    return denied
+            with ks.lock:
+                stored = ks.objects.get(name)
+                if stored is None:
+                    return self._status(404, "NotFound", f"{name} not found")
+                in_rv = str(incoming.get("metadata", {}).get("resourceVersion", ""))
+                if in_rv and in_rv != stored["metadata"]["resourceVersion"]:
+                    return self._status(
+                        409,
+                        "Conflict",
+                        f"resourceVersion {in_rv} != {stored['metadata']['resourceVersion']}",
+                    )
+                new = json.loads(json.dumps(stored))
+                if is_status:
+                    new["status"] = incoming.get("status", {})
+                else:
+                    spec_changed = incoming.get("spec") != stored.get("spec")
+                    new["spec"] = incoming.get("spec", {})
+                    # mutable metadata
+                    im = incoming.get("metadata", {})
+                    for k in ("labels", "annotations", "finalizers", "ownerReferences"):
+                        if k in im:
+                            new["metadata"][k] = im[k]
+                        else:
+                            new["metadata"].pop(k, None)
+                    if spec_changed:
+                        new["metadata"]["generation"] = (
+                            int(stored["metadata"].get("generation", 1)) + 1
+                        )
+                new["metadata"]["resourceVersion"] = str(st.next_rv())
+                if (
+                    new["metadata"].get("deletionTimestamp")
+                    and not new["metadata"].get("finalizers")
+                ):
+                    del ks.objects[name]
+                    st.notify(prefix, "DELETED", new)
+                    return 200, new
+                ks.objects[name] = new
+                st.notify(prefix, "MODIFIED", new)
+                return 200, new
+
+        if method == "DELETE":
+            if not name:
+                return self._status(405, "MethodNotAllowed", "DELETE collection")
+            with ks.lock:
+                stored = ks.objects.get(name)
+                if stored is None:
+                    return self._status(404, "NotFound", f"{name} not found")
+                if stored["metadata"].get("finalizers"):
+                    if not stored["metadata"].get("deletionTimestamp"):
+                        new = json.loads(json.dumps(stored))
+                        new["metadata"]["deletionTimestamp"] = time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                        )
+                        new["metadata"]["resourceVersion"] = str(st.next_rv())
+                        ks.objects[name] = new
+                        st.notify(prefix, "MODIFIED", new)
+                        return 200, new
+                    return 200, stored
+                del ks.objects[name]
+                # Deletion is a write: the DELETED event carries a fresh
+                # rv (etcd semantics) so watch resumes ordered after older
+                # MODIFIEDs still replay it.
+                stored = json.loads(json.dumps(stored))
+                stored["metadata"]["resourceVersion"] = str(st.next_rv())
+                st.notify(prefix, "DELETED", stored)
+                return 200, stored
+
+        return self._status(405, "MethodNotAllowed", f"unsupported {method}")
+
+    def _subscribe(
+        self, prefix: str, since: int
+    ) -> Tuple[List[Dict[str, Any]], threading.Condition, Optional[Dict[str, Any]]]:
+        """Register a watch on one kind shard: (buffer, condition,
+        expired_event|None). When the resume rv is behind the compaction
+        horizon, nothing is registered and the 410 ERROR event to send is
+        returned — a real apiserver answers 200 + ERROR, then ends the
+        watch; the client must relist."""
+        ks = self.state.kind(prefix)
+        buf: List[Dict[str, Any]] = []
+        cond = threading.Condition()
+        with ks.lock:
+            if since and since < ks.compacted_rv:
+                return buf, cond, {
+                    "type": "ERROR",
+                    "object": {
+                        "kind": "Status", "apiVersion": "v1",
+                        "status": "Failure", "code": 410,
+                        "reason": "Expired",
+                        "message": (
+                            f"too old resource version: {since} "
+                            f"({ks.compacted_rv})"
+                        ),
+                    },
+                }
+            if since:
+                # Faithful resume: replay the true event history — including
+                # DELETED — exactly as etcd serves a watch from a historical
+                # rv inside the horizon. Replay and subscription happen under
+                # ONE lock hold, so a write landing while we replay is either
+                # in the history we replay or in the buffer we just
+                # subscribed — never both, never neither (the
+                # lost-event/duplicate race a 4-process hammer exposes
+                # immediately).
+                for rv, etype, o in ks.event_log:
+                    if rv > since:
+                        buf.append({"type": etype, "object": o})
+            else:
+                # No resume rv: current state as ADDED (legacy
+                # list+watch-from-now shape).
+                for oname in sorted(ks.objects):
+                    buf.append(
+                        {"type": "ADDED",
+                         "object": json.loads(json.dumps(ks.objects[oname]))}
+                    )
+            ks.watchers.append((buf, cond))
+        return buf, cond, None
+
+    # ------------------------------------------------------------------
+    # mux request execution (called from per-session worker threads)
+    # ------------------------------------------------------------------
+    def _mux_verb(self, rid, method, path, body, send) -> None:
+        fail = self._check_fail(method, path)
+        code, payload = (
+            self._status(*fail) if fail else self.handle_verb(method, path, body)
+        )
+        try:
+            send({"id": rid, "code": code, "body": payload})
+        except (wiremux.MuxError, OSError):
+            pass  # session died; the read loop tears everything down
+
+    def _mux_watch(self, rid, path, prefix, send, stop, conn) -> None:
+        """One watch stream on a mux session: ack, then push events until
+        the client cancels, the session dies, or the server shuts down."""
+        st = self.state
+        ks = st.kind(prefix)
+        buf: Optional[List[Dict[str, Any]]] = None
+        registered = False
+        try:
+            fail = self._check_fail("GET", path)
+            if fail:
+                code, payload = self._status(*fail)
+                send({"id": rid, "code": code, "body": payload})
+                return
+            qs = parse_qs(urlparse(path).query)
+            since = int(qs.get("resourceVersion", ["0"])[0] or 0)
+            buf, cond, expired = self._subscribe(prefix, since)
+            registered = expired is None
+            send({"id": rid, "code": 200, "watch": True})
+            if expired is not None:
+                send({"watch": rid, "event": expired})
+                return
+            with st.lock:
+                self.active_watch_conns.append(conn)
+            try:
+                while not self._shutdown and not stop.is_set():
+                    with cond:
+                        if not buf:
+                            cond.wait(timeout=0.5)
+                        events, buf[:] = list(buf), []
+                    for evt in events:
+                        send({"watch": rid, "event": evt})
+            finally:
+                with st.lock:
+                    try:
+                        self.active_watch_conns.remove(conn)
+                    except ValueError:
+                        pass
+        except (wiremux.MuxError, OSError):
+            pass
+        finally:
+            if registered and buf is not None:
+                with ks.lock:
+                    ks.watchers = [w for w in ks.watchers if w[0] is not buf]
+            try:
+                send({"watch": rid, "end": True})
+            except (wiremux.MuxError, OSError):
+                pass
 
     # ------------------------------------------------------------------
     def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
@@ -636,8 +945,7 @@ class FakeApiServer:
     def compact(self, up_to_rv: Optional[int] = None) -> None:
         """Etcd compaction: discard watch history; resumes from inside the
         discarded range get a 410 Expired ERROR event and must relist."""
-        with self.state.lock:
-            self.state.compact(up_to_rv)
+        self.state.compact(up_to_rv)
 
     def kill_watch_connections(self) -> int:
         """Socket-level reset of every live streaming watch (no clean HTTP
@@ -677,6 +985,9 @@ class FakeApiServer:
             unblock = srv.watch_blocker()
             ... mutate world ...
             unblock()
+
+        Matches on the path string, which is identical on both transports,
+        so a mux client's re-watch is refused exactly like an HTTP one's.
         """
         def hook(method: str, path: str):
             if method == "GET" and "watch=true" in path:
@@ -700,8 +1011,9 @@ class FakeApiServer:
         """Seed/replace an object directly (bypasses conflict checks)."""
         st = self.state
         name = obj["metadata"]["name"]
-        with st.lock:
-            existed = (prefix, name) in st.objects
+        ks = st.kind(prefix)
+        with ks.lock:
+            existed = name in ks.objects
             meta = obj.setdefault("metadata", {})
             meta.setdefault("uid", str(uuid.uuid4()))
             meta["resourceVersion"] = str(st.next_rv())
@@ -709,19 +1021,21 @@ class FakeApiServer:
             meta.setdefault(
                 "creationTimestamp", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
             )
-            st.objects[(prefix, name)] = obj
+            ks.objects[name] = obj
             st.notify(prefix, "MODIFIED" if existed else "ADDED", obj)
         return obj
 
     def get_object(self, prefix: str, name: str) -> Optional[Dict[str, Any]]:
-        with self.state.lock:
-            obj = self.state.objects.get((prefix, name))
+        ks = self.state.kind(prefix)
+        with ks.lock:
+            obj = ks.objects.get(name)
             return json.loads(json.dumps(obj)) if obj else None
 
     def delete_object(self, prefix: str, name: str) -> None:
         st = self.state
-        with st.lock:
-            obj = st.objects.pop((prefix, name), None)
+        ks = st.kind(prefix)
+        with ks.lock:
+            obj = ks.objects.pop(name, None)
             if obj:
                 obj = json.loads(json.dumps(obj))
                 obj["metadata"]["resourceVersion"] = str(st.next_rv())

@@ -33,6 +33,7 @@ from __future__ import annotations
 import hashlib
 import heapq
 import json
+import os as _os
 import threading
 import time
 import urllib.error
@@ -41,6 +42,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import random as _random
+
+from tpu_composer.runtime import wiremux
 
 ARRIVE = "arrive"
 CANCEL = "cancel"
@@ -342,6 +345,7 @@ class ChurnDriver:
         version: str,
         time_scale: float = 1.0,
         migrate_dwell_s: float = 1.0,
+        wire_mux: Optional[bool] = None,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.plan = plan
@@ -355,11 +359,32 @@ class ChurnDriver:
         self.sent: Dict[str, int] = {}
         self._stop = threading.Event()
         self._mx_seq = 0
+        # Framed transport for the driver's own verbs (same kill switch as
+        # KubeStore). ROADMAP item 1 fingered the per-request urllib cost —
+        # connect + header parse per verb, in the driver process — as
+        # driver overhead distorting the scaling curve; one framed socket
+        # removes it. Timer-thread migrate deletes share it safely
+        # (MuxClient pipelines across threads).
+        if wire_mux is None:
+            wire_mux = _os.environ.get("TPUC_WIRE_MUX", "1") != "0"
+        self._mux: Optional[wiremux.MuxClient] = None
+        self._mux_failed = not wire_mux
 
     # -- tiny wire client (stdlib only; the driver must not depend on
     #    KubeStore so driver cost never shadows what we're measuring) -----
     def _req(self, method: str, path: str,
              body: Optional[Dict[str, Any]] = None) -> Tuple[int, Dict[str, Any]]:
+        if not self._mux_failed:
+            try:
+                if self._mux is None:
+                    self._mux = wiremux.MuxClient(self.base_url)
+                return self._mux.request(method, path, body=body, timeout=10.0)
+            except wiremux.MuxHTTPError as e:
+                return e.code, e.body
+            except wiremux.MuxUnsupported:
+                self._mux_failed = True  # plain-HTTP server: fall through
+            except wiremux.MuxError as e:
+                return 599, {"message": str(e)}
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             self.base_url + path, data=data, method=method,
@@ -448,7 +473,15 @@ class ChurnDriver:
                 break
             handlers[ev.kind](ev)
             self.sent[ev.kind] = self.sent.get(ev.kind, 0) + 1
+        # Leave the mux socket open until the dwell timers (migrate lifts)
+        # have had their say; close() below is the explicit teardown.
         return dict(self.sent)
 
     def stop(self) -> None:
         self._stop.set()
+
+    def close(self) -> None:
+        mux, self._mux = self._mux, None
+        self._mux_failed = True
+        if mux is not None:
+            mux.close()
