@@ -4,7 +4,7 @@
 PYTHON ?= python
 IMG ?= tpu-composer:latest
 
-.PHONY: all test test-fast bench bench-round manifests native lint lint-syntax analyze typecheck run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak migrate-soak brownout-soak proc-smoke churn-bench conformance
+.PHONY: all test test-fast bench bench-round manifests native lint lint-syntax analyze typecheck run dryrun docker-build clean build-installer bundle crash-soak chaos-soak repair-soak shard-soak migrate-soak brownout-soak partition-soak proc-smoke churn-bench conformance
 
 all: native test
 
@@ -126,6 +126,25 @@ migrate-soak:
 ## (reason=overload). Same black-box contract as the other soaks.
 brownout-soak:
 	$(PYTHON) -m pytest tests/test_brownout_soak.py -q -m brownout -p no:randomly
+
+## partition-soak: asymmetric network-partition soak
+## (tests/test_partition_soak.py, markers slow+partition): a 3-replica
+## ProcFleet runs seeded churn with each replica's store wire routed
+## through its own TCP chaos proxy (sim/netchaos.py); the busiest
+## replica's wire goes dark server-to-client — its requests still LAND,
+## every response vanishes (the nastiest partition class: naive retry
+## double-submits, naive liveness never fires). The mux ping deadline
+## must detect the dark wire in seconds (not the 30s per-request
+## baseline), survivors must steal the victim's shards within the lease
+## bound, the victim must FENCE (supervisor-side attributed fabric
+## ledger shows no victim mutation past its monotonic deadline) while
+## riding the outage out alive, and heal() must converge with the
+## nonce-checked zero-double-attach invariant. TPUC_PARTITION_SEED
+## overrides the churn seed. Same black-box contract as the other soaks
+## (TPUC_FLIGHT_FILE / TPUC_TRACE_FILE / TPUC_PROC_WORKDIR uploaded on
+## CI failure).
+partition-soak:
+	$(PYTHON) -m pytest tests/test_partition_soak.py -q -m partition -p no:randomly
 
 ## shard-soak: shard-failover chaos soak (tests/test_shard_failover.py,
 ## markers slow+shard): three full operator replicas over one shared store

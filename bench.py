@@ -351,7 +351,8 @@ APISERVER_RTT_S = 0.010  # injected per-request latency: typical in-cluster apis
 
 def bench_attach_cluster(cycles: int = 20, size: int = 8,
                          rtt_s: float = APISERVER_RTT_S, cached: bool = True,
-                         fabric_batch: bool = True):
+                         fabric_batch: bool = True,
+                         wire_ping_period: float = None):
     """Attach-to-Ready through the REAL cluster path: the manager speaking
     KubeStore to the wire-semantics fake apiserver, every HTTP request
     charged an apiserver RTT. This is the honest latency model (VERDICT r1
@@ -392,8 +393,11 @@ def bench_attach_cluster(cycles: int = 20, size: int = 8,
             "/api/v1/nodes",
             core_node_doc(f"worker-{i}", chips=4, chip_resource=CHIP_RESOURCE),
         )
+    # wire_ping_period=None inherits the env default; the perf-smoke
+    # ping-overhead gate A/Bs an aggressive period against 0.0 (the
+    # TPUC_WIRE_PING=0 semantics) through this knob.
     store = KubeStore(config=KubeConfig(host=srv.url), watch_reconnect_s=0.05,
-                      cache_reads=cached)
+                      cache_reads=cached, wire_ping_period=wire_ping_period)
     pool = _counting_pool()
     dispatcher = _bench_dispatcher(pool, fabric_batch)
     mgr = Manager(store=store)
@@ -1261,6 +1265,190 @@ def bench_wire_idle(window_s: float = 2.0, period: float = 0.4,
     }
 
 
+def bench_partition(ping_period: float = 0.2, fleet_partition_s: float = 5.0,
+                    requests: int = 48):
+    """Wire-plane partition tolerance, quantified (ISSUE 20):
+
+    1. DETECTION — a mux client behind a TCP chaos proxy
+       (sim/netchaos.py) whose wire goes silently dark (half-open: no
+       RST, no FIN, bytes vanish). The ping liveness layer must declare
+       the connection dead within 2x the ping period; the pre-liveness
+       baseline was the per-request timeout (~30s default) because a
+       half-open socket emits no error at all.
+    2. WATCH RESUME — after ``heal()``, how long until a re-established
+       watch delivers events again (redial backoff + handshake + watch
+       re-open, end to end).
+    3. FLEET — a 4-replica process-mode churn (ProcFleet, every replica
+       behind its own proxy) with one replica asymmetrically partitioned
+       (``partition("s2c")``: requests land, responses dark) for
+       ``fleet_partition_s``. Reported: placements/sec during the dark
+       window vs the run overall — the survivors' share of the work must
+       keep the fleet placing while the victim fences."""
+    import os
+    import sys
+    import tempfile
+    import threading
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from fake_apiserver import FakeApiServer, operator_resources
+
+    from tpu_composer import GROUP, VERSION
+    from tpu_composer.runtime import wiremux
+    from tpu_composer.sim.netchaos import ChaosProxy
+
+    cr_prefix = f"/apis/{GROUP}/{VERSION}/composabilityrequests"
+
+    # --- 1+2: detection latency and watch resume, in-proc ---------------
+    srv = FakeApiServer(operator_resources(GROUP, VERSION))
+    srv.start()
+    import urllib.parse as _up
+
+    host = _up.urlsplit(srv.url)
+    proxy = ChaosProxy(host.hostname or "127.0.0.1", host.port or 80)
+    client = wiremux.MuxClient(
+        proxy.url, ping_period=ping_period, ping_misses=1,
+        connect_timeout=1.0,
+    )
+    try:
+        assert client.request("POST", cr_prefix, body={
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "ComposabilityRequest",
+            "metadata": {"name": "bench-part-a"},
+            "spec": {"resource": {"type": "tpu", "model": "tpu-v4",
+                                  "size": 1}},
+        })[0] == 201
+        conn = client._ensure_conn()
+        proxy.partition()  # silent, both directions: the half-open lie
+        t0 = time.monotonic()
+        detected = conn.dead.wait(30.0)
+        detection_s = time.monotonic() - t0
+        if not detected:
+            raise RuntimeError("mux never detected the dark wire")
+
+        proxy.heal()
+        t0 = time.monotonic()
+        watch = None
+        while watch is None:
+            if time.monotonic() - t0 > 30.0:
+                raise RuntimeError("watch never re-established after heal")
+            try:
+                watch = client.watch(
+                    f"{cr_prefix}?watch=true&resourceVersion=0", timeout=5)
+            except wiremux.MuxError:
+                time.sleep(0.02)  # redial backoff window
+        next(watch)  # rv=0 replays the warm object: events flow again
+        watch_resume_s = time.monotonic() - t0
+    finally:
+        client.close()
+        proxy.stop()
+        srv.stop()
+
+    # --- 3: fleet throughput through a 5s one-replica partition ---------
+    from tpu_composer.fleet.proc import ProcFleet
+    from tpu_composer.sim.churn import ChurnDriver, generate_plan
+
+    plan = generate_plan(
+        seed=20, requests=requests, duration_s=6.0, nodes=24,
+        chips_per_node=4, min_size=1, max_size=2,
+        cancel_frac=0.0, resize_frac=0.0, migrate_frac=0.0,
+    )
+    fleet = ProcFleet(
+        tempfile.mkdtemp(prefix="bench-partition-"),
+        nodes=24, chips_per_node=4, shards=8, expected_replicas=4,
+        lease_duration_s=2.0, lease_renew_s=0.25, workers=1,
+        extra_env={
+            "TPUC_POLL_SCALE": "0.25",
+            "TPUC_WIRE_PING_PERIOD": str(ping_period),
+            "TPUC_WIRE_PING_MISSES": "2",
+            "TPUC_WIRE_CONNECT_TIMEOUT": "1.0",
+        },
+        netchaos=True,
+    )
+    running_wall = {}
+    stop_poll = threading.Event()
+
+    def poll_running():
+        prefix = fleet.cr_prefix
+        while not stop_poll.is_set():
+            with fleet.apiserver.state.lock:
+                for (p, name), obj in fleet.apiserver.state.objects.items():
+                    if (p == prefix and name not in running_wall
+                            and (obj.get("status") or {})
+                            .get("state") == "Running"):
+                        running_wall[name] = time.monotonic()
+            time.sleep(0.02)
+
+    try:
+        for i in range(4):
+            fleet.spawn(f"bench-part-{i}", wait_ready_s=60)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if len(fleet.shard_owners()) == fleet.shards:
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("partition bench fleet never claimed shards")
+        poller = threading.Thread(target=poll_running, daemon=True,
+                                  name="bench-partition-poller")
+        poller.start()
+        driver = ChurnDriver(fleet.apiserver.url, plan, GROUP, VERSION)
+        churn = threading.Thread(target=driver.run, daemon=True,
+                                 name="bench-partition-churn")
+        t0 = time.monotonic()
+        churn.start()
+        try:
+            time.sleep(1.0)
+            counts = fleet.in_flight_intents()
+            victim = (max(counts, key=counts.get) if counts
+                      else "bench-part-0")
+            t_dark = time.monotonic()
+            placed_at_dark = len(running_wall)
+            fleet.proxy(victim).partition("s2c")
+            time.sleep(fleet_partition_s)
+            placed_in_window = len(running_wall) - placed_at_dark
+            fleet.proxy(victim).heal()
+            deadline = time.monotonic() + 120
+            while (len(running_wall) < requests
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+        finally:
+            driver.stop()
+            churn.join(timeout=30)
+            stop_poll.set()
+            poller.join(timeout=5)
+        placed = len(running_wall)
+        if placed < requests:
+            raise RuntimeError(
+                f"{requests - placed} request(s) never Running after heal"
+            )
+        wall_s = max(running_wall.values()) - t0
+        fleet.stop_all()
+    finally:
+        fleet.close()
+
+    return {
+        "detection": {
+            "ping_period_s": ping_period,
+            "detection_s": round(detection_s, 3),
+            "bound_s": 2 * ping_period,
+            "baseline_request_timeout_s": 30.0,
+        },
+        "watch_resume_after_heal_s": round(watch_resume_s, 3),
+        "fleet": {
+            "replicas": 4,
+            "partition_s": fleet_partition_s,
+            "partition_direction": "s2c",
+            "victim": victim,
+            "placements": placed,
+            "wall_s": round(wall_s, 2),
+            "placements_per_sec_overall": round(placed / wall_s, 2),
+            "placements_per_sec_dark_window": round(
+                placed_in_window / fleet_partition_s, 2),
+        },
+    }
+
+
 def bench_migration(async_delay: float = 0.05, grace_s: float = 0.0):
     """Live slice migration vs delete/re-solve: evacuation time and
     JOB-VISIBLE pause, same world both ways.
@@ -1695,7 +1883,10 @@ def assert_round_gates(path: str) -> None:
       fit search, whenever the native library was available for the round;
     - wire_plane idle relists: with the fabric event stream healthy the
       idle window must see at most 1 unprompted relist AND strictly fewer
-      than the poll-driven control (wire plane v2's at-idle claim).
+      than the poll-driven control (wire plane v2's at-idle claim);
+    - partition_plane detection: a silently dark wire must be declared
+      dead within 2x the ping period and strictly below the ~30s
+      per-request-timeout baseline (the partition-tolerance claim).
     """
     with open(path) as f:
         doc = json.load(f)
@@ -1705,13 +1896,15 @@ def assert_round_gates(path: str) -> None:
     # verbatim, so gate against it when the headline dropped a block.
     full_rel = extra.get("full_record")
     if full_rel and not all(k in extra for k in (
-            "decision_plane", "placement_engine", "wire_plane")):
+            "decision_plane", "placement_engine", "wire_plane",
+            "partition_plane")):
         full_path = os.path.join(os.path.dirname(os.path.abspath(path)),
                                  full_rel)
         try:
             with open(full_path) as f:
                 full_extra = json.load(f).get("extra", {})
-            for k in ("decision_plane", "placement_engine", "wire_plane"):
+            for k in ("decision_plane", "placement_engine", "wire_plane",
+                      "partition_plane"):
                 extra.setdefault(k, full_extra.get(k, {}))
         except (OSError, ValueError):
             pass
@@ -1749,6 +1942,22 @@ def assert_round_gates(path: str) -> None:
             f" poll={wp.get('idle_relists_poll')} — streaming steady state"
             " must be ~silent and strictly below the poll-driven control"
         )
+    pp = extra.get("partition_plane") or {}
+    if pp:  # absent pre-r12 rounds stay gateable
+        if "error" in pp:
+            failures.append(f"partition_plane errored: {pp['error']}")
+        elif pp.get("detection_s") is None:
+            failures.append("partition_plane.detection_s missing")
+        elif not (pp["detection_s"] <= pp.get("detection_bound_s", 0)
+                  and pp["detection_s"]
+                  < pp.get("detection_baseline_s", 30.0)):
+            failures.append(
+                f"partition_plane detection_s={pp['detection_s']} breaches"
+                f" the 2x-ping-period bound"
+                f" ({pp.get('detection_bound_s')}s) — a silently dark"
+                " wire must be declared dead by the ping deadline, not"
+                " the per-request timeout"
+            )
     if failures:
         raise SystemExit(
             f"BENCH ROUND GATE FAILED ({path}):\n  - "
@@ -2112,6 +2321,11 @@ def perf_smoke(cycles: int = 3):
        at idle must stay ~zero on both (watch-cache-fed reads), and one
        fabric inventory event must ring exactly one reactive pass. All
        counts — no wall-time race.
+    9. ping-liveness overhead — the mux transport's ping/pong liveness
+       probes at a deliberately aggressive 50ms period must add <5%
+       (+50 ms allowance) to the attach p50 versus TPUC_WIRE_PING=0:
+       pongs are answered inline on the server's mux read loop, never
+       through the verb pool, so probing the wire must not tax verbs.
 
     Run via ``make perf-smoke``."""
     on = bench_attach_cluster(cycles=cycles, rtt_s=0.0, cached=True)
@@ -2124,6 +2338,13 @@ def perf_smoke(cycles: int = 3):
     overload_cost = bench_overload(cycles=6, size=4, repeats=2)
     event_plane = bench_event_plane(ops=12, poll_interval=0.5)
     wire_idle = bench_wire_idle(window_s=2.0, period=0.4)
+    # Ping-liveness overhead: the same wave with an AGGRESSIVE 50ms ping
+    # period (100x the production 5s default, so the pinger provably
+    # fires during the run) vs TPUC_WIRE_PING=0 semantics (period 0).
+    ping_on = bench_attach_cluster(cycles=cycles, rtt_s=0.0,
+                                   wire_ping_period=0.05)
+    ping_off = bench_attach_cluster(cycles=cycles, rtt_s=0.0,
+                                    wire_ping_period=0.0)
     out = {
         "metric": "perf_smoke_store_rtts_per_attach",
         "cache_on": on["rtts_per_attach"],
@@ -2161,6 +2382,8 @@ def perf_smoke(cycles: int = 3):
         "idle_store_ops_poll": wire_idle["poll_driven"]["idle_store_wire_ops"],
         "idle_doorbell_relists": wire_idle["event_driven"]["doorbell_relists"],
         "idle_doorbell_s": wire_idle["event_driven"]["doorbell_s"],
+        "wire_ping_on_p50_ms": round(ping_on["p50"], 3),
+        "wire_ping_off_p50_ms": round(ping_off["p50"], 3),
     }
     print(json.dumps(out))
     assert on["rtts_per_attach"] * 2 <= off["rtts_per_attach"], (
@@ -2306,6 +2529,13 @@ def perf_smoke(cycles: int = 3):
         " produce a reactive syncer pass within 5s — event-driven"
         " anti-drift is not wired"
     )
+    assert ping_on["p50"] <= ping_off["p50"] * 1.05 + 50.0, (
+        "mux ping-liveness overhead regression: attach p50 was"
+        f" {ping_on['p50']}ms with a 50ms ping period vs"
+        f" {ping_off['p50']}ms under TPUC_WIRE_PING=0 (expected <5% +"
+        " 50ms — liveness probes must not tax the verb path; they share"
+        " the socket but never the verb pool)"
+    )
     return out
 
 
@@ -2421,6 +2651,24 @@ def main():
         }
     except Exception as e:
         wire_plane = {"error": str(e)}
+    # Partition tolerance (ISSUE 20): dark-wire detection latency via the
+    # mux ping deadline, watch resume after heal, and fleet placement
+    # throughput through a 5s one-replica asymmetric partition.
+    try:
+        pt = bench_partition()
+        partition_plane = {
+            "detection_s": pt["detection"]["detection_s"],
+            "detection_bound_s": pt["detection"]["bound_s"],
+            "detection_baseline_s":
+                pt["detection"]["baseline_request_timeout_s"],
+            "watch_resume_s": pt["watch_resume_after_heal_s"],
+            "dark_window_placements_per_sec":
+                pt["fleet"]["placements_per_sec_dark_window"],
+            "overall_placements_per_sec":
+                pt["fleet"]["placements_per_sec_overall"],
+        }
+    except Exception as e:
+        partition_plane = {"error": str(e)}
     # Live migration vs delete/re-solve: evacuation time and job-visible
     # pause for the same node drain (the make-before-break dividend).
     try:
@@ -2514,6 +2762,7 @@ def main():
         "hot_spots": {"attach_32chip": hot_32, "shard_2replica": hot_shard},
         "event_plane": event_plane,
         "wire_plane": wire_plane,
+        "partition_plane": partition_plane,
         "migration": migration,
         "decision_plane": decision_plane,
         "placement_engine": placement_engine,
@@ -2585,7 +2834,7 @@ def main():
                             for key in ("shard_scaling", "overload",
                                         "decision_plane", "migration",
                                         "event_plane", "wire_plane",
-                                        "proc_scaling"):
+                                        "partition_plane", "proc_scaling"):
                                 out["extra"].pop(key, None)
                                 line = json.dumps(out)
                                 if len(line) <= HEADLINE_BUDGET_CHARS:

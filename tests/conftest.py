@@ -169,6 +169,15 @@ def pytest_configure(config):
         " it out; always also marked slow; run with `make brownout-soak`"
         " or `pytest -m brownout`)",
     )
+    config.addinivalue_line(
+        "markers",
+        "partition: asymmetric network-partition soak (ProcFleet replicas"
+        " behind per-replica TCP chaos proxies; the busiest replica's"
+        " store wire goes dark one direction, survivors steal its shards,"
+        " the victim fences, heal converges with zero double-attach;"
+        " always also marked slow; run with `make partition-soak` or"
+        " `pytest -m partition`)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -89,6 +89,12 @@ class FakeFabricServer:
         self.valid_tokens: set = set()
         self.token_requests = 0
         self.request_log: List[str] = []
+        # Supervisor-side attribution ledger: one entry per MUTATING fabric
+        # verb — (replica identity from X-Tpuc-Replica, monotonic receive
+        # time, verb, resource names). The cross-process TaggedPool analog:
+        # the partition soak asserts a fenced replica has no entries past
+        # its fencing deadline.
+        self.mutation_log: List[tuple] = []
         self._applies: Dict[str, dict] = {}
         self._active_apply: Optional[str] = None
         self._forced_failures: List[tuple] = []
@@ -152,6 +158,18 @@ class _FabricHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _tag(self, verb: str, names: List[str]) -> None:
+        """Record a mutating verb in the supervisor-side attribution
+        ledger, stamped with the calling replica's X-Tpuc-Replica header
+        (httpx adds it from $FABRIC_IDENTITY) — logged BEFORE the pool
+        call, like TaggedPool, so even a half-executed mutation is
+        attributed."""
+        identity = self.headers.get("X-Tpuc-Replica", "")
+        with self.fabric._lock:
+            self.fabric.mutation_log.append(
+                (identity, time.monotonic(), verb, list(names))
+            )
 
     def _authorized(self, path: str) -> bool:
         if not self.fabric.require_auth or path == "/auth/token":
@@ -310,6 +328,8 @@ class _FabricHandler(BaseHTTPRequestHandler):
         op = body.get("op", "")
         if op not in ("add", "remove"):
             return self._send(400, {"error": f"bad batch op {op!r}"})
+        self._tag(f"batch-{op}",
+                  [item.get("name", "") for item in body.get("items", [])])
         results: List[dict] = []
         for item in body.get("items", []):
             name = item.get("name", "")
@@ -354,6 +374,7 @@ class _FabricHandler(BaseHTTPRequestHandler):
             return self._send(200, rec)
         if method == "PUT":
             resource = _resource_from_body(name, self._body())
+            self._tag("attach", [name])
             try:
                 result = _maybe_wait(
                     lambda: pool.add_resource(resource), wait, WaitingDeviceAttaching
@@ -370,6 +391,7 @@ class _FabricHandler(BaseHTTPRequestHandler):
             body = self._body()
             resource = _dummy_resource(name, device_ids=list(body.get("device_ids", [])),
                                        nonce=str(body.get("nonce", "")))
+            self._tag("detach", [name])
             try:
                 _maybe_wait(
                     lambda: pool.remove_resource(resource), wait, WaitingDeviceDetaching
@@ -413,6 +435,7 @@ class _FabricHandler(BaseHTTPRequestHandler):
             body = rec["body"]
             op = body.get("operation", "")
             name = body.get("resource", "")
+            self._tag(f"layout-{op}", [name])
             try:
                 if op == "connect":
                     f.pool.add_resource(_resource_from_body(name, body))
@@ -548,6 +571,11 @@ class _FabricHandler(BaseHTTPRequestHandler):
         degrades one member, never the wave."""
         pool = self.fabric.pool
         adding = "AddMembers" in acc
+        members = acc.get("AddMembers" if adding else "RemoveMembers", [])
+        self._tag(
+            "redfish-add" if adding else "redfish-remove",
+            [m.get("Resource", "") for m in members],
+        )
         results: List[dict] = []
         for m in acc.get("AddMembers" if adding else "RemoveMembers", []):
             name = m.get("Resource", "")

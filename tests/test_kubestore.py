@@ -14,6 +14,7 @@ full-lifecycle entries of composabilityrequest_controller_test.go.
 from __future__ import annotations
 
 import json
+import threading
 import time
 import urllib.request
 
@@ -520,6 +521,166 @@ class TestTransportErrors:
         with pytest.raises(StoreError):
             ks.list(ComposabilityRequest)
         ks.close()
+
+
+class TestRetryClassification:
+    """ISSUE 20 satellite: the retry-once path must distinguish "request
+    never sent" (retry any verb) from "sent, response lost" (ambiguous:
+    retry only reads and CAS-guarded updates; surface creates/deletes as
+    StoreError so the controllers' requeue + nonce machinery resolves the
+    ambiguity) — on BOTH transports."""
+
+    @pytest.fixture()
+    def chaosproxy(self, apiserver):
+        import urllib.parse
+
+        from tpu_composer.sim.netchaos import ChaosProxy
+
+        host = urllib.parse.urlsplit(apiserver.url)
+        proxy = ChaosProxy(host.hostname or "127.0.0.1", host.port or 80)
+        yield proxy
+        proxy.stop()
+
+    def _store(self, chaosproxy, mux: bool) -> KubeStore:
+        return KubeStore(
+            config=KubeConfig(host=chaosproxy.url), cache_reads=False,
+            wire_mux=mux, wire_ping_period=0.2, wire_ping_misses=1,
+        )
+
+    @staticmethod
+    def _resource(name: str) -> ComposableResource:
+        from tpu_composer.api import ComposableResourceSpec
+
+        return ComposableResource(
+            metadata=ObjectMeta(name=name),
+            spec=ComposableResourceSpec(
+                type="tpu", model="tpu-v4", target_node="n0"),
+        )
+
+    def _run_midflight(self, apiserver, chaosproxy, fn, latency=0.5,
+                       cut_after=0.2, warmup=None):
+        """Run ``fn`` in a worker while the server sits on the verb for
+        ``latency`` seconds, cut the wire mid-flight, return the worker's
+        (result, exception)."""
+        out: dict = {}
+
+        def work():
+            if warmup is not None:
+                warmup()  # same thread: establishes the pooled HTTP conn
+            apiserver.latency_s = latency
+            try:
+                out["result"] = fn()
+            except Exception as e:  # classified below by the caller
+                out["error"] = e
+
+        t = threading.Thread(target=work, name="midflight")
+        t.start()
+        time.sleep(cut_after)
+        chaosproxy.cut()
+        t.join(timeout=30)
+        apiserver.latency_s = 0.0
+        assert not t.is_alive(), "verb wedged past the cut"
+        return out.get("result"), out.get("error")
+
+    @pytest.mark.parametrize("mux", [True, False])
+    def test_midflight_create_surfaces_store_error_not_blind_retry(
+            self, apiserver, chaosproxy, mux):
+        store = self._store(chaosproxy, mux)
+        try:
+            warmup = None
+            if not mux:
+                def warmup():
+                    with pytest.raises(NotFoundError):
+                        store.get(ComposableResource, "absent")
+            _, err = self._run_midflight(
+                apiserver, chaosproxy,
+                lambda: store.create(self._resource("ambig-create")),
+                warmup=warmup,
+            )
+            # Ambiguous loss of a non-idempotent verb: typed StoreError —
+            # NOT a blind replay (which would surface AlreadyExistsError
+            # here and double-execute in general).
+            assert isinstance(err, StoreError), err
+            assert not isinstance(err, AlreadyExistsError), (
+                "create was blindly retried after an ambiguous loss")
+            posts = [e for e in apiserver.request_log
+                     if e == ("POST", RES_PREFIX)]
+            assert len(posts) == 1, (
+                f"expected exactly one wire POST, saw {len(posts)}")
+        finally:
+            store.close()
+
+    @pytest.mark.parametrize("mux", [True, False])
+    def test_midflight_delete_surfaces_store_error_not_blind_retry(
+            self, apiserver, chaosproxy, mux):
+        store = self._store(chaosproxy, mux)
+        try:
+            store.create(self._resource("ambig-del"))
+            warmup = None
+            if not mux:
+                def warmup():
+                    store.get(ComposableResource, "ambig-del")
+            _, err = self._run_midflight(
+                apiserver, chaosproxy,
+                lambda: store.delete(ComposableResource, "ambig-del"),
+                warmup=warmup,
+            )
+            assert isinstance(err, StoreError), err
+            assert not isinstance(err, NotFoundError), (
+                "delete was blindly retried after an ambiguous loss")
+            dels = [e for e in apiserver.request_log
+                    if e == ("DELETE", f"{RES_PREFIX}/ambig-del")]
+            assert len(dels) == 1, (
+                f"expected exactly one wire DELETE, saw {len(dels)}")
+        finally:
+            store.close()
+
+    @pytest.mark.parametrize("mux", [True, False])
+    def test_midflight_read_is_retried(self, apiserver, chaosproxy, mux):
+        store = self._store(chaosproxy, mux)
+        try:
+            store.create(self._resource("retry-read"))
+            warmup = None
+            if not mux:
+                def warmup():
+                    store.get(ComposableResource, "retry-read")
+            result, err = self._run_midflight(
+                apiserver, chaosproxy,
+                lambda: store.get(ComposableResource, "retry-read"),
+                warmup=warmup,
+            )
+            assert err is None, f"idempotent GET not retried: {err}"
+            assert result.name == "retry-read"
+        finally:
+            store.close()
+
+    @pytest.mark.parametrize("mux", [True, False])
+    def test_midflight_cas_update_is_retried_never_store_error(
+            self, apiserver, chaosproxy, mux):
+        store = self._store(chaosproxy, mux)
+        try:
+            store.create(self._resource("retry-cas"))
+            got = store.get(ComposableResource, "retry-cas")
+            got.spec.target_node = "n1"
+            warmup = None
+            if not mux:
+                def warmup():
+                    store.get(ComposableResource, "retry-cas")
+            _, err = self._run_midflight(
+                apiserver, chaosproxy, lambda: store.update(got),
+                warmup=warmup,
+            )
+            # CAS-guarded PUT is replay-safe: either the retry landed (no
+            # error) or the first attempt did and the replay hit the
+            # resourceVersion guard (ConflictError -> requeue on fresh
+            # state). NEVER an unclassified StoreError.
+            assert err is None or isinstance(err, ConflictError), err
+            assert not (isinstance(err, StoreError)
+                        and not isinstance(err, ConflictError)), err
+            fresh = store.get(ComposableResource, "retry-cas")
+            assert fresh.spec.target_node in ("n0", "n1")
+        finally:
+            store.close()
 
 
 class TestReflectorTombstones:

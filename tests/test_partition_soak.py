@@ -1,0 +1,221 @@
+"""Asymmetric-partition soak (ISSUE 20 tentpole).
+
+Every crash soak before this one killed processes — the kernel closed the
+sockets and told the peers. This soak makes the NETWORK lie instead: a
+3-replica ProcFleet runs seeded churn with each replica's store wire
+routed through its own TCP chaos proxy (sim/netchaos.py), and the busiest
+replica gets an ASYMMETRIC partition — its requests still land on the
+apiserver, but every response goes dark (``partition("s2c")``). That is
+the nastiest partition class: the victim's writes apply server-side while
+the victim itself sees only silence, so naive retry would double-submit
+and naive liveness would never fire.
+
+What must hold:
+
+- the victim's mux detects the dark wire by ping deadline (seconds, not
+  the 30s per-request baseline) and fails everything pending at once;
+- survivors steal the victim's shard leases within the takeover bound;
+- the victim FENCES: the supervisor-side fabric mutation ledger
+  (X-Tpuc-Replica attribution, monotonic timestamps) shows no fabric
+  mutation by the victim past ``t_partition + renew_deadline + slack``;
+- after ``heal()`` the fleet converges — every surviving request Running,
+  zero pending intents, the victim process alive the whole time (store
+  outage ride-through, no crash) — and the pool's nonce-stamped event
+  ring shows zero double-attach across the handoff.
+
+Run: ``make partition-soak`` (markers slow+partition).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from tpu_composer import GROUP, VERSION
+from tpu_composer.fleet.proc import ProcFleet
+from tpu_composer.sim.churn import ChurnDriver, generate_plan
+
+from tests.test_crash_restart import assert_no_double_attach
+from tests.test_proc_fleet import (
+    _cr_states,
+    _pending_intents,
+    _pool_attach_events,
+    _wait,
+    _workdir,
+)
+
+pytestmark = [pytest.mark.slow, pytest.mark.partition]
+
+LEASE_S = 2.0
+RENEW_S = 0.25
+#: shards.py default: fence a shard renew_deadline after its last
+#: successful renew (lease_duration * 2/3).
+RENEW_DEADLINE_S = LEASE_S * 2.0 / 3.0
+#: Fence bound: the victim's last successful renew is at most one renew
+#: period before the partition, the deadline check runs on the next tick
+#: after the wire fails fast (mux ping deadline ~1.25s with the knobs
+#: below), and ops already handed to the dispatcher may still execute
+#: against the fabric. Everything after this is an UNFENCED mutation.
+FENCE_SLACK_S = 2.5
+#: Lease takeover: one of the victim's in-flight renews may LAND (s2c:
+#: the request applied, the response went dark) and push renewTime
+#: forward once before CAS staleness stops the rest.
+TAKEOVER_BOUND_S = 2 * LEASE_S + 4 * RENEW_S + 3.0
+#: How long the wire stays dark: long enough for takeover plus a quiet
+#: window that would expose a late unfenced mutation.
+PARTITION_HOLD_S = 9.0
+
+#: Wire knobs for every replica: fast ping deadline (detection within
+#: ~1.25s of onset), fast dial timeout so reconnect probes into the
+#: accepted-but-dark proxy fail in bounded time, and the default flap
+#: streak so the victim exercises mux fail-fast (not an instant HTTP
+#: fallback whose blocking reads would wedge the fencing tick).
+WIRE_ENV = {
+    "TPUC_WIRE_PING_PERIOD": "0.5",
+    "TPUC_WIRE_PING_MISSES": "2",
+    "TPUC_WIRE_CONNECT_TIMEOUT": "1.0",
+    "TPUC_WIRE_MUX_MAX_FAILS": "5",
+}
+
+
+class TestAsymmetricPartitionSoak:
+    def test_partitioned_replica_fences_survivors_steal_heal_converges(
+            self, tmp_path):
+        seed = int(os.environ.get("TPUC_PARTITION_SEED", "20"))
+        plan = generate_plan(
+            seed=seed,
+            requests=18,
+            duration_s=6.0,
+            nodes=16,
+            chips_per_node=4,
+            min_size=1,
+            max_size=2,
+            cancel_frac=0.15,
+            resize_frac=0.2,
+            migrate_frac=0.0,
+        )
+        fleet = ProcFleet(
+            _workdir(tmp_path, "partition"),
+            nodes=plan.nodes,
+            chips_per_node=plan.chips_per_node,
+            shards=6,
+            expected_replicas=3,
+            lease_duration_s=LEASE_S,
+            lease_renew_s=RENEW_S,
+            extra_env=WIRE_ENV,
+            netchaos=True,
+        )
+        with fleet:
+            for name in ("part-a", "part-b", "part-c"):
+                fleet.spawn(name, wait_ready_s=60)
+            _wait(
+                lambda: len(fleet.shard_owners()) == fleet.shards
+                and len(set(fleet.shard_owners().values())) == 3,
+                30,
+                "shard leases never balanced across all three replicas",
+            )
+
+            driver = ChurnDriver(fleet.apiserver.url, plan, GROUP, VERSION)
+            churn = threading.Thread(
+                target=driver.run, daemon=True, name="partition-churn")
+            churn.start()
+            try:
+                # Let churn build in-flight state, then pick the busiest
+                # replica — most durable intents in shards it owns.
+                def busiest():
+                    counts = fleet.in_flight_intents()
+                    if counts:
+                        return max(counts, key=counts.get)
+                    return None
+
+                try:
+                    victim = _wait(busiest, 10, "no in-flight intents")
+                except TimeoutError:
+                    victim = "part-a"
+                survivors = [r.name for r in fleet.live()
+                             if r.name != victim]
+
+                # --- the lie begins: requests land, responses go dark ---
+                t_partition = time.monotonic()
+                fleet.proxy(victim).partition("s2c")
+
+                # Survivors steal every one of the victim's shards.
+                def stolen():
+                    owners = fleet.shard_owners()
+                    return (len(owners) == fleet.shards
+                            and victim not in owners.values())
+
+                _wait(
+                    stolen,
+                    TAKEOVER_BOUND_S,
+                    f"survivors never stole {victim}'s shards:"
+                    f" {fleet.shard_owners()}",
+                )
+                takeover_s = time.monotonic() - t_partition
+                assert set(fleet.shard_owners().values()) <= set(survivors)
+
+                # Hold the partition open well past takeover: a victim
+                # that keeps mutating the fabric would show itself here.
+                remaining = PARTITION_HOLD_S - (time.monotonic() - t_partition)
+                if remaining > 0:
+                    time.sleep(remaining)
+
+                # Ride-through, not crash: the victim is wedged, not dead.
+                assert fleet.replicas[victim].alive(), (
+                    f"{victim} died during the partition — outage"
+                    " ride-through is the contract:\n"
+                    + fleet.tail_log(victim)
+                )
+
+                # --- fencing witness (supervisor-side, attributed) ------
+                fence_deadline = t_partition + RENEW_DEADLINE_S + FENCE_SLACK_S
+                with fleet.fabric._lock:
+                    ledger = list(fleet.fabric.mutation_log)
+                assert ledger, "fabric ledger recorded no mutations at all"
+                late = [(ident, t - t_partition, verb, names)
+                        for ident, t, verb, names in ledger
+                        if ident == victim and t > fence_deadline]
+                assert not late, (
+                    f"UNFENCED: {victim} mutated the fabric"
+                    f" {late[0][1]:.2f}s after partition onset (deadline"
+                    f" {RENEW_DEADLINE_S + FENCE_SLACK_S:.2f}s): {late}"
+                )
+
+                # --- heal: the same wire comes back ---------------------
+                fleet.proxy(victim).heal()
+            finally:
+                driver.stop()
+                churn.join(timeout=30)
+
+            def converged():
+                states = _cr_states(fleet)
+                return (states
+                        and all(s == "Running" for s in states.values())
+                        and _pending_intents(fleet) == 0)
+
+            _wait(
+                converged,
+                90,
+                f"fleet never converged after heal: {_cr_states(fleet)},"
+                f" pending={_pending_intents(fleet)}",
+            )
+
+            # The victim survived the entire episode as one process.
+            assert fleet.replicas[victim].alive()
+            assert fleet.replicas[victim].generation == 1
+
+            # Nonce-checked zero double-attach across the partition,
+            # the takeover and the heal.
+            events = _pool_attach_events(fleet)
+            assert events, "pool recorded no materializations"
+            assert_no_double_attach(events)
+
+            # Detection evidence for the bench/README claim: takeover is
+            # governed by the lease clock, nowhere near a 30s-per-request
+            # discovery baseline.
+            assert takeover_s < TAKEOVER_BOUND_S
+
+            fleet.stop_all()

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import io
 import json
+import random
 import threading
 import time
 
@@ -36,8 +37,14 @@ from tpu_composer.api.types import (
 )
 from tpu_composer.runtime import wiremux
 from tpu_composer.runtime.kubestore import KubeConfig, KubeStore
-from tpu_composer.runtime.metrics import wire_mux_active
-from tpu_composer.runtime.store import ConflictError, NotFoundError
+from tpu_composer.runtime.metrics import (
+    wire_mux_active,
+    wire_mux_degraded_total,
+    wire_mux_reconnects_total,
+    wire_ping_rtt_seconds,
+)
+from tpu_composer.runtime.store import ConflictError, NotFoundError, StoreError
+from tpu_composer.sim.netchaos import ChaosProxy
 
 from tests.fake_apiserver import FakeApiServer, operator_resources
 
@@ -103,6 +110,104 @@ class TestFrameCodec:
         huge = (wiremux.MAX_FRAME + 1).to_bytes(4, "big") + b"x"
         with pytest.raises(wiremux.MuxError, match="cap"):
             wiremux.read_frame(_Dribble(huge, chunk=64))
+
+    def test_garbage_payload_is_a_mux_error_not_a_leak(self):
+        # Valid length prefix, non-JSON bytes: the codec owns the error
+        # type — readers classify on MuxError, never raw ValueError.
+        wire = len(b"\xff\xfe{not json").to_bytes(4, "big") + b"\xff\xfe{not json"
+        with pytest.raises(wiremux.MuxError, match="corrupt frame payload"):
+            wiremux.read_frame(_Dribble(wire, chunk=3))
+        # Valid JSON that is not an object is just as dead on arrival.
+        wire = len(b"[1,2]").to_bytes(4, "big") + b"[1,2]"
+        with pytest.raises(wiremux.MuxError, match="not an object"):
+            wiremux.read_frame(_Dribble(wire, chunk=5))
+
+
+class TestFrameCodecFuzz:
+    """Satellite: seeded codec fuzz. Whatever bytes arrive — valid frames
+    chopped at random points, corrupt/oversized length prefixes, garbage
+    payloads, truncations — the reader must return frames, return None
+    (clean EOF), or raise MuxError. It must never hang and never try to
+    allocate past the 64MB cap."""
+
+    SEED = 0x7C20  # PR 20: reproducible corpus
+
+    def _wire(self, rng: random.Random) -> bytes:
+        frames = []
+        for _ in range(rng.randint(1, 3)):
+            frames.append({
+                "id": rng.randint(1, 1 << 30),
+                "method": rng.choice(["GET", "POST", "PUT", "DELETE"]),
+                "path": "/x/" + "p" * rng.randint(0, 200),
+                "body": {"k": "v" * rng.randint(0, 500)},
+            })
+        return b"".join(wiremux.encode_frame(f) for f in frames)
+
+    def _mutate(self, rng: random.Random, wire: bytes) -> bytes:
+        mode = rng.randrange(5)
+        if mode == 0:
+            return wire  # pristine
+        if mode == 1 and len(wire) > 1:
+            return wire[: rng.randrange(1, len(wire))]  # truncate mid-stream
+        if mode == 2:
+            i = rng.randrange(len(wire))
+            return wire[:i] + bytes([wire[i] ^ (1 << rng.randrange(8))]) \
+                + wire[i + 1:]  # single bit flip (prefix or payload)
+        if mode == 3:
+            # Replace a length prefix with 4 random bytes — including the
+            # gigabyte-range values the MAX_FRAME cap exists for.
+            return rng.randbytes(4) + wire[4:]
+        return wire + rng.randbytes(rng.randint(1, 64))  # trailing garbage
+
+    def test_seeded_fuzz_terminates_with_frames_none_or_mux_error(self):
+        rng = random.Random(self.SEED)
+        outcomes = {"frames": 0, "eof": 0, "error": 0}
+        for _ in range(250):
+            data = self._mutate(rng, self._wire(rng))
+            fp = _Dribble(data, chunk=rng.choice([1, 2, 3, 7, 64, 4096]))
+            # Hard bound on reader iterations: a hang here would mean the
+            # codec can spin/block on hostile input.
+            for _ in range(16):
+                try:
+                    frame = wiremux.read_frame(fp)
+                except wiremux.MuxError:
+                    outcomes["error"] += 1
+                    break
+                except MemoryError as e:  # pragma: no cover - the cap failed
+                    raise AssertionError(
+                        "codec tried to allocate past the frame cap") from e
+                if frame is None:
+                    outcomes["eof"] += 1
+                    break
+                assert isinstance(frame, dict)
+                outcomes["frames"] += 1
+            else:
+                raise AssertionError("reader never terminated on fuzz input")
+        # The corpus must actually exercise all three outcomes.
+        assert all(outcomes.values()), outcomes
+
+    def test_oversized_prefix_never_reads_the_claimed_size(self):
+        rng = random.Random(self.SEED + 1)
+
+        class CountingFp:
+            def __init__(self, data: bytes) -> None:
+                self._fp = io.BytesIO(data)
+                self.asked = 0
+
+            def read(self, n: int) -> bytes:
+                self.asked = max(self.asked, n)
+                return self._fp.read(n)
+
+        for _ in range(50):
+            size = rng.randint(wiremux.MAX_FRAME + 1, 1 << 40)
+            fp = CountingFp(size.to_bytes(5, "big")[-4:] + b"x" * 16)
+            size32 = int.from_bytes(size.to_bytes(5, "big")[-4:], "big")
+            if size32 <= wiremux.MAX_FRAME:
+                continue  # truncated to 32 bits below the cap: fine input
+            with pytest.raises(wiremux.MuxError, match="cap"):
+                wiremux.read_frame(fp)
+            # The cap must reject BEFORE any body read is attempted.
+            assert fp.asked <= wiremux._LEN.size
 
 
 # ----------------------------------------------------------------------
@@ -551,6 +656,253 @@ class TestEventDrivenLoops:
         # repairing now would race its own _mutate_slice write.
         assert pub.reconcile_once() == 0
         assert DevicePublisher(store).devices_invisible("inv-node", ["dev-9"])
+
+
+# ----------------------------------------------------------------------
+# liveness: pings, send deadline, watch death, flap damping (ISSUE 20)
+# ----------------------------------------------------------------------
+@pytest.fixture()
+def chaos(srv):
+    import urllib.parse
+
+    host = urllib.parse.urlsplit(srv.url)
+    proxy = ChaosProxy(host.hostname or "127.0.0.1", host.port or 80)
+    yield proxy
+    proxy.stop()
+
+
+class TestMuxLiveness:
+    def test_silent_partition_fails_all_pendings_and_watches_at_once(
+            self, srv, chaos):
+        """The half-open stall: bytes vanish in both directions but every
+        socket stays open. The ping deadline must fail EVERY pending verb
+        and the watch together, within ~2x the ping period — never one by
+        one via 30s per-request timeouts."""
+        rtt_before = wire_ping_rtt_seconds.count()
+        client = wiremux.MuxClient(chaos.url, ping_period=0.2, ping_misses=1,
+                                   connect_timeout=2.0)
+        try:
+            assert client.request("POST", CR_PREFIX,
+                                  body=cr_doc("live-a"))[0] == 201
+            watch = client.watch(
+                f"{CR_PREFIX}?watch=true&resourceVersion=0", timeout=30)
+            ev = json.loads(next(watch))
+            assert ev["type"] == "ADDED"
+            # Let at least one healthy ping/pong round-trip land.
+            deadline = time.monotonic() + 5
+            while (wire_ping_rtt_seconds.count() == rtt_before
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert wire_ping_rtt_seconds.count() > rtt_before
+
+            chaos.partition()
+            fails: list = []
+
+            def pending_get():
+                t0 = time.monotonic()
+                try:
+                    client.request("GET", f"{CR_PREFIX}/live-a", timeout=30)
+                    fails.append(("response?!", time.monotonic() - t0))
+                except wiremux.MuxError:
+                    fails.append(("muxerr", time.monotonic() - t0))
+
+            def pending_watch():
+                t0 = time.monotonic()
+                try:
+                    next(watch)
+                    fails.append(("event?!", time.monotonic() - t0))
+                except wiremux.MuxError:
+                    fails.append(("muxerr", time.monotonic() - t0))
+                except StopIteration:
+                    fails.append(("clean-end?!", time.monotonic() - t0))
+
+            threads = [threading.Thread(target=pending_get,
+                                        name=f"live-get-{i}")
+                       for i in range(4)]
+            threads.append(threading.Thread(target=pending_watch,
+                                            name="live-watch"))
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            assert not any(t.is_alive() for t in threads), \
+                "a pending verb/watch outlived the liveness deadline"
+            kinds = [k for k, _ in fails]
+            assert kinds.count("muxerr") == 5, fails
+            # ≤ 2x ping period nominal (0.4s); generous CI slack but far
+            # below the 30s per-request baseline.
+            times = [dt for _, dt in fails]
+            assert max(times) < 5.0, fails
+            # "At once": one _fail sweep, not a serial bleed-out.
+            assert max(times) - min(times) < 1.0, fails
+        finally:
+            client.close()
+
+    def test_dead_connection_reconnects_and_counts_the_metric(self, srv,
+                                                              chaos):
+        before = wire_mux_reconnects_total.total()
+        client = wiremux.MuxClient(chaos.url, ping_period=0.1, ping_misses=1,
+                                   connect_timeout=2.0)
+        try:
+            assert client.request("POST", CR_PREFIX,
+                                  body=cr_doc("rc-a"))[0] == 201
+            chaos.cut()
+            # The very next call rides the retry-once path onto a fresh
+            # connection. Depending on when the reader notices the RST the
+            # failure is "never sent" (retries any verb) or in-flight
+            # ambiguous (retries only idempotent verbs) — a GET is safe
+            # either way, which is exactly how KubeStore classifies it.
+            code, body = client.request("GET", f"{CR_PREFIX}/rc-a",
+                                        timeout=10, idempotent=True)
+            assert code == 200 and body["metadata"]["name"] == "rc-a"
+            assert wire_mux_reconnects_total.total() == before + 1
+            # The reconnected wire served frames: no failure streak.
+            assert client.fail_streak == 0
+        finally:
+            client.close()
+
+    def test_send_timeout_unwedges_a_stalled_peer(self, srv, chaos):
+        """A peer that stops draining the socket (slow-loris / half-open)
+        must fail the send after ``send_timeout`` — not wedge the calling
+        controller thread inside a blocking sendall forever."""
+        client = wiremux.MuxClient(chaos.url, ping_period=0.0,
+                                   send_timeout=1.0, connect_timeout=2.0)
+        try:
+            assert client.request("POST", CR_PREFIX,
+                                  body=cr_doc("stall-a"))[0] == 201
+            chaos.partition("c2s")  # proxy stops reading: buffers back up
+            big = cr_doc("stall-b")
+            big["spec"]["blob"] = "x" * (16 * 1024 * 1024)
+            t0 = time.monotonic()
+            with pytest.raises(wiremux.MuxError):
+                client.request("POST", CR_PREFIX, body=big, timeout=30)
+            # Two send attempts (the retry redials) at ~1s each, plus
+            # encode time — nowhere near a wedged-forever sendall.
+            assert time.monotonic() - t0 < 15.0
+        finally:
+            client.close()
+
+    def test_killed_connection_fails_watch_well_under_idle_period(
+            self, srv, chaos):
+        """Satellite: when the connection dies, MuxWatch consumers must
+        end immediately with a DISTINGUISHABLE connection-death error —
+        not a clean StopIteration, not a 30s idle timeout."""
+        client = wiremux.MuxClient(chaos.url, ping_period=0.0,
+                                   connect_timeout=2.0)
+        try:
+            watch = client.watch(
+                f"{CR_PREFIX}?watch=true&resourceVersion=0", timeout=30)
+            outcome: list = []
+
+            def consume():
+                t0 = time.monotonic()
+                try:
+                    next(watch)
+                    outcome.append(("event?!", time.monotonic() - t0))
+                except wiremux.MuxError as e:
+                    outcome.append(("muxerr", time.monotonic() - t0, str(e)))
+                except (StopIteration, OSError):
+                    outcome.append(("wrong-type", time.monotonic() - t0))
+
+            t = threading.Thread(target=consume, name="watch-death")
+            t.start()
+            time.sleep(0.1)
+            t_cut = time.monotonic()
+            chaos.cut()
+            t.join(timeout=10)
+            assert not t.is_alive()
+            assert outcome and outcome[0][0] == "muxerr", outcome
+            assert "connection died" in outcome[0][2]
+            # Re-establish end to end, well under one idle period (30s).
+            srv.put_object(CR_PREFIX, cr_doc("rewatch-a"))
+            watch2 = client.watch(
+                f"{CR_PREFIX}?watch=true&resourceVersion=0", timeout=10)
+            ev = json.loads(next(watch2))
+            assert ev["object"]["metadata"]["name"] == "rewatch-a"
+            assert time.monotonic() - t_cut < 10.0
+            watch2.shutdown()
+        finally:
+            client.close()
+
+
+class TestFlapDamping:
+    def test_mux_http_fallback_needs_k_consecutive_failures(self, srv,
+                                                            monkeypatch):
+        """The damper: K consecutive CONNECTION failures demote to HTTP —
+        once, permanently, counted — never a per-request flap."""
+        dials = {"n": 0}
+
+        def blackhole(self):
+            dials["n"] += 1
+            raise wiremux.MuxError("dial blackhole")
+
+        monkeypatch.setattr(wiremux.MuxClient, "_handshake", blackhole)
+        degraded_before = wire_mux_degraded_total.total()
+        store = KubeStore(config=KubeConfig(host=srv.url), cache_reads=False,
+                          wire_mux=True, wire_mux_max_fails=3)
+        try:
+            deadline = time.monotonic() + 20
+            while not store._mux_failed and time.monotonic() < deadline:
+                try:
+                    store.get(ComposableResource, "absent")
+                except (StoreError, NotFoundError):
+                    pass
+                # Paced past the redial backoff so each loop can be a real
+                # dial attempt, not a fail-fast.
+                time.sleep(0.08)
+            assert store._mux_failed, "damper never tripped"
+            assert dials["n"] >= 3, "demoted before K real dial attempts"
+            assert wire_mux_degraded_total.total() == degraded_before + 1
+            assert wire_mux_active.total() == 0.0
+            # Demoted store works over HTTP immediately.
+            store.create(ComposableResource(
+                metadata=ObjectMeta(name="damped"),
+                spec=ComposableResourceSpec(
+                    type="tpu", model="tpu-v4", target_node="n0"),
+            ))
+            assert store.get(ComposableResource, "damped").name == "damped"
+        finally:
+            store.close()
+
+    def test_one_mid_flight_loss_on_healthy_wire_never_degrades(self, srv):
+        """Even with the damper at its most trigger-happy (K=1), a request
+        lost on a connection that HAS served frames is a per-request
+        failure: streak stays 0 and the mux stays up."""
+        import urllib.parse
+
+        host = urllib.parse.urlsplit(srv.url)
+        chaos = ChaosProxy(host.hostname or "127.0.0.1", host.port or 80)
+        store = KubeStore(config=KubeConfig(host=chaos.url),
+                          cache_reads=False, wire_mux=True,
+                          wire_mux_max_fails=1, wire_ping_period=0.2,
+                          wire_ping_misses=1)
+        try:
+            store.create(ComposableResource(
+                metadata=ObjectMeta(name="flap-a"),
+                spec=ComposableResourceSpec(
+                    type="tpu", model="tpu-v4", target_node="n0"),
+            ))
+            srv.latency_s = 0.4
+            got: list = []
+
+            def read_through_cut():
+                # GET is idempotent: the ambiguous mid-flight loss retries
+                # onto a fresh connection and succeeds.
+                got.append(store.get(ComposableResource, "flap-a").name)
+
+            t = threading.Thread(target=read_through_cut, name="flap-get")
+            t.start()
+            time.sleep(0.15)
+            chaos.cut()
+            t.join(timeout=15)
+            assert not t.is_alive()
+            assert got == ["flap-a"]
+            assert not store._mux_failed, \
+                "a per-request loss flapped the transport"
+        finally:
+            srv.latency_s = 0.0
+            store.close()
+            chaos.stop()
 
 
 class TestChurnDriverMux:

@@ -352,6 +352,47 @@ def build_parser() -> argparse.ArgumentParser:
              " has no wire)",
     )
     p.add_argument(
+        "--wire-ping-period",
+        type=float,
+        default=_env_seconds("TPUC_WIRE_PING_PERIOD", 5.0),
+        help="seconds between mux liveness ping frames; a pong outstanding"
+             " past --wire-ping-misses periods declares the framed"
+             " connection dead and fails every pending verb and watch at"
+             " once instead of waiting out per-request timeouts. 0 (or"
+             " TPUC_WIRE_PING=0, the kill switch the perf-smoke overhead"
+             " gate A/Bs against) disables pings entirely (env"
+             " TPUC_WIRE_PING_PERIOD)",
+    )
+    p.add_argument(
+        "--wire-ping-misses",
+        type=int,
+        default=_env_int("TPUC_WIRE_PING_MISSES", 2),
+        help="mux liveness deadline in ping periods: with a ping"
+             " outstanding, the connection is declared dead once NO frame"
+             " of any kind has arrived for (misses + 0.5) ping periods —"
+             " frame-age, so a busy wire never false-positives; worst-case"
+             " detection from stall onset is (misses + 0.75) periods (env"
+             " TPUC_WIRE_PING_MISSES)",
+    )
+    p.add_argument(
+        "--wire-mux-max-fails",
+        type=int,
+        default=_env_int("TPUC_WIRE_MUX_MAX_FAILS", 5),
+        help="flap damper for the mux->HTTP fallback: degrade to plain"
+             " HTTP only after this many CONSECUTIVE mux connection"
+             " failures (failed dials / connections dead before a single"
+             " frame); per-request failures never count and any healthy"
+             " frame resets the streak (env TPUC_WIRE_MUX_MAX_FAILS)",
+    )
+    p.add_argument(
+        "--wire-connect-timeout",
+        type=float,
+        default=_env_seconds("TPUC_WIRE_CONNECT_TIMEOUT", 5.0),
+        help="seconds a mux (re)dial may take before failing fast — bounds"
+             " how long a store call can wedge on an unreachable apiserver"
+             " during a partition (env TPUC_WIRE_CONNECT_TIMEOUT)",
+    )
+    p.add_argument(
         "--fabric-batch",
         action=argparse.BooleanOptionalAction,
         default=os.environ.get("TPUC_FABRIC_BATCH", "1") != "0",
@@ -1049,6 +1090,10 @@ def build_store(args: argparse.Namespace):
             cache_reads=getattr(args, "cached_reads", True),
             namespace=getattr(args, "namespace", None),
             wire_mux=getattr(args, "wire_mux", None),
+            wire_ping_period=getattr(args, "wire_ping_period", None),
+            wire_ping_misses=getattr(args, "wire_ping_misses", None),
+            wire_mux_max_fails=getattr(args, "wire_mux_max_fails", None),
+            wire_connect_timeout=getattr(args, "wire_connect_timeout", None),
         )
     else:
         log.info("store: standalone (state_dir=%s)",

@@ -149,6 +149,13 @@ class JsonHttpClient:
         ctx = tracing.context()
         if ctx is not None and ctx.trace_id:
             headers["X-Tpuc-Trace-Id"] = ctx.trace_id
+        # Replica attribution: which replica issued this fabric verb. The
+        # partition soak's fencing witness — the supervisor-side fabric
+        # records (identity, monotonic time) per mutation and asserts a
+        # fenced replica stopped mutating past its deadline.
+        identity = os.environ.get("FABRIC_IDENTITY", "")
+        if identity:
+            headers["X-Tpuc-Replica"] = identity
         data = None
         if body is not None:
             data = json.dumps(body).encode()

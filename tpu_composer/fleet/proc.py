@@ -37,6 +37,7 @@ import sys
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -87,6 +88,7 @@ class ProcFleet:
         apiserver_latency_s: float = 0.0,
         extra_env: Optional[Dict[str, str]] = None,
         extra_flags: Optional[List[str]] = None,
+        netchaos: bool = False,
     ) -> None:
         from tpu_composer.fabric.inmem import InMemoryPool
 
@@ -140,14 +142,37 @@ class ProcFleet:
         )
         self.fabric = FakeFabricServer(pool=self.pool)
 
-        self.kubeconfig = os.path.join(self.workdir, "kubeconfig.yaml")
-        with open(self.kubeconfig, "w") as f:
+        self.kubeconfig = self._write_kubeconfig(
+            os.path.join(self.workdir, "kubeconfig.yaml"), self.apiserver.url
+        )
+        # Wire-fault mode: each replica's store traffic is routed through
+        # its own TCP chaos proxy (sim/netchaos.py), so partitions, stalls
+        # and corruption can target ONE replica while the others keep a
+        # clean wire. Proxies are created lazily in spawn() (one per
+        # replica name, reused across restarts so a healed replica comes
+        # back through the same — possibly still partitioned — path).
+        self.netchaos = netchaos
+        self.proxies: Dict[str, Any] = {}
+
+    def _write_kubeconfig(self, path: str, server_url: str) -> str:
+        with open(path, "w") as f:
             f.write(
                 "apiVersion: v1\nkind: Config\ncurrent-context: sim\n"
                 "contexts:\n- name: sim\n  context:\n    cluster: sim\n"
                 "clusters:\n- name: sim\n  cluster:\n"
-                f"    server: {self.apiserver.url}\n"
+                f"    server: {server_url}\n"
             )
+        return path
+
+    def proxy(self, name: str):
+        """The ChaosProxy carrying replica ``name``'s store wire (netchaos
+        mode only) — the handle tests script faults through."""
+        if not self.netchaos:
+            raise RuntimeError("ProcFleet(netchaos=True) required")
+        proxy = self.proxies.get(name)
+        if proxy is None:
+            raise KeyError(f"no proxy for replica {name} (never spawned?)")
+        return proxy
 
     # ------------------------------------------------------------------
     # lifecycle verbs
@@ -175,6 +200,24 @@ class ProcFleet:
                 self.replicas[name] = rep
             rep.generation += 1
 
+        kubeconfig = self.kubeconfig
+        if self.netchaos:
+            proxy = self.proxies.get(name)
+            if proxy is None:
+                from tpu_composer.sim.netchaos import ChaosProxy
+
+                host = urllib.parse.urlsplit(self.apiserver.url)
+                proxy = ChaosProxy(
+                    host.hostname or "127.0.0.1",
+                    host.port or 80,
+                    seed=len(self.proxies) + 1,
+                )
+                self.proxies[name] = proxy
+            os.makedirs(rep.workdir, exist_ok=True)
+            kubeconfig = self._write_kubeconfig(
+                os.path.join(rep.workdir, "kubeconfig.yaml"), proxy.url
+            )
+
         gen_dir = os.path.join(rep.workdir, f"g{rep.generation}")
         os.makedirs(gen_dir, exist_ok=True)
         artifacts = {
@@ -198,6 +241,10 @@ class ProcFleet:
             "CDI_PROVIDER_TYPE": "REST_CM",
             "FABRIC_ENDPOINT": self.fabric.url,
             "NODE_AGENT": "FAKE",
+            # Fabric-side attribution: httpx stamps this on every fabric
+            # verb (X-Tpuc-Replica), so the supervisor's mutation log can
+            # prove WHICH replica mutated the pool — the fencing witness.
+            "FABRIC_IDENTITY": name,
             "TPUC_NAMESPACE": self.namespace,
             # Per-replica black boxes: flight recorder, trace ring and
             # fleet view all land beside the log, per pid.
@@ -209,7 +256,7 @@ class ProcFleet:
         env.update(extra_env or {})
         argv = [
             sys.executable, "-m", "tpu_composer",
-            "--kubeconfig", self.kubeconfig,
+            "--kubeconfig", kubeconfig,
             "--namespace", self.namespace,
             "--shards", str(self.shards),
             "--shard-replicas", str(self.expected_replicas),
@@ -318,6 +365,9 @@ class ProcFleet:
 
     def close(self) -> None:
         self.stop_all()
+        for proxy in self.proxies.values():
+            proxy.stop()
+        self.proxies.clear()
         try:
             self.fabric.close()
         finally:

@@ -63,6 +63,7 @@ from tpu_composer.runtime.metrics import (
     status_writes_coalesced_total,
     store_requests_total,
     wire_mux_active,
+    wire_mux_degraded_total,
 )
 from tpu_composer.runtime.store import (
     ADDED,
@@ -259,6 +260,10 @@ class KubeStore:
         cache_sync_timeout_s: float = 5.0,
         namespace: Optional[str] = None,
         wire_mux: Optional[bool] = None,
+        wire_ping_period: Optional[float] = None,
+        wire_ping_misses: Optional[int] = None,
+        wire_mux_max_fails: Optional[int] = None,
+        wire_connect_timeout: Optional[float] = None,
     ) -> None:
         self._cfg = config or KubeConfig.load(kubeconfig)
         # Per-thread persistent HTTP connection (keep-alive). A fresh
@@ -280,6 +285,30 @@ class KubeStore:
         self._mux: Optional[wiremux.MuxClient] = None
         self._mux_lock = threading.Lock()
         self._mux_failed = False
+        # Mux liveness + flap-damping knobs (cmd/main wires the --wire-*
+        # flags through here; env reads are the fallback for direct
+        # constructions). TPUC_WIRE_PING=0 is the kill switch that wins
+        # over any period — the perf-smoke ping-overhead gate A/Bs on it.
+        if wire_ping_period is None:
+            wire_ping_period = float(
+                os.environ.get("TPUC_WIRE_PING_PERIOD", "5.0")
+            )
+        if os.environ.get("TPUC_WIRE_PING", "1") == "0":
+            wire_ping_period = 0.0
+        self._wire_ping_period = max(0.0, wire_ping_period)
+        if wire_ping_misses is None:
+            wire_ping_misses = int(os.environ.get("TPUC_WIRE_PING_MISSES", "2"))
+        self._wire_ping_misses = max(1, wire_ping_misses)
+        if wire_mux_max_fails is None:
+            wire_mux_max_fails = int(
+                os.environ.get("TPUC_WIRE_MUX_MAX_FAILS", "5")
+            )
+        self._wire_mux_max_fails = max(1, wire_mux_max_fails)
+        if wire_connect_timeout is None:
+            wire_connect_timeout = float(
+                os.environ.get("TPUC_WIRE_CONNECT_TIMEOUT", "5.0")
+            )
+        self._wire_connect_timeout = wire_connect_timeout
         # Namespace for the namespaced kinds (Leases, FleetTelemetry):
         # cmd/main wires --namespace / TPUC_NAMESPACE through here; the
         # env read below is the fallback for direct constructions.
@@ -397,8 +426,10 @@ class KubeStore:
                     # response the HTTP path returns, so _WatchThread is
                     # transport-blind.
                     return mux.watch(path, timeout=timeout)
-                code, payload = mux.request(method, path, body=body,
-                                            timeout=timeout)
+                code, payload = mux.request(
+                    method, path, body=body, timeout=timeout,
+                    idempotent=self._retry_safe(method, body),
+                )
                 if code >= 400:
                     raise self._http_error(method, path, code, payload)
                 return payload if isinstance(payload, dict) else {}
@@ -411,7 +442,9 @@ class KubeStore:
             except wiremux.MuxError as e:
                 # Transport failure on the framed socket: same contract as
                 # an HTTP transport failure — typed StoreError, reconnect
-                # happens lazily on the next call.
+                # happens lazily on the next call. Connection-level failure
+                # streaks (never per-request ones) feed the flap damper.
+                self._note_mux_failure(mux)
                 raise StoreError(f"{method} {path}: {e}") from None
         url = self._cfg.host.rstrip("/") + path
         data = json.dumps(body).encode() if body is not None else None
@@ -445,12 +478,17 @@ class KubeStore:
             headers["Content-Type"] = "application/json"
         if self._cfg.token:
             headers["Authorization"] = f"Bearer {self._cfg.token}"
-        # Keep-alive with one retry: a pooled connection the server
-        # idle-closed between requests surfaces as a transport error
-        # before any response bytes — retrying once on a fresh
-        # connection is the standard (urllib3-style) recovery. A
-        # failure on a brand-new connection is a real outage and
-        # propagates immediately.
+        # Keep-alive with one CLASSIFIED retry: a pooled connection the
+        # server idle-closed surfaces as a transport error while writing
+        # the request — nothing was executed, so retrying any verb once on
+        # a fresh connection is the standard (urllib3-style) recovery. A
+        # failure AFTER the request was fully written is ambiguous (the
+        # server may have executed it and the response was lost): only
+        # idempotent verbs — reads and CAS-guarded updates — retry; a
+        # create/delete surfaces as StoreError so the controllers'
+        # requeue + nonce machinery resolves the ambiguity. A failure on
+        # a brand-new connection is a real outage and propagates.
+        idempotent = self._retry_safe(method, body)
         for attempt in (0, 1):
             conn = getattr(self._conn_local, "conn", None)
             reused = conn is not None
@@ -461,21 +499,54 @@ class KubeStore:
                 conn.sock.settimeout(timeout)
             else:
                 conn.timeout = timeout
+            sent = False
             try:
                 conn.request(method, path, body=data, headers=headers)
+                sent = True  # fully written: failures past here are ambiguous
                 resp = conn.getresponse()
                 payload = resp.read().decode(errors="replace")
                 code = resp.status
             except (http.client.HTTPException, OSError) as e:
                 conn.close()
                 self._conn_local.conn = None
-                if reused and attempt == 0:
+                if reused and attempt == 0 and (not sent or idempotent):
                     continue
                 raise StoreError(f"{method} {path}: {e}") from None
             if code >= 400:
                 raise self._http_error(method, path, code, payload)
             return json.loads(payload) if payload else {}
         raise StoreError(f"{method} {path}: retry fell through")  # unreachable
+
+    @staticmethod
+    def _retry_safe(method: str, body: Optional[Dict[str, Any]]) -> bool:
+        """Idempotency classification for ambiguous "sent, response lost"
+        transport failures. GET re-runs trivially. A PUT carrying a
+        ``metadata.resourceVersion`` is CAS-guarded: if the lost attempt
+        actually landed, the replay hits 409 ConflictError and the caller
+        requeues on fresh state — never a double apply. Creates, deletes,
+        and blind PUTs are NOT safe: replaying one can double-execute, so
+        the ambiguity must surface as StoreError and be resolved by the
+        controllers' requeue + nonce machinery, not by the transport."""
+        if method == "GET":
+            return True
+        if method == "PUT":
+            md = (body or {}).get("metadata") or {}
+            return bool(md.get("resourceVersion"))
+        return False
+
+    def _note_mux_failure(self, mux: wiremux.MuxClient) -> None:
+        """Flap damper: degrade to HTTP only after K consecutive mux
+        CONNECTION failures (failed dials plus connections that died
+        before serving a single frame). Per-request failures never count,
+        so one lost verb on a healthy transport can't flap it, and a
+        healthy frame resets the streak — degradation means the wire
+        itself is persistently unusable."""
+        if mux.fail_streak >= self._wire_mux_max_fails:
+            self._mux_disable(
+                f"{mux.fail_streak} consecutive mux connection failures"
+                f" (limit {self._wire_mux_max_fails})",
+                cause="failures",
+            )
 
     def _mux_client(self) -> Optional[wiremux.MuxClient]:
         """The shared framed-transport client, or None when the store is on
@@ -490,17 +561,23 @@ class KubeStore:
                     else None
                 )
                 self._mux = wiremux.MuxClient(
-                    self._cfg.host, ssl_context=ctx, token=self._cfg.token
+                    self._cfg.host,
+                    ssl_context=ctx,
+                    token=self._cfg.token,
+                    connect_timeout=self._wire_connect_timeout,
+                    ping_period=self._wire_ping_period,
+                    ping_misses=self._wire_ping_misses,
                 )
                 wire_mux_active.set(1)
             return self._mux
 
-    def _mux_disable(self, reason: str) -> None:
+    def _mux_disable(self, reason: str, cause: str = "declined") -> None:
         """Permanent fallback to the keep-alive HTTP path for this store."""
         if not self._mux_failed:
             logging.getLogger("tpu_composer.kubestore").warning(
                 "wire mux disabled, falling back to HTTP: %s", reason
             )
+            wire_mux_degraded_total.inc(reason=cause)
         self._mux_failed = True
         wire_mux_active.set(0)
         with self._mux_lock:

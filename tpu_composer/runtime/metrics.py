@@ -428,7 +428,27 @@ wire_mux_active = global_registry.gauge(
     "tpuc_wire_mux_active",
     "1 while the store client is on the multiplexed framed transport"
     " (tpuc-mux/1); 0 after falling back to per-request keep-alive HTTP"
-    " (server declined the upgrade or TPUC_WIRE_MUX=0)",
+    " (server declined the upgrade, the K-streak flap damper tripped, or"
+    " TPUC_WIRE_MUX=0)",
+)
+wire_mux_reconnects_total = global_registry.counter(
+    "tpuc_wire_mux_reconnects_total",
+    "Mux connections re-established after a connection loss (the first"
+    " dial of a process does not count) — each increment is one framed-"
+    "transport death ridden out by reconnect + watch resume-from-cursor",
+)
+wire_mux_degraded_total = global_registry.counter(
+    "tpuc_wire_mux_degraded_total",
+    "Permanent mux->HTTP demotions by reason (declined = server without a"
+    " /mux endpoint; failures = K consecutive mux connection failures"
+    " tripped the flap damper). At most one per process per store",
+)
+wire_ping_rtt_seconds = global_registry.histogram(
+    "tpuc_wire_ping_rtt_seconds",
+    "Mux liveness ping/pong round-trip time on the framed transport —"
+    " the transport-level health signal behind dead-connection detection"
+    " (a pong outstanding past the miss deadline fails the connection)",
+    buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
 )
 
 #: Fabric I/O pipeline (fabric/dispatcher.py): per-node batched group

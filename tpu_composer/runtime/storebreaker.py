@@ -5,8 +5,13 @@ protects the pool manager from a retry storm, this wraps the OBJECT STORE
 (in-proc ``Store``, ``KubeStore``, or the ChaosStore around either) and
 classifies its errors the same way:
 
-- ``StoreError`` (transient 5xx / timeouts / the ChaosStore's blackout) is
-  a breaker failure; ``failure_threshold`` consecutive ones trip OPEN;
+- ``StoreError`` (transient 5xx / timeouts / the ChaosStore's blackout,
+  and — via KubeStore's MuxError→StoreError mapping — every framed-wire
+  transport death: a mux connection failing ALL its pending verbs at once
+  lands the whole batch on the trip streak in one tick, so a partitioned
+  or flapping wire trips the outage ride-through fast instead of bleeding
+  one 30s timeout per verb) is a breaker failure; ``failure_threshold``
+  consecutive ones trip OPEN;
 - ``ConflictError`` / ``NotFoundError`` are the store WORKING — a 409 or
   404 is a healthy apiserver saying no, so they reset the failure streak
   and never trip the breaker.

@@ -23,12 +23,27 @@ Protocol (version ``tpuc-mux/1``):
       one verb; a path carrying ``watch=true`` opens a watch stream whose
       stream id IS the request id.
     ``{"cancel": N}`` — stop watch stream N.
+    ``{"ping": N}`` — liveness probe (client-initiated, answered inline).
 - Server → client:
     ``{"id": N, "code": C, "body": {...}}`` — verb response (or the watch
       accept/denial: a watch ack carries ``"watch": true``).
     ``{"watch": N, "event": {...}}`` — one watch event (same JSON the HTTP
       chunked watch writes per line, including the 410 ERROR persona).
     ``{"watch": N, "end": true}`` — stream N ended server-side.
+    ``{"pong": N}`` — answer to ping N.
+
+Liveness: after the handshake the socket is fully blocking, so a silent
+partition (NAT drop, half-open peer) would otherwise stall every pending
+correlation id until its individual request timeout and leave watches
+waiting out their idle period. With ``ping_period > 0`` the client probes
+the transport with ping frames; a pong outstanding past
+``ping_misses x ping_period`` declares the connection dead and fails ALL
+pending verbs and watch streams at once — detection within ~2x the ping
+period at ``ping_misses=1``, versus the ~30s per-request timeout baseline.
+Sends carry their own wall deadline (``send_timeout``) so a peer that
+stops draining the socket can never wedge a controller thread inside a
+blocking ``sendall``. Reconnects back off (bounded) and fail fast while
+the backoff window is open.
 
 Method/path/body are byte-identical to the HTTP path, so everything keyed
 on them — the sim apiserver's request_log assertions, fail-hook personas,
@@ -43,12 +58,19 @@ import itertools
 import json
 import logging
 import queue
+import select
 import socket
 import ssl
 import struct
 import threading
+import time
 import urllib.parse
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from tpu_composer.runtime.metrics import (
+    wire_mux_reconnects_total,
+    wire_ping_rtt_seconds,
+)
 
 log = logging.getLogger("wiremux")
 
@@ -118,7 +140,13 @@ def read_frame(fp) -> Optional[Dict[str, Any]]:
     body = read_exact(fp, size)
     if body is None:
         raise MuxError("EOF between frame header and body")
-    return json.loads(body)
+    try:
+        obj = json.loads(body)
+    except ValueError as e:
+        raise MuxError(f"corrupt frame payload: {e}") from None
+    if not isinstance(obj, dict):
+        raise MuxError(f"frame payload is {type(obj).__name__}, not an object")
+    return obj
 
 
 # ----------------------------------------------------------------------
@@ -161,6 +189,13 @@ class MuxWatch:
     def _end(self) -> None:
         self._events.put(self._END)
 
+    def _fail(self, err: MuxError) -> None:
+        """Connection death: the consumer must learn NOW, and must be able
+        to tell this apart from a clean server-side stream end — a clean
+        end means "re-list maybe", a dead connection means "reconnect with
+        the resume cursor immediately"."""
+        self._events.put(err)
+
     def __iter__(self) -> "MuxWatch":
         return self
 
@@ -177,6 +212,9 @@ class MuxWatch:
         if item is self._END:
             self._closed = True
             raise StopIteration
+        if isinstance(item, MuxError):
+            self._closed = True
+            raise MuxError(f"mux watch {self._id}: connection died: {item}")
         return (json.dumps(item) + "\n").encode()
 
     def shutdown(self) -> None:
@@ -189,9 +227,18 @@ class MuxWatch:
 
 
 class _MuxConn:
-    """One live framed connection: socket, reader thread, correlation maps."""
+    """One live framed connection: socket, reader thread, pinger thread,
+    correlation maps."""
 
-    def __init__(self, sock: socket.socket) -> None:
+    def __init__(
+        self,
+        sock: socket.socket,
+        ping_period: float = 0.0,
+        ping_misses: int = 2,
+        send_timeout: float = 10.0,
+        on_dead: Optional[Callable[["_MuxConn"], None]] = None,
+        on_alive: Optional[Callable[["_MuxConn"], None]] = None,
+    ) -> None:
         self.sock = sock
         self.rfile = sock.makefile("rb")
         self._wlock = threading.Lock()
@@ -199,20 +246,120 @@ class _MuxConn:
         self._pending: Dict[int, _Pending] = {}
         self._watches: Dict[int, MuxWatch] = {}
         self.dead = threading.Event()
+        self._send_timeout = max(0.1, send_timeout)
+        self._ping_period = max(0.0, ping_period)
+        self._ping_misses = max(1, int(ping_misses))
+        self._ping_sent: Dict[int, float] = {}  # seq -> monotonic send time
+        self._ping_seq = 0
+        self._last_ping = time.monotonic()
+        #: Monotonic time of the last frame of ANY kind from the peer —
+        #: the liveness clock. Any arriving frame proves the wire, so a
+        #: busy connection never false-positives on one slow pong.
+        self._last_frame = time.monotonic()
+        #: True once any frame arrived on this connection — a connection
+        #: that dies frameless counts toward the client's fail streak.
+        self.got_frame = False
+        self._on_dead = on_dead
+        self._on_alive = on_alive
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True, name="mux-reader"
         )
         self._reader.start()
+        if self._ping_period > 0:
+            self._pinger = threading.Thread(
+                target=self._ping_loop, daemon=True, name="mux-pinger"
+            )
+            self._pinger.start()
 
     # -- sending -------------------------------------------------------
     def send(self, frame: Dict[str, Any]) -> None:
         data = encode_frame(frame)
         try:
             with self._wlock:
-                self.sock.sendall(data)
+                self._send_bytes(data)
+        except MuxError as e:
+            self._fail(e)
+            raise
         except OSError as e:
-            self._fail(MuxError(f"mux send: {e}"))
-            raise MuxError(f"mux send: {e}") from None
+            err = MuxError(f"mux send: {e}")
+            self._fail(err)
+            raise err from None
+
+    def _send_bytes(self, data: bytes) -> None:
+        """sendall under a wall deadline: wait-for-writable + partial send,
+        so a peer that stops draining (full TCP buffer, half-open stall)
+        fails the connection after ``send_timeout`` instead of wedging the
+        calling controller thread inside a blocking ``sendall`` forever.
+        Never uses ``settimeout`` — the reader thread shares this socket
+        and a timeout surfacing mid-read would corrupt framing."""
+        deadline = time.monotonic() + self._send_timeout
+        view = memoryview(data)
+        sent = 0
+        while sent < len(view):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise MuxError(
+                    f"mux send: peer stalled for {self._send_timeout}s"
+                    " with socket buffer full"
+                )
+            try:
+                _, writable, _ = select.select(
+                    [], [self.sock], [], min(remaining, 0.25)
+                )
+            except (OSError, ValueError):
+                raise MuxError("mux send: socket closed") from None
+            if not writable:
+                continue
+            try:
+                # MSG_DONTWAIT: non-blocking for THIS call only, without
+                # flipping O_NONBLOCK on the shared fd. A plain send() on a
+                # blocking socket queues the ENTIRE buffer before returning
+                # — against a stalled peer a large frame wedges forever no
+                # matter what select said (select only guarantees SOME
+                # space, not len(view) of it).
+                sent += self.sock.send(view[sent:], socket.MSG_DONTWAIT)
+            except (BlockingIOError, InterruptedError):
+                continue
+
+    # -- liveness ------------------------------------------------------
+    def _ping_loop(self) -> None:
+        """Probe the transport with ping frames every ``ping_period``; the
+        connection is declared dead when NO frame of any kind (pong,
+        response, watch event) has arrived for ``(misses + 0.5) x period``
+        while a probe is outstanding. On a healthy idle wire the frame age
+        oscillates between ~0 and one period (each probe's pong resets
+        it), so the extra half period is the margin that keeps the
+        threshold strictly above the probe cadence. Wakes at
+        quarter-period granularity; worst-case detection from stall onset
+        is ``(misses + 0.75) x period`` — two periods at the bench's
+        ``misses=1``, comfortably under any per-request timeout."""
+        period = self._ping_period
+        deadline = (self._ping_misses + 0.5) * period
+        err: Optional[MuxError] = None
+        while not self.dead.wait(period / 4.0):
+            now = time.monotonic()
+            with self._lock:
+                stale_for = now - self._last_frame
+                if self._ping_sent and stale_for >= deadline:
+                    err = MuxError(
+                        f"mux liveness: no frame for {stale_for:.2f}s with"
+                        f" {len(self._ping_sent)} ping(s) unanswered"
+                        f" (deadline {deadline:g}s ="
+                        f" (misses {self._ping_misses} + 0.5) x {period:g}s)"
+                    )
+                    break
+                if now - self._last_ping < period:
+                    continue
+                self._ping_seq += 1
+                seq = self._ping_seq
+                self._ping_sent[seq] = now
+                self._last_ping = now
+            try:
+                self.send({"ping": seq})
+            except MuxError:
+                return  # send() already failed the connection
+        if err is not None:
+            self._fail(err)
 
     def cancel_watch(self, stream_id: int) -> None:
         with self._lock:
@@ -257,6 +404,17 @@ class _MuxConn:
         self._fail(err or MuxError("mux connection closed"))
 
     def _dispatch(self, frame: Dict[str, Any]) -> None:
+        self._last_frame = time.monotonic()
+        if not self.got_frame:
+            self.got_frame = True
+            if self._on_alive is not None:
+                self._on_alive(self)
+        if "pong" in frame:
+            with self._lock:
+                sent_at = self._ping_sent.pop(frame["pong"], None)
+            if sent_at is not None:
+                wire_ping_rtt_seconds.observe(time.monotonic() - sent_at)
+            return
         if "watch" in frame and "id" not in frame:
             sid = frame["watch"]
             with self._lock:
@@ -280,8 +438,10 @@ class _MuxConn:
         p.event.set()
 
     def _fail(self, err: MuxError) -> None:
-        """Connection is gone: everything in flight fails, every watch
-        stream ends (its consumer reconnects with a resume cursor)."""
+        """Connection is gone: everything in flight fails AT ONCE — every
+        pending verb and every watch stream, not serially via per-request
+        timeouts. Watch consumers get a distinguishable connection-death
+        error so they reconnect from their resume cursor immediately."""
         with self._lock:
             if self.dead.is_set():
                 return
@@ -290,11 +450,14 @@ class _MuxConn:
             self._pending.clear()
             watches = list(self._watches.values())
             self._watches.clear()
+            self._ping_sent.clear()
         for p in pending:
             p.error = err
             p.event.set()
         for w in watches:
-            w._end()
+            w._fail(err)
+        if self._on_dead is not None:
+            self._on_dead(self)
         self.close()
 
     def close(self) -> None:
@@ -315,7 +478,11 @@ class MuxClient:
         base_url: str,
         ssl_context: Optional[ssl.SSLContext] = None,
         token: Optional[str] = None,
-        connect_timeout: float = 10.0,
+        connect_timeout: float = 5.0,
+        ping_period: float = 5.0,
+        ping_misses: int = 2,
+        send_timeout: float = 10.0,
+        redial_backoff_max: float = 2.0,
     ) -> None:
         split = urllib.parse.urlsplit(base_url)
         self._host = split.hostname or "127.0.0.1"
@@ -324,10 +491,21 @@ class MuxClient:
         self._ssl_ctx = ssl_context
         self._token = token
         self._connect_timeout = connect_timeout
+        self._ping_period = max(0.0, ping_period)
+        self._ping_misses = max(1, int(ping_misses))
+        self._send_timeout = send_timeout
+        self._redial_backoff_max = max(0.05, redial_backoff_max)
         self._ids = itertools.count(1)
         self._conn: Optional[_MuxConn] = None
         self._conn_lock = threading.Lock()
         self._closed = False
+        self._backoff = 0.0
+        self._next_dial = 0.0  # monotonic gate: fail fast while it's open
+        self._dialed_once = False
+        #: Consecutive connection-level failures (failed handshakes plus
+        #: connections that died before serving a single frame) — NEVER
+        #: per-request failures. The kubestore's flap damper reads this.
+        self.fail_streak = 0
 
     # -- connection management -----------------------------------------
     def _handshake(self) -> _MuxConn:
@@ -377,9 +555,26 @@ class MuxClient:
             sock.close()
             raise MuxError(f"mux handshake: {e}") from None
         # Handshake done: clear the connect timeout — reads are framed and
-        # blocking from here; per-request deadlines live client-side.
+        # blocking from here; per-request deadlines live client-side and
+        # the ping deadline covers transport liveness.
         sock.settimeout(None)
-        return _MuxConn(sock)
+        return _MuxConn(
+            sock,
+            ping_period=self._ping_period,
+            ping_misses=self._ping_misses,
+            send_timeout=self._send_timeout,
+            on_dead=self._conn_died,
+            on_alive=self._conn_alive,
+        )
+
+    def _conn_died(self, conn: "_MuxConn") -> None:
+        # Reader/pinger-thread callback: a connection that never served a
+        # frame is a connection-level failure episode.
+        if not conn.got_frame:
+            self.fail_streak += 1
+
+    def _conn_alive(self, conn: "_MuxConn") -> None:
+        self.fail_streak = 0
 
     def _ensure_conn(self) -> _MuxConn:
         conn = self._conn
@@ -391,7 +586,33 @@ class MuxClient:
             conn = self._conn
             if conn is not None and not conn.dead.is_set():
                 return conn
-            conn = self._handshake()
+            now = time.monotonic()
+            if now < self._next_dial:
+                raise MuxError(
+                    f"mux reconnect backoff: retry in"
+                    f" {self._next_dial - now:.2f}s after"
+                    f" {self.fail_streak} consecutive connection failures"
+                )
+            try:
+                conn = self._handshake()
+            except MuxUnsupported:
+                raise  # permanent verdict, not a flap: no backoff/streak
+            except MuxError:
+                self.fail_streak += 1
+                self._backoff = min(
+                    max(self._backoff * 2.0, 0.05), self._redial_backoff_max
+                )
+                self._next_dial = time.monotonic() + self._backoff
+                raise
+            self._backoff = 0.0
+            self._next_dial = 0.0
+            if self._dialed_once:
+                wire_mux_reconnects_total.inc()
+                log.info(
+                    "mux reconnected to %s:%s (watches resume from cache"
+                    " cursor)", self._host, self._port,
+                )
+            self._dialed_once = True
             self._conn = conn
             return conn
 
@@ -402,28 +623,43 @@ class MuxClient:
         path: str,
         body: Optional[Dict[str, Any]] = None,
         timeout: float = 30.0,
+        idempotent: bool = False,
     ) -> Tuple[int, Any]:
-        """One pipelined verb. Returns (status code, decoded body). Retries
-        once on a send that hit an already-dead pooled connection (same
-        recovery the keep-alive HTTP path does); a connection that dies
-        while the request is in flight surfaces as MuxError — the caller's
-        normal retry/absorb policy applies."""
+        """One pipelined verb. Returns (status code, decoded body).
+
+        Retry classification: a failure BEFORE the frame left this process
+        ("never sent" — dead pooled connection, registration on a dying
+        connection) is safe to retry for ANY verb, the same recovery the
+        keep-alive HTTP path does. A connection death WHILE the request is
+        in flight is ambiguous — the server may or may not have executed
+        the verb — so it is retried once only when the caller declares the
+        verb ``idempotent`` (reads, CAS-guarded updates); otherwise it
+        surfaces as MuxError so the caller's requeue + nonce machinery
+        resolves the ambiguity. A response timeout always raises."""
         for attempt in (0, 1):
             conn = self._ensure_conn()
             rid = next(self._ids)
-            pending = conn.add_pending(rid)
+            try:
+                pending = conn.add_pending(rid)
+            except MuxError:
+                if attempt == 0:
+                    continue  # never sent: safe for any verb
+                raise
             try:
                 conn.send({"id": rid, "method": method, "path": path,
                            "body": body})
             except MuxError:
                 conn.drop_pending(rid)
                 if attempt == 0:
-                    continue
+                    continue  # never sent: safe for any verb
                 raise
             if not pending.event.wait(timeout):
                 conn.drop_pending(rid)
                 raise MuxError(f"{method} {path}: mux response timeout")
             if pending.error is not None:
+                # In flight when the connection died: ambiguous.
+                if idempotent and attempt == 0:
+                    continue
                 raise pending.error
             return pending.code or 500, pending.body
         raise MuxError(f"{method} {path}: mux retry fell through")

@@ -515,6 +515,13 @@ class FakeApiServer:
                         frame = wiremux.read_frame(self.rfile)
                         if frame is None:
                             break
+                        if "ping" in frame:
+                            # Liveness probe: answered inline on the read
+                            # loop, never through the verb pool, so pongs
+                            # measure the wire — not modeled apiserver
+                            # latency or fail-hook personas.
+                            send({"pong": frame["ping"]})
+                            continue
                         if "cancel" in frame:
                             stop = watch_stops.get(frame["cancel"])
                             if stop is not None:
