@@ -22,6 +22,10 @@ from __future__ import annotations
 import io
 import json
 import random
+import shutil
+import socket
+import ssl
+import subprocess
 import threading
 import time
 
@@ -922,3 +926,178 @@ class TestChurnDriverMux:
             assert body["metadata"]["name"] == "churn-mux"
         finally:
             drv.close()
+
+
+# ----------------------------------------------------------------------
+# TLS wire (REVIEW: ssl.SSLSocket.send() rejects MSG_DONTWAIT)
+# ----------------------------------------------------------------------
+class _TlsMuxServer:
+    """Minimal TLS-terminating tpuc-mux/1 endpoint: per-connection thread
+    does the TLS handshake, answers the HTTP Upgrade with 101, then echoes
+    verbs and answers pings — or, with ``stall=True``, goes dark after the
+    101 (never reads again) to model a slow-loris TLS peer."""
+
+    def __init__(self, certfile: str, keyfile: str, stall: bool = False):
+        self._ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        self._ctx.load_cert_chain(certfile, keyfile)
+        self._stall = stall
+        self._lsock = socket.create_server(("127.0.0.1", 0))
+        self.port = self._lsock.getsockname()[1]
+        self.url = f"https://127.0.0.1:{self.port}"
+        self._stop = threading.Event()
+        self._conns: list = []
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="tls-mux-accept").start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                raw, _ = self._lsock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(raw,), daemon=True,
+                             name="tls-mux-conn").start()
+
+    def _serve(self, raw: socket.socket) -> None:
+        try:
+            sock = self._ctx.wrap_socket(raw, server_side=True)
+        except (ssl.SSLError, OSError):
+            raw.close()
+            return
+        self._conns.append(sock)
+        try:
+            head = b""
+            while b"\r\n\r\n" not in head:
+                b1 = sock.recv(1)
+                if not b1:
+                    return
+                head += b1
+            sock.sendall(b"HTTP/1.1 101 Switching Protocols\r\n"
+                         b"Upgrade: tpuc-mux/1\r\nConnection: Upgrade\r\n\r\n")
+            if self._stall:
+                self._stop.wait()  # handshake done, then never read again
+                return
+            rfile = sock.makefile("rb")
+            while True:
+                frame = wiremux.read_frame(rfile)
+                if frame is None:
+                    return
+                if "ping" in frame:
+                    sock.sendall(wiremux.encode_frame({"pong": frame["ping"]}))
+                elif "id" in frame:
+                    body = frame.get("body") or {}
+                    sock.sendall(wiremux.encode_frame({
+                        "id": frame["id"], "code": 200,
+                        "body": {"echo_bytes": len(json.dumps(body))},
+                    }))
+        except (wiremux.MuxError, OSError, ValueError):
+            return
+        finally:
+            sock.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for s in [self._lsock] + self._conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+@pytest.fixture(scope="module")
+def tls_cert(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = d / "cert.pem", d / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "2",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return str(cert), str(key)
+
+
+class _FlagRejectingSock:
+    """Delegates to a real socket but rejects flags on send() exactly the
+    way ``ssl.SSLSocket`` does — while NOT being an SSLSocket, so
+    ``_send_bytes`` takes the MSG_DONTWAIT path and must convert the
+    ValueError instead of letting it escape unclassified."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+
+    def send(self, data, flags: int = 0) -> int:
+        if flags:
+            raise ValueError("non-zero flags not allowed in calls to send()")
+        return self._sock.send(data)
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)
+
+
+@pytest.mark.skipif(shutil.which("openssl") is None,
+                    reason="openssl CLI unavailable for cert generation")
+class TestTlsMux:
+    def test_post_handshake_verbs_and_pings_cross_a_tls_wire(self, tls_cert):
+        """Regression: ``ssl.SSLSocket.send()`` raises ValueError for any
+        non-zero flags, so the MSG_DONTWAIT write path crashed EVERY
+        post-handshake send on an https base_url — verbs, pings, watch
+        cancels — escaping the MuxError contract. TLS must ride the
+        flagless chunked path instead."""
+        server = _TlsMuxServer(*tls_cert)
+        ctx = ssl.create_default_context(cafile=tls_cert[0])
+        rtt_before = wire_ping_rtt_seconds.count()
+        client = wiremux.MuxClient(server.url, ssl_context=ctx,
+                                   ping_period=0.1, connect_timeout=5.0)
+        try:
+            # Body big enough that _send_bytes takes several TLS chunks.
+            blob = "x" * (4 * wiremux.TLS_SEND_CHUNK)
+            code, body = client.request("POST", "/echo", body={"blob": blob},
+                                        timeout=10)
+            assert code == 200
+            assert body["echo_bytes"] > len(blob)
+            # The pinger thread survives too: before the fix its first
+            # ping died on the same ValueError, silently killing liveness.
+            deadline = time.monotonic() + 5
+            while (wire_ping_rtt_seconds.count() == rtt_before
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert wire_ping_rtt_seconds.count() > rtt_before
+        finally:
+            client.close()
+            server.stop()
+
+    def test_stalled_tls_peer_fails_send_within_deadline(self, tls_cert):
+        """The send deadline must hold on the TLS path as well: a peer
+        that handshakes then never reads fails the send as a MuxError in
+        ~send_timeout per attempt — no wedge, no ValueError."""
+        server = _TlsMuxServer(*tls_cert, stall=True)
+        ctx = ssl.create_default_context(cafile=tls_cert[0])
+        client = wiremux.MuxClient(server.url, ssl_context=ctx,
+                                   ping_period=0.0, send_timeout=1.0,
+                                   connect_timeout=5.0)
+        try:
+            big = cr_doc("tls-stall")
+            big["spec"]["blob"] = "x" * (8 * 1024 * 1024)
+            t0 = time.monotonic()
+            with pytest.raises(wiremux.MuxError):
+                client.request("POST", CR_PREFIX, body=big, timeout=30)
+            # Two send attempts (the retry redials) at ~1s each plus TLS
+            # and encode overhead — nowhere near a wedged-forever send.
+            assert time.monotonic() - t0 < 15.0
+        finally:
+            client.close()
+            server.stop()
+
+
+class TestSendValueErrorSafetyNet:
+    def test_flag_rejecting_socket_fails_as_muxerror_not_valueerror(self):
+        a, b = socket.socketpair()
+        conn = wiremux._MuxConn(_FlagRejectingSock(a))
+        try:
+            with pytest.raises(wiremux.MuxError):
+                conn.send({"id": 1, "method": "GET", "path": "/x"})
+            assert conn.dead.is_set()
+        finally:
+            conn.close()
+            b.close()

@@ -502,7 +502,11 @@ class KubeStore:
             sent = False
             try:
                 conn.request(method, path, body=data, headers=headers)
-                sent = True  # fully written: failures past here are ambiguous
+                # Fully written: failures past here are ambiguous. The
+                # converse — request() raised, so the server provably did
+                # not execute — rests on the invariant documented next to
+                # _retry_safe; see the residual-window note there.
+                sent = True
                 resp = conn.getresponse()
                 payload = resp.read().decode(errors="replace")
                 code = resp.status
@@ -526,7 +530,27 @@ class KubeStore:
         requeues on fresh state — never a double apply. Creates, deletes,
         and blind PUTs are NOT safe: replaying one can double-execute, so
         the ambiguity must surface as StoreError and be resolved by the
-        controllers' requeue + nonce machinery, not by the transport."""
+        controllers' requeue + nonce machinery, not by the transport.
+
+        RESIDUAL WINDOW of the sent/not-sent split in the HTTP leg: an
+        exception raised inside ``conn.request()`` is classified as
+        "never executed" and retried once for ANY verb on a reused
+        connection. That is sound only under the invariant that a raising
+        write path left some suffix of the request un-queued — the server
+        then cannot hold the complete request (headers + full
+        Content-Length body) and will not execute it. CPython's
+        ``http.client`` with a ``bytes`` body upholds this (headers and
+        body coalesce into one ``sendall``, which raises only with
+        unconsumed data remaining), but it is an assumption about the
+        stdlib write path, not something this code can observe: a
+        successful ``sendall`` only proves kernel-buffering, and a
+        transport whose write raised AFTER the full request was queued
+        (e.g. a socket wrapper surfacing a delayed RST from
+        fully-delivered earlier writes) would let a create/delete retry
+        double-execute in that narrow window. If the write path ever
+        grows such a layer, ``sent`` must flip to True the moment body
+        bytes begin flowing, accepting idempotent-only retries for
+        write-phase failures."""
         if method == "GET":
             return True
         if method == "PUT":

@@ -84,6 +84,13 @@ MUX_PATH = "/mux"
 #: us try to allocate gigabytes.
 MAX_FRAME = 64 * 1024 * 1024
 
+#: Per-write chunk on TLS connections, where MSG_DONTWAIT is unavailable
+#: (``ssl.SSLSocket.send`` rejects flags): small enough that one blocking
+#: SSL_write of a chunk-sized record drains quickly even against a nearly
+#: full socket buffer, so the deadline loop in ``_send_bytes`` keeps
+#: control between chunks.
+TLS_SEND_CHUNK = 4096
+
 _LEN = struct.Struct(">I")
 
 
@@ -295,6 +302,16 @@ class _MuxConn:
         deadline = time.monotonic() + self._send_timeout
         view = memoryview(data)
         sent = 0
+        # ssl.SSLSocket.send() raises ValueError for ANY non-zero flags, so
+        # the MSG_DONTWAIT trick below is plain-TCP only. TLS instead
+        # writes one small record per select-writable wakeup: a blocking
+        # SSL_write of a TLS_SEND_CHUNK record parks at most until the
+        # kernel drains that one record (not the whole frame), and the
+        # deadline check between chunks still bounds total elapsed time —
+        # a slightly softer bound than MSG_DONTWAIT's, accepted because
+        # O_NONBLOCK/settimeout can't be flipped on the fd the blocking
+        # reader thread shares.
+        tls = isinstance(self.sock, ssl.SSLSocket)
         while sent < len(view):
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -311,15 +328,27 @@ class _MuxConn:
             if not writable:
                 continue
             try:
-                # MSG_DONTWAIT: non-blocking for THIS call only, without
-                # flipping O_NONBLOCK on the shared fd. A plain send() on a
-                # blocking socket queues the ENTIRE buffer before returning
-                # — against a stalled peer a large frame wedges forever no
-                # matter what select said (select only guarantees SOME
-                # space, not len(view) of it).
-                sent += self.sock.send(view[sent:], socket.MSG_DONTWAIT)
-            except (BlockingIOError, InterruptedError):
+                if tls:
+                    sent += self.sock.send(view[sent:sent + TLS_SEND_CHUNK])
+                else:
+                    # MSG_DONTWAIT: non-blocking for THIS call only,
+                    # without flipping O_NONBLOCK on the shared fd. A plain
+                    # send() on a blocking socket queues the ENTIRE buffer
+                    # before returning — against a stalled peer a large
+                    # frame wedges forever no matter what select said
+                    # (select only guarantees SOME space, not len(view)
+                    # of it).
+                    sent += self.sock.send(view[sent:], socket.MSG_DONTWAIT)
+            except (BlockingIOError, InterruptedError,
+                    ssl.SSLWantWriteError, ssl.SSLWantReadError):
                 continue
+            except ValueError as e:
+                # Safety net: a socket variant that rejects flags (or an
+                # operation on a torn-down SSL object) must fail the
+                # connection as a classified MuxError — never escape as an
+                # unhandled ValueError that would kill the calling
+                # controller thread unclassified.
+                raise MuxError(f"mux send: {e}") from None
 
     # -- liveness ------------------------------------------------------
     def _ping_loop(self) -> None:
@@ -505,7 +534,13 @@ class MuxClient:
         #: Consecutive connection-level failures (failed handshakes plus
         #: connections that died before serving a single frame) — NEVER
         #: per-request failures. The kubestore's flap damper reads this.
+        #: Mutated from the dialing thread (under ``_conn_lock``) AND from
+        #: reader/pinger-thread death/alive callbacks, so every mutation
+        #: takes ``_streak_lock`` — racing unlocked ``+=``/``= 0`` could
+        #: lose an increment or a reset and delay (or falsely trip) the
+        #: K-streak mux->HTTP demotion.
         self.fail_streak = 0
+        self._streak_lock = threading.Lock()
 
     # -- connection management -----------------------------------------
     def _handshake(self) -> _MuxConn:
@@ -571,10 +606,12 @@ class MuxClient:
         # Reader/pinger-thread callback: a connection that never served a
         # frame is a connection-level failure episode.
         if not conn.got_frame:
-            self.fail_streak += 1
+            with self._streak_lock:
+                self.fail_streak += 1
 
     def _conn_alive(self, conn: "_MuxConn") -> None:
-        self.fail_streak = 0
+        with self._streak_lock:
+            self.fail_streak = 0
 
     def _ensure_conn(self) -> _MuxConn:
         conn = self._conn
@@ -598,7 +635,8 @@ class MuxClient:
             except MuxUnsupported:
                 raise  # permanent verdict, not a flap: no backoff/streak
             except MuxError:
-                self.fail_streak += 1
+                with self._streak_lock:
+                    self.fail_streak += 1
                 self._backoff = min(
                     max(self._backoff * 2.0, 0.05), self._redial_backoff_max
                 )
